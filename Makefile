@@ -1,0 +1,33 @@
+# Build orchestration for client_tpu: proto codegen + native libraries.
+
+PROTO_DIR := proto
+PB_OUT := client_tpu/_proto
+CXX ?= g++
+CXXFLAGS ?= -O2 -fPIC -Wall -std=c++17
+NATIVE_OUT := client_tpu/utils/shared_memory
+
+.PHONY: all protos native clean test
+
+all: protos
+
+protos: $(PB_OUT)/inference_pb2.py
+
+$(PB_OUT)/inference_pb2.py: $(PROTO_DIR)/inference.proto $(PROTO_DIR)/model_config.proto
+	mkdir -p $(PB_OUT)
+	protoc -I$(PROTO_DIR) --python_out=$(PB_OUT) \
+	    $(PROTO_DIR)/inference.proto $(PROTO_DIR)/model_config.proto
+	# protoc emits absolute imports; rewrite to package-relative.
+	sed -i 's/^import model_config_pb2 as/from . import model_config_pb2 as/' \
+	    $(PB_OUT)/inference_pb2.py
+
+native: $(NATIVE_OUT)/libcshm_tpu.so
+
+$(NATIVE_OUT)/libcshm_tpu.so: src/cpp/shm/cshm.cc
+	mkdir -p $(NATIVE_OUT)
+	$(CXX) $(CXXFLAGS) -shared -o $@ $< -lrt
+
+clean:
+	rm -f $(PB_OUT)/*_pb2.py $(NATIVE_OUT)/libcshm_tpu.so
+
+test:
+	python -m pytest tests/ -x -q

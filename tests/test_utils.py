@@ -1,0 +1,174 @@
+"""Unit tests for client_tpu.utils — dtype mapping and serialization.
+
+Mirrors the behavior contract of tritonclient.utils
+(reference utils/__init__.py:128-345).
+"""
+
+import numpy as np
+import pytest
+
+from client_tpu import utils
+
+
+class TestDtypeMapping:
+    @pytest.mark.parametrize(
+        "np_dtype,triton",
+        [
+            (np.bool_, "BOOL"),
+            (np.int8, "INT8"),
+            (np.int16, "INT16"),
+            (np.int32, "INT32"),
+            (np.int64, "INT64"),
+            (np.uint8, "UINT8"),
+            (np.uint16, "UINT16"),
+            (np.uint32, "UINT32"),
+            (np.uint64, "UINT64"),
+            (np.float16, "FP16"),
+            (np.float32, "FP32"),
+            (np.float64, "FP64"),
+            (np.object_, "BYTES"),
+            (np.bytes_, "BYTES"),
+        ],
+    )
+    def test_np_to_triton(self, np_dtype, triton):
+        assert utils.np_to_triton_dtype(np_dtype) == triton
+
+    def test_bf16_native(self):
+        import ml_dtypes
+
+        assert utils.np_to_triton_dtype(ml_dtypes.bfloat16) == "BF16"
+        assert utils.triton_to_np_dtype("BF16") == np.dtype(ml_dtypes.bfloat16)
+
+    def test_roundtrip(self):
+        for t in ["BOOL", "INT32", "INT64", "UINT8", "FP16", "FP32", "FP64"]:
+            assert utils.np_to_triton_dtype(utils.triton_to_np_dtype(t)) == t
+
+    def test_unknown(self):
+        assert utils.triton_to_np_dtype("NOPE") is None
+
+    def test_element_size(self):
+        assert utils.triton_dtype_element_size("FP32") == 4
+        assert utils.triton_dtype_element_size("BF16") == 2
+        assert utils.triton_dtype_element_size("BYTES") is None
+
+
+class TestByteTensor:
+    def test_roundtrip_bytes(self):
+        arr = np.array([b"hello", b"", b"tpu \x00 world"], dtype=np.object_)
+        wire = utils.serialize_byte_tensor(arr)
+        out = utils.deserialize_bytes_tensor(wire.tobytes())
+        assert list(out) == [b"hello", b"", b"tpu \x00 world"]
+
+    def test_roundtrip_str(self):
+        arr = np.array(["alpha", "beta"], dtype=np.object_)
+        wire = utils.serialize_byte_tensor(arr)
+        out = utils.deserialize_bytes_tensor(wire.tobytes())
+        assert list(out) == [b"alpha", b"beta"]
+
+    def test_row_major_order(self):
+        arr = np.array([[b"a", b"b"], [b"c", b"d"]], dtype=np.object_)
+        wire = utils.serialize_byte_tensor(arr).tobytes()
+        out = utils.deserialize_bytes_tensor(wire)
+        assert list(out) == [b"a", b"b", b"c", b"d"]
+
+    def test_empty(self):
+        arr = np.array([], dtype=np.object_)
+        assert utils.serialize_byte_tensor(arr).size == 0
+
+    def test_serialized_byte_size(self):
+        arr = np.array([b"abc", b"de"], dtype=np.object_)
+        assert utils.serialized_byte_size(arr) == (4 + 3) + (4 + 2)
+        fixed = np.zeros((2, 3), dtype=np.float32)
+        assert utils.serialized_byte_size(fixed) == 24
+
+
+class TestBF16:
+    def test_roundtrip(self):
+        import ml_dtypes
+
+        arr = np.array([1.0, -2.5, 3.25], dtype=np.float32)
+        wire = utils.serialize_bf16_tensor(arr)
+        assert wire.nbytes == 6
+        out = utils.deserialize_bf16_tensor(wire.tobytes())
+        assert out.dtype == np.dtype(ml_dtypes.bfloat16)
+        np.testing.assert_allclose(out.astype(np.float32), arr, rtol=1e-2)
+
+    def test_native_bf16_input(self):
+        import ml_dtypes
+
+        arr = np.array([0.5, 1.5], dtype=ml_dtypes.bfloat16)
+        wire = utils.serialize_bf16_tensor(arr)
+        out = utils.deserialize_bf16_tensor(wire.tobytes())
+        np.testing.assert_array_equal(out.astype(np.float32), [0.5, 1.5])
+
+    def test_rejects_int(self):
+        with pytest.raises(utils.InferenceServerException):
+            utils.serialize_bf16_tensor(np.array([1, 2], dtype=np.int32))
+
+
+class TestWireBridge:
+    def test_fixed_roundtrip(self):
+        arr = np.arange(12, dtype=np.float32).reshape(3, 4)
+        buf = utils.to_wire_bytes(arr, "FP32")
+        out = utils.from_wire_bytes(buf, "FP32", [3, 4])
+        np.testing.assert_array_equal(out, arr)
+
+    def test_bytes_roundtrip(self):
+        arr = np.array([b"x", b"yz"], dtype=np.object_)
+        buf = utils.to_wire_bytes(arr, "BYTES")
+        out = utils.from_wire_bytes(buf, "BYTES", [2])
+        assert list(out) == [b"x", b"yz"]
+
+    def test_jax_array(self):
+        import jax.numpy as jnp
+
+        arr = jnp.ones((2, 2), dtype=jnp.float32)
+        buf = utils.to_wire_bytes(arr, "FP32")
+        assert len(buf) == 16
+
+    def test_dtype_mismatch(self):
+        with pytest.raises(utils.InferenceServerException):
+            utils.to_wire_bytes(np.ones(2, dtype=np.int64), "FP32")
+
+
+class TestException:
+    def test_fields(self):
+        e = utils.InferenceServerException("boom", status="400", debug_details="d")
+        assert e.message() == "boom"
+        assert e.status() == "400"
+        assert e.debug_details() == "d"
+        assert "[400] boom" == str(e)
+
+    def test_raise_error(self):
+        with pytest.raises(utils.InferenceServerException):
+            utils.raise_error("nope")
+
+
+class TestProto:
+    def test_infer_request_roundtrip(self):
+        from client_tpu._proto import inference_pb2 as pb
+
+        req = pb.ModelInferRequest(model_name="m", model_version="2", id="abc")
+        t = req.inputs.add()
+        t.name, t.datatype = "INPUT0", "FP32"
+        t.shape.extend([2, 2])
+        req.raw_input_contents.append(b"\x00" * 16)
+        req.parameters["sequence_id"].int64_param = 7
+        g = pb.ModelInferRequest()
+        g.ParseFromString(req.SerializeToString())
+        assert g.model_name == "m"
+        assert g.parameters["sequence_id"].int64_param == 7
+        assert len(g.raw_input_contents[0]) == 16
+
+    def test_model_config(self):
+        from client_tpu._proto import model_config_pb2 as mc
+
+        c = mc.ModelConfig(name="llama", backend="jax", max_batch_size=4)
+        c.model_transaction_policy.decoupled = True
+        i = c.input.add()
+        i.name, i.data_type = "tokens", mc.TYPE_INT32
+        i.dims.extend([-1])
+        g = mc.ModelConfig()
+        g.ParseFromString(c.SerializeToString())
+        assert g.model_transaction_policy.decoupled
+        assert g.input[0].data_type == mc.TYPE_INT32
