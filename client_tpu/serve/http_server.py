@@ -1,0 +1,312 @@
+"""KServe-v2 HTTP/REST frontend for the in-process inference engine.
+
+Stdlib ThreadingHTTPServer; implements the endpoints the client surface uses:
+health, metadata, config, infer (binary tensor extension + compression),
+repository control, statistics, trace/log settings, and shared-memory verbs
+(system / tpu; cuda answers with an explicit not-supported error since there is
+no cudart anywhere in this framework).
+"""
+
+import base64
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import unquote
+
+from client_tpu import _codec
+from client_tpu.serve import model_runtime
+from client_tpu.utils import InferenceServerException
+
+_MODEL_URI = re.compile(
+    r"^/v2/models/(?P<model>[^/]+)(?:/versions/(?P<version>[^/]+))?(?P<rest>/.*)?$"
+)
+_SHM_URI = re.compile(
+    r"^/v2/(?P<kind>systemsharedmemory|cudasharedmemory|tpusharedmemory)"
+    r"(?:/region/(?P<region>[^/]+))?/(?P<verb>status|register|unregister)$"
+)
+_REPO_URI = re.compile(
+    r"^/v2/repository/(?:index|models/(?P<model>[^/]+)/(?P<verb>load|unload))$"
+)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    engine = None  # set by subclassing in HttpFrontend
+    verbose = False
+
+    def log_message(self, fmt, *args):  # quiet by default
+        if self.verbose:
+            super().log_message(fmt, *args)
+
+    # -- plumbing ------------------------------------------------------------
+
+    def _body(self):
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length) if length else b""
+        return _codec.decompress(body, self.headers.get("Content-Encoding"))
+
+    def _send(self, status, body=b"", headers=None):
+        self.send_response(status)
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.send_header("Content-Length", str(len(body)))
+        self.send_header("Content-Type", "application/json")
+        self.end_headers()
+        if body:
+            self.wfile.write(body)
+
+    def _send_json(self, obj, status=200):
+        self._send(status, json.dumps(obj).encode("utf-8"))
+
+    def _send_error_json(self, exc):
+        status = 400
+        if isinstance(exc, InferenceServerException) and exc.status():
+            try:
+                status = int(exc.status())
+            except ValueError:
+                pass
+        msg = (
+            exc.message()
+            if isinstance(exc, InferenceServerException)
+            else str(exc)
+        )
+        self._send(status, json.dumps({"error": msg}).encode("utf-8"))
+
+    # -- request routing -----------------------------------------------------
+
+    def do_GET(self):
+        try:
+            self._route_get()
+        except InferenceServerException as e:
+            self._send_error_json(e)
+        except Exception as e:  # pragma: no cover - defensive
+            self._send_error_json(InferenceServerException(str(e), status="500"))
+
+    def do_POST(self):
+        try:
+            # Drain the request body up front: error paths that respond without
+            # reading it would desync subsequent requests on this keep-alive
+            # connection.
+            self._post_body = self._body()
+            self._route_post()
+        except InferenceServerException as e:
+            self._send_error_json(e)
+        except json.JSONDecodeError as e:
+            self._send_error_json(
+                InferenceServerException(f"malformed request JSON: {e}", status="400")
+            )
+        except Exception as e:  # pragma: no cover - defensive
+            self._send_error_json(InferenceServerException(str(e), status="500"))
+
+    def _route_get(self):
+        path = self.path.split("?", 1)[0]
+        eng = self.engine
+        if path == "/v2/health/live":
+            return self._send(200)
+        if path == "/v2/health/ready":
+            return self._send(200)
+        if path in ("/v2", "/v2/"):
+            return self._send_json(
+                {
+                    "name": model_runtime.SERVER_NAME,
+                    "version": model_runtime.SERVER_VERSION,
+                    "extensions": model_runtime.SERVER_EXTENSIONS,
+                }
+            )
+        if path == "/v2/logging":
+            return self._send_json(eng.log_settings)
+        if path == "/v2/trace/setting":
+            return self._send_json(eng.trace_settings)
+        if path == "/v2/models/stats":
+            return self._send_json({"model_stats": eng.statistics()})
+        shm = _SHM_URI.match(path)
+        if shm and shm.group("verb") == "status":
+            return self._shm_status(shm)
+        m = _MODEL_URI.match(path)
+        if m:
+            model = unquote(m.group("model"))
+            version = unquote(m.group("version")) if m.group("version") else ""
+            rest = m.group("rest") or ""
+            if rest == "/ready":
+                if eng.model_ready(model, version):
+                    return self._send(200)
+                return self._send(400, json.dumps({"error": "model not ready"}).encode())
+            if rest == "/config":
+                return self._send_json(eng.get_model(model, version).config())
+            if rest == "/stats":
+                return self._send_json(
+                    {"model_stats": eng.statistics(model, version)}
+                )
+            if rest == "/trace/setting":
+                return self._send_json(eng.trace_settings)
+            if rest == "":
+                return self._send_json(eng.get_model(model, version).metadata())
+        raise InferenceServerException(f"unknown endpoint {path}", status="404")
+
+    def _route_post(self):
+        path = self.path.split("?", 1)[0]
+        eng = self.engine
+        if path == "/v2/repository/index":
+            body = self._post_body
+            ready = False
+            if body:
+                ready = bool(json.loads(body.decode("utf-8")).get("ready"))
+            return self._send_json(eng.repository_index(ready))
+        repo = _REPO_URI.match(path)
+        if repo and repo.group("model"):
+            model_name = unquote(repo.group("model"))
+            if repo.group("verb") == "load":
+                payload = (
+                    json.loads(self._post_body.decode("utf-8"))
+                    if self._post_body
+                    else {}
+                )
+                params = payload.get("parameters", {}) or {}
+                config = params.get("config")
+                files = {
+                    k: base64.b64decode(v)
+                    for k, v in params.items()
+                    if k.startswith("file:")
+                }
+                eng.load_model(
+                    model_name,
+                    config_override=json.loads(config) if config else None,
+                    files=files or None,
+                )
+            else:
+                eng.unload_model(model_name)
+            return self._send_json({})
+        shm = _SHM_URI.match(path)
+        if shm:
+            return self._shm_action(shm)
+        if path == "/v2/logging":
+            settings = json.loads(self._post_body.decode("utf-8") or "{}")
+            eng.log_settings.update(settings)
+            return self._send_json(eng.log_settings)
+        if path == "/v2/trace/setting":
+            settings = json.loads(self._post_body.decode("utf-8") or "{}")
+            eng.trace_settings.update(
+                {k: v for k, v in settings.items() if v is not None}
+            )
+            return self._send_json(eng.trace_settings)
+        m = _MODEL_URI.match(path)
+        if m and (m.group("rest") or "") == "/trace/setting":
+            settings = json.loads(self._post_body.decode("utf-8") or "{}")
+            eng.trace_settings.update(
+                {k: v for k, v in settings.items() if v is not None}
+            )
+            return self._send_json(eng.trace_settings)
+        if m and (m.group("rest") or "") == "/infer":
+            return self._infer(
+                unquote(m.group("model")),
+                unquote(m.group("version")) if m.group("version") else "",
+            )
+        raise InferenceServerException(f"unknown endpoint {path}", status="404")
+
+    # -- handlers ------------------------------------------------------------
+
+    def _shm_status(self, match):
+        kind = match.group("kind")
+        region = unquote(match.group("region")) if match.group("region") else ""
+        eng = self.engine
+        if kind == "systemsharedmemory":
+            regions = eng.shm.system_status(region or None)
+        elif kind == "tpusharedmemory":
+            regions = eng.shm.tpu_status(region or None)
+        else:
+            regions = {}
+        return self._send_json(list(regions.values()))
+
+    def _shm_action(self, match):
+        kind = match.group("kind")
+        region = unquote(match.group("region")) if match.group("region") else ""
+        verb = match.group("verb")
+        eng = self.engine
+        if kind == "cudasharedmemory" and verb != "unregister":
+            raise InferenceServerException(
+                "CUDA shared memory is not supported by this server "
+                "(use tpusharedmemory)",
+                status="400",
+            )
+        body = self._post_body
+        payload = json.loads(body.decode("utf-8")) if body else {}
+        if kind == "systemsharedmemory":
+            if verb == "register":
+                eng.shm.register_system(
+                    region,
+                    payload["key"],
+                    payload.get("offset", 0),
+                    payload["byte_size"],
+                )
+            elif verb == "unregister":
+                eng.shm.unregister_system(region or None)
+        elif kind == "tpusharedmemory":
+            if verb == "register":
+                raw = base64.b64decode(payload["raw_handle"]["b64"])
+                eng.shm.register_tpu(
+                    region, raw, payload.get("device_id", 0), payload["byte_size"]
+                )
+            elif verb == "unregister":
+                eng.shm.unregister_tpu(region or None)
+        else:  # cuda unregister: accept as no-op for symmetry
+            pass
+        return self._send_json({})
+
+    def _infer(self, model, version):
+        body = self._post_body
+        header_length = self.headers.get("Inference-Header-Content-Length")
+        request, binary = _codec.parse_infer_request_body(
+            body, int(header_length) if header_length is not None else None
+        )
+        result = self.engine.execute(model, version, request, binary)
+        if isinstance(result, list):
+            if len(result) != 1:
+                raise InferenceServerException(
+                    f"model '{model}' is decoupled; HTTP requires exactly one "
+                    f"response but got {len(result)} — use gRPC streaming",
+                    status="400",
+                )
+            result = result[0]
+        response_json, blobs = result
+        body, json_size = _codec.build_infer_response_body(response_json, blobs)
+        headers = {}
+        if json_size is not None:
+            headers["Inference-Header-Content-Length"] = str(json_size)
+        accept = (self.headers.get("Accept-Encoding") or "").lower()
+        for algo in ("gzip", "deflate"):
+            if algo in accept:
+                body = _codec.compress(body, algo)
+                headers["Content-Encoding"] = algo
+                break
+        return self._send(200, body, headers)
+
+
+class HttpFrontend:
+    """Threaded HTTP server bound to an InferenceEngine."""
+
+    def __init__(self, engine, host="127.0.0.1", port=0, verbose=False):
+        handler = type(
+            "BoundHandler", (_Handler,), {"engine": engine, "verbose": verbose}
+        )
+        self._httpd = ThreadingHTTPServer((host, port), handler)
+        self._httpd.daemon_threads = True
+        self._thread = None
+
+    @property
+    def address(self):
+        host, port = self._httpd.server_address[:2]
+        return f"{host}:{port}"
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="client_tpu-http-server", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)

@@ -1,0 +1,10 @@
+"""JAX/TPU model zoo for the in-process server (flagship models).
+
+Populated by client_tpu.serve.models.* ; ``jax_models()`` returns the servable
+set used by bench.py and the TPU example configs.
+"""
+
+
+def jax_models():
+    from client_tpu.serve.models.vision import cnn_classifier_model
+    return [cnn_classifier_model()]

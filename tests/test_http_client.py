@@ -1,0 +1,352 @@
+"""End-to-end tests: HTTP client against the in-process server.
+
+Covers the capability surface of the reference's HTTP client + examples
+(reference tritonclient/http/__init__.py, src/python/examples/simple_http_*).
+"""
+
+import numpy as np
+import pytest
+
+import client_tpu.http as httpclient
+from client_tpu.serve import Server
+from client_tpu.utils import InferenceServerException
+
+
+@pytest.fixture(scope="module")
+def server():
+    with Server() as s:
+        yield s
+
+
+@pytest.fixture(scope="module")
+def client(server):
+    with httpclient.InferenceServerClient(server.http_address, concurrency=4) as c:
+        yield c
+
+
+def _simple_inputs(binary=True):
+    inputs = [
+        httpclient.InferInput("INPUT0", [1, 16], "INT32"),
+        httpclient.InferInput("INPUT1", [1, 16], "INT32"),
+    ]
+    i0 = np.arange(16, dtype=np.int32).reshape(1, 16)
+    i1 = np.ones((1, 16), dtype=np.int32)
+    inputs[0].set_data_from_numpy(i0, binary_data=binary)
+    inputs[1].set_data_from_numpy(i1, binary_data=binary)
+    return inputs, i0, i1
+
+
+class TestHealth:
+    def test_server_live(self, client):
+        assert client.is_server_live()
+
+    def test_server_ready(self, client):
+        assert client.is_server_ready()
+
+    def test_model_ready(self, client):
+        assert client.is_model_ready("simple")
+        assert client.is_model_ready("simple", "1")
+        assert not client.is_model_ready("no_such_model")
+
+
+class TestMetadata:
+    def test_server_metadata(self, client):
+        meta = client.get_server_metadata()
+        assert meta["name"] == "client_tpu.serve"
+        assert "binary_tensor_data" in meta["extensions"]
+        assert "tpu_shared_memory" in meta["extensions"]
+
+    def test_model_metadata(self, client):
+        meta = client.get_model_metadata("simple")
+        assert meta["name"] == "simple"
+        assert {t["name"] for t in meta["inputs"]} == {"INPUT0", "INPUT1"}
+        assert meta["inputs"][0]["datatype"] == "INT32"
+
+    def test_model_config(self, client):
+        cfg = client.get_model_config("simple")
+        assert cfg["max_batch_size"] == 8
+        assert cfg["input"][0]["data_type"] == "TYPE_INT32"
+
+    def test_unknown_model(self, client):
+        with pytest.raises(InferenceServerException, match="unknown model"):
+            client.get_model_metadata("no_such_model")
+
+
+class TestInfer:
+    def test_binary(self, client):
+        inputs, i0, i1 = _simple_inputs()
+        outputs = [
+            httpclient.InferRequestedOutput("OUTPUT0"),
+            httpclient.InferRequestedOutput("OUTPUT1"),
+        ]
+        result = client.infer("simple", inputs, outputs=outputs)
+        np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), i0 + i1)
+        np.testing.assert_array_equal(result.as_numpy("OUTPUT1"), i0 - i1)
+
+    def test_json_mode(self, client):
+        inputs, i0, i1 = _simple_inputs(binary=False)
+        outputs = [
+            httpclient.InferRequestedOutput("OUTPUT0", binary_data=False),
+            httpclient.InferRequestedOutput("OUTPUT1", binary_data=False),
+        ]
+        result = client.infer("simple", inputs, outputs=outputs)
+        np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), i0 + i1)
+        assert "data" in result.get_output("OUTPUT0")
+
+    def test_no_outputs_requested(self, client):
+        inputs, i0, i1 = _simple_inputs()
+        result = client.infer("simple", inputs)
+        np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), i0 + i1)
+        np.testing.assert_array_equal(result.as_numpy("OUTPUT1"), i0 - i1)
+
+    def test_request_id(self, client):
+        inputs, _, _ = _simple_inputs()
+        result = client.infer("simple", inputs, request_id="my-req-7")
+        assert result.get_response()["id"] == "my-req-7"
+
+    def test_model_version_in_url(self, client):
+        inputs, i0, i1 = _simple_inputs()
+        result = client.infer("simple", inputs, model_version="1")
+        assert result.get_response()["model_version"] == "1"
+
+    def test_bytes_tensor(self, client):
+        arr = np.array([b"tpu", b"native", b"client"], dtype=np.object_)
+        inp = httpclient.InferInput("INPUT0", [3], "BYTES")
+        inp.set_data_from_numpy(arr)
+        result = client.infer("identity_bytes", [inp])
+        assert list(result.as_numpy("OUTPUT0")) == [b"tpu", b"native", b"client"]
+
+    def test_bytes_json_mode(self, client):
+        arr = np.array(["alpha", "beta"], dtype=np.object_)
+        inp = httpclient.InferInput("INPUT0", [2], "BYTES")
+        inp.set_data_from_numpy(arr, binary_data=False)
+        out = httpclient.InferRequestedOutput("OUTPUT0", binary_data=False)
+        result = client.infer("identity_bytes", [inp], outputs=[out])
+        assert [b.decode() for b in result.as_numpy("OUTPUT0")] == ["alpha", "beta"]
+
+    def test_fp32_identity(self, client):
+        arr = np.random.rand(4, 4).astype(np.float32).reshape(16)
+        inp = httpclient.InferInput("INPUT0", [16], "FP32")
+        inp.set_data_from_numpy(arr)
+        result = client.infer("identity", [inp])
+        np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), arr)
+
+    def test_compression_roundtrip(self, client):
+        for algo in ("gzip", "deflate"):
+            inputs, i0, i1 = _simple_inputs()
+            result = client.infer(
+                "simple",
+                inputs,
+                request_compression_algorithm=algo,
+                response_compression_algorithm=algo,
+            )
+            np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), i0 + i1)
+
+    def test_classification(self, client):
+        x = np.array([[0.1, 3.0, 0.5, 1.0]], dtype=np.float32)
+        inp = httpclient.InferInput("INPUT0", [1, 4], "FP32")
+        inp.set_data_from_numpy(x)
+        out = httpclient.InferRequestedOutput("OUTPUT0", class_count=2)
+        result = client.infer("classifier", [inp], outputs=[out])
+        top = result.as_numpy("OUTPUT0")
+        assert top.shape == (1, 2)
+        score, idx, label = top[0][0].decode().split(":")
+        assert idx == "1" and label == "dog"
+
+    def test_wrong_dtype_rejected(self, client):
+        inp = httpclient.InferInput("INPUT0", [1, 16], "INT32")
+        with pytest.raises(InferenceServerException, match="unexpected datatype"):
+            inp.set_data_from_numpy(np.zeros((1, 16), dtype=np.float32))
+
+    def test_wrong_shape_rejected(self, client):
+        inp = httpclient.InferInput("INPUT0", [1, 16], "INT32")
+        with pytest.raises(InferenceServerException, match="unexpected numpy array shape"):
+            inp.set_data_from_numpy(np.zeros((2, 16), dtype=np.int32))
+
+    def test_server_side_dtype_error(self, client):
+        inp = httpclient.InferInput("INPUT0", [1, 16], "FP32")
+        inp.set_data_from_numpy(np.zeros((1, 16), dtype=np.float32))
+        inp2 = httpclient.InferInput("INPUT1", [1, 16], "FP32")
+        inp2.set_data_from_numpy(np.zeros((1, 16), dtype=np.float32))
+        with pytest.raises(InferenceServerException, match="data-type"):
+            client.infer("simple", [inp, inp2])
+
+    def test_missing_input(self, client):
+        inp = httpclient.InferInput("INPUT0", [1, 16], "INT32")
+        inp.set_data_from_numpy(np.zeros((1, 16), dtype=np.int32))
+        with pytest.raises(InferenceServerException, match="expected 2 inputs"):
+            client.infer("simple", [inp])
+
+    def test_jax_array_input(self, client):
+        import jax.numpy as jnp
+
+        arr = jnp.arange(16, dtype=jnp.float32)
+        inp = httpclient.InferInput("INPUT0", [16], "FP32")
+        inp.set_data_from_array(arr)
+        result = client.infer("identity", [inp])
+        np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), np.asarray(arr))
+
+
+class TestAsyncInfer:
+    def test_many_concurrent(self, client):
+        handles = []
+        for i in range(16):
+            inputs, i0, i1 = _simple_inputs()
+            handles.append(client.async_infer("simple", inputs, request_id=str(i)))
+        for i, h in enumerate(handles):
+            result = h.get_result()
+            assert result.get_response()["id"] == str(i)
+
+    def test_error_propagates(self, client):
+        inputs, _, _ = _simple_inputs()
+        handle = client.async_infer("no_such_model", inputs)
+        with pytest.raises(InferenceServerException):
+            handle.get_result()
+
+
+class TestPipelining:
+    def test_generate_and_parse(self, client, server):
+        inputs, i0, i1 = _simple_inputs()
+        body, json_size = httpclient.InferenceServerClient.generate_request_body(
+            inputs, outputs=[httpclient.InferRequestedOutput("OUTPUT0")]
+        )
+        assert json_size is not None
+        import urllib3
+
+        http = urllib3.PoolManager()
+        r = http.request(
+            "POST",
+            f"http://{server.http_address}/v2/models/simple/infer",
+            body=body,
+            headers={"Inference-Header-Content-Length": str(json_size)},
+        )
+        hl = r.headers.get("Inference-Header-Content-Length")
+        result = httpclient.InferenceServerClient.parse_response_body(
+            r.data, header_length=int(hl) if hl else None
+        )
+        np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), i0 + i1)
+
+
+class TestManagement:
+    def test_repository_index(self, client):
+        index = client.get_model_repository_index()
+        names = {m["name"] for m in index}
+        assert "simple" in names and "classifier" in names
+        assert all(m["state"] == "READY" for m in index)
+
+    def test_load_unload(self, client):
+        client.unload_model("identity")
+        assert not client.is_model_ready("identity")
+        index = client.get_model_repository_index()
+        state = {m["name"]: m["state"] for m in index}
+        assert state["identity"] == "UNAVAILABLE"
+        client.load_model("identity")
+        assert client.is_model_ready("identity")
+
+    def test_statistics(self, client):
+        inputs, _, _ = _simple_inputs()
+        client.infer("simple", inputs)
+        stats = client.get_inference_statistics("simple")["model_stats"][0]
+        assert stats["name"] == "simple"
+        assert stats["inference_count"] >= 1
+        assert stats["inference_stats"]["success"]["count"] >= 1
+        assert stats["inference_stats"]["compute_infer"]["ns"] > 0
+
+    def test_all_statistics(self, client):
+        stats = client.get_inference_statistics()["model_stats"]
+        assert len(stats) >= 5
+
+    def test_trace_settings(self, client):
+        settings = client.get_trace_settings()
+        assert "trace_level" in settings
+        updated = client.update_trace_settings(
+            settings={"trace_level": ["TIMESTAMPS"], "trace_rate": "500"}
+        )
+        assert updated["trace_level"] == ["TIMESTAMPS"]
+        assert client.get_trace_settings()["trace_rate"] == "500"
+
+    def test_log_settings(self, client):
+        updated = client.update_log_settings({"log_verbose_level": 2})
+        assert updated["log_verbose_level"] == 2
+        assert client.get_log_settings()["log_verbose_level"] == 2
+
+    def test_unknown_endpoint(self, client):
+        with pytest.raises(InferenceServerException):
+            client._json_or_raise(client._get("v2/bogus"))
+
+    def test_load_with_config_override(self, client):
+        client.load_model("identity", config={"max_batch_size": 64})
+        cfg = client.get_model_config("identity")
+        assert cfg["max_batch_size"] == 64
+        client.load_model("identity")  # reload without override resets
+        assert client.get_model_config("identity")["max_batch_size"] == 0
+
+    def test_keepalive_survives_error_with_body(self, client):
+        # A 400 on a request that carried a body must not desync the pooled
+        # connection for the next call.
+        with pytest.raises(InferenceServerException, match="CUDA"):
+            client.register_cuda_shared_memory("r0", b"\x00" * 16, 0, 64)
+        assert client.is_server_live()
+        inputs, i0, i1 = _simple_inputs()
+        result = client.infer("simple", inputs)
+        np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), i0 + i1)
+
+    def test_percent_encoded_model_name(self, client, server):
+        from client_tpu.serve.builtins import identity_model
+
+        model = identity_model("weird name/v2", "FP32")
+        server.engine.add_model(model)
+        assert client.is_model_ready("weird name/v2")
+        arr = np.ones(4, dtype=np.float32)
+        inp = httpclient.InferInput("INPUT0", [4], "FP32")
+        inp.set_data_from_numpy(arr)
+        result = client.infer("weird name/v2", [inp])
+        np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), arr)
+
+
+class TestSequenceHttp:
+    def test_stateful_accumulation(self, client):
+        def step(value, seq, start=False, end=False):
+            inp = httpclient.InferInput("INPUT", [1], "INT32")
+            inp.set_data_from_numpy(np.array([value], dtype=np.int32))
+            r = client.infer(
+                "simple_sequence",
+                [inp],
+                sequence_id=seq,
+                sequence_start=start,
+                sequence_end=end,
+            )
+            return int(r.as_numpy("OUTPUT")[0])
+
+        assert step(10, 42, start=True) == 10
+        assert step(5, 42) == 15
+        # interleaved different sequence
+        assert step(100, 43, start=True) == 100
+        assert step(1, 42, end=True) == 16
+        # sequence 42 ended; a new start resets
+        assert step(2, 42, start=True) == 2
+
+
+class TestExample:
+    def test_simple_http_infer_client(self, server):
+        import subprocess
+        import sys
+        import os
+
+        env = dict(os.environ)
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        proc = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(repo, "examples", "simple_http_infer_client.py"),
+                "-u",
+                server.http_address,
+            ],
+            capture_output=True,
+            text=True,
+            env=env,
+            timeout=60,
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert "PASS" in proc.stdout
