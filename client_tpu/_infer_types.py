@@ -14,7 +14,6 @@ from client_tpu.utils import (
     InferenceServerException,
     np_to_triton_dtype,
     raise_error,
-    serialized_byte_size,
     to_wire_bytes,
 )
 

@@ -245,7 +245,7 @@ class InferenceServerClient:
         return r.status == 200
 
     def is_model_ready(self, model_name, model_version="", headers=None, query_params=None):
-        uri = f"v2/models/{quote(model_name, safe="")}"
+        uri = f"v2/models/{quote(model_name, safe='')}"
         if model_version:
             uri += f"/versions/{model_version}"
         r = self._get(uri + "/ready", headers, query_params)
@@ -259,7 +259,7 @@ class InferenceServerClient:
     def get_model_metadata(
         self, model_name, model_version="", headers=None, query_params=None
     ):
-        uri = f"v2/models/{quote(model_name, safe="")}"
+        uri = f"v2/models/{quote(model_name, safe='')}"
         if model_version:
             uri += f"/versions/{model_version}"
         return self._json_or_raise(self._get(uri, headers, query_params))
@@ -267,7 +267,7 @@ class InferenceServerClient:
     def get_model_config(
         self, model_name, model_version="", headers=None, query_params=None
     ):
-        uri = f"v2/models/{quote(model_name, safe="")}"
+        uri = f"v2/models/{quote(model_name, safe='')}"
         if model_version:
             uri += f"/versions/{model_version}"
         return self._json_or_raise(self._get(uri + "/config", headers, query_params))
@@ -293,7 +293,7 @@ class InferenceServerClient:
                     content
                 ).decode("utf-8")
         r = self._post(
-            f"v2/repository/models/{quote(model_name, safe="")}/load",
+            f"v2/repository/models/{quote(model_name, safe='')}/load",
             json.dumps(body).encode("utf-8") if body else b"",
             headers,
             query_params,
@@ -305,7 +305,7 @@ class InferenceServerClient:
     ):
         body = {"parameters": {"unload_dependents": unload_dependents}}
         r = self._post(
-            f"v2/repository/models/{quote(model_name, safe="")}/unload",
+            f"v2/repository/models/{quote(model_name, safe='')}/unload",
             json.dumps(body).encode("utf-8"),
             headers,
             query_params,
@@ -318,7 +318,7 @@ class InferenceServerClient:
         self, model_name="", model_version="", headers=None, query_params=None
     ):
         if model_name:
-            uri = f"v2/models/{quote(model_name, safe="")}"
+            uri = f"v2/models/{quote(model_name, safe='')}"
             if model_version:
                 uri += f"/versions/{model_version}"
             uri += "/stats"
@@ -330,7 +330,7 @@ class InferenceServerClient:
         self, model_name="", settings=None, headers=None, query_params=None
     ):
         uri = (
-            f"v2/models/{quote(model_name, safe="")}/trace/setting"
+            f"v2/models/{quote(model_name, safe='')}/trace/setting"
             if model_name
             else "v2/trace/setting"
         )
@@ -341,7 +341,7 @@ class InferenceServerClient:
 
     def get_trace_settings(self, model_name="", headers=None, query_params=None):
         uri = (
-            f"v2/models/{quote(model_name, safe="")}/trace/setting"
+            f"v2/models/{quote(model_name, safe='')}/trace/setting"
             if model_name
             else "v2/trace/setting"
         )
@@ -361,7 +361,7 @@ class InferenceServerClient:
     def _shm_status(self, kind, region_name, headers, query_params):
         uri = f"v2/{kind}"
         if region_name:
-            uri += f"/region/{quote(region_name, safe="")}"
+            uri += f"/region/{quote(region_name, safe='')}"
         uri += "/status"
         return self._json_or_raise(self._get(uri, headers, query_params))
 
@@ -377,7 +377,7 @@ class InferenceServerClient:
             {"key": key, "offset": offset, "byte_size": byte_size}
         ).encode("utf-8")
         r = self._post(
-            f"v2/systemsharedmemory/region/{quote(name, safe="")}/register",
+            f"v2/systemsharedmemory/region/{quote(name, safe='')}/register",
             body,
             headers,
             query_params,
@@ -389,7 +389,7 @@ class InferenceServerClient:
     ):
         uri = "v2/systemsharedmemory"
         if name:
-            uri += f"/region/{quote(name, safe="")}"
+            uri += f"/region/{quote(name, safe='')}"
         uri += "/unregister"
         self._raise_if_error(self._post(uri, b"", headers, query_params))
 
@@ -409,7 +409,7 @@ class InferenceServerClient:
             }
         ).encode("utf-8")
         r = self._post(
-            f"v2/cudasharedmemory/region/{quote(name, safe="")}/register",
+            f"v2/cudasharedmemory/region/{quote(name, safe='')}/register",
             body,
             headers,
             query_params,
@@ -419,7 +419,7 @@ class InferenceServerClient:
     def unregister_cuda_shared_memory(self, name="", headers=None, query_params=None):
         uri = "v2/cudasharedmemory"
         if name:
-            uri += f"/region/{quote(name, safe="")}"
+            uri += f"/region/{quote(name, safe='')}"
         uri += "/unregister"
         self._raise_if_error(self._post(uri, b"", headers, query_params))
 
@@ -440,7 +440,7 @@ class InferenceServerClient:
             }
         ).encode("utf-8")
         r = self._post(
-            f"v2/tpusharedmemory/region/{quote(name, safe="")}/register",
+            f"v2/tpusharedmemory/region/{quote(name, safe='')}/register",
             body,
             headers,
             query_params,
@@ -450,7 +450,7 @@ class InferenceServerClient:
     def unregister_tpu_shared_memory(self, name="", headers=None, query_params=None):
         uri = "v2/tpusharedmemory"
         if name:
-            uri += f"/region/{quote(name, safe="")}"
+            uri += f"/region/{quote(name, safe='')}"
         uri += "/unregister"
         self._raise_if_error(self._post(uri, b"", headers, query_params))
 
@@ -531,7 +531,7 @@ class InferenceServerClient:
         if response_compression_algorithm:
             request_headers["Accept-Encoding"] = response_compression_algorithm
 
-        uri = f"v2/models/{quote(model_name, safe="")}"
+        uri = f"v2/models/{quote(model_name, safe='')}"
         if model_version:
             uri += f"/versions/{model_version}"
         uri += "/infer"

@@ -182,7 +182,9 @@ class _Handler(BaseHTTPRequestHandler):
             return self._shm_action(shm)
         if path == "/v2/logging":
             settings = json.loads(self._post_body.decode("utf-8") or "{}")
-            eng.log_settings.update(settings)
+            eng.log_settings.update(
+                {k: v for k, v in settings.items() if v is not None}
+            )
             return self._send_json(eng.log_settings)
         if path == "/v2/trace/setting":
             settings = json.loads(self._post_body.decode("utf-8") or "{}")

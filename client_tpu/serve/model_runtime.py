@@ -68,6 +68,7 @@ class SequenceContext:
     def __init__(self, sequence_id):
         self.sequence_id = sequence_id
         self.state = {}
+        self.last_used = time.monotonic()
 
 
 class Model:
@@ -285,7 +286,18 @@ class SharedMemoryRegistry:
         staging_key = descriptor["staging_key"]
         with self._lock:
             if name in self._tpu:
-                return
+                old = self._tpu[name]
+                if (
+                    old["descriptor"].get("staging_key") == staging_key
+                    and old["byte_size"] == byte_size
+                    and old["device_id"] == device_id
+                ):
+                    return
+                raise InferenceServerException(
+                    f"TPU shared memory region '{name}' already registered "
+                    "with different attributes",
+                    status="400",
+                )
             mm = _attach_posix_shm(staging_key, byte_size)
             self._tpu[name] = {
                 "device_id": device_id,
@@ -386,13 +398,14 @@ def _attach_posix_shm(key, length):
 class InferenceEngine:
     """Model repository + request execution shared by the HTTP/gRPC frontends."""
 
-    def __init__(self, models=None, strict_model_config=True):
+    def __init__(self, models=None, strict_model_config=True, max_sequence_idle_s=60.0):
         self._lock = threading.Lock()
         self._models = {}
         self._ready = {}
         self._stats = {}
         self.shm = SharedMemoryRegistry()
         self._sequences = {}
+        self.max_sequence_idle_s = max_sequence_idle_s
         self.trace_settings = {
             "trace_file": "",
             "trace_level": ["OFF"],
@@ -549,10 +562,22 @@ class InferenceEngine:
         seq_id = params.get("sequence_id", 0)
         if not seq_id:
             return None
+        now = time.monotonic()
         with self._lock:
+            # Expire sequences idle past the advertised
+            # max_sequence_idle_microseconds so abandoned sequences (client
+            # crashed before sequence_end) don't leak state forever.
+            expired = [
+                sid
+                for sid, ctx in self._sequences.items()
+                if now - ctx.last_used > self.max_sequence_idle_s
+            ]
+            for sid in expired:
+                del self._sequences[sid]
             if params.get("sequence_start") or seq_id not in self._sequences:
                 self._sequences[seq_id] = SequenceContext(seq_id)
             ctx = self._sequences[seq_id]
+            ctx.last_used = now
             if params.get("sequence_end"):
                 self._sequences.pop(seq_id, None)
             return ctx

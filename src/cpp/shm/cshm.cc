@@ -1,0 +1,159 @@
+// libcshm_tpu.so — POSIX shared-memory primitives for the client_tpu Python
+// package (ctypes-loaded by client_tpu/utils/shared_memory).
+//
+// Role parity with the reference wheel's native libcshm.so
+// (/root/reference/src/python/library/tritonclient/utils/shared_memory/
+// shared_memory.cc): create/attach/read/write/destroy POSIX shm regions that
+// KServe-v2 servers map by key. The C ABI here is client_tpu's own design:
+// opaque region handles with explicit error codes, plus attach-only open so
+// the same library serves both producer (client) and consumer (in-process
+// server / tpu staging) roles.
+
+#include <errno.h>
+#include <fcntl.h>
+#include <string.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstdlib>
+#include <string>
+
+namespace {
+
+struct ShmRegion {
+  std::string key;
+  void* base = nullptr;
+  size_t byte_size = 0;
+  int fd = -1;
+  bool owner = false;  // created (vs attached) — owner may unlink
+};
+
+thread_local std::string g_last_error;
+
+void set_error(const std::string& msg) {
+  g_last_error = msg + ": " + strerror(errno);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Error codes returned by the int-returning entry points.
+enum TpuShmStatus {
+  TPU_SHM_OK = 0,
+  TPU_SHM_ERR_OPEN = -1,
+  TPU_SHM_ERR_MAP = -2,
+  TPU_SHM_ERR_RANGE = -3,
+  TPU_SHM_ERR_HANDLE = -4,
+};
+
+const char* TpuShmLastError() { return g_last_error.c_str(); }
+
+// Create (or open existing) a region of byte_size under /dev/shm/<key> and map
+// it read-write. Returns an opaque handle or nullptr.
+void* TpuShmCreate(const char* key, uint64_t byte_size) {
+  int fd = shm_open(key, O_RDWR | O_CREAT, S_IRUSR | S_IWUSR);
+  if (fd < 0) {
+    set_error(std::string("shm_open failed for '") + key + "'");
+    return nullptr;
+  }
+  if (ftruncate(fd, static_cast<off_t>(byte_size)) < 0) {
+    set_error(std::string("ftruncate failed for '") + key + "'");
+    close(fd);
+    return nullptr;
+  }
+  void* base =
+      mmap(nullptr, byte_size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (base == MAP_FAILED) {
+    set_error(std::string("mmap failed for '") + key + "'");
+    close(fd);
+    return nullptr;
+  }
+  auto* region = new ShmRegion();
+  region->key = key;
+  region->base = base;
+  region->byte_size = byte_size;
+  region->fd = fd;
+  region->owner = true;
+  return region;
+}
+
+// Attach to an existing region (no create, no resize).
+void* TpuShmOpen(const char* key, uint64_t byte_size, uint64_t offset) {
+  int fd = shm_open(key, O_RDWR, S_IRUSR | S_IWUSR);
+  if (fd < 0) {
+    set_error(std::string("shm_open failed for '") + key + "'");
+    return nullptr;
+  }
+  void* base = mmap(nullptr, byte_size + offset, PROT_READ | PROT_WRITE,
+                    MAP_SHARED, fd, 0);
+  if (base == MAP_FAILED) {
+    set_error(std::string("mmap failed for '") + key + "'");
+    close(fd);
+    return nullptr;
+  }
+  auto* region = new ShmRegion();
+  region->key = key;
+  region->base = static_cast<char*>(base) + offset;
+  region->byte_size = byte_size;
+  region->fd = fd;
+  region->owner = false;
+  return region;
+}
+
+int TpuShmWrite(void* handle, uint64_t offset, const void* data,
+                uint64_t size) {
+  auto* region = static_cast<ShmRegion*>(handle);
+  if (region == nullptr || region->base == nullptr) return TPU_SHM_ERR_HANDLE;
+  if (offset + size > region->byte_size) {
+    g_last_error = "write overruns region '" + region->key + "'";
+    return TPU_SHM_ERR_RANGE;
+  }
+  memcpy(static_cast<char*>(region->base) + offset, data, size);
+  return TPU_SHM_OK;
+}
+
+int TpuShmRead(void* handle, uint64_t offset, void* dst, uint64_t size) {
+  auto* region = static_cast<ShmRegion*>(handle);
+  if (region == nullptr || region->base == nullptr) return TPU_SHM_ERR_HANDLE;
+  if (offset + size > region->byte_size) {
+    g_last_error = "read overruns region '" + region->key + "'";
+    return TPU_SHM_ERR_RANGE;
+  }
+  memcpy(dst, static_cast<char*>(region->base) + offset, size);
+  return TPU_SHM_OK;
+}
+
+// Zero-copy view for numpy frombuffer over the mapping.
+void* TpuShmBaseAddr(void* handle) {
+  auto* region = static_cast<ShmRegion*>(handle);
+  return region != nullptr ? region->base : nullptr;
+}
+
+uint64_t TpuShmByteSize(void* handle) {
+  auto* region = static_cast<ShmRegion*>(handle);
+  return region != nullptr ? region->byte_size : 0;
+}
+
+// Unmap and close; owner regions also shm_unlink unless keep_key is set.
+int TpuShmClose(void* handle, int keep_key) {
+  auto* region = static_cast<ShmRegion*>(handle);
+  if (region == nullptr) return TPU_SHM_ERR_HANDLE;
+  if (region->base != nullptr) {
+    munmap(region->base, region->byte_size);
+  }
+  if (region->fd >= 0) close(region->fd);
+  int rc = TPU_SHM_OK;
+  if (region->owner && !keep_key) {
+    if (shm_unlink(region->key.c_str()) < 0) {
+      set_error("shm_unlink failed for '" + region->key + "'");
+      rc = TPU_SHM_ERR_OPEN;
+    }
+  }
+  delete region;
+  return rc;
+}
+
+}  // extern "C"

@@ -1,0 +1,156 @@
+"""End-to-end tests for the asyncio clients (http.aio + grpc.aio)."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from client_tpu.serve import Server
+from client_tpu.utils import InferenceServerException
+
+
+@pytest.fixture(scope="module")
+def server():
+    with Server(grpc_port=0) as s:
+        yield s
+
+
+def _run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+def _simple_inputs(mod):
+    inputs = [
+        mod.InferInput("INPUT0", [1, 16], "INT32"),
+        mod.InferInput("INPUT1", [1, 16], "INT32"),
+    ]
+    i0 = np.arange(16, dtype=np.int32).reshape(1, 16)
+    i1 = np.ones((1, 16), dtype=np.int32)
+    inputs[0].set_data_from_numpy(i0)
+    inputs[1].set_data_from_numpy(i1)
+    return inputs, i0, i1
+
+
+class TestHttpAio:
+    def test_full_flow(self, server):
+        import client_tpu.http.aio as aioclient
+
+        async def flow():
+            async with aioclient.InferenceServerClient(server.http_address) as c:
+                assert await c.is_server_live()
+                assert await c.is_server_ready()
+                assert await c.is_model_ready("simple")
+                meta = await c.get_server_metadata()
+                assert meta["name"] == "client_tpu.serve"
+                inputs, i0, i1 = _simple_inputs(aioclient)
+                result = await c.infer("simple", inputs)
+                np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), i0 + i1)
+                stats = await c.get_inference_statistics("simple")
+                assert stats["model_stats"][0]["inference_count"] >= 1
+                index = await c.get_model_repository_index()
+                assert any(m["name"] == "simple" for m in index)
+
+        _run(flow())
+
+    def test_concurrent_infers(self, server):
+        import client_tpu.http.aio as aioclient
+
+        async def flow():
+            async with aioclient.InferenceServerClient(server.http_address) as c:
+                inputs, i0, i1 = _simple_inputs(aioclient)
+                results = await asyncio.gather(
+                    *(c.infer("simple", inputs) for _ in range(8))
+                )
+                for r in results:
+                    np.testing.assert_array_equal(r.as_numpy("OUTPUT0"), i0 + i1)
+
+        _run(flow())
+
+    def test_error(self, server):
+        import client_tpu.http.aio as aioclient
+
+        async def flow():
+            async with aioclient.InferenceServerClient(server.http_address) as c:
+                inputs, _, _ = _simple_inputs(aioclient)
+                with pytest.raises(InferenceServerException, match="unknown model"):
+                    await c.infer("nope", inputs)
+
+        _run(flow())
+
+    def test_compression(self, server):
+        import client_tpu.http.aio as aioclient
+
+        async def flow():
+            async with aioclient.InferenceServerClient(server.http_address) as c:
+                inputs, i0, i1 = _simple_inputs(aioclient)
+                result = await c.infer(
+                    "simple",
+                    inputs,
+                    request_compression_algorithm="gzip",
+                    response_compression_algorithm="gzip",
+                )
+                np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), i0 + i1)
+
+        _run(flow())
+
+
+class TestGrpcAio:
+    def test_full_flow(self, server):
+        import client_tpu.grpc.aio as aioclient
+
+        async def flow():
+            async with aioclient.InferenceServerClient(server.grpc_address) as c:
+                assert await c.is_server_live()
+                assert await c.is_model_ready("simple")
+                meta = await c.get_server_metadata()
+                assert meta.name == "client_tpu.serve"
+                inputs, i0, i1 = _simple_inputs(aioclient)
+                result = await c.infer("simple", inputs)
+                np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), i0 + i1)
+                cfg = await c.get_model_config("simple")
+                assert cfg.config.max_batch_size == 8
+                stats = await c.get_inference_statistics("simple")
+                assert stats.model_stats[0].inference_count >= 1
+
+        _run(flow())
+
+    def test_stream_infer(self, server):
+        import client_tpu.grpc.aio as aioclient
+
+        async def flow():
+            async with aioclient.InferenceServerClient(server.grpc_address) as c:
+                async def requests():
+                    for v in (1, 2, 3):
+                        inp = aioclient.InferInput("INPUT", [1], "INT32")
+                        inp.set_data_from_numpy(np.array([v], dtype=np.int32))
+                        yield {
+                            "model_name": "simple_sequence",
+                            "inputs": [inp],
+                            "sequence_id": 777,
+                            "sequence_start": v == 1,
+                            "sequence_end": v == 3,
+                        }
+
+                acc = []
+                count = 0
+                async for result, error in c.stream_infer(requests()):
+                    assert error is None
+                    acc.append(int(result.as_numpy("OUTPUT")[0]))
+                    count += 1
+                    if count == 3:
+                        break
+                assert acc == [1, 3, 6]
+
+        _run(flow())
+
+    def test_error(self, server):
+        import client_tpu.grpc.aio as aioclient
+
+        async def flow():
+            async with aioclient.InferenceServerClient(server.grpc_address) as c:
+                inputs, _, _ = _simple_inputs(aioclient)
+                with pytest.raises(InferenceServerException) as e:
+                    await c.infer("nope", inputs)
+                assert e.value.status() == "INVALID_ARGUMENT"
+
+        _run(flow())

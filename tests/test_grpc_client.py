@@ -1,0 +1,285 @@
+"""End-to-end tests: gRPC client against the in-process gRPC frontend.
+
+Covers the reference's gRPC surface (tritonclient/grpc) incl. streaming
+sequence workloads (reference simple_grpc_sequence_stream_infer_client) and
+decoupled models.
+"""
+
+import queue
+
+import numpy as np
+import pytest
+
+import client_tpu.grpc as grpcclient
+from client_tpu.serve import Server
+from client_tpu.utils import InferenceServerException
+
+
+@pytest.fixture(scope="module")
+def server():
+    with Server(grpc_port=0) as s:
+        yield s
+
+
+@pytest.fixture()
+def client(server):
+    with grpcclient.InferenceServerClient(server.grpc_address) as c:
+        yield c
+
+
+def _simple_inputs():
+    inputs = [
+        grpcclient.InferInput("INPUT0", [1, 16], "INT32"),
+        grpcclient.InferInput("INPUT1", [1, 16], "INT32"),
+    ]
+    i0 = np.arange(16, dtype=np.int32).reshape(1, 16)
+    i1 = np.full((1, 16), 2, dtype=np.int32)
+    inputs[0].set_data_from_numpy(i0)
+    inputs[1].set_data_from_numpy(i1)
+    return inputs, i0, i1
+
+
+class TestHealthMetadata:
+    def test_health(self, client):
+        assert client.is_server_live()
+        assert client.is_server_ready()
+        assert client.is_model_ready("simple")
+        assert not client.is_model_ready("nope")
+
+    def test_server_metadata(self, client):
+        meta = client.get_server_metadata()
+        assert meta.name == "client_tpu.serve"
+        meta_json = client.get_server_metadata(as_json=True)
+        assert "tpu_shared_memory" in meta_json["extensions"]
+
+    def test_model_metadata(self, client):
+        meta = client.get_model_metadata("simple")
+        assert meta.name == "simple"
+        assert meta.inputs[0].datatype == "INT32"
+        assert list(meta.inputs[0].shape) == [-1, 16]
+
+    def test_model_config_proto(self, client):
+        from client_tpu._proto import model_config_pb2 as mc
+
+        cfg = client.get_model_config("simple").config
+        assert cfg.max_batch_size == 8
+        assert cfg.input[0].data_type == mc.TYPE_INT32
+        decoupled = client.get_model_config("repeat_int32").config
+        assert decoupled.model_transaction_policy.decoupled
+
+    def test_error_status(self, client):
+        with pytest.raises(InferenceServerException) as e:
+            client.get_model_metadata("nope")
+        assert e.value.status() == "INVALID_ARGUMENT"
+
+
+class TestInfer:
+    def test_infer(self, client):
+        inputs, i0, i1 = _simple_inputs()
+        result = client.infer("simple", inputs)
+        np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), i0 + i1)
+        np.testing.assert_array_equal(result.as_numpy("OUTPUT1"), i0 - i1)
+
+    def test_requested_output_subset(self, client):
+        inputs, i0, i1 = _simple_inputs()
+        result = client.infer(
+            "simple", inputs, outputs=[grpcclient.InferRequestedOutput("OUTPUT1")]
+        )
+        assert result.as_numpy("OUTPUT0") is None
+        np.testing.assert_array_equal(result.as_numpy("OUTPUT1"), i0 - i1)
+
+    def test_request_id_and_version(self, client):
+        inputs, _, _ = _simple_inputs()
+        result = client.infer(
+            "simple", inputs, model_version="1", request_id="rq1"
+        )
+        assert result.get_response().id == "rq1"
+        assert result.get_response().model_version == "1"
+
+    def test_bytes_roundtrip(self, client):
+        arr = np.array([b"grpc", b"bytes"], dtype=np.object_)
+        inp = grpcclient.InferInput("INPUT0", [2], "BYTES")
+        inp.set_data_from_numpy(arr)
+        result = client.infer("identity_bytes", [inp])
+        assert list(result.as_numpy("OUTPUT0")) == [b"grpc", b"bytes"]
+
+    def test_classification(self, client):
+        x = np.array([[0.1, 3.0, 0.5, 1.0]], dtype=np.float32)
+        inp = grpcclient.InferInput("INPUT0", [1, 4], "FP32")
+        inp.set_data_from_numpy(x)
+        out = grpcclient.InferRequestedOutput("OUTPUT0", class_count=2)
+        result = client.infer("classifier", [inp], outputs=[out])
+        top = result.as_numpy("OUTPUT0")
+        assert top.shape == (1, 2)
+        assert top[0][0].decode().split(":")[1:] == ["1", "dog"]
+
+    def test_compression(self, client):
+        inputs, i0, i1 = _simple_inputs()
+        result = client.infer("simple", inputs, compression_algorithm="gzip")
+        np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), i0 + i1)
+
+    def test_decoupled_unary_rejected(self, client):
+        inp = grpcclient.InferInput("IN", [1], "INT32")
+        inp.set_data_from_numpy(np.array([2], dtype=np.int32))
+        with pytest.raises(InferenceServerException, match="decoupled"):
+            client.infer("repeat_int32", [inp])
+
+    def test_custom_parameters(self, client):
+        inputs, i0, i1 = _simple_inputs()
+        result = client.infer("simple", inputs, parameters={"my_param": "x"})
+        np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), i0 + i1)
+
+    def test_reserved_parameter_rejected(self, client):
+        inputs, _, _ = _simple_inputs()
+        with pytest.raises(InferenceServerException, match="reserved"):
+            client.infer("simple", inputs, parameters={"sequence_id": 1})
+
+
+class TestAsyncInfer:
+    def test_callback(self, client):
+        results = queue.Queue()
+        inputs, i0, i1 = _simple_inputs()
+        client.async_infer(
+            "simple",
+            inputs,
+            callback=lambda result, error: results.put((result, error)),
+        )
+        result, error = results.get(timeout=10)
+        assert error is None
+        np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), i0 + i1)
+
+    def test_callback_error(self, client):
+        results = queue.Queue()
+        inputs, _, _ = _simple_inputs()
+        client.async_infer(
+            "nope",
+            inputs,
+            callback=lambda result, error: results.put((result, error)),
+        )
+        result, error = results.get(timeout=10)
+        assert result is None
+        assert isinstance(error, InferenceServerException)
+        assert error.status() == "INVALID_ARGUMENT"
+
+
+class TestStreaming:
+    def test_two_sequences_one_stream(self, client):
+        """Parity scenario: reference
+        simple_grpc_sequence_stream_infer_client.cc:96-136 drives two
+        stateful sequences concurrently on one bidi stream."""
+        results = queue.Queue()
+        client.start_stream(
+            callback=lambda result, error: results.put((result, error))
+        )
+        values = [11, 7, 5, 3, 2, 0, 1]
+
+        def send(value, seq, start=False, end=False):
+            inp = grpcclient.InferInput("INPUT", [1], "INT32")
+            inp.set_data_from_numpy(np.array([value], dtype=np.int32))
+            client.async_stream_infer(
+                "simple_sequence",
+                [inp],
+                request_id=f"{seq}_{value}",
+                sequence_id=seq,
+                sequence_start=start,
+                sequence_end=end,
+            )
+
+        for i, v in enumerate(values):
+            send(v, 1001, start=(i == 0), end=(i == len(values) - 1))
+            send(-v, 1002, start=(i == 0), end=(i == len(values) - 1))
+
+        seq_results = {1001: [], 1002: []}
+        for _ in range(2 * len(values)):
+            result, error = results.get(timeout=15)
+            assert error is None
+            rid = result.get_response().id
+            seq = int(rid.split("_")[0])
+            seq_results[seq].append(int(result.as_numpy("OUTPUT")[0]))
+        client.stop_stream()
+        expected = list(np.cumsum(values))
+        assert seq_results[1001] == expected
+        assert seq_results[1002] == [-v for v in expected]
+
+    def test_decoupled_stream(self, client):
+        results = queue.Queue()
+        client.start_stream(
+            callback=lambda result, error: results.put((result, error))
+        )
+        inp = grpcclient.InferInput("IN", [1], "INT32")
+        inp.set_data_from_numpy(np.array([5], dtype=np.int32))
+        client.async_stream_infer("repeat_int32", [inp])
+        got = []
+        for _ in range(5):
+            result, error = results.get(timeout=15)
+            assert error is None
+            got.append(int(result.as_numpy("OUT")[0]))
+        client.stop_stream()
+        assert got == [0, 1, 2, 3, 4]
+
+    def test_stream_error_reported(self, client):
+        results = queue.Queue()
+        client.start_stream(
+            callback=lambda result, error: results.put((result, error))
+        )
+        inputs, _, _ = _simple_inputs()
+        # unknown model inside the stream -> error via callback, stream survives
+        for inp in inputs:
+            pass
+        client.async_stream_infer("nope", inputs)
+        result, error = results.get(timeout=15)
+        assert error is not None
+        client.stop_stream()
+
+    def test_double_start_rejected(self, client):
+        client.start_stream(callback=lambda result, error: None)
+        with pytest.raises(InferenceServerException, match="already active"):
+            client.start_stream(callback=lambda result, error: None)
+        client.stop_stream()
+
+
+class TestManagement:
+    def test_repository(self, client):
+        index = client.get_model_repository_index()
+        names = {m.name for m in index.models}
+        assert "simple" in names
+        client.unload_model("identity")
+        assert not client.is_model_ready("identity")
+        client.load_model("identity")
+        assert client.is_model_ready("identity")
+
+    def test_load_with_config(self, client):
+        client.load_model("identity", config={"max_batch_size": 16})
+        assert client.get_model_config("identity").config.max_batch_size == 16
+        client.load_model("identity")
+
+    def test_statistics(self, client):
+        inputs, _, _ = _simple_inputs()
+        client.infer("simple", inputs)
+        stats = client.get_inference_statistics("simple")
+        entry = stats.model_stats[0]
+        assert entry.name == "simple"
+        assert entry.inference_count >= 1
+        assert entry.inference_stats.success.count >= 1
+
+    def test_trace_settings(self, client):
+        settings = client.get_trace_settings()
+        assert "trace_level" in settings.settings
+        updated = client.update_trace_settings(
+            settings={"trace_rate": "250", "trace_level": ["TIMESTAMPS", "TENSORS"]}
+        )
+        assert list(updated.settings["trace_level"].value) == [
+            "TIMESTAMPS",
+            "TENSORS",
+        ]
+        assert updated.settings["trace_rate"].value[0] == "250"
+
+    def test_log_settings(self, client):
+        updated = client.update_log_settings({"log_verbose_level": 3})
+        assert updated.settings["log_verbose_level"].uint32_param == 3
+        got = client.get_log_settings(as_json=True)
+        assert got["settings"]["log_verbose_level"]["uint32_param"] == 3
+
+    def test_cuda_shm_rejected(self, client):
+        with pytest.raises(InferenceServerException, match="CUDA"):
+            client.register_cuda_shared_memory("r", b"\x00" * 8, 0, 64)
