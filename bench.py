@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""End-to-end benchmark: KServe-v2 infer round trips against the in-process
+server with the TPU CNN classifier (BASELINE.md config-2 shape: image in,
+class scores out).
+
+Drives the gRPC client at fixed concurrency through the full protocol path
+(serialize → gRPC → engine → jitted TPU forward → response parse) and reports
+throughput + latency percentiles.  vs_baseline compares infer/sec against the
+reference perf_analyzer doc example (69.6 infer/sec, batch 1, concurrency 1 —
+/root/reference/src/c++/perf_analyzer/README.md:60).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import sys
+import threading
+import time
+
+import numpy as np
+
+_REF_INFER_PER_SEC = 69.6
+
+WARMUP_S = 3.0
+MEASURE_S = 10.0
+CONCURRENCY = 4
+IMAGE_SIZE = 224
+
+
+def main():
+    import client_tpu.grpc as grpcclient
+    from client_tpu.serve import Server
+    from client_tpu.serve.models.vision import cnn_classifier_model
+
+    server = Server(
+        models=[cnn_classifier_model(image_size=IMAGE_SIZE)],
+        grpc_port=0,
+        with_default_models=False,
+    ).start()
+    url = server.grpc_address
+
+    rng = np.random.default_rng(0)
+    image = rng.standard_normal((1, 3, IMAGE_SIZE, IMAGE_SIZE)).astype(np.float32)
+
+    stop = threading.Event()
+    lock = threading.Lock()
+    latencies = []
+    measuring = threading.Event()
+
+    def worker():
+        client = grpcclient.InferenceServerClient(url)
+        inp = grpcclient.InferInput("INPUT0", list(image.shape), "FP32")
+        inp.set_data_from_numpy(image)
+        out = grpcclient.InferRequestedOutput("OUTPUT0")
+        while not stop.is_set():
+            t0 = time.perf_counter()
+            result = client.infer("cnn_classifier", [inp], outputs=[out])
+            dt = time.perf_counter() - t0
+            scores = result.as_numpy("OUTPUT0")
+            assert scores.shape == (1, 1000), scores.shape
+            if measuring.is_set():
+                with lock:
+                    latencies.append(dt)
+        client.close()
+
+    threads = [threading.Thread(target=worker, daemon=True) for _ in range(CONCURRENCY)]
+    for t in threads:
+        t.start()
+
+    time.sleep(WARMUP_S)
+    measuring.set()
+    t_start = time.perf_counter()
+    time.sleep(MEASURE_S)
+    measuring.clear()
+    elapsed = time.perf_counter() - t_start
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    server.stop()
+
+    with lock:
+        lat = np.asarray(latencies)
+    if lat.size == 0:
+        print(json.dumps({"metric": "infer_throughput", "value": 0.0,
+                          "unit": "infer/sec", "vs_baseline": 0.0}))
+        return 1
+
+    throughput = lat.size / elapsed
+    result = {
+        "metric": "infer_throughput_cnn224_grpc_c4",
+        "value": round(throughput, 2),
+        "unit": "infer/sec",
+        "vs_baseline": round(throughput / _REF_INFER_PER_SEC, 3),
+        "p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 3),
+        "p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 3),
+        "requests": int(lat.size),
+        "concurrency": CONCURRENCY,
+    }
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
