@@ -280,15 +280,16 @@ class SharedMemoryRegistry:
     # tpu ------------------------------------------------------------------
 
     def register_tpu(self, name, raw_handle, device_id, byte_size):
+        from client_tpu.utils import tpu_shared_memory as _tpushm
+
         descriptor = json.loads(
             raw_handle.decode("utf-8") if isinstance(raw_handle, bytes) else raw_handle
         )
-        staging_key = descriptor["staging_key"]
         with self._lock:
             if name in self._tpu:
                 old = self._tpu[name]
                 if (
-                    old["descriptor"].get("staging_key") == staging_key
+                    old["descriptor"].get("uuid") == descriptor.get("uuid")
                     and old["byte_size"] == byte_size
                     and old["device_id"] == device_id
                 ):
@@ -298,12 +299,29 @@ class SharedMemoryRegistry:
                     "with different attributes",
                     status="400",
                 )
-            mm = _attach_posix_shm(staging_key, byte_size)
+            # Same-process client (in-process server / C-API analog): resolve
+            # the live HBM region through the broker — zero-copy jax.Array
+            # access, no staging.  Otherwise fall back to the host staging
+            # mirror the descriptor advertises.
+            region_obj = _tpushm.resolve_inprocess(descriptor)
+            mm = None
+            if region_obj is None:
+                staging_key = descriptor.get("staging_key")
+                if staging_key is None:
+                    raise InferenceServerException(
+                        f"TPU region '{name}' was created in another process "
+                        "without a staging_key; cross-process registration "
+                        "requires host staging (PJRT has no cross-process "
+                        "buffer export)",
+                        status="400",
+                    )
+                mm = _attach_posix_shm(staging_key, byte_size)
             self._tpu[name] = {
                 "device_id": device_id,
                 "byte_size": byte_size,
                 "descriptor": descriptor,
                 "mmap": mm,
+                "region_obj": region_obj,
             }
 
     def unregister_tpu(self, name=None):
@@ -311,7 +329,7 @@ class SharedMemoryRegistry:
             names = [name] if name else list(self._tpu)
             for n in names:
                 region = self._tpu.pop(n, None)
-                if region is not None:
+                if region is not None and region["mmap"] is not None:
                     region["mmap"].close()
 
     def tpu_status(self, name=None):
@@ -348,9 +366,61 @@ class SharedMemoryRegistry:
             )
         return region, base
 
+    def read_tensor(self, region_name, offset, byte_size, datatype, shape):
+        """Resolve an input tensor from a region.  In-process TPU regions
+        return the live jax.Array (zero-copy); others decode from bytes."""
+        with self._lock:
+            region = self._tpu.get(region_name)
+            obj = region.get("region_obj") if region else None
+        if obj is not None:
+            try:
+                return obj.read_array(offset, byte_size, datatype, shape)
+            except InferenceServerException as e:
+                raise InferenceServerException(e.message(), status="400") from e
+        raw = self.read(region_name, offset, byte_size)
+        return from_wire_bytes(raw, datatype, shape)
+
+    def write_tensor(self, region_name, offset, arr, datatype, max_byte_size):
+        """Write an output tensor into a region; returns bytes written.
+        In-process TPU regions store the device array directly (no D2H)."""
+        with self._lock:
+            region = self._tpu.get(region_name)
+            obj = region.get("region_obj") if region else None
+        if obj is not None:
+            if not (isinstance(arr, np.ndarray) and arr.dtype == np.object_):
+                from client_tpu.utils import triton_to_np_dtype
+
+                want = triton_to_np_dtype(datatype)
+                if want is not None and arr.dtype != np.dtype(want):
+                    arr = arr.astype(want)  # device-side cast, stays resident
+                nbytes = arr.dtype.itemsize * int(np.prod(arr.shape))
+            else:
+                nbytes = len(to_wire_bytes(arr, datatype))
+            if nbytes > max_byte_size:
+                raise InferenceServerException(
+                    f"output needs {nbytes} bytes but region '{region_name}' "
+                    f"mapping holds {max_byte_size}",
+                    status="400",
+                )
+            obj.write_array(offset, arr)
+            return nbytes
+        raw = to_wire_bytes(np.asarray(arr), datatype)
+        if len(raw) > max_byte_size:
+            raise InferenceServerException(
+                f"output needs {len(raw)} bytes but region '{region_name}' "
+                f"mapping holds {max_byte_size}",
+                status="400",
+            )
+        self.write(region_name, offset, raw)
+        return len(raw)
+
     def read(self, region_name, offset, byte_size):
         with self._lock:
             region, base = self._find(region_name)
+            if region["mmap"] is None:
+                raise InferenceServerException(
+                    f"region '{region_name}' has no host mapping", status="400"
+                )
             if offset + byte_size > region["byte_size"]:
                 raise InferenceServerException(
                     f"read of {byte_size} bytes at offset {offset} overruns "
@@ -363,6 +433,10 @@ class SharedMemoryRegistry:
     def write(self, region_name, offset, data):
         with self._lock:
             region, base = self._find(region_name)
+            if region["mmap"] is None:
+                raise InferenceServerException(
+                    f"region '{region_name}' has no host mapping", status="400"
+                )
             if offset + len(data) > region["byte_size"]:
                 raise InferenceServerException(
                     f"write of {len(data)} bytes at offset {offset} overruns "
@@ -605,12 +679,13 @@ class InferenceEngine:
                 )
             params = entry.get("parameters", {}) or {}
             if "shared_memory_region" in params:
-                raw = self.shm.read(
+                arrays[name] = self.shm.read_tensor(
                     params["shared_memory_region"],
                     params.get("shared_memory_offset", 0),
                     params["shared_memory_byte_size"],
+                    datatype,
+                    shape,
                 )
-                arrays[name] = from_wire_bytes(raw, datatype, shape)
             elif "binary_data_size" in params:
                 size = params["binary_data_size"]
                 raw = binary_section[offset : offset + size]
@@ -659,16 +734,21 @@ class InferenceEngine:
                     f"'{model.name}'",
                     status="400",
                 )
-            arr = np.asarray(result_arrays[name])
+            # keep the model's output device-resident until the disposition is
+            # known — the TPU-shm path never needs a D2H transfer; outputs
+            # without array protocol (lists, scalars) normalize host-side
+            arr = result_arrays[name]
+            if not hasattr(arr, "dtype"):
+                arr = np.asarray(arr)
             spec = specs.get(name)
             datatype = (
-                spec.datatype
-                if spec is not None
-                else _np_dtype_to_wire(arr)
+                spec.datatype if spec is not None else _np_dtype_to_wire(arr)
             )
             class_count = params.get("classification", 0)
             if class_count:
-                arr = _classify(arr, class_count, spec.labels if spec else [])
+                arr = _classify(
+                    np.asarray(arr), class_count, spec.labels if spec else []
+                )
                 datatype = "BYTES"
             entry = {
                 "name": name,
@@ -676,37 +756,32 @@ class InferenceEngine:
                 "shape": list(arr.shape),
             }
             if "shared_memory_region" in params:
-                raw = to_wire_bytes(arr, datatype)
-                byte_size = params["shared_memory_byte_size"]
-                if len(raw) > byte_size:
-                    raise InferenceServerException(
-                        f"output '{name}' needs {len(raw)} bytes but region "
-                        f"holds {byte_size}",
-                        status="400",
-                    )
-                self.shm.write(
+                written = self.shm.write_tensor(
                     params["shared_memory_region"],
                     params.get("shared_memory_offset", 0),
-                    raw,
+                    arr,
+                    datatype,
+                    params["shared_memory_byte_size"],
                 )
                 entry["parameters"] = {
                     "shared_memory_region": params["shared_memory_region"],
-                    "shared_memory_byte_size": len(raw),
+                    "shared_memory_byte_size": written,
                 }
             elif params.get("binary_data", False):
-                raw = to_wire_bytes(arr, datatype)
+                raw = to_wire_bytes(np.asarray(arr), datatype)
                 entry["parameters"] = {"binary_data_size": len(raw)}
                 blobs.append(raw)
             else:
+                host = np.asarray(arr)
                 if datatype == "BYTES":
                     entry["data"] = [
                         v.decode("utf-8", errors="replace")
                         if isinstance(v, bytes)
                         else str(v)
-                        for v in arr.flatten()
+                        for v in host.flatten()
                     ]
                 else:
-                    entry["data"] = [v.item() for v in arr.flatten()]
+                    entry["data"] = [v.item() for v in host.flatten()]
             outputs_json.append(entry)
 
         response = {

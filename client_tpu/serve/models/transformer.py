@@ -224,9 +224,11 @@ def decode_step(params, token, cfg, cache):
         k = _rope(k, pos[:, None], cfg.rope_theta)
         # write this step's k/v at position `pos` (same for all batch rows in
         # the serving path; use per-row dynamic slice via one-hot scatter)
-        onehot = jax.nn.one_hot(pos, cfg.max_seq, dtype=k.dtype)  # [B,S]
-        cache["k"][i] = cache["k"][i] + onehot[:, :, None, None] * k
-        cache["v"][i] = cache["v"][i] + onehot[:, :, None, None] * v
+        # overwrite (not add) the slot at `pos` so a reused cache with stale
+        # rows beyond the prompt can't corrupt this step's K/V
+        slot = (jnp.arange(cfg.max_seq)[None, :] == pos[:, None])[:, :, None, None]
+        cache["k"][i] = jnp.where(slot, k, cache["k"][i])
+        cache["v"][i] = jnp.where(slot, v, cache["v"][i])
         # attention against the full static-shape cache, length-masked
         n_rep = cfg.n_heads // cfg.n_kv_heads
         kk = _repeat_kv(cache["k"][i], n_rep)
@@ -275,6 +277,16 @@ def make_train_step(cfg, mesh=None, attn_impl="plain", learning_rate=1e-3):
     return opt, jax.jit(step, donate_argnums=(0, 1))
 
 
+@functools.lru_cache(maxsize=8)
+def _jitted_steps(cfg):
+    """Per-config jitted prefill/decode (cfg is a frozen dataclass, hashable);
+    caching here keeps repeated generate() calls on the same compiled programs."""
+    return (
+        jax.jit(functools.partial(prefill, cfg=cfg)),
+        jax.jit(functools.partial(decode_step, cfg=cfg)),
+    )
+
+
 def generate(params, cfg, prompt, max_new_tokens, temperature=0.0, key=None):
     """Greedy/sampled generation; yields one int token id at a time.
 
@@ -291,8 +303,7 @@ def generate(params, cfg, prompt, max_new_tokens, temperature=0.0, key=None):
     # slot is max_seq - 1
     max_new_tokens = min(max_new_tokens, cfg.max_seq - prompt.shape[1])
     cache = init_cache(cfg, prompt.shape[0])
-    prefill_fn = jax.jit(functools.partial(prefill, cfg=cfg))
-    decode_fn = jax.jit(functools.partial(decode_step, cfg=cfg))
+    prefill_fn, decode_fn = _jitted_steps(cfg)
     logits, cache = prefill_fn(params, prompt, cache=cache)
     for i in range(max_new_tokens):
         if temperature > 0.0:

@@ -71,9 +71,11 @@ class CnnClassifier:
         self._forward = jax.jit(_cnn_forward)
 
     def __call__(self, inputs, params, ctx):
+        # jnp.asarray is a no-op for device-resident (TPU-shm) inputs; the
+        # output stays a device array so shm-output responses never force a
+        # D2H sync — the runtime materializes only for wire-tensor responses.
         x = jnp.asarray(inputs["INPUT0"])
-        scores = self._forward(self.params, x)
-        return {"OUTPUT0": np.asarray(scores)}
+        return {"OUTPUT0": self._forward(self.params, x)}
 
 
 def cnn_classifier_model(name="cnn_classifier", image_size=224):

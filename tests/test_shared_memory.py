@@ -1,0 +1,201 @@
+"""Shared-memory transports end-to-end: system shm (native lib) and TPU
+device-buffer regions (in-process zero-copy + staging fallback).
+
+Mirrors the reference's simple_grpc_shm_client / simple_grpc_cudashm_client
+flows (SURVEY.md §3.5) against the hermetic server.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import client_tpu.grpc as grpcclient
+from client_tpu.serve import Server
+from client_tpu.utils import InferenceServerException
+from client_tpu.utils import shared_memory as sysshm
+from client_tpu.utils import tpu_shared_memory as tpushm
+
+
+@pytest.fixture(scope="module")
+def server():
+    with Server(grpc_port=0) as s:
+        yield s
+
+
+@pytest.fixture()
+def client(server):
+    with grpcclient.InferenceServerClient(server.grpc_address) as c:
+        yield c
+
+
+_NATIVE_BUILT = os.path.exists(
+    os.path.join(os.path.dirname(sysshm.__file__), "libcshm_tpu.so")
+)
+needs_native = pytest.mark.skipif(
+    not _NATIVE_BUILT, reason="libcshm_tpu.so not built (make native)"
+)
+
+
+@needs_native
+class TestSystemShm:
+    def test_round_trip_local(self):
+        h = sysshm.create_shared_memory_region("reg0", "/cl_tpu_test0", 256)
+        try:
+            data = np.arange(16, dtype=np.int32)
+            sysshm.set_shared_memory_region(h, [data])
+            back = sysshm.get_contents_as_numpy(h, np.int32, [16])
+            np.testing.assert_array_equal(back, data)
+            assert "reg0" in sysshm.mapped_shared_memory_regions()
+        finally:
+            sysshm.destroy_shared_memory_region(h)
+        assert "reg0" not in sysshm.mapped_shared_memory_regions()
+
+    def test_infer_via_system_shm(self, client):
+        i0 = np.arange(16, dtype=np.int32).reshape(1, 16)
+        i1 = np.full((1, 16), 3, dtype=np.int32)
+        byte_size = i0.nbytes + i1.nbytes
+        h_in = sysshm.create_shared_memory_region("input_sys", "/cl_in0", byte_size)
+        h_out = sysshm.create_shared_memory_region("output_sys", "/cl_out0", byte_size)
+        try:
+            sysshm.set_shared_memory_region(h_in, [i0, i1])
+            client.register_system_shared_memory("input_sys", "/cl_in0", byte_size)
+            client.register_system_shared_memory("output_sys", "/cl_out0", byte_size)
+
+            status = client.get_system_shared_memory_status(as_json=True)
+            names = set(status.get("regions", {}))
+            assert {"input_sys", "output_sys"} <= names
+
+            inputs = [
+                grpcclient.InferInput("INPUT0", [1, 16], "INT32"),
+                grpcclient.InferInput("INPUT1", [1, 16], "INT32"),
+            ]
+            inputs[0].set_shared_memory("input_sys", i0.nbytes)
+            inputs[1].set_shared_memory("input_sys", i1.nbytes, offset=i0.nbytes)
+            outputs = [
+                grpcclient.InferRequestedOutput("OUTPUT0"),
+                grpcclient.InferRequestedOutput("OUTPUT1"),
+            ]
+            outputs[0].set_shared_memory("output_sys", i0.nbytes)
+            outputs[1].set_shared_memory("output_sys", i1.nbytes, offset=i0.nbytes)
+
+            result = client.infer("simple", inputs, outputs=outputs)
+            out0 = result.get_output("OUTPUT0")
+            assert out0 is not None
+            sum_ = sysshm.get_contents_as_numpy(h_out, np.int32, [1, 16])
+            diff = sysshm.get_contents_as_numpy(
+                h_out, np.int32, [1, 16], offset=i0.nbytes
+            )
+            np.testing.assert_array_equal(sum_, i0 + i1)
+            np.testing.assert_array_equal(diff, i0 - i1)
+        finally:
+            client.unregister_system_shared_memory()
+            sysshm.destroy_shared_memory_region(h_in)
+            sysshm.destroy_shared_memory_region(h_out)
+
+    def test_register_unknown_key_errors(self, client):
+        with pytest.raises(InferenceServerException):
+            client.register_system_shared_memory("bad", "/does_not_exist_key", 64)
+
+
+class TestTpuShm:
+    def test_local_round_trip(self):
+        h = tpushm.create_shared_memory_region("tpu0", 1024)
+        try:
+            data = np.linspace(0, 1, 32, dtype=np.float32).reshape(4, 8)
+            tpushm.set_shared_memory_region(h, [data])
+            back = tpushm.get_contents_as_numpy(h, "FP32", [4, 8])
+            np.testing.assert_allclose(back, data)
+            live = tpushm.get_contents_as_jax(h)
+            import jax
+
+            assert isinstance(live, jax.Array)
+            assert "tpu0" in tpushm.allocated_shared_memory_regions()
+        finally:
+            tpushm.destroy_shared_memory_region(h)
+        assert "tpu0" not in tpushm.allocated_shared_memory_regions()
+
+    def test_infer_via_tpu_shm_zero_copy(self, client):
+        i0 = np.arange(16, dtype=np.int32).reshape(1, 16)
+        i1 = np.full((1, 16), 5, dtype=np.int32)
+        h_in = tpushm.create_shared_memory_region("tpu_in", 256)
+        h_out = tpushm.create_shared_memory_region("tpu_out", 256)
+        try:
+            tpushm.set_shared_memory_region(h_in, [i0, i1])
+            client.register_tpu_shared_memory(
+                "tpu_in", tpushm.get_raw_handle(h_in), 0, 256
+            )
+            client.register_tpu_shared_memory(
+                "tpu_out", tpushm.get_raw_handle(h_out), 0, 256
+            )
+            status = client.get_tpu_shared_memory_status(as_json=True)
+            names = set(status.get("regions", {}))
+            assert {"tpu_in", "tpu_out"} <= names
+
+            inputs = [
+                grpcclient.InferInput("INPUT0", [1, 16], "INT32"),
+                grpcclient.InferInput("INPUT1", [1, 16], "INT32"),
+            ]
+            inputs[0].set_shared_memory("tpu_in", i0.nbytes)
+            inputs[1].set_shared_memory("tpu_in", i1.nbytes, offset=i0.nbytes)
+            outputs = [
+                grpcclient.InferRequestedOutput("OUTPUT0"),
+                grpcclient.InferRequestedOutput("OUTPUT1"),
+            ]
+            outputs[0].set_shared_memory("tpu_out", i0.nbytes)
+            outputs[1].set_shared_memory("tpu_out", i1.nbytes, offset=i0.nbytes)
+
+            client.infer("simple", inputs, outputs=outputs)
+
+            sum_ = tpushm.get_contents_as_numpy(h_out, "INT32", [1, 16])
+            diff = tpushm.get_contents_as_numpy(
+                h_out, "INT32", [1, 16], offset=i0.nbytes
+            )
+            np.testing.assert_array_equal(sum_, i0 + i1)
+            np.testing.assert_array_equal(diff, i0 - i1)
+        finally:
+            client.unregister_tpu_shared_memory()
+            tpushm.destroy_shared_memory_region(h_in)
+            tpushm.destroy_shared_memory_region(h_out)
+
+    def test_cross_process_requires_staging(self, client):
+        """A handle from a 'different process' without staging must be
+        rejected with a clear error."""
+        h = tpushm.create_shared_memory_region("tpu_other", 64)
+        try:
+            desc = json.loads(tpushm.get_raw_handle(h))
+            desc["pid"] = desc["pid"] + 1  # simulate foreign process
+            with pytest.raises(InferenceServerException, match="staging"):
+                client.register_tpu_shared_memory(
+                    "tpu_other", json.dumps(desc).encode(), 0, 64
+                )
+        finally:
+            tpushm.destroy_shared_memory_region(h)
+
+    @needs_native
+    def test_staging_fallback_cross_process(self, client):
+        """Foreign-pid handle WITH staging: server reads via the host mirror."""
+        i0 = np.arange(16, dtype=np.int32).reshape(1, 16)
+        i1 = np.ones((1, 16), dtype=np.int32)
+        h_in = tpushm.create_shared_memory_region(
+            "tpu_staged", 256, staging_key="/cl_tpu_stage0"
+        )
+        try:
+            tpushm.set_shared_memory_region(h_in, [i0, i1])
+            desc = json.loads(tpushm.get_raw_handle(h_in))
+            desc["pid"] = desc["pid"] + 1  # force the staging path
+            client.register_tpu_shared_memory(
+                "tpu_staged", json.dumps(desc).encode(), 0, 256
+            )
+            inputs = [
+                grpcclient.InferInput("INPUT0", [1, 16], "INT32"),
+                grpcclient.InferInput("INPUT1", [1, 16], "INT32"),
+            ]
+            inputs[0].set_shared_memory("tpu_staged", i0.nbytes)
+            inputs[1].set_shared_memory("tpu_staged", i1.nbytes, offset=i0.nbytes)
+            result = client.infer("simple", inputs)
+            np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), i0 + i1)
+        finally:
+            client.unregister_tpu_shared_memory()
+            tpushm.destroy_shared_memory_region(h_in)
