@@ -1,0 +1,245 @@
+"""perf CLI — the perf_analyzer command-line surface.
+
+Option names follow the reference CLI (reference
+src/c++/perf_analyzer/command_line_parser.h:44-160) where the concept
+carries over; TPU-specific additions: ``--shared-memory tpu`` stages inputs
+in TPU HBM, ``--hermetic MODEL`` benchmarks the in-process server without
+sockets (the TRITON_C_API analog).
+"""
+
+import argparse
+import sys
+
+from client_tpu.perf import (
+    BackendKind,
+    ClientBackendFactory,
+    ConcurrencyManager,
+    CustomLoadManager,
+    DataLoader,
+    InferenceProfiler,
+    RequestRateManager,
+    SequenceManager,
+    create_infer_data_manager,
+    print_summary,
+    write_csv,
+)
+from client_tpu.utils import InferenceServerException
+
+
+def _parse_range(text, cast):
+    """start[:end[:step]] (reference concurrency-range format)."""
+    parts = text.split(":")
+    start = cast(parts[0])
+    end = cast(parts[1]) if len(parts) > 1 else start
+    step = cast(parts[2]) if len(parts) > 2 else cast(1)
+    return start, end, step
+
+
+def build_parser():
+    p = argparse.ArgumentParser(
+        prog="python -m client_tpu.perf",
+        description="TPU-native perf_analyzer: load generation + measurement",
+    )
+    p.add_argument("-m", "--model-name", required=True)
+    p.add_argument("-x", "--model-version", default="")
+    p.add_argument("-u", "--url", default="localhost:8001")
+    p.add_argument("-i", "--protocol", choices=["grpc", "http"], default="grpc")
+    p.add_argument("--hermetic", action="store_true",
+                   help="benchmark the in-process server (no sockets)")
+    p.add_argument("--hermetic-models", default="builtin",
+                   help="model sets for --hermetic: builtin,jax")
+    p.add_argument("-b", "--batch-size", type=int, default=1)
+    p.add_argument("--concurrency-range", default=None,
+                   help="start[:end[:step]]")
+    p.add_argument("--request-rate-range", default=None,
+                   help="start[:end[:step]] in req/sec")
+    p.add_argument("--request-intervals", default=None,
+                   help="file of inter-request intervals (ns per line)")
+    p.add_argument("--request-distribution", choices=["constant", "poisson"],
+                   default="constant")
+    p.add_argument("--measurement-interval", type=int, default=2000,
+                   help="window length in msec (-p)")
+    p.add_argument("--max-trials", type=int, default=10)
+    p.add_argument("-s", "--stability-percentage", type=float, default=10.0)
+    p.add_argument("--percentile", type=int, default=None,
+                   help="use this latency percentile for stability checks")
+    p.add_argument("-l", "--latency-threshold", type=float, default=0,
+                   help="stop the sweep past this avg latency (msec)")
+    p.add_argument("--binary-search", action="store_true")
+    p.add_argument("--max-threads", type=int, default=16)
+    p.add_argument("--shared-memory", choices=["none", "system", "tpu"],
+                   default="none")
+    p.add_argument("--output-shared-memory-size", type=int, default=0)
+    p.add_argument("--tpu-device-id", type=int, default=0)
+    p.add_argument("--input-data", default=None,
+                   help="'random', 'zero', a JSON file, or a directory")
+    p.add_argument("--shape", action="append", default=[],
+                   help="NAME:d1,d2,... override for dynamic dims")
+    p.add_argument("--string-length", type=int, default=16)
+    p.add_argument("--sequence", action="store_true",
+                   help="stateful sequence workload")
+    p.add_argument("--sequence-length", type=int, default=20)
+    p.add_argument("--sequence-length-variation", type=float, default=0.0)
+    p.add_argument("--start-sequence-id", type=int, default=1)
+    p.add_argument("--sequence-id-range", type=int, default=2**32 - 1)
+    p.add_argument("-f", "--filename", default=None, help="CSV output path")
+    p.add_argument("-v", "--verbose", action="store_true")
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+
+    shape_overrides = {}
+    for item in args.shape:
+        name, _, dims = item.partition(":")
+        shape_overrides[name] = [int(d) for d in dims.split(",")]
+
+    engine = None
+    if args.hermetic:
+        from client_tpu.serve import InferenceEngine
+        from client_tpu.serve.builtins import default_models
+
+        models = default_models()
+        if "jax" in args.hermetic_models.split(","):
+            from client_tpu.serve.models import jax_models
+
+            models.extend(jax_models())
+        engine = InferenceEngine(models)  # no sockets
+        kind = BackendKind.INPROCESS
+    else:
+        kind = (
+            BackendKind.TRITON_GRPC
+            if args.protocol == "grpc"
+            else BackendKind.TRITON_HTTP
+        )
+
+    def backend_factory():
+        return ClientBackendFactory.create(
+            kind, url=args.url, engine=engine, verbose=False
+        )
+
+    control = backend_factory()
+    try:
+        meta = control.model_metadata(args.model_name, args.model_version)
+        inputs_meta = [dict(m) for m in meta["inputs"]]
+        outputs_meta = [dict(m) for m in meta["outputs"]]
+        for m in inputs_meta:
+            # protobuf-JSON renders int64 dims as strings; normalize, and
+            # resolve a dynamic batch dim with --batch-size
+            dims = [int(d) for d in m["shape"]]
+            if dims and dims[0] == -1:
+                dims[0] = args.batch_size
+            m["shape"] = dims
+
+        loader = DataLoader(
+            inputs_meta, batch_size=args.batch_size,
+            shape_overrides=shape_overrides,
+        )
+        if args.input_data in (None, "random"):
+            loader.generate_data(string_length=args.string_length)
+        elif args.input_data == "zero":
+            loader.generate_data(zero_data=True,
+                                 string_length=args.string_length)
+        elif args.input_data.endswith(".json"):
+            loader.read_data_from_json(args.input_data)
+        else:
+            loader.read_data_from_dir(args.input_data)
+
+        data_manager = create_infer_data_manager(
+            control, loader, inputs_meta, outputs_meta,
+            shared_memory=args.shared_memory,
+            output_shm_byte_size=args.output_shared_memory_size,
+            device_id=args.tpu_device_id,
+            # an out-of-process server can only map TPU regions through the
+            # host staging mirror; in-process resolves HBM buffers directly
+            tpu_staging=not args.hermetic,
+        )
+        data_manager.init()
+
+        sequences = None
+        if args.sequence:
+            sequences = SequenceManager(
+                start_sequence_id=args.start_sequence_id,
+                sequence_id_range=args.sequence_id_range,
+                sequence_length=args.sequence_length,
+                sequence_length_variation=args.sequence_length_variation,
+                sequence_length_specified=True,
+                num_streams=loader.num_streams,
+            )
+
+        common = dict(
+            backend_factory=backend_factory,
+            data_loader=loader,
+            data_manager=data_manager,
+            model_name=args.model_name,
+            model_version=args.model_version,
+            sequence_manager=sequences,
+            max_threads=args.max_threads,
+        )
+        latency_limit_us = args.latency_threshold * 1e3 or None
+
+        if args.request_intervals:
+            manager = CustomLoadManager(
+                intervals_file=args.request_intervals, **common
+            )
+        elif args.request_rate_range:
+            manager = RequestRateManager(
+                distribution=args.request_distribution, **common
+            )
+        else:
+            manager = ConcurrencyManager(**common)
+
+        profiler = InferenceProfiler(
+            manager,
+            backend=control,
+            measurement_window_s=args.measurement_interval / 1e3,
+            max_trials=args.max_trials,
+            stability_threshold=args.stability_percentage / 100.0,
+            percentile=args.percentile,
+            verbose=args.verbose,
+        )
+
+        try:
+            if args.request_intervals:
+                manager.start()
+                results = [profiler.profile_level("custom_intervals", 0)]
+            elif args.request_rate_range:
+                start, end, step = _parse_range(args.request_rate_range, float)
+                results = profiler.profile_request_rate_range(
+                    start, end, step, latency_limit_us
+                )
+            else:
+                start, end, step = _parse_range(
+                    args.concurrency_range or "1", int
+                )
+                if args.binary_search and latency_limit_us:
+                    results, _ = profiler.profile_concurrency_binary(
+                        start, end, latency_limit_us
+                    )
+                else:
+                    results = profiler.profile_concurrency_range(
+                        start, end, step, latency_limit_us
+                    )
+        finally:
+            manager.cleanup()
+
+        print_summary(results, percentile=args.percentile)
+        if args.filename:
+            write_csv(args.filename, results, verbose=args.verbose)
+            print(f"wrote {args.filename}")
+        return 0 if results and all(r.error_count == 0 for r in results) else 1
+    except InferenceServerException as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    finally:
+        try:
+            control.close()
+        except Exception:
+            pass
+        if engine is not None:
+            engine.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

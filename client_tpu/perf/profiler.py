@@ -1,0 +1,253 @@
+"""Measurement engine: windows, stability detection, summarization.
+
+Parity with the reference InferenceProfiler (reference
+src/c++/perf_analyzer/inference_profiler.{h,cc}): per load level, repeat
+measurement windows until the last ``stability_window`` trials agree on both
+latency and throughput within ``stability_threshold`` percent
+(DetermineStability/CheckWindowForStability, inference_profiler.h:365-399),
+clipping each window to requests that completed inside it
+(ValidLatencyMeasurement, :442), then summarize client percentiles, send
+rate, delayed/error counts, and server-side queue/compute deltas from the
+statistics endpoint.
+"""
+
+import time
+
+import numpy as np
+
+from client_tpu.utils import InferenceServerException
+
+
+class PerfStatus:
+    """Summary of one stabilized load level."""
+
+    def __init__(self, level_label, level_value):
+        self.level_label = level_label  # "concurrency" | "request_rate"
+        self.level_value = level_value
+        self.throughput = 0.0  # infer/sec
+        self.latency_avg_us = 0.0
+        self.percentiles_us = {}  # 50/90/95/99 -> usec
+        self.completed_requests = 0
+        self.error_count = 0
+        self.delayed_count = 0
+        self.send_rate = 0.0
+        self.stable = False
+        self.server_stats = {}
+        self.client_window_s = 0.0
+
+    def latency_us(self, percentile=None):
+        if percentile is None:
+            return self.latency_avg_us
+        return self.percentiles_us.get(percentile, 0.0)
+
+
+class Measurement:
+    __slots__ = ("throughput", "latency_avg_ns", "latencies_ns", "errors",
+                 "delayed", "window_s", "send_rate")
+
+    def __init__(self, throughput, latency_avg_ns, latencies_ns, errors,
+                 delayed, window_s, send_rate):
+        self.throughput = throughput
+        self.latency_avg_ns = latency_avg_ns
+        self.latencies_ns = latencies_ns
+        self.errors = errors
+        self.delayed = delayed
+        self.window_s = window_s
+        self.send_rate = send_rate
+
+
+class InferenceProfiler:
+    def __init__(self, manager, backend=None, measurement_window_s=1.0,
+                 max_trials=10, stability_threshold=0.1, stability_window=3,
+                 percentile=None, verbose=False):
+        """stability_threshold is fractional (0.1 == ±10%, the reference's
+        default); percentile selects the latency used for the stability check
+        (None = average, reference --percentile)."""
+        self.manager = manager
+        self.backend = backend
+        self.window_s = measurement_window_s
+        self.max_trials = max_trials
+        self.threshold = stability_threshold
+        self.stability_window = stability_window
+        self.percentile = percentile
+        self.verbose = verbose
+
+    # -- one window ----------------------------------------------------------
+
+    def measure(self):
+        window_start = time.monotonic_ns()
+        self.manager.get_and_reset_num_sent()
+        time.sleep(self.window_s)
+        sent = self.manager.get_and_reset_num_sent()
+        records = self.manager.swap_timestamps()
+        # close the window after the swap so a record completing during the
+        # swap itself is never clipped as "future"
+        window_end = time.monotonic_ns()
+        self.manager.check_health()
+
+        # ValidLatencyMeasurement: only requests completing inside the window
+        valid = [r for r in records
+                 if window_start <= r.end_ns <= window_end and r.ok]
+        errors = sum(1 for r in records if not r.ok)
+        delayed = sum(1 for r in valid if r.delayed)
+        window_s = (window_end - window_start) / 1e9
+        lat = np.array([r.end_ns - r.start_ns for r in valid], np.int64)
+        return Measurement(
+            throughput=len(valid) / window_s,
+            latency_avg_ns=float(lat.mean()) if lat.size else 0.0,
+            latencies_ns=lat,
+            errors=errors,
+            delayed=delayed,
+            window_s=window_s,
+            send_rate=sent / window_s,
+        )
+
+    # -- stability loop ------------------------------------------------------
+
+    def _stability_metric(self, m):
+        if self.percentile and m.latencies_ns.size:
+            return float(np.percentile(m.latencies_ns, self.percentile))
+        return m.latency_avg_ns
+
+    def _is_stable(self, window):
+        if len(window) < self.stability_window:
+            return False
+        tps = [m.throughput for m in window]
+        lats = [self._stability_metric(m) for m in window]
+        if any(m.throughput == 0 for m in window):
+            return False
+        for series in (tps, lats):
+            avg = np.mean(series)
+            if avg <= 0:
+                return False
+            if max(abs(v - avg) / avg for v in series) > self.threshold:
+                return False
+        return True
+
+    def profile_level(self, label, value):
+        """Run windows at the current manager configuration until stable."""
+        window = []
+        for trial in range(self.max_trials):
+            m = self.measure()
+            window.append(m)
+            if len(window) > self.stability_window:
+                window.pop(0)
+            if self.verbose:
+                print(
+                    f"  [trial {trial + 1}] {label}={value} "
+                    f"throughput={m.throughput:.1f}/s "
+                    f"avg_lat={m.latency_avg_ns / 1e3:.0f}us "
+                    f"errors={m.errors}"
+                )
+            if self._is_stable(window):
+                return self._summarize(label, value, window, stable=True)
+        return self._summarize(label, value, window, stable=False)
+
+    def _summarize(self, label, value, window, stable):
+        status = PerfStatus(label, value)
+        status.stable = stable
+        all_lat = (
+            np.concatenate([m.latencies_ns for m in window])
+            if window else np.array([], np.int64)
+        )
+        status.completed_requests = int(all_lat.size)
+        status.client_window_s = sum(m.window_s for m in window)
+        status.throughput = float(np.mean([m.throughput for m in window]))
+        status.send_rate = float(np.mean([m.send_rate for m in window]))
+        status.error_count = sum(m.errors for m in window)
+        status.delayed_count = sum(m.delayed for m in window)
+        if all_lat.size:
+            status.latency_avg_us = float(all_lat.mean()) / 1e3
+            for p in (50, 90, 95, 99):
+                status.percentiles_us[p] = float(np.percentile(all_lat, p)) / 1e3
+        return status
+
+    # -- search over load levels ---------------------------------------------
+
+    def profile_concurrency_range(self, start, end, step, latency_limit_us=None):
+        """Linear sweep (reference Profile<size_t>, inference_profiler.h:243)."""
+        results = []
+        c = start
+        while c <= end:
+            self.manager.change_concurrency_level(c)
+            before = self._server_stats()
+            status = self.profile_level("concurrency", c)
+            status.server_stats = self._server_stats_delta(before)
+            results.append(status)
+            if latency_limit_us and status.latency_us(
+                self.percentile
+            ) > latency_limit_us:
+                break
+            c += step
+        return results
+
+    def profile_request_rate_range(self, start, end, step,
+                                   latency_limit_us=None):
+        results = []
+        r = start
+        while r <= end:
+            self.manager.change_request_rate(r)
+            before = self._server_stats()
+            status = self.profile_level("request_rate", r)
+            status.server_stats = self._server_stats_delta(before)
+            results.append(status)
+            if latency_limit_us and status.latency_us(
+                self.percentile
+            ) > latency_limit_us:
+                break
+            r += step
+        return results
+
+    def profile_concurrency_binary(self, start, end, latency_limit_us):
+        """Binary search for max concurrency under the latency limit
+        (SearchMode::BINARY)."""
+        results = []
+        lo, hi = start, end
+        best = None
+        while lo <= hi:
+            mid = (lo + hi) // 2
+            self.manager.change_concurrency_level(mid)
+            status = self.profile_level("concurrency", mid)
+            results.append(status)
+            if status.latency_us(self.percentile) <= latency_limit_us:
+                best = status
+                lo = mid + 1
+            else:
+                hi = mid - 1
+        return results, best
+
+    # -- server-side stats ---------------------------------------------------
+
+    def _server_stats(self):
+        if self.backend is None:
+            return {}
+        try:
+            stats = self.backend.statistics(self.manager.model_name)
+        except (InferenceServerException, NotImplementedError):
+            return {}
+        return _flatten_stats(stats)
+
+    def _server_stats_delta(self, before):
+        after = self._server_stats()
+        return {
+            k: after.get(k, 0) - before.get(k, 0)
+            for k in after
+        }
+
+
+def _flatten_stats(stats):
+    """Normalize a statistics() response into flat counters (ns totals)."""
+    out = {}
+    model_stats = stats.get("model_stats", []) if isinstance(stats, dict) else []
+    for ms in model_stats:
+        agg = ms.get("inference_stats", {})
+        for phase in ("success", "queue", "compute_input", "compute_infer",
+                      "compute_output"):
+            entry = agg.get(phase, {})
+            out[f"{phase}_count"] = out.get(f"{phase}_count", 0) + int(
+                entry.get("count", 0)
+            )
+            out[f"{phase}_ns"] = out.get(f"{phase}_ns", 0) + int(
+                entry.get("ns", 0)
+            )
+    return out

@@ -1,0 +1,90 @@
+"""Result reporting: stdout summary + CSV export.
+
+Parity with the reference ReportWriter (reference
+src/c++/perf_analyzer/report_writer.cc:39-246): a per-level stdout block in
+the perf_analyzer format and a CSV with one row per load level (verbose adds
+send rate, delayed/error counts and server-side breakdown columns).
+"""
+
+import csv
+
+
+def print_summary(results, percentile=None):
+    for s in results:
+        label = s.level_label.replace("_", " ").title()
+        print(f"{label}: {s.level_value}")
+        if not s.stable:
+            print("  WARNING: measurements did not stabilize")
+        print(
+            f"  Client: request count: {s.completed_requests}, "
+            f"throughput: {s.throughput:.1f} infer/sec, "
+            f"send rate: {s.send_rate:.1f} req/sec"
+        )
+        if s.error_count:
+            print(f"    failed requests: {s.error_count}")
+        if s.delayed_count:
+            print(f"    delayed requests: {s.delayed_count}")
+        print(f"    avg latency: {s.latency_avg_us:.0f} usec")
+        for p in (50, 90, 95, 99):
+            if p in s.percentiles_us:
+                print(f"    p{p} latency: {s.percentiles_us[p]:.0f} usec")
+        if s.server_stats:
+            srv = s.server_stats
+            cnt = max(srv.get("success_count", 0), 1)
+            parts = []
+            for phase in ("queue", "compute_input", "compute_infer",
+                          "compute_output"):
+                ns = srv.get(f"{phase}_ns", 0)
+                parts.append(f"{phase} {ns / cnt / 1e3:.0f}")
+            print(f"  Server: avg usec/request: {', '.join(parts)}")
+        print()
+    if results:
+        best = max(results, key=lambda s: s.throughput)
+        print(
+            f"Best: {best.level_label}={best.level_value} -> "
+            f"{best.throughput:.1f} infer/sec, "
+            f"avg latency {best.latency_avg_us:.0f} usec"
+        )
+
+
+def write_csv(path, results, verbose=False):
+    """CSV export; column set follows report_writer.cc."""
+    fields = [
+        "Level", "Inferences/Second", "Client Send Rate",
+        "Avg latency", "p50 latency", "p90 latency", "p95 latency",
+        "p99 latency", "Request Count", "Failed Count", "Delayed Count",
+        "Stable",
+    ]
+    if verbose:
+        fields += [
+            "Server Queue", "Server Compute Input", "Server Compute Infer",
+            "Server Compute Output",
+        ]
+    with open(path, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(fields)
+        for s in results:
+            row = [
+                s.level_value,
+                f"{s.throughput:.2f}",
+                f"{s.send_rate:.2f}",
+                f"{s.latency_avg_us:.0f}",
+                f"{s.percentiles_us.get(50, 0):.0f}",
+                f"{s.percentiles_us.get(90, 0):.0f}",
+                f"{s.percentiles_us.get(95, 0):.0f}",
+                f"{s.percentiles_us.get(99, 0):.0f}",
+                s.completed_requests,
+                s.error_count,
+                s.delayed_count,
+                int(s.stable),
+            ]
+            if verbose:
+                srv = s.server_stats
+                cnt = max(srv.get("success_count", 0), 1)
+                row += [
+                    f"{srv.get('queue_ns', 0) / cnt / 1e3:.0f}",
+                    f"{srv.get('compute_input_ns', 0) / cnt / 1e3:.0f}",
+                    f"{srv.get('compute_infer_ns', 0) / cnt / 1e3:.0f}",
+                    f"{srv.get('compute_output_ns', 0) / cnt / 1e3:.0f}",
+                ]
+            w.writerow(row)

@@ -1,0 +1,368 @@
+"""perf harness unit tests on the MockClientBackend — the reference's
+doctest+mock test design (SURVEY.md §4: MockClientBackend simulates the load
+path with injectable latency/error schedules; managers and profiler are
+tested with no server).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from client_tpu.perf import (
+    BackendKind,
+    ClientBackendFactory,
+    ConcurrencyManager,
+    CustomLoadManager,
+    DataLoader,
+    InferenceProfiler,
+    MockClientBackend,
+    MockStats,
+    RequestRateManager,
+    SequenceManager,
+    create_infer_data_manager,
+)
+from client_tpu.perf.load_manager import RequestRecord
+from client_tpu.utils import InferenceServerException
+
+META = [{"name": "INPUT0", "datatype": "FP32", "shape": [1, 4]}]
+OUT_META = [{"name": "OUTPUT0", "datatype": "FP32", "shape": [1, 4]}]
+
+
+def _mk_manager(cls, stats=None, latency_s=0.0, error_schedule=None, **kwargs):
+    stats = stats or MockStats()
+
+    def factory():
+        return MockClientBackend(
+            latency_s=latency_s, error_schedule=error_schedule, stats=stats
+        )
+
+    loader = DataLoader(META)
+    loader.generate_data()
+    dm = create_infer_data_manager(factory(), loader, META, OUT_META)
+    dm.init()
+    mgr = cls(
+        backend_factory=factory,
+        data_loader=loader,
+        data_manager=dm,
+        model_name="mock",
+        **kwargs,
+    )
+    return mgr, stats
+
+
+class TestDataLoader:
+    def test_generate_random(self):
+        loader = DataLoader(META)
+        loader.generate_data()
+        arr = loader.get_input_data(0, 0)["INPUT0"].array
+        assert arr.shape == (1, 4) and arr.dtype == np.float32
+
+    def test_generate_zero(self):
+        loader = DataLoader(META)
+        loader.generate_data(zero_data=True)
+        assert not loader.get_input_data(0, 0)["INPUT0"].array.any()
+
+    def test_dynamic_batch_dim_uses_batch_size(self):
+        loader = DataLoader(
+            [{"name": "X", "datatype": "FP32", "shape": [-1, 4]}], batch_size=3
+        )
+        loader.generate_data()
+        assert loader.get_input_data(0, 0)["X"].array.shape == (3, 4)
+
+    def test_dynamic_non_batch_dim_requires_override(self):
+        loader = DataLoader([{"name": "X", "datatype": "FP32", "shape": [1, -1]}])
+        with pytest.raises(InferenceServerException, match="dynamic"):
+            loader.generate_data()
+
+    def test_shape_override(self):
+        loader = DataLoader(
+            [{"name": "X", "datatype": "FP32", "shape": [-1, 4]}],
+            shape_overrides={"X": [2, 4]},
+        )
+        loader.generate_data()
+        assert loader.get_input_data(0, 0)["X"].array.shape == (2, 4)
+
+    def test_json_streams_and_validation(self):
+        doc = {
+            "data": [
+                [{"INPUT0": [1.0, 2.0, 3.0, 4.0]}],
+                [{"INPUT0": {"content": [5.0, 6.0, 7.0, 8.0], "shape": [1, 4]}}],
+            ],
+            "validation_data": [
+                [{"OUTPUT0": [1.0, 2.0, 3.0, 4.0]}],
+                [{"OUTPUT0": [5.0, 6.0, 7.0, 8.0]}],
+            ],
+        }
+        loader = DataLoader(META)
+        loader.read_data_from_json(doc)
+        assert loader.num_streams == 2
+        np.testing.assert_allclose(
+            loader.get_input_data(0, 0)["INPUT0"].array.flatten(),
+            [1, 2, 3, 4],
+        )
+        assert loader.get_expected_outputs(1, 0)["OUTPUT0"].array.size == 4
+
+    def test_bytes_generation(self):
+        loader = DataLoader([{"name": "S", "datatype": "BYTES", "shape": [2]}])
+        loader.generate_data(string_length=5)
+        arr = loader.get_input_data(0, 0)["S"].array
+        assert arr.dtype == np.object_ and len(arr[0]) == 5
+
+
+class TestSequenceManager:
+    def test_id_allocation_and_wraparound(self):
+        sm = SequenceManager(start_sequence_id=10, sequence_id_range=3,
+                             sequence_length=2, sequence_length_specified=True)
+        ids = [sm.begin_sequence(slot).seq_id for slot in range(4)]
+        assert ids == [10, 11, 12, 10]
+
+    def test_advance_flags(self):
+        sm = SequenceManager(sequence_length=3, sequence_length_specified=True)
+        st = sm.begin_sequence(0)
+        flags = [sm.advance(st) for _ in range(3)]
+        assert flags == [(True, False), (False, False), (False, True)]
+
+    def test_length_variation_bounds(self):
+        sm = SequenceManager(sequence_length=100,
+                             sequence_length_variation=20,
+                             sequence_length_specified=True)
+        lengths = {sm.begin_sequence(i).remaining_queries for i in range(50)}
+        assert all(80 <= n <= 120 for n in lengths)
+        assert len(lengths) > 1
+
+
+class TestConcurrencyManager:
+    def test_workers_send_requests(self):
+        mgr, stats = _mk_manager(ConcurrencyManager)
+        try:
+            mgr.change_concurrency_level(4)
+            time.sleep(0.3)
+            records = mgr.swap_timestamps()
+            assert len(records) > 50
+            assert stats.num_infer_calls > 50
+            assert mgr.get_and_reset_num_sent() > 0
+        finally:
+            mgr.cleanup()
+
+    def test_reconfigure_threads(self):
+        mgr, _ = _mk_manager(ConcurrencyManager)
+        try:
+            mgr.change_concurrency_level(2)
+            assert len(mgr._threads) == 2
+            mgr.change_concurrency_level(6)
+            assert len(mgr._threads) == 6
+        finally:
+            mgr.cleanup()
+
+    def test_request_errors_counted_not_fatal(self):
+        mgr, _ = _mk_manager(
+            ConcurrencyManager, error_schedule=[True] * 500_000
+        )
+        try:
+            mgr.change_concurrency_level(1)
+            time.sleep(0.2)
+            mgr.check_health()  # per-request failures never abort the run
+            records = mgr.swap_timestamps()
+            assert records and all(not r.ok for r in records)
+        finally:
+            mgr.cleanup()
+
+    def test_concurrency_beyond_max_threads_refused(self):
+        mgr, _ = _mk_manager(ConcurrencyManager, max_threads=2)
+        try:
+            with pytest.raises(InferenceServerException, match="max-threads"):
+                mgr.change_concurrency_level(3)
+        finally:
+            mgr.cleanup()
+
+    def test_sequences_have_correlation_ids(self):
+        stats = MockStats()
+        sm = SequenceManager(sequence_length=4, sequence_length_specified=True)
+        mgr, stats = _mk_manager(
+            ConcurrencyManager, stats=stats, sequence_manager=sm
+        )
+        try:
+            mgr.change_concurrency_level(2)
+            time.sleep(0.3)
+        finally:
+            mgr.cleanup()
+        assert stats.sequence_ids
+        # two slots -> at most two distinct live sequences at any moment,
+        # and ids keep increasing as sequences retire
+        assert len(set(stats.sequence_ids)) >= 2
+
+
+class TestRequestRateManager:
+    def test_constant_rate(self):
+        mgr, stats = _mk_manager(RequestRateManager)
+        try:
+            mgr.change_request_rate(200)
+            time.sleep(1.0)
+            n = stats.num_infer_calls
+            assert 120 <= n <= 280, n
+        finally:
+            mgr.cleanup()
+
+    def test_poisson_schedule_distribution(self):
+        mgr, _ = _mk_manager(RequestRateManager, distribution="poisson")
+        gaps = mgr._make_schedule(100, horizon=10000)
+        mean = float(np.mean(gaps))
+        assert 0.8 * 1e7 < mean < 1.2 * 1e7
+        assert np.std(gaps.astype(float)) > 0.5 * mean  # exponential-ish
+
+    def test_delayed_flagging(self):
+        # schedule far faster than the mock latency can sustain
+        mgr, _ = _mk_manager(RequestRateManager, latency_s=0.05)
+        try:
+            mgr.change_request_rate(500, num_threads=2)
+            time.sleep(0.5)
+            records = mgr.swap_timestamps()
+            assert any(r.delayed for r in records)
+        finally:
+            mgr.cleanup()
+
+
+class TestCustomLoadManager:
+    def test_replays_intervals(self, tmp_path):
+        path = tmp_path / "intervals.txt"
+        path.write_text("\n".join(["5000000"] * 100))  # 5ms gaps
+        mgr, stats = _mk_manager(CustomLoadManager, intervals_file=str(path))
+        try:
+            mgr.start(num_threads=2)
+            time.sleep(0.5)
+            assert 50 <= stats.num_infer_calls <= 140
+        finally:
+            mgr.cleanup()
+
+
+class _FakeManager:
+    """Deterministic manager stand-in for profiler-only tests."""
+
+    model_name = "mock"
+
+    def __init__(self, schedule):
+        # schedule: list of lists of (latency_ns, ok) generated per window
+        self._schedule = list(schedule)
+        self._sent = 0
+
+    def get_and_reset_num_sent(self):
+        n = self._sent
+        self._sent = 0
+        return n
+
+    def swap_timestamps(self):
+        if not self._schedule:
+            return []
+        batch = self._schedule.pop(0)
+        now = time.monotonic_ns()
+        recs = []
+        for lat_ns, ok in batch:
+            recs.append(RequestRecord(now - lat_ns, now, ok))
+        self._sent += len(batch)
+        return recs
+
+    def check_health(self):
+        pass
+
+
+class TestProfiler:
+    def _profiler(self, schedule, **kwargs):
+        kwargs.setdefault("measurement_window_s", 0.02)
+        return InferenceProfiler(_FakeManager(schedule), **kwargs)
+
+    def test_stable_after_three_windows(self):
+        window = [(1_000_000, True)] * 20
+        prof = self._profiler([window] * 5)
+        status = prof.profile_level("concurrency", 1)
+        assert status.stable
+        assert status.completed_requests == 60  # exactly 3 stable windows
+        assert abs(status.latency_avg_us - 1000) < 1
+
+    def test_unstable_without_convergence(self):
+        # throughput alternates wildly -> never stable
+        schedule = [
+            [(1_000_000, True)] * (5 if i % 2 else 100) for i in range(10)
+        ]
+        prof = self._profiler(schedule, max_trials=6)
+        status = prof.profile_level("concurrency", 1)
+        assert not status.stable
+
+    def test_window_clipping_drops_stale_requests(self):
+        prof = self._profiler([])
+        mgr = prof.manager
+        t0 = time.monotonic_ns()
+
+        class _Mgr(_FakeManager):
+            def swap_timestamps(self):
+                # one record finished long before the window opened
+                return [RequestRecord(t0 - 10**12, t0 - 10**11, True)]
+
+        prof.manager = _Mgr([])
+        m = prof.measure()
+        assert m.throughput == 0
+
+    def test_errors_counted(self):
+        window = [(1_000_000, True)] * 10 + [(1_000_000, False)] * 3
+        prof = self._profiler([window] * 3)
+        status = prof.profile_level("concurrency", 1)
+        assert status.error_count == 9  # 3 per window
+
+    def test_percentiles_monotone(self):
+        lats = [(int(n), True) for n in np.linspace(1e6, 9e6, 50)]
+        prof = self._profiler([lats] * 3)
+        status = prof.profile_level("concurrency", 1)
+        p = status.percentiles_us
+        assert p[50] <= p[90] <= p[95] <= p[99]
+
+
+class TestEndToEndInprocess:
+    """Full harness against the real in-process engine (no sockets)."""
+
+    def test_concurrency_sweep(self, capsys):
+        from client_tpu.perf.__main__ import main
+
+        rc = main([
+            "-m", "simple", "--hermetic",
+            "--concurrency-range", "1:2",
+            "--measurement-interval", "100",
+            "--max-trials", "4",
+            "-s", "50",
+        ])
+        out = capsys.readouterr().out
+        assert "Concurrency: 1" in out
+        assert "Concurrency: 2" in out
+        assert "infer/sec" in out
+        assert rc == 0
+
+    def test_csv_export(self, tmp_path, capsys):
+        from client_tpu.perf.__main__ import main
+
+        csv_path = tmp_path / "report.csv"
+        rc = main([
+            "-m", "simple", "--hermetic",
+            "--concurrency-range", "1",
+            "--measurement-interval", "100",
+            "--max-trials", "3",
+            "-s", "90",
+            "-f", str(csv_path),
+        ])
+        assert rc == 0
+        lines = csv_path.read_text().strip().splitlines()
+        assert len(lines) == 2
+        assert lines[0].startswith("Level,Inferences/Second")
+
+    def test_request_rate_mode(self, capsys):
+        from client_tpu.perf.__main__ import main
+
+        rc = main([
+            "-m", "simple", "--hermetic",
+            "--request-rate-range", "100",
+            "--request-distribution", "poisson",
+            "--measurement-interval", "200",
+            "--max-trials", "3",
+            "-s", "90",
+        ])
+        out = capsys.readouterr().out
+        assert "Request Rate: 100" in out
+        assert rc == 0
