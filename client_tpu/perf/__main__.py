@@ -98,14 +98,9 @@ def main(argv=None):
     engine = None
     if args.hermetic:
         from client_tpu.serve import InferenceEngine
-        from client_tpu.serve.builtins import default_models
+        from client_tpu.serve.models import model_sets
 
-        models = default_models()
-        if "jax" in args.hermetic_models.split(","):
-            from client_tpu.serve.models import jax_models
-
-            models.extend(jax_models())
-        engine = InferenceEngine(models)  # no sockets
+        engine = InferenceEngine(model_sets(args.hermetic_models))  # no sockets
         kind = BackendKind.INPROCESS
     else:
         kind = (

@@ -193,6 +193,37 @@ class _HttpBackend(_GrpcBackend):
         return self._client.get_inference_statistics(model_name, model_version)
 
 
+class _EngineResult:
+    """InferResult-like view over the engine's (response, blobs) tuple so the
+    load path (validation, stats) treats all backends uniformly."""
+
+    def __init__(self, response, blobs):
+        self._response = response
+        self._arrays = {}
+        blob_idx = 0
+        from client_tpu.utils import from_wire_bytes
+        from client_tpu._infer_types import _np_from_json_data
+
+        for out in response.get("outputs", []):
+            params = out.get("parameters", {}) or {}
+            if "binary_data_size" in params:
+                self._arrays[out["name"]] = from_wire_bytes(
+                    blobs[blob_idx], out["datatype"], out["shape"]
+                )
+                blob_idx += 1
+            elif "data" in out:
+                self._arrays[out["name"]] = _np_from_json_data(
+                    out["data"], out["datatype"], out["shape"]
+                )
+            # shm outputs carry no payload; read them from the region
+
+    def as_numpy(self, name):
+        return self._arrays.get(name)
+
+    def get_response(self):
+        return self._response
+
+
 class _InprocessBackend(ClientBackend):
     """Run requests straight into an InferenceEngine — no sockets.
 
@@ -245,7 +276,11 @@ class _InprocessBackend(ClientBackend):
                 {"name": o.name(), "parameters": dict(o.parameters())}
                 for o in outputs
             ]
-        return self._engine.execute(model_name, model_version, request, binary)
+        result = self._engine.execute(model_name, model_version, request, binary)
+        if isinstance(result, list):  # decoupled: list of (response, blobs)
+            return [_EngineResult(r, b) for r, b in result]
+        response, blobs = result
+        return _EngineResult(response, blobs)
 
     def statistics(self, model_name="", model_version=""):
         return self._engine.statistics(model_name, model_version)

@@ -189,11 +189,18 @@ class DataLoader:
                     raise InferenceServerException(
                         f"b64 content for unknown tensor '{name}'"
                     )
-                np_dtype = triton_to_np_dtype(datatype)
                 rshape = _resolve_shape(
                     value.get("shape", meta["shape"]), self._batch,
                     self._shapes, name,
                 )
+                if datatype == "BYTES":
+                    from client_tpu.utils import deserialize_bytes_tensor
+
+                    flat = deserialize_bytes_tensor(
+                        np.frombuffer(raw, np.uint8)
+                    )
+                    return TensorData(flat.reshape(rshape))
+                np_dtype = triton_to_np_dtype(datatype)
                 return TensorData(np.frombuffer(raw, np_dtype).reshape(rshape))
             shape = value.get("shape")
             content = value.get("content")

@@ -83,7 +83,7 @@ class InferContext:
         start = time.monotonic_ns()
         ok = True
         try:
-            self.backend.infer(
+            result = self.backend.infer(
                 self.model_name,
                 data.inputs,
                 outputs=data.outputs,
@@ -92,6 +92,7 @@ class InferContext:
                 sequence_end=seq_end,
                 model_version=self.model_version,
             )
+            ok = self._validate(result, stream_id, step_id)
         except InferenceServerException:
             ok = False  # counted per-window; does not abort the run
         end = time.monotonic_ns()
@@ -99,6 +100,28 @@ class InferContext:
             self.stat.records.append(
                 RequestRecord(start, end, ok, seq_id, delayed)
             )
+
+    def _validate(self, result, stream_id, step_id):
+        """Compare response tensors against the data loader's
+        expected-output (validation_data) entries, when provided."""
+        expected = self.loader.get_expected_outputs(stream_id, step_id)
+        if not expected or result is None or not hasattr(result, "as_numpy"):
+            return True
+        for name, td in expected.items():
+            got = result.as_numpy(name)
+            if got is None:
+                return False
+            want = td.array
+            if got.dtype == np.object_ or want.dtype == np.object_:
+                if list(got.flatten()) != list(want.flatten()):
+                    return False
+            elif not np.allclose(
+                got.reshape(-1).astype(np.float64),
+                want.reshape(-1).astype(np.float64),
+                rtol=1e-5, atol=1e-6,
+            ):
+                return False
+        return True
 
 
 class LoadManager:
@@ -281,6 +304,7 @@ class RequestRateManager(LoadManager):
         while not stop.is_set():
             slot, delayed = self._claim_slot()
             if slot is None:
+                stop.set()  # finite schedule done: a clean stop, not a crash
                 return
             ctx.send(delayed=delayed)
             self.count_sent()

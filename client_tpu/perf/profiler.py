@@ -158,7 +158,10 @@ class InferenceProfiler:
         status.delayed_count = sum(m.delayed for m in window)
         if all_lat.size:
             status.latency_avg_us = float(all_lat.mean()) / 1e3
-            for p in (50, 90, 95, 99):
+            wanted = {50, 90, 95, 99}
+            if self.percentile:
+                wanted.add(self.percentile)  # the stability-governing one
+            for p in sorted(wanted):
                 status.percentiles_us[p] = float(np.percentile(all_lat, p)) / 1e3
         return status
 

@@ -10,6 +10,7 @@ import csv
 
 
 def print_summary(results, percentile=None):
+    """``percentile`` marks which latency governed the stability check."""
     for s in results:
         label = s.level_label.replace("_", " ").title()
         print(f"{label}: {s.level_value}")
@@ -25,9 +26,11 @@ def print_summary(results, percentile=None):
         if s.delayed_count:
             print(f"    delayed requests: {s.delayed_count}")
         print(f"    avg latency: {s.latency_avg_us:.0f} usec")
-        for p in (50, 90, 95, 99):
-            if p in s.percentiles_us:
-                print(f"    p{p} latency: {s.percentiles_us[p]:.0f} usec")
+        for p in sorted(s.percentiles_us):
+            governed = " (stability metric)" if p == percentile else ""
+            print(
+                f"    p{p} latency: {s.percentiles_us[p]:.0f} usec{governed}"
+            )
         if s.server_stats:
             srv = s.server_stats
             cnt = max(srv.get("success_count", 0), 1)

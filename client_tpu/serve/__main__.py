@@ -19,15 +19,14 @@ def main():
     parser.add_argument(
         "--models",
         default="builtin",
-        help="comma-separated model sets to load: builtin,jax (default: builtin)",
+        help="comma-separated model sets: builtin,jax,language (default: builtin)",
     )
     args = parser.parse_args()
 
-    extra = []
-    if "jax" in args.models.split(","):
-        from client_tpu.serve.models import jax_models
+    from client_tpu.serve.models import model_sets
 
-        extra.extend(jax_models())
+    sets = [s for s in args.models.split(",") if s != "builtin"]
+    extra = model_sets(",".join(sets)) if sets else []
 
     from client_tpu.serve import Server
 
@@ -37,6 +36,7 @@ def main():
         grpc_port=args.grpc_port,
         host=args.host,
         verbose=args.verbose,
+        with_default_models="builtin" in args.models.split(","),
     ).start()
     print(f"client_tpu.serve: HTTP on {server.http_address}", flush=True)
     if server.grpc_address:

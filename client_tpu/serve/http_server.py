@@ -32,6 +32,9 @@ _REPO_URI = re.compile(
 
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
+    # responses go out as header-write + body-write; with Nagle on, the body
+    # write stalls ~40ms waiting for the client's delayed ACK of the headers
+    disable_nagle_algorithm = True
     engine = None  # set by subclassing in HttpFrontend
     verbose = False
 

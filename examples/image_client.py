@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""Image classification client — parity with the reference image_client.py
+(reference src/python/examples/image_client.py: preprocess, batch, classify
+via the classification extension).  OpenCV-free: numpy mean-pool resize.
+
+TPU additions: ``--shared-memory tpu`` stages the image batch in TPU HBM via
+client_tpu.utils.tpu_shared_memory (the --shared-memory=cuda analog);
+``--hermetic`` serves the CNN in-process.
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def preprocess(path_or_none, size, rng):
+    """Load (or synthesize) an image as [3, size, size] float32 CHW."""
+    if path_or_none is None:
+        return rng.standard_normal((3, size, size)).astype(np.float32)
+    from PIL import Image  # optional; synthetic input needs no pillow
+
+    img = Image.open(path_or_none).convert("RGB")
+    arr = np.asarray(img, dtype=np.float32) / 255.0
+    h, w, _ = arr.shape
+    ph, pw = h // size or 1, w // size or 1
+    arr = arr[: ph * size, : pw * size].reshape(size, ph, size, pw, 3)
+    arr = arr.mean(axis=(1, 3))
+    return arr.transpose(2, 0, 1)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("image", nargs="*", help="image files (synthetic if none)")
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    parser.add_argument("-i", "--protocol", choices=["grpc", "http"],
+                        default="grpc")
+    parser.add_argument("-m", "--model-name", default="cnn_classifier")
+    parser.add_argument("-c", "--classes", type=int, default=3,
+                        help="top-N classification extension")
+    parser.add_argument("-b", "--batch-size", type=int, default=1)
+    parser.add_argument("--shared-memory", choices=["none", "tpu"],
+                        default="none")
+    parser.add_argument("--hermetic", action="store_true")
+    args = parser.parse_args()
+
+    server = None
+    url = args.url
+    if args.hermetic:
+        from client_tpu.serve import Server
+        from client_tpu.serve.models.vision import cnn_classifier_model
+
+        server = Server(models=[cnn_classifier_model()], grpc_port=0,
+                        with_default_models=False).start()
+        url = server.grpc_address if args.protocol == "grpc" else None
+        if url is None:
+            url = server.http_address
+
+    if args.protocol == "grpc":
+        import client_tpu.grpc as client_mod
+    else:
+        import client_tpu.http as client_mod
+
+    rng = np.random.default_rng(0)
+    paths = args.image or [None] * args.batch_size
+    batch = np.stack([preprocess(p, 224, rng) for p in paths])
+
+    try:
+        with client_mod.InferenceServerClient(url) as client:
+            inp = client_mod.InferInput(
+                "INPUT0", list(batch.shape), "FP32"
+            )
+            out = client_mod.InferRequestedOutput(
+                "OUTPUT0", class_count=args.classes
+            )
+            shm_handle = None
+            if args.shared_memory == "tpu":
+                from client_tpu.utils import tpu_shared_memory as tpushm
+
+                shm_handle = tpushm.create_shared_memory_region(
+                    "image_in", batch.nbytes,
+                    staging_key=None if args.hermetic else "/image_in",
+                )
+                tpushm.set_shared_memory_region(shm_handle, [batch])
+                client.register_tpu_shared_memory(
+                    "image_in", tpushm.get_raw_handle(shm_handle), 0,
+                    batch.nbytes,
+                )
+                inp.set_shared_memory("image_in", batch.nbytes)
+            else:
+                inp.set_data_from_numpy(batch)
+
+            result = client.infer(args.model_name, [inp], outputs=[out])
+            classes = result.as_numpy("OUTPUT0")
+            for i, row in enumerate(np.atleast_2d(classes)):
+                print(f"image {i}:")
+                for entry in row:
+                    score, idx, *label = (
+                        entry.decode() if isinstance(entry, bytes) else str(entry)
+                    ).split(":")
+                    name = label[0] if label else idx
+                    print(f"  {float(score):.4f} ({idx}) = {name}")
+            if shm_handle is not None:
+                client.unregister_tpu_shared_memory("image_in")
+                from client_tpu.utils import tpu_shared_memory as tpushm
+
+                tpushm.destroy_shared_memory_region(shm_handle)
+            print("PASS: image_client")
+    finally:
+        if server:
+            server.stop()
+
+
+if __name__ == "__main__":
+    main()

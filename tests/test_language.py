@@ -1,0 +1,140 @@
+"""Language stack: tokenizer, streaming LM, text ensemble (BASELINE config 5).
+
+Uses a tiny transformer config so CPU tests stay fast; the serving protocol
+path (decoupled responses over gRPC ModelStreamInfer) is identical to the
+full-size deployment.
+"""
+
+import queue
+
+import numpy as np
+import pytest
+
+import client_tpu.grpc as grpcclient
+from client_tpu.serve import Server
+from client_tpu.serve.models import transformer as tfm
+from client_tpu.serve.models.language import (
+    _LmRunner,
+    decode_tokens,
+    detokenizer_model,
+    encode_text,
+    lm_streaming_model,
+    text_ensemble_model,
+    tokenizer_model,
+)
+
+_TINY = tfm.TransformerConfig(
+    vocab_size=258, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+    d_ff=128, max_seq=64, dtype="float32",
+)
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return _LmRunner(cfg=_TINY)
+
+
+@pytest.fixture(scope="module")
+def server(runner):
+    models = [
+        tokenizer_model(),
+        detokenizer_model(),
+        lm_streaming_model(runner=runner),
+        text_ensemble_model(runner=runner),
+    ]
+    with Server(models=models, grpc_port=0, with_default_models=False) as s:
+        yield s
+
+
+@pytest.fixture()
+def client(server):
+    with grpcclient.InferenceServerClient(server.grpc_address) as c:
+        yield c
+
+
+def test_encode_decode_round_trip():
+    text = "hello, TPU! ünïcödé"
+    toks = encode_text(text)
+    assert toks[0] == 256  # BOS
+    assert decode_tokens(toks) == text
+
+
+def test_tokenizer_model_batch(client):
+    texts = np.array([b"ab", b"wxyz"], dtype=np.object_)
+    inp = grpcclient.InferInput("TEXT", [2], "BYTES")
+    inp.set_data_from_numpy(texts)
+    res = client.infer("tokenizer", [inp])
+    tokens = res.as_numpy("TOKENS")
+    lengths = res.as_numpy("LENGTHS")
+    assert list(lengths) == [3, 5]
+    assert tokens.shape == (2, 5)
+    assert decode_tokens(tokens[1][: lengths[1]]) == "wxyz"
+
+
+def test_detokenizer_model(client):
+    toks = encode_text("roundtrip")[None, :]
+    inp = grpcclient.InferInput("TOKENS", list(toks.shape), "INT32")
+    inp.set_data_from_numpy(toks.astype(np.int32))
+    res = client.infer("detokenizer", [inp])
+    assert res.as_numpy("TEXT")[0] == b"roundtrip"
+
+
+def test_lm_streaming_over_grpc(client):
+    """One decoupled response per generated token, in order."""
+    results = queue.Queue()
+    client.start_stream(
+        callback=lambda result, error: results.put((result, error))
+    )
+    prompt = encode_text("abc")
+    t_in = grpcclient.InferInput("TOKENS", [len(prompt)], "INT32")
+    t_in.set_data_from_numpy(prompt)
+    m_in = grpcclient.InferInput("MAX_TOKENS", [1], "INT32")
+    m_in.set_data_from_numpy(np.array([6], dtype=np.int32))
+    client.async_stream_infer("lm_streaming", [t_in, m_in])
+    tokens = []
+    for _ in range(6):
+        result, error = results.get(timeout=30)
+        assert error is None
+        tokens.append(int(result.as_numpy("TOKEN")[0]))
+        if tokens[-1] == 257:  # EOS ends the stream early
+            break
+    client.stop_stream()
+    assert tokens
+    assert all(0 <= t < 258 for t in tokens)
+
+
+def test_lm_streaming_deterministic(runner):
+    a = list(runner.stream(encode_text("abc"), 5))
+    b = list(runner.stream(encode_text("abc"), 5))
+    assert a == b
+
+
+def test_text_ensemble_end_to_end(client):
+    """BYTES prompt in -> streamed BYTES pieces out (config-5 shape)."""
+    results = queue.Queue()
+    client.start_stream(
+        callback=lambda result, error: results.put((result, error))
+    )
+    p_in = grpcclient.InferInput("PROMPT", [1], "BYTES")
+    p_in.set_data_from_numpy(np.array([b"Once upon"], dtype=np.object_))
+    m_in = grpcclient.InferInput("MAX_TOKENS", [1], "INT32")
+    m_in.set_data_from_numpy(np.array([4], dtype=np.int32))
+    client.async_stream_infer("text_generator", [p_in, m_in])
+    pieces = []
+    for _ in range(4):
+        result, error = results.get(timeout=30)
+        assert error is None
+        pieces.append(result.as_numpy("TEXT")[0])
+        if pieces[-1] == b"":  # EOS piece decodes to empty
+            break
+    client.stop_stream()
+    assert pieces
+
+
+def test_sampling_temperature_param(runner):
+    greedy = list(runner.stream(encode_text("xy"), 5))
+    sampled = list(runner.stream(encode_text("xy"), 5, temperature=1.5, seed=7))
+    assert len(sampled) >= 1
+    # different seeds give different samples (overwhelmingly likely)
+    sampled2 = list(runner.stream(encode_text("xy"), 5, temperature=1.5, seed=8))
+    assert sampled != sampled2 or sampled != greedy

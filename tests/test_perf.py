@@ -366,3 +366,54 @@ class TestEndToEndInprocess:
         out = capsys.readouterr().out
         assert "Request Rate: 100" in out
         assert rc == 0
+
+
+class TestValidation:
+    def test_validation_data_marks_mismatches(self):
+        """validation_data wiring: wrong expected output -> records not ok."""
+        from client_tpu.perf import BackendKind, ClientBackendFactory
+        from client_tpu.serve import InferenceEngine
+        from client_tpu.serve.builtins import default_models
+
+        engine = InferenceEngine(default_models())
+        backend = ClientBackendFactory.create(BackendKind.INPROCESS, engine=engine)
+        loader = DataLoader(
+            [
+                {"name": "INPUT0", "datatype": "INT32", "shape": [1, 16]},
+                {"name": "INPUT1", "datatype": "INT32", "shape": [1, 16]},
+            ]
+        )
+        ones = [1] * 16
+        doc = {
+            "data": [[{"INPUT0": ones, "INPUT1": ones}]],
+            "validation_data": [[{"OUTPUT0": [2] * 16}]],  # correct sum
+        }
+        loader.read_data_from_json(doc)
+        out_meta = [{"name": "OUTPUT0", "datatype": "INT32", "shape": [1, 16]}]
+        dm = create_infer_data_manager(backend, loader, loader._inputs, out_meta)
+        dm.init()
+        mgr = ConcurrencyManager(
+            backend_factory=lambda: backend, data_loader=loader,
+            data_manager=dm, model_name="simple",
+        )
+        try:
+            mgr.change_concurrency_level(1)
+            time.sleep(0.2)
+            records = mgr.swap_timestamps()
+            assert records and all(r.ok for r in records)
+        finally:
+            mgr.stop_workers()
+        # now poison the expectation -> every request flagged failed
+        loader.expected_outputs[0][0]["OUTPUT0"].array[:] = 99
+        mgr2 = ConcurrencyManager(
+            backend_factory=lambda: backend, data_loader=loader,
+            data_manager=dm, model_name="simple",
+        )
+        try:
+            mgr2.change_concurrency_level(1)
+            time.sleep(0.2)
+            records = mgr2.swap_timestamps()
+            assert records and all(not r.ok for r in records)
+        finally:
+            mgr2.cleanup()
+            engine.close()
