@@ -6,9 +6,31 @@ CXX ?= g++
 CXXFLAGS ?= -O2 -fPIC -Wall -std=c++17
 NATIVE_OUT := client_tpu/utils/shared_memory
 
-.PHONY: all protos native clean test
+.PHONY: all protos native cpp clean test
 
-all: protos
+all: protos native cpp
+
+# ---- native C++ client library + examples + integration test -------------
+CPP_DIR := src/cpp
+CPP_BUILD := build/cpp
+CLIENT_SRCS := $(CPP_DIR)/client/json.cc $(CPP_DIR)/client/http_client.cc \
+               $(CPP_DIR)/client/shm_utils.cc
+CLIENT_HDRS := $(wildcard $(CPP_DIR)/client/*.h)
+
+cpp: $(CPP_BUILD)/simple_http_infer_client $(CPP_BUILD)/cc_client_test \
+     $(CPP_BUILD)/libhttpclient_tpu.so
+
+$(CPP_BUILD)/libhttpclient_tpu.so: $(CLIENT_SRCS) $(CLIENT_HDRS)
+	mkdir -p $(CPP_BUILD)
+	$(CXX) $(CXXFLAGS) -shared -o $@ $(CLIENT_SRCS) -lrt -lpthread
+
+$(CPP_BUILD)/simple_http_infer_client: $(CPP_DIR)/examples/simple_http_infer_client.cc $(CLIENT_SRCS) $(CLIENT_HDRS)
+	mkdir -p $(CPP_BUILD)
+	$(CXX) $(CXXFLAGS) -o $@ $< $(CLIENT_SRCS) -lrt -lpthread
+
+$(CPP_BUILD)/cc_client_test: $(CPP_DIR)/tests/cc_client_test.cc $(CLIENT_SRCS) $(CLIENT_HDRS)
+	mkdir -p $(CPP_BUILD)
+	$(CXX) $(CXXFLAGS) -o $@ $< $(CLIENT_SRCS) -lrt -lpthread
 
 protos: $(PB_OUT)/inference_pb2.py
 
@@ -28,6 +50,7 @@ $(NATIVE_OUT)/libcshm_tpu.so: src/cpp/shm/cshm.cc
 
 clean:
 	rm -f $(PB_OUT)/*_pb2.py $(NATIVE_OUT)/libcshm_tpu.so
+	rm -rf $(CPP_BUILD)
 
 test:
 	python -m pytest tests/ -x -q

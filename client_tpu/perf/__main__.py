@@ -47,7 +47,7 @@ def build_parser():
     p.add_argument("--hermetic", action="store_true",
                    help="benchmark the in-process server (no sockets)")
     p.add_argument("--hermetic-models", default="builtin",
-                   help="model sets for --hermetic: builtin,jax")
+                   help="model sets for --hermetic: builtin,jax,language")
     p.add_argument("-b", "--batch-size", type=int, default=1)
     p.add_argument("--concurrency-range", default=None,
                    help="start[:end[:step]]")

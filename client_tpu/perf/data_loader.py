@@ -238,5 +238,7 @@ class DataLoader:
 
     def get_expected_outputs(self, stream_id, step_id):
         if stream_id < len(self.expected_outputs):
-            return self.expected_outputs[stream_id][step_id]
+            steps = self.expected_outputs[stream_id]
+            if step_id < len(steps):
+                return steps[step_id]
         return {}

@@ -26,9 +26,10 @@ def preprocess(path_or_none, size, rng):
     img = Image.open(path_or_none).convert("RGB")
     arr = np.asarray(img, dtype=np.float32) / 255.0
     h, w, _ = arr.shape
-    ph, pw = h // size or 1, w // size or 1
-    arr = arr[: ph * size, : pw * size].reshape(size, ph, size, pw, 3)
-    arr = arr.mean(axis=(1, 3))
+    # nearest-neighbor resample: robust for images of any size
+    rows = (np.arange(size) * h // size).clip(0, h - 1)
+    cols = (np.arange(size) * w // size).clip(0, w - 1)
+    arr = arr[rows][:, cols]
     return arr.transpose(2, 0, 1)
 
 

@@ -1,0 +1,233 @@
+// Native client value types — parity with the reference C++ library's
+// common.h (reference src/c++/library/common.h:62-626: Error,
+// InferOptions, InferInput with zero-copy AppendRaw buffer list,
+// InferRequestedOutput, InferResult, RequestTimers), re-built for the TPU
+// framework with no external dependencies.
+#pragma once
+
+#include <chrono>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+namespace ctpu {
+
+class Error {
+ public:
+  Error() = default;
+  explicit Error(const std::string& msg) : msg_(msg), ok_(false) {}
+  static Error Success() { return Error(); }
+  bool IsOk() const { return ok_; }
+  const std::string& Message() const { return msg_; }
+
+ private:
+  std::string msg_;
+  bool ok_ = true;
+};
+
+// Per-request options (reference common.h:159-220).
+struct InferOptions {
+  explicit InferOptions(const std::string& model_name)
+      : model_name(model_name)
+  {
+  }
+  std::string model_name;
+  std::string model_version;
+  std::string request_id;
+  uint64_t sequence_id = 0;
+  bool sequence_start = false;
+  bool sequence_end = false;
+  uint64_t priority = 0;
+  uint64_t timeout_us = 0;       // server-side request timeout
+  uint64_t client_timeout_us = 0;  // client-side socket deadline
+};
+
+// One named input tensor.  AppendRaw keeps caller-owned buffer pointers (the
+// zero-copy list of reference common.h:226-365); SetSharedMemory switches the
+// payload to a region reference.
+class InferInput {
+ public:
+  InferInput(
+      const std::string& name, const std::vector<int64_t>& shape,
+      const std::string& datatype)
+      : name_(name), shape_(shape), datatype_(datatype)
+  {
+  }
+
+  const std::string& Name() const { return name_; }
+  const std::string& Datatype() const { return datatype_; }
+  const std::vector<int64_t>& Shape() const { return shape_; }
+  void SetShape(const std::vector<int64_t>& shape) { shape_ = shape; }
+
+  Error AppendRaw(const uint8_t* input, size_t input_byte_size)
+  {
+    bufs_.emplace_back(input, input_byte_size);
+    total_byte_size_ += input_byte_size;
+    return Error::Success();
+  }
+  Error AppendRaw(const std::vector<uint8_t>& input)
+  {
+    return AppendRaw(input.data(), input.size());
+  }
+
+  Error SetSharedMemory(
+      const std::string& region_name, size_t byte_size, size_t offset = 0)
+  {
+    shm_name_ = region_name;
+    shm_byte_size_ = byte_size;
+    shm_offset_ = offset;
+    bufs_.clear();
+    total_byte_size_ = 0;
+    return Error::Success();
+  }
+
+  Error Reset()
+  {
+    bufs_.clear();
+    total_byte_size_ = 0;
+    shm_name_.clear();
+    shm_byte_size_ = 0;
+    shm_offset_ = 0;
+    return Error::Success();
+  }
+
+  bool IsSharedMemory() const { return !shm_name_.empty(); }
+  const std::string& SharedMemoryName() const { return shm_name_; }
+  size_t SharedMemoryByteSize() const { return shm_byte_size_; }
+  size_t SharedMemoryOffset() const { return shm_offset_; }
+  size_t TotalByteSize() const { return total_byte_size_; }
+  const std::vector<std::pair<const uint8_t*, size_t>>& Buffers() const
+  {
+    return bufs_;
+  }
+
+ private:
+  std::string name_;
+  std::vector<int64_t> shape_;
+  std::string datatype_;
+  std::vector<std::pair<const uint8_t*, size_t>> bufs_;
+  size_t total_byte_size_ = 0;
+  std::string shm_name_;
+  size_t shm_byte_size_ = 0;
+  size_t shm_offset_ = 0;
+};
+
+// One requested output (reference common.h:371-443).
+class InferRequestedOutput {
+ public:
+  explicit InferRequestedOutput(
+      const std::string& name, size_t class_count = 0)
+      : name_(name), class_count_(class_count)
+  {
+  }
+
+  const std::string& Name() const { return name_; }
+  size_t ClassCount() const { return class_count_; }
+
+  Error SetSharedMemory(
+      const std::string& region_name, size_t byte_size, size_t offset = 0)
+  {
+    shm_name_ = region_name;
+    shm_byte_size_ = byte_size;
+    shm_offset_ = offset;
+    return Error::Success();
+  }
+
+  bool IsSharedMemory() const { return !shm_name_.empty(); }
+  const std::string& SharedMemoryName() const { return shm_name_; }
+  size_t SharedMemoryByteSize() const { return shm_byte_size_; }
+  size_t SharedMemoryOffset() const { return shm_offset_; }
+
+ private:
+  std::string name_;
+  size_t class_count_ = 0;
+  std::string shm_name_;
+  size_t shm_byte_size_ = 0;
+  size_t shm_offset_ = 0;
+};
+
+// Result view over a parsed response (reference common.h:449-516).  Owns the
+// response body; RawData returns views into it.
+class InferResult {
+ public:
+  struct Output {
+    std::string datatype;
+    std::vector<int64_t> shape;
+    const uint8_t* data = nullptr;  // into body_ (binary outputs)
+    size_t byte_size = 0;
+    std::vector<std::string> json_values;  // non-binary / BYTES-from-JSON
+    bool in_shared_memory = false;
+  };
+
+  const std::string& ModelName() const { return model_name_; }
+  const std::string& Id() const { return id_; }
+
+  Error Shape(const std::string& name, std::vector<int64_t>* shape) const
+  {
+    auto it = outputs_.find(name);
+    if (it == outputs_.end()) return Error("unknown output '" + name + "'");
+    *shape = it->second.shape;
+    return Error::Success();
+  }
+
+  Error Datatype(const std::string& name, std::string* datatype) const
+  {
+    auto it = outputs_.find(name);
+    if (it == outputs_.end()) return Error("unknown output '" + name + "'");
+    *datatype = it->second.datatype;
+    return Error::Success();
+  }
+
+  Error RawData(
+      const std::string& name, const uint8_t** buf, size_t* byte_size) const
+  {
+    auto it = outputs_.find(name);
+    if (it == outputs_.end()) return Error("unknown output '" + name + "'");
+    if (it->second.data == nullptr)
+      return Error("output '" + name + "' has no binary data");
+    *buf = it->second.data;
+    *byte_size = it->second.byte_size;
+    return Error::Success();
+  }
+
+  // Classification-extension / JSON-rendered values.
+  Error StringData(
+      const std::string& name, std::vector<std::string>* values) const
+  {
+    auto it = outputs_.find(name);
+    if (it == outputs_.end()) return Error("unknown output '" + name + "'");
+    *values = it->second.json_values;
+    return Error::Success();
+  }
+
+  const std::map<std::string, Output>& Outputs() const { return outputs_; }
+
+  std::string model_name_;
+  std::string id_;
+  std::map<std::string, Output> outputs_;
+  std::string body_;  // owns the raw response bytes
+};
+using InferResultPtr = std::shared_ptr<InferResult>;
+
+// Six-timestamp request timer (reference common.h:521-601).
+struct RequestTimers {
+  enum class Kind { REQUEST_START, SEND_START, SEND_END, RECV_START, RECV_END,
+                    REQUEST_END };
+  uint64_t ts[6] = {0, 0, 0, 0, 0, 0};
+  void Capture(Kind k)
+  {
+    ts[static_cast<int>(k)] =
+        std::chrono::duration_cast<std::chrono::nanoseconds>(
+            std::chrono::steady_clock::now().time_since_epoch())
+            .count();
+  }
+  uint64_t Duration(Kind a, Kind b) const
+  {
+    return ts[static_cast<int>(b)] - ts[static_cast<int>(a)];
+  }
+};
+
+}  // namespace ctpu

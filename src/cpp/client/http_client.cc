@@ -1,0 +1,722 @@
+#include "http_client.h"
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstring>
+#include <sstream>
+#include <thread>
+
+namespace ctpu {
+
+namespace {
+
+std::string
+UrlEncode(const std::string& s)
+{
+  std::string out;
+  for (unsigned char c : s) {
+    if (isalnum(c) || c == '-' || c == '_' || c == '.' || c == '~') {
+      out += static_cast<char>(c);
+    } else {
+      char hex[8];
+      std::snprintf(hex, sizeof(hex), "%%%02X", c);
+      out += hex;
+    }
+  }
+  return out;
+}
+
+std::string
+LowerCase(std::string s)
+{
+  for (auto& c : s) c = static_cast<char>(tolower(c));
+  return s;
+}
+
+}  // namespace
+
+Error
+InferenceServerHttpClient::Create(
+    std::unique_ptr<InferenceServerHttpClient>* client,
+    const std::string& server_url, bool verbose)
+{
+  client->reset(new InferenceServerHttpClient(server_url, verbose));
+  if ((*client)->port_ == 0) {
+    return Error("malformed server url '" + server_url + "' (want host:port)");
+  }
+  return Error::Success();
+}
+
+InferenceServerHttpClient::InferenceServerHttpClient(
+    const std::string& url, bool verbose)
+    : verbose_(verbose)
+{
+  std::string stripped = url;
+  auto scheme = stripped.find("://");
+  if (scheme != std::string::npos) stripped = stripped.substr(scheme + 3);
+  auto colon = stripped.rfind(':');
+  if (colon == std::string::npos) return;
+  host_ = stripped.substr(0, colon);
+  try {
+    port_ = std::stoi(stripped.substr(colon + 1));
+  }
+  catch (...) {
+    port_ = 0;
+  }
+}
+
+InferenceServerHttpClient::~InferenceServerHttpClient()
+{
+  CloseSocket();
+}
+
+void
+InferenceServerHttpClient::CloseSocket()
+{
+  if (fd_ >= 0) {
+    ::close(fd_);
+    fd_ = -1;
+  }
+}
+
+Error
+InferenceServerHttpClient::EnsureConnected()
+{
+  if (fd_ >= 0) return Error::Success();
+  struct addrinfo hints = {};
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  struct addrinfo* res = nullptr;
+  std::string port = std::to_string(port_);
+  int rc = ::getaddrinfo(host_.c_str(), port.c_str(), &hints, &res);
+  if (rc != 0) {
+    return Error(
+        "failed to resolve " + host_ + ": " + std::string(gai_strerror(rc)));
+  }
+  Error err("failed to connect to " + host_ + ":" + port);
+  for (struct addrinfo* ai = res; ai != nullptr; ai = ai->ai_next) {
+    int fd = ::socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd < 0) continue;
+    if (::connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) {
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      fd_ = fd;
+      err = Error::Success();
+      break;
+    }
+    ::close(fd);
+  }
+  ::freeaddrinfo(res);
+  return err;
+}
+
+Error
+InferenceServerHttpClient::Request(
+    HttpResponse* response, const std::string& method, const std::string& uri,
+    const std::string& body, const std::map<std::string, std::string>& headers)
+{
+  for (int attempt = 0; attempt < 2; ++attempt) {
+    Error err = EnsureConnected();
+    if (!err.IsOk()) return err;
+
+    std::ostringstream req;
+    req << method << " " << uri << " HTTP/1.1\r\n";
+    req << "Host: " << host_ << ":" << port_ << "\r\n";
+    req << "Content-Length: " << body.size() << "\r\n";
+    req << "Connection: keep-alive\r\n";
+    for (const auto& kv : headers) {
+      req << kv.first << ": " << kv.second << "\r\n";
+    }
+    req << "\r\n";
+    std::string head = req.str();
+
+    bool write_failed = false;
+    const std::string* parts[2] = {&head, &body};
+    for (const std::string* part : parts) {
+      size_t sent = 0;
+      while (sent < part->size()) {
+        ssize_t n = ::send(
+            fd_, part->data() + sent, part->size() - sent, MSG_NOSIGNAL);
+        if (n <= 0) {
+          write_failed = true;
+          break;
+        }
+        sent += static_cast<size_t>(n);
+      }
+      if (write_failed) break;
+    }
+    if (write_failed) {
+      CloseSocket();  // stale keep-alive connection: reconnect and retry once
+      continue;
+    }
+
+    // read response: status line + headers, then Content-Length body
+    std::string buf;
+    size_t header_end = std::string::npos;
+    char chunk[8192];
+    while (header_end == std::string::npos) {
+      ssize_t n = ::recv(fd_, chunk, sizeof(chunk), 0);
+      if (n <= 0) {
+        CloseSocket();
+        buf.clear();
+        break;
+      }
+      buf.append(chunk, static_cast<size_t>(n));
+      header_end = buf.find("\r\n\r\n");
+    }
+    if (buf.empty()) {
+      if (attempt == 0) continue;  // server closed keep-alive; retry
+      return Error("connection closed by server");
+    }
+
+    response->headers.clear();
+    std::istringstream hs(buf.substr(0, header_end));
+    std::string line;
+    std::getline(hs, line);
+    {
+      auto sp1 = line.find(' ');
+      response->status = 0;
+      if (sp1 != std::string::npos) {
+        try {
+          response->status = std::stoi(line.substr(sp1 + 1));
+        }
+        catch (...) {
+          CloseSocket();
+          return Error("malformed status line: " + line);
+        }
+      }
+    }
+    while (std::getline(hs, line)) {
+      if (!line.empty() && line.back() == '\r') line.pop_back();
+      auto colon = line.find(':');
+      if (colon == std::string::npos) continue;
+      std::string key = LowerCase(line.substr(0, colon));
+      std::string val = line.substr(colon + 1);
+      while (!val.empty() && val.front() == ' ') val.erase(val.begin());
+      response->headers[key] = val;
+    }
+
+    size_t content_length = 0;
+    auto cl = response->headers.find("content-length");
+    if (cl != response->headers.end()) {
+      try {
+        content_length = std::stoull(cl->second);
+      }
+      catch (...) {
+        CloseSocket();
+        return Error("malformed Content-Length: " + cl->second);
+      }
+    }
+    response->body = buf.substr(header_end + 4);
+    while (response->body.size() < content_length) {
+      ssize_t n = ::recv(fd_, chunk, sizeof(chunk), 0);
+      if (n <= 0) {
+        CloseSocket();
+        return Error("connection closed mid-body");
+      }
+      response->body.append(chunk, static_cast<size_t>(n));
+    }
+    if (verbose_) {
+      fprintf(
+          stderr, "[ctpu] %s %s -> %d (%zu bytes)\n", method.c_str(),
+          uri.c_str(), response->status, response->body.size());
+    }
+    auto conn = response->headers.find("connection");
+    if (conn != response->headers.end() &&
+        LowerCase(conn->second) == "close") {
+      CloseSocket();
+    }
+    return Error::Success();
+  }
+  return Error("request failed after reconnect");
+}
+
+namespace {
+
+Error
+ErrorFromResponse(const HttpResponse& r)
+{
+  std::string err;
+  auto parsed = json::Parse(r.body, &err);
+  if (parsed != nullptr && parsed->Get("error") != nullptr) {
+    return Error(parsed->Get("error")->AsString());
+  }
+  return Error("HTTP " + std::to_string(r.status) + ": " + r.body);
+}
+
+}  // namespace
+
+Error
+InferenceServerHttpClient::GetJson(const std::string& uri, json::ValuePtr* out)
+{
+  HttpResponse r;
+  Error err = Request(&r, "GET", uri, "");
+  if (!err.IsOk()) return err;
+  if (r.status != 200) return ErrorFromResponse(r);
+  if (out != nullptr) {
+    std::string perr;
+    *out = json::Parse(r.body.empty() ? "{}" : r.body, &perr);
+    if (*out == nullptr) return Error("malformed response JSON: " + perr);
+  }
+  return Error::Success();
+}
+
+Error
+InferenceServerHttpClient::PostJson(
+    const std::string& uri, const std::string& body, json::ValuePtr* out)
+{
+  HttpResponse r;
+  Error err = Request(
+      &r, "POST", uri, body, {{"Content-Type", "application/json"}});
+  if (!err.IsOk()) return err;
+  if (r.status != 200) return ErrorFromResponse(r);
+  if (out != nullptr) {
+    std::string perr;
+    *out = json::Parse(r.body.empty() ? "{}" : r.body, &perr);
+    if (*out == nullptr) return Error("malformed response JSON: " + perr);
+  }
+  return Error::Success();
+}
+
+Error
+InferenceServerHttpClient::IsServerLive(bool* live)
+{
+  HttpResponse r;
+  Error err = Request(&r, "GET", "/v2/health/live", "");
+  if (!err.IsOk()) return err;
+  *live = (r.status == 200);
+  return Error::Success();
+}
+
+Error
+InferenceServerHttpClient::IsServerReady(bool* ready)
+{
+  HttpResponse r;
+  Error err = Request(&r, "GET", "/v2/health/ready", "");
+  if (!err.IsOk()) return err;
+  *ready = (r.status == 200);
+  return Error::Success();
+}
+
+Error
+InferenceServerHttpClient::IsModelReady(
+    bool* ready, const std::string& model_name,
+    const std::string& model_version)
+{
+  std::string uri = "/v2/models/" + UrlEncode(model_name);
+  if (!model_version.empty()) uri += "/versions/" + model_version;
+  uri += "/ready";
+  HttpResponse r;
+  Error err = Request(&r, "GET", uri, "");
+  if (!err.IsOk()) return err;
+  *ready = (r.status == 200);
+  return Error::Success();
+}
+
+Error
+InferenceServerHttpClient::ServerMetadata(json::ValuePtr* metadata)
+{
+  return GetJson("/v2", metadata);
+}
+
+Error
+InferenceServerHttpClient::ModelMetadata(
+    json::ValuePtr* metadata, const std::string& model_name,
+    const std::string& model_version)
+{
+  std::string uri = "/v2/models/" + UrlEncode(model_name);
+  if (!model_version.empty()) uri += "/versions/" + model_version;
+  return GetJson(uri, metadata);
+}
+
+Error
+InferenceServerHttpClient::ModelConfig(
+    json::ValuePtr* config, const std::string& model_name,
+    const std::string& model_version)
+{
+  std::string uri = "/v2/models/" + UrlEncode(model_name);
+  if (!model_version.empty()) uri += "/versions/" + model_version;
+  uri += "/config";
+  return GetJson(uri, config);
+}
+
+Error
+InferenceServerHttpClient::ModelRepositoryIndex(json::ValuePtr* index)
+{
+  return PostJson("/v2/repository/index", "{}", index);
+}
+
+Error
+InferenceServerHttpClient::LoadModel(const std::string& model_name)
+{
+  return PostJson(
+      "/v2/repository/models/" + UrlEncode(model_name) + "/load", "{}");
+}
+
+Error
+InferenceServerHttpClient::UnloadModel(const std::string& model_name)
+{
+  return PostJson(
+      "/v2/repository/models/" + UrlEncode(model_name) + "/unload", "{}");
+}
+
+Error
+InferenceServerHttpClient::ModelInferenceStatistics(
+    json::ValuePtr* stats, const std::string& model_name,
+    const std::string& model_version)
+{
+  std::string uri = "/v2/models";
+  if (!model_name.empty()) {
+    uri += "/" + UrlEncode(model_name);
+    if (!model_version.empty()) uri += "/versions/" + model_version;
+  }
+  uri += "/stats";
+  return GetJson(uri, stats);
+}
+
+Error
+InferenceServerHttpClient::RegisterSystemSharedMemory(
+    const std::string& name, const std::string& key, size_t byte_size,
+    size_t offset)
+{
+  json::Writer w;
+  w.BeginObject();
+  w.Key("key");
+  w.String(key);
+  w.Key("offset");
+  w.Int(static_cast<int64_t>(offset));
+  w.Key("byte_size");
+  w.Int(static_cast<int64_t>(byte_size));
+  w.EndObject();
+  return PostJson(
+      "/v2/systemsharedmemory/region/" + UrlEncode(name) + "/register",
+      w.str());
+}
+
+Error
+InferenceServerHttpClient::UnregisterSystemSharedMemory(const std::string& name)
+{
+  std::string uri = "/v2/systemsharedmemory";
+  if (!name.empty()) uri += "/region/" + UrlEncode(name);
+  return PostJson(uri + "/unregister", "{}");
+}
+
+Error
+InferenceServerHttpClient::SystemSharedMemoryStatus(json::ValuePtr* status)
+{
+  return GetJson("/v2/systemsharedmemory/status", status);
+}
+
+Error
+InferenceServerHttpClient::RegisterTpuSharedMemory(
+    const std::string& name, const std::string& raw_handle, int device_id,
+    size_t byte_size)
+{
+  // raw handle travels base64 over HTTP like the CUDA path (reference
+  // cencode.c / cuda_shared_memory __init__.py:76-77); ours is JSON-safe
+  // already, so b64 here is purely wire-format parity
+  static const char* b64 =
+      "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789+/";
+  std::string encoded;
+  size_t i = 0;
+  while (i + 2 < raw_handle.size()) {
+    uint32_t v = (static_cast<uint8_t>(raw_handle[i]) << 16) |
+                 (static_cast<uint8_t>(raw_handle[i + 1]) << 8) |
+                 static_cast<uint8_t>(raw_handle[i + 2]);
+    encoded += b64[(v >> 18) & 63];
+    encoded += b64[(v >> 12) & 63];
+    encoded += b64[(v >> 6) & 63];
+    encoded += b64[v & 63];
+    i += 3;
+  }
+  if (i + 1 == raw_handle.size()) {
+    uint32_t v = static_cast<uint8_t>(raw_handle[i]) << 16;
+    encoded += b64[(v >> 18) & 63];
+    encoded += b64[(v >> 12) & 63];
+    encoded += "==";
+  } else if (i + 2 == raw_handle.size()) {
+    uint32_t v = (static_cast<uint8_t>(raw_handle[i]) << 16) |
+                 (static_cast<uint8_t>(raw_handle[i + 1]) << 8);
+    encoded += b64[(v >> 18) & 63];
+    encoded += b64[(v >> 12) & 63];
+    encoded += b64[(v >> 6) & 63];
+    encoded += '=';
+  }
+  json::Writer w;
+  w.BeginObject();
+  w.Key("raw_handle");
+  w.BeginObject();
+  w.Key("b64");
+  w.String(encoded);
+  w.EndObject();
+  w.Key("device_id");
+  w.Int(device_id);
+  w.Key("byte_size");
+  w.Int(static_cast<int64_t>(byte_size));
+  w.EndObject();
+  return PostJson(
+      "/v2/tpusharedmemory/region/" + UrlEncode(name) + "/register", w.str());
+}
+
+Error
+InferenceServerHttpClient::UnregisterTpuSharedMemory(const std::string& name)
+{
+  std::string uri = "/v2/tpusharedmemory";
+  if (!name.empty()) uri += "/region/" + UrlEncode(name);
+  return PostJson(uri + "/unregister", "{}");
+}
+
+Error
+InferenceServerHttpClient::TpuSharedMemoryStatus(json::ValuePtr* status)
+{
+  return GetJson("/v2/tpusharedmemory/status", status);
+}
+
+Error
+InferenceServerHttpClient::GenerateRequestBody(
+    std::string* body, size_t* header_length, const InferOptions& options,
+    const std::vector<InferInput*>& inputs,
+    const std::vector<const InferRequestedOutput*>& outputs)
+{
+  json::Writer w;
+  w.BeginObject();
+  if (!options.request_id.empty()) {
+    w.Key("id");
+    w.String(options.request_id);
+  }
+  if (options.sequence_id != 0 || options.priority != 0 ||
+      options.timeout_us != 0 || outputs.empty()) {
+    w.Key("parameters");
+    w.BeginObject();
+    if (outputs.empty()) {
+      // no explicit outputs: ask for all of them in binary form (reference
+      // http_client.cc sets binary_data_output for this case)
+      w.Key("binary_data_output");
+      w.Bool(true);
+    }
+    if (options.sequence_id != 0) {
+      w.Key("sequence_id");
+      w.Int(static_cast<int64_t>(options.sequence_id));
+      w.Key("sequence_start");
+      w.Bool(options.sequence_start);
+      w.Key("sequence_end");
+      w.Bool(options.sequence_end);
+    }
+    if (options.priority != 0) {
+      w.Key("priority");
+      w.Int(static_cast<int64_t>(options.priority));
+    }
+    if (options.timeout_us != 0) {
+      w.Key("timeout");
+      w.Int(static_cast<int64_t>(options.timeout_us));
+    }
+    w.EndObject();
+  }
+  w.Key("inputs");
+  w.BeginArray();
+  for (const InferInput* input : inputs) {
+    w.BeginObject();
+    w.Key("name");
+    w.String(input->Name());
+    w.Key("shape");
+    w.BeginArray();
+    for (int64_t d : input->Shape()) w.Int(d);
+    w.EndArray();
+    w.Key("datatype");
+    w.String(input->Datatype());
+    w.Key("parameters");
+    w.BeginObject();
+    if (input->IsSharedMemory()) {
+      w.Key("shared_memory_region");
+      w.String(input->SharedMemoryName());
+      w.Key("shared_memory_byte_size");
+      w.Int(static_cast<int64_t>(input->SharedMemoryByteSize()));
+      if (input->SharedMemoryOffset() != 0) {
+        w.Key("shared_memory_offset");
+        w.Int(static_cast<int64_t>(input->SharedMemoryOffset()));
+      }
+    } else {
+      w.Key("binary_data_size");
+      w.Int(static_cast<int64_t>(input->TotalByteSize()));
+    }
+    w.EndObject();
+    w.EndObject();
+  }
+  w.EndArray();
+  if (!outputs.empty()) {
+    w.Key("outputs");
+    w.BeginArray();
+    for (const InferRequestedOutput* output : outputs) {
+      w.BeginObject();
+      w.Key("name");
+      w.String(output->Name());
+      w.Key("parameters");
+      w.BeginObject();
+      if (output->IsSharedMemory()) {
+        w.Key("shared_memory_region");
+        w.String(output->SharedMemoryName());
+        w.Key("shared_memory_byte_size");
+        w.Int(static_cast<int64_t>(output->SharedMemoryByteSize()));
+        if (output->SharedMemoryOffset() != 0) {
+          w.Key("shared_memory_offset");
+          w.Int(static_cast<int64_t>(output->SharedMemoryOffset()));
+        }
+      } else if (output->ClassCount() > 0) {
+        w.Key("classification");
+        w.Int(static_cast<int64_t>(output->ClassCount()));
+      } else {
+        w.Key("binary_data");
+        w.Bool(true);
+      }
+      w.EndObject();
+      w.EndObject();
+    }
+    w.EndArray();
+  }
+  w.EndObject();
+
+  *body = w.str();
+  *header_length = body->size();
+  for (const InferInput* input : inputs) {
+    for (const auto& buf : input->Buffers()) {
+      body->append(reinterpret_cast<const char*>(buf.first), buf.second);
+    }
+  }
+  return Error::Success();
+}
+
+Error
+InferenceServerHttpClient::ParseResponseBody(
+    InferResultPtr* result, std::string&& body, size_t header_length)
+{
+  auto res = std::make_shared<InferResult>();
+  res->body_ = std::move(body);
+  size_t json_len =
+      (header_length == 0) ? res->body_.size() : header_length;
+  std::string perr;
+  auto parsed = json::Parse(res->body_.substr(0, json_len), &perr);
+  if (parsed == nullptr) {
+    return Error("malformed inference response: " + perr);
+  }
+  if (parsed->Get("model_name") != nullptr) {
+    res->model_name_ = parsed->Get("model_name")->AsString();
+  }
+  if (parsed->Get("id") != nullptr) res->id_ = parsed->Get("id")->AsString();
+
+  size_t binary_offset = json_len;
+  const json::Value* outputs = parsed->Get("outputs");
+  if (outputs != nullptr) {
+    for (const auto& out : outputs->arr) {
+      InferResult::Output o;
+      if (out->Get("name") == nullptr) {
+        return Error("response output entry missing 'name'");
+      }
+      std::string name = out->Get("name")->AsString();
+      if (out->Get("datatype") != nullptr) {
+        o.datatype = out->Get("datatype")->AsString();
+      }
+      if (out->Get("shape") != nullptr) {
+        for (const auto& d : out->Get("shape")->arr) {
+          o.shape.push_back(d->AsInt());
+        }
+      }
+      const json::Value* params = out->Get("parameters");
+      if (params != nullptr && params->Get("binary_data_size") != nullptr) {
+        o.byte_size =
+            static_cast<size_t>(params->Get("binary_data_size")->AsInt());
+        if (binary_offset + o.byte_size > res->body_.size()) {
+          return Error("binary section underrun for output '" + name + "'");
+        }
+        o.data = reinterpret_cast<const uint8_t*>(res->body_.data()) +
+                 binary_offset;
+        binary_offset += o.byte_size;
+      } else if (
+          params != nullptr &&
+          params->Get("shared_memory_region") != nullptr) {
+        o.in_shared_memory = true;
+      } else if (out->Get("data") != nullptr) {
+        for (const auto& v : out->Get("data")->arr) {
+          if (v->type == json::Type::String) {
+            o.json_values.push_back(v->AsString());
+          } else if (v->type == json::Type::Double) {
+            o.json_values.push_back(std::to_string(v->AsDouble()));
+          } else {
+            o.json_values.push_back(std::to_string(v->AsInt()));
+          }
+        }
+      }
+      res->outputs_[name] = std::move(o);
+    }
+  }
+  *result = res;
+  return Error::Success();
+}
+
+Error
+InferenceServerHttpClient::Infer(
+    InferResultPtr* result, const InferOptions& options,
+    const std::vector<InferInput*>& inputs,
+    const std::vector<const InferRequestedOutput*>& outputs)
+{
+  std::string body;
+  size_t header_length = 0;
+  Error err = GenerateRequestBody(&body, &header_length, options, inputs,
+                                  outputs);
+  if (!err.IsOk()) return err;
+
+  std::string uri = "/v2/models/" + UrlEncode(options.model_name);
+  if (!options.model_version.empty()) {
+    uri += "/versions/" + options.model_version;
+  }
+  uri += "/infer";
+
+  std::map<std::string, std::string> headers = {
+      {"Content-Type", "application/octet-stream"},
+      {"Inference-Header-Content-Length", std::to_string(header_length)},
+  };
+  HttpResponse r;
+  err = Request(&r, "POST", uri, body, headers);
+  if (!err.IsOk()) return err;
+  if (r.status != 200) return ErrorFromResponse(r);
+
+  size_t resp_header_len = 0;
+  auto it = r.headers.find("inference-header-content-length");
+  if (it != r.headers.end()) {
+    try {
+      resp_header_len = std::stoull(it->second);
+    }
+    catch (...) {
+      return Error("malformed Inference-Header-Content-Length: " + it->second);
+    }
+  }
+  return ParseResponseBody(result, std::move(r.body), resp_header_len);
+}
+
+Error
+InferenceServerHttpClient::AsyncInfer(
+    std::function<void(InferResultPtr, Error)> callback,
+    const InferOptions& options, const std::vector<InferInput*>& inputs,
+    const std::vector<const InferRequestedOutput*>& outputs)
+{
+  // one worker per call over a dedicated connection — the reference's
+  // curl-multi reactor collapses to this under keep-alive-per-client
+  std::string url = host_ + ":" + std::to_string(port_);
+  bool verbose = verbose_;
+  std::thread([=]() {
+    std::unique_ptr<InferenceServerHttpClient> client;
+    Error err = Create(&client, url, verbose);
+    InferResultPtr result;
+    if (err.IsOk()) {
+      err = client->Infer(&result, options, inputs, outputs);
+    }
+    callback(result, err);
+  }).detach();
+  return Error::Success();
+}
+
+}  // namespace ctpu

@@ -1,0 +1,102 @@
+// Native KServe-v2 HTTP client — parity with the reference
+// InferenceServerHttpClient (reference src/c++/library/http_client.h:106-652)
+// over raw POSIX sockets with keep-alive instead of libcurl: the image ships
+// no curl/ssl headers and the KServe HTTP surface needs only HTTP/1.1 with
+// Content-Length framing.  Implements the binary-tensor extension
+// (Inference-Header-Content-Length) and the shared-memory verbs including
+// the TPU region registration this framework adds.
+#pragma once
+
+#include <functional>
+#include <future>
+#include <string>
+#include <vector>
+
+#include "common.h"
+#include "json.h"
+
+namespace ctpu {
+
+struct HttpResponse {
+  int status = 0;
+  std::map<std::string, std::string> headers;
+  std::string body;
+};
+
+class InferenceServerHttpClient {
+ public:
+  static Error Create(
+      std::unique_ptr<InferenceServerHttpClient>* client,
+      const std::string& server_url, bool verbose = false);
+  ~InferenceServerHttpClient();
+
+  Error IsServerLive(bool* live);
+  Error IsServerReady(bool* ready);
+  Error IsModelReady(
+      bool* ready, const std::string& model_name,
+      const std::string& model_version = "");
+
+  Error ServerMetadata(json::ValuePtr* metadata);
+  Error ModelMetadata(
+      json::ValuePtr* metadata, const std::string& model_name,
+      const std::string& model_version = "");
+  Error ModelConfig(
+      json::ValuePtr* config, const std::string& model_name,
+      const std::string& model_version = "");
+  Error ModelRepositoryIndex(json::ValuePtr* index);
+  Error LoadModel(const std::string& model_name);
+  Error UnloadModel(const std::string& model_name);
+  Error ModelInferenceStatistics(
+      json::ValuePtr* stats, const std::string& model_name = "",
+      const std::string& model_version = "");
+
+  Error RegisterSystemSharedMemory(
+      const std::string& name, const std::string& key, size_t byte_size,
+      size_t offset = 0);
+  Error UnregisterSystemSharedMemory(const std::string& name = "");
+  Error SystemSharedMemoryStatus(json::ValuePtr* status);
+  Error RegisterTpuSharedMemory(
+      const std::string& name, const std::string& raw_handle, int device_id,
+      size_t byte_size);
+  Error UnregisterTpuSharedMemory(const std::string& name = "");
+  Error TpuSharedMemoryStatus(json::ValuePtr* status);
+
+  Error Infer(
+      InferResultPtr* result, const InferOptions& options,
+      const std::vector<InferInput*>& inputs,
+      const std::vector<const InferRequestedOutput*>& outputs = {});
+
+  // Fire on a worker thread; callback runs there (reference AsyncInfer).
+  Error AsyncInfer(
+      std::function<void(InferResultPtr, Error)> callback,
+      const InferOptions& options, const std::vector<InferInput*>& inputs,
+      const std::vector<const InferRequestedOutput*>& outputs = {});
+
+  // Request/response pipelining helpers (reference http_client.h:122-138).
+  static Error GenerateRequestBody(
+      std::string* body, size_t* header_length, const InferOptions& options,
+      const std::vector<InferInput*>& inputs,
+      const std::vector<const InferRequestedOutput*>& outputs);
+  static Error ParseResponseBody(
+      InferResultPtr* result, std::string&& body, size_t header_length);
+
+ private:
+  InferenceServerHttpClient(const std::string& url, bool verbose);
+  Error Request(
+      HttpResponse* response, const std::string& method,
+      const std::string& uri, const std::string& body,
+      const std::map<std::string, std::string>& headers = {});
+  Error EnsureConnected();
+  void CloseSocket();
+  Error GetJson(const std::string& uri, json::ValuePtr* out);
+  Error PostJson(
+      const std::string& uri, const std::string& body,
+      json::ValuePtr* out = nullptr);
+
+  std::string host_;
+  int port_ = 0;
+  int fd_ = -1;
+  bool verbose_ = false;
+};
+
+}  // namespace ctpu

@@ -1,0 +1,275 @@
+#include "json.h"
+
+#include <cmath>
+#include <cstdio>
+
+namespace ctpu {
+namespace json {
+
+std::string
+Quote(const std::string& s)
+{
+  std::string out = "\"";
+  for (unsigned char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\r': out += "\\r"; break;
+      case '\t': out += "\\t"; break;
+      default:
+        if (c < 0x20) {
+          char hex[8];
+          std::snprintf(hex, sizeof(hex), "\\u%04x", c);
+          out += hex;
+        } else {
+          out += static_cast<char>(c);
+        }
+    }
+  }
+  out += '"';
+  return out;
+}
+
+void
+Writer::Double(double v)
+{
+  Sep();
+  if (std::isfinite(v)) {
+    char tmp[32];
+    std::snprintf(tmp, sizeof(tmp), "%.17g", v);
+    buf_ += tmp;
+  } else {
+    buf_ += "null";  // JSON cannot carry inf/nan
+  }
+}
+
+namespace {
+
+class Parser {
+ public:
+  Parser(const std::string& text) : text_(text) {}
+
+  ValuePtr Run(std::string* err)
+  {
+    ValuePtr v = ParseValue();
+    SkipWs();
+    if (v == nullptr || pos_ != text_.size()) {
+      if (err_msg_.empty()) err_msg_ = "trailing characters";
+      *err = err_msg_ + " at offset " + std::to_string(pos_);
+      return nullptr;
+    }
+    return v;
+  }
+
+ private:
+  void SkipWs()
+  {
+    while (pos_ < text_.size() &&
+           (text_[pos_] == ' ' || text_[pos_] == '\t' || text_[pos_] == '\n' ||
+            text_[pos_] == '\r'))
+      pos_++;
+  }
+
+  bool Consume(char c)
+  {
+    SkipWs();
+    if (pos_ < text_.size() && text_[pos_] == c) {
+      pos_++;
+      return true;
+    }
+    return false;
+  }
+
+  ValuePtr Fail(const std::string& msg)
+  {
+    if (err_msg_.empty()) err_msg_ = msg;
+    return nullptr;
+  }
+
+  ValuePtr ParseValue()
+  {
+    SkipWs();
+    if (pos_ >= text_.size()) return Fail("unexpected end of input");
+    char c = text_[pos_];
+    if (c == '{') return ParseObject();
+    if (c == '[') return ParseArray();
+    if (c == '"') return ParseString();
+    if (c == 't' || c == 'f') return ParseBool();
+    if (c == 'n') return ParseNull();
+    return ParseNumber();
+  }
+
+  ValuePtr ParseObject()
+  {
+    pos_++;  // '{'
+    auto v = std::make_shared<Value>();
+    v->type = Type::Object;
+    SkipWs();
+    if (Consume('}')) return v;
+    while (true) {
+      SkipWs();
+      ValuePtr key = ParseString();
+      if (key == nullptr) return Fail("expected object key");
+      if (!Consume(':')) return Fail("expected ':'");
+      ValuePtr val = ParseValue();
+      if (val == nullptr) return nullptr;
+      v->obj[key->s] = val;
+      if (Consume(',')) continue;
+      if (Consume('}')) return v;
+      return Fail("expected ',' or '}'");
+    }
+  }
+
+  ValuePtr ParseArray()
+  {
+    pos_++;  // '['
+    auto v = std::make_shared<Value>();
+    v->type = Type::Array;
+    SkipWs();
+    if (Consume(']')) return v;
+    while (true) {
+      ValuePtr item = ParseValue();
+      if (item == nullptr) return nullptr;
+      v->arr.push_back(item);
+      if (Consume(',')) continue;
+      if (Consume(']')) return v;
+      return Fail("expected ',' or ']'");
+    }
+  }
+
+  ValuePtr ParseString()
+  {
+    SkipWs();
+    if (pos_ >= text_.size() || text_[pos_] != '"')
+      return Fail("expected string");
+    pos_++;
+    auto v = std::make_shared<Value>();
+    v->type = Type::String;
+    while (pos_ < text_.size()) {
+      char c = text_[pos_++];
+      if (c == '"') return v;
+      if (c == '\\') {
+        if (pos_ >= text_.size()) return Fail("bad escape");
+        char e = text_[pos_++];
+        switch (e) {
+          case '"': v->s += '"'; break;
+          case '\\': v->s += '\\'; break;
+          case '/': v->s += '/'; break;
+          case 'b': v->s += '\b'; break;
+          case 'f': v->s += '\f'; break;
+          case 'n': v->s += '\n'; break;
+          case 'r': v->s += '\r'; break;
+          case 't': v->s += '\t'; break;
+          case 'u': {
+            if (pos_ + 4 > text_.size()) return Fail("bad \\u escape");
+            unsigned code = 0;
+            for (int k = 0; k < 4; k++) {
+              char h = text_[pos_ + k];
+              unsigned digit;
+              if (h >= '0' && h <= '9') digit = h - '0';
+              else if (h >= 'a' && h <= 'f') digit = h - 'a' + 10;
+              else if (h >= 'A' && h <= 'F') digit = h - 'A' + 10;
+              else return Fail("bad \\u escape");
+              code = (code << 4) | digit;
+            }
+            pos_ += 4;
+            // UTF-8 encode the BMP code point (surrogate pairs unhandled —
+            // KServe bodies never carry them)
+            if (code < 0x80) {
+              v->s += static_cast<char>(code);
+            } else if (code < 0x800) {
+              v->s += static_cast<char>(0xC0 | (code >> 6));
+              v->s += static_cast<char>(0x80 | (code & 0x3F));
+            } else {
+              v->s += static_cast<char>(0xE0 | (code >> 12));
+              v->s += static_cast<char>(0x80 | ((code >> 6) & 0x3F));
+              v->s += static_cast<char>(0x80 | (code & 0x3F));
+            }
+            break;
+          }
+          default: return Fail("bad escape");
+        }
+      } else {
+        v->s += c;
+      }
+    }
+    return Fail("unterminated string");
+  }
+
+  ValuePtr ParseBool()
+  {
+    auto v = std::make_shared<Value>();
+    v->type = Type::Bool;
+    if (text_.compare(pos_, 4, "true") == 0) {
+      v->b = true;
+      pos_ += 4;
+      return v;
+    }
+    if (text_.compare(pos_, 5, "false") == 0) {
+      v->b = false;
+      pos_ += 5;
+      return v;
+    }
+    return Fail("bad literal");
+  }
+
+  ValuePtr ParseNull()
+  {
+    if (text_.compare(pos_, 4, "null") == 0) {
+      pos_ += 4;
+      return std::make_shared<Value>();
+    }
+    return Fail("bad literal");
+  }
+
+  ValuePtr ParseNumber()
+  {
+    size_t start = pos_;
+    bool is_double = false;
+    if (pos_ < text_.size() && (text_[pos_] == '-' || text_[pos_] == '+'))
+      pos_++;
+    while (pos_ < text_.size()) {
+      char c = text_[pos_];
+      if (c >= '0' && c <= '9') {
+        pos_++;
+      } else if (c == '.' || c == 'e' || c == 'E' || c == '-' || c == '+') {
+        is_double = true;
+        pos_++;
+      } else {
+        break;
+      }
+    }
+    if (pos_ == start) return Fail("expected value");
+    auto v = std::make_shared<Value>();
+    std::string num = text_.substr(start, pos_ - start);
+    try {
+      if (is_double) {
+        v->type = Type::Double;
+        v->d = std::stod(num);
+      } else {
+        v->type = Type::Int;
+        v->i = std::stoll(num);
+      }
+    }
+    catch (...) {
+      return Fail("bad number");
+    }
+    return v;
+  }
+
+  const std::string& text_;
+  size_t pos_ = 0;
+  std::string err_msg_;
+};
+
+}  // namespace
+
+ValuePtr
+Parse(const std::string& text, std::string* err)
+{
+  return Parser(text).Run(err);
+}
+
+}  // namespace json
+}  // namespace ctpu

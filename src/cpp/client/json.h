@@ -1,0 +1,106 @@
+// Minimal JSON layer for the native client: a string-building writer and a
+// recursive-descent parser.  The image ships no rapidjson (the reference's
+// JSON dep — reference src/c++/library/json_utils.h), so the client carries
+// its own ~300-line implementation; KServe-v2 bodies are small and simple.
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+namespace ctpu {
+namespace json {
+
+class Value;
+using ValuePtr = std::shared_ptr<Value>;
+
+enum class Type { Null, Bool, Int, Double, String, Array, Object };
+
+class Value {
+ public:
+  Type type = Type::Null;
+  bool b = false;
+  int64_t i = 0;
+  double d = 0.0;
+  std::string s;
+  std::vector<ValuePtr> arr;
+  std::map<std::string, ValuePtr> obj;
+
+  bool IsNull() const { return type == Type::Null; }
+  bool AsBool() const { return type == Type::Bool ? b : false; }
+  int64_t AsInt() const
+  {
+    if (type == Type::Int) return i;
+    if (type == Type::Double) return static_cast<int64_t>(d);
+    if (type == Type::String) return std::stoll(s);
+    return 0;
+  }
+  double AsDouble() const
+  {
+    if (type == Type::Double) return d;
+    if (type == Type::Int) return static_cast<double>(i);
+    return 0.0;
+  }
+  const std::string& AsString() const { return s; }
+  const Value* Get(const std::string& key) const
+  {
+    auto it = obj.find(key);
+    return it == obj.end() ? nullptr : it->second.get();
+  }
+  bool Has(const std::string& key) const { return obj.count(key) != 0; }
+};
+
+// Parse `text`; returns nullptr and sets `err` on failure.
+ValuePtr Parse(const std::string& text, std::string* err);
+
+// Escape and quote a string literal.
+std::string Quote(const std::string& s);
+
+// Incremental writer for request bodies.
+class Writer {
+ public:
+  void BeginObject() { Sep(); buf_ += '{'; stack_.push_back(kFirst); }
+  void EndObject() { buf_ += '}'; Pop(); }
+  void BeginArray() { Sep(); buf_ += '['; stack_.push_back(kFirst); }
+  void EndArray() { buf_ += ']'; Pop(); }
+  void Key(const std::string& k)
+  {
+    Sep();
+    buf_ += Quote(k);
+    buf_ += ':';
+    pending_value_ = true;
+  }
+  void String(const std::string& v) { Sep(); buf_ += Quote(v); }
+  void Int(int64_t v) { Sep(); buf_ += std::to_string(v); }
+  void Double(double v);
+  void Bool(bool v) { Sep(); buf_ += v ? "true" : "false"; }
+  void Raw(const std::string& v) { Sep(); buf_ += v; }
+  const std::string& str() const { return buf_; }
+
+ private:
+  static constexpr int kFirst = 0, kNext = 1;
+  void Sep()
+  {
+    if (pending_value_) {
+      pending_value_ = false;
+      return;
+    }
+    if (!stack_.empty()) {
+      if (stack_.back() == kNext) buf_ += ',';
+      stack_.back() = kNext;
+    }
+  }
+  void Pop()
+  {
+    if (!stack_.empty()) stack_.pop_back();
+    if (!stack_.empty()) stack_.back() = kNext;
+  }
+  std::string buf_;
+  std::vector<int> stack_;
+  bool pending_value_ = false;
+};
+
+}  // namespace json
+}  // namespace ctpu

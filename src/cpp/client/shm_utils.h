@@ -1,0 +1,24 @@
+// POSIX system shared-memory helpers — parity with the reference shm_utils
+// (reference src/c++/library/shm_utils.h:38-64): create/map/close/unlink
+// regions used with RegisterSystemSharedMemory.
+#pragma once
+
+#include <cstddef>
+
+#include "common.h"
+
+namespace ctpu {
+
+// shm_open(O_CREAT|O_RDWR) + ftruncate; returns the fd.
+Error CreateSharedMemoryRegion(
+    const std::string& shm_key, size_t byte_size, int* shm_fd);
+
+// mmap a window of the region.
+Error MapSharedMemory(
+    int shm_fd, size_t offset, size_t byte_size, void** shm_addr);
+
+Error CloseSharedMemory(int shm_fd);
+Error UnlinkSharedMemoryRegion(const std::string& shm_key);
+Error UnmapSharedMemory(void* shm_addr, size_t byte_size);
+
+}  // namespace ctpu

@@ -1,0 +1,294 @@
+// Native client integration suite — the reference cc_client_test.cc pattern
+// (reference src/c++/tests/cc_client_test.cc: typed suite against a live
+// server) with a self-contained CHECK harness instead of gtest (not in the
+// image).  Run against the Python in-process server:
+//   cc_client_test <host:port>
+#include <atomic>
+#include <condition_variable>
+#include <cstring>
+#include <iostream>
+#include <mutex>
+
+#include "../client/http_client.h"
+#include "../client/shm_utils.h"
+
+namespace tc = ctpu;
+
+static int g_failures = 0;
+static int g_checks = 0;
+
+#define CHECK(cond)                                                         \
+  do {                                                                      \
+    g_checks++;                                                             \
+    if (!(cond)) {                                                          \
+      g_failures++;                                                         \
+      std::cerr << "FAIL " << __FILE__ << ":" << __LINE__ << "  " << #cond  \
+                << std::endl;                                               \
+    }                                                                       \
+  } while (false)
+
+#define CHECK_OK(expr)                                                      \
+  do {                                                                      \
+    g_checks++;                                                             \
+    tc::Error e__ = (expr);                                                 \
+    if (!e__.IsOk()) {                                                      \
+      g_failures++;                                                         \
+      std::cerr << "FAIL " << __FILE__ << ":" << __LINE__ << "  " << #expr  \
+                << " -> " << e__.Message() << std::endl;                    \
+    }                                                                       \
+  } while (false)
+
+static void
+TestHealthAndMetadata(tc::InferenceServerHttpClient* client)
+{
+  bool live = false, ready = false, model_ready = false;
+  CHECK_OK(client->IsServerLive(&live));
+  CHECK(live);
+  CHECK_OK(client->IsServerReady(&ready));
+  CHECK(ready);
+  CHECK_OK(client->IsModelReady(&model_ready, "simple"));
+  CHECK(model_ready);
+  CHECK_OK(client->IsModelReady(&model_ready, "no_such_model"));
+  CHECK(!model_ready);
+
+  ctpu::json::ValuePtr meta;
+  CHECK_OK(client->ServerMetadata(&meta));
+  CHECK(meta->Get("name") != nullptr);
+
+  CHECK_OK(client->ModelMetadata(&meta, "simple"));
+  CHECK(meta->Get("name")->AsString() == "simple");
+  CHECK(meta->Get("inputs")->arr.size() == 2);
+
+  CHECK_OK(client->ModelConfig(&meta, "simple"));
+  CHECK(meta->Has("max_batch_size") || meta->Has("name"));
+
+  // HTTP repository index is a bare JSON array (Triton HTTP format)
+  CHECK_OK(client->ModelRepositoryIndex(&meta));
+  CHECK(meta->type == ctpu::json::Type::Array && !meta->arr.empty());
+
+  tc::Error err = client->ModelMetadata(&meta, "no_such_model");
+  CHECK(!err.IsOk());
+}
+
+static void
+FillInputs(
+    std::vector<int32_t>& in0, std::vector<int32_t>& in1, tc::InferInput& i0,
+    tc::InferInput& i1)
+{
+  for (int i = 0; i < 16; i++) {
+    in0[i] = i;
+    in1[i] = 2;
+  }
+  i0.AppendRaw(
+      reinterpret_cast<const uint8_t*>(in0.data()),
+      in0.size() * sizeof(int32_t));
+  i1.AppendRaw(
+      reinterpret_cast<const uint8_t*>(in1.data()),
+      in1.size() * sizeof(int32_t));
+}
+
+static void
+TestInfer(tc::InferenceServerHttpClient* client)
+{
+  std::vector<int32_t> in0(16), in1(16);
+  tc::InferInput i0("INPUT0", {1, 16}, "INT32");
+  tc::InferInput i1("INPUT1", {1, 16}, "INT32");
+  FillInputs(in0, in1, i0, i1);
+  tc::InferRequestedOutput o0("OUTPUT0"), o1("OUTPUT1");
+
+  tc::InferOptions options("simple");
+  options.request_id = "42";
+  tc::InferResultPtr result;
+  CHECK_OK(client->Infer(&result, options, {&i0, &i1}, {&o0, &o1}));
+  CHECK(result->ModelName() == "simple");
+  CHECK(result->Id() == "42");
+
+  std::vector<int64_t> shape;
+  CHECK_OK(result->Shape("OUTPUT0", &shape));
+  CHECK(shape.size() == 2 && shape[0] == 1 && shape[1] == 16);
+  std::string datatype;
+  CHECK_OK(result->Datatype("OUTPUT0", &datatype));
+  CHECK(datatype == "INT32");
+
+  const uint8_t* buf = nullptr;
+  size_t size = 0;
+  CHECK_OK(result->RawData("OUTPUT0", &buf, &size));
+  CHECK(size == 16 * sizeof(int32_t));
+  const int32_t* sum = reinterpret_cast<const int32_t*>(buf);
+  for (int i = 0; i < 16; i++) CHECK(sum[i] == in0[i] + in1[i]);
+}
+
+static void
+TestInferClassification(tc::InferenceServerHttpClient* client)
+{
+  std::vector<float> scores = {0.1f, 0.7f, 0.15f, 0.05f};
+  tc::InferInput input("INPUT0", {1, 4}, "FP32");
+  input.AppendRaw(
+      reinterpret_cast<const uint8_t*>(scores.data()),
+      scores.size() * sizeof(float));
+  tc::InferRequestedOutput output("OUTPUT0", /*class_count=*/2);
+  tc::InferOptions options("classifier");
+  tc::InferResultPtr result;
+  CHECK_OK(client->Infer(&result, options, {&input}, {&output}));
+  std::vector<std::string> values;
+  CHECK_OK(result->StringData("OUTPUT0", &values));
+  CHECK(values.size() == 2);
+  // best class is index 1 ("dog") per the builtin classifier's labels
+  CHECK(values[0].find(":1:dog") != std::string::npos);
+}
+
+static void
+TestAsyncInfer(tc::InferenceServerHttpClient* client)
+{
+  std::vector<int32_t> in0(16), in1(16);
+  tc::InferInput i0("INPUT0", {1, 16}, "INT32");
+  tc::InferInput i1("INPUT1", {1, 16}, "INT32");
+  FillInputs(in0, in1, i0, i1);
+  tc::InferOptions options("simple");
+
+  std::mutex mu;
+  std::condition_variable cv;
+  bool done = false;
+  tc::InferResultPtr result;
+  tc::Error async_err;
+  CHECK_OK(client->AsyncInfer(
+      [&](tc::InferResultPtr r, tc::Error e) {
+        std::lock_guard<std::mutex> lk(mu);
+        result = r;
+        async_err = e;
+        done = true;
+        cv.notify_one();
+      },
+      options, {&i0, &i1}));
+  {
+    std::unique_lock<std::mutex> lk(mu);
+    cv.wait_for(lk, std::chrono::seconds(30), [&] { return done; });
+  }
+  CHECK(done);
+  CHECK_OK(async_err);
+  const uint8_t* buf = nullptr;
+  size_t size = 0;
+  CHECK_OK(result->RawData("OUTPUT0", &buf, &size));
+  const int32_t* sum = reinterpret_cast<const int32_t*>(buf);
+  for (int i = 0; i < 16; i++) CHECK(sum[i] == in0[i] + in1[i]);
+}
+
+static void
+TestSystemSharedMemory(tc::InferenceServerHttpClient* client)
+{
+  const char* key = "/cc_test_shm";
+  const size_t region_size = 2 * 16 * sizeof(int32_t);
+  int fd = -1;
+  CHECK_OK(tc::CreateSharedMemoryRegion(key, region_size, &fd));
+  void* addr = nullptr;
+  CHECK_OK(tc::MapSharedMemory(fd, 0, region_size, &addr));
+  int32_t* in_region = static_cast<int32_t*>(addr);
+  for (int i = 0; i < 16; i++) {
+    in_region[i] = i;
+    in_region[16 + i] = 3;
+  }
+
+  CHECK_OK(client->RegisterSystemSharedMemory("cc_in", key, region_size));
+  // HTTP shm status is a bare array of region entries (Triton HTTP format)
+  ctpu::json::ValuePtr status;
+  CHECK_OK(client->SystemSharedMemoryStatus(&status));
+  bool found = false;
+  for (const auto& region : status->arr) {
+    if (region->Get("name") != nullptr &&
+        region->Get("name")->AsString() == "cc_in") {
+      found = true;
+    }
+  }
+  CHECK(found);
+
+  tc::InferInput i0("INPUT0", {1, 16}, "INT32");
+  tc::InferInput i1("INPUT1", {1, 16}, "INT32");
+  i0.SetSharedMemory("cc_in", 16 * sizeof(int32_t), 0);
+  i1.SetSharedMemory("cc_in", 16 * sizeof(int32_t), 16 * sizeof(int32_t));
+  tc::InferRequestedOutput o0("OUTPUT0");
+  tc::InferOptions options("simple");
+  tc::InferResultPtr result;
+  CHECK_OK(client->Infer(&result, options, {&i0, &i1}, {&o0}));
+  const uint8_t* buf = nullptr;
+  size_t size = 0;
+  CHECK_OK(result->RawData("OUTPUT0", &buf, &size));
+  const int32_t* sum = reinterpret_cast<const int32_t*>(buf);
+  for (int i = 0; i < 16; i++) CHECK(sum[i] == i + 3);
+
+  CHECK_OK(client->UnregisterSystemSharedMemory("cc_in"));
+  CHECK_OK(tc::UnmapSharedMemory(addr, region_size));
+  CHECK_OK(tc::CloseSharedMemory(fd));
+  CHECK_OK(tc::UnlinkSharedMemoryRegion(key));
+}
+
+static void
+TestSequence(tc::InferenceServerHttpClient* client)
+{
+  // stateful accumulator over the sequence protocol (request parameters)
+  int32_t values[3] = {5, 7, 11};
+  int32_t expected = 0;
+  for (int step = 0; step < 3; step++) {
+    expected += values[step];
+    tc::InferInput input("INPUT", {1}, "INT32");
+    input.AppendRaw(
+        reinterpret_cast<const uint8_t*>(&values[step]), sizeof(int32_t));
+    tc::InferOptions options("simple_sequence");
+    options.sequence_id = 9001;
+    options.sequence_start = (step == 0);
+    options.sequence_end = (step == 2);
+    tc::InferResultPtr result;
+    CHECK_OK(client->Infer(&result, options, {&input}));
+    const uint8_t* buf = nullptr;
+    size_t size = 0;
+    CHECK_OK(result->RawData("OUTPUT", &buf, &size));
+    CHECK(*reinterpret_cast<const int32_t*>(buf) == expected);
+  }
+}
+
+static void
+TestModelControl(tc::InferenceServerHttpClient* client)
+{
+  bool ready = false;
+  CHECK_OK(client->UnloadModel("simple"));
+  CHECK_OK(client->IsModelReady(&ready, "simple"));
+  CHECK(!ready);
+  CHECK_OK(client->LoadModel("simple"));
+  CHECK_OK(client->IsModelReady(&ready, "simple"));
+  CHECK(ready);
+}
+
+static void
+TestStatistics(tc::InferenceServerHttpClient* client)
+{
+  ctpu::json::ValuePtr stats;
+  CHECK_OK(client->ModelInferenceStatistics(&stats, "simple"));
+  CHECK(stats->Get("model_stats") != nullptr);
+}
+
+int
+main(int argc, char** argv)
+{
+  std::string url = (argc > 1) ? argv[1] : "localhost:8000";
+  std::unique_ptr<tc::InferenceServerHttpClient> client;
+  tc::Error err = tc::InferenceServerHttpClient::Create(&client, url);
+  if (!err.IsOk()) {
+    std::cerr << "error: " << err.Message() << std::endl;
+    return 1;
+  }
+  TestHealthAndMetadata(client.get());
+  TestInfer(client.get());
+  TestInferClassification(client.get());
+  TestAsyncInfer(client.get());
+  TestSystemSharedMemory(client.get());
+  TestSequence(client.get());
+  TestModelControl(client.get());
+  TestStatistics(client.get());
+
+  std::cout << (g_checks - g_failures) << "/" << g_checks << " checks passed"
+            << std::endl;
+  if (g_failures == 0) {
+    std::cout << "PASS: cc_client_test" << std::endl;
+    return 0;
+  }
+  return 1;
+}

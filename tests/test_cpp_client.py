@@ -1,0 +1,45 @@
+"""Native C++ client integration: run the compiled cc_client_test binary and
+example against the in-process server over a real socket (the reference's
+cc_client_test.cc pattern, SURVEY.md §4.3)."""
+
+import os
+import subprocess
+
+import pytest
+
+from client_tpu.serve import Server
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_BUILD = os.path.join(_REPO, "build", "cpp")
+
+needs_cpp = pytest.mark.skipif(
+    not os.path.exists(os.path.join(_BUILD, "cc_client_test")),
+    reason="native client not built (make cpp)",
+)
+
+
+@pytest.fixture(scope="module")
+def server():
+    with Server(http_port=0) as s:
+        yield s
+
+
+@needs_cpp
+def test_cc_client_suite(server):
+    proc = subprocess.run(
+        [os.path.join(_BUILD, "cc_client_test"), server.http_address],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "PASS: cc_client_test" in proc.stdout
+
+
+@needs_cpp
+def test_native_example(server):
+    proc = subprocess.run(
+        [os.path.join(_BUILD, "simple_http_infer_client"), "-u",
+         server.http_address],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "PASS" in proc.stdout
