@@ -1,0 +1,19 @@
+"""client_tpu — a TPU-native client framework for KServe-v2 inference servers.
+
+Capability surface mirrors the Triton client libraries (reference:
+/root/reference/src/python/library/tritonclient) with the CUDA shared-memory
+transport replaced by a libtpu/XLA-PJRT device-buffer path:
+
+- ``client_tpu.http`` / ``client_tpu.http.aio``  — HTTP/REST clients
+- ``client_tpu.grpc`` / ``client_tpu.grpc.aio``  — gRPC clients (incl. streaming)
+- ``client_tpu.utils``                           — dtypes + (de)serialization
+- ``client_tpu.utils.shared_memory``             — POSIX system shared memory
+- ``client_tpu.utils.tpu_shared_memory``         — TPU HBM device-buffer regions
+- ``client_tpu.serve``                           — in-process KServe-v2 server with a
+  JAX/TPU execution runtime (hermetic test double *and* a real TPU serving path)
+- ``client_tpu.perf``                            — perf_analyzer-class load generator
+"""
+
+from client_tpu._version import __version__
+
+__all__ = ["__version__"]

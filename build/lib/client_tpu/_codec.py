@@ -1,0 +1,140 @@
+"""KServe-v2 HTTP body codec: JSON header + appended raw binary tensors.
+
+Shared by the HTTP client and the in-process server so both sides of the
+binary-tensor-data extension (`Inference-Header-Content-Length`) are encoded and
+decoded by one implementation. Spec shape matches the reference client's
+request builder (tritonclient/http/__init__.py:82-139) and result parser
+(http/__init__.py:2045-2115).
+"""
+
+import gzip
+import json
+import zlib
+
+from client_tpu.utils import InferenceServerException
+
+
+def build_infer_request_body(
+    inputs,
+    outputs=None,
+    request_id="",
+    sequence_id=0,
+    sequence_start=False,
+    sequence_end=False,
+    priority=0,
+    timeout=None,
+    parameters=None,
+):
+    """Render InferInput/InferRequestedOutput lists into (body, json_size).
+
+    ``json_size`` is None when no raw binary section follows the JSON header
+    (pure-JSON request).
+    """
+    infer_request = {}
+    if request_id:
+        infer_request["id"] = request_id
+    params = {}
+    if sequence_id:
+        params["sequence_id"] = sequence_id
+        params["sequence_start"] = bool(sequence_start)
+        params["sequence_end"] = bool(sequence_end)
+    if priority:
+        params["priority"] = priority
+    if timeout is not None:
+        params["timeout"] = timeout
+    if parameters:
+        params.update(parameters)
+    if params:
+        infer_request["parameters"] = params
+
+    binary_blobs = []
+    inputs_json = []
+    for inp in inputs:
+        entry = {
+            "name": inp.name(),
+            "shape": inp.shape(),
+            "datatype": inp.datatype(),
+        }
+        if inp.parameters():
+            entry["parameters"] = dict(inp.parameters())
+        raw = inp.raw_data()
+        if raw is not None:
+            binary_blobs.append(raw)
+        elif inp.nonbinary_data() is not None:
+            entry["data"] = inp.nonbinary_data()
+        elif "shared_memory_region" not in inp.parameters():
+            raise InferenceServerException(
+                f"input '{inp.name()}' has no data; call set_data_from_numpy "
+                "or set_shared_memory"
+            )
+        inputs_json.append(entry)
+    infer_request["inputs"] = inputs_json
+
+    if outputs:
+        outputs_json = []
+        for out in outputs:
+            entry = {"name": out.name()}
+            if out.parameters():
+                entry["parameters"] = dict(out.parameters())
+            outputs_json.append(entry)
+        infer_request["outputs"] = outputs_json
+    else:
+        # No explicit outputs: ask for all outputs as binary (binary-data-output
+        # request parameter from the spec's binary-data extension).
+        infer_request.setdefault("parameters", {})["binary_data_output"] = True
+
+    header = json.dumps(infer_request).encode("utf-8")
+    if binary_blobs:
+        return b"".join([header] + binary_blobs), len(header)
+    return header, None
+
+
+def parse_infer_request_body(body, header_length=None):
+    """Server side: split request body into (header_dict, binary_section)."""
+    if header_length is None:
+        return json.loads(body.decode("utf-8")), b""
+    header = json.loads(bytes(body[:header_length]).decode("utf-8"))
+    return header, body[header_length:]
+
+
+def build_infer_response_body(response_json, binary_blobs):
+    """Server side: render response header + binary outputs -> (body, json_size)."""
+    header = json.dumps(response_json).encode("utf-8")
+    if binary_blobs:
+        return b"".join([header] + binary_blobs), len(header)
+    return header, None
+
+
+def parse_infer_response_body(body, header_length=None):
+    """Client side: split response into (header_dict, binary_section)."""
+    if header_length is None:
+        return json.loads(body.decode("utf-8")), b""
+    header = json.loads(bytes(body[:header_length]).decode("utf-8"))
+    return header, body[header_length:]
+
+
+def compress(body, algorithm):
+    """Compress a request body per Content-Encoding *algorithm* (gzip/deflate)."""
+    if algorithm is None:
+        return body
+    if algorithm == "gzip":
+        return gzip.compress(body)
+    if algorithm == "deflate":
+        return zlib.compress(body)
+    raise InferenceServerException(
+        f"unsupported compression algorithm '{algorithm}' (use gzip or deflate)"
+    )
+
+
+def decompress(body, content_encoding):
+    """Decompress a body per its Content-Encoding header value."""
+    if not content_encoding:
+        return body
+    enc = content_encoding.lower()
+    if enc == "gzip":
+        return gzip.decompress(body)
+    if enc == "deflate":
+        return zlib.decompress(body)
+    if enc == "identity":
+        return body
+    raise InferenceServerException(f"unsupported Content-Encoding '{enc}'")

@@ -1,0 +1,197 @@
+"""Transport-independent inference value types.
+
+The reference implements these classes twice — once per transport, building
+JSON dicts (tritonclient/http/__init__.py:1846-2044) or protobuf messages
+(tritonclient/grpc/__init__.py:1846-2150) directly. Here one implementation
+holds the tensor payload + attributes; each transport adapter renders it at
+request-build time. This also lets ``set_data_from_array`` accept device-resident
+``jax.Array`` values uniformly.
+"""
+
+import numpy as np
+
+from client_tpu.utils import (
+    InferenceServerException,
+    np_to_triton_dtype,
+    raise_error,
+    to_wire_bytes,
+)
+
+
+class InferInput:
+    """One named input tensor of an inference request.
+
+    Parity: C++ ``tc::InferInput`` (reference common.h:226-365) and the Python
+    per-transport classes. Payload is either wire bytes (``_raw_data``), a
+    JSON-able nested list (``_data``, HTTP non-binary mode), or a shared-memory
+    reference.
+    """
+
+    def __init__(self, name, shape, datatype):
+        self._name = name
+        self._shape = list(shape)
+        self._datatype = datatype
+        self._parameters = {}
+        self._raw_data = None
+        self._data = None  # non-binary (JSON) payload, HTTP only
+
+    def name(self):
+        return self._name
+
+    def datatype(self):
+        return self._datatype
+
+    def shape(self):
+        return self._shape
+
+    def set_shape(self, shape):
+        self._shape = list(shape)
+        return self
+
+    def parameters(self):
+        return self._parameters
+
+    def raw_data(self):
+        """Wire bytes if set via binary path, else None."""
+        return self._raw_data
+
+    def nonbinary_data(self):
+        """JSON-able payload if set via binary_data=False, else None."""
+        return self._data
+
+    def set_data_from_numpy(self, input_tensor, binary_data=True):
+        """Attach tensor data. Validates dtype and shape against this input.
+
+        With ``binary_data=False`` the values travel in the JSON header (not
+        valid for FP16/BF16, which JSON cannot represent — protocol rule).
+        """
+        if not isinstance(input_tensor, np.ndarray):
+            raise_error("input tensor must be a numpy array")
+        dtype = np_to_triton_dtype(input_tensor.dtype)
+        if self._datatype != dtype:
+            raise_error(
+                f"got unexpected datatype {dtype} from numpy array, "
+                f"expected {self._datatype}"
+            )
+        valid_shape = list(input_tensor.shape) == self._shape
+        if not valid_shape:
+            raise_error(
+                f"got unexpected numpy array shape {list(input_tensor.shape)}, "
+                f"expected {self._shape}"
+            )
+        self._parameters.pop("shared_memory_region", None)
+        self._parameters.pop("shared_memory_byte_size", None)
+        self._parameters.pop("shared_memory_offset", None)
+        if binary_data:
+            self._data = None
+            self._raw_data = to_wire_bytes(input_tensor, self._datatype)
+            self._parameters["binary_data_size"] = len(self._raw_data)
+        else:
+            if self._datatype in ("FP16", "BF16"):
+                raise_error(
+                    f"{self._datatype} tensors must use binary_data=True "
+                    "(JSON cannot represent them)"
+                )
+            self._raw_data = None
+            self._parameters.pop("binary_data_size", None)
+            if self._datatype == "BYTES":
+                self._data = [
+                    b.decode("utf-8") if isinstance(b, bytes) else str(b)
+                    for b in input_tensor.flatten()
+                ]
+            else:
+                self._data = [v.item() for v in input_tensor.flatten()]
+        return self
+
+    def set_data_from_array(self, device_array, binary_data=True):
+        """TPU-native entry: attach a jax.Array (or anything np.asarray accepts).
+
+        Device->host transfer happens here, once, via dlpack/zero-copy where the
+        backend allows. For zero host-copy transport use TPU shared memory
+        (client_tpu.utils.tpu_shared_memory) + ``set_shared_memory`` instead.
+        """
+        arr = np.asarray(device_array)
+        expected = self._datatype
+        got = np_to_triton_dtype(arr.dtype)
+        if got != expected:
+            raise_error(
+                f"device array datatype {got} does not match input {expected}"
+            )
+        return self.set_data_from_numpy(arr, binary_data=binary_data)
+
+    def set_shared_memory(self, region_name, byte_size, offset=0):
+        """Reference a registered shared-memory region instead of inline bytes."""
+        self._data = None
+        self._raw_data = None
+        self._parameters.pop("binary_data_size", None)
+        self._parameters["shared_memory_region"] = region_name
+        self._parameters["shared_memory_byte_size"] = byte_size
+        if offset:
+            self._parameters["shared_memory_offset"] = offset
+        return self
+
+    def reset(self):
+        """Drop payload + payload parameters so the object can be reused
+        (parity: C++ InferInput::Reset, reference common.h:261)."""
+        self._raw_data = None
+        self._data = None
+        for k in (
+            "binary_data_size",
+            "shared_memory_region",
+            "shared_memory_byte_size",
+            "shared_memory_offset",
+        ):
+            self._parameters.pop(k, None)
+        return self
+
+
+class InferRequestedOutput:
+    """One requested output: binary/JSON rendering, classification, or shm target.
+
+    Parity: reference common.h:371-443.
+    """
+
+    def __init__(self, name, binary_data=True, class_count=0):
+        self._name = name
+        self._parameters = {}
+        self._binary = binary_data
+        if binary_data:
+            self._parameters["binary_data"] = True
+        if class_count:
+            self._parameters["classification"] = class_count
+
+    def name(self):
+        return self._name
+
+    def parameters(self):
+        return self._parameters
+
+    def set_shared_memory(self, region_name, byte_size, offset=0):
+        self._parameters.pop("binary_data", None)
+        self._parameters["shared_memory_region"] = region_name
+        self._parameters["shared_memory_byte_size"] = byte_size
+        if offset:
+            self._parameters["shared_memory_offset"] = offset
+        return self
+
+    def unset_shared_memory(self):
+        self._parameters.pop("shared_memory_region", None)
+        self._parameters.pop("shared_memory_byte_size", None)
+        self._parameters.pop("shared_memory_offset", None)
+        if self._binary:
+            self._parameters["binary_data"] = True
+        return self
+
+
+def _np_from_json_data(data, datatype, shape):
+    if datatype == "BYTES":
+        flat = [
+            d.encode("utf-8") if isinstance(d, str) else bytes(d) for d in data
+        ]
+        return np.array(flat, dtype=np.object_).reshape(shape)
+    from client_tpu.utils import triton_to_np_dtype
+
+    np_dtype = triton_to_np_dtype(datatype)
+    if np_dtype is None:
+        raise InferenceServerException(f"unsupported datatype {datatype}")
+    return np.array(data, dtype=np_dtype).reshape(shape)

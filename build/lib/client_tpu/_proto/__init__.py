@@ -1,0 +1,9 @@
+"""Generated protobuf modules for the KServe-v2 protocol.
+
+Generated from proto/inference.proto + proto/model_config.proto by `make protos`
+(plain protoc --python_out; service stubs are hand-built over grpc's generic
+channel API in client_tpu.grpc since grpcio-tools is not a dependency).
+"""
+
+from client_tpu._proto import model_config_pb2  # noqa: F401
+from client_tpu._proto import inference_pb2  # noqa: F401

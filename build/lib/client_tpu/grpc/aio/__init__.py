@@ -1,0 +1,360 @@
+"""asyncio gRPC client — mirror of client_tpu.grpc over ``grpc.aio``.
+
+Capability parity with ``tritonclient.grpc.aio`` (reference
+src/python/library/tritonclient/grpc/aio/__init__.py:34-772): every RPC as a
+coroutine, plus ``stream_infer`` which maps an async iterator of requests onto
+the bidirectional ModelStreamInfer stream and yields (result, error) tuples.
+"""
+
+import grpc
+
+from client_tpu._grpc_infer import (  # noqa: F401
+    InferResult,
+    build_infer_request,
+)
+from client_tpu._grpc_service import build_stubs
+from client_tpu._infer_types import InferInput, InferRequestedOutput  # noqa: F401
+from client_tpu._proto import inference_pb2 as pb
+from client_tpu.grpc import (
+    KeepAliveOptions,  # noqa: F401
+    _channel_options,
+    _grpc_compression,
+    _metadata,
+    raise_error_grpc,
+)
+from client_tpu.utils import InferenceServerException, raise_error
+
+__all__ = [
+    "InferenceServerClient",
+    "InferInput",
+    "InferRequestedOutput",
+    "InferResult",
+    "KeepAliveOptions",
+]
+
+
+class InferenceServerClient:
+    """asyncio client for every GRPCInferenceService RPC."""
+
+    def __init__(
+        self,
+        url,
+        verbose=False,
+        ssl=False,
+        root_certificates=None,
+        private_key=None,
+        certificate_chain=None,
+        creds=None,
+        keepalive_options=None,
+        channel_args=None,
+    ):
+        options = _channel_options(keepalive_options, channel_args)
+        if creds is not None:
+            self._channel = grpc.aio.secure_channel(url, creds, options=options)
+        elif ssl:
+            rc = pk = cc = None
+            if root_certificates:
+                with open(root_certificates, "rb") as f:
+                    rc = f.read()
+            if private_key:
+                with open(private_key, "rb") as f:
+                    pk = f.read()
+            if certificate_chain:
+                with open(certificate_chain, "rb") as f:
+                    cc = f.read()
+            credentials = grpc.ssl_channel_credentials(rc, pk, cc)
+            self._channel = grpc.aio.secure_channel(url, credentials, options=options)
+        else:
+            self._channel = grpc.aio.insecure_channel(url, options=options)
+        self._stubs = build_stubs(self._channel)
+        self._verbose = verbose
+
+    async def close(self):
+        await self._channel.close()
+
+    async def __aenter__(self):
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.close()
+
+    async def _call(self, name, request, headers=None, client_timeout=None, **kw):
+        if self._verbose:
+            print(f"{name}, metadata {headers}\n{request}")
+        try:
+            response = await self._stubs[name](
+                request, metadata=_metadata(headers), timeout=client_timeout, **kw
+            )
+            if self._verbose:
+                print(response)
+            return response
+        except grpc.RpcError as e:
+            raise_error_grpc(e)
+
+    @staticmethod
+    def _maybe_json(response, as_json):
+        if not as_json:
+            return response
+        from google.protobuf import json_format
+
+        return json_format.MessageToDict(response, preserving_proto_field_name=True)
+
+    # -- health --------------------------------------------------------------
+
+    async def is_server_live(self, headers=None, client_timeout=None):
+        r = await self._call("ServerLive", pb.ServerLiveRequest(), headers, client_timeout)
+        return r.live
+
+    async def is_server_ready(self, headers=None, client_timeout=None):
+        r = await self._call(
+            "ServerReady", pb.ServerReadyRequest(), headers, client_timeout
+        )
+        return r.ready
+
+    async def is_model_ready(
+        self, model_name, model_version="", headers=None, client_timeout=None
+    ):
+        r = await self._call(
+            "ModelReady",
+            pb.ModelReadyRequest(name=model_name, version=model_version),
+            headers,
+            client_timeout,
+        )
+        return r.ready
+
+    # -- metadata / config / repository --------------------------------------
+
+    async def get_server_metadata(self, headers=None, as_json=False, client_timeout=None):
+        r = await self._call(
+            "ServerMetadata", pb.ServerMetadataRequest(), headers, client_timeout
+        )
+        return self._maybe_json(r, as_json)
+
+    async def get_model_metadata(
+        self, model_name, model_version="", headers=None, as_json=False,
+        client_timeout=None,
+    ):
+        r = await self._call(
+            "ModelMetadata",
+            pb.ModelMetadataRequest(name=model_name, version=model_version),
+            headers,
+            client_timeout,
+        )
+        return self._maybe_json(r, as_json)
+
+    async def get_model_config(
+        self, model_name, model_version="", headers=None, as_json=False,
+        client_timeout=None,
+    ):
+        r = await self._call(
+            "ModelConfig",
+            pb.ModelConfigRequest(name=model_name, version=model_version),
+            headers,
+            client_timeout,
+        )
+        return self._maybe_json(r, as_json)
+
+    async def get_model_repository_index(
+        self, headers=None, as_json=False, client_timeout=None
+    ):
+        r = await self._call(
+            "RepositoryIndex", pb.RepositoryIndexRequest(), headers, client_timeout
+        )
+        return self._maybe_json(r, as_json)
+
+    async def load_model(
+        self, model_name, headers=None, config=None, files=None, client_timeout=None
+    ):
+        import json as _json
+
+        request = pb.RepositoryModelLoadRequest(model_name=model_name)
+        if config is not None:
+            request.parameters["config"].string_param = (
+                config if isinstance(config, str) else _json.dumps(config)
+            )
+        for path, content in (files or {}).items():
+            request.parameters[path].bytes_param = content
+        await self._call("RepositoryModelLoad", request, headers, client_timeout)
+
+    async def unload_model(
+        self, model_name, headers=None, unload_dependents=False, client_timeout=None
+    ):
+        request = pb.RepositoryModelUnloadRequest(model_name=model_name)
+        request.parameters["unload_dependents"].bool_param = unload_dependents
+        await self._call("RepositoryModelUnload", request, headers, client_timeout)
+
+    # -- statistics ----------------------------------------------------------
+
+    async def get_inference_statistics(
+        self, model_name="", model_version="", headers=None, as_json=False,
+        client_timeout=None,
+    ):
+        r = await self._call(
+            "ModelStatistics",
+            pb.ModelStatisticsRequest(name=model_name, version=model_version),
+            headers,
+            client_timeout,
+        )
+        return self._maybe_json(r, as_json)
+
+    # -- shared memory -------------------------------------------------------
+
+    async def get_system_shared_memory_status(
+        self, region_name="", headers=None, as_json=False, client_timeout=None
+    ):
+        r = await self._call(
+            "SystemSharedMemoryStatus",
+            pb.SystemSharedMemoryStatusRequest(name=region_name),
+            headers,
+            client_timeout,
+        )
+        return self._maybe_json(r, as_json)
+
+    async def register_system_shared_memory(
+        self, name, key, byte_size, offset=0, headers=None, client_timeout=None
+    ):
+        await self._call(
+            "SystemSharedMemoryRegister",
+            pb.SystemSharedMemoryRegisterRequest(
+                name=name, key=key, offset=offset, byte_size=byte_size
+            ),
+            headers,
+            client_timeout,
+        )
+
+    async def unregister_system_shared_memory(
+        self, name="", headers=None, client_timeout=None
+    ):
+        await self._call(
+            "SystemSharedMemoryUnregister",
+            pb.SystemSharedMemoryUnregisterRequest(name=name),
+            headers,
+            client_timeout,
+        )
+
+    async def get_tpu_shared_memory_status(
+        self, region_name="", headers=None, as_json=False, client_timeout=None
+    ):
+        r = await self._call(
+            "TpuSharedMemoryStatus",
+            pb.TpuSharedMemoryStatusRequest(name=region_name),
+            headers,
+            client_timeout,
+        )
+        return self._maybe_json(r, as_json)
+
+    async def register_tpu_shared_memory(
+        self, name, raw_handle, device_id, byte_size, headers=None, client_timeout=None
+    ):
+        await self._call(
+            "TpuSharedMemoryRegister",
+            pb.TpuSharedMemoryRegisterRequest(
+                name=name,
+                raw_handle=raw_handle,
+                device_id=device_id,
+                byte_size=byte_size,
+            ),
+            headers,
+            client_timeout,
+        )
+
+    async def unregister_tpu_shared_memory(
+        self, name="", headers=None, client_timeout=None
+    ):
+        await self._call(
+            "TpuSharedMemoryUnregister",
+            pb.TpuSharedMemoryUnregisterRequest(name=name),
+            headers,
+            client_timeout,
+        )
+
+    # -- inference -----------------------------------------------------------
+
+    async def infer(
+        self,
+        model_name,
+        inputs,
+        model_version="",
+        outputs=None,
+        request_id="",
+        sequence_id=0,
+        sequence_start=False,
+        sequence_end=False,
+        priority=0,
+        timeout=None,
+        client_timeout=None,
+        headers=None,
+        compression_algorithm=None,
+        parameters=None,
+    ):
+        request = build_infer_request(
+            model_name,
+            inputs,
+            model_version,
+            outputs,
+            request_id,
+            sequence_id,
+            sequence_start,
+            sequence_end,
+            priority,
+            timeout,
+            parameters,
+        )
+        response = await self._call(
+            "ModelInfer",
+            request,
+            headers,
+            client_timeout,
+            compression=_grpc_compression(compression_algorithm),
+        )
+        return InferResult(response)
+
+    def stream_infer(
+        self,
+        inputs_iterator,
+        stream_timeout=None,
+        headers=None,
+        compression_algorithm=None,
+    ):
+        """Map an async iterator of request kwargs dicts onto the bidi stream.
+
+        Yields (InferResult, error) tuples (parity: reference aio
+        stream_infer).  Each item from *inputs_iterator* is a dict of
+        ``infer``-style kwargs.
+        """
+
+        async def _requests():
+            async for kwargs in inputs_iterator:
+                yield build_infer_request(
+                    kwargs["model_name"],
+                    kwargs["inputs"],
+                    kwargs.get("model_version", ""),
+                    kwargs.get("outputs"),
+                    kwargs.get("request_id", ""),
+                    kwargs.get("sequence_id", 0),
+                    kwargs.get("sequence_start", False),
+                    kwargs.get("sequence_end", False),
+                    kwargs.get("priority", 0),
+                    kwargs.get("timeout"),
+                    kwargs.get("parameters"),
+                )
+
+        async def _responses():
+            try:
+                stream = self._stubs["ModelStreamInfer"](
+                    _requests(),
+                    metadata=_metadata(headers),
+                    timeout=stream_timeout,
+                    compression=_grpc_compression(compression_algorithm),
+                )
+                async for response in stream:
+                    error = (
+                        InferenceServerException(response.error_message)
+                        if response.error_message
+                        else None
+                    )
+                    yield InferResult(response.infer_response), error
+            except grpc.RpcError as e:
+                raise_error_grpc(e)
+
+        return _responses()

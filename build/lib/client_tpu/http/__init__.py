@@ -1,0 +1,559 @@
+"""Synchronous HTTP/REST client for KServe-v2 servers (Triton-compatible).
+
+Capability parity with ``tritonclient.http`` (reference
+src/python/library/tritonclient/http/__init__.py): full management surface,
+binary tensor-data extension, request/response compression, shared-memory verbs
+(system + cuda passthrough + the client_tpu ``tpu`` flavor), ``async_infer``,
+and the static ``generate_request_body``/``parse_response_body`` pair for
+request pipelining. Transport is a urllib3 connection pool (the image has no
+geventhttpclient); ``async_infer`` multiplexes over a thread pool sized by the
+``concurrency`` constructor argument.
+"""
+
+import base64
+import json
+from concurrent.futures import ThreadPoolExecutor
+from urllib.parse import quote, urlencode
+
+import urllib3
+
+from client_tpu import _codec
+from client_tpu._infer_types import (  # noqa: F401  (re-exported API surface)
+    InferInput,
+    InferRequestedOutput,
+    _np_from_json_data,
+)
+from client_tpu.utils import (
+    InferenceServerException,
+    from_wire_bytes,
+    raise_error,
+)
+
+__all__ = [
+    "InferenceServerClient",
+    "InferInput",
+    "InferRequestedOutput",
+    "InferResult",
+    "InferAsyncRequest",
+]
+
+
+def _get_error_from_response(response_body, status):
+    try:
+        msg = json.loads(response_body.decode("utf-8", errors="replace")).get(
+            "error", response_body.decode("utf-8", errors="replace")
+        )
+    except Exception:
+        msg = response_body.decode("utf-8", errors="replace")
+    return InferenceServerException(msg=msg, status=str(status))
+
+
+class InferAsyncRequest:
+    """Handle returned by ``async_infer``; ``get_result()`` blocks for the result.
+
+    Parity: tritonclient.http InferAsyncRequest (reference http/__init__.py:1683).
+    """
+
+    def __init__(self, future, verbose=False):
+        self._future = future
+        self._verbose = verbose
+
+    def get_result(self, block=True, timeout=None):
+        if not block and not self._future.done():
+            raise_error("inference is not yet completed")
+        try:
+            return self._future.result(timeout=timeout)
+        except InferenceServerException:
+            raise
+        except Exception as e:  # transport-level failure
+            raise InferenceServerException(msg=str(e), debug_details=e) from e
+
+    def cancel(self):
+        return self._future.cancel()
+
+
+class InferResult:
+    """Parsed inference response: JSON header + sliced binary output section.
+
+    Parity: reference http/__init__.py:2045-2168.
+    """
+
+    def __init__(self, response_header, binary_section, verbose=False):
+        self._result = response_header
+        self._verbose = verbose
+        self._output_name_to_buffer = {}
+        offset = 0
+        for output in self._result.get("outputs", []):
+            params = output.get("parameters", {})
+            bin_size = params.get("binary_data_size")
+            if bin_size is not None:
+                self._output_name_to_buffer[output["name"]] = binary_section[
+                    offset : offset + bin_size
+                ]
+                offset += bin_size
+
+    @classmethod
+    def from_response_body(
+        cls, response_body, verbose=False, header_length=None, content_encoding=None
+    ):
+        body = _codec.decompress(bytes(response_body), content_encoding)
+        header, binary = _codec.parse_infer_response_body(body, header_length)
+        return cls(header, binary, verbose)
+
+    def get_response(self):
+        """The response header as a dict (JSON form of ModelInferResponse)."""
+        return self._result
+
+    def get_output(self, name):
+        """The output's JSON metadata dict, or None if absent."""
+        for output in self._result.get("outputs", []):
+            if output["name"] == name:
+                return output
+        return None
+
+    def as_numpy(self, name):
+        """Output tensor as a numpy array (None if not present or in shm)."""
+        output = self.get_output(name)
+        if output is None:
+            return None
+        shape = output["shape"]
+        datatype = output["datatype"]
+        if name in self._output_name_to_buffer:
+            return from_wire_bytes(
+                self._output_name_to_buffer[name], datatype, shape
+            )
+        if "data" in output:
+            return _np_from_json_data(output["data"], datatype, shape)
+        return None
+
+
+class InferenceServerClient:
+    """Blocking HTTP client for every KServe-v2 endpoint.
+
+    Parity: reference http/__init__.py:142-1510 (constructor args adapted:
+    urllib3 pool instead of gevent; ``concurrency`` sizes both the connection
+    pool and the async_infer worker pool).
+    """
+
+    def __init__(
+        self,
+        url,
+        verbose=False,
+        concurrency=1,
+        connection_timeout=60.0,
+        network_timeout=60.0,
+        ssl=False,
+        ssl_context=None,
+        insecure=False,
+    ):
+        if "://" in url:
+            scheme, _, rest = url.partition("://")
+            if scheme not in ("http", "https"):
+                raise_error(f"unsupported scheme '{scheme}' in url")
+            url = rest
+            ssl = ssl or scheme == "https"
+        scheme = "https" if ssl else "http"
+        self._base_url = f"{scheme}://{url}"
+        self._verbose = verbose
+        self._concurrency = concurrency
+        pool_kwargs = {}
+        if ssl:
+            pool_kwargs["ssl_context"] = ssl_context
+            if insecure:
+                pool_kwargs["cert_reqs"] = "CERT_NONE"
+                urllib3.disable_warnings()
+        self._pool = urllib3.PoolManager(
+            maxsize=max(1, concurrency),
+            timeout=urllib3.Timeout(connect=connection_timeout, read=network_timeout),
+            retries=False,
+            **pool_kwargs,
+        )
+        self._executor = None  # lazily created for async_infer
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def close(self):
+        if self._executor is not None:
+            self._executor.shutdown(wait=True)
+            self._executor = None
+        self._pool.clear()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    # -- low-level request helpers -----------------------------------------
+
+    def _request(self, method, uri, headers=None, query_params=None, body=None):
+        url = f"{self._base_url}/{uri}"
+        if query_params:
+            url += "?" + urlencode(query_params, doseq=True)
+        if self._verbose:
+            print(f"{method} {url}, headers {headers}")
+        try:
+            response = self._pool.request(
+                method,
+                url,
+                body=body,
+                headers=headers,
+                preload_content=True,
+                decode_content=False,
+            )
+        except InferenceServerException:
+            raise
+        except Exception as e:
+            raise InferenceServerException(msg=str(e), debug_details=e) from e
+        if self._verbose:
+            print(response.status)
+        return response
+
+    def _get(self, uri, headers=None, query_params=None):
+        return self._request("GET", uri, headers, query_params)
+
+    def _post(self, uri, body=b"", headers=None, query_params=None):
+        return self._request("POST", uri, headers, query_params, body=body)
+
+    @staticmethod
+    def _raise_if_error(response):
+        if response.status != 200:
+            raise _get_error_from_response(response.data, response.status)
+
+    @staticmethod
+    def _json_or_raise(response):
+        InferenceServerClient._raise_if_error(response)
+        content = _codec.decompress(
+            response.data, response.headers.get("Content-Encoding")
+        )
+        return json.loads(content.decode("utf-8")) if content else {}
+
+    # -- health -------------------------------------------------------------
+
+    def is_server_live(self, headers=None, query_params=None):
+        r = self._get("v2/health/live", headers, query_params)
+        return r.status == 200
+
+    def is_server_ready(self, headers=None, query_params=None):
+        r = self._get("v2/health/ready", headers, query_params)
+        return r.status == 200
+
+    def is_model_ready(self, model_name, model_version="", headers=None, query_params=None):
+        uri = f"v2/models/{quote(model_name, safe='')}"
+        if model_version:
+            uri += f"/versions/{model_version}"
+        r = self._get(uri + "/ready", headers, query_params)
+        return r.status == 200
+
+    # -- metadata / config ---------------------------------------------------
+
+    def get_server_metadata(self, headers=None, query_params=None):
+        return self._json_or_raise(self._get("v2", headers, query_params))
+
+    def get_model_metadata(
+        self, model_name, model_version="", headers=None, query_params=None
+    ):
+        uri = f"v2/models/{quote(model_name, safe='')}"
+        if model_version:
+            uri += f"/versions/{model_version}"
+        return self._json_or_raise(self._get(uri, headers, query_params))
+
+    def get_model_config(
+        self, model_name, model_version="", headers=None, query_params=None
+    ):
+        uri = f"v2/models/{quote(model_name, safe='')}"
+        if model_version:
+            uri += f"/versions/{model_version}"
+        return self._json_or_raise(self._get(uri + "/config", headers, query_params))
+
+    # -- repository ----------------------------------------------------------
+
+    def get_model_repository_index(self, headers=None, query_params=None):
+        return self._json_or_raise(
+            self._post("v2/repository/index", b"", headers, query_params)
+        )
+
+    def load_model(
+        self, model_name, headers=None, query_params=None, config=None, files=None
+    ):
+        body = {}
+        if config is not None:
+            body.setdefault("parameters", {})["config"] = (
+                config if isinstance(config, str) else json.dumps(config)
+            )
+        if files:
+            for path, content in files.items():
+                body.setdefault("parameters", {})[path] = base64.b64encode(
+                    content
+                ).decode("utf-8")
+        r = self._post(
+            f"v2/repository/models/{quote(model_name, safe='')}/load",
+            json.dumps(body).encode("utf-8") if body else b"",
+            headers,
+            query_params,
+        )
+        self._raise_if_error(r)
+
+    def unload_model(
+        self, model_name, headers=None, query_params=None, unload_dependents=False
+    ):
+        body = {"parameters": {"unload_dependents": unload_dependents}}
+        r = self._post(
+            f"v2/repository/models/{quote(model_name, safe='')}/unload",
+            json.dumps(body).encode("utf-8"),
+            headers,
+            query_params,
+        )
+        self._raise_if_error(r)
+
+    # -- statistics / trace / log -------------------------------------------
+
+    def get_inference_statistics(
+        self, model_name="", model_version="", headers=None, query_params=None
+    ):
+        if model_name:
+            uri = f"v2/models/{quote(model_name, safe='')}"
+            if model_version:
+                uri += f"/versions/{model_version}"
+            uri += "/stats"
+        else:
+            uri = "v2/models/stats"
+        return self._json_or_raise(self._get(uri, headers, query_params))
+
+    def update_trace_settings(
+        self, model_name="", settings=None, headers=None, query_params=None
+    ):
+        uri = (
+            f"v2/models/{quote(model_name, safe='')}/trace/setting"
+            if model_name
+            else "v2/trace/setting"
+        )
+        r = self._post(
+            uri, json.dumps(settings or {}).encode("utf-8"), headers, query_params
+        )
+        return self._json_or_raise(r)
+
+    def get_trace_settings(self, model_name="", headers=None, query_params=None):
+        uri = (
+            f"v2/models/{quote(model_name, safe='')}/trace/setting"
+            if model_name
+            else "v2/trace/setting"
+        )
+        return self._json_or_raise(self._get(uri, headers, query_params))
+
+    def update_log_settings(self, settings, headers=None, query_params=None):
+        r = self._post(
+            "v2/logging", json.dumps(settings).encode("utf-8"), headers, query_params
+        )
+        return self._json_or_raise(r)
+
+    def get_log_settings(self, headers=None, query_params=None):
+        return self._json_or_raise(self._get("v2/logging", headers, query_params))
+
+    # -- shared memory -------------------------------------------------------
+
+    def _shm_status(self, kind, region_name, headers, query_params):
+        uri = f"v2/{kind}"
+        if region_name:
+            uri += f"/region/{quote(region_name, safe='')}"
+        uri += "/status"
+        return self._json_or_raise(self._get(uri, headers, query_params))
+
+    def get_system_shared_memory_status(
+        self, region_name="", headers=None, query_params=None
+    ):
+        return self._shm_status("systemsharedmemory", region_name, headers, query_params)
+
+    def register_system_shared_memory(
+        self, name, key, byte_size, offset=0, headers=None, query_params=None
+    ):
+        body = json.dumps(
+            {"key": key, "offset": offset, "byte_size": byte_size}
+        ).encode("utf-8")
+        r = self._post(
+            f"v2/systemsharedmemory/region/{quote(name, safe='')}/register",
+            body,
+            headers,
+            query_params,
+        )
+        self._raise_if_error(r)
+
+    def unregister_system_shared_memory(
+        self, name="", headers=None, query_params=None
+    ):
+        uri = "v2/systemsharedmemory"
+        if name:
+            uri += f"/region/{quote(name, safe='')}"
+        uri += "/unregister"
+        self._raise_if_error(self._post(uri, b"", headers, query_params))
+
+    def get_cuda_shared_memory_status(
+        self, region_name="", headers=None, query_params=None
+    ):
+        return self._shm_status("cudasharedmemory", region_name, headers, query_params)
+
+    def register_cuda_shared_memory(
+        self, name, raw_handle, device_id, byte_size, headers=None, query_params=None
+    ):
+        body = json.dumps(
+            {
+                "raw_handle": {"b64": base64.b64encode(raw_handle).decode("utf-8")},
+                "device_id": device_id,
+                "byte_size": byte_size,
+            }
+        ).encode("utf-8")
+        r = self._post(
+            f"v2/cudasharedmemory/region/{quote(name, safe='')}/register",
+            body,
+            headers,
+            query_params,
+        )
+        self._raise_if_error(r)
+
+    def unregister_cuda_shared_memory(self, name="", headers=None, query_params=None):
+        uri = "v2/cudasharedmemory"
+        if name:
+            uri += f"/region/{quote(name, safe='')}"
+        uri += "/unregister"
+        self._raise_if_error(self._post(uri, b"", headers, query_params))
+
+    def get_tpu_shared_memory_status(
+        self, region_name="", headers=None, query_params=None
+    ):
+        return self._shm_status("tpusharedmemory", region_name, headers, query_params)
+
+    def register_tpu_shared_memory(
+        self, name, raw_handle, device_id, byte_size, headers=None, query_params=None
+    ):
+        """Register a TPU device-buffer region (client_tpu extension endpoint)."""
+        body = json.dumps(
+            {
+                "raw_handle": {"b64": base64.b64encode(raw_handle).decode("utf-8")},
+                "device_id": device_id,
+                "byte_size": byte_size,
+            }
+        ).encode("utf-8")
+        r = self._post(
+            f"v2/tpusharedmemory/region/{quote(name, safe='')}/register",
+            body,
+            headers,
+            query_params,
+        )
+        self._raise_if_error(r)
+
+    def unregister_tpu_shared_memory(self, name="", headers=None, query_params=None):
+        uri = "v2/tpusharedmemory"
+        if name:
+            uri += f"/region/{quote(name, safe='')}"
+        uri += "/unregister"
+        self._raise_if_error(self._post(uri, b"", headers, query_params))
+
+    # -- inference -----------------------------------------------------------
+
+    @staticmethod
+    def generate_request_body(
+        inputs,
+        outputs=None,
+        request_id="",
+        sequence_id=0,
+        sequence_start=False,
+        sequence_end=False,
+        priority=0,
+        timeout=None,
+        parameters=None,
+    ):
+        """Build (body, json_size) without sending — the pipelining entry point
+        (parity: reference http/__init__.py:1255)."""
+        return _codec.build_infer_request_body(
+            inputs,
+            outputs,
+            request_id,
+            sequence_id,
+            sequence_start,
+            sequence_end,
+            priority,
+            timeout,
+            parameters,
+        )
+
+    @staticmethod
+    def parse_response_body(
+        response_body, verbose=False, header_length=None, content_encoding=None
+    ):
+        """Parse a raw response body into InferResult (parity: reference
+        http/__init__.py:1336)."""
+        return InferResult.from_response_body(
+            response_body, verbose, header_length, content_encoding
+        )
+
+    def infer(
+        self,
+        model_name,
+        inputs,
+        model_version="",
+        outputs=None,
+        request_id="",
+        sequence_id=0,
+        sequence_start=False,
+        sequence_end=False,
+        priority=0,
+        timeout=None,
+        headers=None,
+        query_params=None,
+        request_compression_algorithm=None,
+        response_compression_algorithm=None,
+        parameters=None,
+    ):
+        """Run one synchronous inference; returns InferResult."""
+        body, json_size = _codec.build_infer_request_body(
+            inputs,
+            outputs,
+            request_id,
+            sequence_id,
+            sequence_start,
+            sequence_end,
+            priority,
+            timeout,
+            parameters,
+        )
+        request_headers = dict(headers) if headers else {}
+        if json_size is not None:
+            request_headers["Inference-Header-Content-Length"] = str(json_size)
+        body = _codec.compress(body, request_compression_algorithm)
+        if request_compression_algorithm:
+            request_headers["Content-Encoding"] = request_compression_algorithm
+        if response_compression_algorithm:
+            request_headers["Accept-Encoding"] = response_compression_algorithm
+
+        uri = f"v2/models/{quote(model_name, safe='')}"
+        if model_version:
+            uri += f"/versions/{model_version}"
+        uri += "/infer"
+        response = self._post(uri, body, request_headers, query_params)
+        self._raise_if_error(response)
+        header_length = response.headers.get("Inference-Header-Content-Length")
+        return InferResult.from_response_body(
+            response.data,
+            self._verbose,
+            int(header_length) if header_length is not None else None,
+            response.headers.get("Content-Encoding"),
+        )
+
+    def async_infer(self, model_name, inputs, **kwargs):
+        """Submit inference on the worker pool; returns InferAsyncRequest.
+
+        Parity: reference http/__init__.py:1512 (gevent greenlet -> thread pool).
+        """
+        if self._executor is None:
+            self._executor = ThreadPoolExecutor(
+                max_workers=max(1, self._concurrency),
+                thread_name_prefix="client_tpu-http",
+            )
+        future = self._executor.submit(self.infer, model_name, inputs, **kwargs)
+        return InferAsyncRequest(future, self._verbose)

@@ -1,0 +1,306 @@
+"""asyncio HTTP client — mirror of client_tpu.http over aiohttp.
+
+Capability parity with ``tritonclient.http.aio`` (reference
+src/python/library/tritonclient/http/aio/__init__.py:64-786).
+"""
+
+import base64
+import json
+from urllib.parse import quote
+
+import aiohttp
+
+from client_tpu import _codec
+from client_tpu._infer_types import InferInput, InferRequestedOutput  # noqa: F401
+from client_tpu.http import InferResult  # same response parsing as sync
+from client_tpu.utils import InferenceServerException, raise_error
+
+__all__ = [
+    "InferenceServerClient",
+    "InferInput",
+    "InferRequestedOutput",
+    "InferResult",
+]
+
+
+class InferenceServerClient:
+    """asyncio client for every KServe-v2 HTTP endpoint."""
+
+    def __init__(
+        self,
+        url,
+        verbose=False,
+        conn_limit=100,
+        conn_timeout=60.0,
+        ssl=False,
+        ssl_context=None,
+    ):
+        if "://" in url:
+            scheme, _, rest = url.partition("://")
+            if scheme not in ("http", "https"):
+                raise_error(f"unsupported scheme '{scheme}' in url")
+            url = rest
+            ssl = ssl or scheme == "https"
+        self._base_url = f"{'https' if ssl else 'http'}://{url}"
+        self._verbose = verbose
+        connector = aiohttp.TCPConnector(limit=conn_limit, ssl=ssl_context if ssl else False)
+        self._session = aiohttp.ClientSession(
+            connector=connector,
+            timeout=aiohttp.ClientTimeout(total=conn_timeout),
+            auto_decompress=False,
+        )
+
+    async def close(self):
+        await self._session.close()
+
+    async def __aenter__(self):
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.close()
+
+    async def _get(self, uri, headers=None, query_params=None):
+        if self._verbose:
+            print(f"GET {self._base_url}/{uri}")
+        return await self._session.get(
+            f"{self._base_url}/{uri}", headers=headers, params=query_params
+        )
+
+    async def _post(self, uri, body=b"", headers=None, query_params=None):
+        if self._verbose:
+            print(f"POST {self._base_url}/{uri}")
+        return await self._session.post(
+            f"{self._base_url}/{uri}", data=body, headers=headers, params=query_params
+        )
+
+    @staticmethod
+    async def _raise_if_error(response):
+        if response.status != 200:
+            body = await response.read()
+            try:
+                msg = json.loads(body.decode("utf-8", errors="replace")).get(
+                    "error", body.decode("utf-8", errors="replace")
+                )
+            except Exception:
+                msg = body.decode("utf-8", errors="replace")
+            raise InferenceServerException(msg=msg, status=str(response.status))
+
+    @staticmethod
+    async def _json_or_raise(response):
+        await InferenceServerClient._raise_if_error(response)
+        body = _codec.decompress(
+            await response.read(), response.headers.get("Content-Encoding")
+        )
+        return json.loads(body.decode("utf-8")) if body else {}
+
+    # -- health --------------------------------------------------------------
+
+    async def is_server_live(self, headers=None, query_params=None):
+        r = await self._get("v2/health/live", headers, query_params)
+        return r.status == 200
+
+    async def is_server_ready(self, headers=None, query_params=None):
+        r = await self._get("v2/health/ready", headers, query_params)
+        return r.status == 200
+
+    async def is_model_ready(
+        self, model_name, model_version="", headers=None, query_params=None
+    ):
+        uri = f"v2/models/{quote(model_name, safe='')}"
+        if model_version:
+            uri += f"/versions/{model_version}"
+        r = await self._get(uri + "/ready", headers, query_params)
+        return r.status == 200
+
+    # -- metadata / config / repository --------------------------------------
+
+    async def get_server_metadata(self, headers=None, query_params=None):
+        return await self._json_or_raise(await self._get("v2", headers, query_params))
+
+    async def get_model_metadata(
+        self, model_name, model_version="", headers=None, query_params=None
+    ):
+        uri = f"v2/models/{quote(model_name, safe='')}"
+        if model_version:
+            uri += f"/versions/{model_version}"
+        return await self._json_or_raise(await self._get(uri, headers, query_params))
+
+    async def get_model_config(
+        self, model_name, model_version="", headers=None, query_params=None
+    ):
+        uri = f"v2/models/{quote(model_name, safe='')}"
+        if model_version:
+            uri += f"/versions/{model_version}"
+        return await self._json_or_raise(
+            await self._get(uri + "/config", headers, query_params)
+        )
+
+    async def get_model_repository_index(self, headers=None, query_params=None):
+        return await self._json_or_raise(
+            await self._post("v2/repository/index", b"", headers, query_params)
+        )
+
+    async def load_model(
+        self, model_name, headers=None, query_params=None, config=None, files=None
+    ):
+        body = {}
+        if config is not None:
+            body.setdefault("parameters", {})["config"] = (
+                config if isinstance(config, str) else json.dumps(config)
+            )
+        for path, content in (files or {}).items():
+            body.setdefault("parameters", {})[path] = base64.b64encode(content).decode()
+        r = await self._post(
+            f"v2/repository/models/{quote(model_name, safe='')}/load",
+            json.dumps(body).encode() if body else b"",
+            headers,
+            query_params,
+        )
+        await self._raise_if_error(r)
+
+    async def unload_model(
+        self, model_name, headers=None, query_params=None, unload_dependents=False
+    ):
+        r = await self._post(
+            f"v2/repository/models/{quote(model_name, safe='')}/unload",
+            json.dumps({"parameters": {"unload_dependents": unload_dependents}}).encode(),
+            headers,
+            query_params,
+        )
+        await self._raise_if_error(r)
+
+    # -- statistics ----------------------------------------------------------
+
+    async def get_inference_statistics(
+        self, model_name="", model_version="", headers=None, query_params=None
+    ):
+        if model_name:
+            uri = f"v2/models/{quote(model_name, safe='')}"
+            if model_version:
+                uri += f"/versions/{model_version}"
+            uri += "/stats"
+        else:
+            uri = "v2/models/stats"
+        return await self._json_or_raise(await self._get(uri, headers, query_params))
+
+    # -- shared memory -------------------------------------------------------
+
+    async def get_system_shared_memory_status(
+        self, region_name="", headers=None, query_params=None
+    ):
+        uri = "v2/systemsharedmemory"
+        if region_name:
+            uri += f"/region/{quote(region_name, safe='')}"
+        return await self._json_or_raise(
+            await self._get(uri + "/status", headers, query_params)
+        )
+
+    async def register_system_shared_memory(
+        self, name, key, byte_size, offset=0, headers=None, query_params=None
+    ):
+        r = await self._post(
+            f"v2/systemsharedmemory/region/{quote(name, safe='')}/register",
+            json.dumps({"key": key, "offset": offset, "byte_size": byte_size}).encode(),
+            headers,
+            query_params,
+        )
+        await self._raise_if_error(r)
+
+    async def unregister_system_shared_memory(
+        self, name="", headers=None, query_params=None
+    ):
+        uri = "v2/systemsharedmemory"
+        if name:
+            uri += f"/region/{quote(name, safe='')}"
+        r = await self._post(uri + "/unregister", b"", headers, query_params)
+        await self._raise_if_error(r)
+
+    async def get_tpu_shared_memory_status(
+        self, region_name="", headers=None, query_params=None
+    ):
+        uri = "v2/tpusharedmemory"
+        if region_name:
+            uri += f"/region/{quote(region_name, safe='')}"
+        return await self._json_or_raise(
+            await self._get(uri + "/status", headers, query_params)
+        )
+
+    async def register_tpu_shared_memory(
+        self, name, raw_handle, device_id, byte_size, headers=None, query_params=None
+    ):
+        r = await self._post(
+            f"v2/tpusharedmemory/region/{quote(name, safe='')}/register",
+            json.dumps(
+                {
+                    "raw_handle": {"b64": base64.b64encode(raw_handle).decode()},
+                    "device_id": device_id,
+                    "byte_size": byte_size,
+                }
+            ).encode(),
+            headers,
+            query_params,
+        )
+        await self._raise_if_error(r)
+
+    async def unregister_tpu_shared_memory(
+        self, name="", headers=None, query_params=None
+    ):
+        uri = "v2/tpusharedmemory"
+        if name:
+            uri += f"/region/{quote(name, safe='')}"
+        r = await self._post(uri + "/unregister", b"", headers, query_params)
+        await self._raise_if_error(r)
+
+    # -- inference -----------------------------------------------------------
+
+    async def infer(
+        self,
+        model_name,
+        inputs,
+        model_version="",
+        outputs=None,
+        request_id="",
+        sequence_id=0,
+        sequence_start=False,
+        sequence_end=False,
+        priority=0,
+        timeout=None,
+        headers=None,
+        query_params=None,
+        request_compression_algorithm=None,
+        response_compression_algorithm=None,
+        parameters=None,
+    ):
+        body, json_size = _codec.build_infer_request_body(
+            inputs,
+            outputs,
+            request_id,
+            sequence_id,
+            sequence_start,
+            sequence_end,
+            priority,
+            timeout,
+            parameters,
+        )
+        request_headers = dict(headers) if headers else {}
+        if json_size is not None:
+            request_headers["Inference-Header-Content-Length"] = str(json_size)
+        body = _codec.compress(body, request_compression_algorithm)
+        if request_compression_algorithm:
+            request_headers["Content-Encoding"] = request_compression_algorithm
+        if response_compression_algorithm:
+            request_headers["Accept-Encoding"] = response_compression_algorithm
+        uri = f"v2/models/{quote(model_name, safe='')}"
+        if model_version:
+            uri += f"/versions/{model_version}"
+        uri += "/infer"
+        response = await self._post(uri, body, request_headers, query_params)
+        await self._raise_if_error(response)
+        data = await response.read()
+        header_length = response.headers.get("Inference-Header-Content-Length")
+        return InferResult.from_response_body(
+            data,
+            self._verbose,
+            int(header_length) if header_length is not None else None,
+            response.headers.get("Content-Encoding"),
+        )

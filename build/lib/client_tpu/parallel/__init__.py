@@ -1,0 +1,95 @@
+"""Multi-chip parallelism layer for the TPU-native framework.
+
+The reference client stack has no model parallelism (SURVEY.md §2.4 note) —
+sharding is a *server-side* concern there.  In this framework the server side
+is in-repo (client_tpu.serve), so the parallelism layer is first-class:
+
+- :func:`make_mesh` — build a ``jax.sharding.Mesh`` over ``dp``/``tp``/``sp``
+  axes (data / tensor / sequence-context parallel) from whatever devices exist.
+- :mod:`client_tpu.parallel.ring_attention` — causal ring attention over the
+  ``sp`` axis (blockwise flash accumulation + ``ppermute`` KV rotation) so
+  long sequences shard across chips with KV traffic riding ICI.
+- Param/activation PartitionSpec builders used by the transformer model family
+  (Megatron-style tensor parallel layout: attention sharded over heads, MLP
+  over the hidden dimension, embedding over vocab).
+
+Everything here is pure ``jax.sharding`` + collectives: XLA inserts the
+all-gathers/reduce-scatters; nothing is hand-scheduled.
+"""
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from client_tpu.parallel.ring_attention import ring_attention  # noqa: F401
+
+
+def make_mesh(devices=None, dp=None, tp=None, sp=None):
+    """Build a ("dp","tp","sp") Mesh over ``devices``.
+
+    Unspecified axis sizes are inferred: tp and sp default to 1, dp absorbs
+    the remaining devices.  The product must equal the device count.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    tp = 1 if tp is None else tp
+    sp = 1 if sp is None else sp
+    if dp is None:
+        if n % (tp * sp):
+            raise ValueError(f"{n} devices not divisible by tp*sp={tp * sp}")
+        dp = n // (tp * sp)
+    if dp * tp * sp != n:
+        raise ValueError(f"dp*tp*sp={dp * tp * sp} != {n} devices")
+    dev_array = np.asarray(devices).reshape(dp, tp, sp)
+    return Mesh(dev_array, ("dp", "tp", "sp"))
+
+
+def batch_spec():
+    """Activation spec: batch over dp, sequence over sp."""
+    return P("dp", "sp")
+
+
+def logit_spec():
+    return P("dp", "sp", "tp")
+
+
+def param_specs(cfg):
+    """PartitionSpecs for transformer params (see models/transformer.py).
+
+    Megatron layout: q/k/v projections column-parallel over heads (tp),
+    o projection row-parallel; MLP up/gate column-parallel over d_ff, down
+    row-parallel; embedding and LM head sharded over vocab.  Norm scales are
+    replicated.
+    """
+    layer = {
+        "attn": {
+            "wq": P(None, "tp"),
+            "wk": P(None, "tp"),
+            "wv": P(None, "tp"),
+            "wo": P("tp", None),
+        },
+        "mlp": {
+            "w_gate": P(None, "tp"),
+            "w_up": P(None, "tp"),
+            "w_down": P("tp", None),
+        },
+        "ln_attn": P(None),
+        "ln_mlp": P(None),
+    }
+    return {
+        "embed": P("tp", None),
+        "layers": [layer for _ in range(cfg.n_layers)],
+        "ln_f": P(None),
+        "lm_head": P(None, "tp"),
+    }
+
+
+def named_shardings(mesh, specs):
+    """Map a PartitionSpec pytree to NamedShardings on ``mesh``."""
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s),
+        specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )

@@ -1,0 +1,146 @@
+"""Causal ring attention over a sequence-parallel mesh axis.
+
+Long-context support: Q/K/V are sharded along the sequence dimension across
+the ``sp`` mesh axis.  Each device keeps its Q block resident and rotates the
+K/V blocks around the ring with ``lax.ppermute`` (ICI neighbor exchange),
+accumulating softmax results blockwise with the numerically-stable
+flash-attention recurrence (running max ``m``, running denominator ``l``,
+running weighted sum ``o``).  After ``sp`` steps every Q block has seen every
+KV block and no device ever materialized the full [T, T] score matrix or the
+full-length K/V.
+
+Causality is enforced per block-pair: a KV block strictly "in the future" of
+the Q block contributes nothing (fully masked); the diagonal block gets the
+usual triangular mask.  All accumulation is float32 regardless of input dtype.
+
+This is the framework's long-context primitive (the reference client has none
+— SURVEY.md §5.7); it is used by the transformer model family's
+sequence-parallel training/prefill path.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+_NEG = -1e30  # stand-in for -inf that keeps exp() NaN-free
+
+
+def _block_accumulate(o, m, l, q, kb, vb, q_pos, kv_pos, scale, causal):
+    """One flash-attention accumulation step against KV block (kb, vb).
+
+    Layouts: q [B,H,Tq,D]; kb/vb [B,H,Tk,D]; o [B,H,Tq,D] f32;
+    m/l [B,H,Tq,1] f32.  q_pos [Tq], kv_pos [Tk] are global token positions.
+    """
+    s = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, kb, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        mask = q_pos[:, None] >= kv_pos[None, :]
+        s = jnp.where(mask[None, None], s, _NEG)
+    blk_max = jnp.max(s, axis=-1, keepdims=True)
+    new_m = jnp.maximum(m, blk_max)
+    corr = jnp.exp(m - new_m)
+    p = jnp.exp(s - new_m)
+    new_l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+    pv = jnp.einsum(
+        "bhqk,bhkd->bhqd", p, vb.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    new_o = o * corr + pv
+    return new_o, new_m, new_l
+
+
+def ring_attention(q, k, v, axis_name="sp", causal=True, scale=None):
+    """Per-shard ring attention body; call inside ``jax.shard_map``.
+
+    Args:
+      q, k, v: [B, T_local, H, D] — the sequence dimension is the local shard
+        of a global sequence laid out contiguously across ``axis_name``.
+      axis_name: mesh axis carrying the sequence shards.
+      causal: apply the causal mask using *global* token positions.
+      scale: score scale; defaults to D**-0.5.
+
+    Returns [B, T_local, H, D] in q's dtype.
+    """
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    b, t_loc, h, d = q.shape
+    if scale is None:
+        scale = d ** -0.5
+
+    qh = q.transpose(0, 2, 1, 3)  # [B,H,T,D]
+    kb = k.transpose(0, 2, 1, 3)
+    vb = v.transpose(0, 2, 1, 3)
+
+    o = jnp.zeros(qh.shape, jnp.float32)
+    m = jnp.full((b, h, t_loc, 1), _NEG, jnp.float32)
+    l = jnp.zeros((b, h, t_loc, 1), jnp.float32)
+    # mark the constant-initialized accumulators as device-varying so both
+    # lax.cond branches below agree on varying-axis types under shard_map
+    varying = tuple(jax.typeof(q).vma) if hasattr(jax, "typeof") else ()
+    if varying:
+        o, m, l = (lax.pcast(x, varying, to="varying") for x in (o, m, l))
+    q_pos = idx * t_loc + jnp.arange(t_loc)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    for step in range(n):
+        kv_idx = (idx - step) % n
+        kv_pos = kv_idx * t_loc + jnp.arange(t_loc)
+        if causal:
+            # KV blocks strictly in this Q block's future contribute exactly
+            # nothing — skip their einsums (kv_idx is device-constant under
+            # SPMD, so each device runs only its selected branch)
+            o, m, l = lax.cond(
+                kv_idx > idx,
+                lambda o, m, l, *_: (o, m, l),
+                functools.partial(_block_accumulate, scale=scale, causal=True),
+                o, m, l, qh, kb, vb, q_pos, kv_pos,
+            )
+        else:
+            o, m, l = _block_accumulate(
+                o, m, l, qh, kb, vb, q_pos, kv_pos, scale, False
+            )
+        if step != n - 1:
+            kb = lax.ppermute(kb, axis_name, perm)
+            vb = lax.ppermute(vb, axis_name, perm)
+
+    out = (o / l).astype(q.dtype)
+    return out.transpose(0, 2, 1, 3)
+
+
+def plain_attention(q, k, v, causal=True, scale=None):
+    """Single-shard reference attention; same [B,T,H,D] interface."""
+    b, t, h, d = q.shape
+    if scale is None:
+        scale = d ** -0.5
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        kt = k.shape[1]
+        # offset so the last q row attends to the full kv length (decode case)
+        pos_q = jnp.arange(t) + (kt - t)
+        mask = pos_q[:, None] >= jnp.arange(kt)[None, :]
+        s = jnp.where(mask[None, None], s, _NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+    return out.astype(q.dtype)
+
+
+def ring_attention_sharded(q, k, v, mesh, causal=True, scale=None):
+    """shard_map wrapper: global [B,T,H,D] arrays, T sharded over ``sp``.
+
+    Batch rides ``dp``; heads ride ``tp``; D is replicated.  The body sees
+    local blocks and exchanges KV over the ring.
+    """
+    spec = P("dp", "sp", "tp", None)
+    fn = jax.shard_map(
+        lambda a, b_, c: ring_attention(a, b_, c, "sp", causal, scale),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)

@@ -1,0 +1,52 @@
+"""perf — the framework's perf_analyzer-class load & measurement harness.
+
+TPU-native rebuild of the reference perf_analyzer (reference
+src/c++/perf_analyzer/, SURVEY.md §2.3): pluggable client backends
+(gRPC/HTTP/in-process/mock), data loading (generated / directory / JSON),
+shared-memory input staging (system or TPU HBM), concurrency and
+request-rate load managers with Poisson/constant/custom schedules, stateful
+sequence workloads, a windowed stability-seeking profiler, and stdout/CSV
+reporting.  CLI: ``python -m client_tpu.perf``.
+"""
+
+from client_tpu.perf.client_backend import (
+    BackendKind,
+    ClientBackend,
+    ClientBackendFactory,
+    MockClientBackend,
+    MockStats,
+)
+from client_tpu.perf.data_loader import DataLoader
+from client_tpu.perf.infer_data import (
+    SharedMemoryType,
+    create_infer_data_manager,
+)
+from client_tpu.perf.load_manager import (
+    ConcurrencyManager,
+    CustomLoadManager,
+    LoadManager,
+    RequestRateManager,
+)
+from client_tpu.perf.profiler import InferenceProfiler, PerfStatus
+from client_tpu.perf.report import print_summary, write_csv
+from client_tpu.perf.sequence_manager import SequenceManager
+
+__all__ = [
+    "BackendKind",
+    "ClientBackend",
+    "ClientBackendFactory",
+    "ConcurrencyManager",
+    "CustomLoadManager",
+    "DataLoader",
+    "InferenceProfiler",
+    "LoadManager",
+    "MockClientBackend",
+    "MockStats",
+    "PerfStatus",
+    "RequestRateManager",
+    "SequenceManager",
+    "SharedMemoryType",
+    "create_infer_data_manager",
+    "print_summary",
+    "write_csv",
+]

@@ -1,0 +1,256 @@
+"""Materialize per-request InferInput/InferRequestedOutput objects.
+
+Parity with the reference's InferDataManager family (reference
+src/c++/perf_analyzer/iinfer_data_manager.h:39-60 and
+infer_data_manager{,_base,_shm,_factory}): a factory picks the plain variant
+(tensor bytes inline in each request) or a shared-memory variant that
+pre-stages input data in system or TPU regions and hands out
+region-referencing inputs.  The TPU variant is the HBM-resident path — data
+is device_put once at init and requests carry only region references.
+"""
+
+import numpy as np
+
+from client_tpu.utils import InferenceServerException, serialized_byte_size
+
+
+class SharedMemoryType:
+    NONE = "none"
+    SYSTEM = "system"
+    TPU = "tpu"
+
+
+class InferData:
+    """Prepared request objects for one (stream, step)."""
+
+    def __init__(self, inputs, outputs):
+        self.inputs = inputs
+        self.outputs = outputs
+
+
+def _nbytes(arr):
+    if arr.dtype == np.object_:
+        return serialized_byte_size(arr)
+    return arr.nbytes
+
+
+class InferDataManager:
+    """Plain variant: every request carries tensor bytes.
+
+    Request objects are built once per (stream, step) at init and reused for
+    every send (the reference prepares infer data per context and rotates;
+    per-request re-serialization would inflate measured client latency).
+    The cached objects are treated as immutable by the workers.
+    """
+
+    def __init__(self, backend, data_loader, inputs_metadata, outputs_metadata):
+        self._backend = backend
+        self._loader = data_loader
+        self._inputs_meta = inputs_metadata
+        self._outputs_meta = outputs_metadata
+        self._cache = {}
+
+    def init(self):
+        for s in range(self._loader.num_streams):
+            for t in range(self._loader.num_steps(s)):
+                self._cache[(s, t)] = self._build(s, t)
+
+    def _build(self, stream_id, step_id):
+        step = self._loader.get_input_data(stream_id, step_id)
+        InferInput = self._backend.infer_input_cls
+        Requested = self._backend.requested_output_cls
+        inputs = []
+        for meta in self._inputs_meta:
+            name = meta["name"]
+            td = step.get(name)
+            if td is None:
+                continue  # optional input absent from this step
+            inp = InferInput(name, list(td.array.shape), meta["datatype"])
+            inp.set_data_from_numpy(td.array)
+            inputs.append(inp)
+        outputs = [Requested(m["name"]) for m in self._outputs_meta]
+        return InferData(inputs, outputs)
+
+    def get_infer_data(self, stream_id, step_id):
+        return self._cache[(stream_id, step_id)]
+
+    def cleanup(self):
+        pass
+
+
+class _ShmInferDataManagerBase(InferDataManager):
+    """Pre-stages every (stream, step) tensor into regions at init; requests
+    reference regions by name+offset (infer_data_manager_shm.h analog)."""
+
+    region_prefix = "perf_shm"
+
+    def __init__(self, backend, data_loader, inputs_metadata, outputs_metadata,
+                 output_byte_size=0):
+        super().__init__(backend, data_loader, inputs_metadata, outputs_metadata)
+        self._regions = {}  # (stream, step, name) -> (region_name, nbytes)
+        self._out_regions = {}  # name -> (region_name, byte_size)
+        self._output_byte_size = output_byte_size
+
+    def _create_and_register(self, region_name, arrays, total):
+        raise NotImplementedError
+
+    def _create_output_region(self, region_name, byte_size):
+        raise NotImplementedError
+
+    def init(self):
+        for s, steps in enumerate(self._loader.streams):
+            for t, step in enumerate(steps):
+                for name, td in step.items():
+                    region_name = f"{self.region_prefix}_{s}_{t}_{name}"
+                    nbytes = _nbytes(td.array)
+                    self._create_and_register(region_name, [td.array], nbytes)
+                    self._regions[(s, t, name)] = (region_name, nbytes)
+        if self._output_byte_size:
+            for meta in self._outputs_meta:
+                region_name = f"{self.region_prefix}_out_{meta['name']}"
+                self._create_output_region(region_name, self._output_byte_size)
+                self._out_regions[meta["name"]] = (
+                    region_name, self._output_byte_size
+                )
+        for s in range(self._loader.num_streams):
+            for t in range(self._loader.num_steps(s)):
+                self._cache[(s, t)] = self._build(s, t)
+
+    def _build(self, stream_id, step_id):
+        step = self._loader.get_input_data(stream_id, step_id)
+        InferInput = self._backend.infer_input_cls
+        Requested = self._backend.requested_output_cls
+        inputs = []
+        for meta in self._inputs_meta:
+            name = meta["name"]
+            td = step.get(name)
+            if td is None:
+                continue
+            region_name, nbytes = self._regions[(stream_id, step_id, name)]
+            inp = InferInput(name, list(td.array.shape), meta["datatype"])
+            inp.set_shared_memory(region_name, nbytes)
+            inputs.append(inp)
+        outputs = []
+        for meta in self._outputs_meta:
+            out = Requested(meta["name"])
+            if meta["name"] in self._out_regions:
+                region_name, byte_size = self._out_regions[meta["name"]]
+                out.set_shared_memory(region_name, byte_size)
+            outputs.append(out)
+        return InferData(inputs, outputs)
+
+
+class SystemShmInferDataManager(_ShmInferDataManagerBase):
+    region_prefix = "perf_sysshm"
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._handles = []
+
+    def _create_and_register(self, region_name, arrays, total):
+        from client_tpu.utils import shared_memory as sysshm
+
+        key = "/" + region_name
+        h = sysshm.create_shared_memory_region(region_name, key, total)
+        sysshm.set_shared_memory_region(h, arrays)
+        self._backend.register_system_shared_memory(region_name, key, total)
+        self._handles.append(h)
+
+    def _create_output_region(self, region_name, byte_size):
+        from client_tpu.utils import shared_memory as sysshm
+
+        key = "/" + region_name
+        h = sysshm.create_shared_memory_region(region_name, key, byte_size)
+        self._backend.register_system_shared_memory(region_name, key, byte_size)
+        self._handles.append(h)
+
+    def cleanup(self):
+        from client_tpu.utils import shared_memory as sysshm
+
+        try:
+            self._backend.unregister_shared_memory()
+        except InferenceServerException:
+            pass
+        for h in self._handles:
+            try:
+                sysshm.destroy_shared_memory_region(h)
+            except InferenceServerException:
+                pass
+        self._handles = []
+
+
+class TpuShmInferDataManager(_ShmInferDataManagerBase):
+    """HBM-resident input staging over client_tpu.utils.tpu_shared_memory."""
+
+    region_prefix = "perf_tpushm"
+
+    def __init__(self, *args, device_id=0, staging=False, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._device_id = device_id
+        self._staging = staging
+        self._handles = []
+
+    def _make_region(self, region_name, byte_size):
+        from client_tpu.utils import tpu_shared_memory as tpushm
+
+        staging_key = ("/" + region_name) if self._staging else None
+        h = tpushm.create_shared_memory_region(
+            region_name, byte_size, self._device_id, staging_key=staging_key
+        )
+        self._handles.append(h)
+        return h
+
+    def _create_and_register(self, region_name, arrays, total):
+        from client_tpu.utils import tpu_shared_memory as tpushm
+
+        h = self._make_region(region_name, total)
+        tpushm.set_shared_memory_region(h, arrays)
+        self._backend.register_tpu_shared_memory(
+            region_name, tpushm.get_raw_handle(h), self._device_id, total
+        )
+
+    def _create_output_region(self, region_name, byte_size):
+        from client_tpu.utils import tpu_shared_memory as tpushm
+
+        h = self._make_region(region_name, byte_size)
+        self._backend.register_tpu_shared_memory(
+            region_name, tpushm.get_raw_handle(h), self._device_id, byte_size
+        )
+
+    def cleanup(self):
+        from client_tpu.utils import tpu_shared_memory as tpushm
+
+        try:
+            self._backend.unregister_shared_memory()
+        except InferenceServerException:
+            pass
+        for h in self._handles:
+            try:
+                tpushm.destroy_shared_memory_region(h)
+            except InferenceServerException:
+                pass
+        self._handles = []
+
+
+def create_infer_data_manager(backend, data_loader, inputs_meta, outputs_meta,
+                              shared_memory=SharedMemoryType.NONE,
+                              output_shm_byte_size=0, device_id=0,
+                              tpu_staging=False):
+    """Factory (infer_data_manager_factory.h analog).  ``tpu_staging``
+    maintains a host mirror so out-of-process servers can map the regions."""
+    if shared_memory == SharedMemoryType.NONE:
+        return InferDataManager(backend, data_loader, inputs_meta, outputs_meta)
+    if shared_memory == SharedMemoryType.SYSTEM:
+        return SystemShmInferDataManager(
+            backend, data_loader, inputs_meta, outputs_meta,
+            output_byte_size=output_shm_byte_size,
+        )
+    if shared_memory == SharedMemoryType.TPU:
+        return TpuShmInferDataManager(
+            backend, data_loader, inputs_meta, outputs_meta,
+            output_byte_size=output_shm_byte_size, device_id=device_id,
+            staging=tpu_staging,
+        )
+    raise InferenceServerException(
+        f"unknown shared memory type '{shared_memory}'"
+    )

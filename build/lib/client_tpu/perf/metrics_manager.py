@@ -1,0 +1,101 @@
+"""Server metrics scraping during measurement.
+
+Parity with the reference MetricsManager (reference
+src/c++/perf_analyzer/metrics_manager.h:44-91): a background thread polls
+the server's Prometheus ``/metrics`` on an interval and keeps per-window
+snapshots; the profiler merges them into each load level's summary.  The
+counters of interest are the TPU ones this framework's server exposes
+(``ctpu_tpu_memory_*``) plus the inference counters — the
+``nv_gpu_utilization`` analog set.
+"""
+
+import threading
+import urllib.request
+
+import numpy as np
+
+
+def parse_prometheus(text):
+    """Prometheus text format -> {metric_name: [(labels_str, value), ...]}."""
+    out = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            name_part, value_part = line.rsplit(" ", 1)
+            value = float(value_part)
+        except ValueError:
+            continue
+        if "{" in name_part:
+            name, labels = name_part.split("{", 1)
+            labels = "{" + labels
+        else:
+            name, labels = name_part, ""
+        out.setdefault(name, []).append((labels, value))
+    return out
+
+
+class MetricsManager:
+    def __init__(self, metrics_url, interval_s=1.0, timeout_s=5.0):
+        self.metrics_url = metrics_url
+        self.interval_s = interval_s
+        self.timeout_s = timeout_s
+        self._snapshots = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = None
+        self.scrape_errors = 0
+
+    def scrape(self):
+        with urllib.request.urlopen(
+            self.metrics_url, timeout=self.timeout_s
+        ) as r:
+            return parse_prometheus(r.read().decode("utf-8", errors="replace"))
+
+    def start(self):
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.is_set():
+                try:
+                    snap = self.scrape()
+                    with self._lock:
+                        self._snapshots.append(snap)
+                except Exception:
+                    self.scrape_errors += 1
+                self._stop.wait(self.interval_s)
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    def swap_snapshots(self):
+        """Collect-and-clear, like the managers' timestamp swap."""
+        with self._lock:
+            snaps = self._snapshots
+            self._snapshots = []
+        return snaps
+
+    @staticmethod
+    def summarize(snapshots, gauges=("ctpu_tpu_memory_used_bytes",)):
+        """Max/avg per gauge over the window's snapshots (the reference
+        merges per-GPU utilization/memory the same way)."""
+        summary = {}
+        for gauge in gauges:
+            values = []
+            for snap in snapshots:
+                for _, v in snap.get(gauge, []):
+                    values.append(v)
+            if values:
+                summary[gauge] = {
+                    "avg": float(np.mean(values)),
+                    "max": float(np.max(values)),
+                }
+        return summary

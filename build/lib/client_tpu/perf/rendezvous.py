@@ -1,0 +1,142 @@
+"""Multi-rank coordination for data-parallel benchmarking.
+
+The reference coordinates multiple perf_analyzer ranks with dlopen'd MPI
+(reference mpi_utils.h:32-84: Init/Barrier/Bcast/Finalize) and requires
+*all* ranks to reach stability before any stops measuring
+(AllMPIRanksAreStable, inference_profiler.h:537).  The TPU-native rebuild
+replaces MPI with a tiny TCP rendezvous — the same shape jax.distributed
+uses for its coordinator — so N perf processes on one or many hosts can
+drive one or many models concurrently:
+
+  rank 0:  python -m client_tpu.perf ... --world-size 2 --rank 0
+  rank 1:  python -m client_tpu.perf ... --world-size 2 --rank 1 \
+               --rendezvous-addr <rank0-host>:<port>
+
+Operations: ``barrier()`` and ``all_gather(obj)`` (JSON payloads,
+length-prefixed frames).  Rank 0 serves; other ranks connect with retry.
+"""
+
+import json
+import socket
+import struct
+import time
+
+from client_tpu.utils import InferenceServerException
+
+
+def _send_frame(sock, obj):
+    payload = json.dumps(obj).encode("utf-8")
+    sock.sendall(struct.pack("<I", len(payload)) + payload)
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise InferenceServerException("rendezvous peer disconnected")
+        buf += chunk
+    return buf
+
+
+def _recv_frame(sock):
+    (length,) = struct.unpack("<I", _recv_exact(sock, 4))
+    return json.loads(_recv_exact(sock, length).decode("utf-8"))
+
+
+class Rendezvous:
+    """Barrier + all-gather across ``world_size`` processes."""
+
+    def __init__(self, rank, world_size, addr="127.0.0.1:29400",
+                 connect_timeout_s=60.0):
+        if not (0 <= rank < world_size):
+            raise InferenceServerException(
+                f"rank {rank} out of range for world size {world_size}"
+            )
+        self.rank = rank
+        self.world_size = world_size
+        host, _, port = addr.rpartition(":")
+        self._host = host or "127.0.0.1"
+        self._port = int(port)
+        self._peers = {}  # rank -> socket (rank 0 only)
+        self._server = None
+        self._sock = None  # connection to rank 0 (ranks > 0)
+        if world_size > 1:
+            if rank == 0:
+                self._serve(connect_timeout_s)
+            else:
+                self._connect(connect_timeout_s)
+
+    def _serve(self, timeout_s):
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind((self._host, self._port))
+        srv.listen(self.world_size)
+        srv.settimeout(timeout_s)
+        self._server = srv
+        deadline = time.monotonic() + timeout_s
+        while len(self._peers) < self.world_size - 1:
+            if time.monotonic() > deadline:
+                raise InferenceServerException(
+                    f"rendezvous timeout: {len(self._peers) + 1}/"
+                    f"{self.world_size} ranks joined"
+                )
+            try:
+                conn, _ = srv.accept()
+            except socket.timeout:
+                continue
+            hello = _recv_frame(conn)
+            self._peers[hello["rank"]] = conn
+
+    def _connect(self, timeout_s):
+        deadline = time.monotonic() + timeout_s
+        last_err = None
+        while time.monotonic() < deadline:
+            try:
+                sock = socket.create_connection(
+                    (self._host, self._port), timeout=5.0
+                )
+                sock.settimeout(timeout_s)
+                _send_frame(sock, {"rank": self.rank})
+                self._sock = sock
+                return
+            except OSError as e:
+                last_err = e
+                time.sleep(0.25)
+        raise InferenceServerException(
+            f"unable to reach rendezvous at {self._host}:{self._port}: "
+            f"{last_err}"
+        )
+
+    def all_gather(self, obj):
+        """Every rank contributes ``obj``; all receive the rank-ordered list."""
+        if self.world_size == 1:
+            return [obj]
+        if self.rank == 0:
+            gathered = {0: obj}
+            for rank, sock in self._peers.items():
+                gathered[rank] = _recv_frame(sock)["payload"]
+            result = [gathered[r] for r in range(self.world_size)]
+            for sock in self._peers.values():
+                _send_frame(sock, {"payload": result})
+            return result
+        _send_frame(self._sock, {"payload": obj})
+        return _recv_frame(self._sock)["payload"]
+
+    def barrier(self):
+        self.all_gather(None)
+
+    def all_ranks_stable(self, local_stable):
+        """AllMPIRanksAreStable analog: true only when every rank is."""
+        return all(self.all_gather(bool(local_stable)))
+
+    def close(self):
+        for sock in self._peers.values():
+            sock.close()
+        self._peers = {}
+        if self._sock is not None:
+            self._sock.close()
+            self._sock = None
+        if self._server is not None:
+            self._server.close()
+            self._server = None

@@ -1,0 +1,78 @@
+"""In-process KServe-v2 server: model runtime + HTTP/gRPC frontends.
+
+Dual purpose (SURVEY.md §4 "hermetic fake server" + the TPU serving path for
+benchmarks). Typical use:
+
+    from client_tpu.serve import Server
+    with Server() as server:
+        client = client_tpu.http.InferenceServerClient(server.http_address)
+        ...
+
+or standalone: ``python -m client_tpu.serve --http-port 8000 --grpc-port 8001``.
+"""
+
+from client_tpu.serve.builtins import default_models
+from client_tpu.serve.model_runtime import (
+    InferenceEngine,
+    Model,
+    TensorSpec,
+)
+
+
+class Server:
+    """Convenience wrapper starting HTTP (and optionally gRPC) frontends."""
+
+    def __init__(
+        self,
+        models=None,
+        http_port=0,
+        grpc_port=None,
+        host="127.0.0.1",
+        verbose=False,
+        with_default_models=True,
+    ):
+        all_models = list(models or [])
+        if with_default_models:
+            all_models.extend(default_models())
+        self.engine = InferenceEngine(all_models)
+        self._http = None
+        self._grpc = None
+        self._http_port = http_port
+        self._grpc_port = grpc_port
+        self._host = host
+        self._verbose = verbose
+
+    @property
+    def http_address(self):
+        return self._http.address if self._http else None
+
+    @property
+    def grpc_address(self):
+        return self._grpc.address if self._grpc else None
+
+    def start(self):
+        from client_tpu.serve.http_server import HttpFrontend
+
+        self._http = HttpFrontend(
+            self.engine, self._host, self._http_port, self._verbose
+        ).start()
+        if self._grpc_port is not None:
+            from client_tpu.serve.grpc_server import GrpcFrontend
+
+            self._grpc = GrpcFrontend(
+                self.engine, self._host, self._grpc_port, self._verbose
+            ).start()
+        return self
+
+    def stop(self):
+        if self._http:
+            self._http.stop()
+        if self._grpc:
+            self._grpc.stop()
+        self.engine.close()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()

@@ -1,0 +1,53 @@
+"""Standalone server entry point: ``python -m client_tpu.serve``."""
+
+import argparse
+import signal
+import threading
+
+
+def main():
+    parser = argparse.ArgumentParser(description="client_tpu in-process KServe-v2 server")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--http-port", type=int, default=8000)
+    parser.add_argument(
+        "--grpc-port",
+        type=int,
+        default=None,
+        help="enable the gRPC frontend on this port",
+    )
+    parser.add_argument("-v", "--verbose", action="store_true")
+    parser.add_argument(
+        "--models",
+        default="builtin",
+        help="comma-separated model sets: builtin,jax,language (default: builtin)",
+    )
+    args = parser.parse_args()
+
+    from client_tpu.serve.models import model_sets
+
+    sets = [s for s in args.models.split(",") if s != "builtin"]
+    extra = model_sets(",".join(sets)) if sets else []
+
+    from client_tpu.serve import Server
+
+    server = Server(
+        models=extra,
+        http_port=args.http_port,
+        grpc_port=args.grpc_port,
+        host=args.host,
+        verbose=args.verbose,
+        with_default_models="builtin" in args.models.split(","),
+    ).start()
+    print(f"client_tpu.serve: HTTP on {server.http_address}", flush=True)
+    if server.grpc_address:
+        print(f"client_tpu.serve: gRPC on {server.grpc_address}", flush=True)
+
+    stop = threading.Event()
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    stop.wait()
+    server.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,441 @@
+"""KServe-v2 gRPC frontend for the in-process inference engine.
+
+Registers generic method handlers from the client_tpu._grpc_service table (no
+grpcio-tools). Bridges protobuf requests to the engine's JSON-dict execution
+form, including bidirectional ModelStreamInfer with decoupled (N-response)
+model support — the transport the LLM token-streaming configs use.
+"""
+
+from concurrent import futures
+
+import grpc
+from google.protobuf import json_format
+
+from client_tpu._grpc_service import METHODS, SERVICE
+from client_tpu._proto import inference_pb2 as pb
+from client_tpu._proto import model_config_pb2 as mc
+from client_tpu.serve import model_runtime
+from client_tpu.utils import InferenceServerException, to_wire_bytes
+from client_tpu._infer_types import _np_from_json_data
+
+_STATUS_MAP = {
+    "400": grpc.StatusCode.INVALID_ARGUMENT,
+    "404": grpc.StatusCode.NOT_FOUND,
+    "500": grpc.StatusCode.INTERNAL,
+    "501": grpc.StatusCode.UNIMPLEMENTED,
+}
+
+
+def _abort(context, exc):
+    code = grpc.StatusCode.INVALID_ARGUMENT
+    if isinstance(exc, InferenceServerException) and exc.status():
+        code = _STATUS_MAP.get(exc.status(), grpc.StatusCode.UNKNOWN)
+    msg = exc.message() if isinstance(exc, InferenceServerException) else str(exc)
+    context.abort(code, msg)
+
+
+def _param_value(param):
+    which = param.WhichOneof("parameter_choice")
+    return getattr(param, which) if which else None
+
+
+def _request_to_dict(request):
+    """ModelInferRequest proto -> (engine request dict, binary section)."""
+    req = {"id": request.id}
+    params = {k: _param_value(v) for k, v in request.parameters.items()}
+    req["parameters"] = params
+
+    raw_cursor = 0
+    binary_parts = []
+    offset = 0
+    inputs = []
+    for tensor in request.inputs:
+        entry = {
+            "name": tensor.name,
+            "datatype": tensor.datatype,
+            "shape": list(tensor.shape),
+        }
+        tparams = {k: _param_value(v) for k, v in tensor.parameters.items()}
+        if "shared_memory_region" in tparams:
+            entry["parameters"] = tparams
+        elif tensor.HasField("contents"):
+            # Typed repeated-field contents: normalize to wire bytes so the
+            # engine has a single decode path.
+            arr = _contents_to_array(tensor)
+            raw = to_wire_bytes(arr, tensor.datatype)
+            entry["parameters"] = {"binary_data_size": len(raw)}
+            binary_parts.append(raw)
+            offset += len(raw)
+        else:
+            if raw_cursor >= len(request.raw_input_contents):
+                raise InferenceServerException(
+                    f"input '{tensor.name}' has no data", status="400"
+                )
+            raw = request.raw_input_contents[raw_cursor]
+            raw_cursor += 1
+            entry["parameters"] = {"binary_data_size": len(raw)}
+            binary_parts.append(raw)
+            offset += len(raw)
+        inputs.append(entry)
+    req["inputs"] = inputs
+
+    if request.outputs:
+        outputs = []
+        for out in request.outputs:
+            oparams = {k: _param_value(v) for k, v in out.parameters.items()}
+            if "shared_memory_region" not in oparams:
+                oparams["binary_data"] = True
+            oparams.pop("binary_data_size", None)
+            outputs.append({"name": out.name, "parameters": oparams})
+        req["outputs"] = outputs
+    else:
+        params["binary_data_output"] = True
+    return req, b"".join(binary_parts)
+
+
+def _contents_to_array(tensor):
+    from client_tpu._grpc_infer import _CONTENTS_FIELD
+
+    field = _CONTENTS_FIELD.get(tensor.datatype)
+    if field is None:
+        raise InferenceServerException(
+            f"unsupported datatype {tensor.datatype}", status="400"
+        )
+    values = list(getattr(tensor.contents, field))
+    if tensor.datatype == "BYTES":
+        return _np_from_json_data(values, "BYTES", list(tensor.shape))
+    return _np_from_json_data(values, tensor.datatype, list(tensor.shape))
+
+
+def _dict_to_response(model_name, model_version, response_json, blobs):
+    """Engine response dict + blobs -> ModelInferResponse proto."""
+    response = pb.ModelInferResponse(
+        model_name=response_json.get("model_name", model_name),
+        model_version=response_json.get("model_version", model_version),
+        id=response_json.get("id", ""),
+    )
+    # raw_output_contents must align positionally with non-shm outputs, so
+    # interleave binary blobs and any JSON-data fallbacks in output order.
+    raws = []
+    blob_cursor = 0
+    for entry in response_json.get("outputs", []):
+        out = response.outputs.add()
+        out.name = entry["name"]
+        out.datatype = entry["datatype"]
+        out.shape.extend(entry["shape"])
+        eparams = entry.get("parameters", {}) or {}
+        for key, value in eparams.items():
+            if key == "binary_data_size":
+                continue
+            if isinstance(value, bool):
+                out.parameters[key].bool_param = value
+            elif isinstance(value, int):
+                out.parameters[key].int64_param = value
+            else:
+                out.parameters[key].string_param = str(value)
+        if "binary_data_size" in eparams:
+            raws.append(blobs[blob_cursor])
+            blob_cursor += 1
+        elif "data" in entry:
+            arr = _np_from_json_data(
+                entry["data"], entry["datatype"], entry["shape"]
+            )
+            raws.append(to_wire_bytes(arr, entry["datatype"]))
+    response.raw_output_contents.extend(raws)
+    return response
+
+
+class _Handlers:
+    def __init__(self, engine, verbose=False):
+        self.engine = engine
+        self.verbose = verbose
+
+    # health ---------------------------------------------------------------
+
+    def ServerLive(self, request, context):
+        return pb.ServerLiveResponse(live=True)
+
+    def ServerReady(self, request, context):
+        return pb.ServerReadyResponse(ready=True)
+
+    def ModelReady(self, request, context):
+        return pb.ModelReadyResponse(
+            ready=self.engine.model_ready(request.name, request.version)
+        )
+
+    # metadata ---------------------------------------------------------------
+
+    def ServerMetadata(self, request, context):
+        return pb.ServerMetadataResponse(
+            name=model_runtime.SERVER_NAME,
+            version=model_runtime.SERVER_VERSION,
+            extensions=model_runtime.SERVER_EXTENSIONS,
+        )
+
+    def ModelMetadata(self, request, context):
+        try:
+            model = self.engine.get_model(request.name, request.version)
+        except InferenceServerException as e:
+            _abort(context, e)
+        meta = model.metadata()
+        response = pb.ModelMetadataResponse(
+            name=meta["name"], versions=meta["versions"], platform=meta["platform"]
+        )
+        for t in meta["inputs"]:
+            tm = response.inputs.add()
+            tm.name, tm.datatype = t["name"], t["datatype"]
+            tm.shape.extend(t["shape"])
+        for t in meta["outputs"]:
+            tm = response.outputs.add()
+            tm.name, tm.datatype = t["name"], t["datatype"]
+            tm.shape.extend(t["shape"])
+        return response
+
+    def ModelConfig(self, request, context):
+        try:
+            model = self.engine.get_model(request.name, request.version)
+        except InferenceServerException as e:
+            _abort(context, e)
+        config = json_format.ParseDict(
+            model.config(), mc.ModelConfig(), ignore_unknown_fields=True
+        )
+        return pb.ModelConfigResponse(config=config)
+
+    # repository -------------------------------------------------------------
+
+    def RepositoryIndex(self, request, context):
+        response = pb.RepositoryIndexResponse()
+        for entry in self.engine.repository_index(request.ready):
+            m = response.models.add()
+            m.name, m.version = entry["name"], entry["version"]
+            m.state, m.reason = entry["state"], entry["reason"]
+        return response
+
+    def RepositoryModelLoad(self, request, context):
+        import json as _json
+
+        config = None
+        files = {}
+        for key, param in request.parameters.items():
+            if key == "config":
+                config = _json.loads(param.string_param)
+            elif param.WhichOneof("parameter_choice") == "bytes_param":
+                files[key] = param.bytes_param
+        try:
+            self.engine.load_model(
+                request.model_name, config_override=config, files=files or None
+            )
+        except InferenceServerException as e:
+            _abort(context, e)
+        return pb.RepositoryModelLoadResponse()
+
+    def RepositoryModelUnload(self, request, context):
+        try:
+            self.engine.unload_model(request.model_name)
+        except InferenceServerException as e:
+            _abort(context, e)
+        return pb.RepositoryModelUnloadResponse()
+
+    # statistics / trace / log -----------------------------------------------
+
+    def ModelStatistics(self, request, context):
+        try:
+            stats = self.engine.statistics(request.name, request.version)
+        except InferenceServerException as e:
+            _abort(context, e)
+        response = pb.ModelStatisticsResponse()
+        for entry in stats:
+            response.model_stats.append(
+                json_format.ParseDict(entry, pb.ModelStatistics())
+            )
+        return response
+
+    def TraceSetting(self, request, context):
+        settings = self.engine.trace_settings
+        if request.settings:
+            for key, value in request.settings.items():
+                values = list(value.value)
+                if not values:
+                    continue
+                settings[key] = values if key == "trace_level" else values[0]
+        response = pb.TraceSettingResponse()
+        for key, value in settings.items():
+            values = value if isinstance(value, list) else [str(value)]
+            response.settings[key].value.extend(values)
+        return response
+
+    def LogSettings(self, request, context):
+        settings = self.engine.log_settings
+        if request.settings:
+            for key, value in request.settings.items():
+                which = value.WhichOneof("parameter_choice")
+                if which:
+                    settings[key] = getattr(value, which)
+        response = pb.LogSettingsResponse()
+        for key, value in settings.items():
+            if isinstance(value, bool):
+                response.settings[key].bool_param = value
+            elif isinstance(value, int):
+                response.settings[key].uint32_param = value
+            else:
+                response.settings[key].string_param = str(value)
+        return response
+
+    # shared memory ----------------------------------------------------------
+
+    def SystemSharedMemoryStatus(self, request, context):
+        try:
+            regions = self.engine.shm.system_status(request.name or None)
+        except InferenceServerException as e:
+            _abort(context, e)
+        response = pb.SystemSharedMemoryStatusResponse()
+        for name, r in regions.items():
+            response.regions[name].name = name
+            response.regions[name].key = r["key"]
+            response.regions[name].offset = r["offset"]
+            response.regions[name].byte_size = r["byte_size"]
+        return response
+
+    def SystemSharedMemoryRegister(self, request, context):
+        try:
+            self.engine.shm.register_system(
+                request.name, request.key, request.offset, request.byte_size
+            )
+        except InferenceServerException as e:
+            _abort(context, e)
+        return pb.SystemSharedMemoryRegisterResponse()
+
+    def SystemSharedMemoryUnregister(self, request, context):
+        self.engine.shm.unregister_system(request.name or None)
+        return pb.SystemSharedMemoryUnregisterResponse()
+
+    def CudaSharedMemoryStatus(self, request, context):
+        return pb.CudaSharedMemoryStatusResponse()
+
+    def CudaSharedMemoryRegister(self, request, context):
+        context.abort(
+            grpc.StatusCode.INVALID_ARGUMENT,
+            "CUDA shared memory is not supported by this server "
+            "(use TpuSharedMemoryRegister)",
+        )
+
+    def CudaSharedMemoryUnregister(self, request, context):
+        return pb.CudaSharedMemoryUnregisterResponse()
+
+    def TpuSharedMemoryStatus(self, request, context):
+        try:
+            regions = self.engine.shm.tpu_status(request.name or None)
+        except InferenceServerException as e:
+            _abort(context, e)
+        response = pb.TpuSharedMemoryStatusResponse()
+        for name, r in regions.items():
+            response.regions[name].name = name
+            response.regions[name].device_id = r["device_id"]
+            response.regions[name].byte_size = r["byte_size"]
+        return response
+
+    def TpuSharedMemoryRegister(self, request, context):
+        try:
+            self.engine.shm.register_tpu(
+                request.name, request.raw_handle, request.device_id, request.byte_size
+            )
+        except InferenceServerException as e:
+            _abort(context, e)
+        return pb.TpuSharedMemoryRegisterResponse()
+
+    def TpuSharedMemoryUnregister(self, request, context):
+        self.engine.shm.unregister_tpu(request.name or None)
+        return pb.TpuSharedMemoryUnregisterResponse()
+
+    # inference --------------------------------------------------------------
+
+    def ModelInfer(self, request, context):
+        try:
+            req, binary = _request_to_dict(request)
+            result = self.engine.execute(
+                request.model_name, request.model_version, req, binary
+            )
+            if isinstance(result, list):
+                raise InferenceServerException(
+                    f"model '{request.model_name}' is decoupled; use "
+                    "ModelStreamInfer",
+                    status="400",
+                )
+            response_json, blobs = result
+            return _dict_to_response(
+                request.model_name, request.model_version, response_json, blobs
+            )
+        except InferenceServerException as e:
+            _abort(context, e)
+
+    def ModelStreamInfer(self, request_iterator, context):
+        for request in request_iterator:
+            try:
+                req, binary = _request_to_dict(request)
+                result = self.engine.execute(
+                    request.model_name, request.model_version, req, binary
+                )
+                responses = result if isinstance(result, list) else [result]
+                for response_json, blobs in responses:
+                    yield pb.ModelStreamInferResponse(
+                        infer_response=_dict_to_response(
+                            request.model_name,
+                            request.model_version,
+                            response_json,
+                            blobs,
+                        )
+                    )
+            except InferenceServerException as e:
+                err = pb.ModelStreamInferResponse(error_message=e.message())
+                err.infer_response.id = request.id
+                yield err
+            except Exception as e:  # pragma: no cover - defensive
+                yield pb.ModelStreamInferResponse(error_message=str(e))
+
+
+class GrpcFrontend:
+    """grpc.server bound to an InferenceEngine via generic method handlers."""
+
+    def __init__(self, engine, host="127.0.0.1", port=0, verbose=False, max_workers=16):
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(
+                max_workers=max_workers, thread_name_prefix="client_tpu-grpc"
+            ),
+            options=[
+                ("grpc.max_send_message_length", 2**31 - 1),
+                ("grpc.max_receive_message_length", 2**31 - 1),
+            ],
+        )
+        handlers_obj = _Handlers(engine, verbose)
+        method_handlers = {}
+        for name, (req_cls, resp_cls, cstream, sstream) in METHODS.items():
+            fn = getattr(handlers_obj, name)
+            kwargs = {
+                "request_deserializer": req_cls.FromString,
+                "response_serializer": resp_cls.SerializeToString,
+            }
+            if cstream and sstream:
+                handler = grpc.stream_stream_rpc_method_handler(fn, **kwargs)
+            elif sstream:
+                handler = grpc.unary_stream_rpc_method_handler(fn, **kwargs)
+            elif cstream:
+                handler = grpc.stream_unary_rpc_method_handler(fn, **kwargs)
+            else:
+                handler = grpc.unary_unary_rpc_method_handler(fn, **kwargs)
+            method_handlers[name] = handler
+        self._server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(SERVICE, method_handlers),)
+        )
+        self._port = self._server.add_insecure_port(f"{host}:{port}")
+        self._host = host
+
+    @property
+    def address(self):
+        return f"{self._host}:{self._port}"
+
+    def start(self):
+        self._server.start()
+        return self
+
+    def stop(self):
+        self._server.stop(grace=2)

@@ -1,0 +1,91 @@
+"""Prometheus-style metrics for the in-process server.
+
+The TPU-native analog of Triton's GPU metrics endpoint (the reference's
+MetricsManager scrapes ``nv_gpu_utilization`` / ``nv_gpu_memory_*`` from the
+server's /metrics — reference metrics_manager.h:44-91): per-model inference
+counters and durations from the engine's statistics, plus per-TPU-device HBM
+usage via ``device.memory_stats()`` where the PJRT runtime exposes it (the
+tunneled axon platform reports none; real TPU VMs report bytes_in_use /
+bytes_limit).
+"""
+
+import time
+
+
+def _device_lines(lines):
+    try:
+        import jax
+
+        devices = jax.devices()
+    except Exception:
+        return
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        labels = f'{{device="{d.id}",kind="{d.device_kind}"}}'
+        used = stats.get("bytes_in_use")
+        limit = stats.get("bytes_limit") or stats.get("bytes_reservable_limit")
+        peak = stats.get("peak_bytes_in_use")
+        if used is not None:
+            lines.append(
+                f"ctpu_tpu_memory_used_bytes{labels} {used}"
+            )
+        if limit is not None:
+            lines.append(
+                f"ctpu_tpu_memory_total_bytes{labels} {limit}"
+            )
+        if peak is not None:
+            lines.append(
+                f"ctpu_tpu_memory_peak_bytes{labels} {peak}"
+            )
+
+
+def render_metrics(engine):
+    """The /metrics payload (Prometheus text exposition format)."""
+    lines = [
+        "# HELP ctpu_inference_request_success Successful inference requests",
+        "# TYPE ctpu_inference_request_success counter",
+        "# HELP ctpu_inference_request_failure Failed inference requests",
+        "# TYPE ctpu_inference_request_failure counter",
+        "# HELP ctpu_inference_count Inferences performed (batch aware)",
+        "# TYPE ctpu_inference_count counter",
+        "# HELP ctpu_inference_duration_us Cumulative request duration",
+        "# TYPE ctpu_inference_duration_us counter",
+        "# HELP ctpu_tpu_memory_used_bytes Device HBM bytes in use",
+        "# TYPE ctpu_tpu_memory_used_bytes gauge",
+    ]
+    stats = engine.statistics()
+    # engine.statistics() returns the HTTP-format bare list of model entries
+    model_stats = stats if isinstance(stats, list) else stats.get(
+        "model_stats", []
+    )
+    for ms in model_stats:
+        model = ms.get("name", "")
+        version = ms.get("version", "")
+        labels = f'{{model="{model}",version="{version}"}}'
+        agg = ms.get("inference_stats", {})
+        success = agg.get("success", {})
+        fail = agg.get("fail", {})
+        lines.append(
+            f"ctpu_inference_request_success{labels} "
+            f"{int(success.get('count', 0))}"
+        )
+        lines.append(
+            f"ctpu_inference_request_failure{labels} "
+            f"{int(fail.get('count', 0))}"
+        )
+        lines.append(
+            f"ctpu_inference_count{labels} "
+            f"{int(ms.get('inference_count', 0))}"
+        )
+        lines.append(
+            f"ctpu_inference_duration_us{labels} "
+            f"{int(success.get('ns', 0)) // 1000}"
+        )
+    _device_lines(lines)
+    lines.append(f"ctpu_scrape_timestamp_seconds {time.time():.3f}")
+    return "\n".join(lines) + "\n"

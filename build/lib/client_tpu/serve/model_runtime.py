@@ -1,0 +1,837 @@
+"""In-process KServe-v2 model runtime.
+
+This is the server-side half of the framework: a model repository + inference
+engine that the HTTP and gRPC frontends (http_server.py / grpc_server.py) share.
+It serves two roles:
+
+1. Hermetic test double — the fake-server role SURVEY.md §4 calls for (the
+   reference has no in-repo server; its tests need external infra).
+2. Real TPU serving path — models whose ``fn`` is a jitted JAX callable run on
+   the TPU chip, which is what bench.py measures end-to-end.
+
+Request execution semantics (shared-memory resolution, classification
+extension, statistics accounting) follow the KServe-v2 spec the reference
+clients target.
+"""
+
+import json
+import mmap
+import os
+import threading
+import time
+
+import numpy as np
+
+from client_tpu.utils import (
+    InferenceServerException,
+    from_wire_bytes,
+    to_wire_bytes,
+)
+from client_tpu._infer_types import _np_from_json_data
+
+SERVER_NAME = "client_tpu.serve"
+SERVER_VERSION = "0.1.0"
+SERVER_EXTENSIONS = [
+    "classification",
+    "sequence",
+    "model_repository",
+    "model_repository(unload_dependents)",
+    "schedule_policy",
+    "model_configuration",
+    "system_shared_memory",
+    "tpu_shared_memory",
+    "binary_tensor_data",
+    "parameters",
+    "statistics",
+    "trace",
+    "logging",
+]
+
+
+class TensorSpec:
+    """Metadata for one model input/output tensor."""
+
+    def __init__(self, name, datatype, dims, labels=None, optional=False):
+        self.name = name
+        self.datatype = datatype
+        self.dims = list(dims)
+        self.labels = labels or []
+        self.optional = optional
+
+    def metadata(self):
+        return {"name": self.name, "datatype": self.datatype, "shape": self.dims}
+
+
+class SequenceContext:
+    """Per-sequence state handed to stateful model functions."""
+
+    def __init__(self, sequence_id):
+        self.sequence_id = sequence_id
+        self.state = {}
+        self.last_used = time.monotonic()
+
+
+class Model:
+    """A servable model: tensor specs + a python/JAX callable.
+
+    ``fn(inputs, parameters, context)`` takes a dict of numpy arrays and
+    returns a dict of numpy arrays — or, for ``decoupled=True`` models, an
+    iterator of such dicts (the LLM token-streaming shape).  ``context`` is a
+    SequenceContext when the request carries a sequence id, else None.
+    """
+
+    def __init__(
+        self,
+        name,
+        inputs,
+        outputs,
+        fn,
+        platform="python",
+        backend="python",
+        versions=("1",),
+        max_batch_size=0,
+        decoupled=False,
+        stateful=False,
+    ):
+        self.name = name
+        self.inputs = list(inputs)
+        self.outputs = list(outputs)
+        self.fn = fn
+        self.platform = platform
+        self.backend = backend
+        self.versions = [str(v) for v in versions]
+        self.max_batch_size = max_batch_size
+        self.decoupled = decoupled
+        self.stateful = stateful
+        self.config_override = None  # set by repository load with config param
+        self.file_overrides = {}
+
+    def metadata(self):
+        return {
+            "name": self.name,
+            "versions": self.versions,
+            "platform": self.platform,
+            "inputs": [t.metadata() for t in self.inputs],
+            "outputs": [t.metadata() for t in self.outputs],
+        }
+
+    def config(self):
+        if self.config_override is not None:
+            merged = dict(self._base_config())
+            merged.update(self.config_override)
+            merged["name"] = self.name
+            return merged
+        return self._base_config()
+
+    def _base_config(self):
+        cfg = {
+            "name": self.name,
+            "platform": self.platform,
+            "backend": self.backend,
+            "max_batch_size": self.max_batch_size,
+            "input": [
+                {"name": t.name, "data_type": f"TYPE_{_cfg_type(t.datatype)}", "dims": t.dims}
+                for t in self.inputs
+            ],
+            "output": [
+                {"name": t.name, "data_type": f"TYPE_{_cfg_type(t.datatype)}", "dims": t.dims}
+                for t in self.outputs
+            ],
+        }
+        if self.decoupled:
+            cfg["model_transaction_policy"] = {"decoupled": True}
+        if self.stateful:
+            cfg["sequence_batching"] = {"max_sequence_idle_microseconds": 60000000}
+        return cfg
+
+
+def _cfg_type(datatype):
+    return "STRING" if datatype == "BYTES" else datatype
+
+
+class ModelStats:
+    """Per-model cumulative statistics in the spec's statistics-extension shape."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.inference_count = 0
+        self.execution_count = 0
+        self.last_inference_ms = 0
+        self.success_count = 0
+        self.success_ns = 0
+        self.fail_count = 0
+        self.fail_ns = 0
+        self.compute_infer_ns = 0
+        self.compute_input_ns = 0
+        self.compute_output_ns = 0
+        self.queue_ns = 0
+
+    def record(self, ok, total_ns, infer_ns, input_ns, output_ns, batch=1):
+        with self.lock:
+            if ok:
+                self.inference_count += batch
+                self.execution_count += 1
+                self.success_count += 1
+                self.success_ns += total_ns
+                self.compute_infer_ns += infer_ns
+                self.compute_input_ns += input_ns
+                self.compute_output_ns += output_ns
+                self.last_inference_ms = int(time.time() * 1000)
+            else:
+                self.fail_count += 1
+                self.fail_ns += total_ns
+
+    def to_json(self, name, version):
+        with self.lock:
+            return {
+                "name": name,
+                "version": version,
+                "last_inference": self.last_inference_ms,
+                "inference_count": self.inference_count,
+                "execution_count": self.execution_count,
+                "inference_stats": {
+                    "success": {"count": self.success_count, "ns": self.success_ns},
+                    "fail": {"count": self.fail_count, "ns": self.fail_ns},
+                    "queue": {"count": self.success_count, "ns": self.queue_ns},
+                    "compute_input": {
+                        "count": self.success_count,
+                        "ns": self.compute_input_ns,
+                    },
+                    "compute_infer": {
+                        "count": self.success_count,
+                        "ns": self.compute_infer_ns,
+                    },
+                    "compute_output": {
+                        "count": self.success_count,
+                        "ns": self.compute_output_ns,
+                    },
+                    "cache_hit": {"count": 0, "ns": 0},
+                    "cache_miss": {"count": 0, "ns": 0},
+                },
+            }
+
+
+class SharedMemoryRegistry:
+    """Server-side registry of system and TPU shared-memory regions.
+
+    System regions attach by POSIX shm key (``/dev/shm``).  TPU regions carry a
+    TpuBufferDescriptor raw handle (JSON: staging_key/device_id/byte_size); the
+    server attaches the descriptor's host-staging region, which same-host
+    clients keep coherent with the HBM buffer (see
+    client_tpu/utils/tpu_shared_memory).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._system = {}
+        self._tpu = {}
+
+    # system ---------------------------------------------------------------
+
+    def register_system(self, name, key, offset, byte_size):
+        with self._lock:
+            if name in self._system:
+                old = self._system[name]
+                if (old["key"], old["offset"], old["byte_size"]) != (
+                    key,
+                    offset,
+                    byte_size,
+                ):
+                    raise InferenceServerException(
+                        f"shared memory region '{name}' already registered "
+                        "with different attributes",
+                        status="400",
+                    )
+                return
+            mm = _attach_posix_shm(key, offset + byte_size)
+            self._system[name] = {
+                "key": key,
+                "offset": offset,
+                "byte_size": byte_size,
+                "mmap": mm,
+            }
+
+    def unregister_system(self, name=None):
+        with self._lock:
+            names = [name] if name else list(self._system)
+            for n in names:
+                region = self._system.pop(n, None)
+                if region is not None:
+                    region["mmap"].close()
+
+    def system_status(self, name=None):
+        with self._lock:
+            regions = {}
+            for n, r in self._system.items():
+                if name and n != name:
+                    continue
+                regions[n] = {
+                    "name": n,
+                    "key": r["key"],
+                    "offset": r["offset"],
+                    "byte_size": r["byte_size"],
+                }
+            if name and not regions:
+                raise InferenceServerException(
+                    f"shared memory region '{name}' is not registered", status="400"
+                )
+            return regions
+
+    # tpu ------------------------------------------------------------------
+
+    def register_tpu(self, name, raw_handle, device_id, byte_size):
+        from client_tpu.utils import tpu_shared_memory as _tpushm
+
+        descriptor = json.loads(
+            raw_handle.decode("utf-8") if isinstance(raw_handle, bytes) else raw_handle
+        )
+        with self._lock:
+            if name in self._tpu:
+                old = self._tpu[name]
+                if (
+                    old["descriptor"].get("uuid") == descriptor.get("uuid")
+                    and old["byte_size"] == byte_size
+                    and old["device_id"] == device_id
+                ):
+                    return
+                raise InferenceServerException(
+                    f"TPU shared memory region '{name}' already registered "
+                    "with different attributes",
+                    status="400",
+                )
+            # Same-process client (in-process server / C-API analog): resolve
+            # the live HBM region through the broker — zero-copy jax.Array
+            # access, no staging.  Otherwise fall back to the host staging
+            # mirror the descriptor advertises.
+            region_obj = _tpushm.resolve_inprocess(descriptor)
+            mm = None
+            if region_obj is None:
+                staging_key = descriptor.get("staging_key")
+                if staging_key is None:
+                    raise InferenceServerException(
+                        f"TPU region '{name}' was created in another process "
+                        "without a staging_key; cross-process registration "
+                        "requires host staging (PJRT has no cross-process "
+                        "buffer export)",
+                        status="400",
+                    )
+                mm = _attach_posix_shm(staging_key, byte_size)
+            self._tpu[name] = {
+                "device_id": device_id,
+                "byte_size": byte_size,
+                "descriptor": descriptor,
+                "mmap": mm,
+                "region_obj": region_obj,
+            }
+
+    def unregister_tpu(self, name=None):
+        with self._lock:
+            names = [name] if name else list(self._tpu)
+            for n in names:
+                region = self._tpu.pop(n, None)
+                if region is not None and region["mmap"] is not None:
+                    region["mmap"].close()
+
+    def tpu_status(self, name=None):
+        with self._lock:
+            regions = {}
+            for n, r in self._tpu.items():
+                if name and n != name:
+                    continue
+                regions[n] = {
+                    "name": n,
+                    "device_id": r["device_id"],
+                    "byte_size": r["byte_size"],
+                }
+            if name and not regions:
+                raise InferenceServerException(
+                    f"TPU shared memory region '{name}' is not registered",
+                    status="400",
+                )
+            return regions
+
+    # data access ----------------------------------------------------------
+
+    def _find(self, region_name):
+        region = self._system.get(region_name)
+        base = 0
+        if region is not None:
+            base = region["offset"]
+        else:
+            region = self._tpu.get(region_name)
+        if region is None:
+            raise InferenceServerException(
+                f"shared memory region '{region_name}' is not registered",
+                status="400",
+            )
+        return region, base
+
+    def read_tensor(self, region_name, offset, byte_size, datatype, shape):
+        """Resolve an input tensor from a region.  In-process TPU regions
+        return the live jax.Array (zero-copy); others decode from bytes."""
+        with self._lock:
+            region = self._tpu.get(region_name)
+            obj = region.get("region_obj") if region else None
+        if obj is not None:
+            try:
+                return obj.read_array(offset, byte_size, datatype, shape)
+            except InferenceServerException as e:
+                raise InferenceServerException(e.message(), status="400") from e
+        raw = self.read(region_name, offset, byte_size)
+        return from_wire_bytes(raw, datatype, shape)
+
+    def write_tensor(self, region_name, offset, arr, datatype, max_byte_size):
+        """Write an output tensor into a region; returns bytes written.
+        In-process TPU regions store the device array directly (no D2H)."""
+        with self._lock:
+            region = self._tpu.get(region_name)
+            obj = region.get("region_obj") if region else None
+        if obj is not None:
+            if not (isinstance(arr, np.ndarray) and arr.dtype == np.object_):
+                from client_tpu.utils import triton_to_np_dtype
+
+                want = triton_to_np_dtype(datatype)
+                if want is not None and arr.dtype != np.dtype(want):
+                    arr = arr.astype(want)  # device-side cast, stays resident
+                nbytes = arr.dtype.itemsize * int(np.prod(arr.shape))
+            else:
+                nbytes = len(to_wire_bytes(arr, datatype))
+            if nbytes > max_byte_size:
+                raise InferenceServerException(
+                    f"output needs {nbytes} bytes but region '{region_name}' "
+                    f"mapping holds {max_byte_size}",
+                    status="400",
+                )
+            obj.write_array(offset, arr)
+            return nbytes
+        raw = to_wire_bytes(np.asarray(arr), datatype)
+        if len(raw) > max_byte_size:
+            raise InferenceServerException(
+                f"output needs {len(raw)} bytes but region '{region_name}' "
+                f"mapping holds {max_byte_size}",
+                status="400",
+            )
+        self.write(region_name, offset, raw)
+        return len(raw)
+
+    def read(self, region_name, offset, byte_size):
+        with self._lock:
+            region, base = self._find(region_name)
+            if region["mmap"] is None:
+                raise InferenceServerException(
+                    f"region '{region_name}' has no host mapping", status="400"
+                )
+            if offset + byte_size > region["byte_size"]:
+                raise InferenceServerException(
+                    f"read of {byte_size} bytes at offset {offset} overruns "
+                    f"region '{region_name}'",
+                    status="400",
+                )
+            mm = region["mmap"]
+            return bytes(mm[base + offset : base + offset + byte_size])
+
+    def write(self, region_name, offset, data):
+        with self._lock:
+            region, base = self._find(region_name)
+            if region["mmap"] is None:
+                raise InferenceServerException(
+                    f"region '{region_name}' has no host mapping", status="400"
+                )
+            if offset + len(data) > region["byte_size"]:
+                raise InferenceServerException(
+                    f"write of {len(data)} bytes at offset {offset} overruns "
+                    f"region '{region_name}'",
+                    status="400",
+                )
+            mm = region["mmap"]
+            mm[base + offset : base + offset + len(data)] = data
+
+    def close(self):
+        self.unregister_system()
+        self.unregister_tpu()
+
+
+def _attach_posix_shm(key, length):
+    path = "/dev/shm/" + key.lstrip("/")
+    try:
+        fd = os.open(path, os.O_RDWR)
+    except OSError as e:
+        raise InferenceServerException(
+            f"unable to open shared memory region key '{key}': {e}", status="400"
+        ) from e
+    try:
+        return mmap.mmap(fd, length)
+    except ValueError as e:
+        raise InferenceServerException(
+            f"unable to map {length} bytes of region key '{key}': {e}", status="400"
+        ) from e
+    finally:
+        os.close(fd)
+
+
+class InferenceEngine:
+    """Model repository + request execution shared by the HTTP/gRPC frontends."""
+
+    def __init__(self, models=None, strict_model_config=True, max_sequence_idle_s=60.0):
+        self._lock = threading.Lock()
+        self._models = {}
+        self._ready = {}
+        self._stats = {}
+        self.shm = SharedMemoryRegistry()
+        self._sequences = {}
+        self.max_sequence_idle_s = max_sequence_idle_s
+        self.trace_settings = {
+            "trace_file": "",
+            "trace_level": ["OFF"],
+            "trace_rate": "1000",
+            "trace_count": "-1",
+            "log_frequency": "0",
+        }
+        self.log_settings = {
+            "log_file": "",
+            "log_info": True,
+            "log_warning": True,
+            "log_error": True,
+            "log_verbose_level": 0,
+            "log_format": "default",
+        }
+        for model in models or []:
+            self.add_model(model)
+
+    # repository -----------------------------------------------------------
+
+    def add_model(self, model, ready=True):
+        with self._lock:
+            self._models[model.name] = model
+            self._ready[model.name] = ready
+            self._stats.setdefault(model.name, ModelStats())
+
+    def get_model(self, name, version=""):
+        with self._lock:
+            model = self._models.get(name)
+            if model is None or not self._ready.get(name):
+                raise InferenceServerException(
+                    f"Request for unknown model: '{name}' is not found", status="400"
+                )
+            if version and version not in model.versions:
+                raise InferenceServerException(
+                    f"Request for unknown model version: '{name}' version "
+                    f"{version} is not found",
+                    status="400",
+                )
+            return model
+
+    def model_ready(self, name, version=""):
+        with self._lock:
+            model = self._models.get(name)
+            return bool(
+                model
+                and self._ready.get(name)
+                and (not version or version in model.versions)
+            )
+
+    def load_model(self, name, config_override=None, files=None):
+        with self._lock:
+            if name not in self._models:
+                raise InferenceServerException(
+                    f"failed to load '{name}', no such model", status="400"
+                )
+            if files and config_override is None:
+                raise InferenceServerException(
+                    "load with file override requires a config override too",
+                    status="400",
+                )
+            model = self._models[name]
+            model.config_override = config_override
+            model.file_overrides = files or {}
+            self._ready[name] = True
+
+    def unload_model(self, name):
+        with self._lock:
+            if name not in self._models:
+                raise InferenceServerException(
+                    f"failed to unload '{name}', no such model", status="400"
+                )
+            self._ready[name] = False
+
+    def repository_index(self, ready_only=False):
+        with self._lock:
+            index = []
+            for name, model in sorted(self._models.items()):
+                is_ready = self._ready.get(name, False)
+                if ready_only and not is_ready:
+                    continue
+                index.append(
+                    {
+                        "name": name,
+                        "version": model.versions[-1],
+                        "state": "READY" if is_ready else "UNAVAILABLE",
+                        "reason": "",
+                    }
+                )
+            return index
+
+    def statistics(self, name="", version=""):
+        with self._lock:
+            stats = []
+            for n, model in sorted(self._models.items()):
+                if name and n != name:
+                    continue
+                stats.append(
+                    self._stats[n].to_json(n, version or model.versions[-1])
+                )
+            if name and not stats:
+                raise InferenceServerException(
+                    f"Request for unknown model: '{name}' is not found", status="400"
+                )
+            return stats
+
+    # execution ------------------------------------------------------------
+
+    def execute(self, model_name, model_version, request, binary_section):
+        """Run one inference request.
+
+        *request* is the JSON-form header dict; *binary_section* the raw bytes
+        after the header. Returns (response_dict, binary_blobs) — for decoupled
+        models, a list of such tuples.
+        """
+        model = self.get_model(model_name, model_version)
+        stats = self._stats[model_name]
+        t0 = time.monotonic_ns()
+        try:
+            t_in0 = time.monotonic_ns()
+            inputs = self._gather_inputs(model, request, binary_section)
+            params = request.get("parameters", {}) or {}
+            context = self._sequence_context(params)
+            t_in1 = time.monotonic_ns()
+            result = model.fn(inputs, params, context)
+            if model.decoupled:
+                responses = []
+                for partial in result:
+                    responses.append(
+                        self._render_response(model, model_version, request, partial)
+                    )
+                # One request = one statistics entry regardless of response count.
+                t1 = time.monotonic_ns()
+                stats.record(True, t1 - t0, t1 - t_in1, t_in1 - t_in0, 0)
+                return responses
+            t_inf1 = time.monotonic_ns()
+            rendered = self._render_response(model, model_version, request, result)
+            t1 = time.monotonic_ns()
+            stats.record(
+                True, t1 - t0, t_inf1 - t_in1, t_in1 - t_in0, t1 - t_inf1,
+                batch=_batch_of(model, request),
+            )
+            return rendered
+        except InferenceServerException:
+            stats.record(False, time.monotonic_ns() - t0, 0, 0, 0)
+            raise
+        except Exception as e:
+            stats.record(False, time.monotonic_ns() - t0, 0, 0, 0)
+            raise InferenceServerException(
+                f"{model_name}: execution failed: {e}", status="500", debug_details=e
+            ) from e
+
+    def _sequence_context(self, params):
+        seq_id = params.get("sequence_id", 0)
+        if not seq_id:
+            return None
+        now = time.monotonic()
+        with self._lock:
+            # Expire sequences idle past the advertised
+            # max_sequence_idle_microseconds so abandoned sequences (client
+            # crashed before sequence_end) don't leak state forever.
+            expired = [
+                sid
+                for sid, ctx in self._sequences.items()
+                if now - ctx.last_used > self.max_sequence_idle_s
+            ]
+            for sid in expired:
+                del self._sequences[sid]
+            if params.get("sequence_start") or seq_id not in self._sequences:
+                self._sequences[seq_id] = SequenceContext(seq_id)
+            ctx = self._sequences[seq_id]
+            ctx.last_used = now
+            if params.get("sequence_end"):
+                self._sequences.pop(seq_id, None)
+            return ctx
+
+    def _gather_inputs(self, model, request, binary_section):
+        specs = {t.name: t for t in model.inputs}
+        arrays = {}
+        offset = 0
+        for entry in request.get("inputs", []):
+            name = entry["name"]
+            spec = specs.get(name)
+            if spec is None:
+                raise InferenceServerException(
+                    f"unexpected inference input '{name}' for model "
+                    f"'{model.name}'",
+                    status="400",
+                )
+            shape = entry["shape"]
+            datatype = entry["datatype"]
+            if spec.datatype != datatype:
+                raise InferenceServerException(
+                    f"inference input '{name}' data-type is '{datatype}', but "
+                    f"model expects '{spec.datatype}'",
+                    status="400",
+                )
+            params = entry.get("parameters", {}) or {}
+            if "shared_memory_region" in params:
+                arrays[name] = self.shm.read_tensor(
+                    params["shared_memory_region"],
+                    params.get("shared_memory_offset", 0),
+                    params["shared_memory_byte_size"],
+                    datatype,
+                    shape,
+                )
+            elif "binary_data_size" in params:
+                size = params["binary_data_size"]
+                raw = binary_section[offset : offset + size]
+                if len(raw) != size:
+                    raise InferenceServerException(
+                        f"input '{name}' binary section underrun", status="400"
+                    )
+                offset += size
+                arrays[name] = from_wire_bytes(raw, datatype, shape)
+            elif "data" in entry:
+                arrays[name] = _np_from_json_data(entry["data"], datatype, shape)
+            else:
+                raise InferenceServerException(
+                    f"input '{name}' has no data", status="400"
+                )
+        missing = [
+            t.name for t in model.inputs if t.name not in arrays and not t.optional
+        ]
+        if missing:
+            raise InferenceServerException(
+                f"expected {len(model.inputs)} inputs but got "
+                f"{len(arrays)} inputs for model '{model.name}' "
+                f"(missing {missing})",
+                status="400",
+            )
+        return arrays
+
+    def _render_response(self, model, model_version, request, result_arrays):
+        requested = request.get("outputs")
+        req_params = request.get("parameters", {}) or {}
+        specs = {t.name: t for t in model.outputs}
+        if requested:
+            selection = [(o["name"], o.get("parameters", {}) or {}) for o in requested]
+        else:
+            default_binary = bool(req_params.get("binary_data_output"))
+            selection = [
+                (t.name, {"binary_data": default_binary}) for t in model.outputs
+            ]
+
+        outputs_json = []
+        blobs = []
+        for name, params in selection:
+            if name not in result_arrays:
+                raise InferenceServerException(
+                    f"unexpected inference output '{name}' for model "
+                    f"'{model.name}'",
+                    status="400",
+                )
+            # keep the model's output device-resident until the disposition is
+            # known — the TPU-shm path never needs a D2H transfer; outputs
+            # without array protocol (lists, scalars) normalize host-side
+            arr = result_arrays[name]
+            if not hasattr(arr, "dtype"):
+                arr = np.asarray(arr)
+            spec = specs.get(name)
+            datatype = (
+                spec.datatype if spec is not None else _np_dtype_to_wire(arr)
+            )
+            class_count = params.get("classification", 0)
+            if class_count:
+                arr = _classify(
+                    np.asarray(arr), class_count, spec.labels if spec else []
+                )
+                datatype = "BYTES"
+            entry = {
+                "name": name,
+                "datatype": datatype,
+                "shape": list(arr.shape),
+            }
+            if "shared_memory_region" in params:
+                written = self.shm.write_tensor(
+                    params["shared_memory_region"],
+                    params.get("shared_memory_offset", 0),
+                    arr,
+                    datatype,
+                    params["shared_memory_byte_size"],
+                )
+                entry["parameters"] = {
+                    "shared_memory_region": params["shared_memory_region"],
+                    "shared_memory_byte_size": written,
+                }
+            elif params.get("binary_data", False):
+                raw = to_wire_bytes(np.asarray(arr), datatype)
+                entry["parameters"] = {"binary_data_size": len(raw)}
+                blobs.append(raw)
+            else:
+                host = np.asarray(arr)
+                if datatype == "BYTES":
+                    entry["data"] = [
+                        v.decode("utf-8", errors="replace")
+                        if isinstance(v, bytes)
+                        else str(v)
+                        for v in host.flatten()
+                    ]
+                else:
+                    entry["data"] = [v.item() for v in host.flatten()]
+            outputs_json.append(entry)
+
+        response = {
+            "model_name": model.name,
+            "model_version": model_version or model.versions[-1],
+            "outputs": outputs_json,
+        }
+        if request.get("id"):
+            response["id"] = request["id"]
+        return response, blobs
+
+    def close(self):
+        self.shm.close()
+
+
+def _np_dtype_to_wire(arr):
+    from client_tpu.utils import np_to_triton_dtype
+
+    dt = np_to_triton_dtype(arr.dtype)
+    if dt is None:
+        raise InferenceServerException(
+            f"model returned unsupported dtype {arr.dtype}", status="500"
+        )
+    return dt
+
+
+def _batch_of(model, request):
+    if model.max_batch_size <= 0:
+        return 1
+    inputs = request.get("inputs", [])
+    if inputs and inputs[0].get("shape"):
+        return int(inputs[0]["shape"][0])
+    return 1
+
+
+def _classify(arr, class_count, labels):
+    """Classification extension: top-N "score:index[:label]" BYTES strings."""
+    def topk_strings(vec):
+        k = min(class_count, vec.size)
+        idx = np.argsort(vec)[::-1][:k]
+        out = []
+        for i in idx:
+            s = f"{float(vec[i]):f}:{int(i)}"
+            if labels and int(i) < len(labels):
+                s += f":{labels[int(i)]}"
+            out.append(s.encode("utf-8"))
+        return out
+
+    if arr.ndim <= 1:
+        return np.array(topk_strings(np.atleast_1d(arr)), dtype=np.object_)
+    flat = arr.reshape(arr.shape[0], -1)
+    rows = [topk_strings(row) for row in flat]
+    return np.array(rows, dtype=np.object_)

@@ -1,0 +1,42 @@
+"""JAX/TPU model zoo for the in-process server (flagship models).
+
+``model_sets("builtin,jax,language")`` is the single set-name resolver used
+by the serve and perf CLIs; ``jax_models()`` is the vision set used by
+bench.py, ``language_models()`` the tokenizer→streaming-LM stack of BASELINE
+config 5.
+"""
+
+from client_tpu.utils import InferenceServerException
+
+
+def jax_models():
+    from client_tpu.serve.models.vision import cnn_classifier_model
+    return [cnn_classifier_model()]
+
+
+def language_models():
+    from client_tpu.serve.models.language import language_models as _lm
+    return _lm()
+
+
+def model_sets(names):
+    """Resolve a comma-separated set list (builtin,jax,language) to models."""
+    from client_tpu.serve.builtins import default_models
+
+    loaders = {
+        "builtin": default_models,
+        "jax": jax_models,
+        "language": language_models,
+    }
+    models = []
+    for name in names.split(","):
+        name = name.strip()
+        if not name:
+            continue
+        if name not in loaders:
+            raise InferenceServerException(
+                f"unknown model set '{name}' (available: "
+                f"{', '.join(sorted(loaders))})"
+            )
+        models.extend(loaders[name]())
+    return models

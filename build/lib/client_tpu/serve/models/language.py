@@ -1,0 +1,185 @@
+"""Language-model serving stack: tokenizer + streaming decoder LM.
+
+The BASELINE.md config-5 shape ("Llama-3 ensemble: tokenizer → LLM streaming
+infer"): a byte-level tokenizer model, a decoupled LM that streams one
+response per generated token (the KServe decoupled/LLM pattern the reference
+exercises via Triton's repeat/decoupled models), and an end-to-end text
+ensemble that chains them server-side.
+
+The LM is the flagship transformer (models/transformer.py) at a small
+byte-vocab configuration so it runs hermetically; swap ``TransformerConfig``
+for a full-size model on real deployments.  Token streaming maps one yielded
+dict to one decoupled KServe response, which the gRPC frontend delivers over
+ModelStreamInfer.
+"""
+
+import numpy as np
+
+import jax
+
+from client_tpu.serve.model_runtime import Model, TensorSpec
+from client_tpu.serve.models import transformer as tfm
+
+# byte-level vocab: 256 bytes + BOS + EOS
+_BOS = 256
+_EOS = 257
+_VOCAB = 258
+
+
+def encode_text(text):
+    """Byte-level tokenize: BOS + utf-8 bytes."""
+    if isinstance(text, str):
+        text = text.encode("utf-8")
+    return np.array([_BOS] + list(text), dtype=np.int32)
+
+
+def decode_tokens(tokens):
+    """Tokens -> utf-8 text (BOS/EOS stripped, lone surrogates replaced)."""
+    return bytes(t for t in tokens if 0 <= t < 256).decode(
+        "utf-8", errors="replace"
+    )
+
+
+def tokenizer_model(name="tokenizer"):
+    """BYTES text -> INT32 token ids (ragged rows padded with EOS)."""
+
+    def fn(inputs, params, ctx):
+        texts = np.atleast_1d(inputs["TEXT"]).reshape(-1)
+        rows = [encode_text(t) for t in texts]
+        width = max(len(r) for r in rows)
+        out = np.full((len(rows), width), _EOS, dtype=np.int32)
+        for i, r in enumerate(rows):
+            out[i, : len(r)] = r
+        lengths = np.array([len(r) for r in rows], dtype=np.int32)
+        return {"TOKENS": out, "LENGTHS": lengths}
+
+    return Model(
+        name,
+        inputs=[TensorSpec("TEXT", "BYTES", [-1])],
+        outputs=[
+            TensorSpec("TOKENS", "INT32", [-1, -1]),
+            TensorSpec("LENGTHS", "INT32", [-1]),
+        ],
+        fn=fn,
+        platform="python",
+    )
+
+
+def detokenizer_model(name="detokenizer"):
+    """INT32 token ids -> BYTES text."""
+
+    def fn(inputs, params, ctx):
+        tokens = np.atleast_2d(inputs["TOKENS"])
+        texts = [decode_tokens(row).encode("utf-8") for row in tokens]
+        return {"TEXT": np.array(texts, dtype=np.object_)}
+
+    return Model(
+        name,
+        inputs=[TensorSpec("TOKENS", "INT32", [-1, -1])],
+        outputs=[TensorSpec("TEXT", "BYTES", [-1])],
+        fn=fn,
+        platform="python",
+    )
+
+
+class _LmRunner:
+    """Owns the transformer params + jitted decode programs."""
+
+    def __init__(self, cfg=None, seed=0):
+        self.cfg = cfg or tfm.TransformerConfig(
+            vocab_size=_VOCAB,
+            d_model=256,
+            n_layers=4,
+            n_heads=8,
+            n_kv_heads=4,
+            d_ff=768,
+            max_seq=512,
+        )
+        self.params = tfm.init_params(jax.random.PRNGKey(seed), self.cfg)
+
+    def stream(self, tokens, max_tokens, temperature=0.0, seed=0):
+        key = jax.random.PRNGKey(seed) if temperature > 0 else None
+        for tok in tfm.generate(
+            self.params, self.cfg, tokens, max_tokens,
+            temperature=temperature, key=key,
+        ):
+            yield tok
+            if tok == _EOS:
+                return
+
+
+def lm_streaming_model(name="lm_streaming", runner=None):
+    """Decoupled LM: one KServe response per generated token.
+
+    Inputs: TOKENS (prompt ids), MAX_TOKENS; optional request parameters
+    ``temperature`` and ``seed``.  Each response carries the token id and its
+    decoded text piece — the Triton LLM-streaming response shape.
+    """
+    runner = runner or _LmRunner()
+
+    def fn(inputs, params, ctx):
+        tokens = np.asarray(inputs["TOKENS"]).reshape(-1).astype(np.int32)
+        max_tokens = int(np.asarray(inputs["MAX_TOKENS"]).flatten()[0])
+        temperature = float(params.get("temperature", 0.0) or 0.0)
+        seed = int(params.get("seed", 0) or 0)
+        for tok in runner.stream(tokens, max_tokens, temperature, seed):
+            piece = decode_tokens([tok]).encode("utf-8")
+            yield {
+                "TOKEN": np.array([tok], dtype=np.int32),
+                "TEXT": np.array([piece], dtype=np.object_),
+            }
+
+    return Model(
+        name,
+        inputs=[
+            TensorSpec("TOKENS", "INT32", [-1]),
+            TensorSpec("MAX_TOKENS", "INT32", [1]),
+        ],
+        outputs=[
+            TensorSpec("TOKEN", "INT32", [1]),
+            TensorSpec("TEXT", "BYTES", [1]),
+        ],
+        fn=fn,
+        decoupled=True,
+    )
+
+
+def text_ensemble_model(name="text_generator", runner=None):
+    """End-to-end ensemble: BYTES prompt -> streamed BYTES pieces.
+
+    Chains tokenizer -> LM server-side, the ensemble pattern of BASELINE
+    config 5 (client sends text, receives a token stream)."""
+    runner = runner or _LmRunner()
+
+    def fn(inputs, params, ctx):
+        text = np.asarray(inputs["PROMPT"]).reshape(-1)[0]
+        max_tokens = int(np.asarray(inputs["MAX_TOKENS"]).flatten()[0])
+        temperature = float(params.get("temperature", 0.0) or 0.0)
+        seed = int(params.get("seed", 0) or 0)
+        tokens = encode_text(text)
+        for tok in runner.stream(tokens, max_tokens, temperature, seed):
+            piece = decode_tokens([tok]).encode("utf-8")
+            yield {"TEXT": np.array([piece], dtype=np.object_)}
+
+    return Model(
+        name,
+        inputs=[
+            TensorSpec("PROMPT", "BYTES", [1]),
+            TensorSpec("MAX_TOKENS", "INT32", [1]),
+        ],
+        outputs=[TensorSpec("TEXT", "BYTES", [1])],
+        fn=fn,
+        platform="ensemble",
+        decoupled=True,
+    )
+
+
+def language_models(shared_runner=True):
+    """The full language set; one shared LM runner keeps params/compile warm."""
+    runner = _LmRunner() if shared_runner else None
+    return [
+        tokenizer_model(),
+        detokenizer_model(),
+        lm_streaming_model(runner=runner),
+        text_ensemble_model(runner=runner),
+    ]

@@ -1,0 +1,316 @@
+"""Decoder-only transformer LM — the framework's flagship served model.
+
+This is the server-side model behind BASELINE.md config 5 (tokenizer→LLM
+streaming inference with decoupled token-by-token responses) and the model
+`__graft_entry__.py` exposes to the driver.  Llama-style architecture:
+RMSNorm, rotary embeddings, grouped-query attention, SwiGLU MLP, untied LM
+head.  Pure functional JAX:
+
+- ``init_params(key, cfg)`` → pytree matching ``client_tpu.parallel.param_specs``
+- ``forward(params, tokens, cfg)`` — full-sequence logits (training/prefill);
+  ``attn_impl="ring"`` switches the attention to sequence-parallel ring
+  attention over the mesh's ``sp`` axis for long-context sharding
+- ``prefill`` / ``decode_step`` — KV-cache incremental decoding for the
+  streaming serving path (static cache shape so every step hits the same
+  compiled program)
+- ``make_train_step(cfg, mesh)`` — jitted dp/tp/sp-sharded Adam training step
+  (the multi-chip path the driver dry-runs)
+
+TPU-first notes: weights and attention/MLP compute are bfloat16 on the MXU
+with float32 softmax/norm/loss accumulations; shapes are static everywhere;
+the decode loop is a fixed-shape program with `lax.dynamic_update_slice` cache
+writes; sharding is annotation-only (GSPMD inserts the collectives).
+"""
+
+import dataclasses
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from client_tpu.parallel.ring_attention import (
+    plain_attention,
+    ring_attention_sharded,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    n_kv_heads: int = 4
+    d_ff: int = 1536
+    max_seq: int = 1024
+    rope_theta: float = 10000.0
+    dtype: str = "bfloat16"
+
+    @property
+    def head_dim(self):
+        return self.d_model // self.n_heads
+
+    @property
+    def jdtype(self):
+        return jnp.dtype(self.dtype)
+
+
+def init_params(key, cfg):
+    """Initialize a params pytree (layout documented in parallel.param_specs)."""
+    dt = cfg.jdtype
+    n_keys = 3 + cfg.n_layers * 7
+    keys = iter(jax.random.split(key, n_keys))
+
+    def dense(shape, fan_in):
+        return jax.random.normal(next(keys), shape, dt) * float(fan_in ** -0.5)
+
+    hd = cfg.head_dim
+    layers = []
+    for _ in range(cfg.n_layers):
+        layers.append(
+            {
+                "attn": {
+                    "wq": dense((cfg.d_model, cfg.n_heads * hd), cfg.d_model),
+                    "wk": dense((cfg.d_model, cfg.n_kv_heads * hd), cfg.d_model),
+                    "wv": dense((cfg.d_model, cfg.n_kv_heads * hd), cfg.d_model),
+                    "wo": dense((cfg.n_heads * hd, cfg.d_model), cfg.n_heads * hd),
+                },
+                "mlp": {
+                    "w_gate": dense((cfg.d_model, cfg.d_ff), cfg.d_model),
+                    "w_up": dense((cfg.d_model, cfg.d_ff), cfg.d_model),
+                    "w_down": dense((cfg.d_ff, cfg.d_model), cfg.d_ff),
+                },
+                "ln_attn": jnp.ones((cfg.d_model,), dt),
+                "ln_mlp": jnp.ones((cfg.d_model,), dt),
+            }
+        )
+    return {
+        "embed": dense((cfg.vocab_size, cfg.d_model), cfg.d_model),
+        "layers": layers,
+        "ln_f": jnp.ones((cfg.d_model,), dt),
+        "lm_head": dense((cfg.d_model, cfg.vocab_size), cfg.d_model),
+    }
+
+
+def _rms_norm(x, scale, eps=1e-5):
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    return (x32 * lax.rsqrt(var + eps)).astype(x.dtype) * scale
+
+
+def _rope(x, positions, theta):
+    # x: [B,T,H,D]; positions: [B,T] or [T]
+    d = x.shape[-1]
+    half = d // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    if positions.ndim == 1:
+        positions = positions[None, :]
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B,T,half]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _repeat_kv(x, n_rep):
+    if n_rep == 1:
+        return x
+    b, t, h, d = x.shape
+    return jnp.repeat(x, n_rep, axis=2)
+
+
+def _attention_block(layer, x, cfg, positions, mesh, attn_impl):
+    """Full-sequence causal self-attention sublayer; returns (x, (k, v)) so
+    prefill can capture the per-layer KV blocks for the cache."""
+    b, t, _ = x.shape
+    hd = cfg.head_dim
+    h = _rms_norm(x, layer["ln_attn"])
+    q = (h @ layer["attn"]["wq"]).reshape(b, t, cfg.n_heads, hd)
+    k = (h @ layer["attn"]["wk"]).reshape(b, t, cfg.n_kv_heads, hd)
+    v = (h @ layer["attn"]["wv"]).reshape(b, t, cfg.n_kv_heads, hd)
+    q = _rope(q, positions, cfg.rope_theta)
+    k = _rope(k, positions, cfg.rope_theta)
+    n_rep = cfg.n_heads // cfg.n_kv_heads
+
+    if attn_impl == "ring":
+        attn = ring_attention_sharded(
+            q, _repeat_kv(k, n_rep), _repeat_kv(v, n_rep), mesh
+        )
+    else:
+        attn = plain_attention(q, _repeat_kv(k, n_rep), _repeat_kv(v, n_rep))
+
+    out = attn.reshape(b, t, cfg.n_heads * hd) @ layer["attn"]["wo"]
+    return x + out, (k, v)
+
+
+def _mlp_block(layer, x):
+    h = _rms_norm(x, layer["ln_mlp"])
+    gate = jax.nn.silu(h @ layer["mlp"]["w_gate"])
+    up = h @ layer["mlp"]["w_up"]
+    return x + (gate * up) @ layer["mlp"]["w_down"]
+
+
+def forward(params, tokens, cfg, mesh=None, attn_impl="plain"):
+    """Full-sequence causal LM: tokens [B,T] int32 → logits [B,T,V] f32."""
+    b, t = tokens.shape
+    x = jnp.take(params["embed"], tokens, axis=0)
+    if mesh is not None:
+        x = lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P("dp", "sp", None))
+        )
+    positions = jnp.arange(t)
+    for layer in params["layers"]:
+        x, _ = _attention_block(layer, x, cfg, positions, mesh, attn_impl)
+        x = _mlp_block(layer, x)
+    x = _rms_norm(x, params["ln_f"])
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    if mesh is not None:
+        logits = lax.with_sharding_constraint(
+            logits, NamedSharding(mesh, P("dp", "sp", "tp"))
+        )
+    return logits
+
+
+def init_cache(cfg, batch):
+    """Static-shape KV cache: per layer k/v [B, max_seq, n_kv, head_dim]."""
+    shape = (batch, cfg.max_seq, cfg.n_kv_heads, cfg.head_dim)
+    return {
+        "k": [jnp.zeros(shape, cfg.jdtype) for _ in range(cfg.n_layers)],
+        "v": [jnp.zeros(shape, cfg.jdtype) for _ in range(cfg.n_layers)],
+        "len": jnp.zeros((batch,), jnp.int32),
+    }
+
+
+def prefill(params, tokens, cfg, cache):
+    """Run the prompt through the model, filling the cache from position 0.
+
+    Returns (last-token logits [B,V], cache).
+    """
+    b, t = tokens.shape
+    x = jnp.take(params["embed"], tokens, axis=0)
+    positions = jnp.arange(t)
+    for i, layer in enumerate(params["layers"]):
+        x, (k, v) = _attention_block(layer, x, cfg, positions, None, "plain")
+        cache["k"][i] = lax.dynamic_update_slice(
+            cache["k"][i], k, (0, 0, 0, 0)
+        )
+        cache["v"][i] = lax.dynamic_update_slice(
+            cache["v"][i], v, (0, 0, 0, 0)
+        )
+        x = _mlp_block(layer, x)
+    x = _rms_norm(x, params["ln_f"])
+    logits = (x[:, -1] @ params["lm_head"]).astype(jnp.float32)
+    cache["len"] = jnp.full((b,), t, jnp.int32)
+    return logits, cache
+
+
+def decode_step(params, token, cfg, cache):
+    """One incremental decode step: token [B] int32 → (logits [B,V], cache)."""
+    b = token.shape[0]
+    x = jnp.take(params["embed"], token, axis=0)[:, None, :]  # [B,1,D]
+    pos = cache["len"]  # [B]
+    for i, layer in enumerate(params["layers"]):
+        hd = cfg.head_dim
+        h = _rms_norm(x, layer["ln_attn"])
+        q = (h @ layer["attn"]["wq"]).reshape(b, 1, cfg.n_heads, hd)
+        k = (h @ layer["attn"]["wk"]).reshape(b, 1, cfg.n_kv_heads, hd)
+        v = (h @ layer["attn"]["wv"]).reshape(b, 1, cfg.n_kv_heads, hd)
+        q = _rope(q, pos[:, None], cfg.rope_theta)
+        k = _rope(k, pos[:, None], cfg.rope_theta)
+        # write this step's k/v at position `pos` (same for all batch rows in
+        # the serving path; use per-row dynamic slice via one-hot scatter)
+        # overwrite (not add) the slot at `pos` so a reused cache with stale
+        # rows beyond the prompt can't corrupt this step's K/V
+        slot = (jnp.arange(cfg.max_seq)[None, :] == pos[:, None])[:, :, None, None]
+        cache["k"][i] = jnp.where(slot, k, cache["k"][i])
+        cache["v"][i] = jnp.where(slot, v, cache["v"][i])
+        # attention against the full static-shape cache, length-masked
+        n_rep = cfg.n_heads // cfg.n_kv_heads
+        kk = _repeat_kv(cache["k"][i], n_rep)
+        vv = _repeat_kv(cache["v"][i], n_rep)
+        s = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, kk, preferred_element_type=jnp.float32
+        ) * (hd ** -0.5)
+        valid = jnp.arange(cfg.max_seq)[None, :] <= pos[:, None]
+        s = jnp.where(valid[:, None, None, :], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", p.astype(vv.dtype), vv)
+        out = attn.reshape(b, 1, cfg.n_heads * hd) @ layer["attn"]["wo"]
+        x = x + out.astype(x.dtype)
+        x = _mlp_block(layer, x)
+    x = _rms_norm(x, params["ln_f"])
+    logits = (x[:, 0] @ params["lm_head"]).astype(jnp.float32)
+    cache["len"] = pos + 1
+    return logits, cache
+
+
+def loss_fn(params, tokens, cfg, mesh=None, attn_impl="plain"):
+    """Next-token cross-entropy over tokens [B,T]."""
+    logits = forward(params, tokens[:, :-1], cfg, mesh, attn_impl)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+    return jnp.mean(nll)
+
+
+def make_train_step(cfg, mesh=None, attn_impl="plain", learning_rate=1e-3):
+    """Jitted Adam train step.  With a mesh, callers should device_put params
+    per ``parallel.param_specs`` and the batch per ``parallel.batch_spec``;
+    GSPMD propagates those shardings through grads and optimizer state."""
+    import optax
+
+    opt = optax.adam(learning_rate)
+
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(
+            params, tokens, cfg, mesh, attn_impl
+        )
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return opt, jax.jit(step, donate_argnums=(0, 1))
+
+
+@functools.lru_cache(maxsize=8)
+def _jitted_steps(cfg):
+    """Per-config jitted prefill/decode (cfg is a frozen dataclass, hashable);
+    caching here keeps repeated generate() calls on the same compiled programs."""
+    return (
+        jax.jit(functools.partial(prefill, cfg=cfg)),
+        jax.jit(functools.partial(decode_step, cfg=cfg)),
+    )
+
+
+def generate(params, cfg, prompt, max_new_tokens, temperature=0.0, key=None):
+    """Greedy/sampled generation; yields one int token id at a time.
+
+    Python-level loop over jitted prefill/decode steps — each yield maps to
+    one decoupled KServe response in the streaming serving path.  Generation
+    stops early if the KV cache fills (prompt_len + new tokens > cfg.max_seq).
+    """
+    prompt = jnp.asarray(prompt, jnp.int32)
+    if prompt.ndim == 1:
+        prompt = prompt[None, :]
+    if temperature > 0.0 and key is None:
+        key = jax.random.PRNGKey(0)
+    # the cache slot for step i's token is prompt_len + i; the last usable
+    # slot is max_seq - 1
+    max_new_tokens = min(max_new_tokens, cfg.max_seq - prompt.shape[1])
+    cache = init_cache(cfg, prompt.shape[0])
+    prefill_fn, decode_fn = _jitted_steps(cfg)
+    logits, cache = prefill_fn(params, prompt, cache=cache)
+    for i in range(max_new_tokens):
+        if temperature > 0.0:
+            key, sub = jax.random.split(key)
+            token = jax.random.categorical(sub, logits / temperature, axis=-1)
+        else:
+            token = jnp.argmax(logits, axis=-1)
+        token = token.astype(jnp.int32)
+        yield int(np.asarray(token)[0])
+        logits, cache = decode_fn(params, token, cache=cache)

@@ -1,0 +1,93 @@
+"""Vision models for the in-process TPU server.
+
+TPU-first design notes: forward passes are jitted once with static shapes so
+XLA tiles the convolutions onto the MXU; parameters live on device in bfloat16
+(compute) with float32 I/O at the protocol boundary. The CNN here is the
+hermetic stand-in for the reference's densenet_onnx / inception example models
+(BASELINE.md configs 1-2) — same tensor interface (NCHW image in, class scores
+out), sized so a single v5e chip turns requests around in sub-millisecond time.
+"""
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from client_tpu.serve.model_runtime import Model, TensorSpec
+
+# ImageNet-ish class count so classification extension demos look real.
+_NUM_CLASSES = 1000
+
+
+def _conv(x, w, stride=1):
+    return jax.lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=(stride, stride),
+        padding="SAME",
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    )
+
+
+def _init_cnn_params(key, channels=(32, 64, 128, 256), in_ch=3, num_classes=_NUM_CLASSES):
+    params = {"convs": [], "scales": []}
+    k = key
+    prev = in_ch
+    for ch in channels:
+        k, sub = jax.random.split(k)
+        # python-float scale: numpy scalars are not weak-typed and would
+        # promote the bfloat16 weights to float32
+        params["convs"].append(
+            jax.random.normal(sub, (ch, prev, 3, 3), jnp.bfloat16)
+            * float(2.0 / np.sqrt(prev * 9))
+        )
+        params["scales"].append(jnp.ones((ch, 1, 1), jnp.bfloat16))
+        prev = ch
+    k, sub = jax.random.split(k)
+    params["head"] = jax.random.normal(
+        sub, (prev, num_classes), jnp.bfloat16
+    ) * float(1.0 / np.sqrt(prev))
+    return params
+
+
+def _cnn_forward(params, x):
+    # x: [N, 3, H, W] float32 -> scores [N, num_classes] float32
+    h = x.astype(jnp.bfloat16)
+    for w, s in zip(params["convs"], params["scales"]):
+        h = _conv(h, w, stride=2)
+        h = jax.nn.relu(h) * s
+    h = jnp.mean(h, axis=(2, 3))  # global average pool
+    return (h @ params["head"]).astype(jnp.float32)
+
+
+class CnnClassifier:
+    """Jitted CNN classifier servable; accepts any batch of 224x224 RGB."""
+
+    def __init__(self, image_size=224, seed=0):
+        self.image_size = image_size
+        self.params = _init_cnn_params(jax.random.PRNGKey(seed))
+        self._forward = jax.jit(_cnn_forward)
+
+    def __call__(self, inputs, params, ctx):
+        # jnp.asarray is a no-op for device-resident (TPU-shm) inputs; the
+        # output stays a device array so shm-output responses never force a
+        # D2H sync — the runtime materializes only for wire-tensor responses.
+        x = jnp.asarray(inputs["INPUT0"])
+        return {"OUTPUT0": self._forward(self.params, x)}
+
+
+def cnn_classifier_model(name="cnn_classifier", image_size=224):
+    """Servable Model wrapping CnnClassifier (densenet_onnx stand-in)."""
+    runner = CnnClassifier(image_size)
+    labels = [f"class_{i}" for i in range(_NUM_CLASSES)]
+    return Model(
+        name,
+        inputs=[TensorSpec("INPUT0", "FP32", [-1, 3, image_size, image_size])],
+        outputs=[TensorSpec("OUTPUT0", "FP32", [-1, _NUM_CLASSES], labels=labels)],
+        fn=runner,
+        platform="jax",
+        backend="jax",
+        max_batch_size=32,
+    )

@@ -1,0 +1,252 @@
+"""Datatype mapping and tensor (de)serialization for the KServe-v2 protocol.
+
+Capability parity with the reference ``tritonclient.utils`` package
+(/root/reference/src/python/library/tritonclient/utils/__init__.py:128-345), with
+two TPU-native upgrades:
+
+- ``BF16`` is a first-class numpy dtype here via ``ml_dtypes.bfloat16`` (the dtype
+  jax itself uses), instead of the reference's uint16-word pack/unpack helpers.
+  The word-level helpers are still provided for interop.
+- ``to_numpy``/``from_numpy`` bridges accept ``jax.Array`` so device-resident
+  tensors flow through the clients without intermediate copies where possible.
+"""
+
+import struct
+
+import numpy as np
+
+try:  # ml_dtypes ships with jax; keep the package importable without it.
+    import ml_dtypes
+
+    _BF16_DTYPE = np.dtype(ml_dtypes.bfloat16)
+except ImportError:  # pragma: no cover
+    ml_dtypes = None
+    _BF16_DTYPE = None
+
+__all__ = [
+    "InferenceServerException",
+    "raise_error",
+    "np_to_triton_dtype",
+    "triton_to_np_dtype",
+    "serialize_byte_tensor",
+    "deserialize_bytes_tensor",
+    "serialize_bf16_tensor",
+    "deserialize_bf16_tensor",
+    "serialized_byte_size",
+]
+
+
+class InferenceServerException(Exception):
+    """Error raised for any server-reported or client-side protocol failure.
+
+    Parity: tritonclient.utils.InferenceServerException
+    (reference utils/__init__.py:66-126).
+    """
+
+    def __init__(self, msg, status=None, debug_details=None):
+        self._msg = msg
+        self._status = status
+        self._debug_details = debug_details
+        super().__init__(msg)
+
+    def __str__(self):
+        msg = self._msg if self._msg is not None else "Unknown error"
+        if self._status is not None:
+            msg = f"[{self._status}] {msg}"
+        return msg
+
+    def message(self):
+        """The error message string."""
+        return self._msg
+
+    def status(self):
+        """Protocol-specific status code (HTTP status / gRPC StatusCode name)."""
+        return self._status
+
+    def debug_details(self):
+        """Any low-level exception or payload that accompanied the failure."""
+        return self._debug_details
+
+
+def raise_error(msg):
+    """Raise an InferenceServerException with *msg* and no status."""
+    raise InferenceServerException(msg=msg)
+
+
+# KServe-v2 datatype string <-> numpy dtype tables. The wire names are the
+# protocol spec (reference utils/__init__.py:128-187 is the same table).
+_NP_TO_TRITON = {
+    np.dtype(np.bool_): "BOOL",
+    np.dtype(np.int8): "INT8",
+    np.dtype(np.int16): "INT16",
+    np.dtype(np.int32): "INT32",
+    np.dtype(np.int64): "INT64",
+    np.dtype(np.uint8): "UINT8",
+    np.dtype(np.uint16): "UINT16",
+    np.dtype(np.uint32): "UINT32",
+    np.dtype(np.uint64): "UINT64",
+    np.dtype(np.float16): "FP16",
+    np.dtype(np.float32): "FP32",
+    np.dtype(np.float64): "FP64",
+}
+if _BF16_DTYPE is not None:
+    _NP_TO_TRITON[_BF16_DTYPE] = "BF16"
+
+_TRITON_TO_NP = {v: k for k, v in _NP_TO_TRITON.items()}
+_TRITON_TO_NP["BYTES"] = np.dtype(np.object_)
+
+# sizeof on the wire for fixed-width types (BYTES is length-prefixed, see below)
+_TRITON_ELEMENT_SIZE = {
+    "BOOL": 1,
+    "INT8": 1,
+    "INT16": 2,
+    "INT32": 4,
+    "INT64": 8,
+    "UINT8": 1,
+    "UINT16": 2,
+    "UINT32": 4,
+    "UINT64": 8,
+    "FP16": 2,
+    "BF16": 2,
+    "FP32": 4,
+    "FP64": 8,
+}
+
+
+def np_to_triton_dtype(np_dtype):
+    """Map a numpy (or ml_dtypes / jax) dtype to its KServe datatype string."""
+    dt = np.dtype(np_dtype)
+    if dt in _NP_TO_TRITON:
+        return _NP_TO_TRITON[dt]
+    if dt.kind in ("O", "S", "U"):
+        return "BYTES"
+    return None
+
+
+def triton_to_np_dtype(dtype):
+    """Map a KServe datatype string to a numpy dtype (BF16 -> ml_dtypes.bfloat16)."""
+    if dtype == "BF16":
+        if _BF16_DTYPE is None:
+            raise_error("BF16 requires the ml_dtypes package")
+        return _BF16_DTYPE
+    return _TRITON_TO_NP.get(dtype, None)
+
+
+def triton_dtype_element_size(dtype):
+    """Bytes per element on the wire for fixed-width datatypes; None for BYTES."""
+    return _TRITON_ELEMENT_SIZE.get(dtype, None)
+
+
+def _iter_elements_as_bytes(input_tensor):
+    flat = np.ascontiguousarray(input_tensor).flatten()
+    for obj in flat:
+        if isinstance(obj, (bytes, bytearray)):  # covers np.bytes_ (a bytes subclass)
+            yield bytes(obj)
+        else:
+            yield str(obj).encode("utf-8")
+
+
+def serialize_byte_tensor(input_tensor):
+    """Serialize a BYTES tensor: each element as <u32le length><payload>, row-major.
+
+    Accepts object/bytes/unicode numpy arrays. Returns a 1-D uint8 numpy array
+    whose buffer is the wire payload (parity: reference utils/__init__.py:188-240).
+    """
+    if input_tensor.size == 0:
+        return np.empty([0], dtype=np.object_)
+    if input_tensor.dtype != np.object_ and input_tensor.dtype.type not in (
+        np.bytes_,
+        np.str_,
+    ):
+        raise_error("cannot serialize bytes tensor: invalid datatype")
+    parts = []
+    for b in _iter_elements_as_bytes(input_tensor):
+        parts.append(struct.pack("<I", len(b)))
+        parts.append(b)
+    blob = b"".join(parts)
+    return np.frombuffer(blob, dtype=np.uint8)
+
+
+def deserialize_bytes_tensor(encoded_tensor):
+    """Inverse of serialize_byte_tensor: wire payload -> 1-D np.object_ array of bytes."""
+    strs = []
+    offset = 0
+    view = memoryview(encoded_tensor)
+    n = len(view)
+    while offset < n:
+        if offset + 4 > n:
+            raise_error("malformed BYTES tensor: truncated length prefix")
+        (length,) = struct.unpack_from("<I", view, offset)
+        offset += 4
+        if offset + length > n:
+            raise_error("malformed BYTES tensor: element overruns payload")
+        strs.append(bytes(view[offset : offset + length]))
+        offset += length
+    return np.array(strs, dtype=np.object_)
+
+
+def serialize_bf16_tensor(input_tensor):
+    """Serialize a tensor to BF16 wire bytes.
+
+    Accepts float16/float32/float64 or ml_dtypes.bfloat16 arrays; rounds to
+    bfloat16 and returns a uint8 view of the 2-byte little-endian words
+    (interop parity with reference utils/__init__.py:276-310).
+    """
+    if _BF16_DTYPE is None:
+        raise_error("BF16 requires the ml_dtypes package")
+    arr = np.asarray(input_tensor)
+    if arr.dtype != _BF16_DTYPE:
+        if arr.dtype.kind != "f":
+            raise_error("cannot serialize bf16 tensor: invalid datatype")
+        arr = arr.astype(_BF16_DTYPE)
+    return np.frombuffer(np.ascontiguousarray(arr).tobytes(), dtype=np.uint8)
+
+
+def deserialize_bf16_tensor(encoded_tensor):
+    """Inverse of serialize_bf16_tensor -> 1-D ml_dtypes.bfloat16 array."""
+    if _BF16_DTYPE is None:
+        raise_error("BF16 requires the ml_dtypes package")
+    return np.frombuffer(bytes(encoded_tensor), dtype=_BF16_DTYPE)
+
+
+def serialized_byte_size(np_array):
+    """Wire size in bytes of *np_array* (length-prefixed accounting for BYTES)."""
+    if np_array.dtype == np.object_ or np_array.dtype.type in (np.bytes_, np.str_):
+        total = 0
+        for b in _iter_elements_as_bytes(np_array):
+            total += 4 + len(b)
+        return total
+    return np_array.nbytes
+
+
+def to_wire_bytes(array, datatype):
+    """Array -> contiguous little-endian wire bytes for *datatype*.
+
+    Device arrays (jax.Array) are converted via ``np.asarray`` which uses dlpack /
+    zero-copy paths where the backend allows it.
+    """
+    arr = np.asarray(array)
+    if datatype == "BYTES":
+        return serialize_byte_tensor(arr).tobytes()
+    if datatype == "BF16":
+        return serialize_bf16_tensor(arr).tobytes()
+    expected = triton_to_np_dtype(datatype)
+    if expected is None:
+        raise_error(f"unsupported datatype {datatype}")
+    if arr.dtype != expected:
+        raise_error(
+            f"input array dtype {arr.dtype} does not match datatype {datatype}"
+        )
+    return np.ascontiguousarray(arr).tobytes()
+
+
+def from_wire_bytes(buf, datatype, shape):
+    """Wire bytes -> numpy array of *datatype* reshaped to *shape*."""
+    if datatype == "BYTES":
+        arr = deserialize_bytes_tensor(buf)
+    else:
+        np_dtype = triton_to_np_dtype(datatype)
+        if np_dtype is None:
+            raise_error(f"unsupported datatype {datatype}")
+        arr = np.frombuffer(bytes(buf), dtype=np_dtype)
+    return arr.reshape(shape)

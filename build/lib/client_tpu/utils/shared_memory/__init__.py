@@ -1,0 +1,152 @@
+"""System shared-memory utilities (ctypes over the native libcshm_tpu.so).
+
+API parity with the reference's ``tritonclient.utils.shared_memory``
+(reference src/python/library/tritonclient/utils/shared_memory/__init__.py:
+46-124): create/set/get/destroy POSIX shm regions plus a process-local
+registry of mapped regions.  The native library (src/cpp/shm/cshm.cc) does
+shm_open + mmap and bulk copies; build it with ``make native``.
+"""
+
+import ctypes
+import os
+
+import numpy as np
+
+from client_tpu.utils import (
+    InferenceServerException,
+    serialize_byte_tensor,
+    triton_to_np_dtype,
+)
+
+_LIB_PATH = os.path.join(os.path.dirname(__file__), "libcshm_tpu.so")
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is None:
+        if not os.path.exists(_LIB_PATH):
+            raise InferenceServerException(
+                f"native shared-memory library not built: {_LIB_PATH} "
+                "(run `make native`)"
+            )
+        _lib = ctypes.CDLL(_LIB_PATH)
+        _lib.TpuShmCreate.restype = ctypes.c_void_p
+        _lib.TpuShmCreate.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+        _lib.TpuShmOpen.restype = ctypes.c_void_p
+        _lib.TpuShmOpen.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64]
+        _lib.TpuShmWrite.restype = ctypes.c_int
+        _lib.TpuShmWrite.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p, ctypes.c_uint64,
+        ]
+        _lib.TpuShmRead.restype = ctypes.c_int
+        _lib.TpuShmRead.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p, ctypes.c_uint64,
+        ]
+        _lib.TpuShmBaseAddr.restype = ctypes.c_void_p
+        _lib.TpuShmBaseAddr.argtypes = [ctypes.c_void_p]
+        _lib.TpuShmClose.restype = ctypes.c_int
+        _lib.TpuShmClose.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        _lib.TpuShmLastError.restype = ctypes.c_char_p
+    return _lib
+
+
+def _last_error(lib):
+    msg = lib.TpuShmLastError()
+    return msg.decode("utf-8", errors="replace") if msg else "unknown error"
+
+
+class SharedMemoryRegion:
+    """Handle for one created-or-attached system shm region."""
+
+    def __init__(self, triton_shm_name, shm_key, byte_size, native_handle):
+        self._triton_shm_name = triton_shm_name
+        self._shm_key = shm_key
+        self._byte_size = byte_size
+        self._handle = native_handle
+
+
+# name -> SharedMemoryRegion, mirroring the reference's mapped_shm_regions
+_mapped_regions = {}
+
+
+def create_shared_memory_region(triton_shm_name, shm_key, byte_size, create=True):
+    """Create (or attach to, with create=False) a POSIX shm region."""
+    lib = _load()
+    if create:
+        handle = lib.TpuShmCreate(shm_key.encode(), byte_size)
+    else:
+        handle = lib.TpuShmOpen(shm_key.encode(), byte_size, 0)
+    if not handle:
+        raise InferenceServerException(
+            f"unable to create shared memory region '{shm_key}': "
+            f"{_last_error(lib)}"
+        )
+    region = SharedMemoryRegion(triton_shm_name, shm_key, byte_size, handle)
+    _mapped_regions[triton_shm_name] = region
+    return region
+
+
+def set_shared_memory_region(shm_handle, input_values, offset=0):
+    """Copy a list of numpy arrays into the region back-to-back at offset."""
+    lib = _load()
+    if not isinstance(input_values, (list, tuple)):
+        raise InferenceServerException("input_values must be a list of numpy arrays")
+    cur = offset
+    for arr in input_values:
+        arr = np.asarray(arr)
+        if arr.dtype == np.object_ or arr.dtype.type == np.str_:
+            raw = serialize_byte_tensor(arr).tobytes()
+        else:
+            raw = np.ascontiguousarray(arr).tobytes()
+        ok = lib.TpuShmWrite(shm_handle._handle, cur, raw, len(raw))
+        if ok != 0:
+            raise InferenceServerException(
+                f"unable to set shared memory region "
+                f"'{shm_handle._triton_shm_name}': {_last_error(lib)}"
+            )
+        cur += len(raw)
+
+
+def get_contents_as_numpy(shm_handle, datatype, shape, offset=0):
+    """Read a tensor of (datatype, shape) out of the region.
+
+    ``datatype`` is a numpy dtype or a KServe datatype string.
+    """
+    lib = _load()
+    if isinstance(datatype, str):
+        np_dtype = triton_to_np_dtype(datatype)
+        is_bytes = datatype == "BYTES"
+    else:
+        np_dtype = np.dtype(datatype)
+        is_bytes = np_dtype == np.object_
+    if is_bytes:
+        # read the remainder of the region and deserialize length-prefixed
+        size = shm_handle._byte_size - offset
+        buf = ctypes.create_string_buffer(size)
+        if lib.TpuShmRead(shm_handle._handle, offset, buf, size) != 0:
+            raise InferenceServerException(_last_error(lib))
+        from client_tpu.utils import deserialize_bytes_tensor
+
+        flat = deserialize_bytes_tensor(np.frombuffer(buf.raw, np.uint8))
+        n = int(np.prod(shape)) if len(shape) else 1
+        return flat[:n].reshape(shape)
+    count = int(np.prod(shape)) if len(shape) else 1
+    size = count * np.dtype(np_dtype).itemsize
+    buf = ctypes.create_string_buffer(size)
+    if lib.TpuShmRead(shm_handle._handle, offset, buf, size) != 0:
+        raise InferenceServerException(_last_error(lib))
+    return np.frombuffer(buf.raw, dtype=np_dtype).reshape(shape).copy()
+
+
+def mapped_shared_memory_regions():
+    """Names of regions currently mapped by this process."""
+    return list(_mapped_regions)
+
+
+def destroy_shared_memory_region(shm_handle, unlink=True):
+    """Unmap the region and (by default) unlink its shm key."""
+    lib = _load()
+    _mapped_regions.pop(shm_handle._triton_shm_name, None)
+    if lib.TpuShmClose(shm_handle._handle, 0 if unlink else 1) != 0:
+        raise InferenceServerException(_last_error(lib))

@@ -1,0 +1,243 @@
+"""TPU shared-memory transport: HBM-resident tensor regions.
+
+This is the framework's replacement for the reference's CUDA IPC shared
+memory (reference src/c++/library/ipc.h:28-33 and
+tritonclient/utils/cuda_shared_memory/ — cudaMalloc + cudaIpcGetMemHandle):
+a *device-buffer registry* over JAX/PJRT instead of cudart.
+
+Design (SURVEY.md §5.8). A region is a named handle to tensors resident in
+TPU HBM, held as ``jax.Array`` slots keyed by byte offset:
+
+- **Same-process** (in-process server, the triton_c_api analog): the server
+  resolves the region through a process-local broker and reads/writes the
+  ``jax.Array`` objects directly — true zero-copy, no H2D/D2H per request,
+  and inference dispatch stays asynchronous (requests pipeline on the device
+  queue exactly like back-to-back jitted calls).
+- **Cross-process same-host**: the raw handle carries an optional POSIX
+  shm *staging key*; writes mirror bytes into the staging region so a server
+  in another process can map it (one host copy — the same cost cudaIpc
+  avoids, because PJRT has no cross-process buffer export; this is the
+  documented fallback, not the benchmark path).
+
+The raw handle (the ``cudaIpcMemHandle_t`` analog, base64-safe JSON) is what
+``register_tpu_shared_memory`` sends to the server:
+``{"uuid", "pid", "device_id", "byte_size", "staging_key"?}``.
+
+Reads with ``get_contents_as_numpy`` force a D2H sync; ``get_contents_as_jax``
+returns the live device array without synchronizing.
+"""
+
+import json
+import os
+import threading
+import uuid as _uuid
+
+import numpy as np
+
+from client_tpu.utils import (
+    InferenceServerException,
+    deserialize_bytes_tensor,
+    serialize_byte_tensor,
+    triton_to_np_dtype,
+)
+
+# Process-local broker: uuid -> TpuRegion.  The in-process server resolves
+# raw handles here (the PJRT same-process fast path).
+_broker = {}
+_broker_lock = threading.Lock()
+
+
+def _jax():
+    import jax  # deferred so pure-protocol users never pay jax import cost
+
+    return jax
+
+
+class TpuRegion:
+    """One named HBM region: jax.Array slots keyed by byte offset."""
+
+    def __init__(self, name, byte_size, device_id, staging_key=None):
+        self.name = name
+        self.byte_size = byte_size
+        self.device_id = device_id
+        self.uuid = _uuid.uuid4().hex
+        self.staging_key = staging_key
+        self._slots = {}  # offset -> jax.Array | np.ndarray (BYTES only)
+        self._staging = None
+        self._lock = threading.Lock()
+        if staging_key is not None:
+            from client_tpu.utils import shared_memory as _sysshm
+
+            self._staging = _sysshm.create_shared_memory_region(
+                f"tpu-staging-{self.uuid}", staging_key, byte_size
+            )
+
+    # -- slot access --------------------------------------------------------
+
+    def _device(self):
+        jax = _jax()
+        devs = jax.devices()
+        if self.device_id >= len(devs):
+            raise InferenceServerException(
+                f"TPU device {self.device_id} not present ({len(devs)} devices)"
+            )
+        return devs[self.device_id]
+
+    def write_array(self, offset, arr):
+        """Place a tensor at ``offset``; device_put unless already on device."""
+        jax = _jax()
+        if isinstance(arr, np.ndarray) and arr.dtype == np.object_:
+            raw = serialize_byte_tensor(arr)
+            nbytes = raw.nbytes
+            stored = arr  # BYTES stay host-side; devices hold no string type
+        else:
+            if not isinstance(arr, jax.Array):
+                arr = jax.device_put(np.ascontiguousarray(arr), self._device())
+            nbytes = arr.dtype.itemsize * int(np.prod(arr.shape))
+            stored = arr
+        if offset + nbytes > self.byte_size:
+            raise InferenceServerException(
+                f"write of {nbytes} bytes at offset {offset} overruns TPU "
+                f"region '{self.name}' ({self.byte_size} bytes)"
+            )
+        with self._lock:
+            # drop slots this write overlaps
+            for off, old in list(self._slots.items()):
+                if off < offset + nbytes and offset < off + _slot_nbytes(old):
+                    del self._slots[off]
+            self._slots[offset] = stored
+        if self._staging is not None:
+            from client_tpu.utils import shared_memory as _sysshm
+
+            _sysshm.set_shared_memory_region(self._staging, [np.asarray(stored)],
+                                             offset=offset)
+        return nbytes
+
+    def read_array(self, offset, byte_size, datatype=None, shape=None):
+        """Zero-copy read: the stored array at ``offset`` if compatible,
+        else a numpy reconstruction from raw slot bytes."""
+        with self._lock:
+            a = self._slots.get(offset)
+        if a is None:
+            raise InferenceServerException(
+                f"no tensor at offset {offset} of TPU region '{self.name}'"
+            )
+        if datatype is None:
+            return a
+        if datatype == "BYTES":
+            if isinstance(a, np.ndarray) and a.dtype == np.object_:
+                return a.reshape(shape) if shape is not None else a
+            raise InferenceServerException(
+                f"TPU region '{self.name}' slot at {offset} is not BYTES"
+            )
+        np_dtype = triton_to_np_dtype(datatype)
+        if np_dtype is None:
+            raise InferenceServerException(f"unsupported datatype {datatype}")
+        want = np.dtype(np_dtype)
+        if _slot_nbytes(a) < byte_size:
+            raise InferenceServerException(
+                f"slot at offset {offset} of TPU region '{self.name}' holds "
+                f"{_slot_nbytes(a)} bytes, request needs {byte_size}"
+            )
+        if a.dtype == want and (shape is None or list(a.shape) == list(shape)):
+            return a  # zero-copy
+        # dtype/shape reinterpretation: materialize host-side
+        host = np.asarray(a).tobytes()[:byte_size]
+        out = np.frombuffer(host, dtype=want)
+        return out.reshape(shape) if shape is not None else out
+
+    def destroy(self):
+        with self._lock:
+            self._slots.clear()
+        if self._staging is not None:
+            from client_tpu.utils import shared_memory as _sysshm
+
+            _sysshm.destroy_shared_memory_region(self._staging)
+            self._staging = None
+
+    def raw_handle(self):
+        desc = {
+            "uuid": self.uuid,
+            "pid": os.getpid(),
+            "device_id": self.device_id,
+            "byte_size": self.byte_size,
+        }
+        if self.staging_key is not None:
+            desc["staging_key"] = self.staging_key
+        return json.dumps(desc).encode("utf-8")
+
+
+def _slot_nbytes(a):
+    if isinstance(a, np.ndarray) and a.dtype == np.object_:
+        return serialize_byte_tensor(a).nbytes
+    return a.dtype.itemsize * int(np.prod(a.shape))
+
+
+def resolve_inprocess(descriptor):
+    """Server-side: map a raw-handle descriptor to a live TpuRegion when the
+    client shares this process; None otherwise."""
+    if descriptor.get("pid") != os.getpid():
+        return None
+    with _broker_lock:
+        return _broker.get(descriptor.get("uuid"))
+
+
+# -- public API (parity with cuda_shared_memory/__init__.py:46-120) ---------
+
+
+def create_shared_memory_region(triton_shm_name, byte_size, device_id=0,
+                                staging_key=None):
+    """Allocate a TPU HBM region.  Pass ``staging_key`` to also maintain a
+    host staging mirror for cross-process servers."""
+    region = TpuRegion(triton_shm_name, byte_size, device_id, staging_key)
+    with _broker_lock:
+        _broker[region.uuid] = region
+    return region
+
+
+def get_raw_handle(shm_handle):
+    """Serializable descriptor to pass to register_tpu_shared_memory."""
+    return shm_handle.raw_handle()
+
+
+def set_shared_memory_region(shm_handle, input_values, offset=0):
+    """Copy a list of tensors (numpy or jax.Array) into the region
+    back-to-back starting at ``offset``."""
+    if not isinstance(input_values, (list, tuple)):
+        raise InferenceServerException("input_values must be a list of tensors")
+    cur = offset
+    for arr in input_values:
+        cur += shm_handle.write_array(cur, arr)
+
+
+def get_contents_as_numpy(shm_handle, datatype, shape, offset=0):
+    """Materialize the tensor at ``offset`` host-side (forces D2H sync)."""
+    if isinstance(datatype, str):
+        wire = datatype
+    else:
+        from client_tpu.utils import np_to_triton_dtype
+
+        wire = np_to_triton_dtype(np.dtype(datatype))
+    count = int(np.prod(shape)) if len(shape) else 1
+    if wire == "BYTES":
+        arr = shm_handle.read_array(offset, 0, "BYTES", shape)
+        return arr
+    itemsize = np.dtype(triton_to_np_dtype(wire)).itemsize
+    arr = shm_handle.read_array(offset, count * itemsize, wire, list(shape))
+    return np.asarray(arr)
+
+
+def get_contents_as_jax(shm_handle, offset=0):
+    """The live device array at ``offset`` — no synchronization, no copy."""
+    return shm_handle.read_array(offset, 0)
+
+
+def allocated_shared_memory_regions():
+    with _broker_lock:
+        return [r.name for r in _broker.values()]
+
+
+def destroy_shared_memory_region(shm_handle):
+    with _broker_lock:
+        _broker.pop(shm_handle.uuid, None)
+    shm_handle.destroy()

@@ -1,0 +1,47 @@
+#!/usr/bin/env python
+"""Build the client_tpu wheel with native libraries included.
+
+The reference stages generated pb2 modules and native shm libs into the
+package before calling setup (reference src/python/library/build_wheel.py:
+120-185); here `make protos native` produces them in-tree, then bdist_wheel
+packages everything.  Usage: python build_wheel.py [--dest-dir dist]
+"""
+
+import argparse
+import os
+import subprocess
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--dest-dir", default="dist")
+    parser.add_argument("--skip-native", action="store_true",
+                        help="package without rebuilding native libs")
+    args = parser.parse_args()
+
+    if not args.skip_native:
+        subprocess.check_call(["make", "protos", "native"], cwd=_HERE)
+
+    lib = os.path.join(
+        _HERE, "client_tpu", "utils", "shared_memory", "libcshm_tpu.so"
+    )
+    if not os.path.exists(lib):
+        print(f"error: {lib} missing (run `make native`)", file=sys.stderr)
+        return 1
+
+    subprocess.check_call(
+        [sys.executable, "setup.py", "-q", "bdist_wheel",
+         "--dist-dir", args.dest_dir],
+        cwd=_HERE,
+    )
+    wheels = [f for f in os.listdir(os.path.join(_HERE, args.dest_dir))
+              if f.endswith(".whl")]
+    print(f"built: {args.dest_dir}/{sorted(wheels)[-1]}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

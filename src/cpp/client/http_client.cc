@@ -698,6 +698,56 @@ InferenceServerHttpClient::Infer(
 }
 
 Error
+InferenceServerHttpClient::InferMulti(
+    std::vector<InferResultPtr>* results,
+    const std::vector<InferOptions>& options,
+    const std::vector<std::vector<InferInput*>>& inputs,
+    const std::vector<std::vector<const InferRequestedOutput*>>& outputs)
+{
+  if (options.size() != 1 && options.size() != inputs.size()) {
+    return Error("options count must be 1 or match request count");
+  }
+  if (!outputs.empty() && outputs.size() != 1 &&
+      outputs.size() != inputs.size()) {
+    return Error("outputs count must be 0, 1, or match request count");
+  }
+  results->clear();
+  static const std::vector<const InferRequestedOutput*> kNoOutputs;
+  for (size_t i = 0; i < inputs.size(); i++) {
+    const InferOptions& opt = options.size() == 1 ? options[0] : options[i];
+    const auto& outs = outputs.empty()
+                           ? kNoOutputs
+                           : (outputs.size() == 1 ? outputs[0] : outputs[i]);
+    InferResultPtr result;
+    Error err = Infer(&result, opt, inputs[i], outs);
+    if (!err.IsOk()) return err;
+    results->push_back(result);
+  }
+  return Error::Success();
+}
+
+Error
+InferenceServerHttpClient::AsyncInferMulti(
+    std::function<void(std::vector<InferResultPtr>, Error)> callback,
+    const std::vector<InferOptions>& options,
+    const std::vector<std::vector<InferInput*>>& inputs,
+    const std::vector<std::vector<const InferRequestedOutput*>>& outputs)
+{
+  std::string url = host_ + ":" + std::to_string(port_);
+  bool verbose = verbose_;
+  std::thread([=]() {
+    std::unique_ptr<InferenceServerHttpClient> client;
+    Error err = Create(&client, url, verbose);
+    std::vector<InferResultPtr> results;
+    if (err.IsOk()) {
+      err = client->InferMulti(&results, options, inputs, outputs);
+    }
+    callback(results, err);
+  }).detach();
+  return Error::Success();
+}
+
+Error
 InferenceServerHttpClient::AsyncInfer(
     std::function<void(InferResultPtr, Error)> callback,
     const InferOptions& options, const std::vector<InferInput*>& inputs,

@@ -72,6 +72,22 @@ class InferenceServerHttpClient {
       const InferOptions& options, const std::vector<InferInput*>& inputs,
       const std::vector<const InferRequestedOutput*>& outputs = {});
 
+  // Batched convenience calls (reference grpc_client.h:441-494 InferMulti /
+  // AsyncInferMulti): one options+inputs+outputs tuple per request; an
+  // options/outputs vector of size 1 is broadcast across all requests.
+  Error InferMulti(
+      std::vector<InferResultPtr>* results,
+      const std::vector<InferOptions>& options,
+      const std::vector<std::vector<InferInput*>>& inputs,
+      const std::vector<std::vector<const InferRequestedOutput*>>& outputs =
+          {});
+  Error AsyncInferMulti(
+      std::function<void(std::vector<InferResultPtr>, Error)> callback,
+      const std::vector<InferOptions>& options,
+      const std::vector<std::vector<InferInput*>>& inputs,
+      const std::vector<std::vector<const InferRequestedOutput*>>& outputs =
+          {});
+
   // Request/response pipelining helpers (reference http_client.h:122-138).
   static Error GenerateRequestBody(
       std::string* body, size_t* header_length, const InferOptions& options,

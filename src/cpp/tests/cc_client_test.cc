@@ -246,6 +246,28 @@ TestSequence(tc::InferenceServerHttpClient* client)
 }
 
 static void
+TestInferMulti(tc::InferenceServerHttpClient* client)
+{
+  std::vector<int32_t> in0(16), in1(16);
+  tc::InferInput i0("INPUT0", {1, 16}, "INT32");
+  tc::InferInput i1("INPUT1", {1, 16}, "INT32");
+  FillInputs(in0, in1, i0, i1);
+  std::vector<tc::InferOptions> options = {tc::InferOptions("simple")};
+  std::vector<std::vector<tc::InferInput*>> inputs = {
+      {&i0, &i1}, {&i0, &i1}, {&i0, &i1}};
+  std::vector<tc::InferResultPtr> results;
+  CHECK_OK(client->InferMulti(&results, options, inputs));
+  CHECK(results.size() == 3);
+  for (const auto& result : results) {
+    const uint8_t* buf = nullptr;
+    size_t size = 0;
+    CHECK_OK(result->RawData("OUTPUT0", &buf, &size));
+    const int32_t* sum = reinterpret_cast<const int32_t*>(buf);
+    for (int i = 0; i < 16; i++) CHECK(sum[i] == in0[i] + in1[i]);
+  }
+}
+
+static void
 TestModelControl(tc::InferenceServerHttpClient* client)
 {
   bool ready = false;
@@ -281,6 +303,7 @@ main(int argc, char** argv)
   TestAsyncInfer(client.get());
   TestSystemSharedMemory(client.get());
   TestSequence(client.get());
+  TestInferMulti(client.get());
   TestModelControl(client.get());
   TestStatistics(client.get());
 

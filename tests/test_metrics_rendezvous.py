@@ -1,0 +1,139 @@
+"""Server /metrics endpoint, perf MetricsManager, multi-rank rendezvous."""
+
+import socket
+import subprocess
+import sys
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+import client_tpu.http as httpclient
+from client_tpu.perf.metrics_manager import MetricsManager, parse_prometheus
+from client_tpu.perf.rendezvous import Rendezvous
+from client_tpu.serve import Server
+from client_tpu.utils import InferenceServerException
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class TestMetricsEndpoint:
+    @pytest.fixture(scope="class")
+    def server(self):
+        with Server(http_port=0) as s:
+            yield s
+
+    def test_scrape_and_counters_advance(self, server):
+        url = f"http://{server.http_address}/metrics"
+        before = parse_prometheus(
+            urllib.request.urlopen(url).read().decode()
+        )
+        with httpclient.InferenceServerClient(server.http_address) as c:
+            inputs = [
+                httpclient.InferInput("INPUT0", [1, 16], "INT32"),
+                httpclient.InferInput("INPUT1", [1, 16], "INT32"),
+            ]
+            inputs[0].set_data_from_numpy(np.ones((1, 16), np.int32))
+            inputs[1].set_data_from_numpy(np.ones((1, 16), np.int32))
+            for _ in range(3):
+                c.infer("simple", inputs)
+        after = parse_prometheus(
+            urllib.request.urlopen(url).read().decode()
+        )
+
+        def success_count(snap):
+            return sum(
+                v for labels, v in snap.get("ctpu_inference_request_success", [])
+                if 'model="simple"' in labels
+            )
+
+        assert success_count(after) - success_count(before) == 3
+        assert "ctpu_scrape_timestamp_seconds" in after
+
+    def test_metrics_manager_collects(self, server):
+        mm = MetricsManager(
+            f"http://{server.http_address}/metrics", interval_s=0.05
+        ).start()
+        import time
+
+        time.sleep(0.3)
+        mm.stop()
+        snaps = mm.swap_snapshots()
+        assert len(snaps) >= 2
+        assert all("ctpu_inference_request_success" in s for s in snaps)
+
+    def test_summarize_gauges(self):
+        snaps = [
+            {"ctpu_tpu_memory_used_bytes": [("{}", 100.0)]},
+            {"ctpu_tpu_memory_used_bytes": [("{}", 300.0)]},
+        ]
+        agg = MetricsManager.summarize(snaps)
+        assert agg["ctpu_tpu_memory_used_bytes"] == {"avg": 200.0, "max": 300.0}
+
+
+class TestRendezvous:
+    def test_all_gather_and_consensus(self):
+        addr = f"127.0.0.1:{_free_port()}"
+        world = 3
+        results = [None] * world
+        consensus = [None] * world
+
+        def run(rank):
+            rv = Rendezvous(rank, world, addr)
+            rv.barrier()
+            results[rank] = rv.all_gather(f"rank{rank}")
+            consensus[rank] = rv.all_ranks_stable(rank != 1)
+            rv.close()
+
+        threads = [threading.Thread(target=run, args=(r,)) for r in range(world)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        expected = ["rank0", "rank1", "rank2"]
+        assert all(r == expected for r in results)
+        assert consensus == [False, False, False]  # rank 1 was unstable
+
+    def test_single_rank_is_local(self):
+        rv = Rendezvous(0, 1)
+        assert rv.all_gather("x") == ["x"]
+        assert rv.all_ranks_stable(True)
+        rv.close()
+
+    def test_bad_rank_rejected(self):
+        with pytest.raises(InferenceServerException):
+            Rendezvous(5, 2)
+
+
+class TestMultiRankCli:
+    def test_two_rank_hermetic_run(self):
+        port = _free_port()
+        args = [
+            sys.executable, "-m", "client_tpu.perf",
+            "-m", "simple", "--hermetic",
+            "--concurrency-range", "1",
+            "--measurement-interval", "100",
+            "--max-trials", "3", "-s", "90",
+            "--world-size", "2",
+            "--rendezvous-addr", f"127.0.0.1:{port}",
+        ]
+        procs = [
+            subprocess.Popen(
+                args + ["--rank", str(rank)],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            )
+            for rank in range(2)
+        ]
+        outs = [p.communicate(timeout=180)[0] for p in procs]
+        for rank, (proc, out) in enumerate(zip(procs, outs)):
+            assert proc.returncode == 0, f"rank {rank}:\n{out}"
+        assert "Aggregate across ranks:" in outs[0]
+        assert "total:" in outs[0]
+        assert "Aggregate" not in outs[1]  # only rank 0 prints the rollup
