@@ -1,0 +1,331 @@
+"""Cross-request dynamic micro-batching for stateless batchable models.
+
+TPU-first rationale: the MXU wants large batched matmuls/convs, and every
+device round trip (H2D, dispatch, D2H) carries fixed latency — per-request
+execution pays that latency per request, a batcher pays it per *batch*.  This
+is the server-side analog of the dynamic batcher in the reference's server
+ecosystem (the client-side reference exposes it via model config
+``dynamic_batching``; model_parser.h:59-193 normalizes scheduler kinds), built
+the XLA way: batches are padded to power-of-two buckets so every batch size
+hits an already-compiled executable instead of triggering a retrace.
+
+Eligibility: stateless, non-decoupled models with ``max_batch_size > 1`` and
+host-resident (wire) inputs.  Shared-memory requests keep the direct
+zero-copy path — batching them would force device→host materialization.
+"""
+
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+from client_tpu.utils import InferenceServerException
+
+
+def _bucket(n, cap):
+    """Smallest bucket >= n from {2^k, 3*2^k}, capped at cap.
+
+    The 1.5x intermediate sizes keep worst-case padding waste to 33% instead
+    of 100% while the bucket count (and so the compile count) stays O(log n).
+    """
+    b = 1
+    while b < n:
+        if b * 3 // 2 >= n and b >= 2:
+            b = b * 3 // 2
+            break
+        b *= 2
+    return min(b, cap)
+
+
+def _buckets_up_to(cap):
+    """All bucket sizes warmup must cover, ending exactly at cap."""
+    out = []
+    b = 1
+    while b < cap:
+        out.append(b)
+        if b >= 2 and b * 3 // 2 < cap:
+            out.append(b * 3 // 2)
+        b *= 2
+    out.append(cap)
+    return sorted(set(out))
+
+
+class _Pending:
+    __slots__ = ("inputs", "rows", "signature", "event", "result", "error", "t_enq")
+
+    def __init__(self, inputs, rows, signature):
+        self.inputs = inputs
+        self.rows = rows
+        self.signature = signature
+        self.event = threading.Event()
+        self.result = None
+        self.error = None
+        self.t_enq = time.monotonic_ns()
+
+
+class ModelBatcher:
+    """One background batcher per model: gathers concurrent requests into a
+    single padded forward pass and splits the host-materialized outputs."""
+
+    def __init__(self, model, stats, max_queue_delay_s=0.003):
+        self.model = model
+        self.stats = stats
+        self.max_batch = max(int(model.max_batch_size), 1)
+        self.max_queue_delay_s = max_queue_delay_s
+        self._cond = threading.Condition()
+        self._queue = deque()
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._loop, name=f"batcher-{model.name}", daemon=True
+        )
+        self._thread.start()
+
+    def warmup(self, input_specs):
+        """Pre-compile every padded bucket (the reference's ``model_warmup``
+        analog): run the model on zeros for each power-of-two batch size so no
+        client request ever pays a compile.  Skipped for models with dynamic
+        non-batch dims."""
+        from client_tpu.utils import triton_to_np_dtype
+
+        shapes = {}
+        for spec in input_specs:
+            dims = list(spec.dims)
+            if any(d < 0 for d in dims[1:]):
+                return
+            np_dtype = triton_to_np_dtype(spec.datatype)
+            if np_dtype is None or np_dtype == np.object_:
+                return
+            shapes[spec.name] = (dims[1:], np_dtype)
+        buckets = _buckets_up_to(self.max_batch)
+        import jax
+
+        for b in buckets:
+            zeros = {
+                name: np.zeros([b] + dims, dtype=np_dtype)
+                for name, (dims, np_dtype) in shapes.items()
+            }
+            jax.device_get(self.model.fn(zeros, {}, None))
+
+    # -- request side -----------------------------------------------------
+
+    def submit(self, inputs):
+        """Block until the batched execution finishes; return this request's
+        slice of the outputs as host numpy arrays."""
+        rows = _leading_rows(inputs)
+        signature = tuple(
+            (name, arr.dtype.str, arr.shape[1:]) for name, arr in sorted(inputs.items())
+        )
+        pending = _Pending(inputs, rows, signature)
+        with self._cond:
+            if self._closed:
+                raise InferenceServerException(
+                    f"model '{self.model.name}' is shutting down", status="500"
+                )
+            self._queue.append(pending)
+            self._cond.notify()
+        pending.event.wait()
+        if pending.error is not None:
+            raise pending.error
+        return pending.result
+
+    def close(self):
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        self._thread.join(timeout=30)
+        # Fail anything still queued.  Drained under the lock so a batcher
+        # thread that outlived the join timeout (e.g. blocked in a cold
+        # compile) cannot race the deque; items it already popped are its to
+        # complete, items still queued are ours to fail.
+        with self._cond:
+            leftovers = list(self._queue)
+            self._queue.clear()
+        for p in leftovers:
+            p.error = InferenceServerException("server shutdown", status="500")
+            p.event.set()
+
+    # -- batcher thread ----------------------------------------------------
+
+    def _loop(self):
+        try:
+            self._run()
+        except BaseException:  # noqa: BLE001 - a dead batcher must not strand waiters
+            with self._cond:
+                self._closed = True
+                leftovers = list(self._queue)
+                self._queue.clear()
+            err = InferenceServerException(
+                f"model '{self.model.name}' batcher thread died", status="500"
+            )
+            for p in leftovers:
+                p.error = err
+                p.event.set()
+            raise
+
+    def _run(self):
+        # Depth-2 pipeline: dispatch batch K+1 (host concat + async H2D +
+        # async forward) BEFORE blocking on batch K's D2H, so the host->device
+        # link streams the next batch while the previous one drains.  On a
+        # remote/tunneled chip this is the difference between serial
+        # (gather, transfer, wait) x N and a saturated link.
+        inflight = None
+        while True:
+            group = self._gather()
+            if group is None:
+                if inflight is not None:
+                    self._complete(*inflight)
+                return
+            dispatched = self._dispatch(group)
+            if inflight is not None:
+                self._complete(*inflight)
+            inflight = dispatched
+            if inflight is None:
+                continue
+            # If the queue is empty, finish the in-flight batch now instead of
+            # holding its requesters hostage to the next arrival.
+            with self._cond:
+                empty = not self._queue
+            if empty:
+                self._complete(*inflight)
+                inflight = None
+
+    def _gather(self):
+        """Take the oldest request, then wait up to max_queue_delay for
+        signature-compatible peers (or until the batch is full)."""
+        with self._cond:
+            while not self._queue:
+                if self._closed:
+                    return None
+                self._cond.wait()
+            first = self._queue.popleft()
+            group = [first]
+            rows = first.rows
+            deadline = time.monotonic() + self.max_queue_delay_s
+            while rows < self.max_batch:
+                # drain compatible items already queued
+                taken = False
+                for i, p in enumerate(self._queue):
+                    if p.signature == first.signature and rows + p.rows <= self.max_batch:
+                        del self._queue[i]
+                        group.append(p)
+                        rows += p.rows
+                        taken = True
+                        break
+                if taken:
+                    continue
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or self._closed:
+                    break
+                self._cond.wait(timeout=remaining)
+            return group
+
+    def _dispatch(self, group):
+        """Host-concat the group, pad to a power-of-two bucket, and issue the
+        (asynchronous) forward.  Returns state for _complete, or None if the
+        dispatch failed (the group is already notified)."""
+        t0 = time.monotonic_ns()
+        try:
+            names = [name for name, _, _ in group[0].signature]
+            rows = sum(p.rows for p in group)
+            # rows <= max_batch by construction, so padded >= rows always.
+            padded = _bucket(rows, cap=self.max_batch)
+            batched = {}
+            for name in names:
+                parts = [p.inputs[name] for p in group]
+                if padded > rows:
+                    pad_shape = (padded - rows,) + parts[0].shape[1:]
+                    parts.append(np.zeros(pad_shape, dtype=parts[0].dtype))
+                batched[name] = (
+                    np.concatenate(parts, axis=0) if len(parts) > 1 else parts[0]
+                )
+            t_in = time.monotonic_ns()
+            result = self.model.fn(batched, {}, None)
+            return group, result, rows, t0, t_in
+        except Exception as e:  # noqa: BLE001 - failure propagates per-request
+            self._fail(group, e)
+            return None
+
+    def _complete(self, group, result, rows, t0, t_in):
+        """Block on the batch's D2H, split rows back to requests, record stats."""
+        try:
+            # One D2H for the whole batch: materialize every output to host
+            # before splitting (device arrays would re-transfer per request).
+            import jax
+
+            host = jax.device_get(result)
+            t_inf = time.monotonic_ns()
+            offset = 0
+            for p in group:
+                p.result = {
+                    name: arr[offset : offset + p.rows] for name, arr in host.items()
+                }
+                offset += p.rows
+                p.event.set()
+            t1 = time.monotonic_ns()
+            queue_ns = sum(t_in - p.t_enq for p in group)
+            self.stats.record_batched(
+                rows=rows,
+                infer_ns=t_inf - t_in,
+                input_ns=t_in - t0,
+                output_ns=t1 - t_inf,
+                queue_ns=queue_ns,
+            )
+        except Exception as e:  # noqa: BLE001 - failure propagates per-request
+            self._fail(group, e)
+
+    def _fail(self, group, e):
+        err = (
+            e
+            if isinstance(e, InferenceServerException)
+            else InferenceServerException(
+                f"{self.model.name}: batched execution failed: {e}",
+                status="500",
+                debug_details=e,
+            )
+        )
+        for p in group:
+            p.error = err
+            p.event.set()
+
+
+def _leading_rows(inputs):
+    for arr in inputs.values():
+        if arr.ndim == 0:
+            raise InferenceServerException(
+                "batchable model input must have a leading batch dimension",
+                status="400",
+            )
+        return int(arr.shape[0])
+    raise InferenceServerException("request has no inputs", status="400")
+
+
+def batchable_request(model, inputs, params, context, request):
+    """Whether this request may take the dynamic-batching path."""
+    if not model.dynamic_batching or model.decoupled or model.stateful:
+        return False
+    if context is not None or params.get("sequence_id"):
+        return False
+    # Request parameters beyond rendering hints reach model.fn on the direct
+    # path; the batcher calls fn once for many requests and cannot honor
+    # per-request parameters, so any such request keeps the direct path.
+    if any(k not in ("binary_data_output",) for k in params):
+        return False
+    if model.max_batch_size <= 1:
+        return False
+    for out in request.get("outputs") or []:
+        # shm outputs stay on the direct path: batching materializes outputs
+        # host-side, which would cost the shm path its zero-copy write.
+        if "shared_memory_region" in (out.get("parameters") or {}):
+            return False
+    rows = None
+    for arr in inputs.values():
+        if not isinstance(arr, np.ndarray) or arr.dtype == np.object_:
+            return False  # device-resident (shm) or BYTES inputs: direct path
+        if arr.ndim == 0:
+            return False
+        if rows is None:
+            rows = arr.shape[0]
+        elif arr.shape[0] != rows:
+            return False
+    return rows is not None and rows <= model.max_batch_size

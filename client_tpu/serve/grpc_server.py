@@ -396,7 +396,7 @@ class _Handlers:
 class GrpcFrontend:
     """grpc.server bound to an InferenceEngine via generic method handlers."""
 
-    def __init__(self, engine, host="127.0.0.1", port=0, verbose=False, max_workers=16):
+    def __init__(self, engine, host="127.0.0.1", port=0, verbose=False, max_workers=96):
         self._server = grpc.server(
             futures.ThreadPoolExecutor(
                 max_workers=max_workers, thread_name_prefix="client_tpu-grpc"

@@ -92,6 +92,9 @@ class Model:
         max_batch_size=0,
         decoupled=False,
         stateful=False,
+        dynamic_batching=False,
+        max_queue_delay_us=3000,
+        warmup=False,
     ):
         self.name = name
         self.inputs = list(inputs)
@@ -103,6 +106,9 @@ class Model:
         self.max_batch_size = max_batch_size
         self.decoupled = decoupled
         self.stateful = stateful
+        self.dynamic_batching = dynamic_batching
+        self.max_queue_delay_us = max_queue_delay_us
+        self.warmup = warmup
         self.config_override = None  # set by repository load with config param
         self.file_overrides = {}
 
@@ -138,6 +144,10 @@ class Model:
                 for t in self.outputs
             ],
         }
+        if self.dynamic_batching:
+            cfg["dynamic_batching"] = {
+                "max_queue_delay_microseconds": self.max_queue_delay_us
+            }
         if self.decoupled:
             cfg["model_transaction_policy"] = {"decoupled": True}
         if self.stateful:
@@ -177,6 +187,28 @@ class ModelStats:
                 self.compute_input_ns += input_ns
                 self.compute_output_ns += output_ns
                 self.last_inference_ms = int(time.time() * 1000)
+            else:
+                self.fail_count += 1
+                self.fail_ns += total_ns
+
+    def record_batched(self, rows, infer_ns, input_ns, output_ns, queue_ns):
+        """One dynamic-batched execution.  Per-request success/fail outcomes
+        are recorded separately by record_request once rendering finishes."""
+        with self.lock:
+            self.inference_count += rows
+            self.execution_count += 1
+            self.compute_infer_ns += infer_ns
+            self.compute_input_ns += input_ns
+            self.compute_output_ns += output_ns
+            self.queue_ns += queue_ns
+            self.last_inference_ms = int(time.time() * 1000)
+
+    def record_request(self, ok, total_ns):
+        """Outcome of one request served through the batched path."""
+        with self.lock:
+            if ok:
+                self.success_count += 1
+                self.success_ns += total_ns
             else:
                 self.fail_count += 1
                 self.fail_ns += total_ns
@@ -477,6 +509,7 @@ class InferenceEngine:
         self._models = {}
         self._ready = {}
         self._stats = {}
+        self._batchers = {}
         self.shm = SharedMemoryRegistry()
         self._sequences = {}
         self.max_sequence_idle_s = max_sequence_idle_s
@@ -505,6 +538,12 @@ class InferenceEngine:
             self._models[model.name] = model
             self._ready[model.name] = ready
             self._stats.setdefault(model.name, ModelStats())
+            # A replaced model must not keep serving through the old batcher.
+            stale = self._batchers.pop(model.name, None)
+        if stale is not None:
+            stale.close()
+        if model.dynamic_batching and model.warmup:
+            self._batcher_for(model).warmup(model.inputs)
 
     def get_model(self, name, version=""):
         with self._lock:
@@ -553,6 +592,9 @@ class InferenceEngine:
                     f"failed to unload '{name}', no such model", status="400"
                 )
             self._ready[name] = False
+            batcher = self._batchers.pop(name, None)
+        if batcher is not None:
+            batcher.close()
 
     def repository_index(self, ready_only=False):
         with self._lock:
@@ -604,6 +646,16 @@ class InferenceEngine:
             params = request.get("parameters", {}) or {}
             context = self._sequence_context(params)
             t_in1 = time.monotonic_ns()
+            if _batchable_request(model, inputs, params, context, request):
+                # The batcher records execution-level statistics; the
+                # per-request outcome is recorded here so a rendering failure
+                # is counted exactly once (by the except clauses below).
+                result = self._batcher_for(model).submit(inputs)
+                rendered = self._render_response(
+                    model, model_version, request, result
+                )
+                stats.record_request(True, time.monotonic_ns() - t0)
+                return rendered
             result = model.fn(inputs, params, context)
             if model.decoupled:
                 responses = []
@@ -631,6 +683,20 @@ class InferenceEngine:
             raise InferenceServerException(
                 f"{model_name}: execution failed: {e}", status="500", debug_details=e
             ) from e
+
+    def _batcher_for(self, model):
+        with self._lock:
+            batcher = self._batchers.get(model.name)
+            if batcher is None:
+                from client_tpu.serve.dynamic_batcher import ModelBatcher
+
+                batcher = ModelBatcher(
+                    model,
+                    self._stats[model.name],
+                    max_queue_delay_s=model.max_queue_delay_us / 1e6,
+                )
+                self._batchers[model.name] = batcher
+            return batcher
 
     def _sequence_context(self, params):
         seq_id = params.get("sequence_id", 0)
@@ -794,7 +860,18 @@ class InferenceEngine:
         return response, blobs
 
     def close(self):
+        with self._lock:
+            batchers = list(self._batchers.values())
+            self._batchers.clear()
+        for batcher in batchers:
+            batcher.close()
         self.shm.close()
+
+
+def _batchable_request(model, inputs, params, context, request):
+    from client_tpu.serve.dynamic_batcher import batchable_request
+
+    return batchable_request(model, inputs, params, context, request)
 
 
 def _np_dtype_to_wire(arr):

@@ -78,8 +78,14 @@ class CnnClassifier:
         return {"OUTPUT0": self._forward(self.params, x)}
 
 
-def cnn_classifier_model(name="cnn_classifier", image_size=224):
-    """Servable Model wrapping CnnClassifier (densenet_onnx stand-in)."""
+def cnn_classifier_model(
+    name="cnn_classifier", image_size=224, max_batch_size=64, warmup=False
+):
+    """Servable Model wrapping CnnClassifier (densenet_onnx stand-in).
+
+    Dynamic batching is on: concurrent wire requests fuse into one padded
+    batched forward (one H2D, one MXU pass, one D2H per batch).
+    """
     runner = CnnClassifier(image_size)
     labels = [f"class_{i}" for i in range(_NUM_CLASSES)]
     return Model(
@@ -89,5 +95,7 @@ def cnn_classifier_model(name="cnn_classifier", image_size=224):
         fn=runner,
         platform="jax",
         backend="jax",
-        max_batch_size=32,
+        max_batch_size=max_batch_size,
+        dynamic_batching=True,
+        warmup=warmup,
     )

@@ -1,0 +1,232 @@
+"""Dynamic micro-batching: concurrent requests fuse into one padded forward.
+
+The server-side analog of the batching the reference's model configs opt into
+via ``dynamic_batching`` (normalized by model_parser.h:59-193); here it is a
+first-class engine feature (client_tpu/serve/dynamic_batcher.py).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from client_tpu.serve.dynamic_batcher import _bucket, _buckets_up_to, batchable_request
+from client_tpu.serve.model_runtime import InferenceEngine, Model, TensorSpec
+from client_tpu.utils import to_wire_bytes
+
+
+def _echo_model(record, **kwargs):
+    """Model that doubles its input and records every executed batch size."""
+
+    def fn(inputs, params, ctx):
+        record.append(int(inputs["IN"].shape[0]))
+        return {"OUT": inputs["IN"] * 2.0}
+
+    defaults = dict(
+        max_batch_size=8,
+        dynamic_batching=True,
+        max_queue_delay_us=20000,
+    )
+    defaults.update(kwargs)
+    return Model(
+        "echo2x",
+        inputs=[TensorSpec("IN", "FP32", [-1, 4])],
+        outputs=[TensorSpec("OUT", "FP32", [-1, 4])],
+        fn=fn,
+        **defaults,
+    )
+
+
+def _request(arr, shm_output=None):
+    raw = to_wire_bytes(arr, "FP32")
+    req = {
+        "id": "",
+        "parameters": {},
+        "inputs": [
+            {
+                "name": "IN",
+                "datatype": "FP32",
+                "shape": list(arr.shape),
+                "parameters": {"binary_data_size": len(raw)},
+            }
+        ],
+        "outputs": [{"name": "OUT", "parameters": {"binary_data": True}}],
+    }
+    if shm_output:
+        req["outputs"][0]["parameters"] = {
+            "shared_memory_region": shm_output,
+            "shared_memory_byte_size": arr.nbytes,
+        }
+    return req, raw
+
+
+def test_bucket_shapes():
+    assert [_bucket(n, 64) for n in (1, 2, 3, 5, 7, 9, 13, 20, 40, 50)] == [
+        1, 2, 3, 6, 8, 12, 16, 24, 48, 64,
+    ]
+    assert _bucket(100, 64) == 64
+    buckets = _buckets_up_to(64)
+    assert buckets == [1, 2, 3, 4, 6, 8, 12, 16, 24, 32, 48, 64]
+    # every _bucket output is a warmed bucket
+    for n in range(1, 65):
+        assert _bucket(n, 64) in buckets
+
+
+def test_concurrent_requests_fuse_and_split_correctly():
+    record = []
+    engine = InferenceEngine(models=[_echo_model(record)])
+    n_threads = 8
+    arrays = [
+        np.full((1, 4), float(i), dtype=np.float32) for i in range(n_threads)
+    ]
+    results = [None] * n_threads
+    barrier = threading.Barrier(n_threads)
+
+    def run(i):
+        req, raw = _request(arrays[i])
+        barrier.wait()
+        response, blobs = engine.execute("echo2x", "", req, raw)
+        results[i] = np.frombuffer(blobs[0], dtype=np.float32).reshape(1, 4)
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for i in range(n_threads):
+        np.testing.assert_array_equal(results[i], arrays[i] * 2.0)
+    # fewer executions than requests proves fusion happened
+    assert sum(record) >= n_threads  # padded rows included
+    assert len(record) < n_threads
+    # every executed batch size is a warmable bucket (padding applied)
+    for b in record:
+        assert b in _buckets_up_to(8)
+    stats = engine.statistics("echo2x")[0]["inference_stats"]
+    assert stats["success"]["count"] == n_threads
+    engine.close()
+
+
+def test_multi_row_requests_batch():
+    record = []
+    engine = InferenceEngine(models=[_echo_model(record)])
+    arr = np.arange(12, dtype=np.float32).reshape(3, 4)
+    req, raw = _request(arr)
+    response, blobs = engine.execute("echo2x", "", req, raw)
+    out = np.frombuffer(blobs[0], dtype=np.float32).reshape(3, 4)
+    np.testing.assert_array_equal(out, arr * 2.0)
+    assert response["outputs"][0]["shape"] == [3, 4]
+    engine.close()
+
+
+def test_oversize_request_takes_direct_path():
+    record = []
+    engine = InferenceEngine(models=[_echo_model(record)])
+    arr = np.zeros((9, 4), dtype=np.float32)  # > max_batch_size=8
+    req, raw = _request(arr)
+    response, blobs = engine.execute("echo2x", "", req, raw)
+    assert np.frombuffer(blobs[0], dtype=np.float32).size == 36
+    assert record == [9]  # executed unbatched, unpadded
+    engine.close()
+
+
+def test_shm_output_bypasses_batcher():
+    model = _echo_model([])
+    arr = np.zeros((1, 4), dtype=np.float32)
+    req, _ = _request(arr, shm_output="region0")
+    inputs = {"IN": arr}
+    assert not batchable_request(model, inputs, {}, None, req)
+
+
+def test_sequence_and_device_inputs_bypass_batcher():
+    model = _echo_model([])
+    arr = np.zeros((1, 4), dtype=np.float32)
+    req, _ = _request(arr)
+    assert not batchable_request(
+        model, {"IN": arr}, {"sequence_id": 7}, None, req
+    )
+
+    class FakeDeviceArray:
+        ndim = 2
+        shape = (1, 4)
+
+    assert not batchable_request(model, {"IN": FakeDeviceArray()}, {}, None, req)
+    # plain numpy wire request IS batchable
+    assert batchable_request(model, {"IN": arr}, {}, None, req)
+
+
+def test_batcher_error_propagates_per_request():
+    def fn(inputs, params, ctx):
+        raise ValueError("boom")
+
+    model = Model(
+        "boom",
+        inputs=[TensorSpec("IN", "FP32", [-1, 4])],
+        outputs=[TensorSpec("OUT", "FP32", [-1, 4])],
+        fn=fn,
+        max_batch_size=8,
+        dynamic_batching=True,
+    )
+    engine = InferenceEngine(models=[model])
+    req, raw = _request(np.zeros((1, 4), dtype=np.float32))
+    from client_tpu.utils import InferenceServerException
+
+    with pytest.raises(InferenceServerException, match="boom"):
+        engine.execute("boom", "", req, raw)
+    stats = engine.statistics("boom")[0]["inference_stats"]
+    assert stats["fail"]["count"] == 1
+    engine.close()
+
+
+def test_unload_closes_batcher_and_reload_works():
+    record = []
+    engine = InferenceEngine(models=[_echo_model(record)])
+    arr = np.ones((1, 4), dtype=np.float32)
+    req, raw = _request(arr)
+    engine.execute("echo2x", "", req, raw)
+    engine.unload_model("echo2x")
+    engine.load_model("echo2x")
+    response, blobs = engine.execute("echo2x", "", req, raw)
+    np.testing.assert_array_equal(
+        np.frombuffer(blobs[0], dtype=np.float32).reshape(1, 4), arr * 2.0
+    )
+    engine.close()
+
+
+def test_request_parameters_bypass_batcher():
+    model = _echo_model([])
+    arr = np.zeros((1, 4), dtype=np.float32)
+    req, _ = _request(arr)
+    # a custom parameter must reach model.fn, so it takes the direct path
+    assert not batchable_request(model, {"IN": arr}, {"top_k": 5}, None, req)
+    assert batchable_request(
+        model, {"IN": arr}, {"binary_data_output": True}, None, req
+    )
+
+
+def test_replacing_model_replaces_batcher():
+    record_v1, record_v2 = [], []
+    engine = InferenceEngine(models=[_echo_model(record_v1)])
+    arr = np.ones((1, 4), dtype=np.float32)
+    req, raw = _request(arr)
+    engine.execute("echo2x", "", req, raw)
+    assert record_v1  # v1 batcher served it
+
+    v2 = _echo_model(record_v2)
+    engine.add_model(v2)
+    engine.execute("echo2x", "", req, raw)
+    assert record_v2  # new batcher bound to the new model fn
+    assert len(record_v1) == 1
+    engine.close()
+
+
+def test_warmup_compiles_all_buckets():
+    record = []
+    engine = InferenceEngine(models=[_echo_model(record, warmup=True)])
+    assert sorted(set(record)) == _buckets_up_to(8)
+    engine.close()
+
+
+def test_dynamic_batching_in_model_config():
+    model = _echo_model([])
+    cfg = model.config()
+    assert cfg["dynamic_batching"]["max_queue_delay_microseconds"] == 20000
