@@ -5,6 +5,7 @@ PB_OUT := client_tpu/_proto
 CXX ?= g++
 CXXFLAGS ?= -O2 -fPIC -Wall -std=c++17
 NATIVE_OUT := client_tpu/utils/shared_memory
+TPUSHM_OUT := client_tpu/utils/tpu_shared_memory
 
 .PHONY: all protos native cpp clean test
 
@@ -42,14 +43,19 @@ $(PB_OUT)/inference_pb2.py: $(PROTO_DIR)/inference.proto $(PROTO_DIR)/model_conf
 	sed -i 's/^import model_config_pb2 as/from . import model_config_pb2 as/' \
 	    $(PB_OUT)/inference_pb2.py
 
-native: $(NATIVE_OUT)/libcshm_tpu.so
+native: $(NATIVE_OUT)/libcshm_tpu.so $(TPUSHM_OUT)/libctpushm.so
 
 $(NATIVE_OUT)/libcshm_tpu.so: src/cpp/shm/cshm.cc
 	mkdir -p $(NATIVE_OUT)
 	$(CXX) $(CXXFLAGS) -shared -o $@ $< -lrt
 
+$(TPUSHM_OUT)/libctpushm.so: src/cpp/shm/ctpushm.cc
+	mkdir -p $(TPUSHM_OUT)
+	$(CXX) $(CXXFLAGS) -shared -o $@ $< -lrt
+
 clean:
-	rm -f $(PB_OUT)/*_pb2.py $(NATIVE_OUT)/libcshm_tpu.so
+	rm -f $(PB_OUT)/*_pb2.py $(NATIVE_OUT)/libcshm_tpu.so \
+	    $(TPUSHM_OUT)/libctpushm.so
 	rm -rf $(CPP_BUILD)
 
 test:

@@ -71,6 +71,9 @@ def build_parser():
                    default="none")
     p.add_argument("--output-shared-memory-size", type=int, default=0)
     p.add_argument("--tpu-device-id", type=int, default=0)
+    p.add_argument("--tpu-shm-sync", action="store_true",
+                   help="record completion latency (forced D2H per request) "
+                        "instead of dispatch-ack latency for TPU shm outputs")
     p.add_argument("--input-data", default=None,
                    help="'random', 'zero', a JSON file, or a directory")
     p.add_argument("--shape", action="append", default=[],
@@ -157,9 +160,7 @@ def main(argv=None):
             shared_memory=args.shared_memory,
             output_shm_byte_size=args.output_shared_memory_size,
             device_id=args.tpu_device_id,
-            # an out-of-process server can only map TPU regions through the
-            # host staging mirror; in-process resolves HBM buffers directly
-            tpu_staging=not args.hermetic,
+            tpu_completion_sync=args.tpu_shm_sync,
         )
         data_manager.init()
 

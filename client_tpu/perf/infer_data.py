@@ -184,21 +184,27 @@ class TpuShmInferDataManager(_ShmInferDataManagerBase):
 
     region_prefix = "perf_tpushm"
 
-    def __init__(self, *args, device_id=0, staging=False, **kwargs):
+    def __init__(self, *args, device_id=0, completion_sync=False, **kwargs):
         super().__init__(*args, **kwargs)
         self._device_id = device_id
-        self._staging = staging
+        self.completion_sync = completion_sync
         self._handles = []
+        self._out_handles = []
 
     def _make_region(self, region_name, byte_size):
         from client_tpu.utils import tpu_shared_memory as tpushm
 
-        staging_key = ("/" + region_name) if self._staging else None
         h = tpushm.create_shared_memory_region(
-            region_name, byte_size, self._device_id, staging_key=staging_key
+            region_name, byte_size, self._device_id
         )
         self._handles.append(h)
         return h
+
+    def sync_outputs(self):
+        """Force a D2H read of every output region so the request latency
+        covers completion, not dispatch ack (--tpu-shm-sync)."""
+        for h, byte_size in self._out_handles:
+            h.read(0, byte_size)
 
     def _create_and_register(self, region_name, arrays, total):
         from client_tpu.utils import tpu_shared_memory as tpushm
@@ -213,6 +219,7 @@ class TpuShmInferDataManager(_ShmInferDataManagerBase):
         from client_tpu.utils import tpu_shared_memory as tpushm
 
         h = self._make_region(region_name, byte_size)
+        self._out_handles.append((h, byte_size))
         self._backend.register_tpu_shared_memory(
             region_name, tpushm.get_raw_handle(h), self._device_id, byte_size
         )
@@ -230,14 +237,17 @@ class TpuShmInferDataManager(_ShmInferDataManagerBase):
             except InferenceServerException:
                 pass
         self._handles = []
+        self._out_handles = []
 
 
 def create_infer_data_manager(backend, data_loader, inputs_meta, outputs_meta,
                               shared_memory=SharedMemoryType.NONE,
                               output_shm_byte_size=0, device_id=0,
-                              tpu_staging=False):
-    """Factory (infer_data_manager_factory.h analog).  ``tpu_staging``
-    maintains a host mirror so out-of-process servers can map the regions."""
+                              tpu_completion_sync=False):
+    """Factory (infer_data_manager_factory.h analog).  ``tpu_completion_sync``
+    makes each request latency cover output completion (forced D2H) rather
+    than dispatch ack.  Every TPU region carries a native host window, so
+    out-of-process servers always attach (no staging toggle needed)."""
     if shared_memory == SharedMemoryType.NONE:
         return InferDataManager(backend, data_loader, inputs_meta, outputs_meta)
     if shared_memory == SharedMemoryType.SYSTEM:
@@ -249,7 +259,7 @@ def create_infer_data_manager(backend, data_loader, inputs_meta, outputs_meta,
         return TpuShmInferDataManager(
             backend, data_loader, inputs_meta, outputs_meta,
             output_byte_size=output_shm_byte_size, device_id=device_id,
-            staging=tpu_staging,
+            completion_sync=tpu_completion_sync,
         )
     raise InferenceServerException(
         f"unknown shared memory type '{shared_memory}'"

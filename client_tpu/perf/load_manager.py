@@ -92,6 +92,8 @@ class InferContext:
                 sequence_end=seq_end,
                 model_version=self.model_version,
             )
+            if getattr(self.data_manager, "completion_sync", False):
+                self.data_manager.sync_outputs()
             ok = self._validate(result, stream_id, step_id)
         except InferenceServerException:
             ok = False  # counted per-window; does not abort the run

@@ -246,11 +246,11 @@ class ModelStats:
 class SharedMemoryRegistry:
     """Server-side registry of system and TPU shared-memory regions.
 
-    System regions attach by POSIX shm key (``/dev/shm``).  TPU regions carry a
-    TpuBufferDescriptor raw handle (JSON: staging_key/device_id/byte_size); the
-    server attaches the descriptor's host-staging region, which same-host
-    clients keep coherent with the HBM buffer (see
-    client_tpu/utils/tpu_shared_memory).
+    System regions attach by POSIX shm key (``/dev/shm``).  TPU regions carry
+    a raw handle (JSON: uuid/pid/device_id/byte_size/staging_key emitted by
+    libctpushm.so); same-process handles resolve to the live TpuRegion
+    (zero-copy jax.Array access), foreign handles attach the region's native
+    host window by shm key (see client_tpu/utils/tpu_shared_memory).
     """
 
     def __init__(self):
@@ -333,36 +333,44 @@ class SharedMemoryRegistry:
                 )
             # Same-process client (in-process server / C-API analog): resolve
             # the live HBM region through the broker — zero-copy jax.Array
-            # access, no staging.  Otherwise fall back to the host staging
-            # mirror the descriptor advertises.
+            # access.  Otherwise attach the region's native host window
+            # (libctpushm.so) by the shm key in the descriptor.
             region_obj = _tpushm.resolve_inprocess(descriptor)
-            mm = None
             if region_obj is None:
-                staging_key = descriptor.get("staging_key")
-                if staging_key is None:
+                if descriptor.get("staging_key") is None:
                     raise InferenceServerException(
-                        f"TPU region '{name}' was created in another process "
-                        "without a staging_key; cross-process registration "
-                        "requires host staging (PJRT has no cross-process "
-                        "buffer export)",
+                        f"TPU region '{name}' descriptor carries no host "
+                        "window (staging_key); cross-process registration "
+                        "requires the native window (PJRT has no "
+                        "cross-process buffer export)",
                         status="400",
                     )
-                mm = _attach_posix_shm(staging_key, byte_size)
+                try:
+                    region_obj = _tpushm.TpuWindowRegion(descriptor)
+                except InferenceServerException as e:
+                    raise InferenceServerException(
+                        f"unable to attach TPU region '{name}': {e.message()}",
+                        status="400",
+                    ) from e
             self._tpu[name] = {
                 "device_id": device_id,
                 "byte_size": byte_size,
                 "descriptor": descriptor,
-                "mmap": mm,
                 "region_obj": region_obj,
             }
 
     def unregister_tpu(self, name=None):
         with self._lock:
             names = [name] if name else list(self._tpu)
-            for n in names:
-                region = self._tpu.pop(n, None)
-                if region is not None and region["mmap"] is not None:
-                    region["mmap"].close()
+            removed = [self._tpu.pop(n, None) for n in names]
+        for region in removed:
+            if region is None:
+                continue
+            obj = region.get("region_obj")
+            # window attachments are server-owned and must be unmapped;
+            # in-process TpuRegions belong to the client (no close method)
+            if obj is not None and hasattr(obj, "close"):
+                obj.close()
 
     def tpu_status(self, name=None):
         with self._lock:
@@ -385,22 +393,20 @@ class SharedMemoryRegistry:
     # data access ----------------------------------------------------------
 
     def _find(self, region_name):
+        """System region (mmap, base offset) or raises.  TPU regions are
+        dispatched through their region_obj before this is consulted."""
         region = self._system.get(region_name)
-        base = 0
-        if region is not None:
-            base = region["offset"]
-        else:
-            region = self._tpu.get(region_name)
         if region is None:
             raise InferenceServerException(
                 f"shared memory region '{region_name}' is not registered",
                 status="400",
             )
-        return region, base
+        return region, region["offset"]
 
     def read_tensor(self, region_name, offset, byte_size, datatype, shape):
         """Resolve an input tensor from a region.  In-process TPU regions
-        return the live jax.Array (zero-copy); others decode from bytes."""
+        return the live jax.Array (zero-copy); window attachments and system
+        regions decode from bytes."""
         with self._lock:
             region = self._tpu.get(region_name)
             obj = region.get("region_obj") if region else None
@@ -448,11 +454,13 @@ class SharedMemoryRegistry:
 
     def read(self, region_name, offset, byte_size):
         with self._lock:
+            tpu = self._tpu.get(region_name)
+            obj = tpu.get("region_obj") if tpu else None
+        if obj is not None:
+            # byte-addressable on both faces (may sync dirty device slots)
+            return obj.read(offset, byte_size)
+        with self._lock:
             region, base = self._find(region_name)
-            if region["mmap"] is None:
-                raise InferenceServerException(
-                    f"region '{region_name}' has no host mapping", status="400"
-                )
             if offset + byte_size > region["byte_size"]:
                 raise InferenceServerException(
                     f"read of {byte_size} bytes at offset {offset} overruns "
@@ -464,11 +472,13 @@ class SharedMemoryRegistry:
 
     def write(self, region_name, offset, data):
         with self._lock:
+            tpu = self._tpu.get(region_name)
+            obj = tpu.get("region_obj") if tpu else None
+        if obj is not None:
+            obj.write(offset, data)
+            return
+        with self._lock:
             region, base = self._find(region_name)
-            if region["mmap"] is None:
-                raise InferenceServerException(
-                    f"region '{region_name}' has no host mapping", status="400"
-                )
             if offset + len(data) > region["byte_size"]:
                 raise InferenceServerException(
                     f"write of {len(data)} bytes at offset {offset} overruns "

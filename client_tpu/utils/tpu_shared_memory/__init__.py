@@ -2,41 +2,41 @@
 
 This is the framework's replacement for the reference's CUDA IPC shared
 memory (reference src/c++/library/ipc.h:28-33 and
-tritonclient/utils/cuda_shared_memory/ — cudaMalloc + cudaIpcGetMemHandle):
-a *device-buffer registry* over JAX/PJRT instead of cudart.
+tritonclient/utils/cuda_shared_memory/ — cudaMalloc + cudaIpcGetMemHandle +
+native libccudashm.so): a *device-buffer registry* over JAX/PJRT instead of
+cudart, backed by the native ``libctpushm.so`` (src/cpp/shm/ctpushm.cc).
 
-Design (SURVEY.md §5.8). A region is a named handle to tensors resident in
-TPU HBM, held as ``jax.Array`` slots keyed by byte offset:
+Design (SURVEY.md §2.2/§5.8).  A region has two coupled faces:
 
-- **Same-process** (in-process server, the triton_c_api analog): the server
-  resolves the region through a process-local broker and reads/writes the
-  ``jax.Array`` objects directly — true zero-copy, no H2D/D2H per request,
-  and inference dispatch stays asynchronous (requests pipeline on the device
-  queue exactly like back-to-back jitted calls).
-- **Cross-process same-host**: the raw handle carries an optional POSIX
-  shm *staging key*; writes mirror bytes into the staging region so a server
-  in another process can map it (one host copy — the same cost cudaIpc
-  avoids, because PJRT has no cross-process buffer export; this is the
-  documented fallback, not the benchmark path).
+- **HBM face** — ``jax.Array`` slots keyed by byte offset.  When client and
+  server share a process (in-process server, the triton_c_api analog) the
+  server resolves the region through a process-local broker and reads/writes
+  the device arrays directly: true zero-copy, no H2D/D2H per request, and
+  inference dispatch stays asynchronous.
+- **Host window (native)** — a POSIX-shm-backed byte-addressable buffer
+  managed by ``libctpushm.so``.  Every region has one; it is the region's
+  process-portable face (PJRT has no cudaIpc-style cross-process HBM
+  export).  Reads and writes work at *any* byte offset.  Device-side writes
+  mark their range dirty and are synced to the window lazily, on first byte
+  read — so the async zero-copy path never pays a hidden D2H.
 
-The raw handle (the ``cudaIpcMemHandle_t`` analog, base64-safe JSON) is what
-``register_tpu_shared_memory`` sends to the server:
-``{"uuid", "pid", "device_id", "byte_size", "staging_key"?}``.
+The raw handle (the ``cudaIpcMemHandle_t`` analog, JSON emitted by the
+native library): ``{"uuid", "pid", "device_id", "byte_size", "staging_key"}``
+where ``staging_key`` is the window's POSIX shm key.
 
-Reads with ``get_contents_as_numpy`` force a D2H sync; ``get_contents_as_jax``
-returns the live device array without synchronizing.
+Reads with ``get_contents_as_numpy`` force a D2H sync of dirty ranges;
+``get_contents_as_jax`` returns the live device array without synchronizing.
 """
 
+import ctypes
 import json
 import os
 import threading
-import uuid as _uuid
 
 import numpy as np
 
 from client_tpu.utils import (
     InferenceServerException,
-    deserialize_bytes_tensor,
     serialize_byte_tensor,
     triton_to_np_dtype,
 )
@@ -46,6 +46,48 @@ from client_tpu.utils import (
 _broker = {}
 _broker_lock = threading.Lock()
 
+_LIB_PATH = os.path.join(os.path.dirname(__file__), "libctpushm.so")
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _load():
+    global _lib
+    with _lib_lock:
+        if _lib is None:
+            if not os.path.exists(_LIB_PATH):
+                raise InferenceServerException(
+                    f"native TPU shared-memory library not built: {_LIB_PATH} "
+                    "(run `make native`)"
+                )
+            lib = ctypes.CDLL(_LIB_PATH)
+            lib.TpuHbmRegionCreate.restype = ctypes.c_void_p
+            lib.TpuHbmRegionCreate.argtypes = [ctypes.c_uint64, ctypes.c_int]
+            lib.TpuHbmRegionOpen.restype = ctypes.c_void_p
+            lib.TpuHbmRegionOpen.argtypes = [ctypes.c_char_p]
+            lib.TpuHbmWrite.restype = ctypes.c_int
+            lib.TpuHbmWrite.argtypes = [
+                ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p, ctypes.c_uint64,
+            ]
+            lib.TpuHbmRead.restype = ctypes.c_int
+            lib.TpuHbmRead.argtypes = [
+                ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p, ctypes.c_uint64,
+            ]
+            lib.TpuHbmGetRawHandle.restype = ctypes.c_int
+            lib.TpuHbmGetRawHandle.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
+            ]
+            lib.TpuHbmRegionDestroy.restype = ctypes.c_int
+            lib.TpuHbmRegionDestroy.argtypes = [ctypes.c_void_p]
+            lib.TpuHbmLastError.restype = ctypes.c_char_p
+            _lib = lib
+    return _lib
+
+
+def _last_error(lib):
+    msg = lib.TpuHbmLastError()
+    return msg.decode("utf-8", errors="replace") if msg else "unknown error"
+
 
 def _jax():
     import jax  # deferred so pure-protocol users never pay jax import cost
@@ -53,24 +95,100 @@ def _jax():
     return jax
 
 
-class TpuRegion:
-    """One named HBM region: jax.Array slots keyed by byte offset."""
+class _Window:
+    """ctypes wrapper over one native host-window handle.
 
-    def __init__(self, name, byte_size, device_id, staging_key=None):
+    The library handle is resolved once at construction; per-operation calls
+    never touch the global loader lock.  Negative offsets/sizes are rejected
+    here before they can wrap through the unsigned native ABI.
+    """
+
+    def __init__(self, lib, handle, byte_size):
+        self._lib = lib
+        self._handle = handle
+        self.byte_size = byte_size
+
+    @classmethod
+    def create(cls, byte_size, device_id):
+        lib = _load()
+        handle = lib.TpuHbmRegionCreate(byte_size, device_id)
+        if not handle:
+            raise InferenceServerException(
+                f"TpuHbmRegionCreate failed: {_last_error(lib)}"
+            )
+        return cls(lib, handle, byte_size)
+
+    @classmethod
+    def open(cls, raw_handle, byte_size):
+        lib = _load()
+        if isinstance(raw_handle, str):
+            raw_handle = raw_handle.encode("utf-8")
+        handle = lib.TpuHbmRegionOpen(raw_handle)
+        if not handle:
+            raise InferenceServerException(
+                f"TpuHbmRegionOpen failed: {_last_error(lib)}"
+            )
+        return cls(lib, handle, byte_size)
+
+    def _live(self):
+        if self._handle is None:
+            raise InferenceServerException("TPU region window is closed")
+        return self._handle
+
+    def write(self, offset, data):
+        if offset < 0:
+            raise InferenceServerException(f"negative offset {offset}")
+        buf = bytes(data) if not isinstance(data, (bytes, bytearray)) else data
+        rc = self._lib.TpuHbmWrite(self._live(), offset, buf, len(buf))
+        if rc != 0:
+            raise InferenceServerException(
+                f"TpuHbmWrite failed ({rc}): {_last_error(self._lib)}"
+            )
+
+    def read(self, offset, nbytes):
+        if offset < 0 or nbytes < 0:
+            raise InferenceServerException(
+                f"negative offset/size ({offset}, {nbytes})"
+            )
+        out = ctypes.create_string_buffer(nbytes) if nbytes else b""
+        if nbytes == 0:
+            return b""
+        rc = self._lib.TpuHbmRead(self._live(), offset, out, nbytes)
+        if rc != 0:
+            raise InferenceServerException(
+                f"TpuHbmRead failed ({rc}): {_last_error(self._lib)}"
+            )
+        return out.raw
+
+    def raw_handle(self):
+        buf = ctypes.create_string_buffer(512)
+        n = self._lib.TpuHbmGetRawHandle(self._live(), buf, 512)
+        if n < 0:
+            raise InferenceServerException(
+                f"TpuHbmGetRawHandle failed ({n}): {_last_error(self._lib)}"
+            )
+        return buf.raw[:n]
+
+    def destroy(self):
+        if self._handle is not None:
+            self._lib.TpuHbmRegionDestroy(self._handle)
+            self._handle = None
+
+
+class TpuRegion:
+    """One named HBM region: device-array slots + native byte window."""
+
+    def __init__(self, name, byte_size, device_id):
         self.name = name
         self.byte_size = byte_size
         self.device_id = device_id
-        self.uuid = _uuid.uuid4().hex
-        self.staging_key = staging_key
+        self._window = _Window.create(byte_size, device_id)
+        desc = json.loads(self._window.raw_handle())
+        self.uuid = desc["uuid"]
+        self.staging_key = desc["staging_key"]
         self._slots = {}  # offset -> jax.Array | np.ndarray (BYTES only)
-        self._staging = None
+        self._dirty = set()  # offsets whose window bytes are stale
         self._lock = threading.Lock()
-        if staging_key is not None:
-            from client_tpu.utils import shared_memory as _sysshm
-
-            self._staging = _sysshm.create_shared_memory_region(
-                f"tpu-staging-{self.uuid}", staging_key, byte_size
-            )
 
     # -- slot access --------------------------------------------------------
 
@@ -84,93 +202,177 @@ class TpuRegion:
         return devs[self.device_id]
 
     def write_array(self, offset, arr):
-        """Place a tensor at ``offset``; device_put unless already on device."""
+        """Place a tensor at ``offset``; device_put unless already on device.
+
+        Host tensors mirror their bytes into the window immediately (cheap
+        memcpy); device tensors only mark the range dirty — the D2H happens
+        lazily on the first byte-level read, never on the dispatch path.
+        """
         jax = _jax()
+        host_bytes = None
         if isinstance(arr, np.ndarray) and arr.dtype == np.object_:
             raw = serialize_byte_tensor(arr)
-            nbytes = raw.nbytes
+            host_bytes = raw.tobytes()
+            nbytes = len(host_bytes)
             stored = arr  # BYTES stay host-side; devices hold no string type
-        else:
-            if not isinstance(arr, jax.Array):
-                arr = jax.device_put(np.ascontiguousarray(arr), self._device())
+        elif isinstance(arr, jax.Array):
             nbytes = arr.dtype.itemsize * int(np.prod(arr.shape))
             stored = arr
-        if offset + nbytes > self.byte_size:
+        else:
+            arr = np.ascontiguousarray(arr)
+            host_bytes = arr.tobytes()
+            nbytes = len(host_bytes)
+            stored = jax.device_put(arr, self._device())
+        if offset < 0 or offset + nbytes > self.byte_size:
             raise InferenceServerException(
                 f"write of {nbytes} bytes at offset {offset} overruns TPU "
                 f"region '{self.name}' ({self.byte_size} bytes)"
             )
         with self._lock:
-            # drop slots this write overlaps
+            # drop slots this write fully or partially overlaps
             for off, old in list(self._slots.items()):
                 if off < offset + nbytes and offset < off + _slot_nbytes(old):
                     del self._slots[off]
+                    self._dirty.discard(off)
             self._slots[offset] = stored
-        if self._staging is not None:
-            from client_tpu.utils import shared_memory as _sysshm
-
-            _sysshm.set_shared_memory_region(self._staging, [np.asarray(stored)],
-                                             offset=offset)
+            if host_bytes is not None:
+                self._window.write(offset, host_bytes)
+            else:
+                self._dirty.add(offset)
         return nbytes
 
+    def read(self, offset, nbytes):
+        """Byte-addressable read at any offset (syncs dirty device slots)."""
+        if offset < 0 or nbytes < 0 or offset + nbytes > self.byte_size:
+            raise InferenceServerException(
+                f"read of {nbytes} bytes at offset {offset} overruns TPU "
+                f"region '{self.name}' ({self.byte_size} bytes)"
+            )
+        with self._lock:
+            self._sync_dirty(offset, nbytes)
+            return self._window.read(offset, nbytes)
+
+    def write(self, offset, data):
+        """Byte-addressable write (drops any device slots it overlaps)."""
+        if offset < 0 or offset + len(data) > self.byte_size:
+            raise InferenceServerException(
+                f"write of {len(data)} bytes at offset {offset} overruns TPU "
+                f"region '{self.name}' ({self.byte_size} bytes)"
+            )
+        with self._lock:
+            for off, old in list(self._slots.items()):
+                if off < offset + len(data) and offset < off + _slot_nbytes(old):
+                    del self._slots[off]
+                    self._dirty.discard(off)
+            self._window.write(offset, data)
+
+    def _sync_dirty(self, offset, nbytes):
+        """Flush dirty device slots overlapping [offset, offset+nbytes) into
+        the window.  Caller holds self._lock."""
+        for off in sorted(self._dirty):
+            slot = self._slots.get(off)
+            if slot is None:
+                self._dirty.discard(off)
+                continue
+            n = _slot_nbytes(slot)
+            if off < offset + nbytes and offset < off + n:
+                host = np.asarray(slot)  # D2H sync
+                self._window.write(off, np.ascontiguousarray(host).tobytes())
+                self._dirty.discard(off)
+
     def read_array(self, offset, byte_size, datatype=None, shape=None):
-        """Zero-copy read: the stored array at ``offset`` if compatible,
-        else a numpy reconstruction from raw slot bytes."""
+        """Zero-copy read when the stored device array at ``offset`` matches;
+        byte-window reconstruction for any other offset/dtype/shape."""
         with self._lock:
             a = self._slots.get(offset)
-        if a is None:
-            raise InferenceServerException(
-                f"no tensor at offset {offset} of TPU region '{self.name}'"
-            )
         if datatype is None:
+            if a is None:
+                raise InferenceServerException(
+                    f"no tensor at offset {offset} of TPU region '{self.name}'"
+                )
             return a
         if datatype == "BYTES":
             if isinstance(a, np.ndarray) and a.dtype == np.object_:
                 return a.reshape(shape) if shape is not None else a
-            raise InferenceServerException(
-                f"TPU region '{self.name}' slot at {offset} is not BYTES"
-            )
+            from client_tpu.utils import deserialize_bytes_tensor
+
+            raw = self.read(offset, byte_size or self.byte_size - offset)
+            arr = deserialize_bytes_tensor(raw)
+            return arr.reshape(shape) if shape is not None else arr
         np_dtype = triton_to_np_dtype(datatype)
         if np_dtype is None:
             raise InferenceServerException(f"unsupported datatype {datatype}")
         want = np.dtype(np_dtype)
-        if _slot_nbytes(a) < byte_size:
-            raise InferenceServerException(
-                f"slot at offset {offset} of TPU region '{self.name}' holds "
-                f"{_slot_nbytes(a)} bytes, request needs {byte_size}"
-            )
-        if a.dtype == want and (shape is None or list(a.shape) == list(shape)):
-            return a  # zero-copy
-        # dtype/shape reinterpretation: materialize host-side
-        host = np.asarray(a).tobytes()[:byte_size]
-        out = np.frombuffer(host, dtype=want)
+        if (
+            a is not None
+            and hasattr(a, "dtype")
+            and a.dtype == want
+            and (shape is None or list(a.shape) == list(shape))
+        ):
+            return a  # zero-copy device array
+        # any other offset/dtype/shape: reconstruct from window bytes
+        raw = self.read(offset, byte_size)
+        out = np.frombuffer(raw, dtype=want)
         return out.reshape(shape) if shape is not None else out
 
     def destroy(self):
         with self._lock:
             self._slots.clear()
-        if self._staging is not None:
-            from client_tpu.utils import shared_memory as _sysshm
-
-            _sysshm.destroy_shared_memory_region(self._staging)
-            self._staging = None
+            self._dirty.clear()
+            self._window.destroy()
 
     def raw_handle(self):
-        desc = {
-            "uuid": self.uuid,
-            "pid": os.getpid(),
-            "device_id": self.device_id,
-            "byte_size": self.byte_size,
-        }
-        if self.staging_key is not None:
-            desc["staging_key"] = self.staging_key
-        return json.dumps(desc).encode("utf-8")
+        return self._window.raw_handle()
 
 
-def _slot_nbytes(a):
-    if isinstance(a, np.ndarray) and a.dtype == np.object_:
-        return serialize_byte_tensor(a).nbytes
-    return a.dtype.itemsize * int(np.prod(a.shape))
+class TpuWindowRegion:
+    """Server-side attachment to a foreign process's region: byte window
+    only (the HBM face is not exportable across processes — reads
+    reconstruct from bytes, writes land in the window)."""
+
+    def __init__(self, descriptor):
+        self.descriptor = descriptor
+        self.byte_size = descriptor["byte_size"]
+        self._window = _Window.open(json.dumps(descriptor), self.byte_size)
+        self._lock = threading.Lock()
+
+    def read(self, offset, nbytes):
+        if offset < 0 or nbytes < 0 or offset + nbytes > self.byte_size:
+            raise InferenceServerException(
+                f"read of {nbytes} bytes at offset {offset} overruns TPU "
+                "region window"
+            )
+        with self._lock:
+            return self._window.read(offset, nbytes)
+
+    def write(self, offset, data):
+        if offset < 0 or offset + len(data) > self.byte_size:
+            raise InferenceServerException(
+                f"write of {len(data)} bytes at offset {offset} overruns TPU "
+                "region window"
+            )
+        with self._lock:
+            self._window.write(offset, data)
+
+    def read_array(self, offset, byte_size, datatype=None, shape=None):
+        from client_tpu.utils import from_wire_bytes
+
+        raw = self.read(offset, byte_size)
+        return from_wire_bytes(raw, datatype, shape)
+
+    def write_array(self, offset, arr):
+        from client_tpu.utils import np_to_triton_dtype, to_wire_bytes
+
+        host = np.asarray(arr)
+        raw = to_wire_bytes(host, np_to_triton_dtype(host.dtype))
+        self.write(offset, raw)
+        return len(raw)
+
+    def close(self):
+        # same lock as read/write: a concurrent request can never race the
+        # munmap (use-after-unmap); late calls see a closed-window error
+        with self._lock:
+            self._window.destroy()
 
 
 def resolve_inprocess(descriptor):
@@ -187,9 +389,12 @@ def resolve_inprocess(descriptor):
 
 def create_shared_memory_region(triton_shm_name, byte_size, device_id=0,
                                 staging_key=None):
-    """Allocate a TPU HBM region.  Pass ``staging_key`` to also maintain a
-    host staging mirror for cross-process servers."""
-    region = TpuRegion(triton_shm_name, byte_size, device_id, staging_key)
+    """Allocate a TPU HBM region (device slots + native host window).
+
+    ``staging_key`` is accepted for backward compatibility and ignored: every
+    region now has a native window whose shm key rides the raw handle.
+    """
+    region = TpuRegion(triton_shm_name, byte_size, device_id)
     with _broker_lock:
         _broker[region.uuid] = region
     return region
@@ -211,7 +416,8 @@ def set_shared_memory_region(shm_handle, input_values, offset=0):
 
 
 def get_contents_as_numpy(shm_handle, datatype, shape, offset=0):
-    """Materialize the tensor at ``offset`` host-side (forces D2H sync)."""
+    """Materialize the tensor at ``offset`` host-side (forces D2H sync of
+    dirty device slots overlapping the range)."""
     if isinstance(datatype, str):
         wire = datatype
     else:
@@ -220,8 +426,7 @@ def get_contents_as_numpy(shm_handle, datatype, shape, offset=0):
         wire = np_to_triton_dtype(np.dtype(datatype))
     count = int(np.prod(shape)) if len(shape) else 1
     if wire == "BYTES":
-        arr = shm_handle.read_array(offset, 0, "BYTES", shape)
-        return arr
+        return shm_handle.read_array(offset, 0, "BYTES", shape)
     itemsize = np.dtype(triton_to_np_dtype(wire)).itemsize
     arr = shm_handle.read_array(offset, count * itemsize, wire, list(shape))
     return np.asarray(arr)
@@ -241,3 +446,9 @@ def destroy_shared_memory_region(shm_handle):
     with _broker_lock:
         _broker.pop(shm_handle.uuid, None)
     shm_handle.destroy()
+
+
+def _slot_nbytes(a):
+    if isinstance(a, np.ndarray) and a.dtype == np.object_:
+        return serialize_byte_tensor(a).nbytes
+    return a.dtype.itemsize * int(np.prod(a.shape))

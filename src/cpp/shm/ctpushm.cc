@@ -1,0 +1,276 @@
+// libctpushm.so — native TPU shared-memory region component.
+//
+// Role parity with the reference wheel's native libccudashm.so
+// (/root/reference/src/python/library/tritonclient/utils/cuda_shared_memory/
+// cuda_shared_memory.cc: cudaMalloc + cudaIpcGetMemHandle + host<->device
+// copies).  PJRT has no cudaIpc-style cross-process HBM export, so the TPU
+// design splits a region into two coupled faces:
+//
+//   * an HBM face: jax.Array slots managed by the Python layer (device_put /
+//     dlpack at the edges) — the zero-copy path when client and server share
+//     a process;
+//   * a host window (this library): a POSIX-shm-backed, byte-addressable
+//     buffer that is the region's process-portable face.  Any byte range can
+//     be read or written at any offset; a server in another process attaches
+//     it by key from the raw handle.
+//
+// The raw handle (the cudaIpcMemHandle_t analog) is JSON:
+//   {"uuid", "pid", "device_id", "byte_size", "staging_key"}
+// generated here so every language binding shares one implementation.
+
+#include <errno.h>
+#include <fcntl.h>
+#include <stdio.h>
+#include <string.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstdlib>
+#include <ctime>
+#include <string>
+
+namespace {
+
+struct TpuHbmRegion {
+  std::string uuid;
+  std::string shm_key;
+  void* base = nullptr;
+  uint64_t byte_size = 0;
+  int device_id = 0;
+  int fd = -1;
+  bool owner = false;  // created (vs attached) — owner unlinks on destroy
+};
+
+thread_local std::string g_last_error;
+
+void set_errno_error(const std::string& msg) {
+  g_last_error = msg + ": " + strerror(errno);
+}
+
+std::string gen_uuid() {
+  unsigned char buf[16];
+  FILE* f = fopen("/dev/urandom", "rb");
+  if (f != nullptr) {
+    size_t got = fread(buf, 1, sizeof(buf), f);
+    fclose(f);
+    if (got != sizeof(buf)) f = nullptr;
+  }
+  if (f == nullptr) {
+    // extremely unlikely; fall back to pid+clock entropy
+    uint64_t a = static_cast<uint64_t>(getpid());
+    uint64_t b = static_cast<uint64_t>(clock());
+    memcpy(buf, &a, 8);
+    memcpy(buf + 8, &b, 8);
+  }
+  char out[33];
+  for (int i = 0; i < 16; ++i) snprintf(out + 2 * i, 3, "%02x", buf[i]);
+  return std::string(out, 32);
+}
+
+// Minimal extraction of "key": "value" / "key": number from the raw-handle
+// JSON (emitted by this library or re-serialized by a language binding, so
+// whitespace after the colon must be tolerated).
+size_t json_value_start(const std::string& js, const char* key) {
+  std::string pat = std::string("\"") + key + "\"";
+  size_t at = js.find(pat);
+  if (at == std::string::npos) return std::string::npos;
+  at += pat.size();
+  while (at < js.size() && (js[at] == ' ' || js[at] == '\t')) ++at;
+  if (at >= js.size() || js[at] != ':') return std::string::npos;
+  ++at;
+  while (at < js.size() && (js[at] == ' ' || js[at] == '\t')) ++at;
+  return at < js.size() ? at : std::string::npos;
+}
+
+bool json_string_field(const std::string& js, const char* key,
+                       std::string* out) {
+  size_t at = json_value_start(js, key);
+  if (at == std::string::npos || js[at] != '"') return false;
+  ++at;
+  size_t end = js.find('"', at);
+  if (end == std::string::npos) return false;
+  *out = js.substr(at, end - at);
+  return true;
+}
+
+bool json_uint_field(const std::string& js, const char* key, uint64_t* out) {
+  size_t at = json_value_start(js, key);
+  if (at == std::string::npos) return false;
+  char* endp = nullptr;
+  *out = strtoull(js.c_str() + at, &endp, 10);
+  return endp != js.c_str() + at;
+}
+
+}  // namespace
+
+extern "C" {
+
+enum TpuHbmStatus {
+  TPU_HBM_OK = 0,
+  TPU_HBM_ERR_OPEN = -1,
+  TPU_HBM_ERR_MAP = -2,
+  TPU_HBM_ERR_RANGE = -3,
+  TPU_HBM_ERR_HANDLE = -4,
+  TPU_HBM_ERR_PARSE = -5,
+};
+
+const char* TpuHbmLastError() { return g_last_error.c_str(); }
+
+// Create an HBM region's host window: a fresh shm segment keyed by uuid.
+void* TpuHbmRegionCreate(uint64_t byte_size, int device_id) {
+  std::string uuid = gen_uuid();
+  std::string key = "/tpushm-" + uuid;
+  int fd = shm_open(key.c_str(), O_RDWR | O_CREAT | O_EXCL, S_IRUSR | S_IWUSR);
+  if (fd < 0) {
+    set_errno_error("shm_open failed for '" + key + "'");
+    return nullptr;
+  }
+  if (ftruncate(fd, static_cast<off_t>(byte_size)) < 0) {
+    set_errno_error("ftruncate failed for '" + key + "'");
+    close(fd);
+    shm_unlink(key.c_str());
+    return nullptr;
+  }
+  void* base =
+      mmap(nullptr, byte_size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (base == MAP_FAILED) {
+    set_errno_error("mmap failed for '" + key + "'");
+    close(fd);
+    shm_unlink(key.c_str());
+    return nullptr;
+  }
+  auto* region = new TpuHbmRegion();
+  region->uuid = uuid;
+  region->shm_key = key;
+  region->base = base;
+  region->byte_size = byte_size;
+  region->device_id = device_id;
+  region->fd = fd;
+  region->owner = true;
+  return region;
+}
+
+// Attach the host window of a region created elsewhere, from its raw handle.
+void* TpuHbmRegionOpen(const char* raw_handle_json) {
+  std::string js(raw_handle_json != nullptr ? raw_handle_json : "");
+  std::string key, uuid;
+  uint64_t byte_size = 0;
+  uint64_t device_id = 0;
+  if (!json_string_field(js, "staging_key", &key) ||
+      !json_uint_field(js, "byte_size", &byte_size)) {
+    g_last_error = "raw handle missing staging_key/byte_size: " + js;
+    return nullptr;
+  }
+  json_string_field(js, "uuid", &uuid);
+  json_uint_field(js, "device_id", &device_id);
+  int fd = shm_open(key.c_str(), O_RDWR, S_IRUSR | S_IWUSR);
+  if (fd < 0) {
+    set_errno_error("shm_open failed for '" + key + "'");
+    return nullptr;
+  }
+  // Reject descriptors whose claimed byte_size exceeds the real segment:
+  // mmap past EOF would succeed but any access beyond it is a SIGBUS.
+  struct stat st;
+  if (fstat(fd, &st) != 0 ||
+      static_cast<uint64_t>(st.st_size) < byte_size) {
+    g_last_error = "region '" + key + "' is smaller than the descriptor's " +
+                   "byte_size claims";
+    close(fd);
+    return nullptr;
+  }
+  void* base =
+      mmap(nullptr, byte_size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (base == MAP_FAILED) {
+    set_errno_error("mmap failed for '" + key + "'");
+    close(fd);
+    return nullptr;
+  }
+  auto* region = new TpuHbmRegion();
+  region->uuid = uuid;
+  region->shm_key = key;
+  region->base = base;
+  region->byte_size = byte_size;
+  region->device_id = static_cast<int>(device_id);
+  region->fd = fd;
+  region->owner = false;
+  return region;
+}
+
+int TpuHbmWrite(void* handle, uint64_t offset, const void* src,
+                uint64_t size) {
+  auto* region = static_cast<TpuHbmRegion*>(handle);
+  if (region == nullptr || region->base == nullptr) return TPU_HBM_ERR_HANDLE;
+  if (offset + size > region->byte_size) {
+    g_last_error = "write overruns TPU region window";
+    return TPU_HBM_ERR_RANGE;
+  }
+  memcpy(static_cast<char*>(region->base) + offset, src, size);
+  return TPU_HBM_OK;
+}
+
+int TpuHbmRead(void* handle, uint64_t offset, void* dst, uint64_t size) {
+  auto* region = static_cast<TpuHbmRegion*>(handle);
+  if (region == nullptr || region->base == nullptr) return TPU_HBM_ERR_HANDLE;
+  if (offset + size > region->byte_size) {
+    g_last_error = "read overruns TPU region window";
+    return TPU_HBM_ERR_RANGE;
+  }
+  memcpy(dst, static_cast<char*>(region->base) + offset, size);
+  return TPU_HBM_OK;
+}
+
+void* TpuHbmBaseAddr(void* handle) {
+  auto* region = static_cast<TpuHbmRegion*>(handle);
+  return region != nullptr ? region->base : nullptr;
+}
+
+uint64_t TpuHbmByteSize(void* handle) {
+  auto* region = static_cast<TpuHbmRegion*>(handle);
+  return region != nullptr ? region->byte_size : 0;
+}
+
+int TpuHbmDeviceId(void* handle) {
+  auto* region = static_cast<TpuHbmRegion*>(handle);
+  return region != nullptr ? region->device_id : -1;
+}
+
+// Raw handle JSON into caller buffer; returns bytes written (excl. NUL) or
+// negative error.
+int TpuHbmGetRawHandle(void* handle, char* out, uint64_t capacity) {
+  auto* region = static_cast<TpuHbmRegion*>(handle);
+  if (region == nullptr) return TPU_HBM_ERR_HANDLE;
+  char buf[512];
+  int n = snprintf(buf, sizeof(buf),
+                   "{\"uuid\":\"%s\",\"pid\":%d,\"device_id\":%d,"
+                   "\"byte_size\":%llu,\"staging_key\":\"%s\"}",
+                   region->uuid.c_str(), static_cast<int>(getpid()),
+                   region->device_id,
+                   static_cast<unsigned long long>(region->byte_size),
+                   region->shm_key.c_str());
+  if (n < 0 || static_cast<uint64_t>(n) >= capacity) {
+    g_last_error = "raw handle buffer too small";
+    return TPU_HBM_ERR_RANGE;
+  }
+  memcpy(out, buf, n + 1);
+  return n;
+}
+
+int TpuHbmRegionDestroy(void* handle) {
+  auto* region = static_cast<TpuHbmRegion*>(handle);
+  if (region == nullptr) return TPU_HBM_ERR_HANDLE;
+  if (region->base != nullptr) munmap(region->base, region->byte_size);
+  if (region->fd >= 0) close(region->fd);
+  int rc = TPU_HBM_OK;
+  if (region->owner) {
+    if (shm_unlink(region->shm_key.c_str()) < 0) {
+      set_errno_error("shm_unlink failed for '" + region->shm_key + "'");
+      rc = TPU_HBM_ERR_OPEN;
+    }
+  }
+  delete region;
+  return rc;
+}
+
+}  // extern "C"
