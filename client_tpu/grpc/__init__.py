@@ -10,6 +10,7 @@ API from client_tpu._grpc_service (no grpcio-tools codegen).
 """
 
 import queue
+import re
 import threading
 
 import grpc
@@ -63,6 +64,16 @@ def raise_error_grpc(rpc_error):
         status=str(rpc_error.code().name),
         debug_details=rpc_error,
     ) from None
+
+
+def _stream_error(error_message):
+    """ModelStreamInferResponse.error_message -> exception.  The server
+    encodes any status code as a leading "[<status>] " prefix (the wire type
+    has no status field); strip it back out."""
+    m = re.match(r"\[([A-Za-z0-9_]+)\] (.*)", error_message, re.DOTALL)
+    if m:
+        return InferenceServerException(m.group(2), status=m.group(1))
+    return InferenceServerException(error_message)
 
 
 def _channel_options(keepalive_options=None, channel_args=None):
@@ -136,7 +147,7 @@ class _InferStream:
         try:
             for response in self._response_iterator:
                 error = (
-                    InferenceServerException(response.error_message)
+                    _stream_error(response.error_message)
                     if response.error_message
                     else None
                 )

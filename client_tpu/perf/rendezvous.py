@@ -85,8 +85,39 @@ class Rendezvous:
                 conn, _ = srv.accept()
             except socket.timeout:
                 continue
-            hello = _recv_frame(conn)
-            self._peers[hello["rank"]] = conn
+            # Validate the hello: a duplicate or out-of-range rank would
+            # silently evict a legitimate peer (all_gather then hangs or
+            # mis-orders); reject the connection instead.  Any handshake
+            # failure (garbage bytes, early disconnect, RST on the reject
+            # send) only drops THAT connection — a port scanner or crashing
+            # peer must not abort the whole rendezvous.
+            try:
+                hello = _recv_frame(conn)
+                peer = hello.get("rank")
+                if (
+                    not isinstance(peer, int)
+                    or isinstance(peer, bool)
+                    or not (1 <= peer < self.world_size)
+                ):
+                    _send_frame(
+                        conn,
+                        {"error": f"invalid rank {peer!r} for world size "
+                                  f"{self.world_size}"},
+                    )
+                    conn.close()
+                    continue
+                if peer in self._peers:
+                    _send_frame(conn, {"error": f"rank {peer} already joined"})
+                    conn.close()
+                    continue
+                _send_frame(conn, {"ok": True})
+            except (InferenceServerException, OSError, ValueError):
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                continue
+            self._peers[peer] = conn
 
     def _connect(self, timeout_s):
         deadline = time.monotonic() + timeout_s
@@ -98,6 +129,12 @@ class Rendezvous:
                 )
                 sock.settimeout(timeout_s)
                 _send_frame(sock, {"rank": self.rank})
+                ack = _recv_frame(sock)
+                if "error" in ack:
+                    sock.close()
+                    raise InferenceServerException(
+                        f"rendezvous rejected rank {self.rank}: {ack['error']}"
+                    )
                 self._sock = sock
                 return
             except OSError as e:

@@ -75,6 +75,11 @@ class ModelBatcher:
         self.max_queue_delay_s = max_queue_delay_s
         self._cond = threading.Condition()
         self._queue = deque()
+        # Requests popped off the queue but not yet completed/failed (gathered
+        # group + the in-flight pipelined batch).  Tracked so the _loop
+        # BaseException handler can fail them too — otherwise a KeyboardInterrupt
+        # /MemoryError between _gather and _fail strands those waiters forever.
+        self._active = set()
         self._closed = False
         self._thread = threading.Thread(
             target=self._loop, name=f"batcher-{model.name}", daemon=True
@@ -153,8 +158,11 @@ class ModelBatcher:
         except BaseException:  # noqa: BLE001 - a dead batcher must not strand waiters
             with self._cond:
                 self._closed = True
-                leftovers = list(self._queue)
+                leftovers = list(self._queue) + [
+                    p for p in self._active if not p.event.is_set()
+                ]
                 self._queue.clear()
+                self._active.clear()
             err = InferenceServerException(
                 f"model '{self.model.name}' batcher thread died", status="500"
             )
@@ -199,6 +207,7 @@ class ModelBatcher:
                     return None
                 self._cond.wait()
             first = self._queue.popleft()
+            self._active.add(first)
             group = [first]
             rows = first.rows
             deadline = time.monotonic() + self.max_queue_delay_s
@@ -208,6 +217,7 @@ class ModelBatcher:
                 for i, p in enumerate(self._queue):
                     if p.signature == first.signature and rows + p.rows <= self.max_batch:
                         del self._queue[i]
+                        self._active.add(p)
                         group.append(p)
                         rows += p.rows
                         taken = True
@@ -262,6 +272,8 @@ class ModelBatcher:
                 }
                 offset += p.rows
                 p.event.set()
+            with self._cond:
+                self._active.difference_update(group)
             t1 = time.monotonic_ns()
             queue_ns = sum(t_in - p.t_enq for p in group)
             self.stats.record_batched(
@@ -287,6 +299,8 @@ class ModelBatcher:
         for p in group:
             p.error = err
             p.event.set()
+        with self._cond:
+            self._active.difference_update(group)
 
 
 def _leading_rows(inputs):

@@ -386,7 +386,10 @@ class _Handlers:
                         )
                     )
             except InferenceServerException as e:
-                err = pb.ModelStreamInferResponse(error_message=e.message())
+                # ModelStreamInferResponse carries only a message string, so
+                # the status rides as a "[<status>] " prefix (str(e) form);
+                # the client strips it back into InferenceServerException.status
+                err = pb.ModelStreamInferResponse(error_message=str(e))
                 err.infer_response.id = request.id
                 yield err
             except Exception as e:  # pragma: no cover - defensive

@@ -192,8 +192,9 @@ class ModelStats:
                 self.fail_ns += total_ns
 
     def record_batched(self, rows, infer_ns, input_ns, output_ns, queue_ns):
-        """One dynamic-batched execution.  Per-request success/fail outcomes
-        are recorded separately by record_request once rendering finishes."""
+        """One dynamic-batched execution.  Per-request success outcomes are
+        recorded separately by record_request_success once rendering finishes;
+        failures go through record(False, ...) in execute()."""
         with self.lock:
             self.inference_count += rows
             self.execution_count += 1
@@ -203,15 +204,13 @@ class ModelStats:
             self.queue_ns += queue_ns
             self.last_inference_ms = int(time.time() * 1000)
 
-    def record_request(self, ok, total_ns):
-        """Outcome of one request served through the batched path."""
+    def record_request_success(self, total_ns):
+        """One successful request served through the batched path.  Failures
+        on that path are counted by ``record(False, ...)`` in execute()'s
+        except clauses, exactly once, like every other failure."""
         with self.lock:
-            if ok:
-                self.success_count += 1
-                self.success_ns += total_ns
-            else:
-                self.fail_count += 1
-                self.fail_ns += total_ns
+            self.success_count += 1
+            self.success_ns += total_ns
 
     def to_json(self, name, version):
         with self.lock:
@@ -657,14 +656,15 @@ class InferenceEngine:
             context = self._sequence_context(params)
             t_in1 = time.monotonic_ns()
             if _batchable_request(model, inputs, params, context, request):
-                # The batcher records execution-level statistics; the
-                # per-request outcome is recorded here so a rendering failure
-                # is counted exactly once (by the except clauses below).
+                # The batcher records execution-level statistics; per-request
+                # success is recorded here, and any failure (batched execution
+                # or rendering) falls through to the except clauses below so
+                # it is counted exactly once.
                 result = self._batcher_for(model).submit(inputs)
                 rendered = self._render_response(
                     model, model_version, request, result
                 )
-                stats.record_request(True, time.monotonic_ns() - t0)
+                stats.record_request_success(time.monotonic_ns() - t0)
                 return rendered
             result = model.fn(inputs, params, context)
             if model.decoupled:

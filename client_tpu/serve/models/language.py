@@ -19,6 +19,7 @@ import jax
 
 from client_tpu.serve.model_runtime import Model, TensorSpec
 from client_tpu.serve.models import transformer as tfm
+from client_tpu.utils import InferenceServerException
 
 # byte-level vocab: 256 bytes + BOS + EOS
 _BOS = 256
@@ -97,7 +98,21 @@ class _LmRunner:
         )
         self.params = tfm.init_params(jax.random.PRNGKey(seed), self.cfg)
 
+    def check_prompt(self, n_prompt_tokens):
+        """Reject prompts the KV cache cannot hold with a clear 400 instead
+        of an opaque shape error out of the jitted prefill (r1 advisor)."""
+        if n_prompt_tokens >= self.cfg.max_seq:
+            raise InferenceServerException(
+                f"prompt of {n_prompt_tokens} tokens exceeds the model's "
+                f"maximum context of {self.cfg.max_seq} (need at least one "
+                "free slot to generate)",
+                status="400",
+            )
+        if n_prompt_tokens == 0:
+            raise InferenceServerException("empty prompt", status="400")
+
     def stream(self, tokens, max_tokens, temperature=0.0, seed=0):
+        self.check_prompt(int(np.asarray(tokens).reshape(-1).shape[0]))
         key = jax.random.PRNGKey(seed) if temperature > 0 else None
         for tok in tfm.generate(
             self.params, self.cfg, tokens, max_tokens,

@@ -138,7 +138,8 @@ class _Window:
     def write(self, offset, data):
         if offset < 0:
             raise InferenceServerException(f"negative offset {offset}")
-        buf = bytes(data) if not isinstance(data, (bytes, bytearray)) else data
+        # bytearray must be converted too: ctypes c_void_p rejects it
+        buf = data if isinstance(data, bytes) else bytes(data)
         rc = self._lib.TpuHbmWrite(self._live(), offset, buf, len(buf))
         if rc != 0:
             raise InferenceServerException(
@@ -229,9 +230,14 @@ class TpuRegion:
                 f"region '{self.name}' ({self.byte_size} bytes)"
             )
         with self._lock:
-            # drop slots this write fully or partially overlaps
+            # drop slots this write fully or partially overlaps; a dirty slot
+            # is flushed to the window first so its non-overlapped bytes
+            # survive (the byte-addressable contract: only the overlapping
+            # range may be overlaid by the new write)
             for off, old in list(self._slots.items()):
                 if off < offset + nbytes and offset < off + _slot_nbytes(old):
+                    if off in self._dirty:
+                        self._flush_slot_locked(off, old)
                     del self._slots[off]
                     self._dirty.discard(off)
             self._slots[offset] = stored
@@ -262,9 +268,16 @@ class TpuRegion:
         with self._lock:
             for off, old in list(self._slots.items()):
                 if off < offset + len(data) and offset < off + _slot_nbytes(old):
+                    if off in self._dirty:
+                        self._flush_slot_locked(off, old)
                     del self._slots[off]
                     self._dirty.discard(off)
             self._window.write(offset, data)
+
+    def _flush_slot_locked(self, off, slot):
+        """D2H-sync one device slot's bytes into the window (lock held)."""
+        host = np.asarray(slot)
+        self._window.write(off, np.ascontiguousarray(host).tobytes())
 
     def _sync_dirty(self, offset, nbytes):
         """Flush dirty device slots overlapping [offset, offset+nbytes) into
@@ -276,8 +289,7 @@ class TpuRegion:
                 continue
             n = _slot_nbytes(slot)
             if off < offset + nbytes and offset < off + n:
-                host = np.asarray(slot)  # D2H sync
-                self._window.write(off, np.ascontiguousarray(host).tobytes())
+                self._flush_slot_locked(off, slot)
                 self._dirty.discard(off)
 
     def read_array(self, offset, byte_size, datatype=None, shape=None):

@@ -59,11 +59,24 @@ InferenceServerHttpClient::InferenceServerHttpClient(
   std::string stripped = url;
   auto scheme = stripped.find("://");
   if (scheme != std::string::npos) stripped = stripped.substr(scheme + 3);
-  auto colon = stripped.rfind(':');
-  if (colon == std::string::npos) return;
-  host_ = stripped.substr(0, colon);
+  std::string port_str;
+  if (!stripped.empty() && stripped.front() == '[') {
+    // RFC 3986 bracketed IPv6 literal: [::1]:8000
+    auto close = stripped.find(']');
+    if (close == std::string::npos) return;
+    host_ = stripped.substr(1, close - 1);
+    if (close + 1 >= stripped.size() || stripped[close + 1] != ':') return;
+    port_str = stripped.substr(close + 2);
+  } else {
+    auto colon = stripped.rfind(':');
+    if (colon == std::string::npos) return;
+    // a second ':' means an unbracketed IPv6 literal — ambiguous, reject
+    if (stripped.find(':') != colon) return;
+    host_ = stripped.substr(0, colon);
+    port_str = stripped.substr(colon + 1);
+  }
   try {
-    port_ = std::stoi(stripped.substr(colon + 1));
+    port_ = std::stoi(port_str);
   }
   catch (...) {
     port_ = 0;
@@ -121,6 +134,12 @@ InferenceServerHttpClient::Request(
     const std::string& body, const std::map<std::string, std::string>& headers)
 {
   for (int attempt = 0; attempt < 2; ++attempt) {
+    // A request may only be retried when it was written to a REUSED
+    // keep-alive connection and ZERO response bytes arrived: then the server
+    // closed the idle connection before reading our request, so it cannot
+    // have executed.  A drop on a fresh connection, or after any response
+    // byte, may mean the request already ran — retrying would double-infer.
+    const bool reused_connection = (fd_ >= 0);
     Error err = EnsureConnected();
     if (!err.IsOk()) return err;
 
@@ -151,27 +170,35 @@ InferenceServerHttpClient::Request(
       if (write_failed) break;
     }
     if (write_failed) {
-      CloseSocket();  // stale keep-alive connection: reconnect and retry once
-      continue;
+      CloseSocket();
+      if (reused_connection && attempt == 0) {
+        continue;  // stale keep-alive: request was never read, safe to resend
+      }
+      return Error("failed to send request to " + host_);
     }
 
     // read response: status line + headers, then Content-Length body
     std::string buf;
     size_t header_end = std::string::npos;
     char chunk[8192];
+    bool read_closed = false;
     while (header_end == std::string::npos) {
       ssize_t n = ::recv(fd_, chunk, sizeof(chunk), 0);
       if (n <= 0) {
         CloseSocket();
-        buf.clear();
+        read_closed = true;
         break;
       }
       buf.append(chunk, static_cast<size_t>(n));
       header_end = buf.find("\r\n\r\n");
     }
-    if (buf.empty()) {
-      if (attempt == 0) continue;  // server closed keep-alive; retry
-      return Error("connection closed by server");
+    if (read_closed) {
+      if (buf.empty() && reused_connection && attempt == 0) {
+        continue;  // idle keep-alive closed under us with nothing received
+      }
+      return Error(
+          buf.empty() ? "connection closed by server"
+                      : "connection closed mid-response");
     }
 
     response->headers.clear();

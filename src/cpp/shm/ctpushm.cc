@@ -202,7 +202,8 @@ int TpuHbmWrite(void* handle, uint64_t offset, const void* src,
                 uint64_t size) {
   auto* region = static_cast<TpuHbmRegion*>(handle);
   if (region == nullptr || region->base == nullptr) return TPU_HBM_ERR_HANDLE;
-  if (offset + size > region->byte_size) {
+  // overflow-safe: offset + size can wrap uint64
+  if (size > region->byte_size || offset > region->byte_size - size) {
     g_last_error = "write overruns TPU region window";
     return TPU_HBM_ERR_RANGE;
   }
@@ -213,7 +214,8 @@ int TpuHbmWrite(void* handle, uint64_t offset, const void* src,
 int TpuHbmRead(void* handle, uint64_t offset, void* dst, uint64_t size) {
   auto* region = static_cast<TpuHbmRegion*>(handle);
   if (region == nullptr || region->base == nullptr) return TPU_HBM_ERR_HANDLE;
-  if (offset + size > region->byte_size) {
+  // overflow-safe: offset + size can wrap uint64
+  if (size > region->byte_size || offset > region->byte_size - size) {
     g_last_error = "read overruns TPU region window";
     return TPU_HBM_ERR_RANGE;
   }

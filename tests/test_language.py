@@ -59,6 +59,35 @@ def test_encode_decode_round_trip():
     assert decode_tokens(toks) == text
 
 
+def test_overlong_prompt_is_client_error(client, runner):
+    """r1 advisor: a prompt beyond the model's max context must surface as a
+    400 InferenceServerException, not an opaque jit shape failure."""
+    from client_tpu.utils import InferenceServerException
+
+    too_long = np.arange(runner.cfg.max_seq + 8, dtype=np.int32) % 255
+    inp_tok = grpcclient.InferInput("TOKENS", [len(too_long)], "INT32")
+    inp_tok.set_data_from_numpy(too_long)
+    inp_max = grpcclient.InferInput("MAX_TOKENS", [1], "INT32")
+    inp_max.set_data_from_numpy(np.array([4], dtype=np.int32))
+    results = queue.Queue()
+    client.start_stream(callback=lambda result, error: results.put(error))
+    try:
+        client.async_stream_infer("lm_streaming", [inp_tok, inp_max])
+        err = results.get(timeout=30)
+    finally:
+        client.stop_stream()
+    assert isinstance(err, InferenceServerException)
+    assert "maximum context" in str(err)
+    assert err.status() in ("400", "INVALID_ARGUMENT")
+
+
+def test_empty_prompt_is_client_error(runner):
+    from client_tpu.utils import InferenceServerException
+
+    with pytest.raises(InferenceServerException, match="empty prompt"):
+        list(runner.stream(np.array([], dtype=np.int32), 4))
+
+
 def test_tokenizer_model_batch(client):
     texts = np.array([b"ab", b"wxyz"], dtype=np.object_)
     inp = grpcclient.InferInput("TEXT", [2], "BYTES")

@@ -1,9 +1,11 @@
 """Server /metrics endpoint, perf MetricsManager, multi-rank rendezvous."""
 
+import queue
 import socket
 import subprocess
 import sys
 import threading
+import time
 import urllib.request
 
 import numpy as np
@@ -110,6 +112,72 @@ class TestRendezvous:
     def test_bad_rank_rejected(self):
         with pytest.raises(InferenceServerException):
             Rendezvous(5, 2)
+
+    def test_duplicate_and_out_of_range_hellos_rejected(self):
+        """r1 advisor: rank 0 must reject duplicate / out-of-range / garbage
+        hellos instead of silently evicting a legitimate peer or crashing."""
+        addr = f"127.0.0.1:{_free_port()}"
+        world = 3
+        gathered = [None] * world
+        rvs = {}
+
+        t0 = threading.Thread(
+            target=lambda: rvs.setdefault(
+                0, Rendezvous(0, world, addr, connect_timeout_s=30.0)
+            )
+        )
+        t0.start()
+        time.sleep(0.2)
+        # garbage on the wire: connect-and-close, then a non-frame byte —
+        # neither may abort the rendezvous
+        port = int(addr.rsplit(":", 1)[1])
+        with socket.create_connection(("127.0.0.1", port), timeout=10):
+            pass
+        with socket.create_connection(("127.0.0.1", port), timeout=10) as gs:
+            gs.sendall(b"\x01")
+        # out-of-range hello on the wire (bypasses the ctor range check)
+        assert _raw_hello(addr, rank=7) == "rejected"
+        # real rank 1 joins (ctor returns once its hello is ack'd) ...
+        rvs[1] = Rendezvous(1, world, addr, connect_timeout_s=30.0)
+        # ... so this duplicate hello must hit the already-joined branch
+        assert _raw_hello(addr, rank=1) == "rejected"
+        # the final rank completes the world
+        rvs[2] = Rendezvous(2, world, addr, connect_timeout_s=30.0)
+        t0.join(timeout=30)
+        assert 0 in rvs
+
+        def gather(rank):
+            gathered[rank] = rvs[rank].all_gather(f"r{rank}")
+
+        threads = [
+            threading.Thread(target=gather, args=(r,)) for r in range(world)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        for rv in rvs.values():
+            rv.close()
+        expected = ["r0", "r1", "r2"]
+        assert gathered == [expected] * world
+
+
+def _raw_hello(addr, rank):
+    """Send a hello frame with an arbitrary rank; how rank 0 answered."""
+    import json as _json
+    import struct
+
+    host, _, port = addr.rpartition(":")
+    with socket.create_connection((host, int(port)), timeout=10) as s:
+        payload = _json.dumps({"rank": rank}).encode()
+        s.sendall(struct.pack("<I", len(payload)) + payload)
+        s.settimeout(10)
+        hdr = s.recv(4)
+        if len(hdr) < 4:
+            return "closed"
+        (n,) = struct.unpack("<I", hdr)
+        resp = _json.loads(s.recv(n).decode())
+        return "rejected" if "error" in resp else "accepted"
 
 
 class TestMultiRankCli:

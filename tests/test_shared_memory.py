@@ -254,6 +254,49 @@ class TestTpuRegionByteSemantics:
         finally:
             tpushm.destroy_shared_memory_region(h)
 
+    def test_partial_overlap_of_dirty_device_slot_flushes_first(self):
+        """ADVICE r2 (medium): a byte write overlapping a *dirty* device slot
+        must flush the slot's bytes to the window first, so the slot's
+        non-overlapped bytes survive the overlay."""
+        import jax
+
+        h = tpushm.create_shared_memory_region("tpu_bytes4", 256)
+        try:
+            dev = jax.device_put(np.arange(16, dtype=np.float32))  # 64B dirty
+            h.write_array(0, dev)
+            h.write(32, np.full(8, 9, dtype=np.float32).tobytes())
+            head = tpushm.get_contents_as_numpy(h, np.float32, [8], offset=0)
+            np.testing.assert_array_equal(head, np.arange(8, dtype=np.float32))
+            tail = tpushm.get_contents_as_numpy(h, np.float32, [8], offset=32)
+            np.testing.assert_array_equal(tail, np.full(8, 9, dtype=np.float32))
+        finally:
+            tpushm.destroy_shared_memory_region(h)
+
+    def test_partial_overlap_by_device_write_flushes_first(self):
+        """Same contract when the overlapping write is itself a device write."""
+        import jax
+
+        h = tpushm.create_shared_memory_region("tpu_bytes5", 256)
+        try:
+            h.write_array(0, jax.device_put(np.arange(16, dtype=np.float32)))
+            h.write_array(32, jax.device_put(np.full(8, 5, dtype=np.float32)))
+            head = tpushm.get_contents_as_numpy(h, np.float32, [8], offset=0)
+            np.testing.assert_array_equal(head, np.arange(8, dtype=np.float32))
+            mid = tpushm.get_contents_as_numpy(h, np.float32, [8], offset=32)
+            np.testing.assert_array_equal(mid, np.full(8, 5, dtype=np.float32))
+        finally:
+            tpushm.destroy_shared_memory_region(h)
+
+    def test_bytearray_write_accepted(self):
+        """ADVICE r2 (low): bytearray input must not raise ctypes.ArgumentError."""
+        h = tpushm.create_shared_memory_region("tpu_bytes6", 64)
+        try:
+            h.write(0, bytearray(np.arange(8, dtype=np.int32).tobytes()))
+            back = tpushm.get_contents_as_numpy(h, np.int32, [8])
+            np.testing.assert_array_equal(back, np.arange(8, dtype=np.int32))
+        finally:
+            tpushm.destroy_shared_memory_region(h)
+
     def test_raw_handle_fields(self):
         h = tpushm.create_shared_memory_region("tpu_bytes3", 128, device_id=0)
         try:
