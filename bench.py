@@ -1,18 +1,24 @@
 #!/usr/bin/env python
-"""End-to-end benchmark: KServe-v2 infer round trips with TPU shared memory.
+"""End-to-end benchmark: the north-star config driven by the perf harness.
 
-The north-star config (BASELINE.json: "perf_analyzer infer/sec + p50/p99
-latency, TPU-shm vs system-shm"): the CNN classifier (BASELINE.md config-2
-shape — image in, class scores out) served in-process, driven over gRPC at
-fixed concurrency with inputs/outputs resident in TPU HBM via
-client_tpu.utils.tpu_shared_memory.  Each request carries only region
-references — no tensor bytes on the wire, no per-request H2D/D2H — so
-dispatches pipeline on the device queue.  The measurement window ends with a
-drain (D2H sync on every output region) so throughput counts only completed
-device work.
+BASELINE.json metric: "perf_analyzer infer/sec + p50/p99 latency, TPU-shm vs
+system-shm".  This script IS that measurement: the CNN classifier
+(BASELINE.md config-2 shape) served in-process over real gRPC sockets, driven
+by ``client_tpu.perf``'s own machinery — ClientBackendFactory → DataLoader →
+TpuShmInferDataManager → ConcurrencyManager → InferenceProfiler — exactly
+the stack behind ``python -m client_tpu.perf -i grpc --shared-memory tpu``.
 
-Also measures the wire-tensor path (tensor bytes in every request) for the
-vs-system comparison, reported as extra keys.
+Headline: drain-corrected completion throughput (profiler.profile_completion)
+— requests carry only TPU-region references, dispatches pipeline on the
+device queue, and the window only closes after a D2H drain, so infer/sec
+counts completed device work, not dispatch acks.  The server's duty cycle
+(BusyTracker: wall-clock fraction with >=1 execution in flight) is reported
+alongside.
+
+Wire mode (tensor bytes every request) runs the profiler's standard
+stability loop for the vs-system comparison, plus link characterization so
+wire numbers can be judged against the physical ceiling of the host<->device
+path.
 
 vs_baseline compares TPU-shm infer/sec against the reference perf_analyzer
 doc example (69.6 infer/sec — /root/reference/src/c++/perf_analyzer/
@@ -23,7 +29,6 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 
 import json
 import sys
-import threading
 import time
 
 import numpy as np
@@ -81,113 +86,106 @@ def _measure_link():
     }
 
 
-def _run_mode(
-    url,
-    image,
-    use_tpu_shm,
-    model_name="cnn_classifier",
-    concurrency=None,
-    completion_sync=False,
-):
-    """Drive the model at fixed concurrency.
+class _Harness:
+    """The client_tpu.perf object graph for one model + transport config."""
 
-    ``completion_sync`` (TPU-shm mode): after each RPC ack, force a D2H read
-    of the output region so the recorded latency covers request *completion*,
-    not dispatch acknowledgement — the honest per-request latency the r01
-    review asked for (ack-latency still reported by the default mode).
-    """
-    import client_tpu.grpc as grpcclient
-    from client_tpu.utils import tpu_shared_memory as tpushm
-
-    n_workers = concurrency or (CONCURRENCY if use_tpu_shm else WIRE_CONCURRENCY)
-    stop = threading.Event()
-    measuring = threading.Event()
-    lock = threading.Lock()
-    latencies = []
-    out_regions = []
-
-    setup = grpcclient.InferenceServerClient(url)
-    if use_tpu_shm:
-        h_in = tpushm.create_shared_memory_region("bench_in", image.nbytes)
-        tpushm.set_shared_memory_region(h_in, [image])  # one-time H2D
-        setup.register_tpu_shared_memory(
-            "bench_in", tpushm.get_raw_handle(h_in), 0, image.nbytes
+    def __init__(self, url, model_name, shared_memory, concurrency,
+                 output_shm_bytes=0, completion_sync=False):
+        from client_tpu.perf import (
+            BackendKind,
+            ClientBackendFactory,
+            ConcurrencyManager,
+            DataLoader,
+            InferenceProfiler,
+            create_infer_data_manager,
         )
-        for w in range(n_workers):
-            h = tpushm.create_shared_memory_region(f"bench_out{w}", _OUT_BYTES)
-            setup.register_tpu_shared_memory(
-                f"bench_out{w}", tpushm.get_raw_handle(h), 0, _OUT_BYTES
-            )
-            out_regions.append(h)
 
-    def worker(widx):
-        client = grpcclient.InferenceServerClient(url)
-        inp = grpcclient.InferInput("INPUT0", list(image.shape), "FP32")
-        if use_tpu_shm:
-            inp.set_shared_memory("bench_in", image.nbytes)
-            out = grpcclient.InferRequestedOutput("OUTPUT0")
-            out.set_shared_memory(f"bench_out{widx}", _OUT_BYTES)
-        else:
-            inp.set_data_from_numpy(image)
-            out = grpcclient.InferRequestedOutput("OUTPUT0")
-        while not stop.is_set():
-            t0 = time.perf_counter()
-            result = client.infer(model_name, [inp], outputs=[out])
-            if use_tpu_shm:
-                if completion_sync:
-                    scores = tpushm.get_contents_as_numpy(
-                        out_regions[widx], "FP32", [1, 1000]
-                    )
-                    assert scores.shape == (1, 1000), scores.shape
-            else:
-                scores = result.as_numpy("OUTPUT0")
-                assert scores.shape == (1, 1000), scores.shape
-            dt = time.perf_counter() - t0
-            if measuring.is_set():
-                with lock:
-                    latencies.append(dt)
-        client.close()
+        def factory():
+            return ClientBackendFactory.create(BackendKind.TRITON_GRPC, url=url)
 
-    threads = [
-        threading.Thread(target=worker, args=(w,), daemon=True)
-        for w in range(n_workers)
-    ]
-    for t in threads:
-        t.start()
-    time.sleep(WARMUP_S)
-    measuring.set()
-    t_start = time.perf_counter()
-    time.sleep(MEASURE_S)
-    measuring.clear()
-    stop.set()
-    for t in threads:
-        t.join(timeout=30)
-    if use_tpu_shm and latencies:
-        # drain: all dispatched device work must be complete and visible
-        for h in out_regions:
-            try:
-                scores = tpushm.get_contents_as_numpy(h, "FP32", [1, 1000])
-                assert scores.shape == (1, 1000)
-            except Exception as e:  # a dead worker left this region unwritten
-                print(f"warning: drain of {h.name} failed: {e}", file=sys.stderr)
-    elapsed = time.perf_counter() - t_start
+        self.control = factory()
+        meta = self.control.model_metadata(model_name, "")
+        inputs_meta = [dict(m) for m in meta["inputs"]]
+        outputs_meta = [dict(m) for m in meta["outputs"]]
+        for m in inputs_meta:
+            dims = [int(d) for d in m["shape"]]
+            if dims and dims[0] == -1:
+                dims[0] = 1
+            m["shape"] = dims
+        loader = DataLoader(inputs_meta, batch_size=1)
+        loader.generate_data()
+        self.data_manager = create_infer_data_manager(
+            self.control, loader, inputs_meta, outputs_meta,
+            shared_memory=shared_memory,
+            output_shm_byte_size=output_shm_bytes,
+            tpu_completion_sync=completion_sync,
+        )
+        self.data_manager.init()
+        self.manager = ConcurrencyManager(
+            backend_factory=factory,
+            data_loader=loader,
+            data_manager=self.data_manager,
+            model_name=model_name,
+            max_threads=concurrency,
+        )
+        self.profiler = InferenceProfiler(
+            self.manager,
+            backend=self.control,
+            measurement_window_s=2.0,
+            max_trials=4,
+            stability_threshold=0.25,
+        )
 
-    if use_tpu_shm:
-        setup.unregister_tpu_shared_memory()
-        for h in out_regions:
-            tpushm.destroy_shared_memory_region(h)
-        tpushm.destroy_shared_memory_region(h_in)
-    setup.close()
+    def close(self):
+        self.manager.cleanup()
+        try:
+            self.control.close()
+        except Exception:
+            pass
 
-    lat = np.asarray(latencies)
-    if lat.size == 0:
-        return {"infer_per_sec": 0.0, "p50_ms": 0.0, "p99_ms": 0.0, "n": 0}
+
+def _status_dict(status):
     return {
-        "infer_per_sec": lat.size / elapsed,
-        "p50_ms": float(np.percentile(lat, 50)) * 1e3,
-        "p99_ms": float(np.percentile(lat, 99)) * 1e3,
-        "n": int(lat.size),
+        "infer_per_sec": status.throughput,
+        "p50_ms": status.percentiles_us.get(50, 0.0) / 1e3,
+        "p99_ms": status.percentiles_us.get(99, 0.0) / 1e3,
+        "n": status.completed_requests,
+        "errors": status.error_count,
     }
+
+
+def _run_tpu_shm(server, completion_sync=False):
+    """TPU-shm mode through the harness; headline = drained completion."""
+    h = _Harness(
+        server.grpc_address, "cnn_classifier", "tpu", CONCURRENCY,
+        output_shm_bytes=_OUT_BYTES, completion_sync=completion_sync,
+    )
+    try:
+        busy0 = server.engine.busy.busy_ns()
+        t0 = time.monotonic_ns()
+        status = h.profiler.profile_completion(
+            CONCURRENCY, window_s=MEASURE_S, warmup_s=WARMUP_S
+        )
+        busy1 = server.engine.busy.busy_ns()
+        elapsed = time.monotonic_ns() - t0
+        out = _status_dict(status)
+        out["duty_cycle_pct"] = round(100.0 * (busy1 - busy0) / elapsed, 1)
+        return out
+    finally:
+        h.close()
+
+
+def _run_wire(server, model_name, concurrency):
+    """Wire-tensor mode: the profiler's standard stability loop (ack ==
+    completion here — the response body carries the output bytes)."""
+    h = _Harness(server.grpc_address, model_name, "none", concurrency)
+    try:
+        results = h.profiler.profile_concurrency_range(
+            concurrency, concurrency, 1
+        )
+        return _status_dict(results[0])
+    finally:
+        h.close()
 
 
 def main():
@@ -195,12 +193,6 @@ def main():
     from client_tpu.serve.models.vision import cnn_classifier_model
 
     link = _measure_link()
-
-    rng = np.random.default_rng(0)
-    image = rng.standard_normal((1, 3, IMAGE_SIZE, IMAGE_SIZE)).astype(np.float32)
-    small = rng.standard_normal((1, 3, SMALL_IMAGE_SIZE, SMALL_IMAGE_SIZE)).astype(
-        np.float32
-    )
 
     server = Server(
         models=[
@@ -213,29 +205,26 @@ def main():
         with_default_models=False,
     ).start()
     try:
-        tpu = _run_mode(server.grpc_address, image, use_tpu_shm=True)
-        tpu_sync = _run_mode(
-            server.grpc_address, image, use_tpu_shm=True, completion_sync=True
-        )
-        wire = _run_mode(server.grpc_address, image, use_tpu_shm=False)
-        wire_small = _run_mode(
-            server.grpc_address, small, use_tpu_shm=False, model_name="cnn_small"
-        )
+        tpu = _run_tpu_shm(server)
+        tpu_sync = _run_tpu_shm(server, completion_sync=True)
+        wire = _run_wire(server, "cnn_classifier", WIRE_CONCURRENCY)
+        wire_small = _run_wire(server, "cnn_small", WIRE_CONCURRENCY)
     finally:
         server.stop()
 
-    # Physical ceiling for the wire path: every request must move the image
-    # over the host<->device link, so bandwidth/bytes bounds infer/sec.
-    wire_ceiling = link["link_h2d_mbps"] * 1e6 / image.nbytes
+    image_bytes = 3 * IMAGE_SIZE * IMAGE_SIZE * 4
+    wire_ceiling = link["link_h2d_mbps"] * 1e6 / image_bytes
     result = {
         "metric": "infer_throughput_cnn224_grpc_c4_tpushm",
         "value": round(tpu["infer_per_sec"], 2),
         "unit": "infer/sec",
         "vs_baseline": round(tpu["infer_per_sec"] / _REF_INFER_PER_SEC, 3),
+        "harness": "client_tpu.perf profile_completion (drain-corrected)",
         "p50_ms": round(tpu["p50_ms"], 3),
         "p99_ms": round(tpu["p99_ms"], 3),
         "requests": tpu["n"],
         "concurrency": CONCURRENCY,
+        "duty_cycle_pct": tpu["duty_cycle_pct"],
         "sync_infer_per_sec": round(tpu_sync["infer_per_sec"], 2),
         "sync_p50_ms": round(tpu_sync["p50_ms"], 3),
         "sync_p99_ms": round(tpu_sync["p99_ms"], 3),
@@ -250,7 +239,7 @@ def main():
         **link,
     }
     print(json.dumps(result))
-    return 0 if tpu["n"] else 1
+    return 0 if tpu["n"] and not tpu["errors"] else 1
 
 
 if __name__ == "__main__":

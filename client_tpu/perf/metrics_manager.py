@@ -98,4 +98,28 @@ class MetricsManager:
                     "avg": float(np.mean(values)),
                     "max": float(np.max(values)),
                 }
+        util = MetricsManager.utilization(snapshots)
+        if util is not None:
+            summary["ctpu_server_utilization"] = {"avg": util, "max": util}
         return summary
+
+    @staticmethod
+    def utilization(snapshots):
+        """Server duty cycle over the window: delta(busy_ns) / delta(wall),
+        from the ctpu_server_busy_ns counter + scrape timestamps.  The
+        nv_gpu_utilization analog; None when fewer than two usable scrapes."""
+
+        def point(snap):
+            busy = snap.get("ctpu_server_busy_ns")
+            ts = snap.get("ctpu_scrape_timestamp_seconds")
+            if not busy or not ts:
+                return None
+            return ts[0][1], busy[0][1]
+
+        points = [p for p in (point(s) for s in snapshots) if p is not None]
+        if len(points) < 2:
+            return None
+        (t0, b0), (t1, b1) = points[0], points[-1]
+        if t1 <= t0:
+            return None
+        return max(0.0, min(1.0, (b1 - b0) / 1e9 / (t1 - t0)))

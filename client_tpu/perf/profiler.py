@@ -185,6 +185,51 @@ class InferenceProfiler:
             )
         return status
 
+    def profile_completion(self, concurrency, window_s=8.0, warmup_s=2.0):
+        """Drain-corrected completion throughput for asynchronous-dispatch
+        transports (TPU shm).
+
+        A TPU-shm request is acked at device *dispatch*; on hardware where
+        dispatch outruns execution the ack rate overstates real throughput.
+        This mode runs one fixed window at ``concurrency``, then stops the
+        workers and drains (``data_manager.sync_outputs()`` — D2H visibility
+        of every output region) before closing the clock, so the reported
+        infer/sec counts only device work that actually completed.  Latency
+        percentiles are still ack latencies (the per-request completion
+        variant is ``--tpu-shm-sync``)."""
+        self.manager.change_concurrency_level(concurrency)
+        time.sleep(warmup_s)
+        self.manager.swap_timestamps()
+        self.manager.get_and_reset_num_sent()
+        t0 = time.monotonic_ns()
+        time.sleep(window_s)
+        self.manager.stop_workers()
+        sync = getattr(self.manager.data_manager, "sync_outputs", None)
+        if sync is not None:
+            sync()
+        t1 = time.monotonic_ns()
+        records = self.manager.swap_timestamps()
+        sent = self.manager.get_and_reset_num_sent()
+        status = PerfStatus("concurrency", concurrency)
+        ok = [r for r in records if r.ok]
+        lat = np.array([r.end_ns - r.start_ns for r in ok], np.int64)
+        elapsed = (t1 - t0) / 1e9
+        status.throughput = len(ok) / elapsed if elapsed > 0 else 0.0
+        status.completed_requests = len(ok)
+        status.client_window_s = elapsed
+        status.error_count = len(records) - len(ok)
+        status.send_rate = sent / elapsed if elapsed > 0 else 0.0
+        status.stable = True  # single drained window: no stability loop
+        if lat.size:
+            status.latency_avg_us = float(lat.mean()) / 1e3
+            for p in (50, 90, 95, 99):
+                status.percentiles_us[p] = float(np.percentile(lat, p)) / 1e3
+        if self.metrics is not None:
+            status.tpu_metrics = self.metrics.summarize(
+                self.metrics.swap_snapshots()
+            )
+        return status
+
     # -- search over load levels ---------------------------------------------
 
     def profile_concurrency_range(self, start, end, step, latency_limit_us=None):

@@ -14,6 +14,7 @@ host-resident (wire) inputs.  Shared-memory requests keep the direct
 zero-copy path — batching them would force device→host materialization.
 """
 
+import sys
 import threading
 import time
 from collections import deque
@@ -51,6 +52,12 @@ def _buckets_up_to(cap):
     return sorted(set(out))
 
 
+def _is_device_array(arr):
+    """jax.Array check without importing jax on the host-only path."""
+    jax = sys.modules.get("jax")
+    return jax is not None and isinstance(arr, jax.Array)
+
+
 class _Pending:
     __slots__ = ("inputs", "rows", "signature", "event", "result", "error", "t_enq")
 
@@ -68,9 +75,10 @@ class ModelBatcher:
     """One background batcher per model: gathers concurrent requests into a
     single padded forward pass and splits the host-materialized outputs."""
 
-    def __init__(self, model, stats, max_queue_delay_s=0.003):
+    def __init__(self, model, stats, max_queue_delay_s=0.003, busy=None):
         self.model = model
         self.stats = stats
+        self._busy = busy  # engine BusyTracker (duty-cycle metric), optional
         self.max_batch = max(int(model.max_batch_size), 1)
         self.max_queue_delay_s = max_queue_delay_s
         self._cond = threading.Condition()
@@ -116,10 +124,16 @@ class ModelBatcher:
 
     def submit(self, inputs):
         """Block until the batched execution finishes; return this request's
-        slice of the outputs as host numpy arrays."""
+        slice of the outputs — host numpy arrays for wire groups, live device
+        slices for device (TPU-shm) groups."""
         rows = _leading_rows(inputs)
-        signature = tuple(
-            (name, arr.dtype.str, arr.shape[1:]) for name, arr in sorted(inputs.items())
+        # Device-resident requests batch with the jnp path (concat + split on
+        # device, no transfers) and must never mix with host groups — the
+        # signature's device flag keeps the populations apart.
+        device = all(_is_device_array(a) for a in inputs.values())
+        signature = (device,) + tuple(
+            (name, arr.dtype.str, tuple(arr.shape[1:]))
+            for name, arr in sorted(inputs.items())
         )
         pending = _Pending(inputs, rows, signature)
         with self._cond:
@@ -233,37 +247,65 @@ class ModelBatcher:
     def _dispatch(self, group):
         """Host-concat the group, pad to a power-of-two bucket, and issue the
         (asynchronous) forward.  Returns state for _complete, or None if the
-        dispatch failed (the group is already notified)."""
+        dispatch failed (the group is already notified).
+
+        The engine duty-cycle span opens here and closes in _complete/_fail:
+        the device is considered busy from issue until results land."""
         t0 = time.monotonic_ns()
+        if self._busy is not None:
+            self._busy.begin()
         try:
-            names = [name for name, _, _ in group[0].signature]
+            device = group[0].signature[0]
+            names = [name for name, _, _ in group[0].signature[1:]]
             rows = sum(p.rows for p in group)
             # rows <= max_batch by construction, so padded >= rows always.
             padded = _bucket(rows, cap=self.max_batch)
+            if device:
+                # TPU-shm path: concat + pad stay on device (one XLA op per
+                # input); the forward runs at batch=`padded` on the MXU
+                # instead of `len(group)` batch-1 dispatches.
+                import jax.numpy as jnp
+
+                concat = jnp.concatenate
+                zeros = jnp.zeros
+            else:
+                concat, zeros = np.concatenate, np.zeros
             batched = {}
             for name in names:
                 parts = [p.inputs[name] for p in group]
                 if padded > rows:
-                    pad_shape = (padded - rows,) + parts[0].shape[1:]
-                    parts.append(np.zeros(pad_shape, dtype=parts[0].dtype))
+                    pad_shape = (padded - rows,) + tuple(parts[0].shape[1:])
+                    parts.append(zeros(pad_shape, dtype=parts[0].dtype))
                 batched[name] = (
-                    np.concatenate(parts, axis=0) if len(parts) > 1 else parts[0]
+                    concat(parts, axis=0) if len(parts) > 1 else parts[0]
                 )
             t_in = time.monotonic_ns()
             result = self.model.fn(batched, {}, None)
             return group, result, rows, t0, t_in
         except Exception as e:  # noqa: BLE001 - failure propagates per-request
+            if self._busy is not None:
+                self._busy.end()
             self._fail(group, e)
             return None
 
     def _complete(self, group, result, rows, t0, t_in):
-        """Block on the batch's D2H, split rows back to requests, record stats."""
-        try:
-            # One D2H for the whole batch: materialize every output to host
-            # before splitting (device arrays would re-transfer per request).
-            import jax
+        """Split rows back to requests and record stats.
 
-            host = jax.device_get(result)
+        Wire groups block on one batch-wide D2H (device arrays would
+        re-transfer per request); device groups split into live device slices
+        — outputs flow into TPU-shm regions with no transfer at all, and the
+        dispatch stays asynchronous."""
+        busy_open = self._busy is not None
+        try:
+            if group[0].signature[0]:
+                host = result  # device group: keep everything on device
+            else:
+                import jax
+
+                host = jax.device_get(result)
+            if busy_open:
+                self._busy.end()  # results landed (or dispatch issued)
+                busy_open = False
             t_inf = time.monotonic_ns()
             offset = 0
             for p in group:
@@ -284,6 +326,8 @@ class ModelBatcher:
                 queue_ns=queue_ns,
             )
         except Exception as e:  # noqa: BLE001 - failure propagates per-request
+            if busy_open:
+                self._busy.end()  # device_get raised before the span closed
             self._fail(group, e)
 
     def _fail(self, group, e):
@@ -327,19 +371,32 @@ def batchable_request(model, inputs, params, context, request):
         return False
     if model.max_batch_size <= 1:
         return False
-    for out in request.get("outputs") or []:
-        # shm outputs stay on the direct path: batching materializes outputs
-        # host-side, which would cost the shm path its zero-copy write.
-        if "shared_memory_region" in (out.get("parameters") or {}):
-            return False
+    device = bool(inputs) and all(
+        _is_device_array(a) for a in inputs.values()
+    )
+    if not device:
+        for out in request.get("outputs") or []:
+            # shm outputs of HOST groups stay on the direct path: host-mode
+            # batching materializes outputs host-side, which would cost the
+            # shm path its zero-copy write.  Device groups render outputs as
+            # live device slices, so shm outputs batch fine there.
+            if "shared_memory_region" in (out.get("parameters") or {}):
+                return False
     rows = None
     for arr in inputs.values():
-        if not isinstance(arr, np.ndarray) or arr.dtype == np.object_:
-            return False  # device-resident (shm) or BYTES inputs: direct path
+        if isinstance(arr, np.ndarray):
+            if arr.dtype == np.object_:
+                return False  # BYTES inputs: direct path
+        elif not _is_device_array(arr):
+            return False
         if arr.ndim == 0:
             return False
         if rows is None:
             rows = arr.shape[0]
         elif arr.shape[0] != rows:
             return False
+    # mixed host/device inputs in one request keep the direct path (a device
+    # concat would silently D2H the host parts or vice versa)
+    if not device and any(_is_device_array(a) for a in inputs.values()):
+        return False
     return rows is not None and rows <= model.max_batch_size

@@ -57,6 +57,9 @@ def render_metrics(engine):
         "# TYPE ctpu_inference_duration_us counter",
         "# HELP ctpu_tpu_memory_used_bytes Device HBM bytes in use",
         "# TYPE ctpu_tpu_memory_used_bytes gauge",
+        "# HELP ctpu_server_busy_ns Wall-clock ns with >=1 model execution in"
+        " flight (duty cycle: rate(ctpu_server_busy_ns)/1e9 = utilization)",
+        "# TYPE ctpu_server_busy_ns counter",
     ]
     stats = engine.statistics()
     # engine.statistics() returns the HTTP-format bare list of model entries
@@ -87,5 +90,8 @@ def render_metrics(engine):
             f"{int(success.get('ns', 0)) // 1000}"
         )
     _device_lines(lines)
+    busy = getattr(engine, "busy", None)
+    if busy is not None:
+        lines.append(f"ctpu_server_busy_ns {busy.busy_ns()}")
     lines.append(f"ctpu_scrape_timestamp_seconds {time.time():.3f}")
     return "\n".join(lines) + "\n"

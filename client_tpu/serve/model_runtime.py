@@ -510,6 +510,48 @@ def _attach_posix_shm(key, length):
         os.close(fd)
 
 
+class BusyTracker:
+    """Wall-clock union of model-execution intervals (server duty cycle).
+
+    The TPU analog of the reference's GPU-utilization scrape
+    (metrics_manager.h:44-91): overlapping executions are unioned, so
+    busy_ns/elapsed is the fraction of wall time the server had at least one
+    model execution in flight — "is the chip being fed?" as a counter."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._active = 0
+        self._since = 0
+        self._busy_ns = 0
+
+    def begin(self):
+        with self._lock:
+            if self._active == 0:
+                self._since = time.monotonic_ns()
+            self._active += 1
+
+    def end(self):
+        with self._lock:
+            self._active -= 1
+            if self._active == 0:
+                self._busy_ns += time.monotonic_ns() - self._since
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+    def busy_ns(self):
+        with self._lock:
+            busy = self._busy_ns
+            if self._active:
+                busy += time.monotonic_ns() - self._since
+            return busy
+
+
 class InferenceEngine:
     """Model repository + request execution shared by the HTTP/gRPC frontends."""
 
@@ -519,6 +561,7 @@ class InferenceEngine:
         self._ready = {}
         self._stats = {}
         self._batchers = {}
+        self.busy = BusyTracker()
         self.shm = SharedMemoryRegistry()
         self._sequences = {}
         self.max_sequence_idle_s = max_sequence_idle_s
@@ -666,17 +709,22 @@ class InferenceEngine:
                 )
                 stats.record_request_success(time.monotonic_ns() - t0)
                 return rendered
-            result = model.fn(inputs, params, context)
             if model.decoupled:
                 responses = []
-                for partial in result:
-                    responses.append(
-                        self._render_response(model, model_version, request, partial)
-                    )
+                with self.busy:
+                    result = model.fn(inputs, params, context)
+                    for partial in result:
+                        responses.append(
+                            self._render_response(
+                                model, model_version, request, partial
+                            )
+                        )
                 # One request = one statistics entry regardless of response count.
                 t1 = time.monotonic_ns()
                 stats.record(True, t1 - t0, t1 - t_in1, t_in1 - t_in0, 0)
                 return responses
+            with self.busy:
+                result = model.fn(inputs, params, context)
             t_inf1 = time.monotonic_ns()
             rendered = self._render_response(model, model_version, request, result)
             t1 = time.monotonic_ns()
@@ -704,6 +752,7 @@ class InferenceEngine:
                     model,
                     self._stats[model.name],
                     max_queue_delay_s=model.max_queue_delay_us / 1e6,
+                    busy=self.busy,
                 )
                 self._batchers[model.name] = batcher
             return batcher

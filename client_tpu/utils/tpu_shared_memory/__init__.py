@@ -231,12 +231,17 @@ class TpuRegion:
             )
         with self._lock:
             # drop slots this write fully or partially overlaps; a dirty slot
-            # is flushed to the window first so its non-overlapped bytes
-            # survive (the byte-addressable contract: only the overlapping
-            # range may be overlaid by the new write)
+            # only PARTIALLY covered is flushed to the window first so its
+            # non-overlapped bytes survive (the byte-addressable contract).
+            # A fully-covered slot is simply replaced — flushing it would put
+            # a hidden D2H on the hot full-overwrite path (every per-request
+            # output write lands at the same offset/size).
             for off, old in list(self._slots.items()):
-                if off < offset + nbytes and offset < off + _slot_nbytes(old):
-                    if off in self._dirty:
+                old_n = _slot_nbytes(old)
+                if off < offset + nbytes and offset < off + old_n:
+                    if off in self._dirty and not (
+                        offset <= off and off + old_n <= offset + nbytes
+                    ):
                         self._flush_slot_locked(off, old)
                     del self._slots[off]
                     self._dirty.discard(off)
@@ -267,8 +272,12 @@ class TpuRegion:
             )
         with self._lock:
             for off, old in list(self._slots.items()):
-                if off < offset + len(data) and offset < off + _slot_nbytes(old):
-                    if off in self._dirty:
+                old_n = _slot_nbytes(old)
+                if off < offset + len(data) and offset < off + old_n:
+                    # flush only partially-covered dirty slots (see write_array)
+                    if off in self._dirty and not (
+                        offset <= off and off + old_n <= offset + len(data)
+                    ):
                         self._flush_slot_locked(off, old)
                     del self._slots[off]
                     self._dirty.discard(off)

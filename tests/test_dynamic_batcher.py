@@ -154,6 +154,122 @@ def test_sequence_and_device_inputs_bypass_batcher():
     assert batchable_request(model, {"IN": arr}, {}, None, req)
 
 
+def test_device_requests_fuse_on_device_with_shm_outputs():
+    """TPU-shm requests (device-resident inputs, shm outputs) batch on the
+    device path: one fused forward, outputs split as live device slices and
+    written to regions without any D2H on the dispatch path."""
+    from client_tpu.utils import tpu_shared_memory as tpushm
+
+    record = []
+    engine = InferenceEngine(models=[_echo_model(record)])
+    n_threads = 4
+    handles = []
+    try:
+        for i in range(n_threads):
+            h_in = tpushm.create_shared_memory_region(f"dev_in{i}", 16)
+            tpushm.set_shared_memory_region(
+                h_in, [np.full((1, 4), float(i + 1), dtype=np.float32)]
+            )
+            h_out = tpushm.create_shared_memory_region(f"dev_out{i}", 16)
+            engine.shm.register_tpu(
+                f"dev_in{i}", tpushm.get_raw_handle(h_in), 0, 16
+            )
+            engine.shm.register_tpu(
+                f"dev_out{i}", tpushm.get_raw_handle(h_out), 0, 16
+            )
+            handles.append((h_in, h_out))
+
+        barrier = threading.Barrier(n_threads)
+        errors = []
+
+        def run(i):
+            req = {
+                "id": "",
+                "parameters": {},
+                "inputs": [
+                    {
+                        "name": "IN",
+                        "datatype": "FP32",
+                        "shape": [1, 4],
+                        "parameters": {
+                            "shared_memory_region": f"dev_in{i}",
+                            "shared_memory_byte_size": 16,
+                        },
+                    }
+                ],
+                "outputs": [
+                    {
+                        "name": "OUT",
+                        "parameters": {
+                            "shared_memory_region": f"dev_out{i}",
+                            "shared_memory_byte_size": 16,
+                        },
+                    }
+                ],
+            }
+            barrier.wait()
+            try:
+                engine.execute("echo2x", "", req, b"")
+            except Exception as e:  # pragma: no cover - surfaced below
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=run, args=(i,)) for i in range(n_threads)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+        # fewer executions than requests proves device-side fusion happened
+        assert len(record) < n_threads
+        for i, (h_in, h_out) in enumerate(handles):
+            got = tpushm.get_contents_as_numpy(h_out, np.float32, [1, 4])
+            np.testing.assert_array_equal(
+                got, np.full((1, 4), 2.0 * (i + 1), dtype=np.float32)
+            )
+    finally:
+        engine.close()
+        for h_in, h_out in handles:
+            tpushm.destroy_shared_memory_region(h_in)
+            tpushm.destroy_shared_memory_region(h_out)
+
+
+def test_device_request_batchable_and_mixed_rejected():
+    import jax
+
+    model = _echo_model([])
+    req_shm_out = {
+        "outputs": [
+            {
+                "name": "OUT",
+                "parameters": {
+                    "shared_memory_region": "r",
+                    "shared_memory_byte_size": 16,
+                },
+            }
+        ]
+    }
+    dev = jax.device_put(np.zeros((1, 4), dtype=np.float32))
+    host = np.zeros((1, 4), dtype=np.float32)
+    # all-device inputs batch, even with shm outputs
+    assert batchable_request(model, {"IN": dev}, {}, None, req_shm_out)
+    # host inputs with shm outputs keep the direct path
+    assert not batchable_request(model, {"IN": host}, {}, None, req_shm_out)
+    # mixed host/device inputs keep the direct path
+    model2 = Model(
+        "echo2",
+        inputs=[TensorSpec("A", "FP32", [-1, 4]), TensorSpec("B", "FP32", [-1, 4])],
+        outputs=[TensorSpec("OUT", "FP32", [-1, 4])],
+        fn=lambda i, p, c: {"OUT": i["A"]},
+        max_batch_size=8,
+        dynamic_batching=True,
+    )
+    assert not batchable_request(
+        model2, {"A": dev, "B": host}, {}, None, {"outputs": []}
+    )
+
+
 def test_batcher_error_propagates_per_request():
     def fn(inputs, params, ctx):
         raise ValueError("boom")

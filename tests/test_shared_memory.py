@@ -287,6 +287,26 @@ class TestTpuRegionByteSemantics:
         finally:
             tpushm.destroy_shared_memory_region(h)
 
+    def test_full_overwrite_of_dirty_slot_skips_flush(self):
+        """The hot serving path: every request's output fully overwrites the
+        previous device slot at the same offset.  That must NOT trigger a
+        hidden D2H flush (it cost 27x throughput when it did)."""
+        import jax
+
+        h = tpushm.create_shared_memory_region("tpu_bytes7", 256)
+        try:
+            h.write_array(0, jax.device_put(np.arange(8, dtype=np.float32)))
+            calls = []
+            orig = h._window.write
+            h._window.write = lambda *a: calls.append(a) or orig(*a)
+            h.write_array(0, jax.device_put(np.full(8, 2, dtype=np.float32)))
+            assert calls == [], "full overwrite must not sync the old slot"
+            h._window.write = orig
+            back = tpushm.get_contents_as_numpy(h, np.float32, [8])
+            np.testing.assert_array_equal(back, np.full(8, 2, dtype=np.float32))
+        finally:
+            tpushm.destroy_shared_memory_region(h)
+
     def test_bytearray_write_accepted(self):
         """ADVICE r2 (low): bytearray input must not raise ctypes.ArgumentError."""
         h = tpushm.create_shared_memory_region("tpu_bytes6", 64)
