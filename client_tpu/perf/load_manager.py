@@ -147,6 +147,7 @@ class LoadManager:
         self.max_threads = max_threads
         self._threads = []  # (thread, ThreadStat, stop_event)
         self._backends = []
+        self._residual = []  # records harvested from stopped workers
         self._sent = 0
         self._sent_lock = threading.Lock()
 
@@ -154,7 +155,8 @@ class LoadManager:
 
     def swap_timestamps(self):
         """Collect and clear all worker records (load_manager.h SwapTimestamps)."""
-        out = []
+        out = self._residual
+        self._residual = []
         for _, stat, _ in self._threads:
             with stat.lock:
                 out.extend(stat.records)
@@ -212,6 +214,13 @@ class LoadManager:
             stop.set()
         for th, _, _ in self._threads:
             th.join(timeout=30)
+        # Records from the final in-flight requests outlive the worker list:
+        # profile_completion stops workers (quiescing sends before the drain)
+        # and only then swaps timestamps.
+        for _, stat, _ in self._threads:
+            with stat.lock:
+                self._residual.extend(stat.records)
+                stat.records = []
         self._threads = []
         for b in self._backends:
             try:
@@ -245,6 +254,10 @@ class ConcurrencyManager(LoadManager):
                 f"{self.max_threads}; raise --max-threads"
             )
         self.stop_workers()
+        # A new level starts with a clean slate: tail records the old level's
+        # workers produced after its last swap belong to no window (they
+        # would otherwise be counted as this level's errors).
+        self._residual = []
         self.concurrency = concurrency
         for slot in range(concurrency):
             self._spawn(self._worker_loop, slot)
@@ -277,6 +290,7 @@ class RequestRateManager(LoadManager):
 
     def change_request_rate(self, rate, num_threads=None):
         self.stop_workers()
+        self._residual = []  # see change_concurrency_level
         self._rate = rate
         self._gaps_ns = np.cumsum(self._make_schedule(rate))
         self._t0 = time.monotonic_ns()
@@ -337,6 +351,7 @@ class CustomLoadManager(RequestRateManager):
 
     def start(self, num_threads=2, repeats=1000):
         self.stop_workers()
+        self._residual = []  # see change_concurrency_level
         self._rate = None  # finite replay: no auto-extension
         gaps = np.tile(self._intervals, repeats)
         self._gaps_ns = np.cumsum(gaps)

@@ -58,6 +58,70 @@ def _is_device_array(arr):
     return jax is not None and isinstance(arr, jax.Array)
 
 
+def _device_batch(parts, padded):
+    """Assemble a padded device batch with a *bounded executable set*.
+
+    ``jnp.concatenate`` over a variable part count compiles one executable per
+    (arity, row-split) combination, and on a remote/tunneled TPU every new
+    executable costs seconds — measured ~4.5s per shape on the axon tunnel,
+    which is exactly the rows-vary-per-window pathology dynamic batching
+    creates.  Instead: allocate the pre-zeroed bucket buffer (one executable
+    per bucket; the zero fill doubles as padding) and lay each part in with
+    ``dynamic_update_slice`` at a *runtime* offset — one executable per
+    (bucket, part-row-count), independent of group composition, all covered
+    by warmup.
+    """
+    from jax import lax
+
+    import jax.numpy as jnp
+
+    buf = jnp.zeros((padded,) + tuple(parts[0].shape[1:]), parts[0].dtype)
+    zero_tail = (0,) * (parts[0].ndim - 1)
+    offset = 0
+    for p in parts:
+        buf = lax.dynamic_update_slice(buf, p, (offset,) + zero_tail)
+        offset += int(p.shape[0])
+    return buf
+
+
+def _fused_group_fn(model_fn):
+    """One jitted callable serving every device-group composition: concat the
+    parts, run the forward, split the outputs back per part — inside a single
+    XLA program, so a K-request group costs exactly ONE dispatch and zero
+    per-request eager ops.  jax.jit retraces per (arity, row-split) pytree —
+    single-row parts (the perf-client shape) dominate, so the executable set
+    stays tiny and warmup covers it.  Requires a jax-pure model fn
+    (``Model.fused_batching``)."""
+    import jax
+
+    def fused(parts):
+        import jax.numpy as jnp
+
+        batched = {
+            name: jnp.concatenate(list(ps), axis=0) if len(ps) > 1 else ps[0]
+            for name, ps in parts.items()
+        }
+        out = model_fn(batched, {}, None)
+        sizes = [int(p.shape[0]) for p in next(iter(parts.values()))]
+        offs = list(np.cumsum(sizes[:-1]))
+        return {
+            name: tuple(jnp.split(arr, offs, axis=0)) if offs else (arr,)
+            for name, arr in out.items()
+        }
+
+    return jax.jit(fused)
+
+
+def _device_split(arr, offset, rows):
+    """One request's row slice, executable set bounded per (shape, rows):
+    ``dynamic_slice`` with a runtime offset — basic ``arr[a:b]`` slicing
+    would compile one executable per distinct offset."""
+    from jax import lax
+
+    sizes = (rows,) + tuple(arr.shape[1:])
+    return lax.dynamic_slice(arr, (offset,) + (0,) * (arr.ndim - 1), sizes)
+
+
 class _Pending:
     __slots__ = ("inputs", "rows", "signature", "event", "result", "error", "t_enq")
 
@@ -81,6 +145,13 @@ class ModelBatcher:
         self._busy = busy  # engine BusyTracker (duty-cycle metric), optional
         self.max_batch = max(int(model.max_batch_size), 1)
         self.max_queue_delay_s = max_queue_delay_s
+        # Device groups with a jax-pure fn fuse concat+forward+split into ONE
+        # jitted dispatch (see _fused_jit); arity is capped so the executable
+        # set stays warmable.
+        self._fused = None
+        self.max_fused_arity = int(
+            getattr(model, "max_fused_arity", 8) or 8
+        )
         self._cond = threading.Condition()
         self._queue = deque()
         # Requests popped off the queue but not yet completed/failed (gathered
@@ -94,11 +165,22 @@ class ModelBatcher:
         )
         self._thread.start()
 
+    def _use_fused(self):
+        return bool(getattr(self.model, "fused_batching", False))
+
+    def _fused_jit(self):
+        if self._fused is None:
+            self._fused = _fused_group_fn(self.model.fn)
+        return self._fused
+
     def warmup(self, input_specs):
         """Pre-compile every padded bucket (the reference's ``model_warmup``
-        analog): run the model on zeros for each power-of-two batch size so no
-        client request ever pays a compile.  Skipped for models with dynamic
-        non-batch dims."""
+        analog) so no client request ever pays a compile.  Covers both group
+        populations: the wire path (host-array forward per bucket) and the
+        device/TPU-shm path (bucket-buffer assembly from single-row parts,
+        forward, and per-request output split) — on a tunneled chip an
+        unwarmed executable costs seconds at request time.  Skipped for
+        models with dynamic non-batch dims."""
         from client_tpu.utils import triton_to_np_dtype
 
         shapes = {}
@@ -119,6 +201,36 @@ class ModelBatcher:
                 for name, (dims, np_dtype) in shapes.items()
             }
             jax.device_get(self.model.fn(zeros, {}, None))
+        if not getattr(self.model, "batch_device_inputs", False):
+            return
+        # Device-group pass: single-row parts are what concurrent perf
+        # clients send.  The rows are committed to the device explicitly —
+        # TPU-shm region arrays arrive committed, and committedness is part
+        # of the jit cache key: an uncommitted warmup would leave every
+        # serving-time signature cold (retrace + executable reload).
+        dev = jax.devices()[0]
+        row = {
+            name: jax.device_put(np.zeros([1] + dims, dtype=np_dtype), dev)
+            for name, (dims, np_dtype) in shapes.items()
+        }
+        if self._use_fused():
+            # one compile per arity: group of k single-row requests
+            for k in range(1, min(self.max_fused_arity, self.max_batch) + 1):
+                parts = {name: (part,) * k for name, part in row.items()}
+                out = self._fused_jit()(parts)
+                jax.block_until_ready(out)
+            return
+        # eager assembly path: per bucket warm (zeros-buffer + one-row
+        # dynamic_update_slice) assembly, the forward on an assembled
+        # buffer, and the one-row output split.
+        for b in buckets:
+            batched = {
+                name: _device_batch([part], b) for name, part in row.items()
+            }
+            result = self.model.fn(batched, {}, None)
+            for arr in result.values():
+                if _is_device_array(arr) and arr.shape and arr.shape[0] == b:
+                    _device_split(arr, 0, 1).block_until_ready()
 
     # -- request side -----------------------------------------------------
 
@@ -224,8 +336,15 @@ class ModelBatcher:
             self._active.add(first)
             group = [first]
             rows = first.rows
+            # Fused device groups cap the part count so the (arity,
+            # row-split)-keyed executable set stays small and warmable.
+            max_arity = (
+                self.max_fused_arity
+                if first.signature[0] and self._use_fused()
+                else self.max_batch
+            )
             deadline = time.monotonic() + self.max_queue_delay_s
-            while rows < self.max_batch:
+            while rows < self.max_batch and len(group) < max_arity:
                 # drain compatible items already queued
                 taken = False
                 for i, p in enumerate(self._queue):
@@ -258,27 +377,39 @@ class ModelBatcher:
             device = group[0].signature[0]
             names = [name for name, _, _ in group[0].signature[1:]]
             rows = sum(p.rows for p in group)
+            if device and self._use_fused():
+                parts = {
+                    name: tuple(p.inputs[name] for p in group)
+                    for name in names
+                }
+                result = self._fused_jit()(parts)
+                return group, ("fused", result), rows, t0, time.monotonic_ns()
             # rows <= max_batch by construction, so padded >= rows always.
             padded = _bucket(rows, cap=self.max_batch)
-            if device:
-                # TPU-shm path: concat + pad stay on device (one XLA op per
-                # input); the forward runs at batch=`padded` on the MXU
-                # instead of `len(group)` batch-1 dispatches.
-                import jax.numpy as jnp
-
-                concat = jnp.concatenate
-                zeros = jnp.zeros
-            else:
-                concat, zeros = np.concatenate, np.zeros
             batched = {}
             for name in names:
                 parts = [p.inputs[name] for p in group]
-                if padded > rows:
-                    pad_shape = (padded - rows,) + tuple(parts[0].shape[1:])
-                    parts.append(zeros(pad_shape, dtype=parts[0].dtype))
-                batched[name] = (
-                    concat(parts, axis=0) if len(parts) > 1 else parts[0]
-                )
+                if device:
+                    # TPU-shm path: assembly stays on device and the forward
+                    # runs at batch=`padded` on the MXU instead of
+                    # `len(group)` batch-1 dispatches.  A lone full-bucket
+                    # part skips assembly entirely (zero-copy).
+                    if len(parts) == 1 and parts[0].shape[0] == padded:
+                        batched[name] = parts[0]
+                    else:
+                        batched[name] = _device_batch(parts, padded)
+                else:
+                    if padded > rows:
+                        pad = np.zeros(
+                            (padded - rows,) + tuple(parts[0].shape[1:]),
+                            dtype=parts[0].dtype,
+                        )
+                        parts = parts + [pad]
+                    batched[name] = (
+                        np.concatenate(parts, axis=0)
+                        if len(parts) > 1
+                        else parts[0]
+                    )
             t_in = time.monotonic_ns()
             result = self.model.fn(batched, {}, None)
             return group, result, rows, t0, t_in
@@ -297,7 +428,31 @@ class ModelBatcher:
         dispatch stays asynchronous."""
         busy_open = self._busy is not None
         try:
-            if group[0].signature[0]:
+            if isinstance(result, tuple) and result[0] == "fused":
+                # per-part output arrays came straight out of the jitted
+                # dispatch — hand them over, nothing left to do on host
+                per_part = result[1]
+                for i, p in enumerate(group):
+                    p.result = {
+                        name: parts[i] for name, parts in per_part.items()
+                    }
+                    p.event.set()
+                if busy_open:
+                    self._busy.end()
+                    busy_open = False
+                with self._cond:
+                    self._active.difference_update(group)
+                t1 = time.monotonic_ns()
+                self.stats.record_batched(
+                    rows=rows,
+                    infer_ns=t1 - t_in,
+                    input_ns=t_in - t0,
+                    output_ns=0,
+                    queue_ns=sum(t_in - p.t_enq for p in group),
+                )
+                return
+            device = group[0].signature[0]
+            if device:
                 host = result  # device group: keep everything on device
             else:
                 import jax
@@ -309,9 +464,20 @@ class ModelBatcher:
             t_inf = time.monotonic_ns()
             offset = 0
             for p in group:
-                p.result = {
-                    name: arr[offset : offset + p.rows] for name, arr in host.items()
-                }
+                if device:
+                    # whole-buffer pass-through when one request fills the
+                    # bucket; dynamic_slice otherwise (bounded executables)
+                    p.result = {
+                        name: arr
+                        if offset == 0 and p.rows == arr.shape[0]
+                        else _device_split(arr, offset, p.rows)
+                        for name, arr in host.items()
+                    }
+                else:
+                    p.result = {
+                        name: arr[offset : offset + p.rows]
+                        for name, arr in host.items()
+                    }
                 offset += p.rows
                 p.event.set()
             with self._cond:
@@ -374,6 +540,16 @@ def batchable_request(model, inputs, params, context, request):
     device = bool(inputs) and all(
         _is_device_array(a) for a in inputs.values()
     )
+    if device and not getattr(model, "batch_device_inputs", False):
+        # Device-resident (TPU-shm) inputs skip batching by default: the
+        # forward dispatches on them directly (zero-copy, one async op),
+        # while fusing adds assemble/split device ops per request — pure
+        # overhead on a path that pays no H2D either way.  Batching exists
+        # to amortize host<->device transfers; device arrays already did.
+        # Opt in per model (`batch_device_inputs=True`) where per-dispatch
+        # latency is negligible and MXU utilization dominates (chip-local
+        # serving of compute-heavy models).
+        return False
     if not device:
         for out in request.get("outputs") or []:
             # shm outputs of HOST groups stay on the direct path: host-mode

@@ -95,6 +95,9 @@ class Model:
         dynamic_batching=False,
         max_queue_delay_us=3000,
         warmup=False,
+        batch_device_inputs=False,
+        fused_batching=False,
+        max_fused_arity=8,
     ):
         self.name = name
         self.inputs = list(inputs)
@@ -109,6 +112,13 @@ class Model:
         self.dynamic_batching = dynamic_batching
         self.max_queue_delay_us = max_queue_delay_us
         self.warmup = warmup
+        # Whether device-resident (TPU-shm) requests fuse into device-side
+        # batches; off by default — see dynamic_batcher.batchable_request.
+        self.batch_device_inputs = batch_device_inputs
+        # Whether fn is jax-pure so device groups can fuse concat+forward+
+        # split into one jitted dispatch (dynamic_batcher._fused_group_fn).
+        self.fused_batching = fused_batching
+        self.max_fused_arity = max_fused_arity  # cap on fused group parts
         self.config_override = None  # set by repository load with config param
         self.file_overrides = {}
 

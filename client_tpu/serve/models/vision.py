@@ -98,4 +98,7 @@ def cnn_classifier_model(
         max_batch_size=max_batch_size,
         dynamic_batching=True,
         warmup=warmup,
+        batch_device_inputs=True,
+        fused_batching=True,
+        max_fused_arity=16,
     )

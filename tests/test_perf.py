@@ -145,6 +145,21 @@ class TestConcurrencyManager:
         finally:
             mgr.cleanup()
 
+    def test_records_survive_stop_workers(self):
+        # profile_completion stops workers (quiescing sends before the output
+        # drain) and only then swaps timestamps; stopping must not discard the
+        # window's records with the thread list.
+        mgr, _ = _mk_manager(ConcurrencyManager)
+        try:
+            mgr.change_concurrency_level(4)
+            time.sleep(0.3)
+            mgr.stop_workers()
+            records = mgr.swap_timestamps()
+            assert len(records) > 50
+            assert mgr.swap_timestamps() == []  # drained exactly once
+        finally:
+            mgr.cleanup()
+
     def test_reconfigure_threads(self):
         mgr, _ = _mk_manager(ConcurrencyManager)
         try:
