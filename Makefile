@@ -18,8 +18,64 @@ CLIENT_SRCS := $(CPP_DIR)/client/json.cc $(CPP_DIR)/client/http_client.cc \
                $(CPP_DIR)/client/shm_utils.cc
 CLIENT_HDRS := $(wildcard $(CPP_DIR)/client/*.h)
 
+# gRPC client: protoc-generated KServe protos + the h2/hpack transport.
+PB_CPP := build/proto_cpp
+GRPC_SRCS := $(CPP_DIR)/grpc/hpack.cc $(CPP_DIR)/grpc/h2.cc \
+             $(CPP_DIR)/client/grpc_client.cc
+GRPC_HDRS := $(wildcard $(CPP_DIR)/grpc/*.h)
+GRPC_OBJS := $(CPP_BUILD)/hpack.o $(CPP_BUILD)/h2.o \
+             $(CPP_BUILD)/grpc_client.o $(CPP_BUILD)/inference.pb.o \
+             $(CPP_BUILD)/model_config.pb.o
+GRPC_LINK := -lprotobuf -lrt -lpthread
+GRPC_INC := -I$(PB_CPP) -I$(CPP_DIR)/client -I$(CPP_DIR)/grpc
+
 cpp: $(CPP_BUILD)/simple_http_infer_client $(CPP_BUILD)/cc_client_test \
-     $(CPP_BUILD)/libhttpclient_tpu.so
+     $(CPP_BUILD)/libhttpclient_tpu.so grpc_cpp
+
+grpc_cpp: $(CPP_BUILD)/simple_grpc_infer_client \
+          $(CPP_BUILD)/simple_grpc_sequence_stream_infer_client \
+          $(CPP_BUILD)/cc_grpc_client_test $(CPP_BUILD)/hpack_unit_test
+
+$(PB_CPP)/inference.pb.cc: $(PROTO_DIR)/inference.proto $(PROTO_DIR)/model_config.proto
+	mkdir -p $(PB_CPP)
+	protoc -I$(PROTO_DIR) --cpp_out=$(PB_CPP) \
+	    $(PROTO_DIR)/inference.proto $(PROTO_DIR)/model_config.proto
+
+$(CPP_BUILD)/inference.pb.o: $(PB_CPP)/inference.pb.cc
+	mkdir -p $(CPP_BUILD)
+	$(CXX) $(CXXFLAGS) -w -c -o $@ $< -I$(PB_CPP)
+
+$(CPP_BUILD)/model_config.pb.o: $(PB_CPP)/inference.pb.cc
+	mkdir -p $(CPP_BUILD)
+	$(CXX) $(CXXFLAGS) -w -c -o $@ $(PB_CPP)/model_config.pb.cc -I$(PB_CPP)
+
+$(CPP_BUILD)/hpack.o: $(CPP_DIR)/grpc/hpack.cc $(GRPC_HDRS)
+	mkdir -p $(CPP_BUILD)
+	$(CXX) $(CXXFLAGS) -c -o $@ $< $(GRPC_INC)
+
+$(CPP_BUILD)/h2.o: $(CPP_DIR)/grpc/h2.cc $(GRPC_HDRS) $(CLIENT_HDRS)
+	mkdir -p $(CPP_BUILD)
+	$(CXX) $(CXXFLAGS) -c -o $@ $< $(GRPC_INC)
+
+$(CPP_BUILD)/grpc_client.o: $(CPP_DIR)/client/grpc_client.cc $(CPP_DIR)/client/grpc_client.h $(GRPC_HDRS) $(CLIENT_HDRS) $(PB_CPP)/inference.pb.cc
+	mkdir -p $(CPP_BUILD)
+	$(CXX) $(CXXFLAGS) -c -o $@ $< $(GRPC_INC)
+
+$(CPP_BUILD)/hpack_unit_test: $(CPP_DIR)/tests/hpack_unit_test.cc $(CPP_BUILD)/hpack.o
+	mkdir -p $(CPP_BUILD)
+	$(CXX) $(CXXFLAGS) -o $@ $< $(CPP_BUILD)/hpack.o $(GRPC_INC)
+
+$(CPP_BUILD)/simple_grpc_infer_client: $(CPP_DIR)/examples/simple_grpc_infer_client.cc $(GRPC_OBJS)
+	mkdir -p $(CPP_BUILD)
+	$(CXX) $(CXXFLAGS) -o $@ $< $(GRPC_OBJS) $(GRPC_INC) $(GRPC_LINK)
+
+$(CPP_BUILD)/simple_grpc_sequence_stream_infer_client: $(CPP_DIR)/examples/simple_grpc_sequence_stream_infer_client.cc $(GRPC_OBJS)
+	mkdir -p $(CPP_BUILD)
+	$(CXX) $(CXXFLAGS) -o $@ $< $(GRPC_OBJS) $(GRPC_INC) $(GRPC_LINK)
+
+$(CPP_BUILD)/cc_grpc_client_test: $(CPP_DIR)/tests/cc_grpc_client_test.cc $(GRPC_OBJS)
+	mkdir -p $(CPP_BUILD)
+	$(CXX) $(CXXFLAGS) -o $@ $< $(GRPC_OBJS) $(GRPC_INC) $(GRPC_LINK)
 
 $(CPP_BUILD)/libhttpclient_tpu.so: $(CLIENT_SRCS) $(CLIENT_HDRS)
 	mkdir -p $(CPP_BUILD)

@@ -205,10 +205,16 @@ class InferResult {
 
   const std::map<std::string, Output>& Outputs() const { return outputs_; }
 
+  // Overall request status — meaningful for async/stream results, where the
+  // failure arrives with the result instead of a return value (reference
+  // common.h InferResult::RequestStatus).
+  const Error& RequestStatus() const { return error_; }
+
   std::string model_name_;
   std::string id_;
   std::map<std::string, Output> outputs_;
   std::string body_;  // owns the raw response bytes
+  Error error_;
 };
 using InferResultPtr = std::shared_ptr<InferResult>;
 

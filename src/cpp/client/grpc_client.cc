@@ -1,0 +1,812 @@
+#include "grpc_client.h"
+
+#include <atomic>
+#include <cstring>
+#include <sstream>
+
+#include "../grpc/h2.h"
+
+namespace ctpu {
+
+namespace {
+
+constexpr const char* kService = "/inference.GRPCInferenceService/";
+
+std::string
+LpmFrame(const std::string& message)
+{
+  std::string out;
+  out.reserve(message.size() + 5);
+  out.push_back(0);  // uncompressed
+  out.push_back(static_cast<char>((message.size() >> 24) & 0xff));
+  out.push_back(static_cast<char>((message.size() >> 16) & 0xff));
+  out.push_back(static_cast<char>((message.size() >> 8) & 0xff));
+  out.push_back(static_cast<char>(message.size() & 0xff));
+  out += message;
+  return out;
+}
+
+// Pulls one complete length-prefixed message out of *buf (erasing it).
+// Returns false when the buffer does not yet hold a complete message.
+bool
+TakeLpm(std::string* buf, std::string* message)
+{
+  if (buf->size() < 5) return false;
+  const uint8_t* p = reinterpret_cast<const uint8_t*>(buf->data());
+  const uint32_t len = (uint32_t(p[1]) << 24) | (uint32_t(p[2]) << 16) |
+                       (uint32_t(p[3]) << 8) | uint32_t(p[4]);
+  if (buf->size() < 5u + len) return false;
+  message->assign(*buf, 5, len);
+  buf->erase(0, 5 + len);
+  return true;
+}
+
+std::string
+PercentDecode(const std::string& in)
+{
+  std::string out;
+  for (size_t i = 0; i < in.size(); ++i) {
+    if (in[i] == '%' && i + 2 < in.size()) {
+      const auto hex = [](char c) -> int {
+        if (c >= '0' && c <= '9') return c - '0';
+        if (c >= 'a' && c <= 'f') return c - 'a' + 10;
+        if (c >= 'A' && c <= 'F') return c - 'A' + 10;
+        return -1;
+      };
+      const int hi = hex(in[i + 1]), lo = hex(in[i + 2]);
+      if (hi >= 0 && lo >= 0) {
+        out.push_back(static_cast<char>(hi * 16 + lo));
+        i += 2;
+        continue;
+      }
+    }
+    out.push_back(in[i]);
+  }
+  return out;
+}
+
+std::string
+GrpcTimeoutValue(uint64_t timeout_us)
+{
+  // gRPC wire format: int + unit.  Microsecond resolution is plenty.
+  return std::to_string(timeout_us) + "u";
+}
+
+// grpc-status / grpc-message from trailers (falling back to the initial
+// headers for trailers-only responses).
+Error
+GrpcStatus(const h2::Stream& stream)
+{
+  const std::vector<h2::Header>* sets[2] = {&stream.trailers,
+                                            &stream.headers};
+  for (const auto* headers : sets) {
+    std::string status, message;
+    for (const auto& h : *headers) {
+      if (h.first == "grpc-status") status = h.second;
+      if (h.first == "grpc-message") message = h.second;
+    }
+    if (status.empty()) continue;
+    if (status == "0") return Error::Success();
+    std::string msg = PercentDecode(message);
+    if (msg.empty()) msg = "request failed";
+    return Error("[grpc-status " + status + "] " + msg);
+  }
+  return Error("response carried no grpc-status");
+}
+
+void
+SetParam(
+    google::protobuf::Map<std::string, inference::InferParameter>* params,
+    const std::string& key, int64_t value)
+{
+  (*params)[key].set_int64_param(value);
+}
+
+void
+SetParam(
+    google::protobuf::Map<std::string, inference::InferParameter>* params,
+    const std::string& key, const std::string& value)
+{
+  (*params)[key].set_string_param(value);
+}
+
+void
+SetParam(
+    google::protobuf::Map<std::string, inference::InferParameter>* params,
+    const std::string& key, bool value)
+{
+  (*params)[key].set_bool_param(value);
+}
+
+}  // namespace
+
+Error
+ParseGrpcInferResult(
+    const inference::ModelInferResponse& response, InferResult** result)
+{
+  auto* res = new InferResult();
+  res->model_name_ = response.model_name();
+  res->id_ = response.id();
+  // Raw output bytes move into body_; Output.data points into it.
+  size_t total = 0;
+  for (const auto& raw : response.raw_output_contents()) total += raw.size();
+  res->body_.reserve(total);
+  std::vector<std::pair<size_t, size_t>> spans;
+  for (const auto& raw : response.raw_output_contents()) {
+    spans.emplace_back(res->body_.size(), raw.size());
+    res->body_ += raw;
+  }
+  for (int i = 0; i < response.outputs_size(); ++i) {
+    const auto& out = response.outputs(i);
+    InferResult::Output o;
+    o.datatype = out.datatype();
+    o.shape.assign(out.shape().begin(), out.shape().end());
+    if (i < static_cast<int>(spans.size())) {
+      o.data = reinterpret_cast<const uint8_t*>(res->body_.data()) +
+               spans[i].first;
+      o.byte_size = spans[i].second;
+    }
+    const auto shm = out.parameters().find("shared_memory_region");
+    if (shm != out.parameters().end()) o.in_shared_memory = true;
+    // classification-extension string values ride typed contents
+    for (const auto& s : out.contents().bytes_contents())
+      o.json_values.push_back(s);
+    res->outputs_.emplace(out.name(), std::move(o));
+  }
+  *result = res;
+  return Error::Success();
+}
+
+Error
+InferenceServerGrpcClient::Create(
+    std::unique_ptr<InferenceServerGrpcClient>* client, const std::string& url,
+    bool verbose)
+{
+  std::string hostport = url;
+  const size_t scheme = hostport.find("://");
+  if (scheme != std::string::npos) hostport = hostport.substr(scheme + 3);
+  std::string host = hostport;
+  int port = 8001;
+  if (!hostport.empty() && hostport[0] == '[') {  // [v6-literal]:port
+    const size_t close = hostport.find(']');
+    if (close == std::string::npos) return Error("malformed IPv6 url");
+    host = hostport.substr(1, close - 1);
+    if (close + 1 < hostport.size() && hostport[close + 1] == ':')
+      port = std::stoi(hostport.substr(close + 2));
+  } else {
+    const size_t colon = hostport.rfind(':');
+    if (colon != std::string::npos) {
+      host = hostport.substr(0, colon);
+      port = std::stoi(hostport.substr(colon + 1));
+    }
+  }
+  client->reset(new InferenceServerGrpcClient(host, port, verbose));
+  return Error::Success();
+}
+
+InferenceServerGrpcClient::InferenceServerGrpcClient(
+    const std::string& host, int port, bool verbose)
+    : host_(host), port_(port), verbose_(verbose)
+{
+}
+
+InferenceServerGrpcClient::~InferenceServerGrpcClient()
+{
+  StopStream();
+  if (conn_ != nullptr) conn_->Close();
+}
+
+Error
+InferenceServerGrpcClient::Connected()
+{
+  std::lock_guard<std::mutex> lk(conn_mu_);
+  if (conn_ != nullptr && conn_->IsOpen()) return Error::Success();
+  // The old connection object (if any) stays alive for as long as any
+  // in-flight call or async callback still holds its shared_ptr.
+  conn_ = std::make_shared<h2::H2Connection>();
+  return conn_->Connect(host_, port_);
+}
+
+std::shared_ptr<h2::H2Connection>
+InferenceServerGrpcClient::Conn()
+{
+  std::lock_guard<std::mutex> lk(conn_mu_);
+  return conn_;
+}
+
+Error
+InferenceServerGrpcClient::Call(
+    const std::string& method, const google::protobuf::Message& request,
+    google::protobuf::Message* response, uint64_t timeout_us,
+    const std::vector<std::pair<std::string, std::string>>& headers)
+{
+  Error err = Connected();
+  if (!err.IsOk()) return err;
+  auto conn = Conn();  // pin across the call (reconnects swap conn_)
+
+  std::string body;
+  if (!request.SerializeToString(&body))
+    return Error("failed to serialize " + method + " request");
+
+  std::vector<h2::Header> hdrs = {
+      {":method", "POST"},
+      {":scheme", "http"},
+      {":path", std::string(kService) + method},
+      {":authority", host_ + ":" + std::to_string(port_)},
+      {"content-type", "application/grpc"},
+      {"te", "trailers"},
+      {"user-agent", "ctpu-grpc-client/1.0"},
+  };
+  if (timeout_us > 0)
+    hdrs.emplace_back("grpc-timeout", GrpcTimeoutValue(timeout_us));
+  for (const auto& h : headers) hdrs.emplace_back(h.first, h.second);
+
+  int32_t sid = 0;
+  err = conn->StartStream(hdrs, false, &sid);
+  if (!err.IsOk()) return err;
+  const std::string framed = LpmFrame(body);
+  const int64_t deadline_ms =
+      timeout_us > 0 ? static_cast<int64_t>(timeout_us / 1000) + 1 : 0;
+  err = conn->SendData(
+      sid, reinterpret_cast<const uint8_t*>(framed.data()), framed.size(),
+      true, deadline_ms);
+  if (err.IsOk()) err = conn->WaitEndStream(sid, deadline_ms);
+  if (!err.IsOk()) {
+    conn->ResetStream(sid, 0x8 /* CANCEL */);
+    conn->ForgetStream(sid);
+    return err;
+  }
+  auto stream = conn->GetStream(sid);
+  std::string wire;
+  wire.swap(stream->data);
+  conn->ForgetStream(sid);
+  err = GrpcStatus(*stream);
+  if (!err.IsOk()) return err;
+  std::string message;
+  if (!TakeLpm(&wire, &message))
+    return Error(method + " response carried no message");
+  if (!response->ParseFromString(message))
+    return Error("failed to parse " + method + " response");
+  if (verbose_) {
+    std::ostringstream oss;
+    oss << method << " OK: " << response->ShortDebugString();
+    fprintf(stderr, "%s\n", oss.str().c_str());
+  }
+  return Error::Success();
+}
+
+// ---------------------------------------------------------------------------
+// management surface
+// ---------------------------------------------------------------------------
+
+Error
+InferenceServerGrpcClient::IsServerLive(bool* live)
+{
+  inference::ServerLiveRequest request;
+  inference::ServerLiveResponse response;
+  Error err = Call("ServerLive", request, &response);
+  *live = err.IsOk() && response.live();
+  return err;
+}
+
+Error
+InferenceServerGrpcClient::IsServerReady(bool* ready)
+{
+  inference::ServerReadyRequest request;
+  inference::ServerReadyResponse response;
+  Error err = Call("ServerReady", request, &response);
+  *ready = err.IsOk() && response.ready();
+  return err;
+}
+
+Error
+InferenceServerGrpcClient::IsModelReady(
+    bool* ready, const std::string& model_name,
+    const std::string& model_version)
+{
+  inference::ModelReadyRequest request;
+  request.set_name(model_name);
+  request.set_version(model_version);
+  inference::ModelReadyResponse response;
+  Error err = Call("ModelReady", request, &response);
+  *ready = err.IsOk() && response.ready();
+  return err;
+}
+
+Error
+InferenceServerGrpcClient::ServerMetadata(
+    inference::ServerMetadataResponse* response)
+{
+  inference::ServerMetadataRequest request;
+  return Call("ServerMetadata", request, response);
+}
+
+Error
+InferenceServerGrpcClient::ModelMetadata(
+    inference::ModelMetadataResponse* response, const std::string& name,
+    const std::string& version)
+{
+  inference::ModelMetadataRequest request;
+  request.set_name(name);
+  request.set_version(version);
+  return Call("ModelMetadata", request, response);
+}
+
+Error
+InferenceServerGrpcClient::ModelConfig(
+    inference::ModelConfigResponse* response, const std::string& name,
+    const std::string& version)
+{
+  inference::ModelConfigRequest request;
+  request.set_name(name);
+  request.set_version(version);
+  return Call("ModelConfig", request, response);
+}
+
+Error
+InferenceServerGrpcClient::ModelRepositoryIndex(
+    inference::RepositoryIndexResponse* response)
+{
+  inference::RepositoryIndexRequest request;
+  return Call("RepositoryIndex", request, response);
+}
+
+Error
+InferenceServerGrpcClient::LoadModel(
+    const std::string& name, const std::string& config_json)
+{
+  inference::RepositoryModelLoadRequest request;
+  request.set_model_name(name);
+  if (!config_json.empty())
+    (*request.mutable_parameters())["config"].set_string_param(config_json);
+  inference::RepositoryModelLoadResponse response;
+  return Call("RepositoryModelLoad", request, &response);
+}
+
+Error
+InferenceServerGrpcClient::UnloadModel(const std::string& name)
+{
+  inference::RepositoryModelUnloadRequest request;
+  request.set_model_name(name);
+  inference::RepositoryModelUnloadResponse response;
+  return Call("RepositoryModelUnload", request, &response);
+}
+
+Error
+InferenceServerGrpcClient::ModelInferenceStatistics(
+    inference::ModelStatisticsResponse* response, const std::string& name,
+    const std::string& version)
+{
+  inference::ModelStatisticsRequest request;
+  request.set_name(name);
+  request.set_version(version);
+  return Call("ModelStatistics", request, response);
+}
+
+Error
+InferenceServerGrpcClient::SystemSharedMemoryStatus(
+    inference::SystemSharedMemoryStatusResponse* response,
+    const std::string& region_name)
+{
+  inference::SystemSharedMemoryStatusRequest request;
+  request.set_name(region_name);
+  return Call("SystemSharedMemoryStatus", request, response);
+}
+
+Error
+InferenceServerGrpcClient::RegisterSystemSharedMemory(
+    const std::string& name, const std::string& key, size_t byte_size,
+    size_t offset)
+{
+  inference::SystemSharedMemoryRegisterRequest request;
+  request.set_name(name);
+  request.set_key(key);
+  request.set_offset(offset);
+  request.set_byte_size(byte_size);
+  inference::SystemSharedMemoryRegisterResponse response;
+  return Call("SystemSharedMemoryRegister", request, &response);
+}
+
+Error
+InferenceServerGrpcClient::UnregisterSystemSharedMemory(
+    const std::string& name)
+{
+  inference::SystemSharedMemoryUnregisterRequest request;
+  request.set_name(name);
+  inference::SystemSharedMemoryUnregisterResponse response;
+  return Call("SystemSharedMemoryUnregister", request, &response);
+}
+
+Error
+InferenceServerGrpcClient::TpuSharedMemoryStatus(
+    inference::CudaSharedMemoryStatusResponse* response,
+    const std::string& region_name)
+{
+  inference::CudaSharedMemoryStatusRequest request;
+  request.set_name(region_name);
+  return Call("CudaSharedMemoryStatus", request, response);
+}
+
+Error
+InferenceServerGrpcClient::RegisterTpuSharedMemory(
+    const std::string& name, const std::string& raw_handle, int device_id,
+    size_t byte_size)
+{
+  inference::CudaSharedMemoryRegisterRequest request;
+  request.set_name(name);
+  request.set_raw_handle(raw_handle);
+  request.set_device_id(device_id);
+  request.set_byte_size(byte_size);
+  inference::CudaSharedMemoryRegisterResponse response;
+  return Call("CudaSharedMemoryRegister", request, &response);
+}
+
+Error
+InferenceServerGrpcClient::UnregisterTpuSharedMemory(const std::string& name)
+{
+  inference::CudaSharedMemoryUnregisterRequest request;
+  request.set_name(name);
+  inference::CudaSharedMemoryUnregisterResponse response;
+  return Call("CudaSharedMemoryUnregister", request, &response);
+}
+
+// ---------------------------------------------------------------------------
+// inference
+// ---------------------------------------------------------------------------
+
+Error
+InferenceServerGrpcClient::BuildInferRequest(
+    const InferOptions& options, const std::vector<InferInput*>& inputs,
+    const std::vector<const InferRequestedOutput*>& outputs,
+    inference::ModelInferRequest* request)
+{
+  request->set_model_name(options.model_name);
+  request->set_model_version(options.model_version);
+  request->set_id(options.request_id);
+  auto* params = request->mutable_parameters();
+  if (options.sequence_id != 0) {
+    SetParam(params, "sequence_id",
+             static_cast<int64_t>(options.sequence_id));
+    SetParam(params, "sequence_start", options.sequence_start);
+    SetParam(params, "sequence_end", options.sequence_end);
+  }
+  if (options.priority != 0)
+    SetParam(params, "priority", static_cast<int64_t>(options.priority));
+  if (options.timeout_us != 0)
+    SetParam(params, "timeout", static_cast<int64_t>(options.timeout_us));
+
+  for (const InferInput* input : inputs) {
+    auto* tensor = request->add_inputs();
+    tensor->set_name(input->Name());
+    tensor->set_datatype(input->Datatype());
+    for (const int64_t d : input->Shape()) tensor->add_shape(d);
+    if (input->IsSharedMemory()) {
+      auto* tp = tensor->mutable_parameters();
+      SetParam(tp, "shared_memory_region", input->SharedMemoryName());
+      SetParam(tp, "shared_memory_byte_size",
+               static_cast<int64_t>(input->SharedMemoryByteSize()));
+      if (input->SharedMemoryOffset() != 0)
+        SetParam(tp, "shared_memory_offset",
+                 static_cast<int64_t>(input->SharedMemoryOffset()));
+    } else {
+      std::string* raw = request->add_raw_input_contents();
+      raw->reserve(input->TotalByteSize());
+      for (const auto& buf : input->Buffers())
+        raw->append(reinterpret_cast<const char*>(buf.first), buf.second);
+    }
+  }
+  for (const InferRequestedOutput* output : outputs) {
+    auto* tensor = request->add_outputs();
+    tensor->set_name(output->Name());
+    auto* tp = tensor->mutable_parameters();
+    if (output->ClassCount() > 0)
+      SetParam(tp, "classification",
+               static_cast<int64_t>(output->ClassCount()));
+    if (output->IsSharedMemory()) {
+      SetParam(tp, "shared_memory_region", output->SharedMemoryName());
+      SetParam(tp, "shared_memory_byte_size",
+               static_cast<int64_t>(output->SharedMemoryByteSize()));
+      if (output->SharedMemoryOffset() != 0)
+        SetParam(tp, "shared_memory_offset",
+                 static_cast<int64_t>(output->SharedMemoryOffset()));
+    }
+  }
+  return Error::Success();
+}
+
+void
+InferenceServerGrpcClient::UpdateStat(const RequestTimers& timers)
+{
+  std::lock_guard<std::mutex> lk(stat_mu_);
+  stat_.completed_request_count++;
+  stat_.cumulative_total_request_time_ns += timers.Duration(
+      RequestTimers::Kind::REQUEST_START, RequestTimers::Kind::REQUEST_END);
+  stat_.cumulative_send_time_ns += timers.Duration(
+      RequestTimers::Kind::SEND_START, RequestTimers::Kind::SEND_END);
+  stat_.cumulative_receive_time_ns += timers.Duration(
+      RequestTimers::Kind::RECV_START, RequestTimers::Kind::RECV_END);
+}
+
+Error
+InferenceServerGrpcClient::ClientInferStat(InferStat* stat)
+{
+  std::lock_guard<std::mutex> lk(stat_mu_);
+  *stat = stat_;
+  return Error::Success();
+}
+
+Error
+InferenceServerGrpcClient::Infer(
+    InferResult** result, const InferOptions& options,
+    const std::vector<InferInput*>& inputs,
+    const std::vector<const InferRequestedOutput*>& outputs,
+    const std::vector<std::pair<std::string, std::string>>& headers)
+{
+  RequestTimers timers;
+  timers.Capture(RequestTimers::Kind::REQUEST_START);
+  inference::ModelInferRequest request;
+  Error err = BuildInferRequest(options, inputs, outputs, &request);
+  if (!err.IsOk()) return err;
+  inference::ModelInferResponse response;
+  timers.Capture(RequestTimers::Kind::SEND_START);
+  err = Call("ModelInfer", request, &response, options.client_timeout_us,
+             headers);
+  timers.Capture(RequestTimers::Kind::SEND_END);
+  timers.Capture(RequestTimers::Kind::RECV_START);
+  if (!err.IsOk()) return err;
+  err = ParseGrpcInferResult(response, result);
+  timers.Capture(RequestTimers::Kind::RECV_END);
+  timers.Capture(RequestTimers::Kind::REQUEST_END);
+  UpdateStat(timers);
+  return err;
+}
+
+Error
+InferenceServerGrpcClient::AsyncInfer(
+    OnCompleteFn callback, const InferOptions& options,
+    const std::vector<InferInput*>& inputs,
+    const std::vector<const InferRequestedOutput*>& outputs,
+    const std::vector<std::pair<std::string, std::string>>& headers)
+{
+  if (callback == nullptr)
+    return Error("AsyncInfer requires a completion callback");
+  Error err = Connected();
+  if (!err.IsOk()) return err;
+
+  inference::ModelInferRequest request;
+  err = BuildInferRequest(options, inputs, outputs, &request);
+  if (!err.IsOk()) return err;
+  std::string body;
+  if (!request.SerializeToString(&body))
+    return Error("failed to serialize ModelInfer request");
+
+  std::vector<h2::Header> hdrs = {
+      {":method", "POST"},
+      {":scheme", "http"},
+      {":path", std::string(kService) + "ModelInfer"},
+      {":authority", host_ + ":" + std::to_string(port_)},
+      {"content-type", "application/grpc"},
+      {"te", "trailers"},
+      {"user-agent", "ctpu-grpc-client/1.0"},
+  };
+  if (options.client_timeout_us > 0)
+    hdrs.emplace_back("grpc-timeout",
+                      GrpcTimeoutValue(options.client_timeout_us));
+  for (const auto& h : headers) hdrs.emplace_back(h.first, h.second);
+
+  // The reactor thread completes the request: on end-of-stream, parse and
+  // fire the user callback (the reference's AsyncReqRepr + cq thread,
+  // grpc_client.cc:1407-1504).  StartStream needs the callback before the
+  // stream id exists, so the lambda reads it from a shared holder.  The
+  // lambda pins the connection so a reconnect cannot free it mid-callback.
+  auto conn_sp = Conn();
+  auto* conn = conn_sp.get();
+  auto done = std::make_shared<std::atomic<bool>>(false);
+  int32_t sid = 0;
+  auto sid_holder = std::make_shared<std::atomic<int32_t>>(0);
+  auto user_cb = std::make_shared<OnCompleteFn>(std::move(callback));
+  err = conn->StartStream(
+      hdrs, false, &sid, [this, conn_sp, conn, done, sid_holder, user_cb]() {
+        const int32_t s = sid_holder->load();
+        if (s == 0) return;
+        auto stream = conn->GetStream(s);
+        if (stream == nullptr || !stream->end_stream) return;
+        if (done->exchange(true)) return;  // single completion
+        InferResult* raw = nullptr;
+        Error status = conn->ConnectionError();
+        if (status.IsOk() && stream->reset)
+          status = Error("h2 stream reset (code " +
+                         std::to_string(stream->rst_code) + ")");
+        if (status.IsOk()) status = GrpcStatus(*stream);
+        if (status.IsOk()) {
+          std::string wire;
+          wire.swap(stream->data);
+          std::string message;
+          inference::ModelInferResponse response;
+          if (!TakeLpm(&wire, &message))
+            status = Error("ModelInfer response carried no message");
+          else if (!response.ParseFromString(message))
+            status = Error("failed to parse ModelInfer response");
+          else
+            status = ParseGrpcInferResult(response, &raw);
+        }
+        conn->ForgetStream(s);
+        if (raw == nullptr) raw = new InferResult();
+        raw->error_ = status;
+        (*user_cb)(InferResultPtr(raw));
+      });
+  if (!err.IsOk()) return err;
+  sid_holder->store(sid);
+  const std::string framed = LpmFrame(body);
+  // From here on the request is owned by the callback path: a send failure
+  // surfaces through the stream/connection event (reset or FailConnection),
+  // which fires the completion — returning the error too would double-report
+  // one request (a retry loop would double-submit).
+  const int64_t send_deadline_ms =
+      options.client_timeout_us > 0
+          ? static_cast<int64_t>(options.client_timeout_us / 1000) + 1
+          : 0;
+  Error send_err = conn->SendData(
+      sid, reinterpret_cast<const uint8_t*>(framed.data()), framed.size(),
+      true, send_deadline_ms);
+  if (!send_err.IsOk()) conn->ResetStream(sid, 0x8 /* CANCEL */);
+  // The stream may already have completed before sid_holder was set (tiny
+  // responses) or via the reset above: nudge once.
+  auto stream = conn->GetStream(sid);
+  if (stream != nullptr && stream->end_stream && stream->on_event)
+    stream->on_event();
+  return Error::Success();
+}
+
+// ---------------------------------------------------------------------------
+// bidi streaming
+// ---------------------------------------------------------------------------
+
+Error
+InferenceServerGrpcClient::StartStream(
+    OnCompleteFn callback, uint64_t stream_timeout_us,
+    const std::vector<std::pair<std::string, std::string>>& headers)
+{
+  if (callback == nullptr)
+    return Error("StartStream requires a completion callback");
+  std::lock_guard<std::mutex> lk(stream_mu_);
+  if (stream_sid_ != 0) return Error("stream already active");
+  Error err = Connected();
+  if (!err.IsOk()) return err;
+
+  std::vector<h2::Header> hdrs = {
+      {":method", "POST"},
+      {":scheme", "http"},
+      {":path", std::string(kService) + "ModelStreamInfer"},
+      {":authority", host_ + ":" + std::to_string(port_)},
+      {"content-type", "application/grpc"},
+      {"te", "trailers"},
+      {"user-agent", "ctpu-grpc-client/1.0"},
+  };
+  if (stream_timeout_us > 0)
+    hdrs.emplace_back("grpc-timeout", GrpcTimeoutValue(stream_timeout_us));
+  for (const auto& h : headers) hdrs.emplace_back(h.first, h.second);
+
+  stream_callback_ = std::move(callback);
+  stream_rx_.clear();
+  stream_timeout_us_ = stream_timeout_us;
+  auto conn_sp = Conn();
+  auto* conn = conn_sp.get();
+  int32_t sid = 0;
+  err = conn->StartStream(hdrs, false, &sid, [this, conn_sp, conn]() {
+    // Reactor thread: drain complete stream messages, deliver results.
+    std::vector<InferResultPtr> ready;
+    OnCompleteFn cb;
+    {
+      std::lock_guard<std::mutex> lk(stream_mu_);
+      // ignore events from a stale stream (client restarted streaming,
+      // possibly on a new connection)
+      if (stream_sid_ == 0 || stream_conn_.get() != conn) return;
+      auto stream = conn->GetStream(stream_sid_);
+      if (stream == nullptr) return;
+      cb = stream_callback_;
+      // Take everything buffered (min_bytes=0 returns immediately).
+      conn->ReadData(stream_sid_, 0, &stream_rx_, 1);
+      std::string message;
+      while (TakeLpm(&stream_rx_, &message)) {
+        inference::ModelStreamInferResponse response;
+        auto* res = new InferResult();
+        if (!response.ParseFromString(message)) {
+          res->error_ = Error("failed to parse stream response");
+        } else if (!response.error_message().empty()) {
+          res->error_ = Error(response.error_message());
+          res->id_ = response.infer_response().id();
+        } else {
+          InferResult* parsed = nullptr;
+          Error perr =
+              ParseGrpcInferResult(response.infer_response(), &parsed);
+          if (perr.IsOk()) {
+            delete res;
+            res = parsed;
+          } else {
+            res->error_ = perr;
+          }
+        }
+        ready.emplace_back(res);
+      }
+      if (stream->end_stream && stream->reset) {
+        auto* res = new InferResult();
+        res->error_ = Error("stream closed (reset " +
+                            std::to_string(stream->rst_code) + ")");
+        ready.emplace_back(res);
+      }
+    }
+    if (cb)
+      for (auto& r : ready) cb(r);
+  });
+  if (!err.IsOk()) {
+    stream_callback_ = nullptr;
+    return err;
+  }
+  stream_conn_ = conn_sp;
+  stream_sid_ = sid;
+  return Error::Success();
+}
+
+Error
+InferenceServerGrpcClient::AsyncStreamInfer(
+    const InferOptions& options, const std::vector<InferInput*>& inputs,
+    const std::vector<const InferRequestedOutput*>& outputs)
+{
+  int32_t sid;
+  std::shared_ptr<h2::H2Connection> conn;
+  {
+    std::lock_guard<std::mutex> lk(stream_mu_);
+    if (stream_sid_ == 0)
+      return Error("no active stream (call StartStream first)");
+    sid = stream_sid_;
+    conn = stream_conn_;
+  }
+  inference::ModelInferRequest request;
+  Error err = BuildInferRequest(options, inputs, outputs, &request);
+  if (!err.IsOk()) return err;
+  std::string body;
+  if (!request.SerializeToString(&body))
+    return Error("failed to serialize stream request");
+  const std::string framed = LpmFrame(body);
+  const int64_t deadline_ms =
+      stream_timeout_us_ > 0
+          ? static_cast<int64_t>(stream_timeout_us_ / 1000) + 1
+          : 0;
+  return conn->SendData(
+      sid, reinterpret_cast<const uint8_t*>(framed.data()), framed.size(),
+      false, deadline_ms);
+}
+
+Error
+InferenceServerGrpcClient::StopStream()
+{
+  int32_t sid;
+  std::shared_ptr<h2::H2Connection> conn;
+  {
+    std::lock_guard<std::mutex> lk(stream_mu_);
+    if (stream_sid_ == 0) return Error::Success();
+    sid = stream_sid_;
+    conn = stream_conn_;
+    stream_sid_ = 0;
+  }
+  // half-close, wait for server to finish, then drop state
+  const int64_t deadline_ms =
+      stream_timeout_us_ > 0
+          ? static_cast<int64_t>(stream_timeout_us_ / 1000) + 1
+          : 10000;
+  Error err = conn->SendData(sid, nullptr, 0, true, deadline_ms);
+  if (err.IsOk()) {
+    conn->WaitEndStream(sid, deadline_ms);
+  }
+  conn->ForgetStream(sid);
+  {
+    std::lock_guard<std::mutex> lk(stream_mu_);
+    stream_callback_ = nullptr;
+    stream_conn_.reset();
+    stream_rx_.clear();
+  }
+  return Error::Success();
+}
+
+}  // namespace ctpu

@@ -1,0 +1,153 @@
+// Native gRPC client for the KServe-v2 inference protocol — parity with the
+// reference C++ gRPC client (reference src/c++/library/grpc_client.h:100-570:
+// management surface, Infer, AsyncInfer via a completion-queue thread,
+// StartStream/AsyncStreamInfer bidi streaming), built on this framework's
+// own HTTP/2 transport (src/cpp/grpc/h2.h) and protoc-generated KServe
+// protos instead of libgrpc++.
+//
+// The per-connection reactor thread plays the role of the reference's
+// completion-queue thread (grpc_client.cc:1484): one thread drives every
+// in-flight async request and the stream reader, so hundreds of requests
+// can be outstanding with no thread-per-request.
+#pragma once
+
+#include <condition_variable>
+#include <deque>
+#include <functional>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "common.h"
+#include "inference.pb.h"
+
+namespace ctpu {
+namespace h2 {
+class H2Connection;
+}
+
+class InferenceServerGrpcClient {
+ public:
+  using OnCompleteFn = std::function<void(InferResultPtr)>;
+
+  // url is "host:port" (no scheme) or "grpc://host:port".
+  static Error Create(
+      std::unique_ptr<InferenceServerGrpcClient>* client,
+      const std::string& url, bool verbose = false);
+  ~InferenceServerGrpcClient();
+
+  // -- server / model management (grpc_client.h:118-259) -------------------
+  Error IsServerLive(bool* live);
+  Error IsServerReady(bool* ready);
+  Error IsModelReady(
+      bool* ready, const std::string& model_name,
+      const std::string& model_version = "");
+  Error ServerMetadata(inference::ServerMetadataResponse* response);
+  Error ModelMetadata(
+      inference::ModelMetadataResponse* response, const std::string& name,
+      const std::string& version = "");
+  Error ModelConfig(
+      inference::ModelConfigResponse* response, const std::string& name,
+      const std::string& version = "");
+  Error ModelRepositoryIndex(inference::RepositoryIndexResponse* response);
+  Error LoadModel(
+      const std::string& name, const std::string& config_json = "");
+  Error UnloadModel(const std::string& name);
+  Error ModelInferenceStatistics(
+      inference::ModelStatisticsResponse* response,
+      const std::string& name = "", const std::string& version = "");
+
+  // -- shared memory verbs (grpc_client.h:263-321) -------------------------
+  Error SystemSharedMemoryStatus(
+      inference::SystemSharedMemoryStatusResponse* response,
+      const std::string& region_name = "");
+  Error RegisterSystemSharedMemory(
+      const std::string& name, const std::string& key, size_t byte_size,
+      size_t offset = 0);
+  Error UnregisterSystemSharedMemory(const std::string& name = "");
+  // TPU device-buffer regions ride the cuda-shm verbs of the KServe proto
+  // (the framework's CUDA-shm replacement — SURVEY §2.2 north star).
+  Error TpuSharedMemoryStatus(
+      inference::CudaSharedMemoryStatusResponse* response,
+      const std::string& region_name = "");
+  Error RegisterTpuSharedMemory(
+      const std::string& name, const std::string& raw_handle, int device_id,
+      size_t byte_size);
+  Error UnregisterTpuSharedMemory(const std::string& name = "");
+
+  // -- inference ------------------------------------------------------------
+  Error Infer(
+      InferResult** result, const InferOptions& options,
+      const std::vector<InferInput*>& inputs,
+      const std::vector<const InferRequestedOutput*>& outputs = {},
+      const std::vector<std::pair<std::string, std::string>>& headers = {});
+  Error AsyncInfer(
+      OnCompleteFn callback, const InferOptions& options,
+      const std::vector<InferInput*>& inputs,
+      const std::vector<const InferRequestedOutput*>& outputs = {},
+      const std::vector<std::pair<std::string, std::string>>& headers = {});
+
+  // -- decoupled / sequence streaming (grpc_client.h:414-504) ---------------
+  // One bidi ModelStreamInfer stream per client.  Responses (and stream
+  // errors, delivered as error-message results) arrive on `callback`.
+  Error StartStream(
+      OnCompleteFn callback, uint64_t stream_timeout_us = 0,
+      const std::vector<std::pair<std::string, std::string>>& headers = {});
+  Error AsyncStreamInfer(
+      const InferOptions& options, const std::vector<InferInput*>& inputs,
+      const std::vector<const InferRequestedOutput*>& outputs = {});
+  Error StopStream();
+
+  // Per-client aggregate of request timers (reference InferStat).
+  struct InferStat {
+    uint64_t completed_request_count = 0;
+    uint64_t cumulative_total_request_time_ns = 0;
+    uint64_t cumulative_send_time_ns = 0;
+    uint64_t cumulative_receive_time_ns = 0;
+  };
+  Error ClientInferStat(InferStat* stat);
+
+ private:
+  InferenceServerGrpcClient(const std::string& host, int port, bool verbose);
+  Error Connected();
+  // One unary gRPC exchange: serialize request, LPM-frame, wait for the
+  // response message + trailers, check grpc-status.
+  Error Call(
+      const std::string& method, const google::protobuf::Message& request,
+      google::protobuf::Message* response, uint64_t timeout_us = 0,
+      const std::vector<std::pair<std::string, std::string>>& headers = {});
+  Error BuildInferRequest(
+      const InferOptions& options, const std::vector<InferInput*>& inputs,
+      const std::vector<const InferRequestedOutput*>& outputs,
+      inference::ModelInferRequest* request);
+  void UpdateStat(const RequestTimers& timers);
+
+  std::string host_;
+  int port_;
+  bool verbose_;
+  // shared_ptr: a reconnect swaps conn_ while requests may still be blocked
+  // inside (or async callbacks may still reference) the old connection —
+  // each call path pins its own reference.
+  std::shared_ptr<h2::H2Connection> conn_;
+  std::mutex conn_mu_;
+  std::shared_ptr<h2::H2Connection> Conn();
+
+  // streaming state
+  std::mutex stream_mu_;
+  std::shared_ptr<h2::H2Connection> stream_conn_;  // owns stream_sid_
+  int32_t stream_sid_ = 0;
+  OnCompleteFn stream_callback_;
+  std::string stream_rx_;  // partial length-prefixed message bytes
+  uint64_t stream_timeout_us_ = 0;
+
+  std::mutex stat_mu_;
+  InferStat stat_;
+};
+
+// Convenience mirrors of the reference's free helpers.
+Error ParseGrpcInferResult(
+    const inference::ModelInferResponse& response, InferResult** result);
+
+}  // namespace ctpu

@@ -1,0 +1,116 @@
+// Sequence inference over one bidi gRPC stream: two interleaved sequences
+// share a ModelStreamInfer stream; the server's stateful accumulator returns
+// the running sum per sequence (parity with reference
+// src/c++/examples/simple_grpc_sequence_stream_infer_client.cc:168-260).
+//
+// Usage: simple_grpc_sequence_stream_infer_client [-u host:port]
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "grpc_client.h"
+
+namespace tc = ctpu;
+
+#define FAIL_IF_ERR(X, MSG)                                 \
+  do {                                                      \
+    tc::Error err__ = (X);                                  \
+    if (!err__.IsOk()) {                                    \
+      fprintf(stderr, "error: %s: %s\n", (MSG),            \
+              err__.Message().c_str());                     \
+      return 1;                                             \
+    }                                                       \
+  } while (false)
+
+int
+main(int argc, char** argv)
+{
+  std::string url = "localhost:8001";
+  for (int i = 1; i < argc - 1; ++i)
+    if (!std::strcmp(argv[i], "-u")) url = argv[++i];
+
+  std::unique_ptr<tc::InferenceServerGrpcClient> client;
+  FAIL_IF_ERR(
+      tc::InferenceServerGrpcClient::Create(&client, url), "create client");
+
+  std::mutex mu;
+  std::condition_variable cv;
+  std::map<std::string, std::vector<int32_t>> results;  // request id -> sums
+  size_t expected = 0;
+
+  FAIL_IF_ERR(
+      client->StartStream(
+          [&](tc::InferResultPtr result) {
+            std::lock_guard<std::mutex> lk(mu);
+            if (!result->RequestStatus().IsOk()) {
+              fprintf(stderr, "stream error: %s\n",
+                      result->RequestStatus().Message().c_str());
+              results["<error>"].push_back(-1);
+            } else {
+              const uint8_t* data = nullptr;
+              size_t nbytes = 0;
+              if (result->RawData("OUTPUT", &data, &nbytes).IsOk())
+                results[result->Id()].push_back(
+                    *reinterpret_cast<const int32_t*>(data));
+            }
+            cv.notify_all();
+          }),
+      "start stream");
+
+  // Two sequences, interleaved on the same stream: ids 100 (values 1..4)
+  // and 200 (values 10..40 by 10).
+  const int steps = 4;
+  for (int step = 0; step < steps; ++step) {
+    for (const uint64_t seq_id : {100ull, 200ull}) {
+      int32_t value = (step + 1) * (seq_id == 100 ? 1 : 10);
+      tc::InferInput input("INPUT", {1}, "INT32");
+      input.AppendRaw(
+          reinterpret_cast<const uint8_t*>(&value), sizeof(value));
+      tc::InferOptions options("simple_sequence");
+      options.sequence_id = seq_id;
+      options.sequence_start = (step == 0);
+      options.sequence_end = (step == steps - 1);
+      options.request_id =
+          std::to_string(seq_id) + "_" + std::to_string(step);
+      FAIL_IF_ERR(
+          client->AsyncStreamInfer(options, {&input}), "stream infer");
+      ++expected;
+    }
+  }
+
+  {
+    std::unique_lock<std::mutex> lk(mu);
+    cv.wait_for(lk, std::chrono::seconds(30), [&] {
+      size_t n = 0;
+      for (auto& kv : results) n += kv.second.size();
+      return n >= expected;
+    });
+  }
+  FAIL_IF_ERR(client->StopStream(), "stop stream");
+
+  // Validate the running sums: seq 100 -> 1,3,6,10; seq 200 -> 10,30,60,100.
+  int32_t acc100 = 0, acc200 = 0;
+  for (int step = 0; step < steps; ++step) {
+    acc100 += step + 1;
+    acc200 += (step + 1) * 10;
+    const auto& r100 = results[std::to_string(100) + "_" + std::to_string(step)];
+    const auto& r200 = results[std::to_string(200) + "_" + std::to_string(step)];
+    if (r100.size() != 1 || r100[0] != acc100 || r200.size() != 1 ||
+        r200[0] != acc200) {
+      fprintf(stderr, "error: step %d got [%zu:%d] [%zu:%d] want %d / %d\n",
+              step, r100.size(), r100.empty() ? -1 : r100[0], r200.size(),
+              r200.empty() ? -1 : r200[0], acc100, acc200);
+      return 1;
+    }
+    printf("seq 100 step %d -> %d ; seq 200 step %d -> %d\n", step, acc100,
+           step, acc200);
+  }
+  printf("PASS : grpc_sequence_stream\n");
+  return 0;
+}

@@ -1,0 +1,705 @@
+#include "h2.h"
+
+#include <arpa/inet.h>
+#include <fcntl.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <cstring>
+
+namespace ctpu {
+namespace h2 {
+
+namespace {
+
+constexpr uint8_t kData = 0x0;
+constexpr uint8_t kHeaders = 0x1;
+constexpr uint8_t kRstStream = 0x3;
+constexpr uint8_t kSettings = 0x4;
+constexpr uint8_t kPushPromise = 0x5;
+constexpr uint8_t kPing = 0x6;
+constexpr uint8_t kGoaway = 0x7;
+constexpr uint8_t kWindowUpdate = 0x8;
+constexpr uint8_t kContinuation = 0x9;
+
+constexpr uint8_t kFlagEndStream = 0x1;
+constexpr uint8_t kFlagAck = 0x1;
+constexpr uint8_t kFlagEndHeaders = 0x4;
+constexpr uint8_t kFlagPadded = 0x8;
+constexpr uint8_t kFlagPriority = 0x20;
+
+// Our receive-side windows.  We buffer in user space and replenish
+// immediately, so these just need to cover the bandwidth-delay product of
+// large tensor responses.
+constexpr uint32_t kInitialWindow = 8 * 1024 * 1024;
+constexpr uint32_t kConnWindowBoost = 64 * 1024 * 1024;
+
+const char kPreface[] = "PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n";
+
+void
+Put24(std::string* s, uint32_t v)
+{
+  s->push_back(static_cast<char>((v >> 16) & 0xff));
+  s->push_back(static_cast<char>((v >> 8) & 0xff));
+  s->push_back(static_cast<char>(v & 0xff));
+}
+
+void
+Put32(std::string* s, uint32_t v)
+{
+  s->push_back(static_cast<char>((v >> 24) & 0xff));
+  s->push_back(static_cast<char>((v >> 16) & 0xff));
+  s->push_back(static_cast<char>((v >> 8) & 0xff));
+  s->push_back(static_cast<char>(v & 0xff));
+}
+
+void
+Put16(std::string* s, uint16_t v)
+{
+  s->push_back(static_cast<char>((v >> 8) & 0xff));
+  s->push_back(static_cast<char>(v & 0xff));
+}
+
+uint32_t
+Get32(const uint8_t* p)
+{
+  return (uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) |
+         (uint32_t(p[2]) << 8) | uint32_t(p[3]);
+}
+
+std::chrono::steady_clock::time_point
+Deadline(int64_t deadline_ms)
+{
+  if (deadline_ms <= 0)
+    return std::chrono::steady_clock::time_point::max();
+  return std::chrono::steady_clock::now() +
+         std::chrono::milliseconds(deadline_ms);
+}
+
+}  // namespace
+
+H2Connection::~H2Connection() { Close(); }
+
+Error
+H2Connection::Connect(
+    const std::string& host, int port, int64_t connect_timeout_ms)
+{
+  struct addrinfo hints;
+  std::memset(&hints, 0, sizeof(hints));
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  struct addrinfo* res = nullptr;
+  const std::string port_s = std::to_string(port);
+  if (getaddrinfo(host.c_str(), port_s.c_str(), &hints, &res) != 0 ||
+      res == nullptr) {
+    return Error("failed to resolve host '" + host + "'");
+  }
+  int fd = -1;
+  for (struct addrinfo* ai = res; ai != nullptr; ai = ai->ai_next) {
+    fd = socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd < 0) continue;
+    // non-blocking connect with timeout
+    const int fl = fcntl(fd, F_GETFL, 0);
+    fcntl(fd, F_SETFL, fl | O_NONBLOCK);
+    int rc = connect(fd, ai->ai_addr, ai->ai_addrlen);
+    if (rc != 0 && errno == EINPROGRESS) {
+      struct pollfd pfd = {fd, POLLOUT, 0};
+      rc = poll(&pfd, 1, static_cast<int>(connect_timeout_ms));
+      int soerr = 0;
+      socklen_t slen = sizeof(soerr);
+      if (rc == 1 &&
+          getsockopt(fd, SOL_SOCKET, SO_ERROR, &soerr, &slen) == 0 &&
+          soerr == 0) {
+        rc = 0;
+      } else {
+        rc = -1;
+      }
+    }
+    if (rc == 0) {
+      fcntl(fd, F_SETFL, fl);  // back to blocking
+      break;
+    }
+    close(fd);
+    fd = -1;
+  }
+  freeaddrinfo(res);
+  if (fd < 0) {
+    return Error(
+        "failed to connect to '" + host + ":" + port_s + "'");
+  }
+  const int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  fd_ = fd;
+
+  // Client preface: magic + SETTINGS (push off, big stream windows), then a
+  // connection-level WINDOW_UPDATE so large responses never stall.
+  std::string settings;
+  Put16(&settings, 0x2);  // ENABLE_PUSH
+  Put32(&settings, 0);
+  Put16(&settings, 0x4);  // INITIAL_WINDOW_SIZE
+  Put32(&settings, kInitialWindow);
+  std::string buf(kPreface, sizeof(kPreface) - 1);
+  Put24(&buf, settings.size());
+  buf.push_back(kSettings);
+  buf.push_back(0);
+  Put32(&buf, 0);
+  buf += settings;
+  Put24(&buf, 4);
+  buf.push_back(kWindowUpdate);
+  buf.push_back(0);
+  Put32(&buf, 0);
+  Put32(&buf, kConnWindowBoost - 65535);
+  Error err =
+      WriteAll(reinterpret_cast<const uint8_t*>(buf.data()), buf.size());
+  if (!err.IsOk()) {
+    close(fd_);
+    fd_ = -1;
+    return err;
+  }
+  open_ = true;
+  reader_ = std::thread(&H2Connection::ReaderLoop, this);
+  return Error::Success();
+}
+
+bool
+H2Connection::IsOpen()
+{
+  std::lock_guard<std::mutex> lk(mu_);
+  return open_ && conn_err_.IsOk();
+}
+
+void
+H2Connection::Close()
+{
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (!open_ && fd_ < 0) return;
+    open_ = false;
+  }
+  if (fd_ >= 0) {
+    // GOAWAY then hard shutdown; the reader thread unblocks on EOF/EPIPE.
+    std::string payload;
+    Put32(&payload, 0);  // last stream id
+    Put32(&payload, 0);  // NO_ERROR
+    WriteFrame(kGoaway, 0, 0, payload);
+    shutdown(fd_, SHUT_RDWR);
+  }
+  if (reader_.joinable()) reader_.join();
+  if (fd_ >= 0) {
+    close(fd_);
+    fd_ = -1;
+  }
+}
+
+Error
+H2Connection::WriteAll(const uint8_t* buf, size_t len)
+{
+  size_t off = 0;
+  while (off < len) {
+    const ssize_t n = send(fd_, buf + off, len - off, MSG_NOSIGNAL);
+    if (n <= 0) {
+      if (n < 0 && (errno == EINTR)) continue;
+      return Error("h2 connection write failed: " +
+                   std::string(std::strerror(errno)));
+    }
+    off += static_cast<size_t>(n);
+  }
+  return Error::Success();
+}
+
+Error
+H2Connection::WriteFrame(
+    uint8_t type, uint8_t flags, int32_t sid, const std::string& payload)
+{
+  std::string hdr;
+  Put24(&hdr, payload.size());
+  hdr.push_back(type);
+  hdr.push_back(flags);
+  Put32(&hdr, static_cast<uint32_t>(sid));
+  std::lock_guard<std::mutex> lk(write_mu_);
+  Error err =
+      WriteAll(reinterpret_cast<const uint8_t*>(hdr.data()), hdr.size());
+  if (!err.IsOk()) return err;
+  if (payload.empty()) return Error::Success();
+  return WriteAll(
+      reinterpret_cast<const uint8_t*>(payload.data()), payload.size());
+}
+
+std::shared_ptr<Stream>
+H2Connection::StreamLocked(int32_t sid)
+{
+  auto it = streams_.find(sid);
+  return it == streams_.end() ? nullptr : it->second;
+}
+
+std::shared_ptr<Stream>
+H2Connection::GetStream(int32_t sid)
+{
+  std::lock_guard<std::mutex> lk(mu_);
+  return StreamLocked(sid);
+}
+
+void
+H2Connection::ForgetStream(int32_t sid)
+{
+  std::lock_guard<std::mutex> lk(mu_);
+  streams_.erase(sid);
+}
+
+Error
+H2Connection::ConnectionError()
+{
+  std::lock_guard<std::mutex> lk(mu_);
+  return conn_err_;
+}
+
+Error
+H2Connection::StartStream(
+    const std::vector<Header>& headers, bool end_stream, int32_t* sid,
+    std::function<void()> on_event)
+{
+  auto stream = std::make_shared<Stream>();
+  stream->on_event = std::move(on_event);
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (!open_) return Error("h2 connection is closed");
+    if (!conn_err_.IsOk()) return conn_err_;
+    if (goaway_) return Error("h2 connection is draining (GOAWAY)");
+    stream->id = next_stream_id_;
+    next_stream_id_ += 2;
+    stream->send_window = peer_initial_window_;
+    streams_[stream->id] = stream;
+  }
+  *sid = stream->id;
+
+  // HPACK encoding shares write_mu_ with the frame writes: header blocks
+  // must land on the wire in encode order.
+  std::string block;
+  std::lock_guard<std::mutex> lk(write_mu_);
+  hpack_tx_.Encode(headers, &block);
+  size_t off = 0;
+  bool first = true;
+  do {
+    const size_t n = std::min<size_t>(block.size() - off, peer_max_frame_);
+    const bool last = (off + n == block.size());
+    std::string hdr;
+    Put24(&hdr, n);
+    hdr.push_back(first ? kHeaders : kContinuation);
+    uint8_t flags = last ? kFlagEndHeaders : 0;
+    if (first && end_stream) flags |= kFlagEndStream;
+    hdr.push_back(flags);
+    Put32(&hdr, static_cast<uint32_t>(stream->id));
+    Error err =
+        WriteAll(reinterpret_cast<const uint8_t*>(hdr.data()), hdr.size());
+    if (err.IsOk() && n > 0) {
+      err = WriteAll(
+          reinterpret_cast<const uint8_t*>(block.data() + off), n);
+    }
+    if (!err.IsOk()) return err;
+    off += n;
+    first = false;
+  } while (off < block.size());
+  return Error::Success();
+}
+
+Error
+H2Connection::SendData(
+    int32_t sid, const uint8_t* buf, size_t len, bool end_stream,
+    int64_t deadline_ms)
+{
+  const auto dl = Deadline(deadline_ms);
+  size_t off = 0;
+  while (off < len || (end_stream && len == 0)) {
+    size_t budget;
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      auto stream = StreamLocked(sid);
+      if (stream == nullptr) return Error("h2 stream closed");
+      if (!cv_.wait_until(lk, dl, [&] {
+            return !conn_err_.IsOk() || stream->reset ||
+                   (conn_send_window_ > 0 && stream->send_window > 0) ||
+                   (end_stream && len == 0);
+          })) {
+        return Error("timeout waiting for send window");
+      }
+      if (!conn_err_.IsOk()) return conn_err_;
+      if (stream->reset)
+        return Error(
+            "h2 stream reset by peer (code " +
+            std::to_string(stream->rst_code) + ")");
+      budget = std::min<size_t>(
+          {len - off, static_cast<size_t>(std::max<int64_t>(
+                          0, std::min(conn_send_window_,
+                                      stream->send_window))),
+           peer_max_frame_});
+      if (len != 0) {
+        conn_send_window_ -= budget;
+        stream->send_window -= budget;
+      }
+    }
+    const bool last = (off + budget == len);
+    std::string payload(
+        reinterpret_cast<const char*>(buf + off), budget);
+    Error err = WriteFrame(
+        kData, (last && end_stream) ? kFlagEndStream : 0, sid, payload);
+    if (!err.IsOk()) return err;
+    off += budget;
+    if (last) break;
+  }
+  return Error::Success();
+}
+
+void
+H2Connection::ResetStream(int32_t sid, uint32_t error_code)
+{
+  std::string payload;
+  Put32(&payload, error_code);
+  WriteFrame(kRstStream, 0, sid, payload);
+  std::lock_guard<std::mutex> lk(mu_);
+  auto stream = StreamLocked(sid);
+  if (stream != nullptr) {
+    stream->reset = true;
+    stream->rst_code = error_code;
+    stream->end_stream = true;
+  }
+  cv_.notify_all();
+}
+
+Error
+H2Connection::WaitHeaders(int32_t sid, int64_t deadline_ms)
+{
+  const auto dl = Deadline(deadline_ms);
+  std::unique_lock<std::mutex> lk(mu_);
+  auto stream = StreamLocked(sid);
+  if (stream == nullptr) return Error("h2 stream closed");
+  if (!cv_.wait_until(lk, dl, [&] {
+        return stream->headers_done || stream->end_stream || stream->reset ||
+               !conn_err_.IsOk();
+      })) {
+    return Error("timeout waiting for response headers");
+  }
+  if (!conn_err_.IsOk()) return conn_err_;
+  if (stream->reset)
+    return Error(
+        "h2 stream reset by peer (code " + std::to_string(stream->rst_code) +
+        ")");
+  return Error::Success();
+}
+
+Error
+H2Connection::ReadData(
+    int32_t sid, size_t min_bytes, std::string* out, int64_t deadline_ms)
+{
+  const auto dl = Deadline(deadline_ms);
+  std::unique_lock<std::mutex> lk(mu_);
+  auto stream = StreamLocked(sid);
+  if (stream == nullptr) return Error("h2 stream closed");
+  if (!cv_.wait_until(lk, dl, [&] {
+        return stream->data.size() - stream->consumed >= min_bytes ||
+               stream->end_stream || stream->reset || !conn_err_.IsOk();
+      })) {
+    return Error("timeout waiting for response data");
+  }
+  if (!conn_err_.IsOk()) return conn_err_;
+  if (stream->reset)
+    return Error(
+        "h2 stream reset by peer (code " + std::to_string(stream->rst_code) +
+        ")");
+  out->append(stream->data, stream->consumed, std::string::npos);
+  stream->consumed = stream->data.size();
+  return Error::Success();
+}
+
+Error
+H2Connection::WaitEndStream(int32_t sid, int64_t deadline_ms)
+{
+  const auto dl = Deadline(deadline_ms);
+  std::unique_lock<std::mutex> lk(mu_);
+  auto stream = StreamLocked(sid);
+  if (stream == nullptr) return Error("h2 stream closed");
+  if (!cv_.wait_until(lk, dl, [&] {
+        return stream->end_stream || stream->reset || !conn_err_.IsOk();
+      })) {
+    return Error("timeout waiting for response");
+  }
+  if (!conn_err_.IsOk()) return conn_err_;
+  if (stream->reset)
+    return Error(
+        "h2 stream reset by peer (code " + std::to_string(stream->rst_code) +
+        ")");
+  return Error::Success();
+}
+
+void
+H2Connection::FailConnection(const std::string& msg)
+{
+  std::vector<std::function<void()>> callbacks;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (conn_err_.IsOk()) conn_err_ = Error(msg);
+    for (auto& kv : streams_) {
+      kv.second->end_stream = true;
+      if (kv.second->on_event) callbacks.push_back(kv.second->on_event);
+    }
+  }
+  cv_.notify_all();
+  for (auto& cb : callbacks) cb();
+}
+
+void
+H2Connection::ReaderLoop()
+{
+  std::string buf;
+  uint8_t hdr[9];
+  while (true) {
+    // frame header
+    size_t got = 0;
+    while (got < 9) {
+      const ssize_t n = recv(fd_, hdr + got, 9 - got, 0);
+      if (n <= 0) {
+        if (n < 0 && errno == EINTR) continue;
+        FailConnection(
+            got == 0 && n == 0 ? "h2 connection closed by peer"
+                               : "h2 connection read failed");
+        return;
+      }
+      got += static_cast<size_t>(n);
+    }
+    const uint32_t len =
+        (uint32_t(hdr[0]) << 16) | (uint32_t(hdr[1]) << 8) | uint32_t(hdr[2]);
+    const uint8_t type = hdr[3];
+    const uint8_t flags = hdr[4];
+    const int32_t sid = static_cast<int32_t>(Get32(hdr + 5) & 0x7fffffff);
+    if (len > 16 * 1024 * 1024) {
+      FailConnection("h2 frame exceeds sane size");
+      return;
+    }
+    buf.resize(len);
+    size_t off = 0;
+    while (off < len) {
+      const ssize_t n = recv(fd_, &buf[off], len - off, 0);
+      if (n <= 0) {
+        if (n < 0 && errno == EINTR) continue;
+        FailConnection("h2 connection read failed mid-frame");
+        return;
+      }
+      off += static_cast<size_t>(n);
+    }
+    HandleFrame(type, flags, sid, std::move(buf));
+    buf.clear();
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      if (!conn_err_.IsOk()) return;
+    }
+  }
+}
+
+void
+H2Connection::HandleFrame(
+    uint8_t type, uint8_t flags, int32_t sid, std::string payload)
+{
+  switch (type) {
+    case kData: {
+      size_t start = 0, end = payload.size();
+      if (flags & kFlagPadded) {
+        if (payload.empty()) return FailConnection("malformed DATA");
+        const uint8_t pad = payload[0];
+        if (1u + pad > payload.size())
+          return FailConnection("malformed DATA padding");
+        start = 1;
+        end = payload.size() - pad;
+      }
+      std::function<void()> cb;
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        auto stream = StreamLocked(sid);
+        if (stream != nullptr) {
+          stream->data.append(payload, start, end - start);
+          if (flags & kFlagEndStream) stream->end_stream = true;
+          cb = stream->on_event;
+        }
+      }
+      // Replenish both windows for the whole frame (padding included).
+      if (!payload.empty()) {
+        std::string wu;
+        Put32(&wu, payload.size());
+        WriteFrame(kWindowUpdate, 0, 0, wu);
+        bool stream_open;
+        {
+          std::lock_guard<std::mutex> lk(mu_);
+          auto stream = StreamLocked(sid);
+          stream_open = stream != nullptr && !stream->end_stream;
+        }
+        if (stream_open) WriteFrame(kWindowUpdate, 0, sid, wu);
+      }
+      cv_.notify_all();
+      if (cb) cb();
+      break;
+    }
+    case kHeaders:
+    case kContinuation: {
+      size_t start = 0, end = payload.size();
+      if (type == kHeaders) {
+        if (flags & kFlagPadded) {
+          if (payload.empty()) return FailConnection("malformed HEADERS");
+          const uint8_t pad = payload[0];
+          if (1u + pad > payload.size())
+            return FailConnection("malformed HEADERS padding");
+          start = 1;
+          end = payload.size() - pad;
+        }
+        if (flags & kFlagPriority) {
+          if (start + 5 > end)
+            return FailConnection("malformed HEADERS priority");
+          start += 5;
+        }
+        hdr_stream_ = sid;
+        hdr_block_.clear();
+        hdr_end_stream_ = (flags & kFlagEndStream) != 0;
+      } else if (sid != hdr_stream_) {
+        return FailConnection("CONTINUATION for wrong stream");
+      }
+      hdr_block_.append(payload, start, end - start);
+      if (!(flags & kFlagEndHeaders)) break;
+      std::vector<Header> decoded;
+      std::function<void()> cb;
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        if (!hpack_rx_.Decode(
+                reinterpret_cast<const uint8_t*>(hdr_block_.data()),
+                hdr_block_.size(), &decoded)) {
+          conn_err_ = Error("HPACK decode failed (COMPRESSION_ERROR)");
+          cv_.notify_all();
+          return;
+        }
+        auto stream = StreamLocked(hdr_stream_);
+        if (stream != nullptr) {
+          if (!stream->headers_done) {
+            stream->headers = std::move(decoded);
+            stream->headers_done = true;
+          } else {
+            stream->trailers = std::move(decoded);
+          }
+          if (hdr_end_stream_) stream->end_stream = true;
+          cb = stream->on_event;
+        }
+      }
+      hdr_block_.clear();
+      cv_.notify_all();
+      if (cb) cb();
+      break;
+    }
+    case kSettings: {
+      if (flags & kFlagAck) break;
+      std::vector<std::function<void()>> callbacks;
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        for (size_t i = 0; i + 6 <= payload.size(); i += 6) {
+          const uint16_t id =
+              (uint16_t(uint8_t(payload[i])) << 8) | uint8_t(payload[i + 1]);
+          const uint32_t value =
+              Get32(reinterpret_cast<const uint8_t*>(payload.data()) + i + 2);
+          switch (id) {
+            case 0x1:
+              // HEADER_TABLE_SIZE constrains the *encoder* toward the peer
+              // (RFC 7540 §6.5.2); ours never uses the dynamic table, so
+              // nothing to do.  Our decoder's cap is OUR advertised value
+              // (default 4096), not the peer's.
+              break;
+            case 0x4: {  // INITIAL_WINDOW_SIZE: delta applies to open streams
+              const int64_t delta =
+                  int64_t(value) - int64_t(peer_initial_window_);
+              peer_initial_window_ = value;
+              for (auto& kv : streams_) kv.second->send_window += delta;
+              break;
+            }
+            case 0x5:  // MAX_FRAME_SIZE
+              peer_max_frame_ = value;
+              break;
+            default:
+              break;
+          }
+        }
+      }
+      WriteFrame(kSettings, kFlagAck, 0, "");
+      cv_.notify_all();
+      break;
+    }
+    case kPing:
+      if (!(flags & kFlagAck) && payload.size() == 8)
+        WriteFrame(kPing, kFlagAck, 0, payload);
+      break;
+    case kWindowUpdate: {
+      if (payload.size() != 4) return FailConnection("malformed WINDOW_UPDATE");
+      const uint32_t inc = Get32(
+          reinterpret_cast<const uint8_t*>(payload.data())) & 0x7fffffff;
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        if (sid == 0) {
+          conn_send_window_ += inc;
+        } else {
+          auto stream = StreamLocked(sid);
+          if (stream != nullptr) stream->send_window += inc;
+        }
+      }
+      cv_.notify_all();
+      break;
+    }
+    case kRstStream: {
+      if (payload.size() != 4) return FailConnection("malformed RST_STREAM");
+      std::function<void()> cb;
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        auto stream = StreamLocked(sid);
+        if (stream != nullptr) {
+          stream->reset = true;
+          stream->end_stream = true;
+          stream->rst_code =
+              Get32(reinterpret_cast<const uint8_t*>(payload.data()));
+          cb = stream->on_event;
+        }
+      }
+      cv_.notify_all();
+      if (cb) cb();
+      break;
+    }
+    case kGoaway: {
+      std::vector<std::function<void()>> callbacks;
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        goaway_ = true;
+        const int32_t last =
+            payload.size() >= 4
+                ? static_cast<int32_t>(
+                      Get32(reinterpret_cast<const uint8_t*>(payload.data())) &
+                      0x7fffffff)
+                : 0;
+        // Streams the server never processed die now; processed ones finish.
+        for (auto& kv : streams_) {
+          if (kv.first > last && !kv.second->end_stream) {
+            kv.second->reset = true;
+            kv.second->rst_code = 0x7;  // REFUSED_STREAM
+            kv.second->end_stream = true;
+            if (kv.second->on_event) callbacks.push_back(kv.second->on_event);
+          }
+        }
+      }
+      cv_.notify_all();
+      for (auto& cb : callbacks) cb();
+      break;
+    }
+    case kPushPromise:
+      FailConnection("unexpected PUSH_PROMISE (push is disabled)");
+      break;
+    default:
+      break;  // unknown frame types are ignored (RFC 7540 §4.1)
+  }
+}
+
+}  // namespace h2
+}  // namespace ctpu

@@ -1,0 +1,398 @@
+#include "hpack.h"
+
+#include <cassert>
+#include <cstring>
+#include <stdexcept>
+
+namespace ctpu {
+namespace h2 {
+
+// ---------------------------------------------------------------------------
+// Huffman
+// ---------------------------------------------------------------------------
+
+// RFC 7541 Appendix B code lengths, symbols 0..255 + EOS(256).  The code
+// itself is derived canonically in the constructor.
+static const uint8_t kHuffLen[257] = {
+    /*   0- 15 */ 13, 23, 28, 28, 28, 28, 28, 28, 28, 24, 30, 28, 28, 30, 28, 28,
+    /*  16- 31 */ 28, 28, 28, 28, 28, 28, 30, 28, 28, 28, 28, 28, 28, 28, 28, 28,
+    /*  32- 47 */ 6, 10, 10, 12, 13, 6, 8, 11, 10, 10, 8, 11, 8, 6, 6, 6,
+    /*  48- 63 */ 5, 5, 5, 6, 6, 6, 6, 6, 6, 6, 7, 8, 15, 6, 12, 10,
+    /*  64- 79 */ 13, 6, 7, 7, 7, 7, 7, 7, 7, 7, 7, 7, 7, 7, 7, 7,
+    /*  80- 95 */ 7, 7, 7, 7, 7, 7, 7, 7, 8, 7, 8, 13, 19, 13, 14, 6,
+    /*  96-111 */ 15, 5, 6, 5, 6, 5, 6, 6, 6, 5, 7, 7, 6, 6, 6, 5,
+    /* 112-127 */ 6, 7, 6, 5, 5, 6, 7, 7, 7, 7, 7, 15, 11, 14, 13, 28,
+    /* 128-143 */ 20, 22, 20, 20, 22, 22, 22, 23, 22, 23, 23, 23, 23, 23, 24, 23,
+    /* 144-159 */ 24, 24, 22, 23, 24, 23, 23, 23, 23, 21, 22, 23, 22, 23, 23, 24,
+    /* 160-175 */ 22, 21, 20, 22, 22, 23, 23, 21, 23, 22, 22, 24, 21, 22, 23, 23,
+    /* 176-191 */ 21, 21, 22, 21, 23, 22, 23, 23, 20, 22, 22, 22, 23, 22, 22, 23,
+    /* 192-207 */ 26, 26, 20, 19, 22, 23, 22, 25, 26, 26, 26, 27, 27, 26, 24, 25,
+    /* 208-223 */ 19, 21, 26, 27, 27, 26, 27, 24, 21, 21, 26, 26, 28, 27, 27, 27,
+    /* 224-239 */ 20, 24, 20, 21, 22, 21, 21, 23, 22, 22, 25, 25, 24, 24, 26, 23,
+    /* 240-255 */ 26, 27, 26, 26, 27, 27, 27, 27, 27, 28, 27, 27, 27, 27, 27, 26,
+    /* EOS 256 */ 30,
+};
+
+Huffman::Huffman()
+{
+  // Canonical code assignment: walk lengths ascending; within a length,
+  // symbols ascend and codes increment.
+  uint64_t kraft = 0;  // in units of 2^-30
+  uint32_t code = 0;
+  uint8_t prev_len = 0;
+  for (uint8_t bits = 1; bits <= 30; ++bits) {
+    for (int sym = 0; sym <= 256; ++sym) {
+      if (kHuffLen[sym] != bits) continue;
+      if (prev_len != 0) code = (code + 1) << (bits - prev_len);
+      // first assignment: code stays 0 at the smallest length
+      if (prev_len == 0) code = 0;
+      prev_len = bits;
+      code_[sym] = code;
+      len_[sym] = bits;
+      kraft += 1ull << (30 - bits);
+    }
+  }
+  if (kraft != (1ull << 30) || code_[256] != 0x3fffffff)
+    throw std::logic_error("HPACK Huffman length table is corrupt");
+
+  // Binary decode tree (513 nodes max for a complete code over 257 syms).
+  nodes_.push_back({{-1, -1}, -1});
+  for (int sym = 0; sym <= 256; ++sym) {
+    int n = 0;
+    for (int b = len_[sym] - 1; b >= 0; --b) {
+      int bit = (code_[sym] >> b) & 1;
+      if (nodes_[n].next[bit] < 0) {
+        nodes_[n].next[bit] = static_cast<int16_t>(nodes_.size());
+        nodes_.push_back({{-1, -1}, -1});
+      }
+      n = nodes_[n].next[bit];
+    }
+    nodes_[n].sym = static_cast<int16_t>(sym);
+  }
+}
+
+const Huffman&
+Huffman::Get()
+{
+  static const Huffman instance;
+  return instance;
+}
+
+bool
+Huffman::Decode(const uint8_t* data, size_t len, std::string* out) const
+{
+  int n = 0;
+  int depth = 0;  // bits consumed since last emit (for padding validation)
+  bool all_ones = true;
+  for (size_t i = 0; i < len; ++i) {
+    for (int b = 7; b >= 0; --b) {
+      int bit = (data[i] >> b) & 1;
+      if (!bit) all_ones = false;
+      n = nodes_[n].next[bit];
+      ++depth;
+      if (n < 0) return false;  // walked past a leaf: corrupt
+      if (nodes_[n].sym >= 0) {
+        if (nodes_[n].sym == 256) return false;  // explicit EOS is an error
+        out->push_back(static_cast<char>(nodes_[n].sym));
+        n = 0;
+        depth = 0;
+        all_ones = true;
+      }
+    }
+  }
+  // Residual bits must be a prefix of EOS (all ones), < 8 bits.
+  return depth < 8 && all_ones;
+}
+
+size_t
+Huffman::EncodedSize(const std::string& in) const
+{
+  size_t bits = 0;
+  for (unsigned char c : in) bits += len_[c];
+  return (bits + 7) / 8;
+}
+
+void
+Huffman::Encode(const std::string& in, std::string* out) const
+{
+  uint64_t acc = 0;
+  int nbits = 0;
+  for (unsigned char c : in) {
+    acc = (acc << len_[c]) | code_[c];
+    nbits += len_[c];
+    while (nbits >= 8) {
+      nbits -= 8;
+      out->push_back(static_cast<char>((acc >> nbits) & 0xff));
+    }
+  }
+  if (nbits > 0) {  // pad with EOS prefix (all ones)
+    acc = (acc << (8 - nbits)) | ((1u << (8 - nbits)) - 1);
+    out->push_back(static_cast<char>(acc & 0xff));
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Static table (RFC 7541 Appendix A)
+// ---------------------------------------------------------------------------
+
+static const Header kStaticTable[61] = {
+    {":authority", ""},
+    {":method", "GET"},
+    {":method", "POST"},
+    {":path", "/"},
+    {":path", "/index.html"},
+    {":scheme", "http"},
+    {":scheme", "https"},
+    {":status", "200"},
+    {":status", "204"},
+    {":status", "206"},
+    {":status", "304"},
+    {":status", "400"},
+    {":status", "404"},
+    {":status", "500"},
+    {"accept-charset", ""},
+    {"accept-encoding", "gzip, deflate"},
+    {"accept-language", ""},
+    {"accept-ranges", ""},
+    {"accept", ""},
+    {"access-control-allow-origin", ""},
+    {"age", ""},
+    {"allow", ""},
+    {"authorization", ""},
+    {"cache-control", ""},
+    {"content-disposition", ""},
+    {"content-encoding", ""},
+    {"content-language", ""},
+    {"content-length", ""},
+    {"content-location", ""},
+    {"content-range", ""},
+    {"content-type", ""},
+    {"cookie", ""},
+    {"date", ""},
+    {"etag", ""},
+    {"expect", ""},
+    {"expires", ""},
+    {"from", ""},
+    {"host", ""},
+    {"if-match", ""},
+    {"if-modified-since", ""},
+    {"if-none-match", ""},
+    {"if-range", ""},
+    {"if-unmodified-since", ""},
+    {"last-modified", ""},
+    {"link", ""},
+    {"location", ""},
+    {"max-forwards", ""},
+    {"proxy-authenticate", ""},
+    {"proxy-authorization", ""},
+    {"range", ""},
+    {"referer", ""},
+    {"refresh", ""},
+    {"retry-after", ""},
+    {"server", ""},
+    {"set-cookie", ""},
+    {"strict-transport-security", ""},
+    {"transfer-encoding", ""},
+    {"user-agent", ""},
+    {"vary", ""},
+    {"via", ""},
+    {"www-authenticate", ""},
+};
+
+// ---------------------------------------------------------------------------
+// Primitive integer / string codecs (RFC 7541 §5)
+// ---------------------------------------------------------------------------
+
+static void
+EncodeInt(uint64_t value, uint8_t prefix_bits, uint8_t first_byte_flags,
+          std::string* out)
+{
+  const uint64_t max_prefix = (1u << prefix_bits) - 1;
+  if (value < max_prefix) {
+    out->push_back(static_cast<char>(first_byte_flags | value));
+    return;
+  }
+  out->push_back(static_cast<char>(first_byte_flags | max_prefix));
+  value -= max_prefix;
+  while (value >= 128) {
+    out->push_back(static_cast<char>(0x80 | (value & 0x7f)));
+    value >>= 7;
+  }
+  out->push_back(static_cast<char>(value));
+}
+
+static bool
+DecodeInt(const uint8_t* data, size_t len, size_t* pos, uint8_t prefix_bits,
+          uint64_t* value)
+{
+  if (*pos >= len) return false;
+  const uint64_t max_prefix = (1u << prefix_bits) - 1;
+  uint64_t v = data[(*pos)++] & max_prefix;
+  if (v < max_prefix) {
+    *value = v;
+    return true;
+  }
+  int shift = 0;
+  while (true) {
+    if (*pos >= len || shift > 56) return false;
+    uint8_t b = data[(*pos)++];
+    v += static_cast<uint64_t>(b & 0x7f) << shift;
+    shift += 7;
+    if (!(b & 0x80)) break;
+  }
+  *value = v;
+  return true;
+}
+
+static bool
+DecodeString(const uint8_t* data, size_t len, size_t* pos, std::string* out)
+{
+  if (*pos >= len) return false;
+  const bool huffman = (data[*pos] & 0x80) != 0;
+  uint64_t slen;
+  if (!DecodeInt(data, len, pos, 7, &slen)) return false;
+  if (*pos + slen > len) return false;
+  out->clear();
+  bool ok = true;
+  if (huffman) {
+    ok = Huffman::Get().Decode(data + *pos, slen, out);
+  } else {
+    out->assign(reinterpret_cast<const char*>(data + *pos), slen);
+  }
+  *pos += slen;
+  return ok;
+}
+
+static void
+EncodeString(const std::string& s, std::string* out)
+{
+  EncodeInt(s.size(), 7, 0x00, out);  // plain, never Huffman on send
+  out->append(s);
+}
+
+// ---------------------------------------------------------------------------
+// HpackDecoder
+// ---------------------------------------------------------------------------
+
+HpackDecoder::HpackDecoder(size_t max_table_size)
+    : max_size_(max_table_size), settings_cap_(max_table_size)
+{
+}
+
+void
+HpackDecoder::SetMaxTableSize(size_t n)
+{
+  settings_cap_ = n;
+  if (max_size_ > n) {
+    max_size_ = n;
+    EvictFor(0);
+  }
+}
+
+bool
+HpackDecoder::Lookup(uint64_t index, Entry* out) const
+{
+  if (index == 0) return false;
+  if (index <= 61) {
+    out->name = kStaticTable[index - 1].first;
+    out->value = kStaticTable[index - 1].second;
+    return true;
+  }
+  const size_t d = index - 62;
+  if (d >= dynamic_.size()) return false;
+  *out = dynamic_[d];
+  return true;
+}
+
+void
+HpackDecoder::EvictFor(size_t need)
+{
+  while (!dynamic_.empty() && dynamic_size_ + need > max_size_) {
+    const Entry& e = dynamic_.back();
+    dynamic_size_ -= e.name.size() + e.value.size() + 32;
+    dynamic_.pop_back();
+  }
+}
+
+void
+HpackDecoder::Insert(const std::string& name, const std::string& value)
+{
+  const size_t sz = name.size() + value.size() + 32;
+  EvictFor(sz);
+  if (sz > max_size_) return;  // too large: table drains empty (RFC §4.4)
+  dynamic_.insert(dynamic_.begin(), {name, value});
+  dynamic_size_ += sz;
+}
+
+bool
+HpackDecoder::Decode(const uint8_t* data, size_t len, std::vector<Header>* out)
+{
+  size_t pos = 0;
+  while (pos < len) {
+    const uint8_t b = data[pos];
+    if (b & 0x80) {  // indexed header field (§6.1)
+      uint64_t index;
+      if (!DecodeInt(data, len, &pos, 7, &index)) return false;
+      Entry e;
+      if (!Lookup(index, &e)) return false;
+      out->emplace_back(std::move(e.name), std::move(e.value));
+    } else if ((b & 0xe0) == 0x20) {  // dynamic table size update (§6.3)
+      uint64_t sz;
+      if (!DecodeInt(data, len, &pos, 5, &sz)) return false;
+      if (sz > settings_cap_) return false;
+      max_size_ = sz;
+      EvictFor(0);
+    } else {
+      // Literal: incremental indexing (01xxxxxx, 6-bit name index),
+      // without indexing (0000xxxx), never indexed (0001xxxx).
+      const bool incremental = (b & 0xc0) == 0x40;
+      const uint8_t prefix = incremental ? 6 : 4;
+      uint64_t name_index;
+      if (!DecodeInt(data, len, &pos, prefix, &name_index)) return false;
+      std::string name;
+      if (name_index > 0) {
+        Entry e;
+        if (!Lookup(name_index, &e)) return false;
+        name = std::move(e.name);
+      } else {
+        if (!DecodeString(data, len, &pos, &name)) return false;
+      }
+      std::string value;
+      if (!DecodeString(data, len, &pos, &value)) return false;
+      if (incremental) Insert(name, value);
+      out->emplace_back(std::move(name), std::move(value));
+    }
+  }
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// HpackEncoder
+// ---------------------------------------------------------------------------
+
+void
+HpackEncoder::Encode(const std::vector<Header>& headers, std::string* out) const
+{
+  for (const Header& h : headers) {
+    int exact = 0, name_only = 0;
+    for (int i = 0; i < 61; ++i) {
+      if (kStaticTable[i].first != h.first) continue;
+      if (name_only == 0) name_only = i + 1;
+      if (kStaticTable[i].second == h.second) {
+        exact = i + 1;
+        break;
+      }
+    }
+    if (exact) {
+      EncodeInt(exact, 7, 0x80, out);  // indexed (§6.1)
+    } else {
+      // literal without indexing (§6.2.2), static name ref when available
+      EncodeInt(name_only, 4, 0x00, out);
+      if (name_only == 0) EncodeString(h.first, out);
+      EncodeString(h.second, out);
+    }
+  }
+}
+
+}  // namespace h2
+}  // namespace ctpu

@@ -1,0 +1,81 @@
+// HPACK (RFC 7541) header compression for the native gRPC client's HTTP/2
+// transport.  The decoder is complete (static + dynamic table, Huffman,
+// table-size updates) because the peer chooses the encoding; the encoder
+// stays in the always-safe subset (indexed static entries + literals
+// without indexing, no Huffman) — every compliant decoder accepts it.
+//
+// Parity note: this replaces the HPACK engine the reference client gets for
+// free from libgrpc (reference src/c++/library/grpc_client.cc links grpc++;
+// this framework's native stack speaks the wire format directly).
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <utility>
+#include <vector>
+
+namespace ctpu {
+namespace h2 {
+
+using Header = std::pair<std::string, std::string>;
+
+// Canonical Huffman code for header strings (RFC 7541 Appendix B).  The
+// table is generated at static-init from the per-symbol code lengths: the
+// RFC's code is canonical (within a length, symbols ascend; first code of a
+// longer length is (last+1) shifted), so lengths fully determine it.  Init
+// verifies the Kraft sum is exactly 1 and the EOS symbol lands on the
+// all-ones 30-bit code — any transcription error in the lengths trips it.
+class Huffman {
+ public:
+  static const Huffman& Get();
+
+  // Decoded string, or false on a malformed sequence (bad EOS padding).
+  bool Decode(const uint8_t* data, size_t len, std::string* out) const;
+  void Encode(const std::string& in, std::string* out) const;
+  size_t EncodedSize(const std::string& in) const;
+
+ private:
+  Huffman();
+  struct Node {
+    int16_t next[2];  // node index, or -1
+    int16_t sym;      // emitted symbol, or -1 for interior
+  };
+  std::vector<Node> nodes_;
+  uint32_t code_[257];
+  uint8_t len_[257];
+};
+
+// Decoding side of one HPACK connection context (one per h2 connection
+// direction; holds the peer-driven dynamic table).
+class HpackDecoder {
+ public:
+  explicit HpackDecoder(size_t max_table_size = 4096);
+
+  // Parse one complete header block.  Appends to *out.  Returns false on a
+  // malformed block (connection error per RFC 7541 §5.2/§6.3).
+  bool Decode(const uint8_t* data, size_t len, std::vector<Header>* out);
+
+  void SetMaxTableSize(size_t n);  // from peer SETTINGS
+
+ private:
+  struct Entry {
+    std::string name, value;
+  };
+  bool Lookup(uint64_t index, Entry* out) const;
+  void Insert(const std::string& name, const std::string& value);
+  void EvictFor(size_t need);
+
+  std::vector<Entry> dynamic_;  // newest at front
+  size_t dynamic_size_ = 0;     // RFC 7541 §4.1 size (bytes + 32/entry)
+  size_t max_size_;             // current limit (table-size updates)
+  size_t settings_cap_;         // upper bound from SETTINGS
+};
+
+// Encoding side: static-table exact/name matches + literal-without-indexing.
+class HpackEncoder {
+ public:
+  void Encode(const std::vector<Header>& headers, std::string* out) const;
+};
+
+}  // namespace h2
+}  // namespace ctpu

@@ -1,0 +1,77 @@
+// HPACK unit test: RFC 7541 Appendix C vectors (Huffman literals, header
+// blocks with dynamic-table evolution) + roundtrips of this implementation.
+// The Huffman table itself is init-verified (Kraft sum, EOS code) in hpack.cc.
+#include "hpack.h"
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+using namespace ctpu::h2;
+
+static bool huff(const char* hex, const char* want) {
+  std::string bytes;
+  for (const char* p = hex; *p; p += 2) {
+    unsigned v; sscanf(p, "%2x", &v); bytes.push_back((char)v);
+  }
+  std::string out;
+  bool ok = Huffman::Get().Decode((const uint8_t*)bytes.data(), bytes.size(), &out);
+  if (!ok || out != want) { printf("FAIL %s -> '%s' (want '%s', ok=%d)\n", hex, out.c_str(), want, ok); return false; }
+  return true;
+}
+
+int main() {
+  // RFC 7541 Appendix C Huffman-coded literals
+  bool ok = true;
+  ok &= huff("f1e3c2e5f23a6ba0ab90f4ff", "www.example.com");        // C.4.1
+  ok &= huff("a8eb10649cbf", "no-cache");                             // C.4.2
+  ok &= huff("25a849e95ba97d7f", "custom-key");                       // C.4.3
+  ok &= huff("25a849e95bb8e8b4bf", "custom-value");                   // C.4.3
+  ok &= huff("6402", "302");                                          // C.6.1
+  ok &= huff("aec3771a4b", "private");                                // C.6.1
+  ok &= huff("d07abe941054d444a8200595040b8166e082a62d1bff",
+             "Mon, 21 Oct 2013 20:13:21 GMT");                        // C.6.1
+  ok &= huff("9d29ad171863c78f0b97c8e9ae82ae43d3",
+             "https://www.example.com");                              // C.6.1
+  ok &= huff("94e7821dd7f2e6c7b335dfdfcd5b3960d5af27087f3672c1ab270fb5291f9587316065c003ed4ee5b1063d5007",
+             "foo=ASDJKHQKBZXOQWEOPIUAXQWEOIU; max-age=3600; version=1"); // C.6.3
+  // Roundtrip our own encoder through the decoder over all byte values
+  std::string all;
+  for (int i = 0; i < 256; ++i) all.push_back((char)i);
+  std::string enc, dec;
+  Huffman::Get().Encode(all, &enc);
+  if (!Huffman::Get().Decode((const uint8_t*)enc.data(), enc.size(), &dec) || dec != all) {
+    printf("FAIL roundtrip\n"); ok = false;
+  }
+  // HPACK block: encoder -> decoder roundtrip
+  HpackEncoder e;
+  HpackDecoder d;
+  std::vector<Header> in = {{":method", "POST"}, {":path", "/inference.GRPCInferenceService/ModelInfer"},
+                            {":scheme", "http"}, {":authority", "localhost:8001"},
+                            {"content-type", "application/grpc"}, {"te", "trailers"},
+                            {"grpc-timeout", "5S"}};
+  std::string block; e.Encode(in, &block);
+  std::vector<Header> got;
+  if (!d.Decode((const uint8_t*)block.data(), block.size(), &got) || got != in) {
+    printf("FAIL hpack roundtrip (%zu)\n", got.size()); ok = false;
+  }
+  // RFC C.3.1 request block (no Huffman, incremental indexing w/ dyn table)
+  {
+    const uint8_t block1[] = {0x82, 0x86, 0x84, 0x41, 0x0f, 'w','w','w','.','e','x','a','m','p','l','e','.','c','o','m'};
+    HpackDecoder d2;
+    std::vector<Header> h1;
+    if (!d2.Decode(block1, sizeof(block1), &h1) || h1 != std::vector<Header>{
+          {":method","GET"},{":scheme","http"},{":path","/"},{":authority","www.example.com"}}) {
+      printf("FAIL C.3.1\n"); ok = false;
+    }
+    // C.3.2 second request reuses dynamic entry 62
+    const uint8_t block2[] = {0x82, 0x86, 0x84, 0xbe, 0x58, 0x08, 'n','o','-','c','a','c','h','e'};
+    std::vector<Header> h2v;
+    if (!d2.Decode(block2, sizeof(block2), &h2v) || h2v != std::vector<Header>{
+          {":method","GET"},{":scheme","http"},{":path","/"},{":authority","www.example.com"},
+          {"cache-control","no-cache"}}) {
+      printf("FAIL C.3.2\n"); ok = false;
+    }
+  }
+  printf(ok ? "ALL OK\n" : "FAILURES\n");
+  return ok ? 0 : 1;
+}

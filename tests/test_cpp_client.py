@@ -43,3 +43,52 @@ def test_native_example(server):
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "PASS" in proc.stdout
+
+
+needs_grpc_cpp = pytest.mark.skipif(
+    not os.path.exists(os.path.join(_BUILD, "cc_grpc_client_test")),
+    reason="native gRPC client not built (make grpc_cpp)",
+)
+
+
+@pytest.fixture(scope="module")
+def grpc_server():
+    with Server(grpc_port=0) as s:
+        yield s
+
+
+@needs_grpc_cpp
+def test_hpack_unit(grpc_server):
+    proc = subprocess.run(
+        [os.path.join(_BUILD, "hpack_unit_test")],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+@needs_grpc_cpp
+def test_cc_grpc_client_suite(grpc_server):
+    """The gRPC half of the typed two-protocol suite (reference
+    cc_client_test.cc:1626-1627): same check list as the HTTP binary, run
+    against the in-process gRPC server over a real socket — exercises the
+    hand-rolled HTTP/2 transport, HPACK, the async reactor (64 concurrent
+    AsyncInfer), bidi sequence streaming, and the management surface."""
+    proc = subprocess.run(
+        [os.path.join(_BUILD, "cc_grpc_client_test"),
+         grpc_server.grpc_address],
+        capture_output=True, text=True, timeout=180,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "PASS: cc_grpc_client_test" in proc.stdout
+
+
+@needs_grpc_cpp
+def test_native_grpc_examples(grpc_server):
+    for exe in ("simple_grpc_infer_client",
+                "simple_grpc_sequence_stream_infer_client"):
+        proc = subprocess.run(
+            [os.path.join(_BUILD, exe), "-u", grpc_server.grpc_address],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert proc.returncode == 0, exe + ": " + proc.stdout + proc.stderr
+        assert "PASS" in proc.stdout, exe
