@@ -27,6 +27,7 @@ from client_tpu.perf.load_manager import (
     LoadManager,
     RequestRateManager,
 )
+from client_tpu.perf.model_parser import ModelParser, SchedulerType
 from client_tpu.perf.profiler import InferenceProfiler, PerfStatus
 from client_tpu.perf.report import print_summary, write_csv
 from client_tpu.perf.sequence_manager import SequenceManager

@@ -23,6 +23,7 @@ from client_tpu.perf import (
     print_summary,
     write_csv,
 )
+from client_tpu.perf.model_parser import ModelParser
 from client_tpu.utils import InferenceServerException
 
 
@@ -44,8 +45,16 @@ def build_parser():
     p.add_argument("-x", "--model-version", default="")
     p.add_argument("-u", "--url", default="localhost:8001")
     p.add_argument("-i", "--protocol", choices=["grpc", "http"], default="grpc")
+    p.add_argument("--service-kind",
+                   choices=["triton", "torchserve", "tfserve"],
+                   default="triton",
+                   help="target service protocol family (reference "
+                        "--service-kind; non-KServe kinds declare the input "
+                        "tensor via --shape)")
     p.add_argument("--hermetic", action="store_true",
-                   help="benchmark the in-process server (no sockets)")
+                   help="benchmark the in-process server (no sockets); with "
+                        "--service-kind torchserve/tfserve spins the "
+                        "matching in-process fake endpoint")
     p.add_argument("--hermetic-models", default="builtin",
                    help="model sets for --hermetic: builtin,jax,language")
     p.add_argument("-b", "--batch-size", type=int, default=1)
@@ -59,6 +68,13 @@ def build_parser():
                    default="constant")
     p.add_argument("--measurement-interval", type=int, default=2000,
                    help="window length in msec (-p)")
+    p.add_argument("--measurement-mode",
+                   choices=["time_windows", "count_windows"],
+                   default="time_windows",
+                   help="close windows on elapsed time or on completed "
+                        "request count (reference --measurement-mode)")
+    p.add_argument("--measurement-request-count", type=int, default=50,
+                   help="requests per window for count_windows mode")
     p.add_argument("--max-trials", type=int, default=10)
     p.add_argument("-s", "--stability-percentage", type=float, default=10.0)
     p.add_argument("--percentile", type=int, default=None,
@@ -110,7 +126,26 @@ def main(argv=None):
         shape_overrides[name] = [int(d) for d in dims.split(",")]
 
     engine = None
-    if args.hermetic:
+    fake = None
+    backend_kwargs = {}
+    if args.service_kind in ("torchserve", "tfserve"):
+        kind = (BackendKind.TORCHSERVE if args.service_kind == "torchserve"
+                else BackendKind.TFSERVE)
+        if args.model_name in shape_overrides:
+            backend_kwargs["input_shape"] = shape_overrides[args.model_name]
+        elif shape_overrides:
+            backend_kwargs["input_shape"] = next(iter(shape_overrides.values()))
+        if args.hermetic:
+            from client_tpu.perf.fake_endpoints import (
+                fake_tfserving,
+                fake_torchserve,
+            )
+
+            fake = (fake_torchserve([args.model_name])
+                    if args.service_kind == "torchserve"
+                    else fake_tfserving([args.model_name])).start()
+            args.url = fake.url
+    elif args.hermetic:
         from client_tpu.serve import InferenceEngine
         from client_tpu.serve.models import model_sets
 
@@ -125,21 +160,24 @@ def main(argv=None):
 
     def backend_factory():
         return ClientBackendFactory.create(
-            kind, url=args.url, engine=engine, verbose=False
+            kind, url=args.url, engine=engine, verbose=False,
+            **backend_kwargs
         )
 
     control = backend_factory()
     try:
-        meta = control.model_metadata(args.model_name, args.model_version)
-        inputs_meta = [dict(m) for m in meta["inputs"]]
-        outputs_meta = [dict(m) for m in meta["outputs"]]
-        for m in inputs_meta:
-            # protobuf-JSON renders int64 dims as strings; normalize, and
-            # resolve a dynamic batch dim with --batch-size
-            dims = [int(d) for d in m["shape"]]
-            if dims and dims[0] == -1:
-                dims[0] = args.batch_size
-            m["shape"] = dims
+        parser_obj = ModelParser.create(
+            control, args.model_name, args.model_version,
+            batch_size=args.batch_size,
+        )
+        inputs_meta = parser_obj.inputs
+        outputs_meta = parser_obj.outputs
+        if parser_obj.requires_sequence_flags() and not args.sequence:
+            print(
+                f"note: model '{args.model_name}' uses the "
+                f"{parser_obj.scheduler_type} scheduler; consider --sequence",
+                file=sys.stderr,
+            )
 
         loader = DataLoader(
             inputs_meta, batch_size=args.batch_size,
@@ -229,6 +267,8 @@ def main(argv=None):
             verbose=args.verbose,
             metrics_manager=metrics,
             rendezvous=rendezvous,
+            measurement_mode=args.measurement_mode,
+            measurement_request_count=args.measurement_request_count,
         )
 
         try:
@@ -293,6 +333,8 @@ def main(argv=None):
             pass
         if engine is not None:
             engine.close()
+        if fake is not None:
+            fake.stop()
 
 
 if __name__ == "__main__":

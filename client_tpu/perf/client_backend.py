@@ -15,6 +15,7 @@ Backends:
   (reference mock_client_backend.h:405-583), used by the unit tests.
 """
 
+import json
 import threading
 import time
 
@@ -28,6 +29,10 @@ class BackendKind:
     TRITON_HTTP = "triton_http"
     INPROCESS = "inprocess"
     MOCK = "mock"
+    # non-KServe protocol families (reference client_backend.h:134-139 lists
+    # TENSORFLOW_SERVING and TORCHSERVE next to the Triton kinds)
+    TORCHSERVE = "torchserve"
+    TFSERVE = "tfserve"
 
 
 class ClientBackend:
@@ -88,6 +93,10 @@ class ClientBackendFactory:
             return _InprocessBackend(engine)
         if kind == BackendKind.MOCK:
             return MockClientBackend(**kwargs)
+        if kind == BackendKind.TORCHSERVE:
+            return _TorchServeBackend(url, **kwargs)
+        if kind == BackendKind.TFSERVE:
+            return _TfServeBackend(url, **kwargs)
         raise InferenceServerException(f"unknown backend kind '{kind}'")
 
 
@@ -302,6 +311,180 @@ class _InprocessBackend(ClientBackend):
     @property
     def requested_output_cls(self):
         return self._mod.InferRequestedOutput
+
+
+class _RestResult:
+    """InferResult-like view over a non-KServe JSON prediction response."""
+
+    def __init__(self, arrays, response):
+        self._arrays = arrays
+        self._response = response
+
+    def as_numpy(self, name):
+        return self._arrays.get(name)
+
+    def get_response(self):
+        return self._response
+
+
+class _TorchServeBackend(ClientBackend):
+    """TorchServe inference-API backend (reference
+    torchserve_http_client.cc:47-225): health via GET /ping, inference via
+    POST /predictions/{model} with the input payload as the request body.
+
+    TorchServe has no tensor-metadata endpoint, so (like the reference,
+    which requires --input-data for this service kind) the input shape is
+    declared at construction: ``input_shape``/``input_datatype`` kwargs, or
+    the DataLoader's ``--shape`` override downstream.
+    """
+
+    kind = BackendKind.TORCHSERVE
+
+    def __init__(self, url, verbose=False, input_shape=None,
+                 input_datatype="FP32", timeout_s=60.0):
+        import urllib3
+
+        if "://" not in url:
+            url = "http://" + url
+        self._base = url.rstrip("/")
+        self._http = urllib3.PoolManager(
+            maxsize=8, timeout=urllib3.Timeout(total=timeout_s)
+        )
+        self._shape = list(input_shape or [-1])
+        self._datatype = input_datatype
+
+    def _get(self, path):
+        r = self._http.request("GET", self._base + path)
+        if r.status != 200:
+            raise InferenceServerException(
+                f"torchserve GET {path} -> {r.status}: {r.data[:200]!r}",
+                status=str(r.status),
+            )
+        return json.loads(r.data)
+
+    def server_live(self):
+        return self._get("/ping").get("status") == "Healthy"
+
+    def model_metadata(self, model_name, model_version=""):
+        # surface the declared tensor interface in KServe-metadata shape so
+        # DataLoader / InferDataManager work unchanged
+        return {
+            "name": model_name,
+            "versions": ["1.0"],
+            "platform": "pytorch_torchserve",
+            "inputs": [{"name": "data", "datatype": self._datatype,
+                        "shape": self._shape}],
+            "outputs": [{"name": "predictions", "datatype": "FP64",
+                         "shape": [-1]}],
+        }
+
+    def model_config(self, model_name, model_version=""):
+        models = self._get(f"/models/{model_name}")
+        return {"name": model_name, "torchserve": models}
+
+    def infer(self, model_name, inputs, outputs=None, request_id="",
+              sequence_id=0, sequence_start=False, sequence_end=False,
+              model_version="", priority=0, timeout_us=None):
+        if not inputs:
+            raise InferenceServerException("torchserve infer needs one input")
+        body = bytes(inputs[0].raw_data() or b"")
+        r = self._http.request(
+            "POST", f"{self._base}/predictions/{model_name}", body=body,
+            headers={"Content-Type": "application/octet-stream"},
+        )
+        if r.status != 200:
+            raise InferenceServerException(
+                f"torchserve predict -> {r.status}: {r.data[:200]!r}",
+                status=str(r.status),
+            )
+        doc = json.loads(r.data)
+        # Numeric predictions become a validatable tensor; anything else
+        # (e.g. TorchServe's {"label": prob, ...} classification dict) stays
+        # reachable via get_response() — a non-numeric 200 is still a
+        # successful inference, not a harness crash.
+        try:
+            arrays = {
+                "predictions": np.asarray(doc, dtype=np.float64).reshape(-1)
+            }
+        except (TypeError, ValueError):
+            arrays = {}
+        return _RestResult(arrays, doc)
+
+    def close(self):
+        self._http.clear()
+
+    @property
+    def infer_input_cls(self):
+        import client_tpu.grpc as grpcclient
+
+        return grpcclient.InferInput
+
+    @property
+    def requested_output_cls(self):
+        import client_tpu.grpc as grpcclient
+
+        return grpcclient.InferRequestedOutput
+
+
+class _TfServeBackend(_TorchServeBackend):
+    """TensorFlow-Serving backend over its REST predict API (the reference's
+    tfserve_grpc_client.cc drives PredictionService/Predict; the REST
+    surface carries the same instances->predictions contract and keeps this
+    framework dependency-free)."""
+
+    kind = BackendKind.TFSERVE
+
+    def server_live(self):
+        return True  # liveness is per-model below
+
+    def model_metadata(self, model_name, model_version=""):
+        md = self._get(f"/v1/models/{model_name}/metadata")
+        meta = {
+            "name": model_name,
+            "versions": [md.get("model_spec", {}).get("version", "1")],
+            "platform": "tensorflow_serving",
+            "inputs": [{"name": "instances", "datatype": self._datatype,
+                        "shape": self._shape}],
+            "outputs": [{"name": "predictions", "datatype": "FP64",
+                         "shape": [-1]}],
+        }
+        return meta
+
+    def model_config(self, model_name, model_version=""):
+        return {"name": model_name,
+                "tfserving": self._get(f"/v1/models/{model_name}")}
+
+    def infer(self, model_name, inputs, outputs=None, request_id="",
+              sequence_id=0, sequence_start=False, sequence_end=False,
+              model_version="", priority=0, timeout_us=None):
+        if not inputs:
+            raise InferenceServerException("tfserve infer needs one input")
+        from client_tpu.utils import from_wire_bytes
+
+        inp = inputs[0]
+        arr = from_wire_bytes(
+            inp.raw_data() or b"", inp.datatype(), inp.shape()
+        )
+        doc = {"instances": arr.reshape(arr.shape[0], -1).tolist()
+               if arr.ndim > 1 else [arr.tolist()]}
+        r = self._http.request(
+            "POST", f"{self._base}/v1/models/{model_name}:predict",
+            body=json.dumps(doc).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        if r.status != 200:
+            raise InferenceServerException(
+                f"tfserve predict -> {r.status}: {r.data[:200]!r}",
+                status=str(r.status),
+            )
+        out = json.loads(r.data)
+        try:  # columnar ("outputs") or non-numeric responses: raw doc only
+            arrays = {
+                "predictions": np.asarray(out["predictions"], np.float64)
+            }
+        except (KeyError, TypeError, ValueError):
+            arrays = {}
+        return _RestResult(arrays, out)
 
 
 class MockStats:

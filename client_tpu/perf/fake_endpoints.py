@@ -1,0 +1,171 @@
+"""In-process fake TorchServe / TensorFlow-Serving endpoints.
+
+The perf harness's pluggable-backend layer (client_backend.py) promises that
+the load engine works over non-KServe protocol families, the way the
+reference ships TorchServe and TF-Serving client backends (reference
+src/c++/perf_analyzer/client_backend/torchserve/torchserve_http_client.cc,
+tensorflow_serving/tfserve_grpc_client.cc).  These stdlib-only fakes give
+the harness (and its tests) hermetic servers speaking each service's actual
+REST dialect:
+
+- TorchServe inference API: ``GET /ping``, ``POST /predictions/{model}``
+  (opaque request body -> JSON prediction).
+- TF-Serving REST API: ``GET /v1/models/{m}``, ``GET /v1/models/{m}/metadata``,
+  ``POST /v1/models/{m}:predict`` ({"instances": ...} -> {"predictions": ...}).
+
+Both run a deterministic model (sum over the payload) so client-side
+validation has ground truth.
+"""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+
+class _Quiet(BaseHTTPRequestHandler):
+    def log_message(self, fmt, *args):  # no stderr chatter under load
+        pass
+
+    def _reply(self, code, payload, content_type="application/json"):
+        body = payload if isinstance(payload, bytes) else json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _body(self):
+        n = int(self.headers.get("Content-Length", 0))
+        return self.rfile.read(n) if n else b""
+
+
+class _TorchServeHandler(_Quiet):
+    """TorchServe inference-API surface (the subset the reference backend
+    drives: ping + predictions; plus the management models listing)."""
+
+    def do_GET(self):
+        if self.path == "/ping":
+            self._reply(200, {"status": "Healthy"})
+        elif self.path.startswith("/models"):
+            name = self.path.rsplit("/", 1)[-1]
+            if name in self.server.models or name == "models":
+                self._reply(
+                    200,
+                    [{"modelName": m, "modelVersion": "1.0"}
+                     for m in self.server.models],
+                )
+            else:
+                self._reply(404, {"code": 404, "message": f"Model not found: {name}"})
+        else:
+            self._reply(404, {"code": 404, "message": "unknown path"})
+
+    def do_POST(self):
+        if not self.path.startswith("/predictions/"):
+            return self._reply(404, {"code": 404, "message": "unknown path"})
+        name = self.path[len("/predictions/"):].split("/")[0]
+        if name not in self.server.models:
+            return self._reply(
+                404, {"code": 404, "message": f"Model not found: {name}"}
+            )
+        raw = self._body()
+        with self.server.stats_lock:
+            self.server.request_count += 1
+        # deterministic "model": sum of payload interpreted as f32 when
+        # aligned, else byte sum — clients can validate either way
+        if len(raw) % 4 == 0 and raw:
+            value = float(np.frombuffer(raw, np.float32).sum())
+        else:
+            value = float(np.frombuffer(raw, np.uint8).sum())
+        self._reply(200, [round(value, 4)])
+
+
+class _TfServingHandler(_Quiet):
+    """TF-Serving REST predict surface."""
+
+    def do_GET(self):
+        parts = self.path.strip("/").split("/")
+        if len(parts) >= 2 and parts[0] == "v1" and parts[1] == "models":
+            name = parts[2].split(":")[0] if len(parts) > 2 else ""
+            if name not in self.server.models:
+                return self._reply(
+                    404, {"error": f"Model {name} not found"}
+                )
+            if len(parts) > 3 and parts[3] == "metadata":
+                return self._reply(200, {
+                    "model_spec": {"name": name, "version": "1"},
+                    "metadata": {"signature_def": {"signature_def": {
+                        "serving_default": {
+                            "inputs": {"input": {"dtype": "DT_FLOAT"}},
+                            "outputs": {"output": {"dtype": "DT_FLOAT"}},
+                        }}}},
+                })
+            return self._reply(200, {"model_version_status": [
+                {"version": "1", "state": "AVAILABLE",
+                 "status": {"error_code": "OK", "error_message": ""}}]})
+        self._reply(404, {"error": "unknown path"})
+
+    def do_POST(self):
+        parts = self.path.strip("/").split("/")
+        if (len(parts) != 3 or parts[0] != "v1" or parts[1] != "models"
+                or not parts[2].endswith(":predict")):
+            return self._reply(404, {"error": "unknown path"})
+        name = parts[2][: -len(":predict")]
+        if name not in self.server.models:
+            return self._reply(404, {"error": f"Model {name} not found"})
+        try:
+            doc = json.loads(self._body())
+            instances = doc["instances"]
+        except Exception:
+            return self._reply(400, {"error": "malformed predict request"})
+        with self.server.stats_lock:
+            self.server.request_count += 1
+        predictions = [
+            [float(np.asarray(inst, dtype=np.float64).sum())]
+            for inst in instances
+        ]
+        self._reply(200, {"predictions": predictions})
+
+
+class _FakeService:
+    def __init__(self, handler, models):
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+        self.httpd.models = set(models)
+        self.httpd.stats_lock = threading.Lock()
+        self.httpd.request_count = 0
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        )
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    @property
+    def url(self):
+        host, port = self.httpd.server_address[:2]
+        return f"{host}:{port}"
+
+    @property
+    def request_count(self):
+        with self.httpd.stats_lock:
+            return self.httpd.request_count
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+def fake_torchserve(models=("resnet",)):
+    return _FakeService(_TorchServeHandler, models)
+
+
+def fake_tfserving(models=("half_plus_two",)):
+    return _FakeService(_TfServingHandler, models)

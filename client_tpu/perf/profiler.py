@@ -33,8 +33,13 @@ class PerfStatus:
         self.send_rate = 0.0
         self.stable = False
         self.server_stats = {}
+        self.ensemble_stats = {}  # composing model -> flat counter deltas
         self.tpu_metrics = {}  # gauge -> {avg, max} from MetricsManager
         self.client_window_s = 0.0
+        # Fraction of worker-slot wall time NOT spent inside a request —
+        # harness bookkeeping + data rotation (reference "perf_analyzer
+        # overhead", inference_profiler.h:430-533).
+        self.overhead_pct = 0.0
 
     def latency_us(self, percentile=None):
         if percentile is None:
@@ -44,10 +49,10 @@ class PerfStatus:
 
 class Measurement:
     __slots__ = ("throughput", "latency_avg_ns", "latencies_ns", "errors",
-                 "delayed", "window_s", "send_rate")
+                 "delayed", "window_s", "send_rate", "busy_ns")
 
     def __init__(self, throughput, latency_avg_ns, latencies_ns, errors,
-                 delayed, window_s, send_rate):
+                 delayed, window_s, send_rate, busy_ns=0):
         self.throughput = throughput
         self.latency_avg_ns = latency_avg_ns
         self.latencies_ns = latencies_ns
@@ -55,16 +60,25 @@ class Measurement:
         self.delayed = delayed
         self.window_s = window_s
         self.send_rate = send_rate
+        self.busy_ns = busy_ns  # total in-request time across worker slots
 
 
 class InferenceProfiler:
     def __init__(self, manager, backend=None, measurement_window_s=1.0,
                  max_trials=10, stability_threshold=0.1, stability_window=3,
                  percentile=None, verbose=False, metrics_manager=None,
-                 rendezvous=None):
+                 rendezvous=None, measurement_mode="time_windows",
+                 measurement_request_count=50):
         """stability_threshold is fractional (0.1 == ±10%, the reference's
         default); percentile selects the latency used for the stability check
-        (None = average, reference --percentile)."""
+        (None = average, reference --percentile).
+
+        measurement_mode: "time_windows" closes each window after
+        ``measurement_window_s``; "count_windows" closes it after
+        ``measurement_request_count`` completed requests (reference
+        --measurement-mode count_windows, inference_profiler.h:430-533),
+        with a 10x-window time cap so an idle server cannot hang the sweep.
+        """
         self.manager = manager
         self.backend = backend
         self.window_s = measurement_window_s
@@ -75,10 +89,18 @@ class InferenceProfiler:
         self.verbose = verbose
         self.metrics = metrics_manager  # optional MetricsManager
         self.rendezvous = rendezvous  # optional multi-rank coordinator
+        if measurement_mode not in ("time_windows", "count_windows"):
+            raise InferenceServerException(
+                f"unknown measurement mode '{measurement_mode}'"
+            )
+        self.measurement_mode = measurement_mode
+        self.request_count = int(measurement_request_count)
 
     # -- one window ----------------------------------------------------------
 
     def measure(self):
+        if self.measurement_mode == "count_windows":
+            return self._measure_count()
         window_start = time.monotonic_ns()
         self.manager.get_and_reset_num_sent()
         time.sleep(self.window_s)
@@ -88,7 +110,38 @@ class InferenceProfiler:
         # swap itself is never clipped as "future"
         window_end = time.monotonic_ns()
         self.manager.check_health()
+        return self._window_measurement(
+            records, window_start, window_end, sent
+        )
 
+    def _measure_count(self):
+        """Close the window once ``request_count`` requests have completed
+        inside it (MeasureForCountWindows); capped at 10x the time window so
+        a stalled server surfaces as a short, zero-ish measurement instead
+        of a hang."""
+        window_start = time.monotonic_ns()
+        deadline = window_start + int(self.window_s * 10 * 1e9)
+        self.manager.get_and_reset_num_sent()
+        records = []
+        sent = 0
+        while True:
+            time.sleep(min(0.02, self.window_s))
+            sent += self.manager.get_and_reset_num_sent()
+            records.extend(self.manager.swap_timestamps())
+            now = time.monotonic_ns()
+            done = sum(
+                1 for r in records
+                if r.ok and window_start <= r.end_ns <= now
+            )
+            if done >= self.request_count or now >= deadline:
+                window_end = now
+                break
+        self.manager.check_health()
+        return self._window_measurement(
+            records, window_start, window_end, sent
+        )
+
+    def _window_measurement(self, records, window_start, window_end, sent):
         # ValidLatencyMeasurement: only requests completing inside the window
         valid = [r for r in records
                  if window_start <= r.end_ns <= window_end and r.ok]
@@ -96,14 +149,25 @@ class InferenceProfiler:
         delayed = sum(1 for r in valid if r.delayed)
         window_s = (window_end - window_start) / 1e9
         lat = np.array([r.end_ns - r.start_ns for r in valid], np.int64)
+        # In-request time attributed to the window a request COMPLETES in
+        # (full duration, not clipped at window_start): consecutive windows
+        # then conserve busy time — clipping both ends would drop the
+        # prior-window portion of every in-flight request and overstate
+        # harness overhead.  Failed requests count too (the slot was busy).
+        busy = sum(
+            r.end_ns - r.start_ns
+            for r in records
+            if r.end_ns <= window_end
+        )
         return Measurement(
-            throughput=len(valid) / window_s,
+            throughput=len(valid) / window_s if window_s > 0 else 0.0,
             latency_avg_ns=float(lat.mean()) if lat.size else 0.0,
             latencies_ns=lat,
             errors=errors,
             delayed=delayed,
             window_s=window_s,
-            send_rate=sent / window_s,
+            send_rate=sent / window_s if window_s > 0 else 0.0,
+            busy_ns=int(busy),
         )
 
     # -- stability loop ------------------------------------------------------
@@ -179,6 +243,16 @@ class InferenceProfiler:
                 wanted.add(self.percentile)  # the stability-governing one
             for p in sorted(wanted):
                 status.percentiles_us[p] = float(np.percentile(all_lat, p)) / 1e3
+        # Harness overhead is only meaningful for concurrency mode, where a
+        # slot is meant to be saturated; request-rate workers idle between
+        # scheduled sends BY DESIGN, so the ratio would just measure pacing.
+        slots = int(getattr(self.manager, "concurrency", 0) or 0)
+        total_slot_ns = sum(m.window_s for m in window) * slots * 1e9
+        if label == "concurrency" and total_slot_ns > 0:
+            busy = sum(m.busy_ns for m in window)
+            status.overhead_pct = round(
+                max(0.0, 100.0 * (1.0 - busy / total_slot_ns)), 2
+            )
         if self.metrics is not None:
             status.tpu_metrics = self.metrics.summarize(
                 self.metrics.swap_snapshots()
@@ -239,8 +313,10 @@ class InferenceProfiler:
         while c <= end:
             self.manager.change_concurrency_level(c)
             before = self._server_stats()
+            before_ens = self._ensemble_stats()
             status = self.profile_level("concurrency", c)
             status.server_stats = self._server_stats_delta(before)
+            status.ensemble_stats = self._ensemble_stats_delta(before_ens)
             results.append(status)
             if latency_limit_us and status.latency_us(
                 self.percentile
@@ -302,11 +378,63 @@ class InferenceProfiler:
             for k in after
         }
 
+    # -- ensemble recursion (reference EnsembleDurations,
+    #    inference_profiler.h:77-120) ----------------------------------------
+
+    def _composing_models(self):
+        """Transitive composing-model names of the swept model, resolved once
+        per profiler (the topology is static across a sweep) via ModelParser
+        — the single implementation of the ensemble walk."""
+        cached = getattr(self, "_composing_cache", None)
+        if cached is not None:
+            return cached
+        composing = []
+        if self.backend is not None:
+            from client_tpu.perf.model_parser import ModelParser
+
+            try:
+                composing = ModelParser.create(
+                    self.backend, self.manager.model_name
+                ).composing_models
+            except (InferenceServerException, NotImplementedError, KeyError):
+                composing = []
+        self._composing_cache = composing
+        return composing
+
+    def _ensemble_stats(self):
+        """Flat counters per composing model of the swept ensemble (empty for
+        non-ensemble models)."""
+        composing = self._composing_models()
+        out = {}
+        for name in composing:
+            try:
+                out[name] = _flatten_stats(self.backend.statistics(name))
+            except (InferenceServerException, NotImplementedError):
+                out[name] = {}
+        return out
+
+    def _ensemble_stats_delta(self, before):
+        after = self._ensemble_stats()
+        return {
+            name: {
+                k: counters.get(k, 0) - before.get(name, {}).get(k, 0)
+                for k in counters
+            }
+            for name, counters in after.items()
+        }
+
 
 def _flatten_stats(stats):
-    """Normalize a statistics() response into flat counters (ns totals)."""
+    """Normalize a statistics() response into flat counters (ns totals).
+    Accepts the wire shape ({"model_stats": [...]}) and the in-process
+    engine's bare list of per-model entries."""
     out = {}
-    model_stats = stats.get("model_stats", []) if isinstance(stats, dict) else []
+    if isinstance(stats, dict):
+        model_stats = stats.get("model_stats", [])
+    elif isinstance(stats, list):
+        model_stats = stats
+    else:
+        model_stats = []
     for ms in model_stats:
         agg = ms.get("inference_stats", {})
         for phase in ("success", "queue", "compute_input", "compute_infer",

@@ -35,6 +35,8 @@ def print_summary(results, percentile=None):
             print(
                 f"    {gauge}: avg {agg['avg']:.0f}, max {agg['max']:.0f}"
             )
+        if s.overhead_pct:
+            print(f"    harness overhead: {s.overhead_pct:.1f}% of slot time")
         if s.server_stats:
             srv = s.server_stats
             cnt = max(srv.get("success_count", 0), 1)
@@ -44,6 +46,13 @@ def print_summary(results, percentile=None):
                 ns = srv.get(f"{phase}_ns", 0)
                 parts.append(f"{phase} {ns / cnt / 1e3:.0f}")
             print(f"  Server: avg usec/request: {', '.join(parts)}")
+        for name, counters in sorted(s.ensemble_stats.items()):
+            cnt = max(counters.get("success_count", 0), 1)
+            infer_us = counters.get("compute_infer_ns", 0) / cnt / 1e3
+            print(
+                f"  Composing model {name}: {counters.get('success_count', 0)}"
+                f" exec, avg compute {infer_us:.0f} usec"
+            )
         print()
     if results:
         best = max(results, key=lambda s: s.throughput)

@@ -140,13 +140,51 @@ def classification_model():
     )
 
 
+def ensemble_model():
+    """Config-driven ensemble chaining simple -> identity_int32 (the
+    reference's ensemble_add_sub pattern: ensemble_scheduling steps with
+    input_map/output_map, composing models keep their own statistics)."""
+    return Model(
+        "simple_ensemble",
+        inputs=[
+            TensorSpec("INPUT0", "INT32", [-1, 16]),
+            TensorSpec("INPUT1", "INT32", [-1, 16]),
+        ],
+        outputs=[
+            TensorSpec("OUTPUT0", "INT32", [-1, 16]),
+            TensorSpec("OUTPUT1", "INT32", [-1, 16]),
+        ],
+        fn=None,  # the engine's ensemble scheduler runs the steps
+        platform="ensemble",
+        ensemble_steps=[
+            {
+                "model_name": "simple",
+                "input_map": {"INPUT0": "INPUT0", "INPUT1": "INPUT1"},
+                "output_map": {"OUTPUT0": "sum", "OUTPUT1": "diff"},
+            },
+            {
+                "model_name": "identity_int32",
+                "input_map": {"INPUT0": "sum"},
+                "output_map": {"OUTPUT0": "OUTPUT0"},
+            },
+            {
+                "model_name": "identity_int32",
+                "input_map": {"INPUT0": "diff"},
+                "output_map": {"OUTPUT0": "OUTPUT1"},
+            },
+        ],
+    )
+
+
 def default_models():
     return [
         simple_model(),
         simple_string_model(),
         identity_model(),
         identity_model("identity_bytes", "BYTES"),
+        identity_model("identity_int32", "INT32"),
         sequence_model(),
         decoupled_model(),
         classification_model(),
+        ensemble_model(),
     ]

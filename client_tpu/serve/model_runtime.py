@@ -98,6 +98,7 @@ class Model:
         batch_device_inputs=False,
         fused_batching=False,
         max_fused_arity=8,
+        ensemble_steps=None,
     ):
         self.name = name
         self.inputs = list(inputs)
@@ -119,6 +120,11 @@ class Model:
         # split into one jitted dispatch (dynamic_batcher._fused_group_fn).
         self.fused_batching = fused_batching
         self.max_fused_arity = max_fused_arity  # cap on fused group parts
+        # Config-driven ensemble (reference ensemble_scheduling): ordered
+        # steps [{"model_name", "input_map" {composing<-ensemble tensor},
+        # "output_map" {composing->ensemble tensor}}].  fn is ignored; the
+        # engine chains the composing models (execute -> per-model stats).
+        self.ensemble_steps = list(ensemble_steps or [])
         self.config_override = None  # set by repository load with config param
         self.file_overrides = {}
 
@@ -162,6 +168,18 @@ class Model:
             cfg["model_transaction_policy"] = {"decoupled": True}
         if self.stateful:
             cfg["sequence_batching"] = {"max_sequence_idle_microseconds": 60000000}
+        if self.ensemble_steps:
+            cfg["ensemble_scheduling"] = {
+                "step": [
+                    {
+                        "model_name": s["model_name"],
+                        "model_version": s.get("model_version", -1),
+                        "input_map": dict(s.get("input_map", {})),
+                        "output_map": dict(s.get("output_map", {})),
+                    }
+                    for s in self.ensemble_steps
+                ]
+            }
         return cfg
 
 
@@ -708,6 +726,18 @@ class InferenceEngine:
             params = request.get("parameters", {}) or {}
             context = self._sequence_context(params)
             t_in1 = time.monotonic_ns()
+            if model.ensemble_steps:
+                result = self._run_ensemble(model, inputs)
+                t_inf1 = time.monotonic_ns()
+                rendered = self._render_response(
+                    model, model_version, request, result
+                )
+                t1 = time.monotonic_ns()
+                stats.record(
+                    True, t1 - t0, t_inf1 - t_in1, t_in1 - t_in0, t1 - t_inf1,
+                    batch=_batch_of(model, request),
+                )
+                return rendered
             if _batchable_request(model, inputs, params, context, request):
                 # The batcher records execution-level statistics; per-request
                 # success is recorded here, and any failure (batched execution
@@ -751,6 +781,59 @@ class InferenceEngine:
             raise InferenceServerException(
                 f"{model_name}: execution failed: {e}", status="500", debug_details=e
             ) from e
+
+    def _run_ensemble(self, model, inputs):
+        """Chain composing models per ensemble_scheduling (the reference's
+        ensemble scheduler): a tensor pool flows ensemble inputs through each
+        step's input_map/output_map.  Each composing model's statistics are
+        recorded under its own name, so clients (and the perf profiler's
+        ensemble recursion) see per-composing-model queue/compute durations.
+        """
+        pool = dict(inputs)
+        for step in model.ensemble_steps:
+            sub = self.get_model(step["model_name"], "")
+            try:
+                sub_inputs = {
+                    ci: pool[et] for ci, et in step["input_map"].items()
+                }
+            except KeyError as e:
+                raise InferenceServerException(
+                    f"ensemble '{model.name}' step '{sub.name}': tensor "
+                    f"{e} not produced by any earlier step", status="400",
+                )
+            sub_stats = self._stats[sub.name]
+            st0 = time.monotonic_ns()
+            try:
+                if sub.ensemble_steps:  # nested ensemble: recurse
+                    out = self._run_ensemble(sub, sub_inputs)
+                else:
+                    with self.busy:
+                        out = sub.fn(sub_inputs, {}, None)
+            except InferenceServerException:
+                sub_stats.record(False, time.monotonic_ns() - st0, 0, 0, 0)
+                raise
+            except Exception as e:
+                sub_stats.record(False, time.monotonic_ns() - st0, 0, 0, 0)
+                raise InferenceServerException(
+                    f"ensemble '{model.name}' step '{sub.name}' failed: {e}",
+                    status="500", debug_details=e,
+                ) from e
+            st1 = time.monotonic_ns()
+            sub_stats.record(True, st1 - st0, st1 - st0, 0, 0)
+            for co, et in step["output_map"].items():
+                if co not in out:
+                    raise InferenceServerException(
+                        f"ensemble '{model.name}' step '{sub.name}' produced "
+                        f"no output '{co}'", status="500",
+                    )
+                pool[et] = out[co]
+        missing = [t.name for t in model.outputs if t.name not in pool]
+        if missing:
+            raise InferenceServerException(
+                f"ensemble '{model.name}' produced no tensor(s) {missing}",
+                status="500",
+            )
+        return {t.name: pool[t.name] for t in model.outputs}
 
     def _batcher_for(self, model):
         with self._lock:

@@ -319,13 +319,13 @@ class TestProfiler:
 
     def test_errors_counted(self):
         window = [(1_000_000, True)] * 10 + [(1_000_000, False)] * 3
-        prof = self._profiler([window] * 3)
+        prof = self._profiler([window] * 10)
         status = prof.profile_level("concurrency", 1)
         assert status.error_count == 9  # 3 per window
 
     def test_percentiles_monotone(self):
         lats = [(int(n), True) for n in np.linspace(1e6, 9e6, 50)]
-        prof = self._profiler([lats] * 3)
+        prof = self._profiler([lats] * 10)
         status = prof.profile_level("concurrency", 1)
         p = status.percentiles_us
         assert p[50] <= p[90] <= p[95] <= p[99]
@@ -432,3 +432,227 @@ class TestValidation:
         finally:
             mgr2.cleanup()
             engine.close()
+
+
+class TestCountWindows:
+    """count_windows measurement mode (reference --measurement-mode
+    count_windows, MeasureForCountWindows)."""
+
+    def _live_manager(self, latency_s=0.001):
+        return _mk_manager(ConcurrencyManager, latency_s=latency_s)
+
+    def test_window_closes_on_request_count(self):
+        mgr, _ = self._live_manager()
+        try:
+            mgr.change_concurrency_level(2)
+            prof = InferenceProfiler(
+                mgr, measurement_window_s=5.0,  # time mode would take 5s
+                measurement_mode="count_windows",
+                measurement_request_count=30,
+            )
+            t0 = time.monotonic()
+            m = prof.measure()
+            elapsed = time.monotonic() - t0
+            # closed by count, far before the 5s time window
+            assert elapsed < 2.5
+            assert m.latencies_ns.size >= 30
+        finally:
+            mgr.cleanup()
+
+    def test_stalled_server_hits_time_cap_not_hang(self):
+        prof = InferenceProfiler(
+            _FakeManager([]),  # never produces records
+            measurement_window_s=0.02,
+            measurement_mode="count_windows",
+            measurement_request_count=1000,
+        )
+        t0 = time.monotonic()
+        m = prof.measure()
+        assert time.monotonic() - t0 < 2.0  # 10x window cap
+        assert m.throughput == 0
+
+    def test_bad_mode_rejected(self):
+        with pytest.raises(InferenceServerException, match="measurement mode"):
+            InferenceProfiler(_FakeManager([]), measurement_mode="bogus")
+
+
+class TestOverheadAccounting:
+    def test_overhead_reflects_idle_slot_time(self):
+        # 1ms mock latency, 2 slots: workers spend nearly all slot time
+        # inside requests -> low overhead; assert it is computed and sane.
+        mgr, _ = _mk_manager(ConcurrencyManager, latency_s=0.001)
+        try:
+            mgr.change_concurrency_level(2)
+            prof = InferenceProfiler(
+                mgr, measurement_window_s=0.2, max_trials=3,
+                stability_threshold=5.0,
+            )
+            status = prof.profile_level("concurrency", 2)
+            assert 0.0 <= status.overhead_pct < 60.0
+        finally:
+            mgr.cleanup()
+
+
+class TestEnsemble:
+    def test_engine_runs_config_driven_ensemble(self):
+        from client_tpu.serve import InferenceEngine
+        from client_tpu.serve.builtins import default_models
+
+        engine = InferenceEngine(default_models())
+        try:
+            a = np.arange(16, dtype=np.int32).reshape(1, 16)
+            b = np.ones((1, 16), dtype=np.int32)
+            request = {
+                "id": "e1",
+                "inputs": [
+                    {"name": "INPUT0", "datatype": "INT32", "shape": [1, 16],
+                     "data": a.flatten().tolist()},
+                    {"name": "INPUT1", "datatype": "INT32", "shape": [1, 16],
+                     "data": b.flatten().tolist()},
+                ],
+            }
+            response, blobs = engine.execute("simple_ensemble", "", request, b"")
+            outs = {o["name"]: o for o in response["outputs"]}
+            assert outs["OUTPUT0"]["data"] == (a + b).flatten().tolist()
+            assert outs["OUTPUT1"]["data"] == (a - b).flatten().tolist()
+            # composing models carry their own statistics
+            stats = {
+                s["name"]: s for s in engine.statistics("", "")
+            }
+            assert stats["simple"]["inference_stats"]["success"]["count"] >= 1
+            assert (
+                stats["identity_int32"]["inference_stats"]["success"]["count"]
+                >= 2
+            )
+            cfg = engine.get_model("simple_ensemble", "").config()
+            step_models = [
+                s["model_name"] for s in cfg["ensemble_scheduling"]["step"]
+            ]
+            assert step_models == ["simple", "identity_int32", "identity_int32"]
+        finally:
+            engine.close()
+
+    def test_profiler_recurses_composing_stats(self):
+        from client_tpu.perf.client_backend import BackendKind, ClientBackendFactory
+        from client_tpu.perf import create_infer_data_manager
+        from client_tpu.serve import InferenceEngine
+        from client_tpu.serve.builtins import default_models
+
+        engine = InferenceEngine(default_models())
+        try:
+            def factory():
+                return ClientBackendFactory.create(
+                    BackendKind.INPROCESS, engine=engine
+                )
+
+            be = factory()
+            meta = be.model_metadata("simple_ensemble")
+            inputs_meta = [dict(m) for m in meta["inputs"]]
+            for m in inputs_meta:
+                m["shape"] = [1, 16]
+            loader = DataLoader(inputs_meta, batch_size=1)
+            loader.generate_data()
+            dm = create_infer_data_manager(
+                be, loader, inputs_meta, [dict(m) for m in meta["outputs"]],
+                shared_memory="none",
+            )
+            dm.init()
+            mgr = ConcurrencyManager(
+                backend_factory=factory, data_loader=loader, data_manager=dm,
+                model_name="simple_ensemble", max_threads=2,
+            )
+            prof = InferenceProfiler(
+                mgr, backend=be, measurement_window_s=0.1, max_trials=3,
+                stability_threshold=5.0,
+            )
+            try:
+                results = prof.profile_concurrency_range(1, 1, 1)
+                ens = results[0].ensemble_stats
+                assert set(ens) == {"simple", "identity_int32"}
+                assert ens["simple"]["success_count"] > 0
+                assert ens["identity_int32"]["success_count"] > 0
+            finally:
+                mgr.cleanup()
+        finally:
+            engine.close()
+
+
+class TestModelParser:
+    """ModelParser normalization (reference model_parser.h:59-193)."""
+
+    def _parser(self, name):
+        from client_tpu.perf import ModelParser
+        from client_tpu.perf.client_backend import BackendKind, ClientBackendFactory
+        from client_tpu.serve import InferenceEngine
+        from client_tpu.serve.builtins import default_models
+
+        engine = InferenceEngine(default_models())
+        be = ClientBackendFactory.create(BackendKind.INPROCESS, engine=engine)
+        try:
+            return ModelParser.create(be, name, batch_size=2)
+        finally:
+            engine.close()
+
+    def test_dynamic_dims_resolved_and_batch_size(self):
+        p = self._parser("simple")
+        assert p.inputs[0]["shape"] == [2, 16]  # -1 -> batch_size
+        assert p.max_batch_size == 8
+
+    def test_scheduler_kinds(self):
+        from client_tpu.perf import SchedulerType
+
+        assert self._parser("simple").scheduler_type == SchedulerType.NONE
+        assert (
+            self._parser("simple_sequence").scheduler_type
+            == SchedulerType.SEQUENCE
+        )
+        ens = self._parser("simple_ensemble")
+        assert ens.scheduler_type == SchedulerType.ENSEMBLE
+        assert ens.composing_models == ["simple", "identity_int32"]
+        assert self._parser("simple_sequence").requires_sequence_flags()
+
+    def test_decoupled_flag(self):
+        assert self._parser("repeat_int32").is_decoupled
+        assert not self._parser("simple").is_decoupled
+
+
+def test_nested_ensemble_recurses():
+    from client_tpu.serve import InferenceEngine
+    from client_tpu.serve.builtins import default_models, ensemble_model
+    from client_tpu.serve.model_runtime import Model, TensorSpec
+
+    outer = Model(
+        "outer_ensemble",
+        inputs=[
+            TensorSpec("INPUT0", "INT32", [-1, 16]),
+            TensorSpec("INPUT1", "INT32", [-1, 16]),
+        ],
+        outputs=[TensorSpec("OUTPUT0", "INT32", [-1, 16])],
+        fn=None,
+        platform="ensemble",
+        ensemble_steps=[
+            {
+                "model_name": "simple_ensemble",  # nested ensemble step
+                "input_map": {"INPUT0": "INPUT0", "INPUT1": "INPUT1"},
+                "output_map": {"OUTPUT0": "OUTPUT0"},
+            },
+        ],
+    )
+    engine = InferenceEngine(default_models() + [outer])
+    try:
+        a = np.arange(16, dtype=np.int32).reshape(1, 16)
+        b = np.full((1, 16), 2, dtype=np.int32)
+        request = {
+            "id": "n1",
+            "inputs": [
+                {"name": "INPUT0", "datatype": "INT32", "shape": [1, 16],
+                 "data": a.flatten().tolist()},
+                {"name": "INPUT1", "datatype": "INT32", "shape": [1, 16],
+                 "data": b.flatten().tolist()},
+            ],
+        }
+        response, _ = engine.execute("outer_ensemble", "", request, b"")
+        outs = {o["name"]: o for o in response["outputs"]}
+        assert outs["OUTPUT0"]["data"] == (a + b).flatten().tolist()
+    finally:
+        engine.close()
