@@ -7,7 +7,7 @@ CXXFLAGS ?= -O2 -fPIC -Wall -std=c++17
 NATIVE_OUT := client_tpu/utils/shared_memory
 TPUSHM_OUT := client_tpu/utils/tpu_shared_memory
 
-.PHONY: all protos native cpp clean test
+.PHONY: all protos native cpp clean test asan
 
 all: protos native cpp
 
@@ -109,10 +109,27 @@ $(TPUSHM_OUT)/libctpushm.so: src/cpp/shm/ctpushm.cc
 	mkdir -p $(TPUSHM_OUT)
 	$(CXX) $(CXXFLAGS) -shared -o $@ $< -lrt
 
+# ---- sanitizer run (SURVEY §5.2): native shm libs + HPACK under ASAN ------
+ASAN_FLAGS := -fsanitize=address -fno-omit-frame-pointer -g -O1
+
+asan: $(CPP_BUILD)/shm_asan_test $(CPP_BUILD)/hpack_asan_test
+	$(CPP_BUILD)/shm_asan_test
+	$(CPP_BUILD)/hpack_asan_test
+
+$(CPP_BUILD)/shm_asan_test: $(CPP_DIR)/tests/shm_sanitizer_test.cc src/cpp/shm/cshm.cc src/cpp/shm/ctpushm.cc
+	mkdir -p $(CPP_BUILD)
+	$(CXX) -std=c++17 -Wall $(ASAN_FLAGS) -o $@ $< \
+	    src/cpp/shm/cshm.cc src/cpp/shm/ctpushm.cc -lrt
+
+$(CPP_BUILD)/hpack_asan_test: $(CPP_DIR)/tests/hpack_unit_test.cc $(CPP_DIR)/grpc/hpack.cc
+	mkdir -p $(CPP_BUILD)
+	$(CXX) -std=c++17 -Wall $(ASAN_FLAGS) -o $@ $< \
+	    $(CPP_DIR)/grpc/hpack.cc -I$(CPP_DIR)/grpc
+
 clean:
 	rm -f $(PB_OUT)/*_pb2.py $(NATIVE_OUT)/libcshm_tpu.so \
 	    $(TPUSHM_OUT)/libctpushm.so
 	rm -rf $(CPP_BUILD)
 
-test:
+test: asan
 	python -m pytest tests/ -x -q

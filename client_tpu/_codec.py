@@ -109,6 +109,7 @@ def parse_infer_response_body(body, header_length=None):
     """Client side: split response into (header_dict, binary_section)."""
     if header_length is None:
         return json.loads(body.decode("utf-8")), b""
+    header_length = int(header_length)  # callers may pass the raw HTTP header
     header = json.loads(bytes(body[:header_length]).decode("utf-8"))
     return header, body[header_length:]
 

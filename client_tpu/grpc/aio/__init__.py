@@ -197,6 +197,59 @@ class InferenceServerClient:
         )
         return self._maybe_json(r, as_json)
 
+    # -- trace / log settings (parity with the sync client; reference
+    #    grpc/aio/__init__.py update_trace_settings..get_log_settings) -------
+
+    async def update_trace_settings(
+        self, model_name="", settings=None, headers=None, as_json=False,
+        client_timeout=None,
+    ):
+        request = pb.TraceSettingRequest(model_name=model_name)
+        for key, value in (settings or {}).items():
+            if value is None:
+                request.settings[key]  # present-but-empty clears the setting
+            elif isinstance(value, (list, tuple)):
+                request.settings[key].value.extend(str(v) for v in value)
+            else:
+                request.settings[key].value.append(str(value))
+        r = await self._call("TraceSetting", request, headers, client_timeout)
+        return self._maybe_json(r, as_json)
+
+    async def get_trace_settings(
+        self, model_name="", headers=None, as_json=False, client_timeout=None
+    ):
+        r = await self._call(
+            "TraceSetting",
+            pb.TraceSettingRequest(model_name=model_name),
+            headers,
+            client_timeout,
+        )
+        return self._maybe_json(r, as_json)
+
+    async def update_log_settings(
+        self, settings, headers=None, as_json=False, client_timeout=None
+    ):
+        request = pb.LogSettingsRequest()
+        for key, value in settings.items():
+            if value is None:
+                request.settings[key]
+            elif isinstance(value, bool):
+                request.settings[key].bool_param = value
+            elif isinstance(value, int):
+                request.settings[key].uint32_param = value
+            else:
+                request.settings[key].string_param = str(value)
+        r = await self._call("LogSettings", request, headers, client_timeout)
+        return self._maybe_json(r, as_json)
+
+    async def get_log_settings(
+        self, headers=None, as_json=False, client_timeout=None
+    ):
+        r = await self._call(
+            "LogSettings", pb.LogSettingsRequest(), headers, client_timeout
+        )
+        return self._maybe_json(r, as_json)
+
     # -- shared memory -------------------------------------------------------
 
     async def get_system_shared_memory_status(

@@ -183,6 +183,73 @@ class InferenceServerClient:
             uri = "v2/models/stats"
         return await self._json_or_raise(await self._get(uri, headers, query_params))
 
+    # -- trace / log settings (parity with the sync client) ------------------
+
+    async def update_trace_settings(
+        self, model_name="", settings=None, headers=None, query_params=None
+    ):
+        uri = (
+            f"v2/models/{quote(model_name, safe='')}/trace/setting"
+            if model_name
+            else "v2/trace/setting"
+        )
+        r = await self._post(
+            uri, json.dumps(settings or {}).encode("utf-8"), headers,
+            query_params,
+        )
+        return await self._json_or_raise(r)
+
+    async def get_trace_settings(
+        self, model_name="", headers=None, query_params=None
+    ):
+        uri = (
+            f"v2/models/{quote(model_name, safe='')}/trace/setting"
+            if model_name
+            else "v2/trace/setting"
+        )
+        return await self._json_or_raise(
+            await self._get(uri, headers, query_params)
+        )
+
+    async def update_log_settings(
+        self, settings, headers=None, query_params=None
+    ):
+        r = await self._post(
+            "v2/logging", json.dumps(settings).encode("utf-8"), headers,
+            query_params,
+        )
+        return await self._json_or_raise(r)
+
+    async def get_log_settings(self, headers=None, query_params=None):
+        return await self._json_or_raise(
+            await self._get("v2/logging", headers, query_params)
+        )
+
+    # -- pipelining statics (reference http/__init__.py:1255/1336; the bodies
+    #    are transport-independent, shared with the sync client) -------------
+
+    @staticmethod
+    def generate_request_body(
+        inputs, outputs=None, request_id="", sequence_id=0,
+        sequence_start=False, sequence_end=False, priority=0, timeout=None,
+        parameters=None,
+    ):
+        """Build (body, json_size) without sending."""
+        return _codec.build_infer_request_body(
+            inputs, outputs, request_id, sequence_id, sequence_start,
+            sequence_end, priority, timeout, parameters,
+        )
+
+    @staticmethod
+    def parse_response_body(
+        response_body, verbose=False, header_length=None,
+        content_encoding=None,
+    ):
+        """Parse a raw response body into InferResult."""
+        return InferResult.from_response_body(
+            response_body, verbose, header_length, content_encoding
+        )
+
     # -- shared memory -------------------------------------------------------
 
     async def get_system_shared_memory_status(

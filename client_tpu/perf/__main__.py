@@ -131,10 +131,11 @@ def main(argv=None):
     if args.service_kind in ("torchserve", "tfserve"):
         kind = (BackendKind.TORCHSERVE if args.service_kind == "torchserve"
                 else BackendKind.TFSERVE)
-        if args.model_name in shape_overrides:
-            backend_kwargs["input_shape"] = shape_overrides[args.model_name]
-        elif shape_overrides:
-            backend_kwargs["input_shape"] = next(iter(shape_overrides.values()))
+        # --shape stays tensor-name-keyed: these services declare one input
+        # ("data" / "instances" — the names their backends synthesize)
+        tensor = "data" if args.service_kind == "torchserve" else "instances"
+        if tensor in shape_overrides:
+            backend_kwargs["input_shape"] = shape_overrides[tensor]
         if args.hermetic:
             from client_tpu.perf.fake_endpoints import (
                 fake_tfserving,

@@ -353,14 +353,35 @@ class _TorchServeBackend(ClientBackend):
         self._shape = list(input_shape or [-1])
         self._datatype = input_datatype
 
+    def _request(self, method, url, **kwargs):
+        """urllib3 request with transport errors wrapped — a transient
+        connection reset must surface as a per-window error count, not kill
+        the sweep via the worker-fatal path (mirrors http/__init__.py)."""
+        try:
+            return self._http.request(method, url, **kwargs)
+        except Exception as e:
+            raise InferenceServerException(
+                f"{self.kind} {method} {url} failed: {e}", debug_details=e
+            ) from e
+
+    @staticmethod
+    def _json(r, what):
+        try:
+            return json.loads(r.data)
+        except Exception as e:
+            raise InferenceServerException(
+                f"{what} returned non-JSON body: {r.data[:200]!r}",
+                debug_details=e,
+            ) from e
+
     def _get(self, path):
-        r = self._http.request("GET", self._base + path)
+        r = self._request("GET", self._base + path)
         if r.status != 200:
             raise InferenceServerException(
                 f"torchserve GET {path} -> {r.status}: {r.data[:200]!r}",
                 status=str(r.status),
             )
-        return json.loads(r.data)
+        return self._json(r, f"GET {path}")
 
     def server_live(self):
         return self._get("/ping").get("status") == "Healthy"
@@ -388,7 +409,7 @@ class _TorchServeBackend(ClientBackend):
         if not inputs:
             raise InferenceServerException("torchserve infer needs one input")
         body = bytes(inputs[0].raw_data() or b"")
-        r = self._http.request(
+        r = self._request(
             "POST", f"{self._base}/predictions/{model_name}", body=body,
             headers={"Content-Type": "application/octet-stream"},
         )
@@ -397,11 +418,14 @@ class _TorchServeBackend(ClientBackend):
                 f"torchserve predict -> {r.status}: {r.data[:200]!r}",
                 status=str(r.status),
             )
-        doc = json.loads(r.data)
-        # Numeric predictions become a validatable tensor; anything else
-        # (e.g. TorchServe's {"label": prob, ...} classification dict) stays
-        # reachable via get_response() — a non-numeric 200 is still a
-        # successful inference, not a harness crash.
+        # A 200 is a successful inference whatever the body shape: numeric
+        # predictions become a validatable tensor; anything else (TorchServe
+        # classification dicts, text/plain custom handlers) stays reachable
+        # via get_response() as parsed JSON or raw bytes.
+        try:
+            doc = json.loads(r.data)
+        except Exception:
+            return _RestResult({}, r.data)
         try:
             arrays = {
                 "predictions": np.asarray(doc, dtype=np.float64).reshape(-1)
@@ -467,7 +491,7 @@ class _TfServeBackend(_TorchServeBackend):
         )
         doc = {"instances": arr.reshape(arr.shape[0], -1).tolist()
                if arr.ndim > 1 else [arr.tolist()]}
-        r = self._http.request(
+        r = self._request(
             "POST", f"{self._base}/v1/models/{model_name}:predict",
             body=json.dumps(doc).encode(),
             headers={"Content-Type": "application/json"},
@@ -477,7 +501,7 @@ class _TfServeBackend(_TorchServeBackend):
                 f"tfserve predict -> {r.status}: {r.data[:200]!r}",
                 status=str(r.status),
             )
-        out = json.loads(r.data)
+        out = self._json(r, "predict")
         try:  # columnar ("outputs") or non-numeric responses: raw doc only
             arrays = {
                 "predictions": np.asarray(out["predictions"], np.float64)

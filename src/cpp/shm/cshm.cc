@@ -107,7 +107,8 @@ int TpuShmWrite(void* handle, uint64_t offset, const void* data,
                 uint64_t size) {
   auto* region = static_cast<ShmRegion*>(handle);
   if (region == nullptr || region->base == nullptr) return TPU_SHM_ERR_HANDLE;
-  if (offset + size > region->byte_size) {
+  // overflow-safe: offset + size can wrap uint64 for adversarial offsets
+  if (size > region->byte_size || offset > region->byte_size - size) {
     g_last_error = "write overruns region '" + region->key + "'";
     return TPU_SHM_ERR_RANGE;
   }
@@ -118,7 +119,8 @@ int TpuShmWrite(void* handle, uint64_t offset, const void* data,
 int TpuShmRead(void* handle, uint64_t offset, void* dst, uint64_t size) {
   auto* region = static_cast<ShmRegion*>(handle);
   if (region == nullptr || region->base == nullptr) return TPU_SHM_ERR_HANDLE;
-  if (offset + size > region->byte_size) {
+  // overflow-safe: offset + size can wrap uint64 for adversarial offsets
+  if (size > region->byte_size || offset > region->byte_size - size) {
     g_last_error = "read overruns region '" + region->key + "'";
     return TPU_SHM_ERR_RANGE;
   }

@@ -154,3 +154,245 @@ class TestGrpcAio:
                 assert e.value.status() == "INVALID_ARGUMENT"
 
         _run(flow())
+
+
+class TestHttpAioParity:
+    """Surface parity with the sync client: trace/log settings, model
+    control, shm verbs, and the pipelining statics."""
+
+    def test_trace_settings_roundtrip(self, server):
+        import client_tpu.http.aio as aioclient
+
+        async def flow():
+            async with aioclient.InferenceServerClient(server.http_address) as c:
+                got = await c.update_trace_settings(
+                    "simple", {"trace_level": ["TIMESTAMPS"], "trace_rate": "1"}
+                )
+                assert got["trace_level"] == ["TIMESTAMPS"]
+                got = await c.get_trace_settings("simple")
+                assert got["trace_rate"] == "1"
+                # global settings view exists too
+                assert isinstance(await c.get_trace_settings(), dict)
+
+        _run(flow())
+
+    def test_log_settings_roundtrip(self, server):
+        import client_tpu.http.aio as aioclient
+
+        async def flow():
+            async with aioclient.InferenceServerClient(server.http_address) as c:
+                got = await c.update_log_settings({"log_verbose_level": 1})
+                assert int(got["log_verbose_level"]) == 1
+                got = await c.get_log_settings()
+                assert "log_verbose_level" in got
+
+        _run(flow())
+
+    def test_model_control(self, server):
+        import client_tpu.http.aio as aioclient
+
+        async def flow():
+            async with aioclient.InferenceServerClient(server.http_address) as c:
+                await c.unload_model("identity")
+                assert not await c.is_model_ready("identity")
+                await c.load_model("identity")
+                assert await c.is_model_ready("identity")
+
+        _run(flow())
+
+    def test_system_shm_verbs(self, server):
+        import client_tpu.http.aio as aioclient
+        from client_tpu.utils import shared_memory as shm
+
+        handle = shm.create_shared_memory_region("aio_shm", "/aio_shm", 64)
+        try:
+            async def flow():
+                async with aioclient.InferenceServerClient(
+                    server.http_address
+                ) as c:
+                    await c.register_system_shared_memory(
+                        "aio_shm", "/aio_shm", 64
+                    )
+                    status = await c.get_system_shared_memory_status()
+                    assert any(r["name"] == "aio_shm" for r in status)
+                    await c.unregister_system_shared_memory("aio_shm")
+                    status = await c.get_system_shared_memory_status()
+                    assert not any(r["name"] == "aio_shm" for r in status)
+
+            _run(flow())
+        finally:
+            shm.destroy_shared_memory_region(handle)
+
+    def test_generate_request_body_static_pipelines(self, server):
+        """The statics build/parse bodies with no client instance — wire a
+        hand-carried request through the sync transport and parse the raw
+        response with the aio static."""
+        import urllib3
+
+        import client_tpu.http.aio as aioclient
+
+        inputs, i0, i1 = _simple_inputs(aioclient)
+        body, json_size = aioclient.InferenceServerClient.generate_request_body(
+            inputs
+        )
+        http = urllib3.PoolManager()
+        r = http.request(
+            "POST",
+            f"http://{server.http_address}/v2/models/simple/infer",
+            body=body,
+            headers={
+                "Content-Type": "application/octet-stream",
+                "Inference-Header-Content-Length": str(json_size),
+            },
+        )
+        assert r.status == 200
+        result = aioclient.InferenceServerClient.parse_response_body(
+            r.data,
+            header_length=r.headers.get("Inference-Header-Content-Length"),
+        )
+        np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), i0 + i1)
+
+    def test_nonbinary_json_tensors(self, server):
+        import client_tpu.http.aio as aioclient
+
+        async def flow():
+            async with aioclient.InferenceServerClient(server.http_address) as c:
+                inputs = [
+                    aioclient.InferInput("INPUT0", [1, 16], "INT32"),
+                    aioclient.InferInput("INPUT1", [1, 16], "INT32"),
+                ]
+                i0 = np.arange(16, dtype=np.int32).reshape(1, 16)
+                i1 = np.ones((1, 16), dtype=np.int32)
+                inputs[0].set_data_from_numpy(i0, binary_data=False)
+                inputs[1].set_data_from_numpy(i1, binary_data=False)
+                result = await c.infer("simple", inputs)
+                np.testing.assert_array_equal(result.as_numpy("OUTPUT0"), i0 + i1)
+
+        _run(flow())
+
+
+class TestGrpcAioParity:
+    def test_trace_settings_roundtrip(self, server):
+        import client_tpu.grpc.aio as aioclient
+
+        async def flow():
+            async with aioclient.InferenceServerClient(server.grpc_address) as c:
+                got = await c.update_trace_settings(
+                    "simple", {"trace_level": ["TIMESTAMPS"], "trace_rate": 1},
+                    as_json=True,
+                )
+                assert "settings" in got
+                got = await c.get_trace_settings("simple", as_json=True)
+                assert "settings" in got
+
+        _run(flow())
+
+    def test_log_settings_roundtrip(self, server):
+        import client_tpu.grpc.aio as aioclient
+
+        async def flow():
+            async with aioclient.InferenceServerClient(server.grpc_address) as c:
+                got = await c.update_log_settings(
+                    {"log_verbose_level": 2}, as_json=True
+                )
+                assert "settings" in got
+                got = await c.get_log_settings(as_json=True)
+                assert "settings" in got
+
+        _run(flow())
+
+    def test_model_control(self, server):
+        import client_tpu.grpc.aio as aioclient
+
+        async def flow():
+            async with aioclient.InferenceServerClient(server.grpc_address) as c:
+                await c.unload_model("identity_bytes")
+                assert not await c.is_model_ready("identity_bytes")
+                await c.load_model("identity_bytes")
+                assert await c.is_model_ready("identity_bytes")
+
+        _run(flow())
+
+    def test_system_shm_verbs(self, server):
+        import client_tpu.grpc.aio as aioclient
+        from client_tpu.utils import shared_memory as shm
+
+        handle = shm.create_shared_memory_region("aio_gshm", "/aio_gshm", 64)
+        try:
+            async def flow():
+                async with aioclient.InferenceServerClient(
+                    server.grpc_address
+                ) as c:
+                    await c.register_system_shared_memory(
+                        "aio_gshm", "/aio_gshm", 64
+                    )
+                    status = await c.get_system_shared_memory_status(
+                        as_json=True
+                    )
+                    names = [
+                        r["name"] for r in status.get("regions", {}).values()
+                    ] + [
+                        r.get("name") for r in status.get("regions", [])
+                        if isinstance(r, dict)
+                    ]
+                    assert "aio_gshm" in names
+                    await c.unregister_system_shared_memory("aio_gshm")
+
+            _run(flow())
+        finally:
+            shm.destroy_shared_memory_region(handle)
+
+    def test_tpu_shm_verbs(self, server):
+        import client_tpu.grpc.aio as aioclient
+        from client_tpu.utils import tpu_shared_memory as tpushm
+
+        handle = tpushm.create_shared_memory_region("aio_tpu", 64)
+        try:
+            async def flow():
+                async with aioclient.InferenceServerClient(
+                    server.grpc_address
+                ) as c:
+                    await c.register_tpu_shared_memory(
+                        "aio_tpu", tpushm.get_raw_handle(handle), 0, 64
+                    )
+                    status = await c.get_tpu_shared_memory_status(as_json=True)
+                    assert status
+                    await c.unregister_tpu_shared_memory("aio_tpu")
+
+            _run(flow())
+        finally:
+            tpushm.destroy_shared_memory_region(handle)
+
+    def test_decoupled_stream(self, server):
+        import client_tpu.grpc.aio as aioclient
+
+        async def flow():
+            async with aioclient.InferenceServerClient(server.grpc_address) as c:
+                inp = aioclient.InferInput("IN", [1], "INT32")
+                inp.set_data_from_numpy(np.array([4], dtype=np.int32))
+
+                async def requests():
+                    yield {"model_name": "repeat_int32", "inputs": [inp]}
+
+                seen = []
+                async for result, error in c.stream_infer(requests()):
+                    assert error is None
+                    seen.append(int(result.as_numpy("OUT")[0]))
+                    if len(seen) == 4:
+                        break
+                assert seen == [0, 1, 2, 3]
+
+        _run(flow())
+
+    def test_metadata_as_json(self, server):
+        import client_tpu.grpc.aio as aioclient
+
+        async def flow():
+            async with aioclient.InferenceServerClient(server.grpc_address) as c:
+                meta = await c.get_model_metadata("simple", as_json=True)
+                assert meta["name"] == "simple"
+                idx = await c.get_model_repository_index(as_json=True)
+                names = [m["name"] for m in idx.get("models", [])]
+                assert "simple" in names
+
+        _run(flow())

@@ -132,7 +132,7 @@ def test_perf_cli_torchserve_hermetic_sweep():
     proc = subprocess.run(
         [sys.executable, "-m", "client_tpu.perf", "-m", "resnet",
          "--service-kind", "torchserve", "--hermetic",
-         "--shape", "resnet:1,8", "--concurrency-range", "1:2:1",
+         "--shape", "data:1,8", "--concurrency-range", "1:2:1",
          "--measurement-interval", "400", "--max-trials", "4"],
         capture_output=True, text=True, timeout=120,
     )
