@@ -32,8 +32,13 @@ GRPC_INC := -I$(PB_CPP) -I$(CPP_DIR)/client -I$(CPP_DIR)/grpc
 cpp: $(CPP_BUILD)/simple_http_infer_client $(CPP_BUILD)/cc_client_test \
      $(CPP_BUILD)/libhttpclient_tpu.so grpc_cpp
 
-grpc_cpp: $(CPP_BUILD)/simple_grpc_infer_client \
-          $(CPP_BUILD)/simple_grpc_sequence_stream_infer_client \
+GRPC_EXAMPLES := simple_grpc_infer_client \
+                 simple_grpc_sequence_stream_infer_client \
+                 simple_grpc_async_infer_client \
+                 simple_grpc_health_metadata \
+                 simple_grpc_model_control
+
+grpc_cpp: $(addprefix $(CPP_BUILD)/,$(GRPC_EXAMPLES)) \
           $(CPP_BUILD)/cc_grpc_client_test $(CPP_BUILD)/hpack_unit_test
 
 $(PB_CPP)/inference.pb.cc: $(PROTO_DIR)/inference.proto $(PROTO_DIR)/model_config.proto
@@ -65,11 +70,7 @@ $(CPP_BUILD)/hpack_unit_test: $(CPP_DIR)/tests/hpack_unit_test.cc $(CPP_BUILD)/h
 	mkdir -p $(CPP_BUILD)
 	$(CXX) $(CXXFLAGS) -o $@ $< $(CPP_BUILD)/hpack.o $(GRPC_INC)
 
-$(CPP_BUILD)/simple_grpc_infer_client: $(CPP_DIR)/examples/simple_grpc_infer_client.cc $(GRPC_OBJS)
-	mkdir -p $(CPP_BUILD)
-	$(CXX) $(CXXFLAGS) -o $@ $< $(GRPC_OBJS) $(GRPC_INC) $(GRPC_LINK)
-
-$(CPP_BUILD)/simple_grpc_sequence_stream_infer_client: $(CPP_DIR)/examples/simple_grpc_sequence_stream_infer_client.cc $(GRPC_OBJS)
+$(addprefix $(CPP_BUILD)/,$(GRPC_EXAMPLES)): $(CPP_BUILD)/%: $(CPP_DIR)/examples/%.cc $(GRPC_OBJS)
 	mkdir -p $(CPP_BUILD)
 	$(CXX) $(CXXFLAGS) -o $@ $< $(GRPC_OBJS) $(GRPC_INC) $(GRPC_LINK)
 

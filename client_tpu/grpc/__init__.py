@@ -66,6 +66,35 @@ def raise_error_grpc(rpc_error):
     ) from None
 
 
+def build_trace_setting_request(model_name, settings):
+    """TraceSettingRequest from a plain dict (shared by the sync and aio
+    clients — the builders are pure functions of ``settings``)."""
+    request = pb.TraceSettingRequest(model_name=model_name)
+    for key, value in (settings or {}).items():
+        if value is None:
+            request.settings[key]  # present-but-empty clears the setting
+        elif isinstance(value, (list, tuple)):
+            request.settings[key].value.extend(str(v) for v in value)
+        else:
+            request.settings[key].value.append(str(value))
+    return request
+
+
+def build_log_settings_request(settings):
+    """LogSettingsRequest from a plain dict (shared sync/aio)."""
+    request = pb.LogSettingsRequest()
+    for key, value in settings.items():
+        if value is None:
+            request.settings[key]
+        elif isinstance(value, bool):  # before int: bool is an int subclass
+            request.settings[key].bool_param = value
+        elif isinstance(value, int):
+            request.settings[key].uint32_param = value
+        else:
+            request.settings[key].string_param = str(value)
+    return request
+
+
 def _stream_error(error_message):
     """ModelStreamInferResponse.error_message -> exception.  The server
     encodes any status code as a leading "[<status>] " prefix (the wire type
@@ -352,14 +381,7 @@ class InferenceServerClient:
         as_json=False,
         client_timeout=None,
     ):
-        request = pb.TraceSettingRequest(model_name=model_name)
-        for key, value in (settings or {}).items():
-            if value is None:
-                request.settings[key]  # present-but-empty clears the setting
-            elif isinstance(value, (list, tuple)):
-                request.settings[key].value.extend(str(v) for v in value)
-            else:
-                request.settings[key].value.append(str(value))
+        request = build_trace_setting_request(model_name, settings)
         return self._maybe_json(
             self._call("TraceSetting", request, headers, client_timeout), as_json
         )
@@ -375,16 +397,7 @@ class InferenceServerClient:
     def update_log_settings(
         self, settings, headers=None, as_json=False, client_timeout=None
     ):
-        request = pb.LogSettingsRequest()
-        for key, value in settings.items():
-            if value is None:
-                request.settings[key]
-            elif isinstance(value, bool):
-                request.settings[key].bool_param = value
-            elif isinstance(value, int):
-                request.settings[key].uint32_param = value
-            else:
-                request.settings[key].string_param = str(value)
+        request = build_log_settings_request(settings)
         return self._maybe_json(
             self._call("LogSettings", request, headers, client_timeout), as_json
         )

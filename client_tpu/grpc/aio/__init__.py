@@ -204,14 +204,9 @@ class InferenceServerClient:
         self, model_name="", settings=None, headers=None, as_json=False,
         client_timeout=None,
     ):
-        request = pb.TraceSettingRequest(model_name=model_name)
-        for key, value in (settings or {}).items():
-            if value is None:
-                request.settings[key]  # present-but-empty clears the setting
-            elif isinstance(value, (list, tuple)):
-                request.settings[key].value.extend(str(v) for v in value)
-            else:
-                request.settings[key].value.append(str(value))
+        from client_tpu.grpc import build_trace_setting_request
+
+        request = build_trace_setting_request(model_name, settings)
         r = await self._call("TraceSetting", request, headers, client_timeout)
         return self._maybe_json(r, as_json)
 
@@ -229,16 +224,9 @@ class InferenceServerClient:
     async def update_log_settings(
         self, settings, headers=None, as_json=False, client_timeout=None
     ):
-        request = pb.LogSettingsRequest()
-        for key, value in settings.items():
-            if value is None:
-                request.settings[key]
-            elif isinstance(value, bool):
-                request.settings[key].bool_param = value
-            elif isinstance(value, int):
-                request.settings[key].uint32_param = value
-            else:
-                request.settings[key].string_param = str(value)
+        from client_tpu.grpc import build_log_settings_request
+
+        request = build_log_settings_request(settings)
         r = await self._call("LogSettings", request, headers, client_timeout)
         return self._maybe_json(r, as_json)
 

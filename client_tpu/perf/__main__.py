@@ -136,6 +136,13 @@ def main(argv=None):
         tensor = "data" if args.service_kind == "torchserve" else "instances"
         if tensor in shape_overrides:
             backend_kwargs["input_shape"] = shape_overrides[tensor]
+        for key in shape_overrides:
+            if key != tensor:
+                print(
+                    f"warning: --shape '{key}' does not match this service "
+                    f"kind's input tensor '{tensor}'; ignored",
+                    file=sys.stderr,
+                )
         if args.hermetic:
             from client_tpu.perf.fake_endpoints import (
                 fake_tfserving,

@@ -167,13 +167,15 @@ def serialize_byte_tensor(input_tensor):
     return np.frombuffer(blob, dtype=np.uint8)
 
 
-def deserialize_bytes_tensor(encoded_tensor):
-    """Inverse of serialize_byte_tensor: wire payload -> 1-D np.object_ array of bytes."""
+def deserialize_bytes_tensor(encoded_tensor, max_elements=None):
+    """Inverse of serialize_byte_tensor: wire payload -> 1-D np.object_ array
+    of bytes.  ``max_elements`` stops after that many elements — for reading
+    out of an shm region whose tail beyond the tensor is arbitrary bytes."""
     strs = []
     offset = 0
     view = memoryview(encoded_tensor)
     n = len(view)
-    while offset < n:
+    while offset < n and (max_elements is None or len(strs) < max_elements):
         if offset + 4 > n:
             raise_error("malformed BYTES tensor: truncated length prefix")
         (length,) = struct.unpack_from("<I", view, offset)

@@ -128,9 +128,17 @@ def get_contents_as_numpy(shm_handle, datatype, shape, offset=0):
             raise InferenceServerException(_last_error(lib))
         from client_tpu.utils import deserialize_bytes_tensor
 
-        flat = deserialize_bytes_tensor(np.frombuffer(buf.raw, np.uint8))
         n = int(np.prod(shape)) if len(shape) else 1
-        return flat[:n].reshape(shape)
+        # stop at exactly n elements: the region's tail past the tensor is
+        # arbitrary bytes, not length-prefixed data
+        flat = deserialize_bytes_tensor(
+            np.frombuffer(buf.raw, np.uint8), max_elements=n
+        )
+        if flat.size < n:
+            raise InferenceServerException(
+                f"region holds {flat.size} BYTES elements, need {n}"
+            )
+        return flat.reshape(shape)
     count = int(np.prod(shape)) if len(shape) else 1
     size = count * np.dtype(np_dtype).itemsize
     buf = ctypes.create_string_buffer(size)

@@ -318,7 +318,14 @@ class TpuRegion:
             from client_tpu.utils import deserialize_bytes_tensor
 
             raw = self.read(offset, byte_size or self.byte_size - offset)
-            arr = deserialize_bytes_tensor(raw)
+            # cap at shape-many elements: the region's tail past the tensor
+            # is arbitrary bytes, not length-prefixed data
+            n = int(np.prod(shape)) if shape else None
+            arr = deserialize_bytes_tensor(raw, max_elements=n)
+            if n is not None and arr.size < n:
+                raise InferenceServerException(
+                    f"region holds {arr.size} BYTES elements, need {n}"
+                )
             return arr.reshape(shape) if shape is not None else arr
         np_dtype = triton_to_np_dtype(datatype)
         if np_dtype is None:

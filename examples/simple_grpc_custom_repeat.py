@@ -1,0 +1,56 @@
+#!/usr/bin/env python
+"""Decoupled N-response model over the bidi stream — parity with the
+reference simple_grpc_custom_repeat.py: one request to repeat_int32
+yields --repeat-count responses."""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import queue  # noqa: E402
+
+import client_tpu.grpc as grpcclient  # noqa: E402
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-u", "--url", default="localhost:8001")
+    parser.add_argument("--hermetic", action="store_true")
+    parser.add_argument("--repeat-count", type=int, default=8)
+    args = parser.parse_args()
+
+    server = None
+    url = args.url
+    if args.hermetic:
+        from client_tpu.serve import Server
+
+        server = Server(grpc_port=0).start()
+        url = server.grpc_address
+
+    try:
+        results = queue.SimpleQueue()
+        with grpcclient.InferenceServerClient(url) as client:
+            client.start_stream(lambda result, error: results.put((result, error)))
+            inp = grpcclient.InferInput("IN", [1], "INT32")
+            inp.set_data_from_numpy(np.array([args.repeat_count], dtype=np.int32))
+            client.async_stream_infer("repeat_int32", [inp])
+            got = []
+            for _ in range(args.repeat_count):
+                result, error = results.get(timeout=30)
+                if error is not None:
+                    sys.exit(f"error: {error}")
+                got.append(int(result.as_numpy("OUT")[0]))
+            client.stop_stream()
+            if got != list(range(args.repeat_count)):
+                sys.exit(f"error: wrong repeat sequence {got}")
+            print(f"PASS: grpc custom repeat x{args.repeat_count}")
+    finally:
+        if server:
+            server.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,53 @@
+// Health + metadata surface over the native gRPC client (parity with
+// reference src/c++/examples/simple_grpc_health_metadata.cc).
+//
+// Usage: simple_grpc_health_metadata [-u host:port]
+#include <cstdio>
+#include <cstring>
+#include <string>
+
+#include "grpc_client.h"
+
+namespace tc = ctpu;
+
+#define FAIL_IF_ERR(X, MSG)                                                \
+  do {                                                                     \
+    tc::Error err__ = (X);                                                 \
+    if (!err__.IsOk()) {                                                   \
+      fprintf(stderr, "error: %s: %s\n", (MSG), err__.Message().c_str());  \
+      return 1;                                                            \
+    }                                                                      \
+  } while (false)
+
+int
+main(int argc, char** argv)
+{
+  std::string url = "localhost:8001";
+  for (int i = 1; i < argc - 1; ++i)
+    if (!std::strcmp(argv[i], "-u")) url = argv[++i];
+  std::unique_ptr<tc::InferenceServerGrpcClient> client;
+  FAIL_IF_ERR(tc::InferenceServerGrpcClient::Create(&client, url), "create");
+
+  bool live = false, ready = false, model_ready = false;
+  FAIL_IF_ERR(client->IsServerLive(&live), "live");
+  FAIL_IF_ERR(client->IsServerReady(&ready), "ready");
+  FAIL_IF_ERR(client->IsModelReady(&model_ready, "simple"), "model ready");
+  printf("live=%d ready=%d model_ready=%d\n", live, ready, model_ready);
+  if (!live || !ready || !model_ready) {
+    fprintf(stderr, "error: server/model not healthy\n");
+    return 1;
+  }
+  inference::ServerMetadataResponse server_meta;
+  FAIL_IF_ERR(client->ServerMetadata(&server_meta), "server metadata");
+  printf("server: %s %s\n", server_meta.name().c_str(),
+         server_meta.version().c_str());
+  inference::ModelMetadataResponse model_meta;
+  FAIL_IF_ERR(client->ModelMetadata(&model_meta, "simple"), "model metadata");
+  printf("model '%s': %d inputs, %d outputs\n", model_meta.name().c_str(),
+         model_meta.inputs_size(), model_meta.outputs_size());
+  inference::ModelConfigResponse config;
+  FAIL_IF_ERR(client->ModelConfig(&config, "simple"), "model config");
+  printf("max_batch_size: %d\n", config.config().max_batch_size());
+  printf("PASS : grpc_health_metadata\n");
+  return 0;
+}

@@ -1,0 +1,51 @@
+// Explicit model control over the native gRPC client (parity with
+// reference src/c++/examples/simple_grpc_model_control.cc): unload,
+// observe readiness, reload, list the repository index.
+//
+// Usage: simple_grpc_model_control [-u host:port]
+#include <cstdio>
+#include <cstring>
+#include <string>
+
+#include "grpc_client.h"
+
+namespace tc = ctpu;
+
+#define FAIL_IF_ERR(X, MSG)                                                \
+  do {                                                                     \
+    tc::Error err__ = (X);                                                 \
+    if (!err__.IsOk()) {                                                   \
+      fprintf(stderr, "error: %s: %s\n", (MSG), err__.Message().c_str());  \
+      return 1;                                                            \
+    }                                                                      \
+  } while (false)
+
+int
+main(int argc, char** argv)
+{
+  std::string url = "localhost:8001";
+  for (int i = 1; i < argc - 1; ++i)
+    if (!std::strcmp(argv[i], "-u")) url = argv[++i];
+  std::unique_ptr<tc::InferenceServerGrpcClient> client;
+  FAIL_IF_ERR(tc::InferenceServerGrpcClient::Create(&client, url), "create");
+
+  const std::string model = "identity";
+  FAIL_IF_ERR(client->UnloadModel(model), "unload");
+  bool ready = true;
+  client->IsModelReady(&ready, model);
+  if (ready) {
+    fprintf(stderr, "error: model still ready after unload\n");
+    return 1;
+  }
+  FAIL_IF_ERR(client->LoadModel(model), "load");
+  FAIL_IF_ERR(client->IsModelReady(&ready, model), "ready");
+  if (!ready) {
+    fprintf(stderr, "error: model not ready after load\n");
+    return 1;
+  }
+  inference::RepositoryIndexResponse index;
+  FAIL_IF_ERR(client->ModelRepositoryIndex(&index), "index");
+  printf("repository holds %d models\n", index.models_size());
+  printf("PASS : grpc_model_control\n");
+  return 0;
+}

@@ -85,7 +85,10 @@ def test_cc_grpc_client_suite(grpc_server):
 @needs_grpc_cpp
 def test_native_grpc_examples(grpc_server):
     for exe in ("simple_grpc_infer_client",
-                "simple_grpc_sequence_stream_infer_client"):
+                "simple_grpc_sequence_stream_infer_client",
+                "simple_grpc_async_infer_client",
+                "simple_grpc_health_metadata",
+                "simple_grpc_model_control"):
         proc = subprocess.run(
             [os.path.join(_BUILD, exe), "-u", grpc_server.grpc_address],
             capture_output=True, text=True, timeout=60,
