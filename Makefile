@@ -15,6 +15,7 @@ all: protos native cpp
 CPP_DIR := src/cpp
 CPP_BUILD := build/cpp
 CLIENT_SRCS := $(CPP_DIR)/client/json.cc $(CPP_DIR)/client/http_client.cc \
+               $(CPP_DIR)/client/http_reactor.cc \
                $(CPP_DIR)/client/shm_utils.cc
 CLIENT_HDRS := $(wildcard $(CPP_DIR)/client/*.h)
 
@@ -26,7 +27,7 @@ GRPC_HDRS := $(wildcard $(CPP_DIR)/grpc/*.h)
 GRPC_OBJS := $(CPP_BUILD)/hpack.o $(CPP_BUILD)/h2.o \
              $(CPP_BUILD)/grpc_client.o $(CPP_BUILD)/inference.pb.o \
              $(CPP_BUILD)/model_config.pb.o
-GRPC_LINK := -lprotobuf -lrt -lpthread
+GRPC_LINK := -lprotobuf -lrt -lpthread -lz
 GRPC_INC := -I$(PB_CPP) -I$(CPP_DIR)/client -I$(CPP_DIR)/grpc
 
 cpp: $(CPP_BUILD)/simple_http_infer_client $(CPP_BUILD)/cc_client_test \
@@ -80,15 +81,15 @@ $(CPP_BUILD)/cc_grpc_client_test: $(CPP_DIR)/tests/cc_grpc_client_test.cc $(GRPC
 
 $(CPP_BUILD)/libhttpclient_tpu.so: $(CLIENT_SRCS) $(CLIENT_HDRS)
 	mkdir -p $(CPP_BUILD)
-	$(CXX) $(CXXFLAGS) -shared -o $@ $(CLIENT_SRCS) -lrt -lpthread
+	$(CXX) $(CXXFLAGS) -shared -o $@ $(CLIENT_SRCS) -lrt -lpthread -lz
 
 $(CPP_BUILD)/simple_http_infer_client: $(CPP_DIR)/examples/simple_http_infer_client.cc $(CLIENT_SRCS) $(CLIENT_HDRS)
 	mkdir -p $(CPP_BUILD)
-	$(CXX) $(CXXFLAGS) -o $@ $< $(CLIENT_SRCS) -lrt -lpthread
+	$(CXX) $(CXXFLAGS) -o $@ $< $(CLIENT_SRCS) -lrt -lpthread -lz
 
 $(CPP_BUILD)/cc_client_test: $(CPP_DIR)/tests/cc_client_test.cc $(CLIENT_SRCS) $(CLIENT_HDRS)
 	mkdir -p $(CPP_BUILD)
-	$(CXX) $(CXXFLAGS) -o $@ $< $(CLIENT_SRCS) -lrt -lpthread
+	$(CXX) $(CXXFLAGS) -o $@ $< $(CLIENT_SRCS) -lrt -lpthread -lz
 
 protos: $(PB_OUT)/inference_pb2.py
 

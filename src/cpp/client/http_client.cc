@@ -7,9 +7,14 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <zlib.h>
+
+#include <chrono>
 #include <cstring>
 #include <sstream>
 #include <thread>
+
+#include "http_reactor.h"
 
 namespace ctpu {
 
@@ -684,11 +689,74 @@ InferenceServerHttpClient::ParseResponseBody(
   return Error::Success();
 }
 
+namespace {
+
+// gzip = zlib with the RFC-1952 wrapper (windowBits 15+16); deflate = the
+// RFC-1950 zlib stream browsers and servers actually speak for
+// "Content-Encoding: deflate".
+Error
+ZCompress(
+    const std::string& in,
+    InferenceServerHttpClient::CompressionType type, std::string* out)
+{
+  z_stream zs = {};
+  const int window =
+      type == InferenceServerHttpClient::CompressionType::GZIP ? 15 + 16 : 15;
+  if (deflateInit2(&zs, Z_DEFAULT_COMPRESSION, Z_DEFLATED, window, 8,
+                   Z_DEFAULT_STRATEGY) != Z_OK) {
+    return Error("deflateInit2 failed");
+  }
+  out->resize(deflateBound(&zs, in.size()));
+  zs.next_in = reinterpret_cast<Bytef*>(const_cast<char*>(in.data()));
+  zs.avail_in = in.size();
+  zs.next_out = reinterpret_cast<Bytef*>(&(*out)[0]);
+  zs.avail_out = out->size();
+  const int rc = deflate(&zs, Z_FINISH);
+  deflateEnd(&zs);
+  if (rc != Z_STREAM_END) return Error("deflate failed");
+  out->resize(out->size() - zs.avail_out);
+  return Error::Success();
+}
+
+Error
+ZDecompress(
+    const std::string& in,
+    InferenceServerHttpClient::CompressionType type, std::string* out)
+{
+  z_stream zs = {};
+  const int window =
+      type == InferenceServerHttpClient::CompressionType::GZIP ? 15 + 16 : 15;
+  if (inflateInit2(&zs, window) != Z_OK) return Error("inflateInit2 failed");
+  zs.next_in = reinterpret_cast<Bytef*>(const_cast<char*>(in.data()));
+  zs.avail_in = in.size();
+  char chunk[65536];
+  int rc = Z_OK;
+  while (rc != Z_STREAM_END) {
+    zs.next_out = reinterpret_cast<Bytef*>(chunk);
+    zs.avail_out = sizeof(chunk);
+    rc = inflate(&zs, Z_NO_FLUSH);
+    if (rc != Z_OK && rc != Z_STREAM_END) {
+      inflateEnd(&zs);
+      return Error("inflate failed (corrupt compressed response)");
+    }
+    out->append(chunk, sizeof(chunk) - zs.avail_out);
+    if (rc != Z_STREAM_END && zs.avail_in == 0) {
+      inflateEnd(&zs);
+      return Error("truncated compressed response");
+    }
+  }
+  inflateEnd(&zs);
+  return Error::Success();
+}
+
+}  // namespace
+
 Error
 InferenceServerHttpClient::Infer(
     InferResultPtr* result, const InferOptions& options,
     const std::vector<InferInput*>& inputs,
-    const std::vector<const InferRequestedOutput*>& outputs)
+    const std::vector<const InferRequestedOutput*>& outputs,
+    CompressionType request_compression, CompressionType response_compression)
 {
   std::string body;
   size_t header_length = 0;
@@ -706,10 +774,34 @@ InferenceServerHttpClient::Infer(
       {"Content-Type", "application/octet-stream"},
       {"Inference-Header-Content-Length", std::to_string(header_length)},
   };
+  if (request_compression != CompressionType::NONE) {
+    std::string compressed;
+    err = ZCompress(body, request_compression, &compressed);
+    if (!err.IsOk()) return err;
+    body.swap(compressed);
+    headers["Content-Encoding"] =
+        request_compression == CompressionType::GZIP ? "gzip" : "deflate";
+  }
+  if (response_compression != CompressionType::NONE) {
+    headers["Accept-Encoding"] =
+        response_compression == CompressionType::GZIP ? "gzip" : "deflate";
+  }
   HttpResponse r;
   err = Request(&r, "POST", uri, body, headers);
   if (!err.IsOk()) return err;
   if (r.status != 200) return ErrorFromResponse(r);
+  const auto enc = r.headers.find("content-encoding");
+  if (enc != r.headers.end() && !enc->second.empty() &&
+      enc->second != "identity") {
+    std::string plain;
+    err = ZDecompress(
+        r.body,
+        enc->second == "gzip" ? CompressionType::GZIP
+                              : CompressionType::DEFLATE,
+        &plain);
+    if (!err.IsOk()) return err;
+    r.body.swap(plain);
+  }
 
   size_t resp_header_len = 0;
   auto it = r.headers.find("inference-header-content-length");
@@ -760,17 +852,53 @@ InferenceServerHttpClient::AsyncInferMulti(
     const std::vector<std::vector<InferInput*>>& inputs,
     const std::vector<std::vector<const InferRequestedOutput*>>& outputs)
 {
-  std::string url = host_ + ":" + std::to_string(port_);
-  bool verbose = verbose_;
-  std::thread([=]() {
-    std::unique_ptr<InferenceServerHttpClient> client;
-    Error err = Create(&client, url, verbose);
+  if (options.size() != 1 && options.size() != inputs.size()) {
+    return Error("options count must be 1 or match request count");
+  }
+  if (!outputs.empty() && outputs.size() != 1 &&
+      outputs.size() != inputs.size()) {
+    return Error("outputs count must be 0, 1, or match request count");
+  }
+  // fan out on the reactor; gather in order; one callback when all land
+  struct Gather {
+    std::mutex mu;
     std::vector<InferResultPtr> results;
-    if (err.IsOk()) {
-      err = client->InferMulti(&results, options, inputs, outputs);
-    }
-    callback(results, err);
-  }).detach();
+    Error first_error;
+    size_t remaining;
+    std::function<void(std::vector<InferResultPtr>, Error)> callback;
+  };
+  if (inputs.empty()) {  // the callback must fire exactly once, even empty
+    callback({}, Error::Success());
+    return Error::Success();
+  }
+  auto gather = std::make_shared<Gather>();
+  gather->results.resize(inputs.size());
+  gather->remaining = inputs.size();
+  gather->callback = std::move(callback);
+  static const std::vector<const InferRequestedOutput*> kNoOutputs;
+  for (size_t i = 0; i < inputs.size(); i++) {
+    const InferOptions& opt = options.size() == 1 ? options[0] : options[i];
+    const auto& outs = outputs.empty()
+                           ? kNoOutputs
+                           : (outputs.size() == 1 ? outputs[0] : outputs[i]);
+    auto complete = [gather, i](InferResultPtr result, Error status) {
+      bool last = false;
+      {
+        std::lock_guard<std::mutex> lk(gather->mu);
+        gather->results[i] = result;
+        if (!status.IsOk() && gather->first_error.IsOk())
+          gather->first_error = status;
+        last = (--gather->remaining == 0);
+      }
+      if (last) gather->callback(gather->results, gather->first_error);
+    };
+    Error err = AsyncInfer(complete, opt, inputs[i], outs);
+    // A mid-batch submission failure cannot return an error: earlier
+    // requests are already in flight (a caller retrying the batch would
+    // double-execute them).  Route it through the gather as this request's
+    // completion instead — the one batch callback reports it.
+    if (!err.IsOk()) complete(nullptr, err);
+  }
   return Error::Success();
 }
 
@@ -780,19 +908,74 @@ InferenceServerHttpClient::AsyncInfer(
     const InferOptions& options, const std::vector<InferInput*>& inputs,
     const std::vector<const InferRequestedOutput*>& outputs)
 {
-  // one worker per call over a dedicated connection — the reference's
-  // curl-multi reactor collapses to this under keep-alive-per-client
-  std::string url = host_ + ":" + std::to_string(port_);
-  bool verbose = verbose_;
-  std::thread([=]() {
-    std::unique_ptr<InferenceServerHttpClient> client;
-    Error err = Create(&client, url, verbose);
-    InferResultPtr result;
-    if (err.IsOk()) {
-      err = client->Infer(&result, options, inputs, outputs);
+  if (callback == nullptr)
+    return Error("AsyncInfer requires a completion callback");
+  {
+    std::lock_guard<std::mutex> lk(reactor_mu_);
+    if (reactor_ == nullptr) {
+      auto reactor =
+          std::unique_ptr<HttpReactor>(new HttpReactor(host_, port_));
+      Error err = reactor->Start();
+      if (!err.IsOk()) return err;
+      reactor_ = std::move(reactor);
     }
-    callback(result, err);
-  }).detach();
+  }
+  std::string body;
+  size_t header_length = 0;
+  Error err = GenerateRequestBody(&body, &header_length, options, inputs,
+                                  outputs);
+  if (!err.IsOk()) return err;
+  std::string uri = "/v2/models/" + UrlEncode(options.model_name);
+  if (!options.model_version.empty()) {
+    uri += "/versions/" + options.model_version;
+  }
+  uri += "/infer";
+  std::ostringstream req;
+  req << "POST " << uri << " HTTP/1.1\r\n";
+  req << "Host: " << host_ << ":" << port_ << "\r\n";
+  req << "Content-Length: " << body.size() << "\r\n";
+  req << "Connection: keep-alive\r\n";
+  req << "Content-Type: application/octet-stream\r\n";
+  req << "Inference-Header-Content-Length: " << header_length << "\r\n";
+  req << "\r\n";
+  std::string framed = req.str() + body;
+  uint64_t deadline_ns = 0;
+  if (options.client_timeout_us > 0) {
+    deadline_ns =
+        std::chrono::duration_cast<std::chrono::nanoseconds>(
+            std::chrono::steady_clock::now().time_since_epoch())
+            .count() +
+        options.client_timeout_us * 1000ull;
+  }
+  reactor_->Submit(
+      std::move(framed),
+      [callback](HttpResponse response, Error status) {
+        if (!status.IsOk()) {
+          callback(nullptr, status);
+          return;
+        }
+        if (response.status != 200) {
+          callback(nullptr, ErrorFromResponse(response));
+          return;
+        }
+        size_t resp_header_len = 0;
+        auto it = response.headers.find("inference-header-content-length");
+        if (it != response.headers.end()) {
+          try {
+            resp_header_len = std::stoull(it->second);
+          }
+          catch (...) {
+            callback(nullptr,
+                     Error("malformed Inference-Header-Content-Length"));
+            return;
+          }
+        }
+        InferResultPtr result;
+        Error perr = ParseResponseBody(
+            &result, std::move(response.body), resp_header_len);
+        callback(result, perr);
+      },
+      deadline_ns);
   return Error::Success();
 }
 

@@ -15,7 +15,11 @@
 #include "common.h"
 #include "json.h"
 
+#include <mutex>
+
 namespace ctpu {
+
+class HttpReactor;
 
 struct HttpResponse {
   int status = 0;
@@ -61,12 +65,23 @@ class InferenceServerHttpClient {
   Error UnregisterTpuSharedMemory(const std::string& name = "");
   Error TpuSharedMemoryStatus(json::ValuePtr* status);
 
+  // Compression algorithms for the infer body (reference http_client.h
+  // Infer(..., request_compression_algorithm, response_compression_algorithm)
+  // — gzip/deflate via zlib; TLS is out of scope in this image, compression
+  // is not).
+  enum class CompressionType { NONE, DEFLATE, GZIP };
+
   Error Infer(
       InferResultPtr* result, const InferOptions& options,
       const std::vector<InferInput*>& inputs,
-      const std::vector<const InferRequestedOutput*>& outputs = {});
+      const std::vector<const InferRequestedOutput*>& outputs = {},
+      CompressionType request_compression = CompressionType::NONE,
+      CompressionType response_compression = CompressionType::NONE);
 
-  // Fire on a worker thread; callback runs there (reference AsyncInfer).
+  // Event-loop async: requests ride the client's epoll reactor (one thread,
+  // many in-flight keep-alive connections — the reference's curl-multi
+  // AsyncTransfer, http_client.cc:1882-1956).  The callback runs on the
+  // reactor thread; do not block in it.
   Error AsyncInfer(
       std::function<void(InferResultPtr, Error)> callback,
       const InferOptions& options, const std::vector<InferInput*>& inputs,
@@ -113,6 +128,8 @@ class InferenceServerHttpClient {
   int port_ = 0;
   int fd_ = -1;
   bool verbose_ = false;
+  std::mutex reactor_mu_;
+  std::unique_ptr<HttpReactor> reactor_;  // created on first AsyncInfer
 };
 
 }  // namespace ctpu

@@ -1,0 +1,458 @@
+#include "http_reactor.h"
+
+#include <fcntl.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/epoll.h>
+#include <sys/eventfd.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cctype>
+#include <chrono>
+#include <cstring>
+
+namespace ctpu {
+
+namespace {
+
+uint64_t
+NowNs()
+{
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+std::string
+Lower(std::string s)
+{
+  for (char& c : s) c = static_cast<char>(std::tolower(c));
+  return s;
+}
+
+}  // namespace
+
+HttpReactor::HttpReactor(
+    const std::string& host, int port, size_t max_connections)
+    : host_(host), port_(port), max_connections_(max_connections)
+{
+}
+
+HttpReactor::~HttpReactor()
+{
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    shutdown_ = true;
+  }
+  if (wake_fd_ >= 0) {
+    uint64_t one = 1;
+    ssize_t n = write(wake_fd_, &one, sizeof(one));
+    (void)n;
+  }
+  if (thread_.joinable()) thread_.join();
+  for (auto& kv : conns_) {
+    if (kv.second->active != nullptr) {
+      kv.second->active->callback(
+          HttpResponse(), Error("reactor shut down"));
+    }
+    close(kv.second->fd);
+  }
+  conns_.clear();
+  // fail anything never assigned
+  std::deque<std::unique_ptr<Request>> leftover;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    leftover.swap(pending_);
+  }
+  for (auto& r : leftover) {
+    r->callback(HttpResponse(), Error("reactor shut down"));
+  }
+  if (epoll_fd_ >= 0) close(epoll_fd_);
+  if (wake_fd_ >= 0) close(wake_fd_);
+}
+
+Error
+HttpReactor::Start()
+{
+  epoll_fd_ = epoll_create1(EPOLL_CLOEXEC);
+  if (epoll_fd_ < 0) return Error("epoll_create1 failed");
+  wake_fd_ = eventfd(0, EFD_CLOEXEC | EFD_NONBLOCK);
+  if (wake_fd_ < 0) return Error("eventfd failed");
+  struct epoll_event ev = {};
+  ev.events = EPOLLIN;
+  ev.data.fd = wake_fd_;
+  epoll_ctl(epoll_fd_, EPOLL_CTL_ADD, wake_fd_, &ev);
+  running_ = true;
+  thread_ = std::thread(&HttpReactor::Loop, this);
+  return Error::Success();
+}
+
+void
+HttpReactor::Submit(std::string request, Callback callback, uint64_t deadline)
+{
+  auto req = std::unique_ptr<Request>(new Request{
+      std::move(request), std::move(callback), deadline});
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    pending_.push_back(std::move(req));
+  }
+  uint64_t one = 1;
+  ssize_t n = write(wake_fd_, &one, sizeof(one));
+  (void)n;
+}
+
+void
+HttpReactor::Loop()
+{
+  struct epoll_event events[64];
+  while (true) {
+    const int n = epoll_wait(epoll_fd_, events, 64, 50 /* ms */);
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      if (shutdown_) return;
+    }
+    for (int i = 0; i < n; ++i) {
+      if (events[i].data.fd == wake_fd_) {
+        uint64_t drain;
+        while (read(wake_fd_, &drain, sizeof(drain)) > 0) {
+        }
+        continue;
+      }
+      auto it = conns_.find(events[i].data.fd);
+      if (it == conns_.end()) continue;
+      Conn* conn = it->second.get();
+      if (events[i].events & (EPOLLERR | EPOLLHUP)) {
+        FailConn(conn, "connection error");
+        continue;
+      }
+      if (events[i].events & EPOLLOUT) HandleWritable(conn);
+      // FailConn inside HandleWritable may have erased the conn
+      it = conns_.find(events[i].data.fd);
+      if (it == conns_.end()) continue;
+      if (events[i].events & EPOLLIN) HandleReadable(conn);
+    }
+    DrainSubmissions();
+    CheckDeadlines();
+  }
+}
+
+void
+HttpReactor::DrainSubmissions()
+{
+  // hand queued requests to idle connections, then open new ones up to cap.
+  // Iterate over an fd snapshot: AssignRequest can fail the write and erase
+  // the connection from conns_ mid-walk.
+  std::vector<int> fds;
+  fds.reserve(conns_.size());
+  for (const auto& kv : conns_) fds.push_back(kv.first);
+  for (const int fd : fds) {
+    auto it = conns_.find(fd);
+    if (it == conns_.end() || it->second->state != Conn::IDLE) continue;
+    if (!AssignRequest(it->second.get())) return;
+  }
+  size_t queued;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    queued = pending_.size();
+  }
+  while (queued > 0 && conns_.size() < max_connections_) {
+    StartConnection();
+    --queued;
+  }
+}
+
+bool
+HttpReactor::AssignRequest(Conn* conn)
+{
+  std::unique_ptr<Request> req;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (pending_.empty()) return false;
+    req = std::move(pending_.front());
+    pending_.pop_front();
+  }
+  conn->active = std::move(req);
+  conn->out = conn->active->bytes;
+  conn->out_off = 0;
+  conn->in.clear();
+  conn->header_end = std::string::npos;
+  conn->content_length = std::string::npos;
+  conn->response = HttpResponse();
+  conn->state = Conn::WRITING;
+  struct epoll_event ev = {};
+  ev.events = EPOLLOUT | EPOLLIN;
+  ev.data.fd = conn->fd;
+  epoll_ctl(epoll_fd_, EPOLL_CTL_MOD, conn->fd, &ev);
+  HandleWritable(conn);
+  return true;
+}
+
+void
+HttpReactor::StartConnection()
+{
+  if (!resolved_) {
+    struct addrinfo hints = {};
+    hints.ai_family = AF_UNSPEC;
+    hints.ai_socktype = SOCK_STREAM;
+    struct addrinfo* res = nullptr;
+    const std::string port = std::to_string(port_);
+    if (getaddrinfo(host_.c_str(), port.c_str(), &hints, &res) != 0 ||
+        res == nullptr) {
+      // fail one pending request so the queue cannot stall silently
+      std::unique_ptr<Request> req;
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        if (!pending_.empty()) {
+          req = std::move(pending_.front());
+          pending_.pop_front();
+        }
+      }
+      if (req != nullptr)
+        req->callback(HttpResponse(), Error("failed to resolve " + host_));
+      return;
+    }
+    for (struct addrinfo* ai = res; ai != nullptr; ai = ai->ai_next) {
+      Addr a;
+      a.family = ai->ai_family;
+      a.socktype = ai->ai_socktype;
+      a.protocol = ai->ai_protocol;
+      std::memcpy(&a.addr, ai->ai_addr, ai->ai_addrlen);
+      a.addrlen = ai->ai_addrlen;
+      addrs_.push_back(a);
+    }
+    freeaddrinfo(res);
+    resolved_ = true;
+  }
+  int fd = -1;
+  for (const Addr& a : addrs_) {
+    fd = socket(a.family, a.socktype | SOCK_NONBLOCK, a.protocol);
+    if (fd < 0) continue;
+    const int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    if (connect(fd, reinterpret_cast<const struct sockaddr*>(&a.addr),
+                a.addrlen) == 0 ||
+        errno == EINPROGRESS) {
+      break;
+    }
+    close(fd);
+    fd = -1;
+  }
+  if (fd < 0) return;
+  auto conn = std::unique_ptr<Conn>(new Conn());
+  conn->fd = fd;
+  conn->state = Conn::CONNECTING;
+  struct epoll_event ev = {};
+  ev.events = EPOLLOUT;
+  ev.data.fd = fd;
+  epoll_ctl(epoll_fd_, EPOLL_CTL_ADD, fd, &ev);
+  conns_[fd] = std::move(conn);
+}
+
+void
+HttpReactor::HandleWritable(Conn* conn)
+{
+  if (conn->state == Conn::CONNECTING) {
+    int soerr = 0;
+    socklen_t slen = sizeof(soerr);
+    if (getsockopt(conn->fd, SOL_SOCKET, SO_ERROR, &soerr, &slen) != 0 ||
+        soerr != 0) {
+      FailConn(conn, "connect failed");
+      return;
+    }
+    conn->state = Conn::IDLE;
+    if (!AssignRequest(conn)) {
+      struct epoll_event ev = {};
+      ev.events = EPOLLIN;  // watch for server-side close while idle
+      ev.data.fd = conn->fd;
+      epoll_ctl(epoll_fd_, EPOLL_CTL_MOD, conn->fd, &ev);
+    }
+    return;
+  }
+  if (conn->state != Conn::WRITING) return;
+  while (conn->out_off < conn->out.size()) {
+    const ssize_t n =
+        send(conn->fd, conn->out.data() + conn->out_off,
+             conn->out.size() - conn->out_off, MSG_NOSIGNAL);
+    if (n > 0) {
+      conn->out_off += static_cast<size_t>(n);
+      continue;
+    }
+    if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) return;
+    FailConn(conn, "request write failed");
+    return;
+  }
+  conn->state = Conn::READING;
+  struct epoll_event ev = {};
+  ev.events = EPOLLIN;
+  ev.data.fd = conn->fd;
+  epoll_ctl(epoll_fd_, EPOLL_CTL_MOD, conn->fd, &ev);
+}
+
+void
+HttpReactor::HandleReadable(Conn* conn)
+{
+  if (conn->state == Conn::IDLE) {
+    // the server closed an idle keep-alive connection
+    char probe;
+    if (recv(conn->fd, &probe, 1, MSG_PEEK) <= 0) CloseConn(conn);
+    return;
+  }
+  if (conn->state != Conn::READING && conn->state != Conn::WRITING) return;
+  char chunk[16384];
+  while (true) {
+    const ssize_t n = recv(conn->fd, chunk, sizeof(chunk), 0);
+    if (n > 0) {
+      conn->in.append(chunk, static_cast<size_t>(n));
+      continue;
+    }
+    if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) break;
+    FailConn(conn, n == 0 ? "connection closed mid-response"
+                          : "response read failed");
+    return;
+  }
+  if (conn->header_end == std::string::npos) {
+    conn->header_end = conn->in.find("\r\n\r\n");
+    if (conn->header_end == std::string::npos) return;
+    // parse status line + headers
+    const std::string head = conn->in.substr(0, conn->header_end);
+    size_t line_end = head.find("\r\n");
+    const std::string status_line =
+        head.substr(0, line_end == std::string::npos ? head.size() : line_end);
+    const size_t sp = status_line.find(' ');
+    if (sp != std::string::npos)
+      conn->response.status = std::atoi(status_line.c_str() + sp + 1);
+    size_t pos = line_end == std::string::npos ? head.size() : line_end + 2;
+    while (pos < head.size()) {
+      size_t eol = head.find("\r\n", pos);
+      if (eol == std::string::npos) eol = head.size();
+      const std::string line = head.substr(pos, eol - pos);
+      const size_t colon = line.find(':');
+      if (colon != std::string::npos) {
+        std::string key = Lower(line.substr(0, colon));
+        size_t vstart = colon + 1;
+        while (vstart < line.size() && line[vstart] == ' ') ++vstart;
+        conn->response.headers[key] = line.substr(vstart);
+      }
+      pos = eol + 2;
+    }
+    const auto cl = conn->response.headers.find("content-length");
+    if (cl != conn->response.headers.end())
+      conn->content_length = std::strtoull(cl->second.c_str(), nullptr, 10);
+    else
+      conn->content_length = 0;  // KServe responses always carry a length
+  }
+  const size_t body_start = conn->header_end + 4;
+  if (conn->in.size() >= body_start + conn->content_length) {
+    conn->response.body =
+        conn->in.substr(body_start, conn->content_length);
+    FinishResponse(conn);
+  }
+}
+
+void
+HttpReactor::FinishResponse(Conn* conn)
+{
+  std::unique_ptr<Request> done = std::move(conn->active);
+  HttpResponse response = std::move(conn->response);
+  if (conn->out_off < conn->out.size()) {
+    // Early response (e.g. 400/413) while our body was still in flight:
+    // the stream is desynced — the server still expects the old body's
+    // tail — so this connection must not be reused.
+    CloseConn(conn);
+  } else {
+    conn->ever_used = true;
+    conn->state = Conn::IDLE;
+    if (!AssignRequest(conn)) {
+      struct epoll_event ev = {};
+      ev.events = EPOLLIN;
+      ev.data.fd = conn->fd;
+      epoll_ctl(epoll_fd_, EPOLL_CTL_MOD, conn->fd, &ev);
+    }
+  }
+  done->callback(std::move(response), Error::Success());
+}
+
+void
+HttpReactor::FailConn(Conn* conn, const std::string& msg)
+{
+  std::unique_ptr<Request> active = std::move(conn->active);
+  const bool connecting = (conn->state == Conn::CONNECTING);
+  const bool retryable =
+      conn->ever_used && active != nullptr && conn->in.empty();
+  if (active != nullptr) {
+    if (retryable) {
+      // stale keep-alive closed before reading our request: it cannot have
+      // executed — requeue at the front (same rule as the sync client)
+      std::lock_guard<std::mutex> lk(mu_);
+      pending_.push_front(std::move(active));
+    } else {
+      active->callback(HttpResponse(), Error(msg));
+    }
+  } else if (connecting) {
+    // A failed connect must surface: fail one queued request per doomed
+    // connection, otherwise an unreachable server leaves every AsyncInfer
+    // callback pending forever while the loop retries connects.
+    std::unique_ptr<Request> victim;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      if (!pending_.empty()) {
+        victim = std::move(pending_.front());
+        pending_.pop_front();
+      }
+    }
+    if (victim != nullptr) {
+      victim->callback(
+          HttpResponse(),
+          Error("failed to connect to " + host_ + ":" +
+                std::to_string(port_)));
+    }
+  }
+  CloseConn(conn);
+}
+
+void
+HttpReactor::CloseConn(Conn* conn)
+{
+  const int fd = conn->fd;
+  epoll_ctl(epoll_fd_, EPOLL_CTL_DEL, fd, nullptr);
+  close(fd);
+  conns_.erase(fd);
+}
+
+void
+HttpReactor::CheckDeadlines()
+{
+  const uint64_t now = NowNs();
+  std::vector<Conn*> expired;
+  for (auto& kv : conns_) {
+    Conn* conn = kv.second.get();
+    if (conn->active != nullptr && conn->active->deadline_ns != 0 &&
+        now > conn->active->deadline_ns) {
+      expired.push_back(conn);
+    }
+  }
+  for (Conn* conn : expired) {
+    std::unique_ptr<Request> active = std::move(conn->active);
+    active->callback(HttpResponse(), Error("request timed out"));
+    CloseConn(conn);  // mid-request connection state is unusable
+  }
+  // expired requests still queued
+  std::vector<std::unique_ptr<Request>> timed_out;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    for (auto it = pending_.begin(); it != pending_.end();) {
+      if ((*it)->deadline_ns != 0 && now > (*it)->deadline_ns) {
+        timed_out.push_back(std::move(*it));
+        it = pending_.erase(it);
+      } else {
+        ++it;
+      }
+    }
+  }
+  for (auto& r : timed_out)
+    r->callback(HttpResponse(), Error("request timed out in queue"));
+}
+
+}  // namespace ctpu

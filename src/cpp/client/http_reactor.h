@@ -1,0 +1,97 @@
+// Event-loop HTTP/1.1 client engine for AsyncInfer — the native analog of
+// the reference's curl-multi reactor (reference
+// src/c++/library/http_client.cc:1882-1956 AsyncTransfer): one thread, an
+// epoll set of non-blocking keep-alive connections, hundreds of in-flight
+// requests with no thread-per-request.
+#pragma once
+
+#include <sys/socket.h>
+
+#include <cstdint>
+#include <deque>
+#include <functional>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "common.h"
+#include "http_client.h"  // HttpResponse
+
+namespace ctpu {
+
+class HttpReactor {
+ public:
+  // Callback runs on the reactor thread — do not block in it.
+  using Callback = std::function<void(HttpResponse, Error)>;
+
+  HttpReactor(
+      const std::string& host, int port, size_t max_connections = 64);
+  ~HttpReactor();
+  HttpReactor(const HttpReactor&) = delete;
+  HttpReactor& operator=(const HttpReactor&) = delete;
+
+  Error Start();
+  // Queue one fully-framed HTTP/1.1 request (must carry Content-Length and
+  // Connection: keep-alive).  deadline: monotonic ns, 0 = none.
+  void Submit(std::string request, Callback callback, uint64_t deadline_ns = 0);
+
+ private:
+  struct Request {
+    std::string bytes;
+    Callback callback;
+    uint64_t deadline_ns;
+  };
+  struct Conn {
+    int fd = -1;
+    enum State { CONNECTING, WRITING, READING, IDLE } state = CONNECTING;
+    std::string out;
+    size_t out_off = 0;
+    std::string in;
+    size_t header_end = std::string::npos;
+    size_t content_length = std::string::npos;
+    HttpResponse response;
+    std::unique_ptr<Request> active;
+    bool ever_used = false;  // reused keep-alive vs fresh connection
+  };
+
+  void Loop();
+  void DrainSubmissions();
+  bool AssignRequest(Conn* conn);  // pop queue -> start writing; false if empty
+  void StartConnection();
+  void HandleWritable(Conn* conn);
+  void HandleReadable(Conn* conn);
+  void FailConn(Conn* conn, const std::string& msg);
+  void FinishResponse(Conn* conn);
+  void CloseConn(Conn* conn);
+  void CheckDeadlines();
+
+  std::string host_;
+  int port_;
+  size_t max_connections_;
+  int epoll_fd_ = -1;
+  int wake_fd_ = -1;  // eventfd: submissions + shutdown
+  std::thread thread_;
+  bool running_ = false;
+
+  std::mutex mu_;  // guards pending_ (+running_ flag flips)
+  std::deque<std::unique_ptr<Request>> pending_;
+  bool shutdown_ = false;
+
+  std::map<int, std::unique_ptr<Conn>> conns_;  // by fd
+
+  // The target is fixed for the reactor's lifetime: resolve once (lazily,
+  // on the loop thread) and reuse — a slow resolver must not stall every
+  // in-flight request on each new connection.
+  struct Addr {
+    int family, socktype, protocol;
+    struct sockaddr_storage addr;
+    socklen_t addrlen;
+  };
+  std::vector<Addr> addrs_;
+  bool resolved_ = false;
+};
+
+}  // namespace ctpu

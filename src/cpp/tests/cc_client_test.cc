@@ -174,6 +174,65 @@ TestAsyncInfer(tc::InferenceServerHttpClient* client)
 }
 
 static void
+TestInferCompressed(tc::InferenceServerHttpClient* client)
+{
+  // request body gzip-compressed, response requested as deflate (zlib)
+  std::vector<int32_t> in0(16), in1(16);
+  tc::InferInput i0("INPUT0", {1, 16}, "INT32");
+  tc::InferInput i1("INPUT1", {1, 16}, "INT32");
+  FillInputs(in0, in1, i0, i1);
+  tc::InferOptions options("simple");
+  using CT = tc::InferenceServerHttpClient::CompressionType;
+  for (const auto mode : {CT::GZIP, CT::DEFLATE}) {
+    tc::InferResultPtr result;
+    CHECK_OK(client->Infer(&result, options, {&i0, &i1}, {}, mode, mode));
+    const uint8_t* buf = nullptr;
+    size_t size = 0;
+    CHECK_OK(result->RawData("OUTPUT0", &buf, &size));
+    const int32_t* sum = reinterpret_cast<const int32_t*>(buf);
+    for (int i = 0; i < 16; i++) CHECK(sum[i] == in0[i] + in1[i]);
+  }
+}
+
+static void
+TestAsyncInferBurst(tc::InferenceServerHttpClient* client)
+{
+  // 64 requests in flight on the client's epoll reactor — one event-loop
+  // thread, a handful of keep-alive connections, no thread-per-request.
+  const int kRequests = 64;
+  std::vector<int32_t> in0(16), in1(16);
+  tc::InferInput i0("INPUT0", {1, 16}, "INT32");
+  tc::InferInput i1("INPUT1", {1, 16}, "INT32");
+  FillInputs(in0, in1, i0, i1);
+  tc::InferOptions options("simple");
+
+  std::mutex mu;
+  std::condition_variable cv;
+  int done = 0, good = 0;
+  for (int r = 0; r < kRequests; ++r) {
+    CHECK_OK(client->AsyncInfer(
+        [&](tc::InferResultPtr result, tc::Error e) {
+          std::lock_guard<std::mutex> lk(mu);
+          ++done;
+          const uint8_t* buf = nullptr;
+          size_t size = 0;
+          if (e.IsOk() && result != nullptr &&
+              result->RawData("OUTPUT0", &buf, &size).IsOk() &&
+              size == 16 * sizeof(int32_t)) {
+            ++good;
+          }
+          cv.notify_all();
+        },
+        options, {&i0, &i1}));
+  }
+  std::unique_lock<std::mutex> lk(mu);
+  const bool all = cv.wait_for(
+      lk, std::chrono::seconds(60), [&] { return done == kRequests; });
+  CHECK(all);
+  CHECK(good == kRequests);
+}
+
+static void
 TestSystemSharedMemory(tc::InferenceServerHttpClient* client)
 {
   const char* key = "/cc_test_shm";
@@ -301,6 +360,8 @@ main(int argc, char** argv)
   TestInfer(client.get());
   TestInferClassification(client.get());
   TestAsyncInfer(client.get());
+  TestAsyncInferBurst(client.get());
+  TestInferCompressed(client.get());
   TestSystemSharedMemory(client.get());
   TestSequence(client.get());
   TestInferMulti(client.get());
