@@ -10,6 +10,8 @@
 #include <unistd.h>
 
 #include <cctype>
+#include <cerrno>
+#include <cstdlib>
 #include <chrono>
 #include <cstring>
 
@@ -157,9 +159,19 @@ HttpReactor::DrainSubmissions()
     std::lock_guard<std::mutex> lk(mu_);
     queued = pending_.size();
   }
-  while (queued > 0 && conns_.size() < max_connections_) {
+  // Connections still connecting (or idle) will serve the queue when ready:
+  // they count against demand, or a single slow connect would spawn a new
+  // socket every loop tick for the same request.
+  size_t available = 0;
+  for (const auto& kv : conns_) {
+    if (kv.second->state == Conn::CONNECTING ||
+        kv.second->state == Conn::IDLE) {
+      ++available;
+    }
+  }
+  while (queued > available && conns_.size() < max_connections_) {
     StartConnection();
-    --queued;
+    ++available;
   }
 }
 
@@ -227,7 +239,8 @@ HttpReactor::StartConnection()
   }
   int fd = -1;
   for (const Addr& a : addrs_) {
-    fd = socket(a.family, a.socktype | SOCK_NONBLOCK, a.protocol);
+    fd = socket(a.family, a.socktype | SOCK_NONBLOCK | SOCK_CLOEXEC,
+                a.protocol);
     if (fd < 0) continue;
     const int one = 1;
     setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
@@ -294,9 +307,11 @@ void
 HttpReactor::HandleReadable(Conn* conn)
 {
   if (conn->state == Conn::IDLE) {
-    // the server closed an idle keep-alive connection
-    char probe;
-    if (recv(conn->fd, &probe, 1, MSG_PEEK) <= 0) CloseConn(conn);
+    // Data or EOF on an idle keep-alive connection: either way it is
+    // unusable (a server pushing bytes outside a request desynced it).
+    // Consuming nothing would leave the level-triggered EPOLLIN firing
+    // every tick — a busy-spin — so always close.
+    CloseConn(conn);
     return;
   }
   if (conn->state != Conn::READING && conn->state != Conn::WRITING) return;
@@ -338,13 +353,26 @@ HttpReactor::HandleReadable(Conn* conn)
       pos = eol + 2;
     }
     const auto cl = conn->response.headers.find("content-length");
-    if (cl != conn->response.headers.end())
-      conn->content_length = std::strtoull(cl->second.c_str(), nullptr, 10);
-    else
+    if (cl != conn->response.headers.end()) {
+      char* end = nullptr;
+      errno = 0;
+      const unsigned long long v =
+          std::strtoull(cl->second.c_str(), &end, 10);
+      // reject non-numeric, trailing junk, and absurd sizes (also guards
+      // the body_start + content_length overflow below)
+      if (end == cl->second.c_str() || (end != nullptr && *end != '\0') ||
+          errno == ERANGE || v > (1ull << 40)) {
+        FailConn(conn, "malformed Content-Length: " + cl->second);
+        return;
+      }
+      conn->content_length = static_cast<size_t>(v);
+    } else {
       conn->content_length = 0;  // KServe responses always carry a length
+    }
   }
   const size_t body_start = conn->header_end + 4;
-  if (conn->in.size() >= body_start + conn->content_length) {
+  if (conn->in.size() >= body_start &&
+      conn->in.size() - body_start >= conn->content_length) {
     conn->response.body =
         conn->in.substr(body_start, conn->content_length);
     FinishResponse(conn);

@@ -1,0 +1,58 @@
+// Minimal Node client for the KServe-v2 gRPC service (parity with reference
+// src/grpc_generated/javascript): health + add/sub inference on "simple",
+// protos loaded at runtime from proto/inference.proto.
+const grpc = require("@grpc/grpc-js");
+const protoLoader = require("@grpc/proto-loader");
+const path = require("path");
+
+const url =
+  process.argv.includes("-u")
+    ? process.argv[process.argv.indexOf("-u") + 1]
+    : "localhost:8001";
+
+const definition = protoLoader.loadSync(
+  path.join(__dirname, "../../../proto/inference.proto"),
+  { keepCase: true, longs: Number, defaults: true }
+);
+const inference = grpc.loadPackageDefinition(definition).inference;
+const client = new inference.GRPCInferenceService(
+  url, grpc.credentials.createInsecure()
+);
+
+function int32Bytes(values) {
+  const buf = Buffer.alloc(values.length * 4);
+  values.forEach((v, i) => buf.writeInt32LE(v, i * 4));
+  return buf;
+}
+
+client.ServerLive({}, (err, live) => {
+  if (err || !live.live) {
+    console.error("server not live:", err);
+    process.exit(1);
+  }
+  const input0 = Array.from({ length: 16 }, (_, i) => i);
+  const input1 = Array.from({ length: 16 }, () => 1);
+  const request = {
+    model_name: "simple",
+    inputs: [
+      { name: "INPUT0", datatype: "INT32", shape: [1, 16] },
+      { name: "INPUT1", datatype: "INT32", shape: [1, 16] },
+    ],
+    outputs: [{ name: "OUTPUT0" }, { name: "OUTPUT1" }],
+    raw_input_contents: [int32Bytes(input0), int32Bytes(input1)],
+  };
+  client.ModelInfer(request, (err, response) => {
+    if (err) {
+      console.error("infer failed:", err.message);
+      process.exit(1);
+    }
+    const sum = response.raw_output_contents[0];
+    for (let i = 0; i < 16; i++) {
+      if (sum.readInt32LE(i * 4) !== input0[i] + input1[i]) {
+        console.error("wrong arithmetic at", i);
+        process.exit(1);
+      }
+    }
+    console.log("PASS: js simple infer");
+  });
+});

@@ -1,0 +1,31 @@
+// KServe datatype table (parity with reference pojo/DataType.java and the
+// dtype map in client_tpu/utils/__init__.py).
+package clienttpu;
+
+public enum DataType {
+  BOOL(1),
+  UINT8(1),
+  UINT16(2),
+  UINT32(4),
+  UINT64(8),
+  INT8(1),
+  INT16(2),
+  INT32(4),
+  INT64(8),
+  FP16(2),
+  BF16(2),
+  FP32(4),
+  FP64(8),
+  BYTES(-1);
+
+  private final int byteSize;
+
+  DataType(int byteSize) {
+    this.byteSize = byteSize;
+  }
+
+  /** Element width in bytes; -1 for the variable-length BYTES type. */
+  public int byteSize() {
+    return byteSize;
+  }
+}

@@ -1,0 +1,100 @@
+// One named input tensor (parity with reference
+// src/java/src/main/java/triton/client/InferInput.java): typed setters
+// produce little-endian wire bytes for the binary extension, or a
+// shared-memory reference.
+package clienttpu;
+
+import java.nio.ByteBuffer;
+import java.nio.ByteOrder;
+import java.nio.charset.StandardCharsets;
+import java.util.LinkedHashMap;
+import java.util.Map;
+
+public class InferInput {
+  private final String name;
+  private final long[] shape;
+  private final DataType datatype;
+  private byte[] data;
+  private final Map<String, Object> parameters = new LinkedHashMap<>();
+
+  public InferInput(String name, long[] shape, DataType datatype) {
+    this.name = name;
+    this.shape = shape.clone();
+    this.datatype = datatype;
+  }
+
+  public String getName() {
+    return name;
+  }
+
+  public long[] getShape() {
+    return shape.clone();
+  }
+
+  public DataType getDatatype() {
+    return datatype;
+  }
+
+  byte[] rawData() {
+    return data;
+  }
+
+  Map<String, Object> parameters() {
+    return parameters;
+  }
+
+  public void setData(int[] values) {
+    ByteBuffer buf =
+        ByteBuffer.allocate(values.length * 4).order(ByteOrder.LITTLE_ENDIAN);
+    for (int v : values) buf.putInt(v);
+    this.data = buf.array();
+  }
+
+  public void setData(long[] values) {
+    ByteBuffer buf =
+        ByteBuffer.allocate(values.length * 8).order(ByteOrder.LITTLE_ENDIAN);
+    for (long v : values) buf.putLong(v);
+    this.data = buf.array();
+  }
+
+  public void setData(float[] values) {
+    ByteBuffer buf =
+        ByteBuffer.allocate(values.length * 4).order(ByteOrder.LITTLE_ENDIAN);
+    for (float v : values) buf.putFloat(v);
+    this.data = buf.array();
+  }
+
+  public void setData(double[] values) {
+    ByteBuffer buf =
+        ByteBuffer.allocate(values.length * 8).order(ByteOrder.LITTLE_ENDIAN);
+    for (double v : values) buf.putDouble(v);
+    this.data = buf.array();
+  }
+
+  public void setData(byte[] rawBytes) {
+    this.data = rawBytes.clone();
+  }
+
+  /** BYTES tensors: 4-byte little-endian length prefix per element. */
+  public void setData(String[] values) {
+    int total = 0;
+    byte[][] encoded = new byte[values.length][];
+    for (int i = 0; i < values.length; i++) {
+      encoded[i] = values[i].getBytes(StandardCharsets.UTF_8);
+      total += 4 + encoded[i].length;
+    }
+    ByteBuffer buf = ByteBuffer.allocate(total).order(ByteOrder.LITTLE_ENDIAN);
+    for (byte[] e : encoded) {
+      buf.putInt(e.length);
+      buf.put(e);
+    }
+    this.data = buf.array();
+  }
+
+  public void setSharedMemory(String regionName, long byteSize, long offset) {
+    parameters.put("shared_memory_region", regionName);
+    parameters.put("shared_memory_byte_size", byteSize);
+    if (offset != 0) parameters.put("shared_memory_offset", offset);
+    data = null;
+  }
+}

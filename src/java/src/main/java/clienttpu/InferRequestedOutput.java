@@ -1,0 +1,35 @@
+// One requested output (parity with reference InferRequestedOutput.java).
+package clienttpu;
+
+import java.util.LinkedHashMap;
+import java.util.Map;
+
+public class InferRequestedOutput {
+  private final String name;
+  private final Map<String, Object> parameters = new LinkedHashMap<>();
+
+  public InferRequestedOutput(String name) {
+    this(name, true, 0);
+  }
+
+  public InferRequestedOutput(String name, boolean binaryData, int classCount) {
+    this.name = name;
+    if (binaryData) parameters.put("binary_data", Boolean.TRUE);
+    if (classCount > 0) parameters.put("classification", classCount);
+  }
+
+  public String getName() {
+    return name;
+  }
+
+  Map<String, Object> parameters() {
+    return parameters;
+  }
+
+  public void setSharedMemory(String regionName, long byteSize, long offset) {
+    parameters.remove("binary_data");
+    parameters.put("shared_memory_region", regionName);
+    parameters.put("shared_memory_byte_size", byteSize);
+    if (offset != 0) parameters.put("shared_memory_offset", offset);
+  }
+}

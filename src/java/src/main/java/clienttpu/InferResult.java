@@ -1,0 +1,135 @@
+// Parsed inference response (parity with reference InferResult.java):
+// JSON header + binary section split by Inference-Header-Content-Length.
+package clienttpu;
+
+import java.nio.ByteBuffer;
+import java.nio.ByteOrder;
+import java.nio.charset.StandardCharsets;
+import java.util.ArrayList;
+import java.util.LinkedHashMap;
+import java.util.List;
+import java.util.Map;
+
+public class InferResult {
+  private final Map<String, Object> response;
+  private final Map<String, byte[]> binaryOutputs = new LinkedHashMap<>();
+  private final Map<String, Map<String, Object>> outputsByName =
+      new LinkedHashMap<>();
+
+  @SuppressWarnings("unchecked")
+  InferResult(byte[] body, int headerLength) throws InferenceException {
+    String headerJson =
+        headerLength > 0
+            ? new String(body, 0, headerLength, StandardCharsets.UTF_8)
+            : new String(body, StandardCharsets.UTF_8);
+    this.response = Json.parseObject(headerJson);
+    int cursor = headerLength > 0 ? headerLength : body.length;
+    Object outputs = response.get("outputs");
+    if (outputs instanceof List) {
+      for (Object o : (List<Object>) outputs) {
+        Map<String, Object> out = (Map<String, Object>) o;
+        String name = (String) out.get("name");
+        outputsByName.put(name, out);
+        Object params = out.get("parameters");
+        if (params instanceof Map) {
+          Object size = ((Map<String, Object>) params).get("binary_data_size");
+          if (size instanceof Long) {
+            int n = ((Long) size).intValue();
+            byte[] blob = new byte[n];
+            System.arraycopy(body, cursor, blob, 0, n);
+            binaryOutputs.put(name, blob);
+            cursor += n;
+          }
+        }
+      }
+    }
+  }
+
+  public String getId() {
+    Object id = response.get("id");
+    return id == null ? "" : id.toString();
+  }
+
+  public String getModelName() {
+    Object name = response.get("model_name");
+    return name == null ? "" : name.toString();
+  }
+
+  public Map<String, Object> getResponse() {
+    return response;
+  }
+
+  public long[] getShape(String output) throws InferenceException {
+    Map<String, Object> out = requireOutput(output);
+    @SuppressWarnings("unchecked")
+    List<Object> dims = (List<Object>) out.get("shape");
+    long[] shape = new long[dims.size()];
+    for (int i = 0; i < shape.length; i++) shape[i] = (Long) dims.get(i);
+    return shape;
+  }
+
+  public int[] getOutputAsInt(String output) throws InferenceException {
+    ByteBuffer buf = binaryBuffer(output);
+    int[] values = new int[buf.remaining() / 4];
+    for (int i = 0; i < values.length; i++) values[i] = buf.getInt();
+    return values;
+  }
+
+  public float[] getOutputAsFloat(String output) throws InferenceException {
+    ByteBuffer buf = binaryBuffer(output);
+    float[] values = new float[buf.remaining() / 4];
+    for (int i = 0; i < values.length; i++) values[i] = buf.getFloat();
+    return values;
+  }
+
+  public double[] getOutputAsDouble(String output) throws InferenceException {
+    ByteBuffer buf = binaryBuffer(output);
+    double[] values = new double[buf.remaining() / 8];
+    for (int i = 0; i < values.length; i++) values[i] = buf.getDouble();
+    return values;
+  }
+
+  /** BYTES output: 4-byte little-endian length-prefixed elements. */
+  public String[] getOutputAsString(String output) throws InferenceException {
+    byte[] blob = binaryOutputs.get(output);
+    if (blob != null) {
+      ByteBuffer buf = ByteBuffer.wrap(blob).order(ByteOrder.LITTLE_ENDIAN);
+      List<String> values = new ArrayList<>();
+      while (buf.remaining() >= 4) {
+        int n = buf.getInt();
+        byte[] raw = new byte[n];
+        buf.get(raw);
+        values.add(new String(raw, StandardCharsets.UTF_8));
+      }
+      return values.toArray(new String[0]);
+    }
+    // non-binary JSON payload
+    Map<String, Object> out = requireOutput(output);
+    @SuppressWarnings("unchecked")
+    List<Object> data = (List<Object>) out.get("data");
+    if (data == null) {
+      throw new InferenceException("output '" + output + "' carries no data");
+    }
+    String[] values = new String[data.size()];
+    for (int i = 0; i < values.length; i++) values[i] = String.valueOf(data.get(i));
+    return values;
+  }
+
+  private Map<String, Object> requireOutput(String output)
+      throws InferenceException {
+    Map<String, Object> out = outputsByName.get(output);
+    if (out == null) {
+      throw new InferenceException("unknown output '" + output + "'");
+    }
+    return out;
+  }
+
+  private ByteBuffer binaryBuffer(String output) throws InferenceException {
+    byte[] blob = binaryOutputs.get(output);
+    if (blob == null) {
+      throw new InferenceException(
+          "output '" + output + "' has no binary data");
+    }
+    return ByteBuffer.wrap(blob).order(ByteOrder.LITTLE_ENDIAN);
+  }
+}

@@ -1,0 +1,26 @@
+// Error type carrying the server's HTTP status (parity with reference
+// src/java/src/main/java/triton/client/InferenceException.java).
+package clienttpu;
+
+public class InferenceException extends Exception {
+  private final int status;
+
+  public InferenceException(String message) {
+    this(message, 0);
+  }
+
+  public InferenceException(String message, int status) {
+    super(message);
+    this.status = status;
+  }
+
+  public InferenceException(String message, Throwable cause) {
+    super(message, cause);
+    this.status = 0;
+  }
+
+  /** HTTP status of the failed request, or 0 for client-side failures. */
+  public int getStatus() {
+    return status;
+  }
+}

@@ -1,0 +1,266 @@
+// KServe-v2 HTTP client over java.net.http (parity with reference
+// src/java/src/main/java/triton/client/InferenceServerClient.java:59-221:
+// health, metadata, model control, statistics, shared memory verbs, infer
+// with the binary-tensor extension).
+package clienttpu;
+
+import java.io.IOException;
+import java.net.URI;
+import java.net.http.HttpClient;
+import java.net.http.HttpRequest;
+import java.net.http.HttpResponse;
+import java.nio.charset.StandardCharsets;
+import java.time.Duration;
+import java.util.ArrayList;
+import java.util.LinkedHashMap;
+import java.util.List;
+import java.util.Map;
+
+public class InferenceServerClient implements AutoCloseable {
+  private final String baseUrl;
+  private final HttpClient http;
+  private final Duration requestTimeout;
+
+  public InferenceServerClient(String url) {
+    this(url, Duration.ofSeconds(60), Duration.ofSeconds(60));
+  }
+
+  public InferenceServerClient(
+      String url, Duration connectTimeout, Duration requestTimeout) {
+    String base = url;
+    if (!base.startsWith("http://") && !base.startsWith("https://")) {
+      base = "http://" + base;
+    }
+    if (base.endsWith("/")) base = base.substring(0, base.length() - 1);
+    this.baseUrl = base;
+    this.requestTimeout = requestTimeout;
+    this.http = HttpClient.newBuilder().connectTimeout(connectTimeout).build();
+  }
+
+  @Override
+  public void close() {}
+
+  // ---- health -------------------------------------------------------------
+
+  public boolean isServerLive() throws InferenceException {
+    return get("/v2/health/live").statusCode() == 200;
+  }
+
+  public boolean isServerReady() throws InferenceException {
+    return get("/v2/health/ready").statusCode() == 200;
+  }
+
+  public boolean isModelReady(String modelName) throws InferenceException {
+    return get("/v2/models/" + enc(modelName) + "/ready").statusCode() == 200;
+  }
+
+  // ---- metadata / control -------------------------------------------------
+
+  public Map<String, Object> getServerMetadata() throws InferenceException {
+    return json(get("/v2"));
+  }
+
+  public Map<String, Object> getModelMetadata(String modelName)
+      throws InferenceException {
+    return json(get("/v2/models/" + enc(modelName)));
+  }
+
+  public Map<String, Object> getModelConfig(String modelName)
+      throws InferenceException {
+    return json(get("/v2/models/" + enc(modelName) + "/config"));
+  }
+
+  @SuppressWarnings("unchecked")
+  public List<Object> getModelRepositoryIndex() throws InferenceException {
+    HttpResponse<byte[]> r = post("/v2/repository/index", new byte[0], null);
+    check(r);
+    try {
+      return (List<Object>) Json.parse(
+          new String(r.body(), StandardCharsets.UTF_8));
+    } catch (ClassCastException e) {
+      throw new InferenceException("malformed repository index", e);
+    }
+  }
+
+  public void loadModel(String modelName) throws InferenceException {
+    check(post("/v2/repository/models/" + enc(modelName) + "/load",
+               new byte[0], null));
+  }
+
+  public void unloadModel(String modelName) throws InferenceException {
+    check(post("/v2/repository/models/" + enc(modelName) + "/unload",
+               new byte[0], null));
+  }
+
+  public Map<String, Object> getInferenceStatistics(String modelName)
+      throws InferenceException {
+    String path = modelName.isEmpty()
+        ? "/v2/models/stats"
+        : "/v2/models/" + enc(modelName) + "/stats";
+    return json(get(path));
+  }
+
+  // ---- shared memory ------------------------------------------------------
+
+  public void registerSystemSharedMemory(
+      String name, String key, long byteSize) throws InferenceException {
+    Map<String, Object> body = new LinkedHashMap<>();
+    body.put("key", key);
+    body.put("offset", 0L);
+    body.put("byte_size", byteSize);
+    check(post("/v2/systemsharedmemory/region/" + enc(name) + "/register",
+               Json.write(body).getBytes(StandardCharsets.UTF_8), null));
+  }
+
+  public void unregisterSystemSharedMemory(String name)
+      throws InferenceException {
+    String path = name.isEmpty()
+        ? "/v2/systemsharedmemory/unregister"
+        : "/v2/systemsharedmemory/region/" + enc(name) + "/unregister";
+    check(post(path, new byte[0], null));
+  }
+
+  public Map<String, Object> getSystemSharedMemoryStatus()
+      throws InferenceException {
+    HttpResponse<byte[]> r = get("/v2/systemsharedmemory/status");
+    check(r);
+    Map<String, Object> out = new LinkedHashMap<>();
+    try {
+      Object parsed =
+          Json.parse(new String(r.body(), StandardCharsets.UTF_8));
+      out.put("regions", parsed);
+    } catch (InferenceException e) {
+      throw e;
+    }
+    return out;
+  }
+
+  // ---- inference ----------------------------------------------------------
+
+  public InferResult infer(
+      String modelName, List<InferInput> inputs,
+      List<InferRequestedOutput> outputs) throws InferenceException {
+    return infer(modelName, "", inputs, outputs, "");
+  }
+
+  public InferResult infer(
+      String modelName, String modelVersion, List<InferInput> inputs,
+      List<InferRequestedOutput> outputs, String requestId)
+      throws InferenceException {
+    // JSON header
+    Map<String, Object> header = new LinkedHashMap<>();
+    if (!requestId.isEmpty()) header.put("id", requestId);
+    List<Object> ins = new ArrayList<>();
+    List<byte[]> blobs = new ArrayList<>();
+    for (InferInput input : inputs) {
+      Map<String, Object> entry = new LinkedHashMap<>();
+      entry.put("name", input.getName());
+      entry.put("shape", input.getShape());
+      entry.put("datatype", input.getDatatype().name());
+      Map<String, Object> params = new LinkedHashMap<>(input.parameters());
+      byte[] raw = input.rawData();
+      if (raw != null) {
+        params.put("binary_data_size", (long) raw.length);
+        blobs.add(raw);
+      }
+      if (!params.isEmpty()) entry.put("parameters", params);
+      ins.add(entry);
+    }
+    header.put("inputs", ins);
+    if (outputs != null && !outputs.isEmpty()) {
+      List<Object> outs = new ArrayList<>();
+      for (InferRequestedOutput output : outputs) {
+        Map<String, Object> entry = new LinkedHashMap<>();
+        entry.put("name", output.getName());
+        if (!output.parameters().isEmpty()) {
+          entry.put("parameters", output.parameters());
+        }
+        outs.add(entry);
+      }
+      header.put("outputs", outs);
+    }
+    byte[] headerBytes = Json.write(header).getBytes(StandardCharsets.UTF_8);
+    int total = headerBytes.length;
+    for (byte[] b : blobs) total += b.length;
+    byte[] body = new byte[total];
+    int cursor = headerBytes.length;
+    System.arraycopy(headerBytes, 0, body, 0, headerBytes.length);
+    for (byte[] b : blobs) {
+      System.arraycopy(b, 0, body, cursor, b.length);
+      cursor += b.length;
+    }
+
+    String path = "/v2/models/" + enc(modelName)
+        + (modelVersion.isEmpty() ? "" : "/versions/" + modelVersion)
+        + "/infer";
+    Map<String, String> headers = new LinkedHashMap<>();
+    headers.put("Content-Type", "application/octet-stream");
+    headers.put(
+        "Inference-Header-Content-Length",
+        Integer.toString(headerBytes.length));
+    HttpResponse<byte[]> r = post(path, body, headers);
+    check(r);
+    int respHeaderLen = 0;
+    String lengthHeader =
+        r.headers().firstValue("inference-header-content-length").orElse("");
+    if (!lengthHeader.isEmpty()) {
+      respHeaderLen = Integer.parseInt(lengthHeader);
+    }
+    return new InferResult(r.body(), respHeaderLen);
+  }
+
+  // ---- plumbing -----------------------------------------------------------
+
+  private static String enc(String s) {
+    return java.net.URLEncoder.encode(s, StandardCharsets.UTF_8)
+        .replace("+", "%20");
+  }
+
+  private HttpResponse<byte[]> get(String path) throws InferenceException {
+    try {
+      HttpRequest request = HttpRequest.newBuilder(URI.create(baseUrl + path))
+          .timeout(requestTimeout).GET().build();
+      return http.send(request, HttpResponse.BodyHandlers.ofByteArray());
+    } catch (IOException | InterruptedException e) {
+      throw new InferenceException("GET " + path + " failed: " + e, e);
+    }
+  }
+
+  private HttpResponse<byte[]> post(
+      String path, byte[] body, Map<String, String> headers)
+      throws InferenceException {
+    try {
+      HttpRequest.Builder builder =
+          HttpRequest.newBuilder(URI.create(baseUrl + path))
+              .timeout(requestTimeout)
+              .POST(HttpRequest.BodyPublishers.ofByteArray(body));
+      if (headers != null) {
+        for (Map.Entry<String, String> h : headers.entrySet()) {
+          builder.header(h.getKey(), h.getValue());
+        }
+      }
+      return http.send(builder.build(), HttpResponse.BodyHandlers.ofByteArray());
+    } catch (IOException | InterruptedException e) {
+      throw new InferenceException("POST " + path + " failed: " + e, e);
+    }
+  }
+
+  private Map<String, Object> json(HttpResponse<byte[]> r)
+      throws InferenceException {
+    check(r);
+    return Json.parseObject(new String(r.body(), StandardCharsets.UTF_8));
+  }
+
+  private void check(HttpResponse<byte[]> r) throws InferenceException {
+    if (r.statusCode() == 200) return;
+    String body = new String(r.body(), StandardCharsets.UTF_8);
+    String message = body;
+    try {
+      Object err = Json.parseObject(body).get("error");
+      if (err != null) message = err.toString();
+    } catch (InferenceException ignored) {
+      // non-JSON error body: report it raw
+    }
+    throw new InferenceException(message, r.statusCode());
+  }
+}

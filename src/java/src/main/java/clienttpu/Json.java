@@ -1,0 +1,263 @@
+// Dependency-free JSON writer + minimal parser for the KServe-v2 HTTP
+// protocol (the Java twin of src/cpp/client/json.{h,cc}).  The parser
+// covers exactly the JSON the server emits: objects, arrays, strings with
+// escapes, numbers, booleans, null.
+package clienttpu;
+
+import java.util.ArrayList;
+import java.util.LinkedHashMap;
+import java.util.List;
+import java.util.Map;
+
+public final class Json {
+  private Json() {}
+
+  // ---- writing ------------------------------------------------------------
+
+  public static String escape(String s) {
+    StringBuilder out = new StringBuilder("\"");
+    for (int i = 0; i < s.length(); i++) {
+      char c = s.charAt(i);
+      switch (c) {
+        case '"':
+          out.append("\\\"");
+          break;
+        case '\\':
+          out.append("\\\\");
+          break;
+        case '\n':
+          out.append("\\n");
+          break;
+        case '\r':
+          out.append("\\r");
+          break;
+        case '\t':
+          out.append("\\t");
+          break;
+        default:
+          if (c < 0x20) {
+            out.append(String.format("\\u%04x", (int) c));
+          } else {
+            out.append(c);
+          }
+      }
+    }
+    return out.append('"').toString();
+  }
+
+  public static String write(Object value) {
+    StringBuilder sb = new StringBuilder();
+    writeValue(value, sb);
+    return sb.toString();
+  }
+
+  private static void writeValue(Object value, StringBuilder sb) {
+    if (value == null) {
+      sb.append("null");
+    } else if (value instanceof String) {
+      sb.append(escape((String) value));
+    } else if (value instanceof Map) {
+      sb.append('{');
+      boolean first = true;
+      for (Map.Entry<?, ?> e : ((Map<?, ?>) value).entrySet()) {
+        if (!first) sb.append(',');
+        first = false;
+        sb.append(escape(String.valueOf(e.getKey()))).append(':');
+        writeValue(e.getValue(), sb);
+      }
+      sb.append('}');
+    } else if (value instanceof List) {
+      sb.append('[');
+      boolean first = true;
+      for (Object v : (List<?>) value) {
+        if (!first) sb.append(',');
+        first = false;
+        writeValue(v, sb);
+      }
+      sb.append(']');
+    } else if (value instanceof long[]) {
+      sb.append('[');
+      long[] arr = (long[]) value;
+      for (int i = 0; i < arr.length; i++) {
+        if (i > 0) sb.append(',');
+        sb.append(arr[i]);
+      }
+      sb.append(']');
+    } else {
+      sb.append(value); // Number / Boolean
+    }
+  }
+
+  // ---- parsing ------------------------------------------------------------
+
+  public static Object parse(String text) throws InferenceException {
+    Parser p = new Parser(text);
+    Object v = p.value();
+    p.skipWs();
+    if (!p.done()) throw new InferenceException("trailing JSON content");
+    return v;
+  }
+
+  @SuppressWarnings("unchecked")
+  public static Map<String, Object> parseObject(String text)
+      throws InferenceException {
+    Object v = parse(text);
+    if (!(v instanceof Map)) {
+      throw new InferenceException("expected a JSON object");
+    }
+    return (Map<String, Object>) v;
+  }
+
+  private static final class Parser {
+    private final String s;
+    private int pos = 0;
+
+    Parser(String s) {
+      this.s = s;
+    }
+
+    boolean done() {
+      return pos >= s.length();
+    }
+
+    void skipWs() {
+      while (pos < s.length() && Character.isWhitespace(s.charAt(pos))) pos++;
+    }
+
+    Object value() throws InferenceException {
+      skipWs();
+      if (done()) throw new InferenceException("unexpected end of JSON");
+      char c = s.charAt(pos);
+      switch (c) {
+        case '{':
+          return object();
+        case '[':
+          return array();
+        case '"':
+          return string();
+        case 't':
+          expect("true");
+          return Boolean.TRUE;
+        case 'f':
+          expect("false");
+          return Boolean.FALSE;
+        case 'n':
+          expect("null");
+          return null;
+        default:
+          return number();
+      }
+    }
+
+    private void expect(String word) throws InferenceException {
+      if (!s.startsWith(word, pos)) {
+        throw new InferenceException("malformed JSON literal at " + pos);
+      }
+      pos += word.length();
+    }
+
+    private Map<String, Object> object() throws InferenceException {
+      Map<String, Object> out = new LinkedHashMap<>();
+      pos++; // '{'
+      skipWs();
+      if (!done() && s.charAt(pos) == '}') {
+        pos++;
+        return out;
+      }
+      while (true) {
+        skipWs();
+        String key = string();
+        skipWs();
+        if (done() || s.charAt(pos) != ':') {
+          throw new InferenceException("expected ':' at " + pos);
+        }
+        pos++;
+        out.put(key, value());
+        skipWs();
+        if (done()) throw new InferenceException("unterminated object");
+        char c = s.charAt(pos++);
+        if (c == '}') return out;
+        if (c != ',') throw new InferenceException("expected ',' at " + pos);
+      }
+    }
+
+    private List<Object> array() throws InferenceException {
+      List<Object> out = new ArrayList<>();
+      pos++; // '['
+      skipWs();
+      if (!done() && s.charAt(pos) == ']') {
+        pos++;
+        return out;
+      }
+      while (true) {
+        out.add(value());
+        skipWs();
+        if (done()) throw new InferenceException("unterminated array");
+        char c = s.charAt(pos++);
+        if (c == ']') return out;
+        if (c != ',') throw new InferenceException("expected ',' at " + pos);
+      }
+    }
+
+    private String string() throws InferenceException {
+      if (done() || s.charAt(pos) != '"') {
+        throw new InferenceException("expected string at " + pos);
+      }
+      pos++;
+      StringBuilder out = new StringBuilder();
+      while (pos < s.length()) {
+        char c = s.charAt(pos++);
+        if (c == '"') return out.toString();
+        if (c == '\\') {
+          if (pos >= s.length()) break;
+          char esc = s.charAt(pos++);
+          switch (esc) {
+            case 'n':
+              out.append('\n');
+              break;
+            case 'r':
+              out.append('\r');
+              break;
+            case 't':
+              out.append('\t');
+              break;
+            case 'b':
+              out.append('\b');
+              break;
+            case 'f':
+              out.append('\f');
+              break;
+            case 'u':
+              if (pos + 4 > s.length()) {
+                throw new InferenceException("bad \\u escape");
+              }
+              out.append((char) Integer.parseInt(s.substring(pos, pos + 4), 16));
+              pos += 4;
+              break;
+            default:
+              out.append(esc); // covers \" \\ \/
+          }
+        } else {
+          out.append(c);
+        }
+      }
+      throw new InferenceException("unterminated string");
+    }
+
+    private Object number() throws InferenceException {
+      int start = pos;
+      while (pos < s.length() && "+-0123456789.eE".indexOf(s.charAt(pos)) >= 0) {
+        pos++;
+      }
+      String token = s.substring(start, pos);
+      try {
+        if (token.contains(".") || token.contains("e") || token.contains("E")) {
+          return Double.parseDouble(token);
+        }
+        return Long.parseLong(token);
+      } catch (NumberFormatException e) {
+        throw new InferenceException("malformed number '" + token + "'");
+      }
+    }
+  }
+}

@@ -1,0 +1,45 @@
+// Smoke main for the Java client — the add/sub example every other client
+// language ships (reference src/java/.../examples/SimpleInferClient.java).
+//   java -cp build clienttpu.SimpleInferClient http://localhost:8000
+package clienttpu;
+
+import java.util.Arrays;
+import java.util.List;
+
+public final class SimpleInferClient {
+  public static void main(String[] args) throws Exception {
+    String url = args.length > 0 ? args[0] : "http://localhost:8000";
+    try (InferenceServerClient client = new InferenceServerClient(url)) {
+      if (!client.isServerLive()) {
+        System.err.println("error: server not live");
+        System.exit(1);
+      }
+      int[] input0 = new int[16];
+      int[] input1 = new int[16];
+      for (int i = 0; i < 16; i++) {
+        input0[i] = i;
+        input1[i] = 1;
+      }
+      InferInput in0 = new InferInput("INPUT0", new long[] {1, 16}, DataType.INT32);
+      InferInput in1 = new InferInput("INPUT1", new long[] {1, 16}, DataType.INT32);
+      in0.setData(input0);
+      in1.setData(input1);
+      List<InferRequestedOutput> outputs = Arrays.asList(
+          new InferRequestedOutput("OUTPUT0"),
+          new InferRequestedOutput("OUTPUT1"));
+      InferResult result =
+          client.infer("simple", Arrays.asList(in0, in1), outputs);
+      int[] sum = result.getOutputAsInt("OUTPUT0");
+      int[] diff = result.getOutputAsInt("OUTPUT1");
+      for (int i = 0; i < 16; i++) {
+        System.out.printf("%d + %d = %d, %d - %d = %d%n", input0[i], input1[i],
+                          sum[i], input0[i], input1[i], diff[i]);
+        if (sum[i] != input0[i] + input1[i] || diff[i] != input0[i] - input1[i]) {
+          System.err.println("error: wrong arithmetic");
+          System.exit(1);
+        }
+      }
+      System.out.println("PASS: java simple infer");
+    }
+  }
+}
