@@ -4,14 +4,18 @@ The reference client stack has no model parallelism (SURVEY.md §2.4 note) —
 sharding is a *server-side* concern there.  In this framework the server side
 is in-repo (client_tpu.serve), so the parallelism layer is first-class:
 
-- :func:`make_mesh` — build a ``jax.sharding.Mesh`` over ``dp``/``tp``/``sp``
-  axes (data / tensor / sequence-context parallel) from whatever devices exist.
+- :func:`make_mesh` — build a ``jax.sharding.Mesh`` over the five axes
+  ``dp``/``tp``/``sp``/``ep``/``pp`` (data / tensor / sequence-context /
+  expert / pipeline parallel) from whatever devices exist.
 - :mod:`client_tpu.parallel.ring_attention` — causal ring attention over the
   ``sp`` axis (blockwise flash accumulation + ``ppermute`` KV rotation) so
   long sequences shard across chips with KV traffic riding ICI.
+- :mod:`client_tpu.parallel.pipeline` — GPipe pipeline parallelism over
+  ``pp`` (shard_map'd ``lax.scan`` schedule with ``ppermute`` handoffs).
 - Param/activation PartitionSpec builders used by the transformer model family
-  (Megatron-style tensor parallel layout: attention sharded over heads, MLP
-  over the hidden dimension, embedding over vocab).
+  (Megatron-style tensor parallel layout: attention sharded over heads, dense
+  MLP over the hidden dimension, embedding over vocab; for MoE configs the
+  expert dim shards over ``ep`` with each expert's hidden dim over ``tp``).
 
 Everything here is pure ``jax.sharding`` + collectives: XLA inserts the
 all-gathers/reduce-scatters; nothing is hand-scheduled.
@@ -25,25 +29,32 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from client_tpu.parallel.ring_attention import ring_attention  # noqa: F401
 
 
-def make_mesh(devices=None, dp=None, tp=None, sp=None):
-    """Build a ("dp","tp","sp") Mesh over ``devices``.
+def make_mesh(devices=None, dp=None, tp=None, sp=None, ep=None, pp=None):
+    """Build a ("dp","tp","sp","ep","pp") Mesh over ``devices``.
 
-    Unspecified axis sizes are inferred: tp and sp default to 1, dp absorbs
-    the remaining devices.  The product must equal the device count.
+    Axes: data / tensor / sequence(context) / expert / pipeline parallel.
+    Unspecified axis sizes default to 1 (dp absorbs the remaining devices),
+    so existing dp/tp/sp meshes are unchanged — the extra size-1 axes
+    replicate trivially.  The product must equal the device count.
     """
     if devices is None:
         devices = jax.devices()
     n = len(devices)
     tp = 1 if tp is None else tp
     sp = 1 if sp is None else sp
+    ep = 1 if ep is None else ep
+    pp = 1 if pp is None else pp
+    denom = tp * sp * ep * pp
     if dp is None:
-        if n % (tp * sp):
-            raise ValueError(f"{n} devices not divisible by tp*sp={tp * sp}")
-        dp = n // (tp * sp)
-    if dp * tp * sp != n:
-        raise ValueError(f"dp*tp*sp={dp * tp * sp} != {n} devices")
-    dev_array = np.asarray(devices).reshape(dp, tp, sp)
-    return Mesh(dev_array, ("dp", "tp", "sp"))
+        if n % denom:
+            raise ValueError(
+                f"{n} devices not divisible by tp*sp*ep*pp={denom}"
+            )
+        dp = n // denom
+    if dp * denom != n:
+        raise ValueError(f"dp*tp*sp*ep*pp={dp * denom} != {n} devices")
+    dev_array = np.asarray(devices).reshape(dp, tp, sp, ep, pp)
+    return Mesh(dev_array, ("dp", "tp", "sp", "ep", "pp"))
 
 
 def batch_spec():
@@ -70,14 +81,24 @@ def param_specs(cfg):
             "wv": P(None, "tp"),
             "wo": P("tp", None),
         },
-        "mlp": {
-            "w_gate": P(None, "tp"),
-            "w_up": P(None, "tp"),
-            "w_down": P("tp", None),
-        },
         "ln_attn": P(None),
         "ln_mlp": P(None),
     }
+    if getattr(cfg, "n_experts", 0) > 0:
+        # expert-parallel MoE: the expert dim shards over ep, each expert's
+        # hidden dim over tp; the router is replicated (every device routes)
+        layer["moe"] = {
+            "router": P(None, None),
+            "w_gate": P("ep", None, "tp"),
+            "w_up": P("ep", None, "tp"),
+            "w_down": P("ep", "tp", None),
+        }
+    else:
+        layer["mlp"] = {
+            "w_gate": P(None, "tp"),
+            "w_up": P(None, "tp"),
+            "w_down": P("tp", None),
+        }
     return {
         "embed": P("tp", None),
         "layers": [layer for _ in range(cfg.n_layers)],

@@ -49,6 +49,13 @@ class TransformerConfig:
     max_seq: int = 1024
     rope_theta: float = 10000.0
     dtype: str = "bfloat16"
+    # n_experts > 0 switches the FFN to a top-k-routed mixture of experts
+    # (expert-parallel over the mesh's "ep" axis — parallel.param_specs)
+    n_experts: int = 0
+    top_k: int = 2
+    # Switch-style load-balance aux loss coefficient (loss_fn adds it for
+    # MoE configs; without it the router collapses onto few experts)
+    router_aux_coef: float = 0.01
 
     @property
     def head_dim(self):
@@ -62,7 +69,7 @@ class TransformerConfig:
 def init_params(key, cfg):
     """Initialize a params pytree (layout documented in parallel.param_specs)."""
     dt = cfg.jdtype
-    n_keys = 3 + cfg.n_layers * 7
+    n_keys = 3 + cfg.n_layers * 8
     keys = iter(jax.random.split(key, n_keys))
 
     def dense(shape, fan_in):
@@ -71,23 +78,31 @@ def init_params(key, cfg):
     hd = cfg.head_dim
     layers = []
     for _ in range(cfg.n_layers):
-        layers.append(
-            {
-                "attn": {
-                    "wq": dense((cfg.d_model, cfg.n_heads * hd), cfg.d_model),
-                    "wk": dense((cfg.d_model, cfg.n_kv_heads * hd), cfg.d_model),
-                    "wv": dense((cfg.d_model, cfg.n_kv_heads * hd), cfg.d_model),
-                    "wo": dense((cfg.n_heads * hd, cfg.d_model), cfg.n_heads * hd),
-                },
-                "mlp": {
-                    "w_gate": dense((cfg.d_model, cfg.d_ff), cfg.d_model),
-                    "w_up": dense((cfg.d_model, cfg.d_ff), cfg.d_model),
-                    "w_down": dense((cfg.d_ff, cfg.d_model), cfg.d_ff),
-                },
-                "ln_attn": jnp.ones((cfg.d_model,), dt),
-                "ln_mlp": jnp.ones((cfg.d_model,), dt),
+        entry = {
+            "attn": {
+                "wq": dense((cfg.d_model, cfg.n_heads * hd), cfg.d_model),
+                "wk": dense((cfg.d_model, cfg.n_kv_heads * hd), cfg.d_model),
+                "wv": dense((cfg.d_model, cfg.n_kv_heads * hd), cfg.d_model),
+                "wo": dense((cfg.n_heads * hd, cfg.d_model), cfg.n_heads * hd),
+            },
+            "ln_attn": jnp.ones((cfg.d_model,), dt),
+            "ln_mlp": jnp.ones((cfg.d_model,), dt),
+        }
+        if cfg.n_experts > 0:
+            e = cfg.n_experts
+            entry["moe"] = {
+                "router": dense((cfg.d_model, e), cfg.d_model),
+                "w_gate": dense((e, cfg.d_model, cfg.d_ff), cfg.d_model),
+                "w_up": dense((e, cfg.d_model, cfg.d_ff), cfg.d_model),
+                "w_down": dense((e, cfg.d_ff, cfg.d_model), cfg.d_ff),
             }
-        )
+        else:
+            entry["mlp"] = {
+                "w_gate": dense((cfg.d_model, cfg.d_ff), cfg.d_model),
+                "w_up": dense((cfg.d_model, cfg.d_ff), cfg.d_model),
+                "w_down": dense((cfg.d_ff, cfg.d_model), cfg.d_ff),
+            }
+        layers.append(entry)
     return {
         "embed": dense((cfg.vocab_size, cfg.d_model), cfg.d_model),
         "layers": layers,
@@ -155,8 +170,64 @@ def _mlp_block(layer, x):
     return x + (gate * up) @ layer["mlp"]["w_down"]
 
 
-def forward(params, tokens, cfg, mesh=None, attn_impl="plain"):
-    """Full-sequence causal LM: tokens [B,T] int32 → logits [B,T,V] f32."""
+def _moe_block(layer, x, cfg):
+    """Top-k-routed mixture-of-experts FFN, expert-parallel over ``ep``.
+
+    Dense formulation: every expert computes on every token (stacked-weight
+    einsums with the expert dim sharded over ep — each device runs its local
+    experts on the MXU) and the router's top-k weights zero out unselected
+    experts in the combine; the contraction over experts becomes a psum over
+    ep inserted by GSPMD.  Compiler-friendly (static shapes, no gather/sort
+    dispatch) and exact; capacity-based sparse dispatch is the big-scale
+    optimization this trades away.
+    """
+    moe = layer["moe"]
+    h = _rms_norm(x, layer["ln_mlp"])
+    logits = (
+        h.astype(jnp.float32) @ moe["router"].astype(jnp.float32)
+    )  # [B,T,E]
+    top_w, top_idx = lax.top_k(logits, cfg.top_k)
+    top_w = jax.nn.softmax(top_w, axis=-1)  # renormalize over the selected k
+    combine = jnp.sum(
+        jax.nn.one_hot(top_idx, cfg.n_experts, dtype=jnp.float32)
+        * top_w[..., None],
+        axis=-2,
+    )  # [B,T,E]
+    g = jnp.einsum("btd,edf->ebtf", h, moe["w_gate"])
+    u = jnp.einsum("btd,edf->ebtf", h, moe["w_up"])
+    expert_out = jnp.einsum(
+        "ebtf,efd->ebtd", jax.nn.silu(g) * u, moe["w_down"]
+    )  # [E,B,T,D]
+    out = jnp.einsum(
+        "ebtd,bte->btd",
+        expert_out.astype(jnp.float32),
+        combine,
+        preferred_element_type=jnp.float32,
+    ).astype(x.dtype)
+    # Switch-transformer load-balance loss: E * Σ_e (token fraction routed
+    # to e) * (mean router prob of e); minimized (=1) at uniform routing
+    probs = jax.nn.softmax(logits, axis=-1)
+    frac = jnp.mean(
+        jax.nn.one_hot(top_idx, cfg.n_experts, dtype=jnp.float32), axis=(0, 1, 2)
+    )  # per-expert routed fraction over B*T*K; uniform router → 1/E each
+    aux = cfg.n_experts * jnp.sum(frac * jnp.mean(probs, axis=(0, 1)))
+    return x + out, aux
+
+
+def _ffn_block(layer, x, cfg):
+    """FFN (dense or MoE) → (residual output, router aux loss or 0)."""
+    if "moe" in layer:
+        return _moe_block(layer, x, cfg)
+    return _mlp_block(layer, x), jnp.float32(0.0)
+
+
+def forward(params, tokens, cfg, mesh=None, attn_impl="plain",
+            with_aux=False):
+    """Full-sequence causal LM: tokens [B,T] int32 → logits [B,T,V] f32.
+
+    With ``with_aux=True`` returns ``(logits, aux)`` where aux is the mean
+    per-layer router load-balance loss (0 for dense configs).
+    """
     b, t = tokens.shape
     x = jnp.take(params["embed"], tokens, axis=0)
     if mesh is not None:
@@ -164,15 +235,19 @@ def forward(params, tokens, cfg, mesh=None, attn_impl="plain"):
             x, NamedSharding(mesh, P("dp", "sp", None))
         )
     positions = jnp.arange(t)
+    aux_total = jnp.float32(0.0)
     for layer in params["layers"]:
         x, _ = _attention_block(layer, x, cfg, positions, mesh, attn_impl)
-        x = _mlp_block(layer, x)
+        x, aux = _ffn_block(layer, x, cfg)
+        aux_total = aux_total + aux
     x = _rms_norm(x, params["ln_f"])
     logits = (x @ params["lm_head"]).astype(jnp.float32)
     if mesh is not None:
         logits = lax.with_sharding_constraint(
             logits, NamedSharding(mesh, P("dp", "sp", "tp"))
         )
+    if with_aux:
+        return logits, aux_total / len(params["layers"])
     return logits
 
 
@@ -202,7 +277,7 @@ def prefill(params, tokens, cfg, cache):
         cache["v"][i] = lax.dynamic_update_slice(
             cache["v"][i], v, (0, 0, 0, 0)
         )
-        x = _mlp_block(layer, x)
+        x, _ = _ffn_block(layer, x, cfg)
     x = _rms_norm(x, params["ln_f"])
     logits = (x[:, -1] @ params["lm_head"]).astype(jnp.float32)
     cache["len"] = jnp.full((b,), t, jnp.int32)
@@ -242,39 +317,110 @@ def decode_step(params, token, cfg, cache):
         attn = jnp.einsum("bhqk,bkhd->bqhd", p.astype(vv.dtype), vv)
         out = attn.reshape(b, 1, cfg.n_heads * hd) @ layer["attn"]["wo"]
         x = x + out.astype(x.dtype)
-        x = _mlp_block(layer, x)
+        x, _ = _ffn_block(layer, x, cfg)
     x = _rms_norm(x, params["ln_f"])
     logits = (x[:, 0] @ params["lm_head"]).astype(jnp.float32)
     cache["len"] = pos + 1
     return logits, cache
 
 
-def loss_fn(params, tokens, cfg, mesh=None, attn_impl="plain"):
-    """Next-token cross-entropy over tokens [B,T]."""
-    logits = forward(params, tokens[:, :-1], cfg, mesh, attn_impl)
-    targets = tokens[:, 1:]
+def _next_token_nll(logits, targets):
+    """Mean next-token cross-entropy: logits [B,T,V] f32, targets [B,T]."""
     logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
-    return jnp.mean(nll)
+    return jnp.mean(-jnp.take_along_axis(logp, targets[..., None], axis=-1))
+
+
+def loss_fn(params, tokens, cfg, mesh=None, attn_impl="plain"):
+    """Next-token cross-entropy over tokens [B,T] (+ router aux for MoE)."""
+    logits, aux = forward(
+        params, tokens[:, :-1], cfg, mesh, attn_impl, with_aux=True
+    )
+    loss = _next_token_nll(logits, tokens[:, 1:])
+    if cfg.n_experts > 0:
+        loss = loss + cfg.router_aux_coef * aux
+    return loss
+
+
+def _make_adam_step(loss, learning_rate):
+    """Shared Adam scaffolding: (loss(params, tokens) -> scalar) → jitted
+    ``step(params, opt_state, tokens) -> (params, opt_state, loss)``."""
+    import optax
+
+    opt = optax.adam(learning_rate)
+
+    def step(params, opt_state, tokens):
+        value, grads = jax.value_and_grad(loss)(params, tokens)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, value
+
+    return opt, jax.jit(step, donate_argnums=(0, 1))
 
 
 def make_train_step(cfg, mesh=None, attn_impl="plain", learning_rate=1e-3):
     """Jitted Adam train step.  With a mesh, callers should device_put params
     per ``parallel.param_specs`` and the batch per ``parallel.batch_spec``;
     GSPMD propagates those shardings through grads and optimizer state."""
-    import optax
+    return _make_adam_step(
+        lambda params, tokens: loss_fn(params, tokens, cfg, mesh, attn_impl),
+        learning_rate,
+    )
 
-    opt = optax.adam(learning_rate)
 
-    def step(params, opt_state, tokens):
-        loss, grads = jax.value_and_grad(loss_fn)(
-            params, tokens, cfg, mesh, attn_impl
+def stack_pipeline_params(params, n_stages):
+    """Re-lay the per-layer list as pipeline stages (parallel.pipeline)."""
+    from client_tpu.parallel.pipeline import stack_stage_params
+
+    return {
+        "embed": params["embed"],
+        "stages": stack_stage_params(params["layers"], n_stages),
+        "ln_f": params["ln_f"],
+        "lm_head": params["lm_head"],
+    }
+
+
+def forward_pipelined(pparams, tokens, cfg, mesh, n_microbatches):
+    """Full-sequence logits with the layer stack pipelined over ``pp``.
+
+    Embedding and LM head run outside the pipeline region (replicated);
+    each stage scans its local layer block over the incoming microbatch,
+    whose batch dim shards over ``dp`` (parallel.pipeline batch_axis).
+    """
+    from client_tpu.parallel.pipeline import pipeline_apply
+
+    b, t = tokens.shape
+    x = jnp.take(pparams["embed"], tokens, axis=0)
+    positions = jnp.arange(t)
+
+    def stage_fn(stage_layers, h):
+        def layer_step(hh, layer):
+            hh, _ = _attention_block(layer, hh, cfg, positions, None, "plain")
+            hh, _ = _ffn_block(layer, hh, cfg)
+            return hh, None
+
+        h, _ = lax.scan(layer_step, h, stage_layers)
+        return h
+
+    x = pipeline_apply(stage_fn, pparams["stages"], x, mesh, n_microbatches)
+    x = _rms_norm(x, pparams["ln_f"])
+    return (x @ pparams["lm_head"]).astype(jnp.float32)
+
+
+def make_pipeline_train_step(cfg, mesh, n_microbatches, learning_rate=1e-3):
+    """Jitted Adam train step over pipeline-stacked params: gradients flow
+    back through the scan + ppermute schedule (reverse ppermute).  Pipeline
+    composes with data parallelism (the microbatch shards over ``dp``
+    inside the region — parallel.pipeline); stage weights are replicated
+    over tp/ep within the region, and MoE router aux loss is not collected
+    on this path."""
+
+    def loss(pparams, tokens):
+        logits = forward_pipelined(
+            pparams, tokens[:, :-1], cfg, mesh, n_microbatches
         )
-        updates, opt_state = opt.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return params, opt_state, loss
+        return _next_token_nll(logits, tokens[:, 1:])
 
-    return opt, jax.jit(step, donate_argnums=(0, 1))
+    return _make_adam_step(loss, learning_rate)
 
 
 @functools.lru_cache(maxsize=8)
