@@ -108,6 +108,29 @@ def build_parser():
                    help="metrics endpoint (default: http://<url>/metrics)")
     p.add_argument("--metrics-interval", type=float, default=1000.0,
                    help="scrape interval in msec")
+    # SSL/TLS (reference command_line_parser.h SSL option block; names match)
+    p.add_argument("--ssl-grpc-use-ssl", action="store_true",
+                   help="use an SSL-encrypted gRPC channel")
+    p.add_argument("--ssl-grpc-root-certifications-file", default=None)
+    p.add_argument("--ssl-grpc-private-key-file", default=None)
+    p.add_argument("--ssl-grpc-certificate-chain-file", default=None)
+    p.add_argument("--ssl-https-verify-peer", type=int, choices=[0, 1],
+                   default=1, help="0 disables server-cert verification")
+    p.add_argument("--ssl-https-ca-certificates-file", default=None,
+                   help="also switches the HTTP client to https://")
+    p.add_argument("--ssl-https-client-certificate-file", default=None)
+    p.add_argument("--ssl-https-private-key-file", default=None)
+    # trace control plane: pushed to the server before profiling (reference
+    # command_line_parser.h trace options → TraceSetting RPC)
+    p.add_argument("--trace-level", action="append", default=None,
+                   choices=["OFF", "TIMESTAMPS", "TENSORS"],
+                   help="may repeat; OFF clears")
+    p.add_argument("--trace-rate", type=int, default=None,
+                   help="trace 1 of every N requests")
+    p.add_argument("--trace-count", type=int, default=None,
+                   help="stop tracing after N traces (-1 = unlimited)")
+    p.add_argument("--log-frequency", type=int, default=None,
+                   help="flush the trace log every N traces")
     p.add_argument("--world-size", type=int, default=1,
                    help="number of coordinated perf ranks (MPI-mode analog)")
     p.add_argument("--rank", type=int, default=0)
@@ -166,14 +189,51 @@ def main(argv=None):
             else BackendKind.TRITON_HTTP
         )
 
+    ssl_options = None
+    if args.protocol == "grpc" and args.ssl_grpc_use_ssl:
+        ssl_options = {
+            "use_ssl": True,
+            "root_certificates": args.ssl_grpc_root_certifications_file,
+            "private_key": args.ssl_grpc_private_key_file,
+            "certificate_chain": args.ssl_grpc_certificate_chain_file,
+        }
+    elif args.protocol == "http" and (
+        args.ssl_https_ca_certificates_file
+        or args.ssl_https_client_certificate_file
+        or not args.ssl_https_verify_peer
+    ):
+        ssl_options = {
+            "use_ssl": True,
+            "verify_peer": bool(args.ssl_https_verify_peer),
+            "ca_certificates_file": args.ssl_https_ca_certificates_file,
+            "client_certificate_file": args.ssl_https_client_certificate_file,
+            "private_key_file": args.ssl_https_private_key_file,
+        }
+
     def backend_factory():
         return ClientBackendFactory.create(
             kind, url=args.url, engine=engine, verbose=False,
-            **backend_kwargs
+            ssl_options=ssl_options, **backend_kwargs
         )
 
     control = backend_factory()
     try:
+        trace_settings = {}
+        if args.trace_level is not None:
+            trace_settings["trace_level"] = args.trace_level
+        if args.trace_rate is not None:
+            trace_settings["trace_rate"] = str(args.trace_rate)
+        if args.trace_count is not None:
+            trace_settings["trace_count"] = str(args.trace_count)
+        if args.log_frequency is not None:
+            trace_settings["log_frequency"] = str(args.log_frequency)
+        if trace_settings:
+            control.update_trace_settings(
+                model_name=args.model_name, settings=trace_settings
+            )
+            if args.verbose:
+                print(f"trace settings applied: {trace_settings}",
+                      file=sys.stderr)
         parser_obj = ModelParser.create(
             control, args.model_name, args.model_version,
             batch_size=args.batch_size,

@@ -63,6 +63,13 @@ class ClientBackend:
         """Server utilization metrics snapshot (TPU duty/HBM when exposed)."""
         return {}
 
+    def update_trace_settings(self, model_name="", settings=None):
+        """Push trace settings to the server (KServe trace extension);
+        non-Triton protocol families have no trace control plane."""
+        raise InferenceServerException(
+            f"trace settings not supported by the '{self.kind}' backend"
+        )
+
     def register_system_shared_memory(self, name, key, byte_size):
         raise NotImplementedError
 
@@ -80,11 +87,12 @@ class ClientBackendFactory:
     """Create backends by kind+url (client_backend.h:250-307 analog)."""
 
     @staticmethod
-    def create(kind, url=None, engine=None, verbose=False, **kwargs):
+    def create(kind, url=None, engine=None, verbose=False, ssl_options=None,
+               **kwargs):
         if kind == BackendKind.TRITON_GRPC:
-            return _GrpcBackend(url, verbose)
+            return _GrpcBackend(url, verbose, ssl_options=ssl_options)
         if kind == BackendKind.TRITON_HTTP:
-            return _HttpBackend(url, verbose)
+            return _HttpBackend(url, verbose, ssl_options=ssl_options)
         if kind == BackendKind.INPROCESS:
             if engine is None:
                 raise InferenceServerException(
@@ -103,11 +111,19 @@ class ClientBackendFactory:
 class _GrpcBackend(ClientBackend):
     kind = BackendKind.TRITON_GRPC
 
-    def __init__(self, url, verbose=False):
+    def __init__(self, url, verbose=False, ssl_options=None):
         import client_tpu.grpc as grpcclient
 
+        opts = ssl_options or {}
         self._mod = grpcclient
-        self._client = grpcclient.InferenceServerClient(url, verbose=verbose)
+        self._client = grpcclient.InferenceServerClient(
+            url,
+            verbose=verbose,
+            ssl=opts.get("use_ssl", False),
+            root_certificates=opts.get("root_certificates"),
+            private_key=opts.get("private_key"),
+            certificate_chain=opts.get("certificate_chain"),
+        )
 
     def model_metadata(self, model_name, model_version=""):
         return self._client.get_model_metadata(
@@ -139,6 +155,11 @@ class _GrpcBackend(ClientBackend):
             model_name, model_version, as_json=True
         )
 
+    def update_trace_settings(self, model_name="", settings=None):
+        return self._client.update_trace_settings(
+            model_name=model_name, settings=settings or {}, as_json=True
+        )
+
     def register_system_shared_memory(self, name, key, byte_size):
         self._client.register_system_shared_memory(name, key, byte_size)
 
@@ -166,11 +187,43 @@ class _GrpcBackend(ClientBackend):
 class _HttpBackend(_GrpcBackend):
     kind = BackendKind.TRITON_HTTP
 
-    def __init__(self, url, verbose=False):
+    def __init__(self, url, verbose=False, ssl_options=None):
         import client_tpu.http as httpclient
 
+        opts = ssl_options or {}
+        ctx = None
+        if opts.get("use_ssl") and (
+            opts.get("ca_certificates_file")
+            or opts.get("client_certificate_file")
+        ):
+            import ssl as _ssl
+
+            ctx = _ssl.create_default_context(
+                cafile=opts.get("ca_certificates_file")
+            )
+            if opts.get("client_certificate_file"):
+                ctx.load_cert_chain(
+                    opts["client_certificate_file"],
+                    keyfile=opts.get("private_key_file"),
+                )
+            if not opts.get("verify_peer", True):
+                # urllib3 would otherwise set CERT_NONE on a verifying
+                # context and raise (check_hostname conflicts)
+                ctx.check_hostname = False
+                ctx.verify_mode = _ssl.CERT_NONE
         self._mod = httpclient
-        self._client = httpclient.InferenceServerClient(url, verbose=verbose)
+        self._client = httpclient.InferenceServerClient(
+            url,
+            verbose=verbose,
+            ssl=opts.get("use_ssl", False),
+            ssl_context=ctx,
+            insecure=not opts.get("verify_peer", True),
+        )
+
+    def update_trace_settings(self, model_name="", settings=None):
+        return self._client.update_trace_settings(
+            model_name=model_name, settings=settings or {}
+        )
 
     # the HTTP client returns parsed JSON natively (no as_json kwarg); its
     # `timeout` is the KServe per-request server-side timeout in MICROSECONDS
@@ -248,6 +301,12 @@ class _InprocessBackend(ClientBackend):
 
         self._mod = grpcclient
         self._engine = engine
+
+    def update_trace_settings(self, model_name="", settings=None):
+        self._engine.trace_settings.update(
+            {k: v for k, v in (settings or {}).items() if v is not None}
+        )
+        return dict(self._engine.trace_settings)
 
     def model_metadata(self, model_name, model_version=""):
         return self._engine.get_model(model_name, model_version).metadata()

@@ -37,12 +37,24 @@ struct InferOptions {
   std::string model_name;
   std::string model_version;
   std::string request_id;
+  // Correlation id for stateful sequences: numeric or string form
+  // (reference common.h supports both; a non-empty sequence_id_str wins).
   uint64_t sequence_id = 0;
+  std::string sequence_id_str;
   bool sequence_start = false;
   bool sequence_end = false;
   uint64_t priority = 0;
   uint64_t timeout_us = 0;       // server-side request timeout
   uint64_t client_timeout_us = 0;  // client-side socket deadline
+};
+
+// Per-client aggregate of request timers (reference common.h:94-115
+// InferStat); both protocol clients expose it via ClientInferStat.
+struct InferStat {
+  uint64_t completed_request_count = 0;
+  uint64_t cumulative_total_request_time_ns = 0;
+  uint64_t cumulative_send_time_ns = 0;
+  uint64_t cumulative_receive_time_ns = 0;
 };
 
 // One named input tensor.  AppendRaw keeps caller-owned buffer pointers (the

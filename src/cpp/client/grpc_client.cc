@@ -190,6 +190,26 @@ InferenceServerGrpcClient::InferenceServerGrpcClient(
 {
 }
 
+Error
+InferenceServerGrpcClient::Create(
+    std::unique_ptr<InferenceServerGrpcClient>* client, const std::string& url,
+    const GrpcSslOptions& ssl_options, bool verbose)
+{
+  (void)ssl_options;
+  (void)verbose;
+  client->reset();
+#ifdef CLIENT_TPU_ENABLE_TLS
+  return Error(
+      "CLIENT_TPU_ENABLE_TLS is defined but no TLS transport is linked in "
+      "this build");
+#else
+  return Error(
+      "TLS support is not compiled in: this toolchain ships no OpenSSL "
+      "headers; rebuild with -DCLIENT_TPU_ENABLE_TLS against an "
+      "OpenSSL-equipped toolchain, or terminate TLS in a local proxy");
+#endif
+}
+
 InferenceServerGrpcClient::~InferenceServerGrpcClient()
 {
   StopStream();
@@ -464,7 +484,11 @@ InferenceServerGrpcClient::BuildInferRequest(
   request->set_model_version(options.model_version);
   request->set_id(options.request_id);
   auto* params = request->mutable_parameters();
-  if (options.sequence_id != 0) {
+  if (!options.sequence_id_str.empty()) {
+    SetParam(params, "sequence_id", options.sequence_id_str);
+    SetParam(params, "sequence_start", options.sequence_start);
+    SetParam(params, "sequence_end", options.sequence_end);
+  } else if (options.sequence_id != 0) {
     SetParam(params, "sequence_id",
              static_cast<int64_t>(options.sequence_id));
     SetParam(params, "sequence_start", options.sequence_start);

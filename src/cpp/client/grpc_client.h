@@ -28,6 +28,15 @@ namespace h2 {
 class H2Connection;
 }
 
+// Channel TLS options (reference grpc_client.cc:119-129 SSL credentials).
+// Declared for API parity; the TLS Create overload is gated exactly like
+// HttpSslOptions (no OpenSSL headers in this toolchain).
+struct GrpcSslOptions {
+  std::string root_certificates;  // PEM path
+  std::string private_key;        // PEM path
+  std::string certificate_chain;  // PEM path
+};
+
 class InferenceServerGrpcClient {
  public:
   using OnCompleteFn = std::function<void(InferResultPtr)>;
@@ -36,6 +45,11 @@ class InferenceServerGrpcClient {
   static Error Create(
       std::unique_ptr<InferenceServerGrpcClient>* client,
       const std::string& url, bool verbose = false);
+  // TLS channel variant; see GrpcSslOptions for the gating note.
+  static Error Create(
+      std::unique_ptr<InferenceServerGrpcClient>* client,
+      const std::string& url, const GrpcSslOptions& ssl_options,
+      bool verbose = false);
   ~InferenceServerGrpcClient();
 
   // -- server / model management (grpc_client.h:118-259) -------------------
@@ -100,13 +114,9 @@ class InferenceServerGrpcClient {
       const std::vector<const InferRequestedOutput*>& outputs = {});
   Error StopStream();
 
-  // Per-client aggregate of request timers (reference InferStat).
-  struct InferStat {
-    uint64_t completed_request_count = 0;
-    uint64_t cumulative_total_request_time_ns = 0;
-    uint64_t cumulative_send_time_ns = 0;
-    uint64_t cumulative_receive_time_ns = 0;
-  };
+  // Per-client aggregate of request timers (ctpu::InferStat in common.h;
+  // the nested name is kept for source compatibility).
+  using InferStat = ctpu::InferStat;
   Error ClientInferStat(InferStat* stat);
 
  private:

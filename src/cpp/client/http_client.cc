@@ -57,6 +57,28 @@ InferenceServerHttpClient::Create(
   return Error::Success();
 }
 
+Error
+InferenceServerHttpClient::Create(
+    std::unique_ptr<InferenceServerHttpClient>* client,
+    const std::string& server_url, const HttpSslOptions& ssl_options,
+    bool verbose)
+{
+#ifdef CLIENT_TPU_ENABLE_TLS
+  (void)ssl_options;
+  return Error(
+      "CLIENT_TPU_ENABLE_TLS is defined but no TLS transport is linked in "
+      "this build");
+#else
+  (void)ssl_options;
+  (void)verbose;
+  client->reset();
+  return Error(
+      "TLS support is not compiled in: this toolchain ships no OpenSSL "
+      "headers; rebuild with -DCLIENT_TPU_ENABLE_TLS against an "
+      "OpenSSL-equipped toolchain, or terminate TLS in a local proxy");
+#endif
+}
+
 InferenceServerHttpClient::InferenceServerHttpClient(
     const std::string& url, bool verbose)
     : verbose_(verbose)
@@ -136,7 +158,8 @@ InferenceServerHttpClient::EnsureConnected()
 Error
 InferenceServerHttpClient::Request(
     HttpResponse* response, const std::string& method, const std::string& uri,
-    const std::string& body, const std::map<std::string, std::string>& headers)
+    const std::string& body, const std::map<std::string, std::string>& headers,
+    RequestTimers* timers)
 {
   for (int attempt = 0; attempt < 2; ++attempt) {
     // A request may only be retried when it was written to a REUSED
@@ -159,6 +182,7 @@ InferenceServerHttpClient::Request(
     req << "\r\n";
     std::string head = req.str();
 
+    if (timers != nullptr) timers->Capture(RequestTimers::Kind::SEND_START);
     bool write_failed = false;
     const std::string* parts[2] = {&head, &body};
     for (const std::string* part : parts) {
@@ -182,6 +206,10 @@ InferenceServerHttpClient::Request(
       return Error("failed to send request to " + host_);
     }
 
+    if (timers != nullptr) {
+      timers->Capture(RequestTimers::Kind::SEND_END);
+      timers->Capture(RequestTimers::Kind::RECV_START);
+    }
     // read response: status line + headers, then Content-Length body
     std::string buf;
     size_t header_end = std::string::npos;
@@ -258,6 +286,7 @@ InferenceServerHttpClient::Request(
           stderr, "[ctpu] %s %s -> %d (%zu bytes)\n", method.c_str(),
           uri.c_str(), response->status, response->body.size());
     }
+    if (timers != nullptr) timers->Capture(RequestTimers::Kind::RECV_END);
     auto conn = response->headers.find("connection");
     if (conn != response->headers.end() &&
         LowerCase(conn->second) == "close") {
@@ -521,8 +550,10 @@ InferenceServerHttpClient::GenerateRequestBody(
     w.Key("id");
     w.String(options.request_id);
   }
-  if (options.sequence_id != 0 || options.priority != 0 ||
-      options.timeout_us != 0 || outputs.empty()) {
+  const bool has_sequence =
+      options.sequence_id != 0 || !options.sequence_id_str.empty();
+  if (has_sequence || options.priority != 0 || options.timeout_us != 0 ||
+      outputs.empty()) {
     w.Key("parameters");
     w.BeginObject();
     if (outputs.empty()) {
@@ -531,9 +562,13 @@ InferenceServerHttpClient::GenerateRequestBody(
       w.Key("binary_data_output");
       w.Bool(true);
     }
-    if (options.sequence_id != 0) {
+    if (has_sequence) {
       w.Key("sequence_id");
-      w.Int(static_cast<int64_t>(options.sequence_id));
+      if (!options.sequence_id_str.empty()) {
+        w.String(options.sequence_id_str);
+      } else {
+        w.Int(static_cast<int64_t>(options.sequence_id));
+      }
       w.Key("sequence_start");
       w.Bool(options.sequence_start);
       w.Key("sequence_end");
@@ -758,6 +793,8 @@ InferenceServerHttpClient::Infer(
     const std::vector<const InferRequestedOutput*>& outputs,
     CompressionType request_compression, CompressionType response_compression)
 {
+  RequestTimers timers;
+  timers.Capture(RequestTimers::Kind::REQUEST_START);
   std::string body;
   size_t header_length = 0;
   Error err = GenerateRequestBody(&body, &header_length, options, inputs,
@@ -787,7 +824,7 @@ InferenceServerHttpClient::Infer(
         response_compression == CompressionType::GZIP ? "gzip" : "deflate";
   }
   HttpResponse r;
-  err = Request(&r, "POST", uri, body, headers);
+  err = Request(&r, "POST", uri, body, headers, &timers);
   if (!err.IsOk()) return err;
   if (r.status != 200) return ErrorFromResponse(r);
   const auto enc = r.headers.find("content-encoding");
@@ -813,7 +850,33 @@ InferenceServerHttpClient::Infer(
       return Error("malformed Inference-Header-Content-Length: " + it->second);
     }
   }
-  return ParseResponseBody(result, std::move(r.body), resp_header_len);
+  err = ParseResponseBody(result, std::move(r.body), resp_header_len);
+  if (err.IsOk()) {
+    timers.Capture(RequestTimers::Kind::REQUEST_END);
+    UpdateStat(timers);
+  }
+  return err;
+}
+
+void
+InferenceServerHttpClient::UpdateStat(const RequestTimers& timers)
+{
+  std::lock_guard<std::mutex> lk(stat_mu_);
+  stat_.completed_request_count++;
+  stat_.cumulative_total_request_time_ns += timers.Duration(
+      RequestTimers::Kind::REQUEST_START, RequestTimers::Kind::REQUEST_END);
+  stat_.cumulative_send_time_ns += timers.Duration(
+      RequestTimers::Kind::SEND_START, RequestTimers::Kind::SEND_END);
+  stat_.cumulative_receive_time_ns += timers.Duration(
+      RequestTimers::Kind::RECV_START, RequestTimers::Kind::RECV_END);
+}
+
+Error
+InferenceServerHttpClient::ClientInferStat(InferStat* stat)
+{
+  std::lock_guard<std::mutex> lk(stat_mu_);
+  *stat = stat_;
+  return Error::Success();
 }
 
 Error

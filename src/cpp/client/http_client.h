@@ -27,11 +27,28 @@ struct HttpResponse {
   std::string body;
 };
 
+// TLS options (reference http_client.h:46-87 HttpSslOptions).  The API is
+// declared for parity, but this build environment ships no OpenSSL headers:
+// the TLS Create overload returns an error unless the library was compiled
+// with -DCLIENT_TPU_ENABLE_TLS against an OpenSSL-equipped toolchain.
+struct HttpSslOptions {
+  bool verify_peer = true;
+  bool verify_host = true;
+  std::string ca_info;    // CA bundle path
+  std::string cert;       // client certificate path (PEM)
+  std::string key;        // client private key path (PEM)
+};
+
 class InferenceServerHttpClient {
  public:
   static Error Create(
       std::unique_ptr<InferenceServerHttpClient>* client,
       const std::string& server_url, bool verbose = false);
+  // HTTPS variant; see HttpSslOptions for the gating note.
+  static Error Create(
+      std::unique_ptr<InferenceServerHttpClient>* client,
+      const std::string& server_url, const HttpSslOptions& ssl_options,
+      bool verbose = false);
   ~InferenceServerHttpClient();
 
   Error IsServerLive(bool* live);
@@ -103,6 +120,10 @@ class InferenceServerHttpClient {
       const std::vector<std::vector<const InferRequestedOutput*>>& outputs =
           {});
 
+  // Per-client aggregate of request timers (reference InferStat; the gRPC
+  // client exposes the same surface).
+  Error ClientInferStat(InferStat* stat);
+
   // Request/response pipelining helpers (reference http_client.h:122-138).
   static Error GenerateRequestBody(
       std::string* body, size_t* header_length, const InferOptions& options,
@@ -116,9 +137,11 @@ class InferenceServerHttpClient {
   Error Request(
       HttpResponse* response, const std::string& method,
       const std::string& uri, const std::string& body,
-      const std::map<std::string, std::string>& headers = {});
+      const std::map<std::string, std::string>& headers = {},
+      RequestTimers* timers = nullptr);
   Error EnsureConnected();
   void CloseSocket();
+  void UpdateStat(const RequestTimers& timers);
   Error GetJson(const std::string& uri, json::ValuePtr* out);
   Error PostJson(
       const std::string& uri, const std::string& body,
@@ -130,6 +153,9 @@ class InferenceServerHttpClient {
   bool verbose_ = false;
   std::mutex reactor_mu_;
   std::unique_ptr<HttpReactor> reactor_;  // created on first AsyncInfer
+
+  std::mutex stat_mu_;
+  InferStat stat_;
 };
 
 }  // namespace ctpu

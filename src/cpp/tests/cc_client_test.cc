@@ -305,6 +305,52 @@ TestSequence(tc::InferenceServerHttpClient* client)
 }
 
 static void
+TestStringSequenceId(tc::InferenceServerHttpClient* client)
+{
+  // same protocol, string correlation id (reference InferOptions supports
+  // both forms); a distinct id must start a distinct accumulator
+  int32_t values[3] = {2, 3, 4};
+  int32_t expected = 0;
+  for (int step = 0; step < 3; step++) {
+    expected += values[step];
+    tc::InferInput input("INPUT", {1}, "INT32");
+    input.AppendRaw(
+        reinterpret_cast<const uint8_t*>(&values[step]), sizeof(int32_t));
+    tc::InferOptions options("simple_sequence");
+    options.sequence_id_str = "corr-abc";
+    options.sequence_start = (step == 0);
+    options.sequence_end = (step == 2);
+    tc::InferResultPtr result;
+    CHECK_OK(client->Infer(&result, options, {&input}));
+    const uint8_t* buf = nullptr;
+    size_t size = 0;
+    CHECK_OK(result->RawData("OUTPUT", &buf, &size));
+    CHECK(*reinterpret_cast<const int32_t*>(buf) == expected);
+  }
+}
+
+static void
+TestClientInferStat(tc::InferenceServerHttpClient* client)
+{
+  tc::InferStat before;
+  CHECK_OK(client->ClientInferStat(&before));
+  std::vector<int32_t> in0(16), in1(16);
+  tc::InferInput i0("INPUT0", {1, 16}, "INT32");
+  tc::InferInput i1("INPUT1", {1, 16}, "INT32");
+  FillInputs(in0, in1, i0, i1);
+  tc::InferResultPtr result;
+  CHECK_OK(client->Infer(&result, tc::InferOptions("simple"), {&i0, &i1}));
+  tc::InferStat after;
+  CHECK_OK(client->ClientInferStat(&after));
+  CHECK(after.completed_request_count == before.completed_request_count + 1);
+  CHECK(
+      after.cumulative_total_request_time_ns >
+      before.cumulative_total_request_time_ns);
+  CHECK(after.cumulative_send_time_ns >= before.cumulative_send_time_ns);
+  CHECK(after.cumulative_receive_time_ns > before.cumulative_receive_time_ns);
+}
+
+static void
 TestInferMulti(tc::InferenceServerHttpClient* client)
 {
   std::vector<int32_t> in0(16), in1(16);
@@ -364,6 +410,8 @@ main(int argc, char** argv)
   TestInferCompressed(client.get());
   TestSystemSharedMemory(client.get());
   TestSequence(client.get());
+  TestStringSequenceId(client.get());
+  TestClientInferStat(client.get());
   TestInferMulti(client.get());
   TestModelControl(client.get());
   TestStatistics(client.get());

@@ -249,6 +249,33 @@ TestSequenceStream(tc::InferenceServerGrpcClient* client)
 }
 
 static void
+TestStringSequenceId(tc::InferenceServerGrpcClient* client)
+{
+  // unary infer over the sequence protocol with a string correlation id
+  // (string_param in the request parameters map)
+  int32_t values[3] = {10, 20, 30};
+  int32_t expected = 0;
+  for (int step = 0; step < 3; ++step) {
+    expected += values[step];
+    tc::InferInput input("INPUT", {1}, "INT32");
+    input.AppendRaw(
+        reinterpret_cast<const uint8_t*>(&values[step]), sizeof(int32_t));
+    tc::InferOptions options("simple_sequence");
+    options.sequence_id_str = "grpc-corr-xyz";
+    options.sequence_start = (step == 0);
+    options.sequence_end = (step == 2);
+    tc::InferResult* result = nullptr;
+    CHECK_OK(client->Infer(&result, options, {&input}));
+    if (result == nullptr) return;
+    std::unique_ptr<tc::InferResult> owner(result);
+    const uint8_t* buf = nullptr;
+    size_t size = 0;
+    CHECK_OK(result->RawData("OUTPUT", &buf, &size));
+    CHECK(*reinterpret_cast<const int32_t*>(buf) == expected);
+  }
+}
+
+static void
 TestStatistics(tc::InferenceServerGrpcClient* client)
 {
   inference::ModelStatisticsResponse stats;
@@ -294,6 +321,7 @@ main(int argc, char** argv)
   TestInferErrors(client.get());
   TestAsyncInfer(client.get());
   TestSequenceStream(client.get());
+  TestStringSequenceId(client.get());
   TestStatistics(client.get());
   TestSharedMemoryVerbs(client.get());
 
