@@ -18,6 +18,9 @@ CLIENT_SRCS := $(CPP_DIR)/client/json.cc $(CPP_DIR)/client/http_client.cc \
                $(CPP_DIR)/client/http_reactor.cc \
                $(CPP_DIR)/client/shm_utils.cc
 CLIENT_HDRS := $(wildcard $(CPP_DIR)/client/*.h)
+# Each client TU compiled once; every example/test links the objects.
+CLIENT_OBJS := $(CPP_BUILD)/json.o $(CPP_BUILD)/http_client.o \
+               $(CPP_BUILD)/http_reactor.o $(CPP_BUILD)/shm_utils.o
 
 # gRPC client: protoc-generated KServe protos + the h2/hpack transport.
 PB_CPP := build/proto_cpp
@@ -26,18 +29,29 @@ GRPC_SRCS := $(CPP_DIR)/grpc/hpack.cc $(CPP_DIR)/grpc/h2.cc \
 GRPC_HDRS := $(wildcard $(CPP_DIR)/grpc/*.h)
 GRPC_OBJS := $(CPP_BUILD)/hpack.o $(CPP_BUILD)/h2.o \
              $(CPP_BUILD)/grpc_client.o $(CPP_BUILD)/inference.pb.o \
-             $(CPP_BUILD)/model_config.pb.o
+             $(CPP_BUILD)/model_config.pb.o $(CPP_BUILD)/shm_utils.o
 GRPC_LINK := -lprotobuf -lrt -lpthread -lz
 GRPC_INC := -I$(PB_CPP) -I$(CPP_DIR)/client -I$(CPP_DIR)/grpc
 
-cpp: $(CPP_BUILD)/simple_http_infer_client $(CPP_BUILD)/cc_client_test \
+HTTP_EXAMPLES := simple_http_infer_client \
+                 simple_http_health_metadata \
+                 simple_http_async_infer_client \
+                 simple_http_string_infer_client \
+                 simple_http_shm_client \
+                 simple_http_model_control
+
+cpp: $(addprefix $(CPP_BUILD)/,$(HTTP_EXAMPLES)) $(CPP_BUILD)/cc_client_test \
      $(CPP_BUILD)/libhttpclient_tpu.so grpc_cpp
 
 GRPC_EXAMPLES := simple_grpc_infer_client \
                  simple_grpc_sequence_stream_infer_client \
+                 simple_grpc_sequence_sync_infer_client \
                  simple_grpc_async_infer_client \
                  simple_grpc_health_metadata \
-                 simple_grpc_model_control
+                 simple_grpc_model_control \
+                 simple_grpc_shm_client \
+                 simple_grpc_string_infer_client \
+                 reuse_infer_objects_grpc_client
 
 grpc_cpp: $(addprefix $(CPP_BUILD)/,$(GRPC_EXAMPLES)) \
           $(CPP_BUILD)/cc_grpc_client_test $(CPP_BUILD)/hpack_unit_test
@@ -83,13 +97,17 @@ $(CPP_BUILD)/libhttpclient_tpu.so: $(CLIENT_SRCS) $(CLIENT_HDRS)
 	mkdir -p $(CPP_BUILD)
 	$(CXX) $(CXXFLAGS) -shared -o $@ $(CLIENT_SRCS) -lrt -lpthread -lz
 
-$(CPP_BUILD)/simple_http_infer_client: $(CPP_DIR)/examples/simple_http_infer_client.cc $(CLIENT_SRCS) $(CLIENT_HDRS)
+$(CLIENT_OBJS): $(CPP_BUILD)/%.o: $(CPP_DIR)/client/%.cc $(CLIENT_HDRS)
 	mkdir -p $(CPP_BUILD)
-	$(CXX) $(CXXFLAGS) -o $@ $< $(CLIENT_SRCS) -lrt -lpthread -lz
+	$(CXX) $(CXXFLAGS) -c -o $@ $< -I$(CPP_DIR)/client
 
-$(CPP_BUILD)/cc_client_test: $(CPP_DIR)/tests/cc_client_test.cc $(CLIENT_SRCS) $(CLIENT_HDRS)
+$(addprefix $(CPP_BUILD)/,$(HTTP_EXAMPLES)): $(CPP_BUILD)/%: $(CPP_DIR)/examples/%.cc $(CLIENT_OBJS)
 	mkdir -p $(CPP_BUILD)
-	$(CXX) $(CXXFLAGS) -o $@ $< $(CLIENT_SRCS) -lrt -lpthread -lz
+	$(CXX) $(CXXFLAGS) -o $@ $< $(CLIENT_OBJS) -I$(CPP_DIR)/client -lrt -lpthread -lz
+
+$(CPP_BUILD)/cc_client_test: $(CPP_DIR)/tests/cc_client_test.cc $(CLIENT_OBJS)
+	mkdir -p $(CPP_BUILD)
+	$(CXX) $(CXXFLAGS) -o $@ $< $(CLIENT_OBJS) -I$(CPP_DIR)/client -lrt -lpthread -lz
 
 protos: $(PB_OUT)/inference_pb2.py
 

@@ -1,0 +1,122 @@
+// Native gRPC system-shared-memory example: stage both inputs in one POSIX
+// region, take both outputs in another, so tensor bytes never ride the
+// socket (parity with reference src/c++/examples/simple_grpc_shm_client.cc).
+//
+// Usage: simple_grpc_shm_client [-u host:port]
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <iostream>
+#include <string>
+#include <vector>
+
+#include "grpc_client.h"
+#include "shm_utils.h"
+
+namespace tc = ctpu;
+
+#define FAIL_IF_ERR(X, MSG)                                 \
+  do {                                                      \
+    tc::Error err__ = (X);                                  \
+    if (!err__.IsOk()) {                                    \
+      fprintf(stderr, "error: %s: %s\n", (MSG),            \
+              err__.Message().c_str());                     \
+      return 1;                                             \
+    }                                                       \
+  } while (false)
+
+int
+main(int argc, char** argv)
+{
+  std::string url = "localhost:8001";
+  for (int i = 1; i < argc - 1; ++i) {
+    if (!std::strcmp(argv[i], "-u")) url = argv[++i];
+  }
+
+  std::unique_ptr<tc::InferenceServerGrpcClient> client;
+  FAIL_IF_ERR(
+      tc::InferenceServerGrpcClient::Create(&client, url), "create client");
+
+  constexpr size_t kTensorBytes = 16 * sizeof(int32_t);
+  const std::string in_key = "/grpc_shm_example_in";
+  const std::string out_key = "/grpc_shm_example_out";
+  // start clean even if a previous run crashed mid-example
+  tc::UnlinkSharedMemoryRegion(in_key);
+  tc::UnlinkSharedMemoryRegion(out_key);
+  client->UnregisterSystemSharedMemory("grpc_shm_example_in");
+  client->UnregisterSystemSharedMemory("grpc_shm_example_out");
+
+  int in_fd = -1, out_fd = -1;
+  void* in_addr = nullptr;
+  void* out_addr = nullptr;
+  FAIL_IF_ERR(
+      tc::CreateSharedMemoryRegion(in_key, 2 * kTensorBytes, &in_fd),
+      "create input region");
+  FAIL_IF_ERR(
+      tc::MapSharedMemory(in_fd, 0, 2 * kTensorBytes, &in_addr),
+      "map input region");
+  FAIL_IF_ERR(
+      tc::CreateSharedMemoryRegion(out_key, 2 * kTensorBytes, &out_fd),
+      "create output region");
+  FAIL_IF_ERR(
+      tc::MapSharedMemory(out_fd, 0, 2 * kTensorBytes, &out_addr),
+      "map output region");
+
+  int32_t* in_ptr = static_cast<int32_t*>(in_addr);
+  for (int i = 0; i < 16; ++i) {
+    in_ptr[i] = i;        // INPUT0 at offset 0
+    in_ptr[16 + i] = 1;   // INPUT1 at offset kTensorBytes
+  }
+
+  FAIL_IF_ERR(
+      client->RegisterSystemSharedMemory(
+          "grpc_shm_example_in", in_key, 2 * kTensorBytes),
+      "register input region");
+  FAIL_IF_ERR(
+      client->RegisterSystemSharedMemory(
+          "grpc_shm_example_out", out_key, 2 * kTensorBytes),
+      "register output region");
+
+  tc::InferInput in0("INPUT0", {1, 16}, "INT32");
+  tc::InferInput in1("INPUT1", {1, 16}, "INT32");
+  in0.SetSharedMemory("grpc_shm_example_in", kTensorBytes, 0);
+  in1.SetSharedMemory("grpc_shm_example_in", kTensorBytes, kTensorBytes);
+  tc::InferRequestedOutput out0("OUTPUT0"), out1("OUTPUT1");
+  out0.SetSharedMemory("grpc_shm_example_out", kTensorBytes, 0);
+  out1.SetSharedMemory("grpc_shm_example_out", kTensorBytes, kTensorBytes);
+
+  tc::InferOptions options("simple");
+  tc::InferResult* result = nullptr;
+  FAIL_IF_ERR(
+      client->Infer(&result, options, {&in0, &in1}, {&out0, &out1}),
+      "inference failed");
+  std::unique_ptr<tc::InferResult> owner(result);
+
+  const int32_t* sum = static_cast<int32_t*>(out_addr);
+  const int32_t* diff = sum + 16;
+  for (int i = 0; i < 16; ++i) {
+    std::cout << in_ptr[i] << " + " << in_ptr[16 + i] << " = " << sum[i]
+              << std::endl;
+    if (sum[i] != in_ptr[i] + in_ptr[16 + i] ||
+        diff[i] != in_ptr[i] - in_ptr[16 + i]) {
+      std::cerr << "error: incorrect result in shared memory" << std::endl;
+      return 1;
+    }
+  }
+
+  FAIL_IF_ERR(
+      client->UnregisterSystemSharedMemory("grpc_shm_example_in"),
+      "unregister input");
+  FAIL_IF_ERR(
+      client->UnregisterSystemSharedMemory("grpc_shm_example_out"),
+      "unregister output");
+  tc::UnmapSharedMemory(in_addr, 2 * kTensorBytes);
+  tc::UnmapSharedMemory(out_addr, 2 * kTensorBytes);
+  tc::CloseSharedMemory(in_fd);
+  tc::CloseSharedMemory(out_fd);
+  tc::UnlinkSharedMemoryRegion(in_key);
+  tc::UnlinkSharedMemoryRegion(out_key);
+
+  std::cout << "PASS: simple_grpc_shm_client (native)" << std::endl;
+  return 0;
+}

@@ -1,0 +1,76 @@
+// Native HTTP health + metadata example: liveness/readiness probes, server
+// and model metadata, repository index (parity with reference
+// src/c++/examples/simple_http_health_metadata.cc).
+//
+// Usage: simple_http_health_metadata [-u host:port]
+#include <cstdio>
+#include <cstring>
+#include <iostream>
+#include <string>
+
+#include "http_client.h"
+
+namespace tc = ctpu;
+
+#define FAIL_IF_ERR(X, MSG)                                 \
+  do {                                                      \
+    tc::Error err__ = (X);                                  \
+    if (!err__.IsOk()) {                                    \
+      fprintf(stderr, "error: %s: %s\n", (MSG),            \
+              err__.Message().c_str());                     \
+      return 1;                                             \
+    }                                                       \
+  } while (false)
+
+int
+main(int argc, char** argv)
+{
+  std::string url = "localhost:8000";
+  for (int i = 1; i < argc - 1; ++i) {
+    if (!std::strcmp(argv[i], "-u")) url = argv[++i];
+  }
+
+  std::unique_ptr<tc::InferenceServerHttpClient> client;
+  FAIL_IF_ERR(
+      tc::InferenceServerHttpClient::Create(&client, url), "create client");
+
+  bool live = false, ready = false, model_ready = false;
+  FAIL_IF_ERR(client->IsServerLive(&live), "server live");
+  FAIL_IF_ERR(client->IsServerReady(&ready), "server ready");
+  FAIL_IF_ERR(client->IsModelReady(&model_ready, "simple"), "model ready");
+  std::cout << "live=" << live << " ready=" << ready
+            << " simple_ready=" << model_ready << std::endl;
+  if (!live || !ready || !model_ready) {
+    std::cerr << "error: server/model not ready" << std::endl;
+    return 1;
+  }
+
+  tc::json::ValuePtr meta;
+  FAIL_IF_ERR(client->ServerMetadata(&meta), "server metadata");
+  const tc::json::Value* name = meta->Get("name");
+  if (name == nullptr || name->AsString().empty()) {
+    std::cerr << "error: empty server name" << std::endl;
+    return 1;
+  }
+  std::cout << "server: " << name->AsString() << std::endl;
+
+  tc::json::ValuePtr model_meta;
+  FAIL_IF_ERR(
+      client->ModelMetadata(&model_meta, "simple"), "model metadata");
+  const tc::json::Value* model_name = model_meta->Get("name");
+  if (model_name == nullptr || model_name->AsString() != "simple") {
+    std::cerr << "error: model metadata name mismatch" << std::endl;
+    return 1;
+  }
+
+  tc::json::ValuePtr index;
+  FAIL_IF_ERR(client->ModelRepositoryIndex(&index), "repository index");
+  std::cout << "repository index has " << index->arr.size() << " models"
+            << std::endl;
+  if (index->arr.empty()) {
+    std::cerr << "error: empty repository index" << std::endl;
+    return 1;
+  }
+  std::cout << "PASS: simple_http_health_metadata (native)" << std::endl;
+  return 0;
+}

@@ -1,0 +1,65 @@
+// Native HTTP model-control example: explicit unload/load cycle with
+// readiness probes between steps (parity with reference
+// src/c++/examples/simple_http_model_control.cc).
+//
+// Usage: simple_http_model_control [-u host:port]
+#include <cstdio>
+#include <cstring>
+#include <iostream>
+#include <string>
+
+#include "http_client.h"
+
+namespace tc = ctpu;
+
+#define FAIL_IF_ERR(X, MSG)                                 \
+  do {                                                      \
+    tc::Error err__ = (X);                                  \
+    if (!err__.IsOk()) {                                    \
+      fprintf(stderr, "error: %s: %s\n", (MSG),            \
+              err__.Message().c_str());                     \
+      return 1;                                             \
+    }                                                       \
+  } while (false)
+
+int
+main(int argc, char** argv)
+{
+  std::string url = "localhost:8000";
+  std::string model = "simple";
+  for (int i = 1; i < argc - 1; ++i) {
+    if (!std::strcmp(argv[i], "-u")) url = argv[++i];
+    if (!std::strcmp(argv[i], "-m")) model = argv[++i];
+  }
+
+  std::unique_ptr<tc::InferenceServerHttpClient> client;
+  FAIL_IF_ERR(
+      tc::InferenceServerHttpClient::Create(&client, url), "create client");
+
+  bool ready = false;
+  FAIL_IF_ERR(client->IsModelReady(&ready, model), "initial readiness");
+  std::cout << model << " initially ready=" << ready << std::endl;
+  if (!ready) {
+    std::cerr << "error: model must start loaded" << std::endl;
+    return 1;
+  }
+
+  FAIL_IF_ERR(client->UnloadModel(model), "unload");
+  tc::Error e = client->IsModelReady(&ready, model);
+  // unloaded: server answers ready=false or NOT_FOUND; both are "not ready"
+  if (e.IsOk() && ready) {
+    std::cerr << "error: model still ready after unload" << std::endl;
+    return 1;
+  }
+  std::cout << model << " unloaded" << std::endl;
+
+  FAIL_IF_ERR(client->LoadModel(model), "load");
+  FAIL_IF_ERR(client->IsModelReady(&ready, model), "readiness after load");
+  if (!ready) {
+    std::cerr << "error: model not ready after load" << std::endl;
+    return 1;
+  }
+  std::cout << model << " reloaded and ready" << std::endl;
+  std::cout << "PASS: simple_http_model_control (native)" << std::endl;
+  return 0;
+}

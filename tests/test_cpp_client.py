@@ -13,7 +13,12 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _BUILD = os.path.join(_REPO, "build", "cpp")
 
 needs_cpp = pytest.mark.skipif(
-    not os.path.exists(os.path.join(_BUILD, "cc_client_test")),
+    # probe the newest binary too, so a stale pre-expansion build dir skips
+    # instead of erroring on the missing example
+    not all(
+        os.path.exists(os.path.join(_BUILD, exe))
+        for exe in ("cc_client_test", "simple_http_model_control")
+    ),
     reason="native client not built (make cpp)",
 )
 
@@ -35,18 +40,26 @@ def test_cc_client_suite(server):
 
 
 @needs_cpp
-def test_native_example(server):
-    proc = subprocess.run(
-        [os.path.join(_BUILD, "simple_http_infer_client"), "-u",
-         server.http_address],
-        capture_output=True, text=True, timeout=60,
-    )
-    assert proc.returncode == 0, proc.stdout + proc.stderr
-    assert "PASS" in proc.stdout
+def test_native_http_examples(server):
+    for exe in ("simple_http_infer_client",
+                "simple_http_health_metadata",
+                "simple_http_async_infer_client",
+                "simple_http_string_infer_client",
+                "simple_http_shm_client",
+                "simple_http_model_control"):
+        proc = subprocess.run(
+            [os.path.join(_BUILD, exe), "-u", server.http_address],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert proc.returncode == 0, exe + ": " + proc.stdout + proc.stderr
+        assert "PASS" in proc.stdout, exe
 
 
 needs_grpc_cpp = pytest.mark.skipif(
-    not os.path.exists(os.path.join(_BUILD, "cc_grpc_client_test")),
+    not all(
+        os.path.exists(os.path.join(_BUILD, exe))
+        for exe in ("cc_grpc_client_test", "reuse_infer_objects_grpc_client")
+    ),
     reason="native gRPC client not built (make grpc_cpp)",
 )
 
@@ -86,9 +99,13 @@ def test_cc_grpc_client_suite(grpc_server):
 def test_native_grpc_examples(grpc_server):
     for exe in ("simple_grpc_infer_client",
                 "simple_grpc_sequence_stream_infer_client",
+                "simple_grpc_sequence_sync_infer_client",
                 "simple_grpc_async_infer_client",
                 "simple_grpc_health_metadata",
-                "simple_grpc_model_control"):
+                "simple_grpc_model_control",
+                "simple_grpc_shm_client",
+                "simple_grpc_string_infer_client",
+                "reuse_infer_objects_grpc_client"):
         proc = subprocess.run(
             [os.path.join(_BUILD, exe), "-u", grpc_server.grpc_address],
             capture_output=True, text=True, timeout=60,
