@@ -1,0 +1,10 @@
+"""TPU kernel library (Pallas) for the framework's hot ops.
+
+The serving/training compute path is XLA-compiled JAX; this package holds
+the hand-written Pallas TPU kernels for the operations where blockwise
+control over VMEM residency beats what the compiler fuses on its own —
+starting with causal flash attention (:mod:`client_tpu.ops.flash_attention`),
+the transformer family's dominant op.
+"""
+
+from client_tpu.ops.flash_attention import flash_attention  # noqa: F401

@@ -1,0 +1,216 @@
+"""Causal flash attention as a Pallas TPU kernel.
+
+Grid (batch·head, Q blocks, KV blocks): the KV dimension is the innermost,
+sequentially-iterated ("arbitrary") grid axis, so only ONE [Bk, D] K block
+and V block are VMEM-resident at a time — Pallas double-buffers the block
+DMAs while the streaming-softmax state (running max / denominator /
+f32 accumulator) persists in VMEM scratch across the KV sweep.  VMEM use is
+O(Bq·D + Bk·D) regardless of sequence length, so the kernel compiles at any
+T the HBM can hold; the [T, T] score matrix never exists anywhere.  Causal
+masking skips the compute (not just the scores) of fully-past-diagonal
+blocks via ``pl.when``.  MXU work is the two block matmuls (Q·Kᵀ, P·V),
+accumulated f32.
+
+Backward: ``jax.custom_vjp`` whose bwd recomputes attention with the plain
+einsum formulation and differentiates that — the forward keeps flash memory
+behavior (nothing saved but q/k/v), the backward trades the O(T²) score
+materialization back in.  A fused Pallas backward is the next optimization.
+
+Off-TPU (CPU tests, the 8-device virtual mesh) the kernel runs in Pallas
+interpret mode automatically, so every test exercises the same code path
+the chip runs compiled.
+
+Reference has no analog (client-only stack); this implements the standard
+flash-attention-2 forward on the layout conventions of
+client_tpu.parallel.ring_attention (same [B, T, H, D] interface as
+``plain_attention``).
+"""
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG = -1e30  # -inf stand-in that keeps exp() NaN-free
+
+
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+               scale, block_q, block_k, causal):
+    """One (batch·head, q-block, kv-block) program.
+
+    Block shapes: q_ref/o_ref [1, block_q, D]; k_ref/v_ref [1, block_k, D].
+    acc/m/l scratch persists across the (sequential) KV grid axis.
+    """
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    n_kv = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    # a KV block strictly past this Q block's last row contributes nothing —
+    # skip its matmuls entirely
+    diag_ok = (ki * block_k <= qi * block_q + block_q - 1) if causal else True
+
+    @pl.when(diag_ok)
+    def _accumulate():
+        q = q_ref[0].astype(jnp.float32) * scale  # [Bq, D]
+        kb = k_ref[0].astype(jnp.float32)         # [Bk, D]
+        vb = v_ref[0].astype(jnp.float32)
+        s = lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [Bq, Bk]
+        if causal:
+            q_pos = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, 1), 0
+            )
+            kv_pos = ki * block_k + lax.broadcasted_iota(
+                jnp.int32, (1, block_k), 1
+            )
+            s = jnp.where(q_pos >= kv_pos, s, _NEG)
+        m = m_ref[:]
+        blk_max = jnp.max(s, axis=-1, keepdims=True)
+        new_m = jnp.maximum(m, blk_max)
+        corr = jnp.exp(m - new_m)
+        p = jnp.exp(s - new_m)
+        l_ref[:] = l_ref[:] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        pv = lax.dot_general(
+            p, vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[:] = acc_ref[:] * corr + pv
+        m_ref[:] = new_m
+
+    @pl.when(ki == n_kv - 1)
+    def _finish():
+        # every real row saw at least its own diagonal key, so l > 0; the
+        # guard only shields padded Q rows, whose output is sliced off
+        o_ref[0] = (
+            acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)
+        ).astype(o_ref.dtype)
+
+
+def _fa_forward(q, k, v, scale, block_q, block_k, causal, interpret):
+    """[BH, T, D] inputs → [BH, T, D] output via the Pallas kernel."""
+    bh, t, d = q.shape
+    grid = (bh, t // block_q, t // block_k)
+    kernel = functools.partial(
+        _fa_kernel, scale=scale, block_q=block_q, block_k=block_k,
+        causal=causal,
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _reference(q, k, v, causal, scale):
+    """Plain einsum attention on [BH, T, D] — the bwd recompute path."""
+    s = jnp.einsum(
+        "bqd,bkd->bqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        t = q.shape[1]
+        mask = jnp.arange(t)[:, None] >= jnp.arange(t)[None, :]
+        s = jnp.where(mask[None], s, _NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bqk,bkd->bqd", p.astype(v.dtype), v).astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _fa(q, k, v, scale, block_q, block_k, causal, interpret):
+    return _fa_forward(q, k, v, scale, block_q, block_k, causal, interpret)
+
+
+def _fa_fwd(q, k, v, scale, block_q, block_k, causal, interpret):
+    out = _fa_forward(q, k, v, scale, block_q, block_k, causal, interpret)
+    return out, (q, k, v)
+
+
+def _fa_bwd(scale, block_q, block_k, causal, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda a, b, c: _reference(a, b, c, causal, scale),
+                     q, k, v)
+    return vjp(g)
+
+
+_fa.defvjp(_fa_fwd, _fa_bwd)
+
+
+def flash_attention(q, k, v, causal=True, scale=None, block_q=128,
+                    block_k=128, interpret=None):
+    """Flash attention with the ``plain_attention`` interface.
+
+    Args:
+      q, k, v: [B, T, H, D] (same head count — repeat GQA KV first, as the
+        transformer's attention block already does).
+      causal: apply the causal mask (q and kv must be the same length).
+      scale: score scale; defaults to D**-0.5.
+      block_q, block_k: kernel tile sizes (clamped to the padded length).
+      interpret: force Pallas interpret mode; default: on for any backend
+        without a real TPU.
+
+    Returns [B, T, H, D] in q's dtype.
+    """
+    b, t, h, d = q.shape
+    if scale is None:
+        scale = d ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    # Blocks must stay sublane-aligned (Mosaic tiling: the second-to-last
+    # dim of a VMEM access needs 8/16/32-multiples by dtype) — so never
+    # clamp a block to a ragged t; round t up and pad instead.
+    align = 32
+    block_q = min(block_q, -(-t // align) * align)
+    block_k = min(block_k, -(-t // align) * align)
+    # padded length must tile by BOTH block sizes
+    pad = (-t) % math.lcm(block_q, block_k)
+
+    if pad and not causal:
+        # non-causal has no positional mask to neutralize padded keys; the
+        # ragged remainder is small — use the plain formulation directly
+        from client_tpu.parallel.ring_attention import plain_attention
+
+        return plain_attention(q, k, v, causal=False, scale=scale)
+
+    def fold(x):
+        # [B,T,H,D] -> [B*H, T, D]
+        return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+    qf, kf, vf = fold(q), fold(k), fold(v)
+    if pad:
+        # padded KV rows sit in the causal future of every real Q row (the
+        # position mask zeroes them); padded Q rows are sliced off below
+        qf = jnp.pad(qf, ((0, 0), (0, pad), (0, 0)))
+        kf = jnp.pad(kf, ((0, 0), (0, pad), (0, 0)))
+        vf = jnp.pad(vf, ((0, 0), (0, pad), (0, 0)))
+
+    out = _fa(qf, kf, vf, scale, block_q, block_k, causal, interpret)
+    if pad:
+        out = out[:, :t]
+    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)

@@ -156,6 +156,19 @@ def _attention_block(layer, x, cfg, positions, mesh, attn_impl):
         attn = ring_attention_sharded(
             q, _repeat_kv(k, n_rep), _repeat_kv(v, n_rep), mesh
         )
+    elif attn_impl == "flash":
+        # Pallas kernel (client_tpu.ops): no [T,T] score materialization —
+        # the long-context single-shard path.  It has no partitioning rule,
+        # so sp-sharded activations would be silently gathered: use "ring"
+        # (which consumes the mesh) for sequence-parallel runs.
+        if mesh is not None:
+            raise ValueError(
+                "attn_impl='flash' is single-shard; use attn_impl='ring' "
+                "with a mesh"
+            )
+        from client_tpu.ops import flash_attention
+
+        attn = flash_attention(q, _repeat_kv(k, n_rep), _repeat_kv(v, n_rep))
     else:
         attn = plain_attention(q, _repeat_kv(k, n_rep), _repeat_kv(v, n_rep))
 
