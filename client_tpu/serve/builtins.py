@@ -183,6 +183,7 @@ def default_models():
         identity_model(),
         identity_model("identity_bytes", "BYTES"),
         identity_model("identity_int32", "INT32"),
+        identity_model("identity_int8", "INT8"),
         sequence_model(),
         decoupled_model(),
         classification_model(),

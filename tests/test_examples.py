@@ -14,9 +14,9 @@ from client_tpu.serve import Server
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _EXAMPLES = os.path.join(_REPO, "examples")
 
-# example -> which address it takes (grpc/http).  Excludes the interactive /
-# special-setup ones covered elsewhere (image_client, llm_streaming,
-# memory-growth-style loops).
+# example -> which address it takes (grpc/http).  Excludes only the
+# interactive / special-setup ones covered elsewhere (image_client's file
+# inputs, llm_streaming's language model set).
 GRPC_EXAMPLES = [
     "simple_grpc_infer_client.py",
     "simple_grpc_async_infer_client.py",
@@ -34,7 +34,14 @@ GRPC_EXAMPLES = [
     "simple_grpc_custom_args_client.py",
     "simple_grpc_custom_repeat.py",
     "ensemble_client.py",
+    "ensemble_image_client.py",
     "reuse_infer_objects_client.py",
+    "grpc_client.py",
+    "grpc_image_client.py",
+    "grpc_explicit_int_content_client.py",
+    "grpc_explicit_int8_content_client.py",
+    "grpc_explicit_byte_content_client.py",
+    "memory_growth_test.py",
 ]
 HTTP_EXAMPLES = [
     "simple_http_infer_client.py",
