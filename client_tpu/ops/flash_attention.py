@@ -1,27 +1,33 @@
-"""Causal flash attention as a Pallas TPU kernel.
+"""Causal flash attention as Pallas TPU kernels (forward + fused backward).
 
-Grid (batch·head, Q blocks, KV blocks): the KV dimension is the innermost,
-sequentially-iterated ("arbitrary") grid axis, so only ONE [Bk, D] K block
-and V block are VMEM-resident at a time — Pallas double-buffers the block
-DMAs while the streaming-softmax state (running max / denominator /
-f32 accumulator) persists in VMEM scratch across the KV sweep.  VMEM use is
-O(Bq·D + Bk·D) regardless of sequence length, so the kernel compiles at any
-T the HBM can hold; the [T, T] score matrix never exists anywhere.  Causal
-masking skips the compute (not just the scores) of fully-past-diagonal
-blocks via ``pl.when``.  MXU work is the two block matmuls (Q·Kᵀ, P·V),
-accumulated f32.
+Forward — grid (batch·head, Q blocks, KV blocks): the KV dimension is the
+innermost, sequentially-iterated ("arbitrary") grid axis, so only ONE
+[Bk, D] K block and V block are VMEM-resident at a time — Pallas
+double-buffers the block DMAs while the streaming-softmax state (running
+max / denominator / f32 accumulator) persists in VMEM scratch across the KV
+sweep.  VMEM use is O(Bq·D + Bk·D) regardless of sequence length, so the
+kernel compiles at any T the HBM can hold; the [T, T] score matrix never
+exists anywhere.  Causal masking skips the compute (not just the scores) of
+fully-past-diagonal blocks via ``pl.when``.  Alongside the output the
+forward emits the per-row log-sum-exp, the one O(T) residual the backward
+needs.
 
-Backward: ``jax.custom_vjp`` whose bwd recomputes attention with the plain
-einsum formulation and differentiates that — the forward keeps flash memory
-behavior (nothing saved but q/k/v), the backward trades the O(T²) score
-materialization back in.  A fused Pallas backward is the next optimization.
+Backward — the standard two-kernel flash-attention-2 scheme, both streaming
+the same way as the forward:
+- dQ kernel: grid (BH, Q blocks, KV blocks), dQ accumulated in VMEM
+  scratch across the KV sweep; scores recomputed blockwise from q/k and the
+  saved LSE (p = exp(s − lse)), never materialized globally.
+- dK/dV kernel: grid (BH, KV blocks, Q blocks), dK and dV accumulated in
+  scratch across the Q sweep.
+Both use delta = rowsum(dO ⊙ O) (computed once, O(T)) for the softmax
+Jacobian, so memory stays O(block) end to end — no O(T²) anywhere in
+training either.
 
-Off-TPU (CPU tests, the 8-device virtual mesh) the kernel runs in Pallas
+Off-TPU (CPU tests, the 8-device virtual mesh) the kernels run in Pallas
 interpret mode automatically, so every test exercises the same code path
 the chip runs compiled.
 
-Reference has no analog (client-only stack); this implements the standard
-flash-attention-2 forward on the layout conventions of
+Reference has no analog (client-only stack); layout conventions follow
 client_tpu.parallel.ring_attention (same [B, T, H, D] interface as
 ``plain_attention``).
 """
@@ -38,12 +44,50 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG = -1e30  # -inf stand-in that keeps exp() NaN-free
 
 
-def _fa_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
-               scale, block_q, block_k, causal):
-    """One (batch·head, q-block, kv-block) program.
+def _block_scores(q_ref, k_ref, qi, ki, scale, block_q, block_k, causal):
+    """Recompute one [Bq, Bk] score block (f32, scaled, causally masked)."""
+    q = q_ref[0].astype(jnp.float32)
+    kb = k_ref[0].astype(jnp.float32)
+    s = lax.dot_general(
+        q, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        q_pos = qi * block_q + lax.broadcasted_iota(
+            jnp.int32, (block_q, 1), 0
+        )
+        kv_pos = ki * block_k + lax.broadcasted_iota(
+            jnp.int32, (1, block_k), 1
+        )
+        s = jnp.where(q_pos >= kv_pos, s, _NEG)
+    return s
 
-    Block shapes: q_ref/o_ref [1, block_q, D]; k_ref/v_ref [1, block_k, D].
-    acc/m/l scratch persists across the (sequential) KV grid axis.
+
+def _block_dscores(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qi, ki,
+                   scale, block_q, block_k, causal):
+    """Backward softmax-Jacobian for one block pair: returns (p, ds, do32).
+
+    p = exp(s − lse) recomputed from the saved LSE; ds = p·(dO·Vᵀ − delta)
+    ·scale — shared verbatim by the dQ and dK/dV kernels.
+    """
+    s = _block_scores(q_ref, k_ref, qi, ki, scale, block_q, block_k, causal)
+    p = jnp.exp(s - lse_ref[...].reshape(-1, 1))  # [Bq, Bk]
+    do = do_ref[0].astype(jnp.float32)
+    vb = v_ref[0].astype(jnp.float32)
+    dp = lax.dot_general(
+        do, vb, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [Bq, Bk]
+    ds = p * (dp - delta_ref[...].reshape(-1, 1)) * scale
+    return p, ds, do
+
+
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
+               scale, block_q, block_k, causal):
+    """Forward: one (batch·head, q-block, kv-block) program.
+
+    Block shapes: q_ref/o_ref [1, block_q, D]; k_ref/v_ref [1, block_k, D];
+    lse_ref [1, block_q, 1] (trailing singleton keeps the block 2D-tileable
+    on TPU).  acc/m/l scratch persists across the KV axis.
     """
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -61,21 +105,9 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
 
     @pl.when(diag_ok)
     def _accumulate():
-        q = q_ref[0].astype(jnp.float32) * scale  # [Bq, D]
-        kb = k_ref[0].astype(jnp.float32)         # [Bk, D]
+        s = _block_scores(q_ref, k_ref, qi, ki, scale, block_q, block_k,
+                          causal)
         vb = v_ref[0].astype(jnp.float32)
-        s = lax.dot_general(
-            q, kb, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # [Bq, Bk]
-        if causal:
-            q_pos = qi * block_q + lax.broadcasted_iota(
-                jnp.int32, (block_q, 1), 0
-            )
-            kv_pos = ki * block_k + lax.broadcasted_iota(
-                jnp.int32, (1, block_k), 1
-            )
-            s = jnp.where(q_pos >= kv_pos, s, _NEG)
         m = m_ref[:]
         blk_max = jnp.max(s, axis=-1, keepdims=True)
         new_m = jnp.maximum(m, blk_max)
@@ -93,13 +125,13 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
     def _finish():
         # every real row saw at least its own diagonal key, so l > 0; the
         # guard only shields padded Q rows, whose output is sliced off
-        o_ref[0] = (
-            acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)
-        ).astype(o_ref.dtype)
+        l = jnp.maximum(l_ref[:], 1e-30)
+        o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+        lse_ref[...] = (m_ref[:] + jnp.log(l)).reshape(1, -1, 1)
 
 
 def _fa_forward(q, k, v, scale, block_q, block_k, causal, interpret):
-    """[BH, T, D] inputs → [BH, T, D] output via the Pallas kernel."""
+    """[BH, T, D] inputs → ([BH, T, D] out, [BH, T, 1] lse)."""
     bh, t, d = q.shape
     grid = (bh, t // block_q, t // block_k)
     kernel = functools.partial(
@@ -108,14 +140,20 @@ def _fa_forward(q, k, v, scale, block_q, block_k, causal, interpret):
     )
     return pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, t, 1), jnp.float32),
+        ),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_specs=(
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+        ),
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -128,34 +166,145 @@ def _fa_forward(q, k, v, scale, block_q, block_k, causal, interpret):
     )(q, k, v)
 
 
-def _reference(q, k, v, causal, scale):
-    """Plain einsum attention on [BH, T, D] — the bwd recompute path."""
-    s = jnp.einsum(
-        "bqd,bkd->bqk", q, k, preferred_element_type=jnp.float32
-    ) * scale
-    if causal:
-        t = q.shape[1]
-        mask = jnp.arange(t)[:, None] >= jnp.arange(t)[None, :]
-        s = jnp.where(mask[None], s, _NEG)
-    p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bqk,bkd->bqd", p.astype(v.dtype), v).astype(q.dtype)
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               acc_ref, *, scale, block_q, block_k, causal):
+    """dQ: one (batch·head, q-block, kv-block) program; dQ in scratch."""
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    n_kv = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    diag_ok = (ki * block_k <= qi * block_q + block_q - 1) if causal else True
+
+    @pl.when(diag_ok)
+    def _accumulate():
+        _, ds, _ = _block_dscores(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qi, ki, scale,
+            block_q, block_k, causal,
+        )
+        kb = k_ref[0].astype(jnp.float32)
+        acc_ref[:] += lax.dot_general(
+            ds, kb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(ki == n_kv - 1)
+    def _finish():
+        dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
+                dv_ref, dk_acc, dv_acc, *, scale, block_q, block_k, causal):
+    """dK/dV: one (batch·head, kv-block, q-block) program; both in scratch."""
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    n_q = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    diag_ok = (ki * block_k <= qi * block_q + block_q - 1) if causal else True
+
+    @pl.when(diag_ok)
+    def _accumulate():
+        p, ds, do = _block_dscores(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qi, ki, scale,
+            block_q, block_k, causal,
+        )
+        dv_acc[:] += lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [Bk, D]
+        qb = q_ref[0].astype(jnp.float32)
+        dk_acc[:] += lax.dot_general(
+            ds, qb, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [Bk, D]
+
+    @pl.when(qi == n_q - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _fa_backward(q, k, v, out, lse, g, scale, block_q, block_k, causal,
+                 interpret):
+    """Fused flash backward on [BH, T, D] arrays → (dq, dk, dv)."""
+    bh, t, d = q.shape
+    delta = jnp.sum(
+        g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1,
+        keepdims=True,
+    )  # [BH, T, 1]
+
+    qspec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
+    kspec_dq = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
+    rowspec = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0))
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel, scale=scale, block_q=block_q, block_k=block_k,
+            causal=causal,
+        ),
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        grid=(bh, t // block_q, t // block_k),
+        in_specs=[qspec, kspec_dq, kspec_dq, qspec, rowspec, rowspec],
+        out_specs=qspec,
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+
+    # kv-major grid: q-row inputs are indexed by the INNER axis here
+    qspec_kv = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0))
+    kspec_kv = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0))
+    rowspec_kv = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, j, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel, scale=scale, block_q=block_q, block_k=block_k,
+            causal=causal,
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, t, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, t, d), v.dtype),
+        ),
+        grid=(bh, t // block_k, t // block_q),
+        in_specs=[qspec_kv, kspec_kv, kspec_kv, qspec_kv, rowspec_kv,
+                  rowspec_kv],
+        out_specs=(kspec_kv, kspec_kv),
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+    return dq, dk, dv
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _fa(q, k, v, scale, block_q, block_k, causal, interpret):
-    return _fa_forward(q, k, v, scale, block_q, block_k, causal, interpret)
+    out, _ = _fa_forward(q, k, v, scale, block_q, block_k, causal, interpret)
+    return out
 
 
 def _fa_fwd(q, k, v, scale, block_q, block_k, causal, interpret):
-    out = _fa_forward(q, k, v, scale, block_q, block_k, causal, interpret)
-    return out, (q, k, v)
+    out, lse = _fa_forward(q, k, v, scale, block_q, block_k, causal,
+                           interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _fa_bwd(scale, block_q, block_k, causal, interpret, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(lambda a, b, c: _reference(a, b, c, causal, scale),
-                     q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    return _fa_backward(q, k, v, out, lse, g, scale, block_q, block_k,
+                        causal, interpret)
 
 
 _fa.defvjp(_fa_fwd, _fa_bwd)
