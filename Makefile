@@ -54,6 +54,7 @@ GRPC_EXAMPLES := simple_grpc_infer_client \
                  reuse_infer_objects_grpc_client
 
 grpc_cpp: $(addprefix $(CPP_BUILD)/,$(GRPC_EXAMPLES)) \
+          $(CPP_BUILD)/simple_grpc_tpushm_client \
           $(CPP_BUILD)/cc_grpc_client_test $(CPP_BUILD)/hpack_unit_test
 
 $(PB_CPP)/inference.pb.cc: $(PROTO_DIR)/inference.proto $(PROTO_DIR)/model_config.proto
@@ -88,6 +89,16 @@ $(CPP_BUILD)/hpack_unit_test: $(CPP_DIR)/tests/hpack_unit_test.cc $(CPP_BUILD)/h
 $(addprefix $(CPP_BUILD)/,$(GRPC_EXAMPLES)): $(CPP_BUILD)/%: $(CPP_DIR)/examples/%.cc $(GRPC_OBJS)
 	mkdir -p $(CPP_BUILD)
 	$(CXX) $(CXXFLAGS) -o $@ $< $(GRPC_OBJS) $(GRPC_INC) $(GRPC_LINK)
+
+$(CPP_BUILD)/ctpushm.o: $(CPP_DIR)/shm/ctpushm.cc
+	mkdir -p $(CPP_BUILD)
+	$(CXX) $(CXXFLAGS) -c -o $@ $<
+
+# TPU-shm example links the libctpushm code directly (same TU the wheel
+# ships as libctpushm.so)
+$(CPP_BUILD)/simple_grpc_tpushm_client: $(CPP_DIR)/examples/simple_grpc_tpushm_client.cc $(GRPC_OBJS) $(CPP_BUILD)/ctpushm.o
+	mkdir -p $(CPP_BUILD)
+	$(CXX) $(CXXFLAGS) -o $@ $< $(GRPC_OBJS) $(CPP_BUILD)/ctpushm.o $(GRPC_INC) $(GRPC_LINK)
 
 $(CPP_BUILD)/cc_grpc_client_test: $(CPP_DIR)/tests/cc_grpc_client_test.cc $(GRPC_OBJS)
 	mkdir -p $(CPP_BUILD)

@@ -439,12 +439,12 @@ InferenceServerGrpcClient::UnregisterSystemSharedMemory(
 
 Error
 InferenceServerGrpcClient::TpuSharedMemoryStatus(
-    inference::CudaSharedMemoryStatusResponse* response,
+    inference::TpuSharedMemoryStatusResponse* response,
     const std::string& region_name)
 {
-  inference::CudaSharedMemoryStatusRequest request;
+  inference::TpuSharedMemoryStatusRequest request;
   request.set_name(region_name);
-  return Call("CudaSharedMemoryStatus", request, response);
+  return Call("TpuSharedMemoryStatus", request, response);
 }
 
 Error
@@ -452,22 +452,22 @@ InferenceServerGrpcClient::RegisterTpuSharedMemory(
     const std::string& name, const std::string& raw_handle, int device_id,
     size_t byte_size)
 {
-  inference::CudaSharedMemoryRegisterRequest request;
+  inference::TpuSharedMemoryRegisterRequest request;
   request.set_name(name);
   request.set_raw_handle(raw_handle);
   request.set_device_id(device_id);
   request.set_byte_size(byte_size);
-  inference::CudaSharedMemoryRegisterResponse response;
-  return Call("CudaSharedMemoryRegister", request, &response);
+  inference::TpuSharedMemoryRegisterResponse response;
+  return Call("TpuSharedMemoryRegister", request, &response);
 }
 
 Error
 InferenceServerGrpcClient::UnregisterTpuSharedMemory(const std::string& name)
 {
-  inference::CudaSharedMemoryUnregisterRequest request;
+  inference::TpuSharedMemoryUnregisterRequest request;
   request.set_name(name);
-  inference::CudaSharedMemoryUnregisterResponse response;
-  return Call("CudaSharedMemoryUnregister", request, &response);
+  inference::TpuSharedMemoryUnregisterResponse response;
+  return Call("TpuSharedMemoryUnregister", request, &response);
 }
 
 // ---------------------------------------------------------------------------

@@ -81,10 +81,11 @@ class InferenceServerGrpcClient {
       const std::string& name, const std::string& key, size_t byte_size,
       size_t offset = 0);
   Error UnregisterSystemSharedMemory(const std::string& name = "");
-  // TPU device-buffer regions ride the cuda-shm verbs of the KServe proto
-  // (the framework's CUDA-shm replacement — SURVEY §2.2 north star).
+  // TPU device-buffer regions: the framework's CUDA-shm replacement rides
+  // its own Tpu* RPC set (proto/inference.proto:50-55 — SURVEY §2.2 north
+  // star).
   Error TpuSharedMemoryStatus(
-      inference::CudaSharedMemoryStatusResponse* response,
+      inference::TpuSharedMemoryStatusResponse* response,
       const std::string& region_name = "");
   Error RegisterTpuSharedMemory(
       const std::string& name, const std::string& raw_handle, int device_id,

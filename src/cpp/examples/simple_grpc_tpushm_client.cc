@@ -1,0 +1,140 @@
+// Native TPU shared-memory example — the C++ face of the framework's
+// CUDA-shm replacement (SURVEY.md §3.5 north star; Python twin:
+// examples/simple_grpc_tpushm_client.py): allocate TPU regions through the
+// libctpushm C ABI, hand the serialized raw handle to the server over
+// gRPC, run infer with inputs and outputs referenced by region, and read
+// the results back through the region window — tensor bytes never ride the
+// request.
+//
+// Usage: simple_grpc_tpushm_client [-u host:port]
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <iostream>
+#include <string>
+#include <vector>
+
+#include "grpc_client.h"
+
+// libctpushm C ABI (src/cpp/shm/ctpushm.cc — linked into this binary; the
+// Python wheel loads the same code as libctpushm.so)
+#include "../shm/ctpushm.h"
+
+// shm windows outlive the process (POSIX): destroy on EVERY exit path so
+// failed runs don't accumulate /dev/shm/tpushm-* objects
+struct RegionGuard {
+  void* region;
+  ~RegionGuard() {
+    if (region != nullptr) TpuHbmRegionDestroy(region);
+  }
+};
+
+namespace tc = ctpu;
+
+#define FAIL_IF_ERR(X, MSG)                                 \
+  do {                                                      \
+    tc::Error err__ = (X);                                  \
+    if (!err__.IsOk()) {                                    \
+      fprintf(stderr, "error: %s: %s\n", (MSG),            \
+              err__.Message().c_str());                     \
+      return 1;                                             \
+    }                                                       \
+  } while (false)
+
+#define FAIL_IF_SHM(X, MSG)                                 \
+  do {                                                      \
+    if ((X) != 0) {                                         \
+      fprintf(stderr, "error: %s: %s\n", (MSG),            \
+              TpuHbmLastError());                           \
+      return 1;                                             \
+    }                                                       \
+  } while (false)
+
+int
+main(int argc, char** argv)
+{
+  std::string url = "localhost:8001";
+  for (int i = 1; i < argc - 1; ++i) {
+    if (!std::strcmp(argv[i], "-u")) url = argv[++i];
+  }
+
+  std::unique_ptr<tc::InferenceServerGrpcClient> client;
+  FAIL_IF_ERR(
+      tc::InferenceServerGrpcClient::Create(&client, url), "create client");
+
+  constexpr uint64_t kTensorBytes = 16 * sizeof(int32_t);
+  void* in_region = TpuHbmRegionCreate(2 * kTensorBytes, 0);
+  void* out_region = TpuHbmRegionCreate(2 * kTensorBytes, 0);
+  RegionGuard in_guard{in_region}, out_guard{out_region};
+  if (in_region == nullptr || out_region == nullptr) {
+    fprintf(stderr, "error: region create: %s\n", TpuHbmLastError());
+    return 1;
+  }
+
+  int32_t input0[16], input1[16];
+  for (int i = 0; i < 16; ++i) {
+    input0[i] = i;
+    input1[i] = 5;
+  }
+  FAIL_IF_SHM(
+      TpuHbmWrite(in_region, 0, input0, kTensorBytes), "write INPUT0");
+  FAIL_IF_SHM(
+      TpuHbmWrite(in_region, kTensorBytes, input1, kTensorBytes),
+      "write INPUT1");
+
+  // GetRawHandle returns the JSON length (>0) on success, negative on error
+  char in_handle[512], out_handle[512];
+  if (TpuHbmGetRawHandle(in_region, in_handle, sizeof(in_handle)) <= 0 ||
+      TpuHbmGetRawHandle(out_region, out_handle, sizeof(out_handle)) <= 0) {
+    fprintf(stderr, "error: raw handle: %s\n", TpuHbmLastError());
+    return 1;
+  }
+
+  client->UnregisterTpuSharedMemory();
+  FAIL_IF_ERR(
+      client->RegisterTpuSharedMemory(
+          "tpu_in_cc", in_handle, 0, 2 * kTensorBytes),
+      "register input region");
+  FAIL_IF_ERR(
+      client->RegisterTpuSharedMemory(
+          "tpu_out_cc", out_handle, 0, 2 * kTensorBytes),
+      "register output region");
+
+  tc::InferInput in0("INPUT0", {1, 16}, "INT32");
+  tc::InferInput in1("INPUT1", {1, 16}, "INT32");
+  in0.SetSharedMemory("tpu_in_cc", kTensorBytes, 0);
+  in1.SetSharedMemory("tpu_in_cc", kTensorBytes, kTensorBytes);
+  tc::InferRequestedOutput out0("OUTPUT0"), out1("OUTPUT1");
+  out0.SetSharedMemory("tpu_out_cc", kTensorBytes, 0);
+  out1.SetSharedMemory("tpu_out_cc", kTensorBytes, kTensorBytes);
+
+  tc::InferOptions options("simple");
+  tc::InferResult* result = nullptr;
+  FAIL_IF_ERR(
+      client->Infer(&result, options, {&in0, &in1}, {&out0, &out1}),
+      "inference failed");
+  std::unique_ptr<tc::InferResult> owner(result);
+
+  int32_t sum[16], diff[16];
+  FAIL_IF_SHM(TpuHbmRead(out_region, 0, sum, kTensorBytes), "read OUTPUT0");
+  FAIL_IF_SHM(
+      TpuHbmRead(out_region, kTensorBytes, diff, kTensorBytes),
+      "read OUTPUT1");
+  for (int i = 0; i < 16; ++i) {
+    std::cout << input0[i] << " + " << input1[i] << " = " << sum[i]
+              << std::endl;
+    if (sum[i] != input0[i] + input1[i] ||
+        diff[i] != input0[i] - input1[i]) {
+      std::cerr << "error: incorrect result in TPU region" << std::endl;
+      return 1;
+    }
+  }
+
+  FAIL_IF_ERR(
+      client->UnregisterTpuSharedMemory("tpu_in_cc"), "unregister input");
+  FAIL_IF_ERR(
+      client->UnregisterTpuSharedMemory("tpu_out_cc"), "unregister output");
+
+  std::cout << "PASS: simple_grpc_tpushm_client (native)" << std::endl;
+  return 0;
+}

@@ -18,6 +18,7 @@
 //   {"uuid", "pid", "device_id", "byte_size", "staging_key"}
 // generated here so every language binding shares one implementation.
 
+#include "ctpushm.h"
 #include <errno.h>
 #include <fcntl.h>
 #include <stdio.h>
@@ -106,15 +107,6 @@ bool json_uint_field(const std::string& js, const char* key, uint64_t* out) {
 }  // namespace
 
 extern "C" {
-
-enum TpuHbmStatus {
-  TPU_HBM_OK = 0,
-  TPU_HBM_ERR_OPEN = -1,
-  TPU_HBM_ERR_MAP = -2,
-  TPU_HBM_ERR_RANGE = -3,
-  TPU_HBM_ERR_HANDLE = -4,
-  TPU_HBM_ERR_PARSE = -5,
-};
 
 const char* TpuHbmLastError() { return g_last_error.c_str(); }
 

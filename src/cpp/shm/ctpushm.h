@@ -1,0 +1,40 @@
+// C ABI of libctpushm — TPU device-buffer regions with a POSIX-shm host
+// window (the framework's CUDA-shm replacement; Python wrapper:
+// client_tpu/utils/tpu_shared_memory).  One shared declaration set so every
+// consumer (the .so's own TU, native examples, sanitizer tests, non-Python
+// language bindings) drifts into a compile error instead of a runtime one.
+#pragma once
+
+#include <cstdint>
+
+extern "C" {
+
+enum TpuHbmStatus {
+  TPU_HBM_OK = 0,
+  TPU_HBM_ERR_OPEN = -1,
+  TPU_HBM_ERR_MAP = -2,
+  TPU_HBM_ERR_RANGE = -3,
+  TPU_HBM_ERR_HANDLE = -4,
+  TPU_HBM_ERR_PARSE = -5,
+};
+
+// Thread-local message for the most recent failure.
+const char* TpuHbmLastError();
+
+// Create a region (fresh uuid-keyed shm window); NULL on failure.
+void* TpuHbmRegionCreate(uint64_t byte_size, int device_id);
+// Attach a region created elsewhere from its raw JSON handle.
+void* TpuHbmRegionOpen(const char* raw_handle_json);
+// Byte-window IO; TpuHbmStatus return codes.
+int TpuHbmWrite(void* handle, uint64_t offset, const void* src,
+                uint64_t size);
+int TpuHbmRead(void* handle, uint64_t offset, void* dst, uint64_t size);
+void* TpuHbmBaseAddr(void* handle);
+uint64_t TpuHbmByteSize(void* handle);
+int TpuHbmDeviceId(void* handle);
+// Serialize the region's raw JSON handle into out (NUL-terminated).
+// Returns the JSON length (> 0) on success, a TpuHbmStatus (< 0) on error.
+int TpuHbmGetRawHandle(void* handle, char* out, uint64_t capacity);
+int TpuHbmRegionDestroy(void* handle);
+
+}  // extern "C"

@@ -301,7 +301,7 @@ TestSharedMemoryVerbs(tc::InferenceServerGrpcClient* client)
   CHECK_OK(client->SystemSharedMemoryStatus(&status));
   // Unregister-all must succeed even when empty.
   CHECK_OK(client->UnregisterSystemSharedMemory());
-  inference::CudaSharedMemoryStatusResponse tpu_status;
+  inference::TpuSharedMemoryStatusResponse tpu_status;
   CHECK_OK(client->TpuSharedMemoryStatus(&tpu_status));
   CHECK_OK(client->UnregisterTpuSharedMemory());
 }

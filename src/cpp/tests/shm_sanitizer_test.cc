@@ -7,6 +7,7 @@
 #include <cstdio>
 #include <cstring>
 #include <string>
+#include "../shm/ctpushm.h"
 
 extern "C" {
 // libcshm_tpu (src/cpp/shm/cshm.cc)
@@ -19,17 +20,6 @@ void* TpuShmBaseAddr(void* handle);
 uint64_t TpuShmByteSize(void* handle);
 int TpuShmClose(void* handle, int keep_key);
 
-// libctpushm (src/cpp/shm/ctpushm.cc)
-const char* TpuHbmLastError();
-void* TpuHbmRegionCreate(uint64_t byte_size, int device_id);
-void* TpuHbmRegionOpen(const char* raw_handle_json);
-int TpuHbmWrite(void* handle, uint64_t offset, const void* src, uint64_t n);
-int TpuHbmRead(void* handle, uint64_t offset, void* dst, uint64_t n);
-void* TpuHbmBaseAddr(void* handle);
-uint64_t TpuHbmByteSize(void* handle);
-int TpuHbmDeviceId(void* handle);
-int TpuHbmGetRawHandle(void* handle, char* out, uint64_t capacity);
-int TpuHbmRegionDestroy(void* handle);
 }
 
 static int g_failures = 0;

@@ -105,6 +105,7 @@ def test_native_grpc_examples(grpc_server):
                 "simple_grpc_model_control",
                 "simple_grpc_shm_client",
                 "simple_grpc_string_infer_client",
+                "simple_grpc_tpushm_client",
                 "reuse_infer_objects_grpc_client"):
         proc = subprocess.run(
             [os.path.join(_BUILD, exe), "-u", grpc_server.grpc_address],
