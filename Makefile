@@ -38,6 +38,7 @@ HTTP_EXAMPLES := simple_http_infer_client \
                  simple_http_async_infer_client \
                  simple_http_string_infer_client \
                  simple_http_shm_client \
+                 simple_http_sequence_sync_infer_client \
                  simple_http_model_control
 
 cpp: $(addprefix $(CPP_BUILD)/,$(HTTP_EXAMPLES)) $(CPP_BUILD)/cc_client_test \
@@ -51,6 +52,9 @@ GRPC_EXAMPLES := simple_grpc_infer_client \
                  simple_grpc_model_control \
                  simple_grpc_shm_client \
                  simple_grpc_string_infer_client \
+                 simple_grpc_ensemble_client \
+                 simple_grpc_decoupled_repeat_client \
+                 image_client \
                  reuse_infer_objects_grpc_client
 
 grpc_cpp: $(addprefix $(CPP_BUILD)/,$(GRPC_EXAMPLES)) \

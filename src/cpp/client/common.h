@@ -205,13 +205,38 @@ class InferResult {
     return Error::Success();
   }
 
-  // Classification-extension / JSON-rendered values.
+  // Classification-extension / BYTES values.  Typed-contents responses fill
+  // json_values directly; raw binary BYTES payloads carry the 4-byte-LE
+  // length framing, deserialized here exactly like the reference's
+  // InferResult::StringData.
   Error StringData(
       const std::string& name, std::vector<std::string>* values) const
   {
     auto it = outputs_.find(name);
     if (it == outputs_.end()) return Error("unknown output '" + name + "'");
-    *values = it->second.json_values;
+    if (!it->second.json_values.empty() || it->second.data == nullptr ||
+        it->second.datatype != "BYTES") {
+      // deframing only applies to BYTES payloads; typed tensors keep the
+      // pre-existing empty-vector behavior
+      *values = it->second.json_values;
+      return Error::Success();
+    }
+    values->clear();
+    const uint8_t* p = it->second.data;
+    size_t off = 0;
+    const size_t size = it->second.byte_size;
+    while (off + 4 <= size) {
+      const uint32_t len = uint32_t(p[off]) | (uint32_t(p[off + 1]) << 8) |
+                           (uint32_t(p[off + 2]) << 16) |
+                           (uint32_t(p[off + 3]) << 24);
+      off += 4;
+      if (off + len > size)
+        return Error("malformed BYTES framing in output '" + name + "'");
+      values->emplace_back(reinterpret_cast<const char*>(p) + off, len);
+      off += len;
+    }
+    if (off != size)
+      return Error("malformed BYTES framing in output '" + name + "'");
     return Error::Success();
   }
 

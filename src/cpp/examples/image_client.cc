@@ -1,0 +1,140 @@
+// Native image-classification client — the C++ analog of the reference's
+// flagship image_client.cc (reference src/c++/examples/image_client.cc:
+// 85-128 preprocess + classify via the classification extension), without
+// the OpenCV dependency: reads a raw float32 CHW file or synthesizes an
+// input, sizes it from the model's metadata, and prints the top-N
+// "score (index) = label" lines.
+//
+// Usage: image_client [-u host:port] [-m model] [-c top_n] [raw_f32_file]
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <iostream>
+#include <random>
+#include <string>
+#include <vector>
+
+#include "grpc_client.h"
+
+namespace tc = ctpu;
+
+#define FAIL_IF_ERR(X, MSG)                                 \
+  do {                                                      \
+    tc::Error err__ = (X);                                  \
+    if (!err__.IsOk()) {                                    \
+      fprintf(stderr, "error: %s: %s\n", (MSG),            \
+              err__.Message().c_str());                     \
+      return 1;                                             \
+    }                                                       \
+  } while (false)
+
+int
+main(int argc, char** argv)
+{
+  std::string url = "localhost:8001";
+  std::string model = "classifier";
+  int classes = 2;
+  std::string file;
+  for (int i = 1; i < argc; ++i) {
+    if (!std::strcmp(argv[i], "-u") && i + 1 < argc) url = argv[++i];
+    else if (!std::strcmp(argv[i], "-m") && i + 1 < argc) model = argv[++i];
+    else if (!std::strcmp(argv[i], "-c") && i + 1 < argc)
+      classes = std::atoi(argv[++i]);
+    else if (argv[i][0] != '-') file = argv[i];
+  }
+
+  std::unique_ptr<tc::InferenceServerGrpcClient> client;
+  FAIL_IF_ERR(
+      tc::InferenceServerGrpcClient::Create(&client, url), "create client");
+
+  // size the input tensor from the model's metadata (image_client.cc does
+  // the same via ParseModel)
+  inference::ModelMetadataResponse meta;
+  FAIL_IF_ERR(client->ModelMetadata(&meta, model), "model metadata");
+  if (meta.inputs_size() != 1 || meta.outputs_size() != 1) {
+    std::cerr << "error: expected a single-input single-output classifier"
+              << std::endl;
+    return 1;
+  }
+  const auto& spec = meta.inputs(0);
+  std::vector<int64_t> dims;
+  size_t elements = 1;
+  for (int64_t d : spec.shape()) {
+    dims.push_back(d < 0 ? 1 : d);
+    elements *= static_cast<size_t>(dims.back());
+  }
+  std::cout << "model " << model << ": input " << spec.name() << " x"
+            << elements << " " << spec.datatype() << std::endl;
+
+  std::vector<float> image(elements);
+  if (!file.empty()) {
+    std::ifstream in(file, std::ios::binary);
+    if (!in ||
+        !in.read(
+            reinterpret_cast<char*>(image.data()),
+            elements * sizeof(float))) {
+      std::cerr << "error: cannot read " << elements * sizeof(float)
+                << " bytes from " << file << std::endl;
+      return 1;
+    }
+  } else {
+    std::mt19937 rng(0);
+    std::normal_distribution<float> dist(0.f, 1.f);
+    for (float& v : image) v = dist(rng);
+  }
+
+  tc::InferInput input(spec.name(), dims, spec.datatype());
+  input.AppendRaw(
+      reinterpret_cast<const uint8_t*>(image.data()),
+      image.size() * sizeof(float));
+  tc::InferRequestedOutput output(meta.outputs(0).name(), classes);
+
+  tc::InferOptions options(model);
+  tc::InferResult* result = nullptr;
+  FAIL_IF_ERR(
+      client->Infer(&result, options, {&input}, {&output}),
+      "inference failed");
+  std::unique_ptr<tc::InferResult> owner(result);
+
+  // classification extension: top-N "score:index[:label]" strings
+  std::vector<std::string> entries;
+  FAIL_IF_ERR(
+      result->StringData(meta.outputs(0).name(), &entries), "classification");
+  if (static_cast<int>(entries.size()) != classes) {
+    std::cerr << "error: wanted top-" << classes << ", got "
+              << entries.size() << std::endl;
+    return 1;
+  }
+  double prev = 1e30;
+  for (const auto& entry : entries) {
+    const size_t c1 = entry.find(':');
+    if (c1 == std::string::npos) {
+      std::cerr << "error: malformed classification entry '" << entry << "'"
+                << std::endl;
+      return 1;
+    }
+    const size_t c2 = entry.find(':', c1 + 1);
+    double score = 0.0;
+    try {
+      score = std::stod(entry.substr(0, c1));
+    }
+    catch (...) {
+      std::cerr << "error: non-numeric score in '" << entry << "'"
+                << std::endl;
+      return 1;
+    }
+    const std::string idx = entry.substr(c1 + 1, c2 - c1 - 1);
+    const std::string label =
+        c2 == std::string::npos ? "" : entry.substr(c2 + 1);
+    std::cout << "  " << score << " (" << idx << ") = " << label
+              << std::endl;
+    if (score > prev) {
+      std::cerr << "error: classification not sorted" << std::endl;
+      return 1;
+    }
+    prev = score;
+  }
+  std::cout << "PASS: image_client (native)" << std::endl;
+  return 0;
+}
