@@ -1,0 +1,130 @@
+"""Int8 weight-only quantization with a Pallas dequant-matmul kernel.
+
+Weights quantize per-output-channel symmetric (int8 value × f32 scale); the
+kernel streams int8 weight tiles HBM→VMEM (half the DMA of bf16), runs the
+matmul with f32 accumulation over the K grid axis in VMEM scratch, and
+applies the channel scales once at the end — activations stay unquantized,
+so there is no activation calibration to manage.
+
+What it buys, measured on a v5e chip: ~1.8× smaller serving weights (the
+capacity to hold a ~2× larger model per chip), greedy decode that agrees
+with bf16, and identical per-step device time at sub-GB model sizes — at
+that scale decode is dispatch-bound, not HBM-bound, so the bandwidth win
+only turns into a latency win for weight footprints approaching the HBM
+bandwidth × step-time product.
+
+Grid (M tiles, N tiles, K tiles), K innermost/sequential — the same
+streamed-accumulator shape as client_tpu.ops.flash_attention.  Off-TPU the
+kernel runs in interpret mode, so CPU tests exercise the chip's code path.
+
+The reference stack has no quantization anywhere; this is a TPU-serving
+capability addition (pallas guide §"Quantization Kernels" pattern).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def quantize_int8(w):
+    """Per-output-channel symmetric int8 quantization of a [K, N] weight.
+
+    Returns {"q": int8 [K, N], "s": f32 [N]} with w ≈ q * s.
+    """
+    w32 = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(w32), axis=0)  # per output channel
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
+    return {"q": q, "s": scale}
+
+
+def is_quantized(w):
+    return isinstance(w, dict) and "q" in w and "s" in w
+
+
+def _int8_mm_kernel(x_ref, wq_ref, s_ref, o_ref, acc_ref, *, n_k):
+    """One (m-tile, n-tile, k-tile) program; f32 accumulator in scratch."""
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # dequant (sans scale) into x's dtype: bf16 holds all int8 values
+    # exactly, and the dot then runs at bf16 MXU rate with f32 accumulation
+    x = x_ref[...]                              # [bm, bk]
+    w = wq_ref[...].astype(x.dtype)             # [bk, bn]
+    acc_ref[:] += lax.dot_general(
+        x, w, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+    @pl.when(ki == n_k - 1)
+    def _finish():
+        # channel scales applied once after the K accumulation
+        o_ref[...] = (acc_ref[:] * s_ref[...]).astype(o_ref.dtype)
+
+
+def int8_matmul(x, qw, block_m=128, block_n=128, block_k=512,
+                interpret=None):
+    """``x @ (q * s)`` with int8 weight tiles streamed through VMEM.
+
+    Args:
+      x: [..., K] activations (any float dtype; leading dims fold into M).
+      qw: dict from :func:`quantize_int8` ({"q": int8 [K, N], "s": f32 [N]}).
+
+    Returns [..., N] in x's dtype.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    q, s = qw["q"], qw["s"]
+    k, n = q.shape
+    lead = x.shape[:-1]
+    m = 1
+    for d in lead:
+        m *= d
+    x2 = x.reshape(m, k)
+
+    # tile sizes: sublane/lane-aligned, clamped to padded dims
+    bm = min(block_m, max(8, -(-m // 8) * 8))
+    bn = min(block_n, n)
+    bk = min(block_k, k)
+    pad_m = (-m) % bm
+    if n % bn or k % bk:
+        # ragged weight dims: dequantized jnp fallback (rare — projection
+        # widths are MXU-shaped multiples in every shipped config)
+        w = q.astype(x.dtype) * s.astype(x.dtype)
+        return (x2[:m] @ w).reshape(*lead, n)
+    if pad_m:
+        x2 = jnp.pad(x2, ((0, pad_m), (0, 0)))
+
+    grid = ((m + pad_m) // bm, n // bn, k // bk)
+    out = pl.pallas_call(
+        functools.partial(_int8_mm_kernel, n_k=grid[2]),
+        out_shape=jax.ShapeDtypeStruct((m + pad_m, n), x.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk)),
+            pl.BlockSpec((bk, bn), lambda i, j, kk: (kk, j)),
+            pl.BlockSpec((1, bn), lambda i, j, kk: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(x2, q, s.reshape(1, n))
+    if pad_m:
+        out = out[:m]
+    return out.reshape(*lead, n)
+
+
+def matmul(x, w, **kwargs):
+    """Dispatch helper: plain ``x @ w`` or the int8 kernel for quantized w."""
+    if is_quantized(w):
+        return int8_matmul(x, w, **kwargs)
+    return x @ w

@@ -32,6 +32,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from client_tpu.ops.quant import matmul as _mm
 from client_tpu.parallel.ring_attention import (
     plain_attention,
     ring_attention_sharded,
@@ -145,9 +146,9 @@ def _attention_block(layer, x, cfg, positions, mesh, attn_impl):
     b, t, _ = x.shape
     hd = cfg.head_dim
     h = _rms_norm(x, layer["ln_attn"])
-    q = (h @ layer["attn"]["wq"]).reshape(b, t, cfg.n_heads, hd)
-    k = (h @ layer["attn"]["wk"]).reshape(b, t, cfg.n_kv_heads, hd)
-    v = (h @ layer["attn"]["wv"]).reshape(b, t, cfg.n_kv_heads, hd)
+    q = _mm(h, layer["attn"]["wq"]).reshape(b, t, cfg.n_heads, hd)
+    k = _mm(h, layer["attn"]["wk"]).reshape(b, t, cfg.n_kv_heads, hd)
+    v = _mm(h, layer["attn"]["wv"]).reshape(b, t, cfg.n_kv_heads, hd)
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
     n_rep = cfg.n_heads // cfg.n_kv_heads
@@ -172,15 +173,15 @@ def _attention_block(layer, x, cfg, positions, mesh, attn_impl):
     else:
         attn = plain_attention(q, _repeat_kv(k, n_rep), _repeat_kv(v, n_rep))
 
-    out = attn.reshape(b, t, cfg.n_heads * hd) @ layer["attn"]["wo"]
+    out = _mm(attn.reshape(b, t, cfg.n_heads * hd), layer["attn"]["wo"])
     return x + out, (k, v)
 
 
 def _mlp_block(layer, x):
     h = _rms_norm(x, layer["ln_mlp"])
-    gate = jax.nn.silu(h @ layer["mlp"]["w_gate"])
-    up = h @ layer["mlp"]["w_up"]
-    return x + (gate * up) @ layer["mlp"]["w_down"]
+    gate = jax.nn.silu(_mm(h, layer["mlp"]["w_gate"]))
+    up = _mm(h, layer["mlp"]["w_up"])
+    return x + _mm(gate * up, layer["mlp"]["w_down"])
 
 
 def _moe_block(layer, x, cfg):
@@ -242,6 +243,17 @@ def forward(params, tokens, cfg, mesh=None, attn_impl="plain",
     per-layer router load-balance loss (0 for dense configs).
     """
     b, t = tokens.shape
+    if mesh is not None:
+        from client_tpu.ops.quant import is_quantized
+
+        if is_quantized(params["lm_head"]):
+            # the int8 pallas_call has no partitioning rule; GSPMD would
+            # silently gather sharded activations into it (same hazard the
+            # flash branch guards against)
+            raise ValueError(
+                "quantized params are single-device serving weights; "
+                "dequantize or drop the mesh"
+            )
     x = jnp.take(params["embed"], tokens, axis=0)
     if mesh is not None:
         x = lax.with_sharding_constraint(
@@ -254,7 +266,7 @@ def forward(params, tokens, cfg, mesh=None, attn_impl="plain",
         x, aux = _ffn_block(layer, x, cfg)
         aux_total = aux_total + aux
     x = _rms_norm(x, params["ln_f"])
-    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    logits = _mm(x, params["lm_head"]).astype(jnp.float32)
     if mesh is not None:
         logits = lax.with_sharding_constraint(
             logits, NamedSharding(mesh, P("dp", "sp", "tp"))
@@ -292,7 +304,7 @@ def prefill(params, tokens, cfg, cache):
         )
         x, _ = _ffn_block(layer, x, cfg)
     x = _rms_norm(x, params["ln_f"])
-    logits = (x[:, -1] @ params["lm_head"]).astype(jnp.float32)
+    logits = _mm(x[:, -1], params["lm_head"]).astype(jnp.float32)
     cache["len"] = jnp.full((b,), t, jnp.int32)
     return logits, cache
 
@@ -305,9 +317,9 @@ def decode_step(params, token, cfg, cache):
     for i, layer in enumerate(params["layers"]):
         hd = cfg.head_dim
         h = _rms_norm(x, layer["ln_attn"])
-        q = (h @ layer["attn"]["wq"]).reshape(b, 1, cfg.n_heads, hd)
-        k = (h @ layer["attn"]["wk"]).reshape(b, 1, cfg.n_kv_heads, hd)
-        v = (h @ layer["attn"]["wv"]).reshape(b, 1, cfg.n_kv_heads, hd)
+        q = _mm(h, layer["attn"]["wq"]).reshape(b, 1, cfg.n_heads, hd)
+        k = _mm(h, layer["attn"]["wk"]).reshape(b, 1, cfg.n_kv_heads, hd)
+        v = _mm(h, layer["attn"]["wv"]).reshape(b, 1, cfg.n_kv_heads, hd)
         q = _rope(q, pos[:, None], cfg.rope_theta)
         k = _rope(k, pos[:, None], cfg.rope_theta)
         # write this step's k/v at position `pos` (same for all batch rows in
@@ -328,11 +340,11 @@ def decode_step(params, token, cfg, cache):
         s = jnp.where(valid[:, None, None, :], s, -1e30)
         p = jax.nn.softmax(s, axis=-1)
         attn = jnp.einsum("bhqk,bkhd->bqhd", p.astype(vv.dtype), vv)
-        out = attn.reshape(b, 1, cfg.n_heads * hd) @ layer["attn"]["wo"]
+        out = _mm(attn.reshape(b, 1, cfg.n_heads * hd), layer["attn"]["wo"])
         x = x + out.astype(x.dtype)
         x, _ = _ffn_block(layer, x, cfg)
     x = _rms_norm(x, params["ln_f"])
-    logits = (x[:, 0] @ params["lm_head"]).astype(jnp.float32)
+    logits = _mm(x[:, 0], params["lm_head"]).astype(jnp.float32)
     cache["len"] = pos + 1
     return logits, cache
 
@@ -380,6 +392,40 @@ def make_train_step(cfg, mesh=None, attn_impl="plain", learning_rate=1e-3):
     )
 
 
+def quantize_params(params):
+    """Int8 weight-only quantization of the serving weights.
+
+    Every 2D projection (attention, dense MLP, LM head) becomes a
+    {"q": int8, "s": f32} pair consumed by the Pallas dequant-matmul
+    (client_tpu.ops.quant) — halving weight HBM traffic on the
+    bandwidth-bound decode path.  The embedding stays full-precision (it is
+    a gather, not a matmul); MoE expert stacks keep their einsum path.
+    This is a serving transform: quantized params are not trainable.
+    """
+    from client_tpu.ops.quant import quantize_int8
+
+    def q_layer(layer):
+        out = {
+            "attn": {k: quantize_int8(w) for k, w in layer["attn"].items()},
+            "ln_attn": layer["ln_attn"],
+            "ln_mlp": layer["ln_mlp"],
+        }
+        if "mlp" in layer:
+            out["mlp"] = {
+                k: quantize_int8(w) for k, w in layer["mlp"].items()
+            }
+        if "moe" in layer:
+            out["moe"] = layer["moe"]
+        return out
+
+    return {
+        "embed": params["embed"],
+        "layers": [q_layer(layer) for layer in params["layers"]],
+        "ln_f": params["ln_f"],
+        "lm_head": quantize_int8(params["lm_head"]),
+    }
+
+
 def stack_pipeline_params(params, n_stages):
     """Re-lay the per-layer list as pipeline stages (parallel.pipeline)."""
     from client_tpu.parallel.pipeline import stack_stage_params
@@ -416,7 +462,7 @@ def forward_pipelined(pparams, tokens, cfg, mesh, n_microbatches):
 
     x = pipeline_apply(stage_fn, pparams["stages"], x, mesh, n_microbatches)
     x = _rms_norm(x, pparams["ln_f"])
-    return (x @ pparams["lm_head"]).astype(jnp.float32)
+    return _mm(x, pparams["lm_head"]).astype(jnp.float32)
 
 
 def make_pipeline_train_step(cfg, mesh, n_microbatches, learning_rate=1e-3):
