@@ -86,7 +86,7 @@ def detokenizer_model(name="detokenizer"):
 class _LmRunner:
     """Owns the transformer params + jitted decode programs."""
 
-    def __init__(self, cfg=None, seed=0):
+    def __init__(self, cfg=None, seed=0, quantize=False, params=None):
         self.cfg = cfg or tfm.TransformerConfig(
             vocab_size=_VOCAB,
             d_model=256,
@@ -96,7 +96,13 @@ class _LmRunner:
             d_ff=768,
             max_seq=512,
         )
-        self.params = tfm.init_params(jax.random.PRNGKey(seed), self.cfg)
+        if params is None:
+            params = tfm.init_params(jax.random.PRNGKey(seed), self.cfg)
+        self.params = params
+        if quantize:
+            # int8 weight-only serving (client_tpu.ops.quant): ~2x weight
+            # capacity per chip, same decode programs via the _mm dispatch
+            self.params = tfm.quantize_params(self.params)
 
     def check_prompt(self, n_prompt_tokens):
         """Reject prompts the KV cache cannot hold with a clear 400 instead
@@ -190,11 +196,22 @@ def text_ensemble_model(name="text_generator", runner=None):
 
 
 def language_models(shared_runner=True):
-    """The full language set; one shared LM runner keeps params/compile warm."""
+    """The full language set; one shared LM runner keeps params/compile warm.
+
+    ``lm_streaming_int8`` serves the same architecture from int8-quantized
+    weights (weight-only; client_tpu.ops.quant).
+    """
     runner = _LmRunner() if shared_runner else None
+    # the int8 runner quantizes the SHARED weights (no second param init)
+    int8_runner = _LmRunner(
+        cfg=runner.cfg if runner else None,
+        params=runner.params if runner else None,
+        quantize=True,
+    )
     return [
         tokenizer_model(),
         detokenizer_model(),
         lm_streaming_model(runner=runner),
+        lm_streaming_model(name="lm_streaming_int8", runner=int8_runner),
         text_ensemble_model(runner=runner),
     ]

@@ -40,6 +40,10 @@ def server(runner):
         tokenizer_model(),
         detokenizer_model(),
         lm_streaming_model(runner=runner),
+        lm_streaming_model(
+            name="lm_streaming_int8",
+            runner=_LmRunner(cfg=_TINY, quantize=True),
+        ),
         text_ensemble_model(runner=runner),
     ]
     with Server(models=models, grpc_port=0, with_default_models=False) as s:
@@ -108,8 +112,10 @@ def test_detokenizer_model(client):
     assert res.as_numpy("TEXT")[0] == b"roundtrip"
 
 
-def test_lm_streaming_over_grpc(client):
-    """One decoupled response per generated token, in order."""
+@pytest.mark.parametrize("model_name", ["lm_streaming", "lm_streaming_int8"])
+def test_lm_streaming_over_grpc(client, model_name):
+    """One decoupled response per generated token, in order — same protocol
+    from the bf16 and the int8-quantized LM."""
     results = queue.Queue()
     client.start_stream(
         callback=lambda result, error: results.put((result, error))
@@ -119,7 +125,7 @@ def test_lm_streaming_over_grpc(client):
     t_in.set_data_from_numpy(prompt)
     m_in = grpcclient.InferInput("MAX_TOKENS", [1], "INT32")
     m_in.set_data_from_numpy(np.array([6], dtype=np.int32))
-    client.async_stream_infer("lm_streaming", [t_in, m_in])
+    client.async_stream_infer(model_name, [t_in, m_in])
     tokens = []
     for _ in range(6):
         result, error = results.get(timeout=30)
