@@ -1,0 +1,90 @@
+"""Training checkpoint / resume for the model families (orbax-backed).
+
+The serving stack already has the reference's checkpoint-reuse semantics
+(InferInput.Reset, sequence-id reuse — SURVEY §5.4); this module adds the
+framework-scale counterpart the reference never needed: durable training
+state.  A CheckpointManager wraps orbax with the two properties multi-chip
+training needs:
+
+- **sharding-aware restore**: pass the live (possibly mesh-sharded) state
+  as ``template`` and each leaf is restored onto its donor's sharding —
+  params land back on the dp/tp/sp/ep/pp mesh with no host-side gather.
+- **atomic, retention-managed steps**: orbax writes to a temp dir and
+  renames, so a killed run never sees a torn checkpoint; ``max_to_keep``
+  bounds disk.
+
+Works on any backend (tests run it on the CPU mesh); async save is off by
+default to keep the API synchronous and deterministic.
+"""
+
+import jax
+import orbax.checkpoint as ocp
+
+
+class CheckpointManager:
+    """Save/restore (params, opt_state, step) training state.
+
+    Usage::
+
+        mgr = CheckpointManager(dir, max_to_keep=3)
+        mgr.save(step, params=params, opt_state=opt_state)
+        ...
+        restored = mgr.restore(template={"params": params,
+                                         "opt_state": opt_state})
+        params, opt_state = restored["params"], restored["opt_state"]
+        start = mgr.latest_step() + 1
+    """
+
+    def __init__(self, directory, max_to_keep=3):
+        import os
+
+        self._dir = os.path.abspath(str(directory))
+        self._mgr = ocp.CheckpointManager(
+            self._dir,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                enable_async_checkpointing=False,
+            ),
+        )
+
+    def save(self, step, /, **state):
+        """Write one atomic checkpoint for ``step`` (kwargs form the tree)."""
+        self._mgr.save(step, args=ocp.args.StandardSave(dict(state)))
+        self._mgr.wait_until_finished()
+
+    def latest_step(self):
+        """Newest retained step, or None if the directory holds none."""
+        return self._mgr.latest_step()
+
+    def restore(self, template, step=None):
+        """Restore ``step`` (default: latest) shaped/sharded like template.
+
+        Every leaf comes back with the template leaf's dtype and sharding —
+        a mesh-sharded template restores straight onto the mesh.
+        """
+        if step is None:
+            step = self._mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {self._dir}")
+        abstract = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(
+                x.shape, x.dtype,
+                sharding=getattr(x, "sharding", None),
+            ),
+            template,
+        )
+        return self._mgr.restore(
+            step, args=ocp.args.StandardRestore(abstract)
+        )
+
+    def all_steps(self):
+        return list(self._mgr.all_steps())
+
+    def close(self):
+        self._mgr.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
