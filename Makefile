@@ -39,6 +39,9 @@ HTTP_EXAMPLES := simple_http_infer_client \
                  simple_http_string_infer_client \
                  simple_http_shm_client \
                  simple_http_sequence_sync_infer_client \
+                 simple_http_ensemble_client \
+                 simple_http_infer_multi_client \
+                 reuse_infer_objects_http_client \
                  simple_http_model_control
 
 cpp: $(addprefix $(CPP_BUILD)/,$(HTTP_EXAMPLES)) $(CPP_BUILD)/cc_client_test \
@@ -54,6 +57,8 @@ GRPC_EXAMPLES := simple_grpc_infer_client \
                  simple_grpc_string_infer_client \
                  simple_grpc_ensemble_client \
                  simple_grpc_decoupled_repeat_client \
+                 simple_grpc_custom_args_client \
+                 simple_grpc_timeout_client \
                  image_client \
                  reuse_infer_objects_grpc_client
 

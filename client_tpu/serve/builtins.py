@@ -6,6 +6,8 @@ see reference src/python/examples/*), so the examples and tests here run
 hermetically. JAX/TPU models live in client_tpu.serve.models.
 """
 
+import time
+
 import numpy as np
 
 from client_tpu.serve.model_runtime import Model, TensorSpec
@@ -73,6 +75,22 @@ def identity_model(name="identity", datatype="FP32"):
         name,
         inputs=[TensorSpec("INPUT0", datatype, [-1])],
         outputs=[TensorSpec("OUTPUT0", datatype, [-1])],
+        fn=fn,
+    )
+
+
+def slow_identity_model(delay_s=0.05):
+    """Identity with a fixed server-side delay — the timeout-behavior test
+    model (the reference ships delay models for the same purpose)."""
+
+    def fn(inputs, params, ctx):
+        time.sleep(delay_s)
+        return {"OUTPUT0": inputs["INPUT0"]}
+
+    return Model(
+        "slow_identity",
+        inputs=[TensorSpec("INPUT0", "INT32", [-1])],
+        outputs=[TensorSpec("OUTPUT0", "INT32", [-1])],
         fn=fn,
     )
 
@@ -184,6 +202,7 @@ def default_models():
         identity_model("identity_bytes", "BYTES"),
         identity_model("identity_int32", "INT32"),
         identity_model("identity_int8", "INT8"),
+        slow_identity_model(),
         sequence_model(),
         decoupled_model(),
         classification_model(),

@@ -1,0 +1,118 @@
+// Native HTTP object-reuse example — the HTTP twin of
+// reuse_infer_objects_grpc_client.cc (reference
+// src/c++/examples/reuse_infer_objects_client.cc): one InferInput set
+// serves many requests via Reset + AppendRaw, including a switch to
+// shared-memory payloads and back.
+//
+// Usage: reuse_infer_objects_http_client [-u host:port]
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <iostream>
+#include <string>
+#include <vector>
+
+#include "http_client.h"
+#include "shm_utils.h"
+
+namespace tc = ctpu;
+
+#define FAIL_IF_ERR(X, MSG)                                 \
+  do {                                                      \
+    tc::Error err__ = (X);                                  \
+    if (!err__.IsOk()) {                                    \
+      fprintf(stderr, "error: %s: %s\n", (MSG),            \
+              err__.Message().c_str());                     \
+      return 1;                                             \
+    }                                                       \
+  } while (false)
+
+int
+main(int argc, char** argv)
+{
+  std::string url = "localhost:8000";
+  for (int i = 1; i < argc - 1; ++i) {
+    if (!std::strcmp(argv[i], "-u")) url = argv[++i];
+  }
+
+  std::unique_ptr<tc::InferenceServerHttpClient> client;
+  FAIL_IF_ERR(
+      tc::InferenceServerHttpClient::Create(&client, url), "create client");
+
+  tc::InferInput in0("INPUT0", {1, 16}, "INT32");
+  tc::InferInput in1("INPUT1", {1, 16}, "INT32");
+  tc::InferOptions options("simple");
+
+  // rounds 0-2: raw buffers through the same objects
+  for (int round = 0; round < 3; ++round) {
+    std::vector<int32_t> input0(16), input1(16);
+    for (int i = 0; i < 16; ++i) {
+      input0[i] = round * 10 + i;
+      input1[i] = round;
+    }
+    FAIL_IF_ERR(in0.Reset(), "reset INPUT0");
+    FAIL_IF_ERR(in1.Reset(), "reset INPUT1");
+    in0.AppendRaw(
+        reinterpret_cast<const uint8_t*>(input0.data()),
+        input0.size() * sizeof(int32_t));
+    in1.AppendRaw(
+        reinterpret_cast<const uint8_t*>(input1.data()),
+        input1.size() * sizeof(int32_t));
+    tc::InferResultPtr result;
+    FAIL_IF_ERR(client->Infer(&result, options, {&in0, &in1}), "infer");
+    const uint8_t* data = nullptr;
+    size_t size = 0;
+    FAIL_IF_ERR(result->RawData("OUTPUT0", &data, &size), "OUTPUT0");
+    const int32_t* sum = reinterpret_cast<const int32_t*>(data);
+    for (int i = 0; i < 16; ++i) {
+      if (sum[i] != input0[i] + input1[i]) {
+        std::cerr << "error: wrong sum in round " << round << std::endl;
+        return 1;
+      }
+    }
+    std::cout << "raw round " << round << " ok" << std::endl;
+  }
+
+  // final round: the SAME objects switch to a shared-memory payload
+  constexpr size_t kTensorBytes = 16 * sizeof(int32_t);
+  const std::string key = "/reuse_http_in";
+  tc::UnlinkSharedMemoryRegion(key);
+  client->UnregisterSystemSharedMemory("reuse_http_in");
+  int fd = -1;
+  void* addr = nullptr;
+  FAIL_IF_ERR(
+      tc::CreateSharedMemoryRegion(key, 2 * kTensorBytes, &fd), "create shm");
+  FAIL_IF_ERR(tc::MapSharedMemory(fd, 0, 2 * kTensorBytes, &addr), "map shm");
+  int32_t* p = static_cast<int32_t*>(addr);
+  for (int i = 0; i < 16; ++i) {
+    p[i] = 1000 + i;
+    p[16 + i] = 1;
+  }
+  FAIL_IF_ERR(
+      client->RegisterSystemSharedMemory(
+          "reuse_http_in", key, 2 * kTensorBytes),
+      "register shm");
+  FAIL_IF_ERR(in0.Reset(), "reset INPUT0");
+  FAIL_IF_ERR(in1.Reset(), "reset INPUT1");
+  in0.SetSharedMemory("reuse_http_in", kTensorBytes, 0);
+  in1.SetSharedMemory("reuse_http_in", kTensorBytes, kTensorBytes);
+  tc::InferResultPtr result;
+  FAIL_IF_ERR(client->Infer(&result, options, {&in0, &in1}), "shm infer");
+  const uint8_t* data = nullptr;
+  size_t size = 0;
+  FAIL_IF_ERR(result->RawData("OUTPUT0", &data, &size), "OUTPUT0");
+  const int32_t* sum = reinterpret_cast<const int32_t*>(data);
+  for (int i = 0; i < 16; ++i) {
+    if (sum[i] != 1000 + i + 1) {
+      std::cerr << "error: wrong shm-round sum" << std::endl;
+      return 1;
+    }
+  }
+  std::cout << "shm round ok (same objects)" << std::endl;
+  client->UnregisterSystemSharedMemory("reuse_http_in");
+  tc::UnmapSharedMemory(addr, 2 * kTensorBytes);
+  tc::CloseSharedMemory(fd);
+  tc::UnlinkSharedMemoryRegion(key);
+  std::cout << "PASS: reuse_infer_objects_http_client (native)" << std::endl;
+  return 0;
+}

@@ -1,0 +1,81 @@
+// Native gRPC client-timeout example: a generous deadline succeeds; a
+// microscopic one must surface DEADLINE_EXCEEDED as a clean Error, and the
+// connection must remain usable afterwards (the reference's
+// client_timeout test behavior in cc_client_test.cc).
+//
+// Usage: simple_grpc_timeout_client [-u host:port]
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <iostream>
+#include <string>
+#include <vector>
+
+#include "grpc_client.h"
+
+namespace tc = ctpu;
+
+#define FAIL_IF_ERR(X, MSG)                                 \
+  do {                                                      \
+    tc::Error err__ = (X);                                  \
+    if (!err__.IsOk()) {                                    \
+      fprintf(stderr, "error: %s: %s\n", (MSG),            \
+              err__.Message().c_str());                     \
+      return 1;                                             \
+    }                                                       \
+  } while (false)
+
+static tc::Error
+DoInfer(
+    tc::InferenceServerGrpcClient* client, uint64_t client_timeout_us,
+    tc::InferResult** result)
+{
+  // slow_identity sleeps 50ms server-side — the deterministic way to make
+  // a deadline race winnable (the reference uses delay models the same way)
+  static std::vector<int32_t> values(8, 7);
+  tc::InferInput in0("INPUT0", {8}, "INT32");
+  in0.AppendRaw(
+      reinterpret_cast<const uint8_t*>(values.data()), 8 * sizeof(int32_t));
+  tc::InferOptions options("slow_identity");
+  options.client_timeout_us = client_timeout_us;
+  return client->Infer(result, options, {&in0});
+}
+
+int
+main(int argc, char** argv)
+{
+  std::string url = "localhost:8001";
+  for (int i = 1; i < argc - 1; ++i) {
+    if (!std::strcmp(argv[i], "-u")) url = argv[++i];
+  }
+
+  std::unique_ptr<tc::InferenceServerGrpcClient> client;
+  FAIL_IF_ERR(
+      tc::InferenceServerGrpcClient::Create(&client, url), "create client");
+
+  // generous deadline: must succeed
+  tc::InferResult* result = nullptr;
+  FAIL_IF_ERR(DoInfer(client.get(), 30 * 1000 * 1000, &result), "generous");
+  delete result;
+  std::cout << "30s deadline on 50ms model: ok" << std::endl;
+
+  // 5ms against a 50ms model: must fail with a deadline error, not hang
+  result = nullptr;
+  tc::Error err = DoInfer(client.get(), 5 * 1000, &result);
+  if (err.IsOk()) {
+    std::cerr << "error: 5ms deadline never expired on the 50ms model"
+              << std::endl;
+    delete result;
+    return 1;
+  }
+  std::cout << "5ms deadline on 50ms model: failed as expected ("
+            << err.Message() << ")" << std::endl;
+
+  // the connection stays usable after the deadline error
+  result = nullptr;
+  FAIL_IF_ERR(DoInfer(client.get(), 0, &result), "post-timeout request");
+  delete result;
+  std::cout << "connection usable after timeout" << std::endl;
+  std::cout << "PASS: simple_grpc_timeout_client (native)" << std::endl;
+  return 0;
+}

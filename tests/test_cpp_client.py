@@ -17,7 +17,7 @@ needs_cpp = pytest.mark.skipif(
     # instead of erroring on the missing example
     not all(
         os.path.exists(os.path.join(_BUILD, exe))
-        for exe in ("cc_client_test", "simple_http_sequence_sync_infer_client")
+        for exe in ("cc_client_test", "reuse_infer_objects_http_client")
     ),
     reason="native client not built (make cpp)",
 )
@@ -47,6 +47,9 @@ def test_native_http_examples(server):
                 "simple_http_string_infer_client",
                 "simple_http_shm_client",
                 "simple_http_sequence_sync_infer_client",
+                "simple_http_ensemble_client",
+                "simple_http_infer_multi_client",
+                "reuse_infer_objects_http_client",
                 "simple_http_model_control"):
         proc = subprocess.run(
             [os.path.join(_BUILD, exe), "-u", server.http_address],
@@ -59,7 +62,7 @@ def test_native_http_examples(server):
 needs_grpc_cpp = pytest.mark.skipif(
     not all(
         os.path.exists(os.path.join(_BUILD, exe))
-        for exe in ("cc_grpc_client_test", "image_client")
+        for exe in ("cc_grpc_client_test", "simple_grpc_timeout_client")
     ),
     reason="native gRPC client not built (make grpc_cpp)",
 )
@@ -109,6 +112,8 @@ def test_native_grpc_examples(grpc_server):
                 "simple_grpc_tpushm_client",
                 "simple_grpc_ensemble_client",
                 "simple_grpc_decoupled_repeat_client",
+                "simple_grpc_custom_args_client",
+                "simple_grpc_timeout_client",
                 "image_client",
                 "reuse_infer_objects_grpc_client"):
         proc = subprocess.run(
