@@ -62,12 +62,15 @@ def _block_scores(q_ref, k_ref, qi, ki, scale, block_q, block_k, causal):
     return s
 
 
-def _block_dscores(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qi, ki,
-                   scale, block_q, block_k, causal):
+def _block_dscores(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, glse_ref,
+                   qi, ki, scale, block_q, block_k, causal):
     """Backward softmax-Jacobian for one block pair: returns (p, ds, do32).
 
-    p = exp(s − lse) recomputed from the saved LSE; ds = p·(dO·Vᵀ − delta)
-    ·scale — shared verbatim by the dQ and dK/dV kernels.
+    p = exp(s − lse) recomputed from the saved LSE;
+    ds = p·(dO·Vᵀ − delta + dLSE)·scale — the dLSE term carries the
+    cotangent of the forward's log-sum-exp output (∂lse_i/∂s_ij = p_ij),
+    zero when only the attention output is differentiated.  Shared verbatim
+    by the dQ and dK/dV kernels.
     """
     s = _block_scores(q_ref, k_ref, qi, ki, scale, block_q, block_k, causal)
     p = jnp.exp(s - lse_ref[...].reshape(-1, 1))  # [Bq, Bk]
@@ -77,7 +80,9 @@ def _block_dscores(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qi, ki,
         do, vb, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     )  # [Bq, Bk]
-    ds = p * (dp - delta_ref[...].reshape(-1, 1)) * scale
+    row = (dp - delta_ref[...].reshape(-1, 1)
+           + glse_ref[...].reshape(-1, 1))
+    ds = p * row * scale
     return p, ds, do
 
 
@@ -130,6 +135,15 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
         lse_ref[...] = (m_ref[:] + jnp.log(l)).reshape(1, -1, 1)
 
 
+def _sds(shape, dtype, like):
+    """ShapeDtypeStruct carrying the varying-mesh-axes of ``like`` so
+    pallas_call outputs type-check under shard_map's check_vma."""
+    vma = tuple(jax.typeof(like).vma) if hasattr(jax, "typeof") else None
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=frozenset(vma))
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _fa_forward(q, k, v, scale, block_q, block_k, causal, interpret):
     """[BH, T, D] inputs → ([BH, T, D] out, [BH, T, 1] lse)."""
     bh, t, d = q.shape
@@ -141,8 +155,8 @@ def _fa_forward(q, k, v, scale, block_q, block_k, causal, interpret):
     return pl.pallas_call(
         kernel,
         out_shape=(
-            jax.ShapeDtypeStruct((bh, t, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, t, 1), jnp.float32),
+            _sds((bh, t, d), q.dtype, q),
+            _sds((bh, t, 1), jnp.float32, q),
         ),
         grid=grid,
         in_specs=[
@@ -166,8 +180,8 @@ def _fa_forward(q, k, v, scale, block_q, block_k, causal, interpret):
     )(q, k, v)
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               acc_ref, *, scale, block_q, block_k, causal):
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, glse_ref,
+               dq_ref, acc_ref, *, scale, block_q, block_k, causal):
     """dQ: one (batch·head, q-block, kv-block) program; dQ in scratch."""
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -182,8 +196,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     @pl.when(diag_ok)
     def _accumulate():
         _, ds, _ = _block_dscores(
-            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qi, ki, scale,
-            block_q, block_k, causal,
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, glse_ref,
+            qi, ki, scale, block_q, block_k, causal,
         )
         kb = k_ref[0].astype(jnp.float32)
         acc_ref[:] += lax.dot_general(
@@ -196,8 +210,9 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
-                dv_ref, dk_acc, dv_acc, *, scale, block_q, block_k, causal):
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, glse_ref,
+                dk_ref, dv_ref, dk_acc, dv_acc, *, scale, block_q, block_k,
+                causal):
     """dK/dV: one (batch·head, kv-block, q-block) program; both in scratch."""
     ki = pl.program_id(1)
     qi = pl.program_id(2)
@@ -213,8 +228,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
     @pl.when(diag_ok)
     def _accumulate():
         p, ds, do = _block_dscores(
-            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qi, ki, scale,
-            block_q, block_k, causal,
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, glse_ref,
+            qi, ki, scale, block_q, block_k, causal,
         )
         dv_acc[:] += lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
@@ -232,9 +247,13 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _fa_backward(q, k, v, out, lse, g, scale, block_q, block_k, causal,
-                 interpret):
-    """Fused flash backward on [BH, T, D] arrays → (dq, dk, dv)."""
+def _fa_backward(q, k, v, out, lse, g, g_lse, scale, block_q, block_k,
+                 causal, interpret):
+    """Fused flash backward on [BH, T, D] arrays → (dq, dk, dv).
+
+    ``g_lse`` is the cotangent of the forward's lse output ([BH, T, 1];
+    pass zeros when only the attention output is differentiated).
+    """
     bh, t, d = q.shape
     delta = jnp.sum(
         g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1,
@@ -249,16 +268,17 @@ def _fa_backward(q, k, v, out, lse, g, scale, block_q, block_k, causal,
             _dq_kernel, scale=scale, block_q=block_q, block_k=block_k,
             causal=causal,
         ),
-        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        out_shape=_sds((bh, t, d), q.dtype, q),
         grid=(bh, t // block_q, t // block_k),
-        in_specs=[qspec, kspec_dq, kspec_dq, qspec, rowspec, rowspec],
+        in_specs=[qspec, kspec_dq, kspec_dq, qspec, rowspec, rowspec,
+                  rowspec],
         out_specs=qspec,
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary", "arbitrary"),
         ),
         interpret=interpret,
-    )(q, k, v, g, lse, delta)
+    )(q, k, v, g, lse, delta, g_lse)
 
     # kv-major grid: q-row inputs are indexed by the INNER axis here
     qspec_kv = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0))
@@ -270,12 +290,12 @@ def _fa_backward(q, k, v, out, lse, g, scale, block_q, block_k, causal,
             causal=causal,
         ),
         out_shape=(
-            jax.ShapeDtypeStruct((bh, t, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, t, d), v.dtype),
+            _sds((bh, t, d), k.dtype, q),
+            _sds((bh, t, d), v.dtype, q),
         ),
         grid=(bh, t // block_k, t // block_q),
         in_specs=[qspec_kv, kspec_kv, kspec_kv, qspec_kv, rowspec_kv,
-                  rowspec_kv],
+                  rowspec_kv, rowspec_kv],
         out_specs=(kspec_kv, kspec_kv),
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
@@ -285,7 +305,7 @@ def _fa_backward(q, k, v, out, lse, g, scale, block_q, block_k, causal,
             dimension_semantics=("parallel", "arbitrary", "arbitrary"),
         ),
         interpret=interpret,
-    )(q, k, v, g, lse, delta)
+    )(q, k, v, g, lse, delta, g_lse)
     return dq, dk, dv
 
 
@@ -303,11 +323,104 @@ def _fa_fwd(q, k, v, scale, block_q, block_k, causal, interpret):
 
 def _fa_bwd(scale, block_q, block_k, causal, interpret, res, g):
     q, k, v, out, lse = res
-    return _fa_backward(q, k, v, out, lse, g, scale, block_q, block_k,
-                        causal, interpret)
+    return _fa_backward(q, k, v, out, lse, g, jnp.zeros_like(lse), scale,
+                        block_q, block_k, causal, interpret)
 
 
 _fa.defvjp(_fa_fwd, _fa_bwd)
+
+
+def _prep(t, d, scale, interpret, block_q, block_k):
+    """Shared wrapper defaults: score scale, interpret-mode autodetect, and
+    sublane-aligned block clamps (Mosaic tiling: never clamp to a ragged t)."""
+    if scale is None:
+        scale = d ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    align = 32
+    block_q = min(block_q, -(-t // align) * align)
+    block_k = min(block_k, -(-t // align) * align)
+    return scale, interpret, block_q, block_k
+
+
+def _fold(x, b, t, h, d):
+    """[B,T,H,D] -> [B*H, T, D]."""
+    return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+
+def _reference_lse(q, k, v, causal, scale):
+    """(out, lse) on [BH, T, D] via plain einsums — bwd recompute path for
+    the lse-exposing variant."""
+    s = jnp.einsum(
+        "bqd,bkd->bqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        t = q.shape[1]
+        mask = jnp.arange(t)[:, None] >= jnp.arange(t)[None, :]
+        s = jnp.where(mask[None], s, _NEG)
+    lse = jax.scipy.special.logsumexp(s, axis=-1)[..., None]
+    p = jnp.exp(s - lse)
+    out = jnp.einsum("bqk,bkd->bqd", p.astype(v.dtype), v).astype(q.dtype)
+    return out, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _fa_lse(q, k, v, scale, block_q, block_k, causal, interpret):
+    return _fa_forward(q, k, v, scale, block_q, block_k, causal, interpret)
+
+
+def _fa_lse_fwd(q, k, v, scale, block_q, block_k, causal, interpret):
+    out, lse = _fa_forward(q, k, v, scale, block_q, block_k, causal,
+                           interpret)
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _fa_lse_bwd(scale, block_q, block_k, causal, interpret, res, g):
+    q, k, v, out, lse = res
+    g_out, g_lse = g
+    return _fa_backward(q, k, v, out, lse, g_out,
+                        g_lse.astype(jnp.float32), scale, block_q, block_k,
+                        causal, interpret)
+
+
+_fa_lse.defvjp(_fa_lse_fwd, _fa_lse_bwd)
+
+
+def flash_attention_with_lse(q, k, v, causal=True, scale=None, block_q=128,
+                             block_k=128, interpret=None):
+    """Flash attention returning ``(out, lse)`` on the [B, T, H, D] layout.
+
+    ``lse`` is [B, H, T, 1] f32 — the per-row log-sum-exp that lets partial
+    attention results over disjoint KV shards merge exactly:
+    ``o = Σ_s o_s · exp(lse_s − logaddexp_s lse_s)``.  This is the building
+    block ring attention uses to run each ring step through the kernel.
+    T must tile by the (aligned) block sizes — ring shards are powers of
+    two, so no padding path is carried here.
+    """
+    b, t, h, d = q.shape
+    scale, interpret, block_q, block_k = _prep(
+        t, d, scale, interpret, block_q, block_k
+    )
+    qf = _fold(q, b, t, h, d)
+    kf = _fold(k, b, t, h, d)
+    vf = _fold(v, b, t, h, d)
+    if t % block_q or t % block_k:
+        # sub-block / ragged shard: the einsum reference is exact and cheap
+        # at small sizes, but it is O(T²) — refuse silently degrading a
+        # long-context shard (pad the global sequence upstream instead)
+        if t > 1024:
+            raise ValueError(
+                f"shard length {t} does not tile by blocks "
+                f"({block_q},{block_k}) and is too long for the dense "
+                "fallback; pad the sequence so shards tile"
+            )
+        out, lse = _reference_lse(qf, kf, vf, causal, scale)
+    else:
+        out, lse = _fa_lse(
+            qf, kf, vf, scale, block_q, block_k, causal, interpret
+        )
+    out = out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+    return out, lse.reshape(b, h, t, 1)
 
 
 def flash_attention(q, k, v, causal=True, scale=None, block_q=128,
@@ -326,17 +439,9 @@ def flash_attention(q, k, v, causal=True, scale=None, block_q=128,
     Returns [B, T, H, D] in q's dtype.
     """
     b, t, h, d = q.shape
-    if scale is None:
-        scale = d ** -0.5
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
-
-    # Blocks must stay sublane-aligned (Mosaic tiling: the second-to-last
-    # dim of a VMEM access needs 8/16/32-multiples by dtype) — so never
-    # clamp a block to a ragged t; round t up and pad instead.
-    align = 32
-    block_q = min(block_q, -(-t // align) * align)
-    block_k = min(block_k, -(-t // align) * align)
+    scale, interpret, block_q, block_k = _prep(
+        t, d, scale, interpret, block_q, block_k
+    )
     # padded length must tile by BOTH block sizes
     pad = (-t) % math.lcm(block_q, block_k)
 
@@ -347,11 +452,9 @@ def flash_attention(q, k, v, causal=True, scale=None, block_q=128,
 
         return plain_attention(q, k, v, causal=False, scale=scale)
 
-    def fold(x):
-        # [B,T,H,D] -> [B*H, T, D]
-        return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
-
-    qf, kf, vf = fold(q), fold(k), fold(v)
+    qf = _fold(q, b, t, h, d)
+    kf = _fold(k, b, t, h, d)
+    vf = _fold(v, b, t, h, d)
     if pad:
         # padded KV rows sit in the causal future of every real Q row (the
         # position mask zeroes them); padded Q rows are sliced off below

@@ -53,7 +53,8 @@ def _block_accumulate(o, m, l, q, kb, vb, q_pos, kv_pos, scale, causal):
     return new_o, new_m, new_l
 
 
-def ring_attention(q, k, v, axis_name="sp", causal=True, scale=None):
+def ring_attention(q, k, v, axis_name="sp", causal=True, scale=None,
+                   impl="plain"):
     """Per-shard ring attention body; call inside ``jax.shard_map``.
 
     Args:
@@ -62,9 +63,18 @@ def ring_attention(q, k, v, axis_name="sp", causal=True, scale=None):
       axis_name: mesh axis carrying the sequence shards.
       causal: apply the causal mask using *global* token positions.
       scale: score scale; defaults to D**-0.5.
+      impl: "plain" — the per-step block accumulate is XLA einsums
+        materializing one [Tloc, Tloc] score block; "flash" — each ring
+        step runs the Pallas kernel (client_tpu.ops) over the local pair
+        and steps merge by log-sum-exp, so per-step memory is O(block)
+        even at long local shards.  Block-causality makes the two modes
+        line up exactly: the diagonal step is the kernel's own causal
+        mask, past steps are unmasked, future steps are skipped.
 
     Returns [B, T_local, H, D] in q's dtype.
     """
+    if impl == "flash":
+        return _ring_attention_flash(q, k, v, axis_name, causal, scale)
     n = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
     b, t_loc, h, d = q.shape
@@ -111,6 +121,80 @@ def ring_attention(q, k, v, axis_name="sp", causal=True, scale=None):
     return out.transpose(0, 2, 1, 3)
 
 
+def _ring_attention_flash(q, k, v, axis_name, causal, scale):
+    """Ring schedule with the Pallas flash kernel as the per-step engine.
+
+    Each step computes a self-contained (out_s, lse_s) for the resident Q
+    shard against the rotating KV shard; partial results merge with the
+    exact softmax-combine ``o ← o·α + o_s·α_s`` where the α's renormalize
+    by ``logaddexp(lse, lse_s)``.  Future KV shards are skipped (their lse
+    is −inf and contributes nothing, so the cond is purely a compute save).
+    """
+    from client_tpu.ops.flash_attention import flash_attention_with_lse
+
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    b, t_loc, h, d = q.shape
+    if scale is None:
+        scale = d ** -0.5
+
+    acc = jnp.zeros((b, h, t_loc, d), jnp.float32)
+    lse = jnp.full((b, h, t_loc, 1), _NEG, jnp.float32)
+    varying = tuple(jax.typeof(q).vma) if hasattr(jax, "typeof") else ()
+    if varying:
+        acc, lse = (lax.pcast(x, varying, to="varying") for x in (acc, lse))
+    kb, vb = k, v
+
+    def step_pair(kb_vb, step_causal):
+        kb_, vb_ = kb_vb
+        out_s, lse_s = flash_attention_with_lse(
+            q, kb_, vb_, causal=step_causal, scale=scale
+        )
+        return out_s.transpose(0, 2, 1, 3).astype(jnp.float32), lse_s
+
+    def merge(acc, lse, out_s, lse_s):
+        new_lse = jnp.logaddexp(lse, lse_s)
+        return (
+            acc * jnp.exp(lse - new_lse) + out_s * jnp.exp(lse_s - new_lse),
+            new_lse,
+        )
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    for step in range(n):
+        kv_idx = (idx - step) % n
+        if causal:
+            def on_diag(acc, lse, kb_, vb_):
+                out_s, lse_s = step_pair((kb_, vb_), True)
+                return merge(acc, lse, out_s, lse_s)
+
+            def off_diag(acc, lse, kb_, vb_):
+                out_s, lse_s = step_pair((kb_, vb_), False)
+                return merge(acc, lse, out_s, lse_s)
+
+            def skip(acc, lse, kb_, vb_):
+                return acc, lse
+
+            # three-way: strictly-future shard contributes nothing; the
+            # diagonal shard uses the kernel's local causal mask; past
+            # shards attend fully (global positions never needed)
+            acc, lse = lax.cond(
+                kv_idx > idx,
+                skip,
+                lambda a, l, kb_, vb_: lax.cond(
+                    kv_idx == idx, on_diag, off_diag, a, l, kb_, vb_
+                ),
+                acc, lse, kb, vb,
+            )
+        else:
+            out_s, lse_s = step_pair((kb, vb), False)
+            acc, lse = merge(acc, lse, out_s, lse_s)
+        if step != n - 1:
+            kb = lax.ppermute(kb, axis_name, perm)
+            vb = lax.ppermute(vb, axis_name, perm)
+
+    return acc.astype(q.dtype).transpose(0, 2, 1, 3)
+
+
 def plain_attention(q, k, v, causal=True, scale=None):
     """Single-shard reference attention; same [B,T,H,D] interface."""
     b, t, h, d = q.shape
@@ -130,17 +214,25 @@ def plain_attention(q, k, v, causal=True, scale=None):
     return out.astype(q.dtype)
 
 
-def ring_attention_sharded(q, k, v, mesh, causal=True, scale=None):
+def ring_attention_sharded(q, k, v, mesh, causal=True, scale=None,
+                           impl="plain"):
     """shard_map wrapper: global [B,T,H,D] arrays, T sharded over ``sp``.
 
     Batch rides ``dp``; heads ride ``tp``; D is replicated.  The body sees
-    local blocks and exchanges KV over the ring.
+    local blocks and exchanges KV over the ring; ``impl="flash"`` runs each
+    ring step through the Pallas kernel (O(block) per-step memory).
     """
     spec = P("dp", "sp", "tp", None)
+    # check_vma: Pallas INTERPRET mode (the off-TPU test path) lowers to
+    # dynamic_slice with invariant index operands, which the varying-axis
+    # checker rejects — disable it only there; compiled TPU runs keep the
+    # checker for both impls.
+    interpret = jax.default_backend() != "tpu"
     fn = jax.shard_map(
-        lambda a, b_, c: ring_attention(a, b_, c, "sp", causal, scale),
+        lambda a, b_, c: ring_attention(a, b_, c, "sp", causal, scale, impl),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
+        check_vma=not (impl == "flash" and interpret),
     )
     return fn(q, k, v)

@@ -153,9 +153,13 @@ def _attention_block(layer, x, cfg, positions, mesh, attn_impl):
     k = _rope(k, positions, cfg.rope_theta)
     n_rep = cfg.n_heads // cfg.n_kv_heads
 
-    if attn_impl == "ring":
+    if attn_impl in ("ring", "ring_flash"):
+        # "ring_flash": the same sp-sharded ring schedule with each step's
+        # block pair computed by the Pallas flash kernel (O(block) memory
+        # per step — the long-context sharded-training configuration)
         attn = ring_attention_sharded(
-            q, _repeat_kv(k, n_rep), _repeat_kv(v, n_rep), mesh
+            q, _repeat_kv(k, n_rep), _repeat_kv(v, n_rep), mesh,
+            impl="flash" if attn_impl == "ring_flash" else "plain",
         )
     elif attn_impl == "flash":
         # Pallas kernel (client_tpu.ops): no [T,T] score materialization —
