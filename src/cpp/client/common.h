@@ -1,8 +1,18 @@
 // Native client value types — parity with the reference C++ library's
 // common.h (reference src/c++/library/common.h:62-626: Error,
 // InferOptions, InferInput with zero-copy AppendRaw buffer list,
-// InferRequestedOutput, InferResult, RequestTimers), re-built for the TPU
-// framework with no external dependencies.
+// InferRequestedOutput, InferResult, RequestTimers, InferStat), re-built
+// for the TPU framework with no external dependencies.
+//
+// Deliberate divergence: the reference's InferenceServerClient base class
+// owns a worker thread + condition variable that each transport's async
+// path feeds (common.h:120-154).  Here there is no shared base — each
+// client owns an event-loop reactor (http_reactor.h epoll loop;
+// grpc_client.h per-connection HTTP/2 reactor thread), which is the model
+// the reference itself uses for HTTP (curl-multi) and gRPC (completion
+// queue); the extra base-class thread would be a third mechanism with no
+// consumer.  The shared pieces that ARE cross-transport (InferStat
+// aggregation, RequestTimers) live in this header.
 #pragma once
 
 #include <chrono>
