@@ -53,6 +53,46 @@ def _block_accumulate(o, m, l, q, kb, vb, q_pos, kv_pos, scale, causal):
     return new_o, new_m, new_l
 
 
+def _ring_schedule(state, k, v, axis_name, causal, step_fn):
+    """THE ring schedule, shared by both impls: rotate KV around the ring
+    with ppermute, calling ``step_fn(state, kb, vb, kv_idx, idx)`` for every
+    non-future shard pair (under causality, strictly-future KV shards are
+    skipped — they contribute exactly nothing).  ``state`` is any pytree;
+    step_fn owns the accumulate/merge semantics."""
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    kb, vb = k, v
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    for step in range(n):
+        kv_idx = (idx - step) % n
+        if causal:
+            # kv_idx is device-constant under SPMD: each device runs only
+            # its selected branch, so the skip really saves the compute
+            state = lax.cond(
+                kv_idx > idx,
+                lambda st, *_: st,
+                lambda st, kb_, vb_: step_fn(st, kb_, vb_, kv_idx, idx),
+                state, kb, vb,
+            )
+        else:
+            state = step_fn(state, kb, vb, kv_idx, idx)
+        if step != n - 1:
+            kb = lax.ppermute(kb, axis_name, perm)
+            vb = lax.ppermute(vb, axis_name, perm)
+    return state
+
+
+def _varying_full(q, shapes_dtypes):
+    """Constant-filled accumulators (shape, dtype, fill triples) marked with
+    q's device-varying axes so the lax.cond branches' varying-axis types
+    agree under shard_map."""
+    arrs = [jnp.full(sh, fill, dt) for sh, dt, fill in shapes_dtypes]
+    varying = tuple(jax.typeof(q).vma) if hasattr(jax, "typeof") else ()
+    if varying:
+        arrs = [lax.pcast(a, varying, to="varying") for a in arrs]
+    return arrs
+
+
 def ring_attention(q, k, v, axis_name="sp", causal=True, scale=None,
                    impl="plain"):
     """Per-shard ring attention body; call inside ``jax.shard_map``.
@@ -75,48 +115,30 @@ def ring_attention(q, k, v, axis_name="sp", causal=True, scale=None,
     """
     if impl == "flash":
         return _ring_attention_flash(q, k, v, axis_name, causal, scale)
-    n = lax.psum(1, axis_name)
-    idx = lax.axis_index(axis_name)
     b, t_loc, h, d = q.shape
     if scale is None:
         scale = d ** -0.5
 
     qh = q.transpose(0, 2, 1, 3)  # [B,H,T,D]
-    kb = k.transpose(0, 2, 1, 3)
-    vb = v.transpose(0, 2, 1, 3)
+    o, m, l = _varying_full(q, [
+        (qh.shape, jnp.float32, 0.0),
+        ((b, h, t_loc, 1), jnp.float32, _NEG),
+        ((b, h, t_loc, 1), jnp.float32, 0.0),
+    ])
+    # transpose KV once; the schedule rotates whatever layout it is given
+    q_pos = lax.axis_index(axis_name) * t_loc + jnp.arange(t_loc)
 
-    o = jnp.zeros(qh.shape, jnp.float32)
-    m = jnp.full((b, h, t_loc, 1), _NEG, jnp.float32)
-    l = jnp.zeros((b, h, t_loc, 1), jnp.float32)
-    # mark the constant-initialized accumulators as device-varying so both
-    # lax.cond branches below agree on varying-axis types under shard_map
-    varying = tuple(jax.typeof(q).vma) if hasattr(jax, "typeof") else ()
-    if varying:
-        o, m, l = (lax.pcast(x, varying, to="varying") for x in (o, m, l))
-    q_pos = idx * t_loc + jnp.arange(t_loc)
-
-    perm = [(i, (i + 1) % n) for i in range(n)]
-    for step in range(n):
-        kv_idx = (idx - step) % n
+    def step_fn(state, kb, vb, kv_idx, idx):
+        o, m, l = state
         kv_pos = kv_idx * t_loc + jnp.arange(t_loc)
-        if causal:
-            # KV blocks strictly in this Q block's future contribute exactly
-            # nothing — skip their einsums (kv_idx is device-constant under
-            # SPMD, so each device runs only its selected branch)
-            o, m, l = lax.cond(
-                kv_idx > idx,
-                lambda o, m, l, *_: (o, m, l),
-                functools.partial(_block_accumulate, scale=scale, causal=True),
-                o, m, l, qh, kb, vb, q_pos, kv_pos,
-            )
-        else:
-            o, m, l = _block_accumulate(
-                o, m, l, qh, kb, vb, q_pos, kv_pos, scale, False
-            )
-        if step != n - 1:
-            kb = lax.ppermute(kb, axis_name, perm)
-            vb = lax.ppermute(vb, axis_name, perm)
+        return _block_accumulate(
+            o, m, l, qh, kb, vb, q_pos, kv_pos, scale, causal,
+        )
 
+    o, m, l = _ring_schedule(
+        (o, m, l), k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3),
+        axis_name, causal, step_fn,
+    )
     out = (o / l).astype(q.dtype)
     return out.transpose(0, 2, 1, 3)
 
@@ -132,25 +154,14 @@ def _ring_attention_flash(q, k, v, axis_name, causal, scale):
     """
     from client_tpu.ops.flash_attention import flash_attention_with_lse
 
-    n = lax.psum(1, axis_name)
-    idx = lax.axis_index(axis_name)
     b, t_loc, h, d = q.shape
     if scale is None:
         scale = d ** -0.5
 
-    acc = jnp.zeros((b, h, t_loc, d), jnp.float32)
-    lse = jnp.full((b, h, t_loc, 1), _NEG, jnp.float32)
-    varying = tuple(jax.typeof(q).vma) if hasattr(jax, "typeof") else ()
-    if varying:
-        acc, lse = (lax.pcast(x, varying, to="varying") for x in (acc, lse))
-    kb, vb = k, v
-
-    def step_pair(kb_vb, step_causal):
-        kb_, vb_ = kb_vb
-        out_s, lse_s = flash_attention_with_lse(
-            q, kb_, vb_, causal=step_causal, scale=scale
-        )
-        return out_s.transpose(0, 2, 1, 3).astype(jnp.float32), lse_s
+    acc, lse = _varying_full(q, [
+        ((b, h, t_loc, d), jnp.float32, 0.0),
+        ((b, h, t_loc, 1), jnp.float32, _NEG),
+    ])
 
     def merge(acc, lse, out_s, lse_s):
         new_lse = jnp.logaddexp(lse, lse_s)
@@ -159,39 +170,29 @@ def _ring_attention_flash(q, k, v, axis_name, causal, scale):
             new_lse,
         )
 
-    perm = [(i, (i + 1) % n) for i in range(n)]
-    for step in range(n):
-        kv_idx = (idx - step) % n
+    def step_fn(state, kb, vb, kv_idx, idx):
+        acc, lse = state
+
+        def run(step_causal, a, l, kb_, vb_):
+            out_s, lse_s = flash_attention_with_lse(
+                q, kb_, vb_, causal=step_causal, scale=scale
+            )
+            out_s = out_s.transpose(0, 2, 1, 3).astype(jnp.float32)
+            return merge(a, l, out_s, lse_s)
+
         if causal:
-            def on_diag(acc, lse, kb_, vb_):
-                out_s, lse_s = step_pair((kb_, vb_), True)
-                return merge(acc, lse, out_s, lse_s)
-
-            def off_diag(acc, lse, kb_, vb_):
-                out_s, lse_s = step_pair((kb_, vb_), False)
-                return merge(acc, lse, out_s, lse_s)
-
-            def skip(acc, lse, kb_, vb_):
-                return acc, lse
-
-            # three-way: strictly-future shard contributes nothing; the
-            # diagonal shard uses the kernel's local causal mask; past
-            # shards attend fully (global positions never needed)
-            acc, lse = lax.cond(
-                kv_idx > idx,
-                skip,
-                lambda a, l, kb_, vb_: lax.cond(
-                    kv_idx == idx, on_diag, off_diag, a, l, kb_, vb_
-                ),
+            # the diagonal shard uses the kernel's local causal mask; past
+            # shards attend fully (global positions never needed — the
+            # schedule already skipped strictly-future shards)
+            return lax.cond(
+                kv_idx == idx,
+                functools.partial(run, True),
+                functools.partial(run, False),
                 acc, lse, kb, vb,
             )
-        else:
-            out_s, lse_s = step_pair((kb, vb), False)
-            acc, lse = merge(acc, lse, out_s, lse_s)
-        if step != n - 1:
-            kb = lax.ppermute(kb, axis_name, perm)
-            vb = lax.ppermute(vb, axis_name, perm)
+        return run(False, acc, lse, kb, vb)
 
+    acc, lse = _ring_schedule((acc, lse), k, v, axis_name, causal, step_fn)
     return acc.astype(q.dtype).transpose(0, 2, 1, 3)
 
 
