@@ -1,0 +1,92 @@
+"""Shared device-completion observer for async-dispatch bookkeeping.
+
+A single daemon thread per observer waits on watched device results and runs
+a per-item callback at completion — the mechanism the engine's duty-cycle
+metric (BusyTracker spans) and the dynamic batcher's pipeline backpressure
+both close through.  One wait covers a whole backlog: the observer blocks on
+*every* array in the drained batch (not just the newest — watch order across
+request threads is not dispatch order, and multi-device models have no
+single stream), then fires the callbacks.  Host-only results complete
+immediately on the caller thread.
+"""
+
+import threading
+
+
+def _completion_arrays(result, out=None):
+    """Arrays worth waiting on from a result pytree (nested dict/list/tuple
+    of arrays — e.g. a fused batch's per-part output dict of tuples)."""
+    if out is None:
+        out = []
+    if isinstance(result, dict):
+        for v in result.values():
+            _completion_arrays(v, out)
+    elif isinstance(result, (list, tuple)):
+        for v in result:
+            _completion_arrays(v, out)
+    elif hasattr(result, "block_until_ready"):
+        out.append(result)
+    return out
+
+
+class CompletionObserver:
+    def __init__(self, name="completion-observer"):
+        self._name = name
+        self._cv = threading.Condition()
+        self._backlog = []  # (arrays, callback)
+        self._closed = False
+        self._thread = None
+
+    def watch(self, result, callback):
+        """Run *callback* once every device array in *result* has completed.
+
+        Host results (nothing to wait on) run the callback inline.  Watches
+        arriving after close() — e.g. a batcher thread that outlived its
+        bounded shutdown join — block inline on the caller thread and still
+        run the callback, so no span/semaphore/counter ever leaks.
+        """
+        arrays = _completion_arrays(result)
+        if not arrays:
+            callback()
+            return
+        with self._cv:
+            if not self._closed:
+                if self._thread is None:
+                    self._thread = threading.Thread(
+                        target=self._loop, name=self._name, daemon=True
+                    )
+                    self._thread.start()
+                self._backlog.append((arrays, callback))
+                self._cv.notify()
+                return
+        self._settle(arrays)
+        callback()
+
+    @staticmethod
+    def _settle(arrays):
+        try:
+            import jax
+
+            jax.block_until_ready(arrays)
+        except Exception:  # noqa: BLE001 - failed results still complete
+            pass
+
+    def _loop(self):
+        while True:
+            with self._cv:
+                while not self._backlog and not self._closed:
+                    self._cv.wait()
+                if not self._backlog:
+                    return
+                batch, self._backlog = self._backlog, []
+            self._settle([arrays for arrays, _ in batch])
+            for _, callback in batch:
+                callback()
+
+    def close(self, timeout=30):
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+            thread = self._thread
+        if thread is not None:
+            thread.join(timeout=timeout)

@@ -21,6 +21,7 @@ from collections import deque
 
 import numpy as np
 
+from client_tpu.serve._completion import CompletionObserver
 from client_tpu.utils import InferenceServerException
 
 
@@ -139,7 +140,8 @@ class ModelBatcher:
     """One background batcher per model: gathers concurrent requests into a
     single padded forward pass and splits the host-materialized outputs."""
 
-    def __init__(self, model, stats, max_queue_delay_s=0.003, busy=None):
+    def __init__(self, model, stats, max_queue_delay_s=0.003, busy=None,
+                 pipeline_depth=4):
         self.model = model
         self.stats = stats
         self._busy = busy  # engine BusyTracker (duty-cycle metric), optional
@@ -152,6 +154,43 @@ class ModelBatcher:
         self.max_fused_arity = int(
             getattr(model, "max_fused_arity", 8) or 8
         )
+        # Dispatch/completion are decoupled: the batcher thread only gathers
+        # and issues batches; completion waits run off the dispatch path.  On
+        # a remote/tunneled chip a completion wait costs a full link RTT —
+        # serializing it behind dispatch (the old depth-2 pipeline) left the
+        # H2D stream idle ~half the time.  Two populations, two backpressure
+        # regimes:
+        #  - HOST (wire) groups hold full tensor copies host-side and end in
+        #    a real batch-wide D2H, so a small completion pool + semaphore
+        #    (pipeline_depth) bounds memory while keeping the link streaming.
+        #  - DEVICE (TPU-shm) groups hold only HBM references; acks are
+        #    dispatch-time by contract, so throttling dispatch to the
+        #    completion-OBSERVATION rate (RTT-quantized over a tunnel) would
+        #    cap throughput at depth/RTT.  They get a deep semaphore purely
+        #    as a runaway bound, and one FIFO watcher thread that collapses a
+        #    completion backlog into a single block_until_ready (a device
+        #    stream executes dispatches in order, so the newest result
+        #    completing implies every older one did).
+        self.pipeline_depth = max(int(pipeline_depth), 1)
+        self.device_pipeline_depth = max(self.pipeline_depth, 64)
+        self._sem = threading.Semaphore(self.pipeline_depth)
+        self._sem_device = threading.Semaphore(self.device_pipeline_depth)
+        self._observer = CompletionObserver(
+            name=f"batcher-{model.name}-watch"
+        )
+        # Host completions run real work (batch D2H + row split) on daemon
+        # worker threads consuming _host_q; daemon so a wedged device call
+        # can never hang interpreter exit, bounded-waited in close().
+        self._host_cv = threading.Condition()
+        self._host_q = deque()
+        self._host_threads = []
+        self._host_outstanding = 0
+        # Workers exit on _host_closed, set only AFTER the batcher thread is
+        # joined: the batcher keeps dispatching its remaining queue after
+        # _closed, and a worker exiting early on a momentarily-empty queue
+        # would strand those late batches (clients blocked forever).
+        self._host_closed = False
+        self._inflight = 0  # dispatched, completion pending (under _cond)
         self._cond = threading.Condition()
         self._queue = deque()
         # Requests popped off the queue but not yet completed/failed (gathered
@@ -265,6 +304,21 @@ class ModelBatcher:
             self._closed = True
             self._cond.notify_all()
         self._thread.join(timeout=30)
+        # Host completion tasks for batches already dispatched should finish
+        # before leftovers are failed — their requests are _active, not
+        # queued.  Bounded: a task wedged on a stalled device must not hang
+        # close() (the workers are daemon threads; queued requests still get
+        # their shutdown error below).
+        deadline = time.monotonic() + 30
+        with self._host_cv:
+            self._host_closed = True
+            self._host_cv.notify_all()
+            while self._host_outstanding or self._host_q:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._host_cv.wait(timeout=remaining)
+        self._observer.close(timeout=30)
         # Fail anything still queued.  Drained under the lock so a batcher
         # thread that outlived the join timeout (e.g. blocked in a cold
         # compile) cannot race the deque; items it already popped are its to
@@ -298,35 +352,125 @@ class ModelBatcher:
             raise
 
     def _run(self):
-        # Depth-2 pipeline: dispatch batch K+1 (host concat + async H2D +
-        # async forward) BEFORE blocking on batch K's D2H, so the host->device
-        # link streams the next batch while the previous one drains.  On a
-        # remote/tunneled chip this is the difference between serial
-        # (gather, transfer, wait) x N and a saturated link.
-        inflight = None
+        # Pipelined dispatch: the batcher thread gathers and issues batches;
+        # completion waits run elsewhere (pool for host groups, FIFO watcher
+        # for device groups), so on a remote/tunneled chip the H2D stream
+        # keeps flowing while earlier batches' completion RTTs are in flight.
         while True:
             group = self._gather()
             if group is None:
-                if inflight is not None:
-                    self._complete(*inflight)
                 return
+            device = group[0].signature[0]
+            sem = self._sem_device if device else self._sem
+            # Backpressure: block while the pipeline is full.  The queue
+            # keeps filling meanwhile, and _topup folds those arrivals into
+            # this batch — depth and batch size grow together under load.
+            sem.acquire()
+            self._topup(group)
             dispatched = self._dispatch(group)
-            if inflight is not None:
-                self._complete(*inflight)
-            inflight = dispatched
-            if inflight is None:
+            if dispatched is None:
+                sem.release()
                 continue
-            # If the queue is empty, finish the in-flight batch now instead of
-            # holding its requesters hostage to the next arrival.
             with self._cond:
-                empty = not self._queue
-            if empty:
-                self._complete(*inflight)
-                inflight = None
+                self._inflight += 1
+            if device:
+                arrays = self._handoff_device(*dispatched)
+                if arrays is None:  # handoff failed; group already notified
+                    if self._busy is not None:
+                        self._busy.end()
+                    self._finish_one(sem)
+                else:
+                    self._observer.watch(
+                        arrays, lambda s=sem: self._device_done(s)
+                    )
+            else:
+                self._submit_host(dispatched)
+
+    def _device_done(self, sem):
+        """Observer callback: a device batch's results actually landed."""
+        if self._busy is not None:
+            self._busy.end()
+        self._finish_one(sem)
+
+    def _finish_one(self, sem):
+        with self._cond:
+            self._inflight -= 1
+            # wake a _gather waiting out its peer-delay: with nothing in
+            # flight the delay no longer buys anything
+            self._cond.notify_all()
+        sem.release()
+
+    # -- host-group completion workers --------------------------------------
+
+    def _submit_host(self, dispatched):
+        with self._host_cv:
+            self._host_q.append(dispatched)
+            self._host_threads = [
+                t for t in self._host_threads if t.is_alive()
+            ]
+            if len(self._host_threads) < self.pipeline_depth:
+                t = threading.Thread(
+                    target=self._host_loop,
+                    name=f"batcher-{self.model.name}-done",
+                    daemon=True,
+                )
+                self._host_threads.append(t)
+                t.start()
+            self._host_cv.notify()
+
+    def _host_loop(self):
+        while True:
+            with self._host_cv:
+                while not self._host_q and not self._host_closed:
+                    self._host_cv.wait()
+                if not self._host_q:
+                    self._host_cv.notify_all()  # wake the close() waiter
+                    return
+                dispatched = self._host_q.popleft()
+                self._host_outstanding += 1
+            try:
+                self._complete_host(*dispatched)
+            finally:
+                with self._host_cv:
+                    self._host_outstanding -= 1
+                    self._host_cv.notify_all()
+                self._finish_one(self._sem)
+
+    def _drain_compatible_locked(self, group, first, rows, max_arity):
+        """Fold queued signature-compatible requests into *group* (no wait).
+        Caller holds self._cond.  Returns the updated row count."""
+        while rows < self.max_batch and len(group) < max_arity:
+            taken = False
+            for i, p in enumerate(self._queue):
+                if p.signature == first.signature and rows + p.rows <= self.max_batch:
+                    del self._queue[i]
+                    self._active.add(p)
+                    group.append(p)
+                    rows += p.rows
+                    taken = True
+                    break
+            if not taken:
+                break
+        return rows
+
+    def _max_arity(self, first):
+        # Fused device groups cap the part count so the (arity,
+        # row-split)-keyed executable set stays small and warmable.
+        return (
+            self.max_fused_arity
+            if first.signature[0] and self._use_fused()
+            else self.max_batch
+        )
 
     def _gather(self):
-        """Take the oldest request, then wait up to max_queue_delay for
-        signature-compatible peers (or until the batch is full)."""
+        """Take the oldest request and fold in signature-compatible peers.
+
+        Batch-while-busy: the timed max_queue_delay wait for peers only
+        happens while at least one batch is dispatched-but-incomplete — an
+        idle pipeline dispatches immediately, so low-concurrency requests pay
+        zero artificial queue delay (the reference's fixed-delay scheduler
+        charges it unconditionally; this is the latency/throughput-optimal
+        variant: delay only when the delay is hidden by in-flight work)."""
         with self._cond:
             while not self._queue:
                 if self._closed:
@@ -335,33 +479,35 @@ class ModelBatcher:
             first = self._queue.popleft()
             self._active.add(first)
             group = [first]
-            rows = first.rows
-            # Fused device groups cap the part count so the (arity,
-            # row-split)-keyed executable set stays small and warmable.
-            max_arity = (
-                self.max_fused_arity
-                if first.signature[0] and self._use_fused()
-                else self.max_batch
+            max_arity = self._max_arity(first)
+            rows = self._drain_compatible_locked(
+                group, first, first.rows, max_arity
             )
             deadline = time.monotonic() + self.max_queue_delay_s
-            while rows < self.max_batch and len(group) < max_arity:
-                # drain compatible items already queued
-                taken = False
-                for i, p in enumerate(self._queue):
-                    if p.signature == first.signature and rows + p.rows <= self.max_batch:
-                        del self._queue[i]
-                        self._active.add(p)
-                        group.append(p)
-                        rows += p.rows
-                        taken = True
-                        break
-                if taken:
-                    continue
+            while (
+                rows < self.max_batch
+                and len(group) < max_arity
+                and self._inflight > 0
+                and not self._closed
+            ):
                 remaining = deadline - time.monotonic()
-                if remaining <= 0 or self._closed:
+                if remaining <= 0:
                     break
                 self._cond.wait(timeout=remaining)
+                rows = self._drain_compatible_locked(
+                    group, first, rows, max_arity
+                )
             return group
+
+    def _topup(self, group):
+        """Last-moment fold-in of arrivals that queued while the pipeline
+        semaphore blocked (or between gather and dispatch)."""
+        with self._cond:
+            first = group[0]
+            rows = sum(p.rows for p in group)
+            self._drain_compatible_locked(
+                group, first, rows, self._max_arity(first)
+            )
 
     def _dispatch(self, group):
         """Host-concat the group, pad to a power-of-two bucket, and issue the
@@ -419,14 +565,12 @@ class ModelBatcher:
             self._fail(group, e)
             return None
 
-    def _complete(self, group, result, rows, t0, t_in):
-        """Split rows back to requests and record stats.
-
-        Wire groups block on one batch-wide D2H (device arrays would
-        re-transfer per request); device groups split into live device slices
-        — outputs flow into TPU-shm regions with no transfer at all, and the
-        dispatch stays asynchronous."""
-        busy_open = self._busy is not None
+    def _handoff_device(self, group, result, rows, t0, t_in):
+        """Hand a device group's results to its waiters at DISPATCH time
+        (ack == dispatch, the TPU-shm contract) — splitting is lazy device
+        ops, no transfer.  Returns the arrays the watcher should observe for
+        completion (busy span + semaphore close there), or None on failure
+        (the group is already notified)."""
         try:
             if isinstance(result, tuple) and result[0] == "fused":
                 # per-part output arrays came straight out of the jitted
@@ -437,47 +581,55 @@ class ModelBatcher:
                         name: parts[i] for name, parts in per_part.items()
                     }
                     p.event.set()
-                if busy_open:
-                    self._busy.end()
-                    busy_open = False
-                with self._cond:
-                    self._active.difference_update(group)
-                t1 = time.monotonic_ns()
-                self.stats.record_batched(
-                    rows=rows,
-                    infer_ns=t1 - t_in,
-                    input_ns=t_in - t0,
-                    output_ns=0,
-                    queue_ns=sum(t_in - p.t_enq for p in group),
-                )
-                return
-            device = group[0].signature[0]
-            if device:
-                host = result  # device group: keep everything on device
+                watch = per_part
             else:
-                import jax
-
-                host = jax.device_get(result)
-            if busy_open:
-                self._busy.end()  # results landed (or dispatch issued)
-                busy_open = False
-            t_inf = time.monotonic_ns()
-            offset = 0
-            for p in group:
-                if device:
+                offset = 0
+                for p in group:
                     # whole-buffer pass-through when one request fills the
                     # bucket; dynamic_slice otherwise (bounded executables)
                     p.result = {
                         name: arr
                         if offset == 0 and p.rows == arr.shape[0]
                         else _device_split(arr, offset, p.rows)
-                        for name, arr in host.items()
+                        for name, arr in result.items()
                     }
-                else:
-                    p.result = {
-                        name: arr[offset : offset + p.rows]
-                        for name, arr in host.items()
-                    }
+                    offset += p.rows
+                    p.event.set()
+                watch = result
+            with self._cond:
+                self._active.difference_update(group)
+            t1 = time.monotonic_ns()
+            self.stats.record_batched(
+                rows=rows,
+                infer_ns=t1 - t_in,
+                input_ns=t_in - t0,
+                output_ns=0,
+                queue_ns=sum(t_in - p.t_enq for p in group),
+            )
+            return watch
+        except Exception as e:  # noqa: BLE001 - failure propagates per-request
+            self._fail(group, e)
+            return None
+
+    def _complete_host(self, group, result, rows, t0, t_in):
+        """Wire-group completion (runs on the completion pool): one
+        batch-wide D2H, then split host rows back to requests.  The busy
+        span closes when results land host-side — real completion."""
+        busy_open = self._busy is not None
+        try:
+            import jax
+
+            host = jax.device_get(result)
+            if busy_open:
+                self._busy.end()  # wire results landed host-side
+                busy_open = False
+            t_inf = time.monotonic_ns()
+            offset = 0
+            for p in group:
+                p.result = {
+                    name: arr[offset : offset + p.rows]
+                    for name, arr in host.items()
+                }
                 offset += p.rows
                 p.event.set()
             with self._cond:

@@ -28,6 +28,7 @@ from client_tpu.utils import (
     to_wire_bytes,
 )
 from client_tpu._infer_types import _np_from_json_data
+from client_tpu.serve._completion import CompletionObserver
 
 SERVER_NAME = "client_tpu.serve"
 SERVER_VERSION = "0.1.0"
@@ -590,6 +591,7 @@ class InferenceEngine:
         self._stats = {}
         self._batchers = {}
         self.busy = BusyTracker()
+        self._busy_observer = CompletionObserver(name="busy-observer")
         self.shm = SharedMemoryRegistry()
         self._sequences = {}
         self.max_sequence_idle_s = max_sequence_idle_s
@@ -763,10 +765,23 @@ class InferenceEngine:
                 t1 = time.monotonic_ns()
                 stats.record(True, t1 - t0, t1 - t_in1, t_in1 - t_in0, 0)
                 return responses
-            with self.busy:
+            # Direct path: the busy span opens at dispatch and is closed by
+            # the observer at device completion (async results) or right
+            # after rendering (host results already materialized) — duty
+            # cycle measures device occupancy, not dispatch-issue time.
+            self.busy.begin()
+            watched = False
+            try:
                 result = model.fn(inputs, params, context)
-            t_inf1 = time.monotonic_ns()
-            rendered = self._render_response(model, model_version, request, result)
+                t_inf1 = time.monotonic_ns()
+                rendered = self._render_response(
+                    model, model_version, request, result
+                )
+                self._busy_observer.watch(result, self.busy.end)
+                watched = True
+            finally:
+                if not watched:
+                    self.busy.end()
             t1 = time.monotonic_ns()
             stats.record(
                 True, t1 - t0, t_inf1 - t_in1, t_in1 - t_in0, t1 - t_inf1,
@@ -875,9 +890,20 @@ class InferenceEngine:
             return ctx
 
     def _gather_inputs(self, model, request, binary_section):
+        """Resolve request inputs to arrays.
+
+        *binary_section* is either one contiguous bytes object (the HTTP
+        binary extension: tensors back-to-back after the JSON header) or a
+        list of per-tensor buffers (the gRPC frontend hands over the proto's
+        ``raw_input_contents`` untouched).  Both decode through zero-copy
+        ``np.frombuffer`` views — no tensor bytes are copied between the
+        transport and the model.
+        """
         specs = {t.name: t for t in model.inputs}
         arrays = {}
         offset = 0
+        part_cursor = 0
+        sectioned = not isinstance(binary_section, (list, tuple))
         for entry in request.get("inputs", []):
             name = entry["name"]
             spec = specs.get(name)
@@ -906,12 +932,21 @@ class InferenceEngine:
                 )
             elif "binary_data_size" in params:
                 size = params["binary_data_size"]
-                raw = binary_section[offset : offset + size]
+                if sectioned:
+                    raw = memoryview(binary_section)[offset : offset + size]
+                    offset += size
+                else:
+                    if part_cursor >= len(binary_section):
+                        raise InferenceServerException(
+                            f"input '{name}' binary section underrun",
+                            status="400",
+                        )
+                    raw = binary_section[part_cursor]
+                    part_cursor += 1
                 if len(raw) != size:
                     raise InferenceServerException(
                         f"input '{name}' binary section underrun", status="400"
                     )
-                offset += size
                 arrays[name] = from_wire_bytes(raw, datatype, shape)
             elif "data" in entry:
                 arrays[name] = _np_from_json_data(entry["data"], datatype, shape)
@@ -1017,6 +1052,7 @@ class InferenceEngine:
             self._batchers.clear()
         for batcher in batchers:
             batcher.close()
+        self._busy_observer.close()
         self.shm.close()
 
 

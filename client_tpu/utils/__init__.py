@@ -243,12 +243,20 @@ def to_wire_bytes(array, datatype):
 
 
 def from_wire_bytes(buf, datatype, shape):
-    """Wire bytes -> numpy array of *datatype* reshaped to *shape*."""
+    """Wire bytes -> numpy array of *datatype* reshaped to *shape*.
+
+    Fixed-width datatypes decode as a zero-copy ``np.frombuffer`` view over
+    *buf* (bytes, memoryview, or any C-contiguous buffer) — the hot serving
+    path hands transport-owned buffers straight to the model with no copy.
+    The view is read-only; consumers that mutate must copy first.
+    """
     if datatype == "BYTES":
-        arr = deserialize_bytes_tensor(buf)
+        arr = deserialize_bytes_tensor(
+            buf if isinstance(buf, bytes) else bytes(buf)
+        )
     else:
         np_dtype = triton_to_np_dtype(datatype)
         if np_dtype is None:
             raise_error(f"unsupported datatype {datatype}")
-        arr = np.frombuffer(bytes(buf), dtype=np_dtype)
+        arr = np.frombuffer(buf, dtype=np_dtype)
     return arr.reshape(shape)

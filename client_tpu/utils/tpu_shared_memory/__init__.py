@@ -253,15 +253,56 @@ class TpuRegion:
         return nbytes
 
     def read(self, offset, nbytes):
-        """Byte-addressable read at any offset (syncs dirty device slots)."""
+        """Byte-addressable read at any offset (syncs dirty device slots).
+
+        The D2H transfer of dirty slots happens OUTSIDE the region lock:
+        concurrent readers (e.g. perf-harness completion-sync workers all
+        polling the same output region) each pay their own link RTT in
+        parallel instead of serializing behind one lock-held transfer — on a
+        tunneled device that is the difference between N×RTT and ~1×RTT for
+        N concurrent syncs."""
         if offset < 0 or nbytes < 0 or offset + nbytes > self.byte_size:
             raise InferenceServerException(
                 f"read of {nbytes} bytes at offset {offset} overruns TPU "
                 f"region '{self.name}' ({self.byte_size} bytes)"
             )
         with self._lock:
-            self._sync_dirty(offset, nbytes)
-            return self._window.read(offset, nbytes)
+            base = self._window.read(offset, nbytes)
+            snaps = [
+                (off, self._slots[off])
+                for off in sorted(self._dirty)
+                if off in self._slots
+                and off < offset + nbytes
+                and offset < off + _slot_nbytes(self._slots[off])
+            ]
+            for off in list(self._dirty):
+                if off not in self._slots:
+                    self._dirty.discard(off)
+        if not snaps:
+            return base
+        # D2H outside the lock — concurrent readers transfer in parallel —
+        # then overlay the snapshot bytes over the window view locally.  The
+        # reader observes the region as of read start even if writers keep
+        # re-dirtying the same offsets (the old settle-under-the-lock loop
+        # could chase a continuously-rewritten slot for seconds while
+        # serializing every other reader behind it).
+        flushed = [
+            (off, slot, np.ascontiguousarray(np.asarray(slot)).tobytes())
+            for off, slot in snaps
+        ]
+        buf = bytearray(base)
+        for off, slot, host in flushed:
+            lo = max(off, offset)
+            hi = min(off + len(host), offset + nbytes)
+            if lo < hi:
+                buf[lo - offset : hi - offset] = host[lo - off : hi - off]
+        with self._lock:
+            # opportunistic write-back: only what no concurrent write replaced
+            for off, slot, host in flushed:
+                if self._slots.get(off) is slot and off in self._dirty:
+                    self._window.write(off, host)
+                    self._dirty.discard(off)
+        return bytes(buf)
 
     def write(self, offset, data):
         """Byte-addressable write (drops any device slots it overlaps)."""
@@ -319,8 +360,9 @@ class TpuRegion:
 
             raw = self.read(offset, byte_size or self.byte_size - offset)
             # cap at shape-many elements: the region's tail past the tensor
-            # is arbitrary bytes, not length-prefixed data
-            n = int(np.prod(shape)) if shape else None
+            # is arbitrary bytes, not length-prefixed data (a 0-d shape []
+            # caps at 1 element, matching the `shape is not None` reshape)
+            n = int(np.prod(shape)) if shape is not None else None
             arr = deserialize_bytes_tensor(raw, max_elements=n)
             if n is not None and arr.size < n:
                 raise InferenceServerException(
