@@ -50,6 +50,12 @@ SMALL_IMAGE_SIZE = 64
 _OUT_BYTES = 1000 * 4  # FP32 scores
 
 
+def _timed(fn):
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
+
+
 def _measure_link():
     """Honest host<->device link characteristics (MB/s both ways, RTT ms).
 
@@ -66,23 +72,26 @@ def _measure_link():
     h2d_src = np.random.default_rng(1).standard_normal((n,)).astype(np.float32)
     fsum = jax.jit(jnp.sum)
     float(fsum(jax.device_put(h2d_src)))  # warm shape + compile
-    t0 = time.perf_counter()
-    float(fsum(jax.device_put(h2d_src)))
-    h2d_s = time.perf_counter() - t0
+    # best-of-3 probes: a tunneled link's instantaneous bandwidth swings
+    # several-fold minute to minute; the best probe is the closest estimate
+    # of the path's capability (the saturation ratio stays honest either way)
+    h2d_s = min(
+        _timed(lambda: float(fsum(jax.device_put(h2d_src))))
+        for _ in range(3)
+    )
 
     gen = jax.jit(lambda k: jax.random.normal(k, (n,), jnp.float32))
     np.asarray(gen(jax.random.PRNGKey(0)))  # warm
-    out = gen(jax.random.PRNGKey(1))
-    t0 = time.perf_counter()
-    np.asarray(out)
-    d2h_s = time.perf_counter() - t0
+    outs = [gen(jax.random.PRNGKey(k)) for k in range(1, 4)]
+    d2h_s = min(_timed(lambda o=o: np.asarray(o)) for o in outs)
 
     bump = jax.jit(lambda x: x + 1.0)
     d = jax.device_put(np.float32(0.0))
     float(bump(d))  # warm
-    t0 = time.perf_counter()
-    float(bump(jax.device_put(np.float32(1.0))))
-    rtt_s = time.perf_counter() - t0
+    rtt_s = min(
+        _timed(lambda: float(bump(jax.device_put(np.float32(1.0)))))
+        for _ in range(3)
+    )
 
     mb = n * 4 / 1e6
     return {
@@ -96,7 +105,7 @@ class _Harness:
     """The client_tpu.perf object graph for one model + transport config."""
 
     def __init__(self, url, model_name, shared_memory, concurrency,
-                 output_shm_bytes=0, completion_sync=False):
+                 output_shm_bytes=0, completion_sync=False, batch_size=1):
         from client_tpu.perf import (
             BackendKind,
             ClientBackendFactory,
@@ -116,10 +125,11 @@ class _Harness:
         for m in inputs_meta:
             dims = [int(d) for d in m["shape"]]
             if dims and dims[0] == -1:
-                dims[0] = 1
+                dims[0] = batch_size
             m["shape"] = dims
-        loader = DataLoader(inputs_meta, batch_size=1)
+        loader = DataLoader(inputs_meta, batch_size=batch_size)
         loader.generate_data()
+        self.loader = loader
         self.data_manager = create_infer_data_manager(
             self.control, loader, inputs_meta, outputs_meta,
             shared_memory=shared_memory,
@@ -160,11 +170,65 @@ def _status_dict(status):
     }
 
 
-def _run_tpu_shm(server, concurrency=CONCURRENCY, completion_sync=False):
+def _run_tpu_shm_multiproc(server, processes=4, concurrency=CONCURRENCY):
+    """TPU-shm load from *separate processes* (region-by-name referencing):
+    the server keeps its GIL to itself, the way real remote clients would
+    drive it — perf_analyzer's multi-worker shape (client_tpu.perf.procpool).
+    The coordinator owns the regions and performs the completion drain."""
+    from client_tpu.perf.procpool import (
+        export_region_specs,
+        run_completion_multiproc,
+    )
+
+    h = _Harness(
+        server.grpc_address, "cnn_classifier", "tpu", 1,
+        output_shm_bytes=_OUT_BYTES,
+    )
+    try:
+        input_specs, output_specs = export_region_specs(
+            h.data_manager, h.data_manager._inputs_meta, h.loader
+        )
+        spec = {
+            "mode": "shm_ref",
+            "num_streams": h.loader.num_streams,
+            "steps_per_stream": [
+                h.loader.num_steps(s) for s in range(h.loader.num_streams)
+            ],
+            "input_specs": input_specs,
+            "output_specs": output_specs,
+        }
+        marks = {}
+
+        def on_go():
+            # duty cycle covers the measurement window, not process spawn
+            marks["busy0"] = server.engine.busy.busy_ns()
+            marks["t0"] = time.monotonic_ns()
+
+        res = run_completion_multiproc(
+            server.grpc_address, "cnn_classifier",
+            processes=processes, concurrency=concurrency,
+            window_s=MEASURE_S, warmup_s=WARMUP_S, spec=spec,
+            sync_outputs=h.data_manager.sync_outputs,
+            on_go=on_go,
+        )
+        busy1 = server.engine.busy.busy_ns()
+        busy0 = marks.get("busy0", 0)
+        elapsed = time.monotonic_ns() - marks.get("t0", busy1)
+        out = _status_dict(res)
+        out["processes"] = res.processes
+        out["duty_cycle_pct"] = round(100.0 * (busy1 - busy0) / elapsed, 1)
+        return out
+    finally:
+        h.close()
+
+
+def _run_tpu_shm(server, concurrency=CONCURRENCY, completion_sync=False,
+                 batch_size=1):
     """TPU-shm mode through the harness; headline = drained completion."""
     h = _Harness(
         server.grpc_address, "cnn_classifier", "tpu", concurrency,
-        output_shm_bytes=_OUT_BYTES, completion_sync=completion_sync,
+        output_shm_bytes=_OUT_BYTES * batch_size,
+        completion_sync=completion_sync, batch_size=batch_size,
     )
     try:
         busy0 = server.engine.busy.busy_ns()
@@ -194,6 +258,117 @@ def _run_wire(server, model_name, concurrency):
         h.close()
 
 
+def _run_seq_stream(server, n_sequences=8, steps=25):
+    """BASELINE.md config 4: stateful sequences over one gRPC bidi stream
+    (the simple_grpc_sequence_stream_infer_client shape).  Reports
+    per-message stream round-trip latency and message throughput."""
+    import queue
+
+    import client_tpu.grpc as grpcclient
+
+    lats = []
+    with grpcclient.InferenceServerClient(server.grpc_address) as client:
+        results = queue.Queue()
+        client.start_stream(callback=lambda result, error: results.put((result, error)))
+        t_start = time.perf_counter()
+        for seq in range(1, n_sequences + 1):
+            acc = 0
+            for step in range(steps):
+                inp = grpcclient.InferInput("INPUT", [1], "INT32")
+                inp.set_data_from_numpy(np.array([step], dtype=np.int32))
+                t0 = time.perf_counter()
+                client.async_stream_infer(
+                    "simple_sequence",
+                    [inp],
+                    sequence_id=seq,
+                    sequence_start=(step == 0),
+                    sequence_end=(step == steps - 1),
+                )
+                result, error = results.get(timeout=30)
+                lats.append((time.perf_counter() - t0) * 1e3)
+                if error is not None:
+                    raise RuntimeError(f"sequence stream error: {error}")
+                acc += step
+                got = int(result.as_numpy("OUTPUT")[0])
+                if got != acc:
+                    raise RuntimeError(
+                        f"sequence state wrong: {got} != {acc}"
+                    )
+        total_s = time.perf_counter() - t_start
+        client.stop_stream()
+    lats_arr = np.asarray(lats)
+    return {
+        "seq_stream_msgs_per_sec": round(len(lats) / total_s, 2),
+        "seq_stream_p50_ms": round(float(np.percentile(lats_arr, 50)), 3),
+        "seq_stream_p99_ms": round(float(np.percentile(lats_arr, 99)), 3),
+    }
+
+
+def _run_lm_stream(server, prompts=4, max_tokens=64):
+    """BASELINE.md config 5: token streaming from the int8-quantized LM over
+    the decoupled gRPC stream.  Reports time-to-first-token and steady-state
+    tokens/sec (first token excluded from the rate)."""
+    import queue
+
+    import client_tpu.grpc as grpcclient
+
+    from client_tpu.serve.models.language import encode_text
+
+    ttfts = []
+    token_gaps = []
+    with grpcclient.InferenceServerClient(server.grpc_address) as client:
+        results = queue.Queue()
+        client.start_stream(callback=lambda result, error: results.put((result, error)))
+        # warmup prompt: the first call pays the LM's jit compile; TTFT
+        # should measure serving latency, not one-time compilation
+        w_ids = np.asarray(encode_text("warm"), dtype=np.int32)
+        w_t = grpcclient.InferInput("TOKENS", [len(w_ids)], "INT32")
+        w_t.set_data_from_numpy(w_ids)
+        w_m = grpcclient.InferInput("MAX_TOKENS", [1], "INT32")
+        w_m.set_data_from_numpy(np.array([4], dtype=np.int32))
+        client.async_stream_infer("lm_streaming_int8", [w_t, w_m])
+        for _ in range(4):
+            r, e = results.get(timeout=300)
+            if e is not None:
+                raise RuntimeError(f"LM warmup error: {e}")
+            if int(r.as_numpy("TOKEN")[0]) == 257:  # EOS ends the stream
+                break
+        for i in range(prompts):
+            ids = encode_text(f"benchmark prompt {i}: once upon a time")
+            t_in = grpcclient.InferInput("TOKENS", [len(ids)], "INT32")
+            t_in.set_data_from_numpy(np.asarray(ids, dtype=np.int32))
+            m_in = grpcclient.InferInput("MAX_TOKENS", [1], "INT32")
+            m_in.set_data_from_numpy(np.array([max_tokens], dtype=np.int32))
+            t0 = time.perf_counter()
+            client.async_stream_infer("lm_streaming_int8", [t_in, m_in])
+            got = 0
+            t_prev = t0
+            while got < max_tokens:
+                result, error = results.get(timeout=120)
+                if error is not None:
+                    raise RuntimeError(f"LM stream error: {error}")
+                now = time.perf_counter()
+                if got == 0:
+                    ttfts.append((now - t0) * 1e3)
+                else:
+                    token_gaps.append(now - t_prev)
+                t_prev = now
+                got += 1
+                # the stream ends with an explicit EOS-token response
+                # (empty TEXT also decodes from a mid-stream BOS — not EOS)
+                if int(result.as_numpy("TOKEN")[0]) == 257:
+                    break
+        client.stop_stream()
+    return {
+        # 0.0 = "no steady-state gaps observed", never a fabricated rate
+        "lm_tokens_per_sec": round(
+            len(token_gaps) / float(np.sum(token_gaps)), 2
+        ) if token_gaps else 0.0,
+        "lm_ttft_ms": round(float(np.median(ttfts)), 2),
+        "lm_model": "lm_streaming_int8",
+    }
+
+
 def main():
     # Persistent compilation cache: on a tunneled TPU every new executable
     # costs seconds; caching makes warmup/compile one-time per machine, so
@@ -207,6 +382,8 @@ def main():
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 
     from client_tpu.serve import Server
+    from client_tpu.serve.builtins import sequence_model
+    from client_tpu.serve.models import language_models
     from client_tpu.serve.models.vision import cnn_classifier_model
 
     link = _measure_link()
@@ -217,23 +394,35 @@ def main():
             cnn_classifier_model(
                 name="cnn_small", image_size=SMALL_IMAGE_SIZE, warmup=True
             ),
+            sequence_model(),
+            *language_models(),
         ],
         grpc_port=0,
         with_default_models=False,
     ).start()
     try:
         tpu = _run_tpu_shm(server)
+        tpu_mp = _run_tpu_shm_multiproc(server, processes=4,
+                                        concurrency=CONCURRENCY)
+        tpu_b8 = _run_tpu_shm(server, concurrency=8, batch_size=8)
         tpu_c4 = _run_tpu_shm(server, concurrency=CONCURRENCY_LOW)
         tpu_sync = _run_tpu_shm(
             server, concurrency=CONCURRENCY_LOW, completion_sync=True
         )
         wire = _run_wire(server, "cnn_classifier", WIRE_CONCURRENCY)
         wire_small = _run_wire(server, "cnn_small", WIRE_CONCURRENCY)
+        seq = _run_seq_stream(server)
+        lm = _run_lm_stream(server)
     finally:
         server.stop()
 
     image_bytes = 3 * IMAGE_SIZE * IMAGE_SIZE * 4
-    wire_ceiling = link["link_h2d_mbps"] * 1e6 / image_bytes
+    # Ceiling = the better of the probe estimate and what the wire path
+    # itself achieved: a serial 20MB probe can under-read a fluctuating
+    # tunnel that request pipelining then out-performs (saturation stays
+    # <= 100% and means "fraction of demonstrated link capability").
+    achieved_mbps = wire["infer_per_sec"] * image_bytes / 1e6
+    wire_ceiling = max(link["link_h2d_mbps"], achieved_mbps) * 1e6 / image_bytes
     result = {
         "metric": "infer_throughput_cnn224_grpc_tpushm",
         "value": round(tpu["infer_per_sec"], 2),
@@ -245,11 +434,25 @@ def main():
         "requests": tpu["n"],
         "concurrency": CONCURRENCY,
         "duty_cycle_pct": tpu["duty_cycle_pct"],
+        # separate-process load generation (client_tpu.perf.procpool):
+        # the server keeps its GIL; clients reference regions by name
+        "mp_infer_per_sec": round(tpu_mp["infer_per_sec"], 2),
+        "mp_p50_ms": round(tpu_mp["p50_ms"], 3),
+        "mp_processes": tpu_mp["processes"],
+        "mp_duty_cycle_pct": tpu_mp["duty_cycle_pct"],
+        # batched clients (reference perf_analyzer -b): rows/sec through the
+        # same path — device throughput past the per-request RPC ceiling
+        "b8_rows_per_sec": round(tpu_b8["infer_per_sec"] * 8, 2),
+        "b8_request_p50_ms": round(tpu_b8["p50_ms"], 3),
         "c4_infer_per_sec": round(tpu_c4["infer_per_sec"], 2),
         "c4_p50_ms": round(tpu_c4["p50_ms"], 3),
         "sync_infer_per_sec": round(tpu_sync["infer_per_sec"], 2),
         "sync_p50_ms": round(tpu_sync["p50_ms"], 3),
         "sync_p99_ms": round(tpu_sync["p99_ms"], 3),
+        # sync floor: every per-request completion observation costs >= 1
+        # host<->device link round trip (link_rtt_ms below); on a TPU VM the
+        # same path's floor is PCIe-class (sub-ms)
+        "sync_floor_rtt_ms": None,  # filled from link below
         "wire_infer_per_sec": round(wire["infer_per_sec"], 2),
         "wire_p50_ms": round(wire["p50_ms"], 3),
         "wire_concurrency": WIRE_CONCURRENCY,
@@ -258,8 +461,11 @@ def main():
         ),
         "wire_small64_infer_per_sec": round(wire_small["infer_per_sec"], 2),
         "wire_small64_p50_ms": round(wire_small["p50_ms"], 3),
+        **seq,
+        **lm,
         **link,
     }
+    result["sync_floor_rtt_ms"] = link["link_rtt_ms"]
     print(json.dumps(result))
     return 0 if tpu["n"] and not tpu["errors"] else 1
 

@@ -253,11 +253,24 @@ class ModelBatcher:
             for name, (dims, np_dtype) in shapes.items()
         }
         if self._use_fused():
-            # one compile per arity: group of k single-row requests
-            for k in range(1, min(self.max_fused_arity, self.max_batch) + 1):
-                parts = {name: (part,) * k for name, part in row.items()}
-                out = self._fused_jit()(parts)
-                jax.block_until_ready(out)
+            # one compile per (arity, part-rows): groups of k single-row
+            # requests (the concurrency-sweep shape) and, when the batch
+            # budget allows, k eight-row requests (the batched-client shape,
+            # reference perf_analyzer -b)
+            for rows in (1, 8):
+                if rows > self.max_batch:
+                    continue
+                part = {
+                    name: jax.device_put(
+                        np.zeros([rows] + dims, dtype=np_dtype), dev
+                    )
+                    for name, (dims, np_dtype) in shapes.items()
+                }
+                max_k = min(self.max_fused_arity, self.max_batch // rows)
+                for k in range(1, max_k + 1):
+                    parts = {name: (p,) * k for name, p in part.items()}
+                    out = self._fused_jit()(parts)
+                    jax.block_until_ready(out)
             return
         # eager assembly path: per bucket warm (zeros-buffer + one-row
         # dynamic_update_slice) assembly, the forward on an assembled
@@ -286,6 +299,12 @@ class ModelBatcher:
             (name, arr.dtype.str, tuple(arr.shape[1:]))
             for name, arr in sorted(inputs.items())
         )
+        if device and self._use_fused():
+            # fused jit retraces per (arity, row-split): mixing row counts in
+            # one group would hit signatures warmup never compiled (seconds
+            # of cold XLA compile on the request path) — groups stay
+            # row-uniform so every composition is a warmed executable
+            signature += (rows,)
         pending = _Pending(inputs, rows, signature)
         with self._cond:
             if self._closed:
@@ -521,7 +540,11 @@ class ModelBatcher:
             self._busy.begin()
         try:
             device = group[0].signature[0]
-            names = [name for name, _, _ in group[0].signature[1:]]
+            # per-input entries only (a fused-device signature carries a
+            # trailing row-count scalar for group row-uniformity)
+            names = [
+                e[0] for e in group[0].signature[1:] if isinstance(e, tuple)
+            ]
             rows = sum(p.rows for p in group)
             if device and self._use_fused():
                 parts = {

@@ -755,3 +755,75 @@ def test_nested_ensemble_recurses():
         assert outs["OUTPUT0"]["data"] == (a + b).flatten().tolist()
     finally:
         engine.close()
+
+
+class TestProcPool:
+    """Multi-process load generation (client_tpu.perf.procpool) — the
+    GIL-sidestep analog of the reference's native multi-worker perf_analyzer
+    (perf_analyzer.cc:56-424)."""
+
+    def test_multiproc_wire_load(self):
+        from client_tpu.serve import Server
+        from client_tpu.perf.procpool import run_completion_multiproc
+
+        with Server(grpc_port=0) as server:
+            res = run_completion_multiproc(
+                server.grpc_address, "simple",
+                processes=2, concurrency=4,
+                window_s=1.0, warmup_s=0.2,
+                spec={"mode": "wire"},
+            )
+            assert res.processes == 2
+            assert res.error_count == 0
+            assert res.completed_requests > 0
+            assert res.throughput > 0
+            assert 50 in res.percentiles_us
+
+    def test_multiproc_worker_error_reported(self):
+        from client_tpu.perf.procpool import run_completion_multiproc
+
+        with pytest.raises(InferenceServerException, match="load worker"):
+            run_completion_multiproc(
+                "127.0.0.1:1", "nope", processes=1, concurrency=1,
+                window_s=0.2, warmup_s=0.0, spec={"mode": "wire"},
+                start_timeout_s=30,
+            )
+
+    def test_preregistered_shm_specs(self):
+        """Region-by-name referencing: a worker-side data manager builds
+        region-referencing requests without creating regions (no jax)."""
+        from client_tpu.perf.procpool import (
+            PreRegisteredShmInferDataManager,
+            ShapeOnlyLoader,
+        )
+
+        class _FakeInput:
+            def __init__(self, name, shape, datatype):
+                self.name, self.shape, self.datatype = name, shape, datatype
+
+            def set_shared_memory(self, region, nbytes, offset=0):
+                self.region, self.nbytes = region, nbytes
+
+        class _FakeOut:
+            def __init__(self, name):
+                self.name = name
+
+            def set_shared_memory(self, region, nbytes, offset=0):
+                self.region = region
+
+        class _FakeBackend:
+            infer_input_cls = _FakeInput
+            requested_output_cls = _FakeOut
+
+        mgr = PreRegisteredShmInferDataManager(
+            _FakeBackend(),
+            {(0, 0): [("IN", [1, 4], "FP32", "region_in", 16)]},
+            [("OUT", "region_out", 16)],
+        )
+        mgr.init()
+        data = mgr.get_infer_data(0, 0)
+        assert data.inputs[0].region == "region_in"
+        assert data.outputs[0].region == "region_out"
+        loader = ShapeOnlyLoader(1, [1])
+        assert loader.num_steps(0) == 1
+        assert loader.get_expected_outputs(0, 0) == {}
