@@ -1,5 +1,7 @@
 #include "grpc_client.h"
 
+#include <zlib.h>
+
 #include <atomic>
 #include <cstring>
 #include <sstream>
@@ -13,11 +15,11 @@ namespace {
 constexpr const char* kService = "/inference.GRPCInferenceService/";
 
 std::string
-LpmFrame(const std::string& message)
+LpmFrame(const std::string& message, bool compressed = false)
 {
   std::string out;
   out.reserve(message.size() + 5);
-  out.push_back(0);  // uncompressed
+  out.push_back(compressed ? 1 : 0);
   out.push_back(static_cast<char>((message.size() >> 24) & 0xff));
   out.push_back(static_cast<char>((message.size() >> 16) & 0xff));
   out.push_back(static_cast<char>((message.size() >> 8) & 0xff));
@@ -28,11 +30,15 @@ LpmFrame(const std::string& message)
 
 // Pulls one complete length-prefixed message out of *buf (erasing it).
 // Returns false when the buffer does not yet hold a complete message.
+// *compressed reports the LPM compression flag — the caller must reject it
+// unless it negotiated grpc-encoding (this client never advertises
+// grpc-accept-encoding, so a flagged response is a protocol violation).
 bool
-TakeLpm(std::string* buf, std::string* message)
+TakeLpm(std::string* buf, std::string* message, bool* compressed = nullptr)
 {
   if (buf->size() < 5) return false;
   const uint8_t* p = reinterpret_cast<const uint8_t*>(buf->data());
+  if (compressed != nullptr) *compressed = p[0] != 0;
   const uint32_t len = (uint32_t(p[1]) << 24) | (uint32_t(p[2]) << 16) |
                        (uint32_t(p[3]) << 8) | uint32_t(p[4]);
   if (buf->size() < 5u + len) return false;
@@ -40,6 +46,58 @@ TakeLpm(std::string* buf, std::string* message)
   buf->erase(0, 5 + len);
   return true;
 }
+
+// zlib-compress for the gRPC message encodings: "gzip" (RFC 1952 wrapper,
+// windowBits 15+16) or "deflate" (RFC 1950 zlib stream).
+Error
+CompressMessage(const std::string& in, bool gzip, std::string* out)
+{
+  z_stream zs;
+  std::memset(&zs, 0, sizeof(zs));
+  const int window = gzip ? 15 + 16 : 15;
+  if (deflateInit2(&zs, Z_DEFAULT_COMPRESSION, Z_DEFLATED, window, 8,
+                   Z_DEFAULT_STRATEGY) != Z_OK)
+    return Error("deflateInit2 failed");
+  out->resize(deflateBound(&zs, in.size()));
+  zs.next_in = reinterpret_cast<Bytef*>(const_cast<char*>(in.data()));
+  zs.avail_in = in.size();
+  zs.next_out = reinterpret_cast<Bytef*>(&(*out)[0]);
+  zs.avail_out = out->size();
+  const int rc = deflate(&zs, Z_FINISH);
+  deflateEnd(&zs);
+  if (rc != Z_STREAM_END) return Error("message compression failed");
+  out->resize(out->size() - zs.avail_out);
+  return Error::Success();
+}
+
+// Compress *body* in place per the requested algorithm and append the
+// matching grpc-encoding header; *compressed reports whether the LPM flag
+// must be set.  Shared by the sync and async infer paths.
+Error
+ApplyCompression(
+    GrpcCompression compression, std::string* body,
+    std::vector<h2::Header>* hdrs, bool* compressed)
+{
+  *compressed = false;
+  if (compression == GrpcCompression::NONE) return Error::Success();
+  std::string packed;
+  Error err = CompressMessage(
+      *body, compression == GrpcCompression::GZIP, &packed);
+  if (!err.IsOk()) return err;
+  body->swap(packed);
+  hdrs->emplace_back(
+      "grpc-encoding",
+      compression == GrpcCompression::GZIP ? "gzip" : "deflate");
+  *compressed = true;
+  return Error::Success();
+}
+
+// Shared channel cache (reference grpc_client.cc:79-120: one channel per
+// url, shared by every client created with use_cached_channel; the entry's
+// weak_ptr drops the "share count" role onto shared_ptr refcounting — the
+// connection closes when its last client is destroyed).
+std::mutex g_channel_mu;
+std::map<std::string, std::weak_ptr<h2::H2Connection>> g_channels;
 
 std::string
 PercentDecode(const std::string& in)
@@ -193,6 +251,21 @@ InferenceServerGrpcClient::InferenceServerGrpcClient(
 Error
 InferenceServerGrpcClient::Create(
     std::unique_ptr<InferenceServerGrpcClient>* client, const std::string& url,
+    const KeepAliveOptions& keepalive, bool use_cached_channel, bool verbose)
+{
+  Error err = Create(client, url, verbose);
+  if (!err.IsOk()) return err;
+  (*client)->keepalive_ = keepalive;
+  (*client)->keepalive_enabled_ =
+      keepalive.keepalive_time_ms > 0 &&
+      keepalive.keepalive_time_ms < INT32_MAX;
+  (*client)->shared_channel_ = use_cached_channel;
+  return Error::Success();
+}
+
+Error
+InferenceServerGrpcClient::Create(
+    std::unique_ptr<InferenceServerGrpcClient>* client, const std::string& url,
     const GrpcSslOptions& ssl_options, bool verbose)
 {
   (void)ssl_options;
@@ -213,7 +286,10 @@ InferenceServerGrpcClient::Create(
 InferenceServerGrpcClient::~InferenceServerGrpcClient()
 {
   StopStream();
-  if (conn_ != nullptr) conn_->Close();
+  // A cached (shared) channel may still serve other clients: dropping our
+  // reference is enough — H2Connection closes itself when the last user's
+  // shared_ptr goes away.
+  if (conn_ != nullptr && !shared_channel_) conn_->Close();
 }
 
 Error
@@ -223,8 +299,52 @@ InferenceServerGrpcClient::Connected()
   if (conn_ != nullptr && conn_->IsOpen()) return Error::Success();
   // The old connection object (if any) stays alive for as long as any
   // in-flight call or async callback still holds its shared_ptr.
+  if (shared_channel_) {
+    const std::string key = host_ + ":" + std::to_string(port_);
+    {
+      std::lock_guard<std::mutex> clk(g_channel_mu);
+      auto it = g_channels.find(key);
+      if (it != g_channels.end()) {
+        auto cached = it->second.lock();
+        if (cached != nullptr && cached->IsOpen()) {
+          conn_ = cached;
+          // a later client's keepalive request applies to the shared
+          // channel (first effective enabler's interval wins)
+          if (keepalive_enabled_)
+            conn_->EnableKeepAlive(
+                keepalive_.keepalive_time_ms,
+                keepalive_.keepalive_timeout_ms);
+          return Error::Success();
+        }
+      }
+    }
+    // Connect OUTSIDE the cache lock: a slow/unroutable host must not
+    // stall every cached-channel client process-wide.
+    auto fresh = std::make_shared<h2::H2Connection>();
+    Error err = fresh->Connect(host_, port_);
+    if (!err.IsOk()) return err;
+    if (keepalive_enabled_)
+      fresh->EnableKeepAlive(
+          keepalive_.keepalive_time_ms, keepalive_.keepalive_timeout_ms);
+    std::lock_guard<std::mutex> clk(g_channel_mu);
+    auto it = g_channels.find(key);
+    if (it != g_channels.end()) {
+      auto raced = it->second.lock();
+      if (raced != nullptr && raced->IsOpen()) {
+        conn_ = raced;  // another thread won the connect race; use theirs
+        return Error::Success();
+      }
+    }
+    g_channels[key] = fresh;
+    conn_ = fresh;
+    return Error::Success();
+  }
   conn_ = std::make_shared<h2::H2Connection>();
-  return conn_->Connect(host_, port_);
+  Error err = conn_->Connect(host_, port_);
+  if (err.IsOk() && keepalive_enabled_)
+    conn_->EnableKeepAlive(
+        keepalive_.keepalive_time_ms, keepalive_.keepalive_timeout_ms);
+  return err;
 }
 
 std::shared_ptr<h2::H2Connection>
@@ -238,7 +358,8 @@ Error
 InferenceServerGrpcClient::Call(
     const std::string& method, const google::protobuf::Message& request,
     google::protobuf::Message* response, uint64_t timeout_us,
-    const std::vector<std::pair<std::string, std::string>>& headers)
+    const std::vector<std::pair<std::string, std::string>>& headers,
+    GrpcCompression compression)
 {
   Error err = Connected();
   if (!err.IsOk()) return err;
@@ -257,6 +378,9 @@ InferenceServerGrpcClient::Call(
       {"te", "trailers"},
       {"user-agent", "ctpu-grpc-client/1.0"},
   };
+  bool compressed = false;
+  err = ApplyCompression(compression, &body, &hdrs, &compressed);
+  if (!err.IsOk()) return err;
   if (timeout_us > 0)
     hdrs.emplace_back("grpc-timeout", GrpcTimeoutValue(timeout_us));
   for (const auto& h : headers) hdrs.emplace_back(h.first, h.second);
@@ -264,7 +388,7 @@ InferenceServerGrpcClient::Call(
   int32_t sid = 0;
   err = conn->StartStream(hdrs, false, &sid);
   if (!err.IsOk()) return err;
-  const std::string framed = LpmFrame(body);
+  const std::string framed = LpmFrame(body, compressed);
   const int64_t deadline_ms =
       timeout_us > 0 ? static_cast<int64_t>(timeout_us / 1000) + 1 : 0;
   err = conn->SendData(
@@ -283,8 +407,13 @@ InferenceServerGrpcClient::Call(
   err = GrpcStatus(*stream);
   if (!err.IsOk()) return err;
   std::string message;
-  if (!TakeLpm(&wire, &message))
+  bool rx_compressed = false;
+  if (!TakeLpm(&wire, &message, &rx_compressed))
     return Error(method + " response carried no message");
+  if (rx_compressed)
+    return Error(
+        "compressed gRPC response messages are not supported (this client "
+        "sends no grpc-accept-encoding)");
   if (!response->ParseFromString(message))
     return Error("failed to parse " + method + " response");
   if (verbose_) {
@@ -401,6 +530,60 @@ InferenceServerGrpcClient::ModelInferenceStatistics(
   request.set_name(name);
   request.set_version(version);
   return Call("ModelStatistics", request, response);
+}
+
+Error
+InferenceServerGrpcClient::UpdateTraceSettings(
+    inference::TraceSettingResponse* response, const std::string& model_name,
+    const std::map<std::string, std::vector<std::string>>& settings)
+{
+  inference::TraceSettingRequest request;
+  request.set_model_name(model_name);
+  for (const auto& kv : settings) {
+    auto& value = (*request.mutable_settings())[kv.first];
+    for (const auto& v : kv.second) value.add_value(v);
+  }
+  return Call("TraceSetting", request, response);
+}
+
+Error
+InferenceServerGrpcClient::GetTraceSettings(
+    inference::TraceSettingResponse* response, const std::string& model_name)
+{
+  return UpdateTraceSettings(response, model_name, {});
+}
+
+Error
+InferenceServerGrpcClient::UpdateLogSettings(
+    inference::LogSettingsResponse* response,
+    const std::map<std::string, std::string>& settings)
+{
+  inference::LogSettingsRequest request;
+  for (const auto& kv : settings) {
+    auto& value = (*request.mutable_settings())[kv.first];
+    // bool and uint32 settings ride their natural types; the rest strings
+    // (mirror of the python client's log_settings plumbing)
+    if (kv.second == "true" || kv.second == "false") {
+      value.set_bool_param(kv.second == "true");
+    } else if (!kv.second.empty() && kv.second.size() <= 9 &&
+               kv.second.find_first_not_of("0123456789") ==
+                   std::string::npos) {
+      // <= 9 digits always fits uint32; longer numerics ride as strings
+      // rather than throwing or truncating
+      value.set_uint32_param(
+          static_cast<uint32_t>(std::stoul(kv.second)));
+    } else {
+      value.set_string_param(kv.second);
+    }
+  }
+  return Call("LogSettings", request, response);
+}
+
+Error
+InferenceServerGrpcClient::GetLogSettings(
+    inference::LogSettingsResponse* response)
+{
+  return UpdateLogSettings(response, {});
 }
 
 Error
@@ -564,7 +747,8 @@ InferenceServerGrpcClient::Infer(
     InferResult** result, const InferOptions& options,
     const std::vector<InferInput*>& inputs,
     const std::vector<const InferRequestedOutput*>& outputs,
-    const std::vector<std::pair<std::string, std::string>>& headers)
+    const std::vector<std::pair<std::string, std::string>>& headers,
+    GrpcCompression compression)
 {
   RequestTimers timers;
   timers.Capture(RequestTimers::Kind::REQUEST_START);
@@ -574,7 +758,7 @@ InferenceServerGrpcClient::Infer(
   inference::ModelInferResponse response;
   timers.Capture(RequestTimers::Kind::SEND_START);
   err = Call("ModelInfer", request, &response, options.client_timeout_us,
-             headers);
+             headers, compression);
   timers.Capture(RequestTimers::Kind::SEND_END);
   timers.Capture(RequestTimers::Kind::RECV_START);
   if (!err.IsOk()) return err;
@@ -590,7 +774,8 @@ InferenceServerGrpcClient::AsyncInfer(
     OnCompleteFn callback, const InferOptions& options,
     const std::vector<InferInput*>& inputs,
     const std::vector<const InferRequestedOutput*>& outputs,
-    const std::vector<std::pair<std::string, std::string>>& headers)
+    const std::vector<std::pair<std::string, std::string>>& headers,
+    GrpcCompression compression)
 {
   if (callback == nullptr)
     return Error("AsyncInfer requires a completion callback");
@@ -613,6 +798,9 @@ InferenceServerGrpcClient::AsyncInfer(
       {"te", "trailers"},
       {"user-agent", "ctpu-grpc-client/1.0"},
   };
+  bool compressed = false;
+  err = ApplyCompression(compression, &body, &hdrs, &compressed);
+  if (!err.IsOk()) return err;
   if (options.client_timeout_us > 0)
     hdrs.emplace_back("grpc-timeout",
                       GrpcTimeoutValue(options.client_timeout_us));
@@ -646,9 +834,12 @@ InferenceServerGrpcClient::AsyncInfer(
           std::string wire;
           wire.swap(stream->data);
           std::string message;
+          bool rx_compressed = false;
           inference::ModelInferResponse response;
-          if (!TakeLpm(&wire, &message))
+          if (!TakeLpm(&wire, &message, &rx_compressed))
             status = Error("ModelInfer response carried no message");
+          else if (rx_compressed)
+            status = Error("compressed gRPC response messages are not supported");
           else if (!response.ParseFromString(message))
             status = Error("failed to parse ModelInfer response");
           else
@@ -661,7 +852,7 @@ InferenceServerGrpcClient::AsyncInfer(
       });
   if (!err.IsOk()) return err;
   sid_holder->store(sid);
-  const std::string framed = LpmFrame(body);
+  const std::string framed = LpmFrame(body, compressed);
   // From here on the request is owned by the callback path: a send failure
   // surfaces through the stream/connection event (reset or FailConnection),
   // which fires the completion — returning the error too would double-report
@@ -679,6 +870,102 @@ InferenceServerGrpcClient::AsyncInfer(
   auto stream = conn->GetStream(sid);
   if (stream != nullptr && stream->end_stream && stream->on_event)
     stream->on_event();
+  return Error::Success();
+}
+
+// ---------------------------------------------------------------------------
+// batched multi-request variants (reference grpc_client.h:455-494)
+// ---------------------------------------------------------------------------
+
+Error
+InferenceServerGrpcClient::InferMulti(
+    std::vector<InferResult*>* results, const std::vector<InferOptions>& options,
+    const std::vector<std::vector<InferInput*>>& inputs,
+    const std::vector<std::vector<const InferRequestedOutput*>>& outputs,
+    const std::vector<std::pair<std::string, std::string>>& headers)
+{
+  // The reference permits a single shared options/outputs row for N inputs.
+  if (inputs.empty()) return Error("InferMulti needs at least one request");
+  if (options.size() != 1 && options.size() != inputs.size())
+    return Error("InferMulti options must be size 1 or match inputs");
+  if (!outputs.empty() && outputs.size() != 1 &&
+      outputs.size() != inputs.size())
+    return Error("InferMulti outputs must be empty, size 1, or match inputs");
+  results->clear();
+  for (size_t i = 0; i < inputs.size(); ++i) {
+    const InferOptions& opt = options.size() == 1 ? options[0] : options[i];
+    static const std::vector<const InferRequestedOutput*> kNoOutputs;
+    const auto& outs = outputs.empty()
+                           ? kNoOutputs
+                           : (outputs.size() == 1 ? outputs[0] : outputs[i]);
+    InferResult* result = nullptr;
+    Error err = Infer(&result, opt, inputs[i], outs, headers);
+    if (!err.IsOk()) return err;
+    results->push_back(result);
+  }
+  return Error::Success();
+}
+
+Error
+InferenceServerGrpcClient::AsyncInferMulti(
+    OnMultiCompleteFn callback, const std::vector<InferOptions>& options,
+    const std::vector<std::vector<InferInput*>>& inputs,
+    const std::vector<std::vector<const InferRequestedOutput*>>& outputs,
+    const std::vector<std::pair<std::string, std::string>>& headers)
+{
+  if (callback == nullptr)
+    return Error("AsyncInferMulti requires a completion callback");
+  if (inputs.empty())
+    return Error("AsyncInferMulti needs at least one request");
+  if (options.size() != 1 && options.size() != inputs.size())
+    return Error("AsyncInferMulti options must be size 1 or match inputs");
+  if (!outputs.empty() && outputs.size() != 1 &&
+      outputs.size() != inputs.size())
+    return Error(
+        "AsyncInferMulti outputs must be empty, size 1, or match inputs");
+
+  // All requests fly concurrently on the multiplexed connection; the last
+  // completion fires the user callback with results in request order.
+  struct MultiState {
+    std::mutex mu;
+    std::vector<InferResultPtr> results;
+    size_t pending;
+    OnMultiCompleteFn callback;
+  };
+  auto state = std::make_shared<MultiState>();
+  state->results.resize(inputs.size());
+  state->pending = inputs.size();
+  state->callback = std::move(callback);
+  for (size_t i = 0; i < inputs.size(); ++i) {
+    const InferOptions& opt = options.size() == 1 ? options[0] : options[i];
+    static const std::vector<const InferRequestedOutput*> kNoOutputs;
+    const auto& outs = outputs.empty()
+                           ? kNoOutputs
+                           : (outputs.size() == 1 ? outputs[0] : outputs[i]);
+    Error err = AsyncInfer(
+        [state, i](InferResultPtr result) {
+          bool fire = false;
+          {
+            std::lock_guard<std::mutex> lk(state->mu);
+            state->results[i] = std::move(result);
+            fire = (--state->pending == 0);
+          }
+          if (fire) state->callback(std::move(state->results));
+        },
+        opt, inputs[i], outs, headers);
+    if (!err.IsOk()) {
+      // submission failed: deliver an error result for this slot
+      auto* res = new InferResult();
+      res->error_ = err;
+      bool fire = false;
+      {
+        std::lock_guard<std::mutex> lk(state->mu);
+        state->results[i] = InferResultPtr(res);
+        fire = (--state->pending == 0);
+      }
+      if (fire) state->callback(std::move(state->results));
+    }
+  }
   return Error::Success();
 }
 
@@ -732,10 +1019,14 @@ InferenceServerGrpcClient::StartStream(
       // Take everything buffered (min_bytes=0 returns immediately).
       conn->ReadData(stream_sid_, 0, &stream_rx_, 1);
       std::string message;
-      while (TakeLpm(&stream_rx_, &message)) {
+      bool rx_compressed = false;
+      while (TakeLpm(&stream_rx_, &message, &rx_compressed)) {
         inference::ModelStreamInferResponse response;
         auto* res = new InferResult();
-        if (!response.ParseFromString(message)) {
+        if (rx_compressed) {
+          res->error_ =
+              Error("compressed gRPC response messages are not supported");
+        } else if (!response.ParseFromString(message)) {
           res->error_ = Error("failed to parse stream response");
         } else if (!response.error_message().empty()) {
           res->error_ = Error(response.error_message());

@@ -14,6 +14,7 @@
 #include <condition_variable>
 #include <deque>
 #include <functional>
+#include <map>
 #include <memory>
 #include <mutex>
 #include <string>
@@ -37,6 +38,21 @@ struct GrpcSslOptions {
   std::string certificate_chain;  // PEM path
 };
 
+// Channel keepalive (reference grpc_client.h:62-82 KeepAliveOptions): h2
+// PING probes every keepalive_time_ms; an unacked probe after
+// keepalive_timeout_ms fails the connection so every pending request
+// surfaces the failure instead of hanging on a dead peer.
+struct KeepAliveOptions {
+  int64_t keepalive_time_ms = INT32_MAX;  // INT32_MAX = disabled (reference default)
+  int64_t keepalive_timeout_ms = 20000;
+  bool keepalive_permit_without_calls = false;
+};
+
+// Per-call message compression (reference grpc_client.h:411 passes
+// grpc_compression_algorithm): the LPM payload is compressed and flagged,
+// with the matching grpc-encoding header.
+enum class GrpcCompression { NONE, DEFLATE, GZIP };
+
 class InferenceServerGrpcClient {
  public:
   using OnCompleteFn = std::function<void(InferResultPtr)>;
@@ -45,6 +61,14 @@ class InferenceServerGrpcClient {
   static Error Create(
       std::unique_ptr<InferenceServerGrpcClient>* client,
       const std::string& url, bool verbose = false);
+  // Keepalive + channel-cache variant (reference grpc_client.cc:79-120
+  // NewGrpcChannel: one shared channel per url with a share count).  With
+  // use_cached_channel, clients for the same url multiplex one
+  // H2Connection; the connection closes when its last user is destroyed.
+  static Error Create(
+      std::unique_ptr<InferenceServerGrpcClient>* client,
+      const std::string& url, const KeepAliveOptions& keepalive,
+      bool use_cached_channel, bool verbose = false);
   // TLS channel variant; see GrpcSslOptions for the gating note.
   static Error Create(
       std::unique_ptr<InferenceServerGrpcClient>* client,
@@ -73,6 +97,19 @@ class InferenceServerGrpcClient {
       inference::ModelStatisticsResponse* response,
       const std::string& name = "", const std::string& version = "");
 
+  // -- trace / log settings (reference grpc_client.h:291-309) --------------
+  Error UpdateTraceSettings(
+      inference::TraceSettingResponse* response,
+      const std::string& model_name = "",
+      const std::map<std::string, std::vector<std::string>>& settings = {});
+  Error GetTraceSettings(
+      inference::TraceSettingResponse* response,
+      const std::string& model_name = "");
+  Error UpdateLogSettings(
+      inference::LogSettingsResponse* response,
+      const std::map<std::string, std::string>& settings = {});
+  Error GetLogSettings(inference::LogSettingsResponse* response);
+
   // -- shared memory verbs (grpc_client.h:263-321) -------------------------
   Error SystemSharedMemoryStatus(
       inference::SystemSharedMemoryStatusResponse* response,
@@ -97,11 +134,33 @@ class InferenceServerGrpcClient {
       InferResult** result, const InferOptions& options,
       const std::vector<InferInput*>& inputs,
       const std::vector<const InferRequestedOutput*>& outputs = {},
-      const std::vector<std::pair<std::string, std::string>>& headers = {});
+      const std::vector<std::pair<std::string, std::string>>& headers = {},
+      GrpcCompression compression = GrpcCompression::NONE);
   Error AsyncInfer(
       OnCompleteFn callback, const InferOptions& options,
       const std::vector<InferInput*>& inputs,
       const std::vector<const InferRequestedOutput*>& outputs = {},
+      const std::vector<std::pair<std::string, std::string>>& headers = {},
+      GrpcCompression compression = GrpcCompression::NONE);
+
+  // -- batched multi-request variants (reference grpc_client.h:455-494) ----
+  // Issue one request per options/inputs row.  InferMulti returns on the
+  // first failure (already-returned results stay owned by the caller);
+  // AsyncInferMulti fires `callback` once with all results (error results
+  // included) after every request completes.
+  using OnMultiCompleteFn = std::function<void(std::vector<InferResultPtr>)>;
+  Error InferMulti(
+      std::vector<InferResult*>* results,
+      const std::vector<InferOptions>& options,
+      const std::vector<std::vector<InferInput*>>& inputs,
+      const std::vector<std::vector<const InferRequestedOutput*>>& outputs =
+          {},
+      const std::vector<std::pair<std::string, std::string>>& headers = {});
+  Error AsyncInferMulti(
+      OnMultiCompleteFn callback, const std::vector<InferOptions>& options,
+      const std::vector<std::vector<InferInput*>>& inputs,
+      const std::vector<std::vector<const InferRequestedOutput*>>& outputs =
+          {},
       const std::vector<std::pair<std::string, std::string>>& headers = {});
 
   // -- decoupled / sequence streaming (grpc_client.h:414-504) ---------------
@@ -128,7 +187,8 @@ class InferenceServerGrpcClient {
   Error Call(
       const std::string& method, const google::protobuf::Message& request,
       google::protobuf::Message* response, uint64_t timeout_us = 0,
-      const std::vector<std::pair<std::string, std::string>>& headers = {});
+      const std::vector<std::pair<std::string, std::string>>& headers = {},
+      GrpcCompression compression = GrpcCompression::NONE);
   Error BuildInferRequest(
       const InferOptions& options, const std::vector<InferInput*>& inputs,
       const std::vector<const InferRequestedOutput*>& outputs,
@@ -138,6 +198,9 @@ class InferenceServerGrpcClient {
   std::string host_;
   int port_;
   bool verbose_;
+  bool shared_channel_ = false;  // cached-channel clients never Close()
+  KeepAliveOptions keepalive_;
+  bool keepalive_enabled_ = false;
   // shared_ptr: a reconnect swaps conn_ while requests may still be blocked
   // inside (or async callbacks may still reference) the old connection —
   // each call path pins its own reference.

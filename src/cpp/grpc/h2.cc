@@ -174,13 +174,67 @@ H2Connection::IsOpen()
 }
 
 void
+H2Connection::EnableKeepAlive(int64_t interval_ms, int64_t timeout_ms)
+{
+  std::lock_guard<std::mutex> lk(mu_);
+  if (keepalive_.joinable() || !open_) return;
+  keepalive_interval_ms_ = interval_ms > 0 ? interval_ms : 10000;
+  keepalive_timeout_ms_ = timeout_ms > 0 ? timeout_ms : 20000;
+  keepalive_ = std::thread([this] {
+    while (true) {
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_.wait_for(
+            lk, std::chrono::milliseconds(keepalive_interval_ms_),
+            [&] { return keepalive_stop_ || !conn_err_.IsOk(); });
+        if (keepalive_stop_ || !conn_err_.IsOk() || !open_) return;
+      }
+      Error err = Ping(keepalive_timeout_ms_);
+      if (!err.IsOk()) {
+        FailConnection("keepalive ping timed out: " + err.Message());
+        return;
+      }
+    }
+  });
+}
+
+Error
+H2Connection::Ping(int64_t timeout_ms)
+{
+  uint64_t acked_before;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (!conn_err_.IsOk()) return conn_err_;
+    if (!open_) return Error("h2 connection closed");
+    acked_before = ping_acks_;
+  }
+  std::string payload(8, '\0');
+  Error err = WriteFrame(kPing, 0, 0, payload);
+  if (!err.IsOk()) return err;
+  std::unique_lock<std::mutex> lk(mu_);
+  const bool got = cv_.wait_for(
+      lk, std::chrono::milliseconds(timeout_ms > 0 ? timeout_ms : 20000),
+      [&] {
+        return ping_acks_ != acked_before || !conn_err_.IsOk() ||
+               keepalive_stop_;
+      });
+  if (!conn_err_.IsOk()) return conn_err_;
+  if (keepalive_stop_) return Error("h2 connection closing");
+  if (!got) return Error("timeout waiting for PING ack");
+  return Error::Success();
+}
+
+void
 H2Connection::Close()
 {
   {
     std::lock_guard<std::mutex> lk(mu_);
     if (!open_ && fd_ < 0) return;
     open_ = false;
+    keepalive_stop_ = true;
   }
+  cv_.notify_all();
+  if (keepalive_.joinable()) keepalive_.join();
   if (fd_ >= 0) {
     // GOAWAY then hard shutdown; the reader thread unblocks on EOF/EPIPE.
     std::string payload;
@@ -322,6 +376,7 @@ H2Connection::SendData(
       if (stream == nullptr) return Error("h2 stream closed");
       if (!cv_.wait_until(lk, dl, [&] {
             return !conn_err_.IsOk() || stream->reset ||
+                   stream->end_stream ||
                    (conn_send_window_ > 0 && stream->send_window > 0) ||
                    (end_stream && len == 0);
           })) {
@@ -332,6 +387,16 @@ H2Connection::SendData(
         return Error(
             "h2 stream reset by peer (code " +
             std::to_string(stream->rst_code) + ")");
+      if (stream->end_stream && off < len) {
+        // Peer half-closed without RST (e.g. a trailers-only early
+        // response, auth reject, RESOURCE_EXHAUSTED): the RPC is decided
+        // and the rest of the body is moot.  Stop sending and report
+        // success for the sent prefix so the caller reads the REAL
+        // grpc-status from the trailers already buffered on the stream —
+        // erroring here would mask it (and a deadline-less caller whose
+        // window never reopens would otherwise block forever).
+        return Error::Success();
+      }
       budget = std::min<size_t>(
           {len - off, static_cast<size_t>(std::max<int64_t>(
                           0, std::min(conn_send_window_,
@@ -503,6 +568,11 @@ void
 H2Connection::HandleFrame(
     uint8_t type, uint8_t flags, int32_t sid, std::string payload)
 {
+  // RFC 7540 §6.10: an unterminated header block admits ONLY CONTINUATION
+  // frames for the same stream; anything else is a connection error.  (A
+  // CONTINUATION for a different stream is also caught below.)
+  if (expect_continuation_ && type != kContinuation)
+    return FailConnection("frame interleaved in header block (§6.10)");
   switch (type) {
     case kData: {
       size_t start = 0, end = payload.size();
@@ -565,7 +635,11 @@ H2Connection::HandleFrame(
         return FailConnection("CONTINUATION for wrong stream");
       }
       hdr_block_.append(payload, start, end - start);
-      if (!(flags & kFlagEndHeaders)) break;
+      if (!(flags & kFlagEndHeaders)) {
+        expect_continuation_ = true;
+        break;
+      }
+      expect_continuation_ = false;
       std::vector<Header> decoded;
       std::function<void()> cb;
       {
@@ -631,8 +705,13 @@ H2Connection::HandleFrame(
       break;
     }
     case kPing:
-      if (!(flags & kFlagAck) && payload.size() == 8)
+      if (!(flags & kFlagAck) && payload.size() == 8) {
         WriteFrame(kPing, kFlagAck, 0, payload);
+      } else if (flags & kFlagAck) {
+        std::lock_guard<std::mutex> lk(mu_);
+        ping_acks_++;
+        cv_.notify_all();
+      }
       break;
     case kWindowUpdate: {
       if (payload.size() != 4) return FailConnection("malformed WINDOW_UPDATE");

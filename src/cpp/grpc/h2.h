@@ -85,6 +85,13 @@ class H2Connection {
   void ForgetStream(int32_t sid);  // release finished stream state
   Error ConnectionError();
 
+  // PING keepalive (reference grpc_client.h:62-82 KeepAliveOptions): a
+  // probe thread sends PING every interval_ms; a probe unacked for
+  // timeout_ms fails the connection (every waiter wakes with the error).
+  void EnableKeepAlive(int64_t interval_ms, int64_t timeout_ms);
+  // One synchronous PING round trip — liveness check / RTT probe.
+  Error Ping(int64_t timeout_ms);
+
  private:
   Error WriteAll(const uint8_t* buf, size_t len);
   Error WriteFrame(
@@ -97,6 +104,7 @@ class H2Connection {
 
   int fd_ = -1;
   std::thread reader_;
+  std::thread keepalive_;
   std::mutex mu_;                  // stream table + windows + hpack_rx_
   std::condition_variable cv_;
   std::mutex write_mu_;            // serializes socket writes + hpack_tx_
@@ -107,6 +115,13 @@ class H2Connection {
   int32_t hdr_stream_ = 0;
   std::string hdr_block_;
   bool hdr_end_stream_ = false;
+  // RFC 7540 §6.10: between a HEADERS/CONTINUATION without END_HEADERS and
+  // the block's end, only CONTINUATION for the same stream is legal.
+  bool expect_continuation_ = false;
+  uint64_t ping_acks_ = 0;  // PING ACK count (guarded by mu_)
+  int64_t keepalive_interval_ms_ = 0;
+  int64_t keepalive_timeout_ms_ = 0;
+  bool keepalive_stop_ = false;
 
   int64_t conn_send_window_ = 65535;
   uint32_t peer_max_frame_ = 16384;

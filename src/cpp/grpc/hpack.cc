@@ -328,6 +328,7 @@ bool
 HpackDecoder::Decode(const uint8_t* data, size_t len, std::vector<Header>* out)
 {
   size_t pos = 0;
+  bool field_seen = false;  // §4.2: size updates only at block start
   while (pos < len) {
     const uint8_t b = data[pos];
     if (b & 0x80) {  // indexed header field (§6.1)
@@ -336,7 +337,9 @@ HpackDecoder::Decode(const uint8_t* data, size_t len, std::vector<Header>* out)
       Entry e;
       if (!Lookup(index, &e)) return false;
       out->emplace_back(std::move(e.name), std::move(e.value));
+      field_seen = true;
     } else if ((b & 0xe0) == 0x20) {  // dynamic table size update (§6.3)
+      if (field_seen) return false;  // RFC 7541 §4.2: must precede fields
       uint64_t sz;
       if (!DecodeInt(data, len, &pos, 5, &sz)) return false;
       if (sz > settings_cap_) return false;
@@ -361,6 +364,7 @@ HpackDecoder::Decode(const uint8_t* data, size_t len, std::vector<Header>* out)
       if (!DecodeString(data, len, &pos, &value)) return false;
       if (incremental) Insert(name, value);
       out->emplace_back(std::move(name), std::move(value));
+      field_seen = true;
     }
   }
   return true;

@@ -306,6 +306,164 @@ TestSharedMemoryVerbs(tc::InferenceServerGrpcClient* client)
   CHECK_OK(client->UnregisterTpuSharedMemory());
 }
 
+static void
+TestTraceAndLogSettings(tc::InferenceServerGrpcClient* client)
+{
+  // reference grpc_client.h:291-309 — get, update, get-back
+  inference::TraceSettingResponse trace;
+  CHECK_OK(client->GetTraceSettings(&trace));
+  CHECK(trace.settings().count("trace_level") == 1);
+  CHECK_OK(client->UpdateTraceSettings(
+      &trace, "", {{"trace_level", {"TIMESTAMPS"}}, {"trace_rate", {"500"}}}));
+  inference::TraceSettingResponse trace2;
+  CHECK_OK(client->GetTraceSettings(&trace2));
+  bool rate_ok = trace2.settings().count("trace_rate") == 1 &&
+                 trace2.settings().at("trace_rate").value_size() == 1 &&
+                 trace2.settings().at("trace_rate").value(0) == "500";
+  CHECK(rate_ok);
+
+  inference::LogSettingsResponse log;
+  CHECK_OK(client->GetLogSettings(&log));
+  CHECK(log.settings().count("log_info") == 1);
+  CHECK_OK(client->UpdateLogSettings(
+      &log, {{"log_verbose_level", "2"}, {"log_info", "true"}}));
+  inference::LogSettingsResponse log2;
+  CHECK_OK(client->GetLogSettings(&log2));
+  bool level_ok = log2.settings().count("log_verbose_level") == 1 &&
+                  log2.settings().at("log_verbose_level").uint32_param() == 2;
+  CHECK(level_ok);
+}
+
+static void
+TestInferMulti(tc::InferenceServerGrpcClient* client)
+{
+  // reference grpc_client.h:455-494 — N independent requests, one call
+  const int kN = 4;
+  std::vector<std::vector<int32_t>> data0(kN), data1(kN);
+  std::vector<std::unique_ptr<tc::InferInput>> owned;
+  std::vector<std::vector<tc::InferInput*>> inputs;
+  for (int r = 0; r < kN; ++r) {
+    data0[r].assign(16, r);
+    data1[r].assign(16, 10 * r);
+    auto in0 = std::make_unique<tc::InferInput>(
+        "INPUT0", std::vector<int64_t>{1, 16}, "INT32");
+    auto in1 = std::make_unique<tc::InferInput>(
+        "INPUT1", std::vector<int64_t>{1, 16}, "INT32");
+    in0->AppendRaw(
+        reinterpret_cast<const uint8_t*>(data0[r].data()),
+        16 * sizeof(int32_t));
+    in1->AppendRaw(
+        reinterpret_cast<const uint8_t*>(data1[r].data()),
+        16 * sizeof(int32_t));
+    inputs.push_back({in0.get(), in1.get()});
+    owned.push_back(std::move(in0));
+    owned.push_back(std::move(in1));
+  }
+  std::vector<tc::InferOptions> options(1, tc::InferOptions("simple"));
+  std::vector<tc::InferResult*> results;
+  CHECK_OK(client->InferMulti(&results, options, inputs));
+  CHECK(results.size() == kN);
+  for (int r = 0; r < static_cast<int>(results.size()); ++r) {
+    std::unique_ptr<tc::InferResult> owner(results[r]);
+    const uint8_t* buf = nullptr;
+    size_t size = 0;
+    CHECK_OK(results[r]->RawData("OUTPUT0", &buf, &size));
+    CHECK(size == 16 * sizeof(int32_t));
+    CHECK(reinterpret_cast<const int32_t*>(buf)[3] == 11 * r);
+  }
+
+  // async variant: one callback with all results, request order preserved
+  std::mutex mu;
+  std::condition_variable cv;
+  bool fired = false;
+  int good = 0;
+  CHECK_OK(client->AsyncInferMulti(
+      [&](std::vector<tc::InferResultPtr> multi) {
+        std::lock_guard<std::mutex> lk(mu);
+        for (int r = 0; r < static_cast<int>(multi.size()); ++r) {
+          const uint8_t* buf = nullptr;
+          size_t size = 0;
+          if (multi[r] && multi[r]->RequestStatus().IsOk() &&
+              multi[r]->RawData("OUTPUT0", &buf, &size).IsOk() &&
+              reinterpret_cast<const int32_t*>(buf)[0] == 11 * r) {
+            ++good;
+          }
+        }
+        fired = true;
+        cv.notify_all();
+      },
+      options, inputs));
+  std::unique_lock<std::mutex> lk(mu);
+  cv.wait_for(lk, std::chrono::seconds(30), [&] { return fired; });
+  CHECK(fired);
+  CHECK(good == kN);
+}
+
+static void
+TestCompression(tc::InferenceServerGrpcClient* client)
+{
+  // per-call gzip/deflate message compression (reference grpc_client.h:411);
+  // the python gRPC server transparently decompresses both encodings
+  for (const auto algo :
+       {tc::GrpcCompression::GZIP, tc::GrpcCompression::DEFLATE}) {
+    tc::InferResult* result = nullptr;
+    std::vector<int32_t> input0(16), input1(16);
+    for (int i = 0; i < 16; ++i) {
+      input0[i] = i;
+      input1[i] = i;
+    }
+    tc::InferInput in0("INPUT0", {1, 16}, "INT32");
+    tc::InferInput in1("INPUT1", {1, 16}, "INT32");
+    in0.AppendRaw(
+        reinterpret_cast<const uint8_t*>(input0.data()),
+        16 * sizeof(int32_t));
+    in1.AppendRaw(
+        reinterpret_cast<const uint8_t*>(input1.data()),
+        16 * sizeof(int32_t));
+    tc::InferOptions options("simple");
+    CHECK_OK(client->Infer(&result, options, {&in0, &in1}, {}, {}, algo));
+    if (result == nullptr) continue;
+    std::unique_ptr<tc::InferResult> owner(result);
+    const uint8_t* buf = nullptr;
+    size_t size = 0;
+    CHECK_OK(result->RawData("OUTPUT0", &buf, &size));
+    CHECK(reinterpret_cast<const int32_t*>(buf)[7] == 14);
+  }
+}
+
+static void
+TestKeepAliveAndChannelCache(const std::string& url)
+{
+  // keepalive: pings every 200ms must not disturb request traffic
+  tc::KeepAliveOptions keepalive;
+  keepalive.keepalive_time_ms = 200;
+  keepalive.keepalive_timeout_ms = 5000;
+  std::unique_ptr<tc::InferenceServerGrpcClient> ka_client;
+  CHECK_OK(tc::InferenceServerGrpcClient::Create(
+      &ka_client, url, keepalive, /*use_cached_channel=*/false));
+  bool live = false;
+  CHECK_OK(ka_client->IsServerLive(&live));
+  std::this_thread::sleep_for(std::chrono::milliseconds(700));  // >2 pings
+  tc::InferResult* result = nullptr;
+  CHECK_OK(DoInfer(ka_client.get(), "simple", &result));
+  delete result;
+
+  // channel cache: two clients share one connection; destroying the first
+  // must not break the second (shared_ptr refcount is the share count)
+  std::unique_ptr<tc::InferenceServerGrpcClient> c1, c2;
+  CHECK_OK(tc::InferenceServerGrpcClient::Create(
+      &c1, url, tc::KeepAliveOptions(), /*use_cached_channel=*/true));
+  CHECK_OK(tc::InferenceServerGrpcClient::Create(
+      &c2, url, tc::KeepAliveOptions(), /*use_cached_channel=*/true));
+  tc::InferResult* r1 = nullptr;
+  CHECK_OK(DoInfer(c1.get(), "simple", &r1));
+  delete r1;
+  c1.reset();  // drops one reference; the shared channel stays open
+  tc::InferResult* r2 = nullptr;
+  CHECK_OK(DoInfer(c2.get(), "simple", &r2));
+  delete r2;
+}
+
 int
 main(int argc, char** argv)
 {
@@ -324,6 +482,10 @@ main(int argc, char** argv)
   TestStringSequenceId(client.get());
   TestStatistics(client.get());
   TestSharedMemoryVerbs(client.get());
+  TestTraceAndLogSettings(client.get());
+  TestInferMulti(client.get());
+  TestCompression(client.get());
+  TestKeepAliveAndChannelCache(url);
 
   std::cout << g_checks << " checks, " << g_failures << " failures"
             << std::endl;
