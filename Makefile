@@ -7,27 +7,41 @@ CXXFLAGS ?= -O2 -fPIC -Wall -std=c++17
 NATIVE_OUT := client_tpu/utils/shared_memory
 TPUSHM_OUT := client_tpu/utils/tpu_shared_memory
 
-.PHONY: all protos native cpp clean test asan
+.PHONY: all protos native cpp clean test asan java
 
 all: protos native cpp
+
+# ---- Java client (compiled when a JDK is present; skipped otherwise) ------
+JAVA_SRC := $(shell find src/java -name '*.java' 2>/dev/null)
+JAVA_BUILD := build/java/classes
+
+java:
+	@if command -v javac >/dev/null 2>&1; then \
+	  mkdir -p $(JAVA_BUILD) && \
+	  javac -d $(JAVA_BUILD) $(JAVA_SRC) && \
+	  echo "java client compiled to $(JAVA_BUILD)"; \
+	else \
+	  echo "javac not found: skipping java client build"; \
+	fi
 
 # ---- native C++ client library + examples + integration test -------------
 CPP_DIR := src/cpp
 CPP_BUILD := build/cpp
 CLIENT_SRCS := $(CPP_DIR)/client/json.cc $(CPP_DIR)/client/http_client.cc \
                $(CPP_DIR)/client/http_reactor.cc \
-               $(CPP_DIR)/client/shm_utils.cc
+               $(CPP_DIR)/client/shm_utils.cc $(CPP_DIR)/client/transport.cc
 CLIENT_HDRS := $(wildcard $(CPP_DIR)/client/*.h)
 # Each client TU compiled once; every example/test links the objects.
 CLIENT_OBJS := $(CPP_BUILD)/json.o $(CPP_BUILD)/http_client.o \
-               $(CPP_BUILD)/http_reactor.o $(CPP_BUILD)/shm_utils.o
+               $(CPP_BUILD)/http_reactor.o $(CPP_BUILD)/shm_utils.o \
+               $(CPP_BUILD)/transport.o
 
 # gRPC client: protoc-generated KServe protos + the h2/hpack transport.
 PB_CPP := build/proto_cpp
 GRPC_SRCS := $(CPP_DIR)/grpc/hpack.cc $(CPP_DIR)/grpc/h2.cc \
              $(CPP_DIR)/client/grpc_client.cc
 GRPC_HDRS := $(wildcard $(CPP_DIR)/grpc/*.h)
-GRPC_OBJS := $(CPP_BUILD)/hpack.o $(CPP_BUILD)/h2.o \
+GRPC_OBJS := $(CPP_BUILD)/hpack.o $(CPP_BUILD)/h2.o $(CPP_BUILD)/transport.o \
              $(CPP_BUILD)/grpc_client.o $(CPP_BUILD)/inference.pb.o \
              $(CPP_BUILD)/model_config.pb.o $(CPP_BUILD)/shm_utils.o
 GRPC_LINK := -lprotobuf -lrt -lpthread -lz
@@ -129,7 +143,7 @@ $(CPP_BUILD)/cc_client_test: $(CPP_DIR)/tests/cc_client_test.cc $(CLIENT_OBJS)
 	mkdir -p $(CPP_BUILD)
 	$(CXX) $(CXXFLAGS) -o $@ $< $(CLIENT_OBJS) -I$(CPP_DIR)/client -lrt -lpthread -lz
 
-protos: $(PB_OUT)/inference_pb2.py
+protos: $(PB_OUT)/inference_pb2.py $(PB_OUT)/tfserve_pb2.py
 
 $(PB_OUT)/inference_pb2.py: $(PROTO_DIR)/inference.proto $(PROTO_DIR)/model_config.proto
 	mkdir -p $(PB_OUT)
@@ -138,6 +152,10 @@ $(PB_OUT)/inference_pb2.py: $(PROTO_DIR)/inference.proto $(PROTO_DIR)/model_conf
 	# protoc emits absolute imports; rewrite to package-relative.
 	sed -i 's/^import model_config_pb2 as/from . import model_config_pb2 as/' \
 	    $(PB_OUT)/inference_pb2.py
+
+$(PB_OUT)/tfserve_pb2.py: $(PROTO_DIR)/tfserve.proto
+	mkdir -p $(PB_OUT)
+	protoc -I$(PROTO_DIR) --python_out=$(PB_OUT) $(PROTO_DIR)/tfserve.proto
 
 native: $(NATIVE_OUT)/libcshm_tpu.so $(TPUSHM_OUT)/libctpushm.so
 

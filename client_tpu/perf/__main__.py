@@ -46,7 +46,8 @@ def build_parser():
     p.add_argument("-u", "--url", default="localhost:8001")
     p.add_argument("-i", "--protocol", choices=["grpc", "http"], default="grpc")
     p.add_argument("--service-kind",
-                   choices=["triton", "torchserve", "tfserve"],
+                   choices=["triton", "torchserve", "tfserve",
+                            "tfserve_rest"],
                    default="triton",
                    help="target service protocol family (reference "
                         "--service-kind; non-KServe kinds declare the input "
@@ -108,6 +109,11 @@ def build_parser():
                    help="metrics endpoint (default: http://<url>/metrics)")
     p.add_argument("--metrics-interval", type=float, default=1000.0,
                    help="scrape interval in msec")
+    p.add_argument("--collect-local-tpu-metrics", action="store_true",
+                   help="also sample this host's PJRT device gauges (HBM "
+                        "used/total/peak) each scrape — device telemetry "
+                        "when the server under test exposes no TPU metrics "
+                        "(requires colocation with the chip)")
     # SSL/TLS (reference command_line_parser.h SSL option block; names match)
     p.add_argument("--ssl-grpc-use-ssl", action="store_true",
                    help="use an SSL-encrypted gRPC channel")
@@ -151,12 +157,20 @@ def main(argv=None):
     engine = None
     fake = None
     backend_kwargs = {}
-    if args.service_kind in ("torchserve", "tfserve"):
-        kind = (BackendKind.TORCHSERVE if args.service_kind == "torchserve"
-                else BackendKind.TFSERVE)
+    if args.service_kind in ("torchserve", "tfserve", "tfserve_rest"):
+        kind = {
+            "torchserve": BackendKind.TORCHSERVE,
+            "tfserve": BackendKind.TFSERVE,  # gRPC PredictionService
+            "tfserve_rest": BackendKind.TFSERVE_REST,
+        }[args.service_kind]
         # --shape stays tensor-name-keyed: these services declare one input
-        # ("data" / "instances" — the names their backends synthesize)
-        tensor = "data" if args.service_kind == "torchserve" else "instances"
+        # ("data" / "instances" / "input" — the names their backends
+        # synthesize)
+        tensor = {
+            "torchserve": "data",
+            "tfserve": "input",
+            "tfserve_rest": "instances",
+        }[args.service_kind]
         if tensor in shape_overrides:
             backend_kwargs["input_shape"] = shape_overrides[tensor]
         for key in shape_overrides:
@@ -169,12 +183,15 @@ def main(argv=None):
         if args.hermetic:
             from client_tpu.perf.fake_endpoints import (
                 fake_tfserving,
+                fake_tfserving_grpc,
                 fake_torchserve,
             )
 
-            fake = (fake_torchserve([args.model_name])
-                    if args.service_kind == "torchserve"
-                    else fake_tfserving([args.model_name])).start()
+            fake = {
+                "torchserve": fake_torchserve,
+                "tfserve": fake_tfserving_grpc,
+                "tfserve_rest": fake_tfserving,
+            }[args.service_kind]([args.model_name]).start()
             args.url = fake.url
     elif args.hermetic:
         from client_tpu.serve import InferenceEngine
@@ -313,6 +330,9 @@ def main(argv=None):
             rendezvous.barrier()  # start measuring together (MPIBarrierWorld)
 
         metrics = None
+        if args.collect_local_tpu_metrics and not args.collect_metrics:
+            print("warning: --collect-local-tpu-metrics has no effect "
+                  "without --collect-metrics", file=sys.stderr)
         if args.collect_metrics:
             from client_tpu.perf.metrics_manager import MetricsManager
 
@@ -322,7 +342,8 @@ def main(argv=None):
             else:
                 url = args.metrics_url or f"http://{args.url}/metrics"
                 metrics = MetricsManager(
-                    url, interval_s=args.metrics_interval / 1e3
+                    url, interval_s=args.metrics_interval / 1e3,
+                    include_local_devices=args.collect_local_tpu_metrics,
                 ).start()
 
         profiler = InferenceProfiler(

@@ -32,7 +32,11 @@ class BackendKind:
     # non-KServe protocol families (reference client_backend.h:134-139 lists
     # TENSORFLOW_SERVING and TORCHSERVE next to the Triton kinds)
     TORCHSERVE = "torchserve"
+    # TFSERVE speaks gRPC PredictionService (the reference's
+    # tfserve_grpc_client.cc shape); the REST variant stays available for
+    # endpoints with only the HTTP surface enabled
     TFSERVE = "tfserve"
+    TFSERVE_REST = "tfserve_rest"
 
 
 class ClientBackend:
@@ -104,6 +108,8 @@ class ClientBackendFactory:
         if kind == BackendKind.TORCHSERVE:
             return _TorchServeBackend(url, **kwargs)
         if kind == BackendKind.TFSERVE:
+            return _TfServeGrpcBackend(url, **kwargs)
+        if kind == BackendKind.TFSERVE_REST:
             return _TfServeBackend(url, **kwargs)
         raise InferenceServerException(f"unknown backend kind '{kind}'")
 
@@ -509,13 +515,212 @@ class _TorchServeBackend(ClientBackend):
         return grpcclient.InferRequestedOutput
 
 
-class _TfServeBackend(_TorchServeBackend):
-    """TensorFlow-Serving backend over its REST predict API (the reference's
-    tfserve_grpc_client.cc drives PredictionService/Predict; the REST
-    surface carries the same instances->predictions contract and keeps this
-    framework dependency-free)."""
+class _TfServeGrpcBackend(ClientBackend):
+    """TensorFlow-Serving backend over gRPC PredictionService — the
+    reference's service shape (tensorflow_serving/tfserve_grpc_client.cc:
+    PredictRequest with a TensorProto inputs map, ModelService status for
+    liveness).  Wire messages come from the self-contained
+    proto/tfserve.proto mirror (field numbers match upstream tensorflow, so
+    this talks to a real TF-Serving endpoint)."""
 
     kind = BackendKind.TFSERVE
+
+    _DTYPES = {
+        "FP32": ("DT_FLOAT", np.float32),
+        "FP64": ("DT_DOUBLE", np.float64),
+        "INT32": ("DT_INT32", np.int32),
+        "INT64": ("DT_INT64", np.int64),
+        "INT16": ("DT_INT16", np.int16),
+        "INT8": ("DT_INT8", np.int8),
+        "UINT8": ("DT_UINT8", np.uint8),
+        "UINT32": ("DT_UINT32", np.uint32),
+        "UINT64": ("DT_UINT64", np.uint64),
+        "BOOL": ("DT_BOOL", np.bool_),
+    }
+
+    def __init__(self, url, verbose=False, signature_name="serving_default",
+                 input_name="input", output_name="output", input_shape=None,
+                 input_datatype="FP32", **_):
+        import grpc
+
+        from client_tpu._proto import tfserve_pb2 as tfs
+
+        self._tfs = tfs
+        self._signature = signature_name
+        self._input_name = input_name
+        self._output_name = output_name
+        self._shape = input_shape or [-1, 4]
+        self._datatype = input_datatype
+        self._channel = grpc.insecure_channel(url)
+        service = "/tensorflow.serving.PredictionService/"
+        self._predict = self._channel.unary_unary(
+            service + "Predict",
+            request_serializer=tfs.PredictRequest.SerializeToString,
+            response_deserializer=tfs.PredictResponse.FromString,
+        )
+        self._metadata_rpc = self._channel.unary_unary(
+            service + "GetModelMetadata",
+            request_serializer=tfs.GetModelMetadataRequest.SerializeToString,
+            response_deserializer=tfs.GetModelMetadataResponse.FromString,
+        )
+        self._status = self._channel.unary_unary(
+            "/tensorflow.serving.ModelService/GetModelStatus",
+            request_serializer=tfs.GetModelStatusRequest.SerializeToString,
+            response_deserializer=tfs.GetModelStatusResponse.FromString,
+        )
+
+    def server_live(self):
+        return True  # liveness is per-model (GetModelStatus) below
+
+    def model_ready(self, model_name, model_version=""):
+        import grpc
+
+        request = self._tfs.GetModelStatusRequest()
+        request.model_spec.name = model_name
+        try:
+            response = self._status(request)
+        except grpc.RpcError as e:
+            raise InferenceServerException(
+                f"GetModelStatus failed: {e.details()}"
+            ) from e
+        return any(
+            s.state == self._tfs.ModelVersionStatus.AVAILABLE
+            for s in response.model_version_status
+        )
+
+    def model_metadata(self, model_name, model_version=""):
+        import grpc
+
+        request = self._tfs.GetModelMetadataRequest()
+        request.model_spec.name = model_name
+        request.metadata_field.append("signature_def")
+        version = "1"
+        try:
+            response = self._metadata_rpc(request)
+            if response.model_spec.version.value:
+                version = str(response.model_spec.version.value)
+        except grpc.RpcError:
+            pass  # metadata verb optional on some deployments
+        return {
+            "name": model_name,
+            "versions": [version],
+            "platform": "tensorflow_serving",
+            "inputs": [{"name": self._input_name,
+                        "datatype": self._datatype, "shape": self._shape}],
+            "outputs": [{"name": self._output_name, "datatype": "FP32",
+                         "shape": [-1]}],
+        }
+
+    def model_config(self, model_name, model_version=""):
+        return {"name": model_name, "platform": "tensorflow_serving"}
+
+    def _to_tensor(self, tensor, inp):
+        from client_tpu.utils import from_wire_bytes
+
+        datatype = inp.datatype()
+        if datatype == "BYTES":
+            arr = from_wire_bytes(inp.raw_data() or b"", "BYTES", inp.shape())
+            tensor.dtype = self._tfs.DT_STRING
+            for v in arr.flatten():
+                tensor.string_val.append(
+                    v if isinstance(v, bytes) else str(v).encode()
+                )
+        else:
+            entry = self._DTYPES.get(datatype)
+            if entry is None:
+                raise InferenceServerException(
+                    f"tfserve backend cannot map datatype {datatype}"
+                )
+            tensor.dtype = getattr(self._tfs, entry[0])
+            tensor.tensor_content = inp.raw_data() or b""
+        for d in inp.shape():
+            tensor.tensor_shape.dim.add().size = int(d)
+
+    def _from_tensor(self, tensor):
+        shape = [d.size for d in tensor.tensor_shape.dim]
+        for wire, (dt_name, np_dtype) in self._DTYPES.items():
+            if tensor.dtype == getattr(self._tfs, dt_name):
+                if tensor.tensor_content:
+                    arr = np.frombuffer(tensor.tensor_content, dtype=np_dtype)
+                else:
+                    # upstream's repeated-field conventions: int_val also
+                    # carries the narrow integer dtypes
+                    field = {
+                        "DT_FLOAT": tensor.float_val,
+                        "DT_DOUBLE": tensor.double_val,
+                        "DT_INT32": tensor.int_val,
+                        "DT_INT16": tensor.int_val,
+                        "DT_INT8": tensor.int_val,
+                        "DT_UINT8": tensor.int_val,
+                        "DT_INT64": tensor.int64_val,
+                        "DT_UINT32": tensor.uint32_val,
+                        "DT_UINT64": tensor.uint64_val,
+                        "DT_BOOL": tensor.bool_val,
+                    }[dt_name]
+                    arr = np.asarray(list(field), dtype=np_dtype)
+                return arr.reshape(shape) if shape else arr
+        if tensor.dtype == self._tfs.DT_STRING:
+            arr = np.array(list(tensor.string_val), dtype=np.object_)
+            return arr.reshape(shape) if shape else arr
+        raise InferenceServerException(
+            f"tfserve response carried unsupported dtype {tensor.dtype}"
+        )
+
+    def infer(self, model_name, inputs, outputs=None, request_id="",
+              sequence_id=0, sequence_start=False, sequence_end=False,
+              model_version="", priority=0, timeout_us=None):
+        import grpc
+
+        if not inputs:
+            raise InferenceServerException("tfserve infer needs inputs")
+        request = self._tfs.PredictRequest()
+        request.model_spec.name = model_name
+        request.model_spec.signature_name = self._signature
+        if model_version:
+            request.model_spec.version.value = int(model_version)
+        for inp in inputs:
+            self._to_tensor(request.inputs[inp.name()], inp)
+        for out in outputs or []:
+            request.output_filter.append(out.name())
+        timeout_s = (timeout_us / 1e6) if timeout_us else None
+        try:
+            response = self._predict(request, timeout=timeout_s)
+        except grpc.RpcError as e:
+            raise InferenceServerException(
+                f"tfserve Predict failed: {e.details()}",
+                status=str(e.code().name),
+            ) from e
+        arrays = {
+            name: self._from_tensor(tensor)
+            for name, tensor in response.outputs.items()
+        }
+        return _RestResult(arrays, {"model_spec": response.model_spec.name})
+
+    def statistics(self, model_name="", model_version=""):
+        raise NotImplementedError("tensorflow serving exposes no statistics")
+
+    def close(self):
+        self._channel.close()
+
+    @property
+    def infer_input_cls(self):
+        import client_tpu.grpc as grpcclient
+
+        return grpcclient.InferInput
+
+    @property
+    def requested_output_cls(self):
+        import client_tpu.grpc as grpcclient
+
+        return grpcclient.InferRequestedOutput
+
+
+class _TfServeBackend(_TorchServeBackend):
+    """TensorFlow-Serving backend over its REST predict API — for
+    deployments with only the HTTP surface enabled (the gRPC
+    PredictionService backend above is the reference's shape)."""
+
+    kind = BackendKind.TFSERVE_REST
 
     def server_live(self):
         return True  # liveness is per-model below

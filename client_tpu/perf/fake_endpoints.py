@@ -169,3 +169,129 @@ def fake_torchserve(models=("resnet",)):
 
 def fake_tfserving(models=("half_plus_two",)):
     return _FakeService(_TfServingHandler, models)
+
+
+class _FakeTfServingGrpc:
+    """Hermetic gRPC PredictionService (the real protocol surface the
+    TFSERVE backend speaks): Predict sums each row of the first input into
+    an ``output`` DT_FLOAT tensor; GetModelStatus reports AVAILABLE."""
+
+    def __init__(self, models):
+        self.models = set(models)
+        self.request_count = 0
+        self.stats_lock = threading.Lock()
+        self._server = None
+        self._port = 0
+
+    def start(self):
+        from concurrent import futures
+
+        import grpc
+
+        from client_tpu._proto import tfserve_pb2 as tfs
+
+        outer = self
+
+        def Predict(request, context):
+            if request.model_spec.name not in outer.models:
+                context.abort(
+                    grpc.StatusCode.NOT_FOUND,
+                    f"Servable not found: {request.model_spec.name}",
+                )
+            with outer.stats_lock:
+                outer.request_count += 1
+            response = tfs.PredictResponse()
+            response.model_spec.name = request.model_spec.name
+            out = response.outputs["output"]
+            out.dtype = tfs.DT_FLOAT
+            for name, tensor in sorted(request.inputs.items()):
+                shape = [d.size for d in tensor.tensor_shape.dim]
+                if tensor.tensor_content:
+                    arr = np.frombuffer(
+                        tensor.tensor_content, dtype=np.float32
+                    )
+                elif tensor.float_val:
+                    arr = np.asarray(list(tensor.float_val), np.float32)
+                else:
+                    arr = np.zeros(0, np.float32)
+                rows = int(shape[0]) if shape else 1
+                sums = arr.reshape(rows, -1).sum(axis=1) if arr.size else (
+                    np.zeros(rows, np.float32)
+                )
+                out.tensor_content = np.asarray(
+                    sums, np.float32
+                ).tobytes()
+                out.tensor_shape.dim.add().size = rows
+                out.tensor_shape.dim.add().size = 1
+                break  # first input only (half_plus_two-style single-input)
+            return response
+
+        def GetModelStatus(request, context):
+            response = tfs.GetModelStatusResponse()
+            if request.model_spec.name in outer.models:
+                s = response.model_version_status.add()
+                s.version = 1
+                s.state = tfs.ModelVersionStatus.AVAILABLE
+            return response
+
+        def GetModelMetadata(request, context):
+            response = tfs.GetModelMetadataResponse()
+            response.model_spec.name = request.model_spec.name
+            response.model_spec.version.value = 1
+            return response
+
+        handlers = {
+            "Predict": grpc.unary_unary_rpc_method_handler(
+                Predict,
+                request_deserializer=tfs.PredictRequest.FromString,
+                response_serializer=tfs.PredictResponse.SerializeToString,
+            ),
+            "GetModelMetadata": grpc.unary_unary_rpc_method_handler(
+                GetModelMetadata,
+                request_deserializer=(
+                    tfs.GetModelMetadataRequest.FromString
+                ),
+                response_serializer=(
+                    tfs.GetModelMetadataResponse.SerializeToString
+                ),
+            ),
+        }
+        model_handlers = {
+            "GetModelStatus": grpc.unary_unary_rpc_method_handler(
+                GetModelStatus,
+                request_deserializer=tfs.GetModelStatusRequest.FromString,
+                response_serializer=(
+                    tfs.GetModelStatusResponse.SerializeToString
+                ),
+            ),
+        }
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=8))
+        self._server.add_generic_rpc_handlers((
+            grpc.method_handlers_generic_handler(
+                "tensorflow.serving.PredictionService", handlers
+            ),
+            grpc.method_handlers_generic_handler(
+                "tensorflow.serving.ModelService", model_handlers
+            ),
+        ))
+        self._port = self._server.add_insecure_port("127.0.0.1:0")
+        self._server.start()
+        return self
+
+    @property
+    def url(self):
+        return f"127.0.0.1:{self._port}"
+
+    def stop(self):
+        if self._server is not None:
+            self._server.stop(grace=1)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+def fake_tfserving_grpc(models=("half_plus_two",)):
+    return _FakeTfServingGrpc(models)

@@ -36,11 +36,55 @@ def parse_prometheus(text):
     return out
 
 
+def local_device_snapshot():
+    """Device gauges read directly from the local PJRT runtime
+    (jax.local_devices()[i].memory_stats()) — the telemetry source of last
+    resort when the *server* under test exposes no TPU gauges (any
+    third-party KServe server; reference metrics_manager.h:44-91 has the
+    same blind spot for non-Triton servers).  Only meaningful when the perf
+    process is colocated with the chip.  Returns {} off-device."""
+    out = {}
+    try:
+        import jax
+
+        devices = jax.local_devices()
+    except Exception:
+        return out
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        labels = f'{{device="{d.id}",source="local"}}'
+        used = stats.get("bytes_in_use")
+        limit = stats.get("bytes_limit") or stats.get(
+            "bytes_reservable_limit"
+        )
+        peak = stats.get("peak_bytes_in_use")
+        if used is not None:
+            out.setdefault("ctpu_tpu_memory_used_bytes", []).append(
+                (labels, float(used))
+            )
+        if limit is not None:
+            out.setdefault("ctpu_tpu_memory_total_bytes", []).append(
+                (labels, float(limit))
+            )
+        if peak is not None:
+            out.setdefault("ctpu_tpu_memory_peak_bytes", []).append(
+                (labels, float(peak))
+            )
+    return out
+
+
 class MetricsManager:
-    def __init__(self, metrics_url, interval_s=1.0, timeout_s=5.0):
+    def __init__(self, metrics_url, interval_s=1.0, timeout_s=5.0,
+                 include_local_devices=False):
         self.metrics_url = metrics_url
         self.interval_s = interval_s
         self.timeout_s = timeout_s
+        self.include_local_devices = include_local_devices
         self._snapshots = []
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -48,10 +92,33 @@ class MetricsManager:
         self.scrape_errors = 0
 
     def scrape(self):
-        with urllib.request.urlopen(
-            self.metrics_url, timeout=self.timeout_s
-        ) as r:
-            return parse_prometheus(r.read().decode("utf-8", errors="replace"))
+        try:
+            with urllib.request.urlopen(
+                self.metrics_url, timeout=self.timeout_s
+            ) as r:
+                snap = parse_prometheus(
+                    r.read().decode("utf-8", errors="replace")
+                )
+        except Exception:
+            # A server with no /metrics endpoint at all is the PRIMARY
+            # local-devices use case: the local snapshot must still flow.
+            if not self.include_local_devices:
+                raise
+            self.scrape_errors += 1
+            snap = {}
+            local = self._local_snapshot()
+            if not local:
+                raise
+            snap.update(local)
+            return snap
+        if self.include_local_devices:
+            for name, entries in self._local_snapshot().items():
+                # server-reported gauges win; local fills the blind spot
+                if name not in snap:
+                    snap[name] = entries
+        return snap
+
+    _local_snapshot = staticmethod(local_device_snapshot)
 
     def start(self):
         self._stop.clear()
@@ -84,7 +151,9 @@ class MetricsManager:
         return snaps
 
     @staticmethod
-    def summarize(snapshots, gauges=("ctpu_tpu_memory_used_bytes",)):
+    def summarize(snapshots, gauges=("ctpu_tpu_memory_used_bytes",
+                                     "ctpu_tpu_memory_total_bytes",
+                                     "ctpu_tpu_memory_peak_bytes")):
         """Max/avg per gauge over the window's snapshots (the reference
         merges per-GPU utilization/memory the same way)."""
         summary = {}

@@ -93,11 +93,18 @@ ApplyCompression(
 }
 
 // Shared channel cache (reference grpc_client.cc:79-120: one channel per
-// url, shared by every client created with use_cached_channel; the entry's
-// weak_ptr drops the "share count" role onto shared_ptr refcounting — the
-// connection closes when its last client is destroyed).
+// url with an explicit share count).  The map holds STRONG references and
+// the count tracks clients created with use_cached_channel for that url;
+// the last departing client Closes the connection from its own thread.
+// (Async completion lambdas hold only weak refs — see AsyncInfer — so a
+// connection's final strong reference is never dropped on its own reader
+// thread, where ~H2Connection's reader join would be a self-join.)
+struct CachedChannel {
+  std::shared_ptr<h2::H2Connection> conn;
+  int users = 0;
+};
 std::mutex g_channel_mu;
-std::map<std::string, std::weak_ptr<h2::H2Connection>> g_channels;
+std::map<std::string, CachedChannel> g_channels;
 
 std::string
 PercentDecode(const std::string& in)
@@ -268,28 +275,62 @@ InferenceServerGrpcClient::Create(
     std::unique_ptr<InferenceServerGrpcClient>* client, const std::string& url,
     const GrpcSslOptions& ssl_options, bool verbose)
 {
-  (void)ssl_options;
-  (void)verbose;
-  client->reset();
-#ifdef CLIENT_TPU_ENABLE_TLS
-  return Error(
-      "CLIENT_TPU_ENABLE_TLS is defined but no TLS transport is linked in "
-      "this build");
-#else
-  return Error(
-      "TLS support is not compiled in: this toolchain ships no OpenSSL "
-      "headers; rebuild with -DCLIENT_TPU_ENABLE_TLS against an "
-      "OpenSSL-equipped toolchain, or terminate TLS in a local proxy");
-#endif
+  // Probe the TLS transport seam up front so a misconfigured build fails at
+  // Create (the reference fails at channel creation too) instead of on the
+  // first request.  The per-connection transport is made in Connected().
+  TlsConfig probe;
+  probe.root_certificates = ssl_options.root_certificates;
+  probe.private_key = ssl_options.private_key;
+  probe.certificate_chain = ssl_options.certificate_chain;
+  std::unique_ptr<ByteTransport> transport;
+  Error err = MakeTlsTransport(probe, &transport);
+  if (!err.IsOk()) {
+    client->reset();
+    return err;
+  }
+  err = Create(client, url, verbose);
+  if (!err.IsOk()) return err;
+  (*client)->tls_enabled_ = true;
+  (*client)->tls_config_ = probe;
+  return Error::Success();
 }
 
 InferenceServerGrpcClient::~InferenceServerGrpcClient()
 {
   StopStream();
-  // A cached (shared) channel may still serve other clients: dropping our
-  // reference is enough — H2Connection closes itself when the last user's
-  // shared_ptr goes away.
-  if (conn_ != nullptr && !shared_channel_) conn_->Close();
+  if (conn_ == nullptr) {
+    if (shared_channel_) DropCachedUser(nullptr);
+    return;
+  }
+  if (!shared_channel_) {
+    conn_->Close();
+    return;
+  }
+  // Cached channel: decrement the share count; the LAST user closes the
+  // connection (from this client thread — never the reader's).
+  DropCachedUser(conn_);
+}
+
+void
+InferenceServerGrpcClient::DropCachedUser(
+    const std::shared_ptr<h2::H2Connection>& conn)
+{
+  const std::string key = host_ + ":" + std::to_string(port_);
+  std::shared_ptr<h2::H2Connection> to_close;
+  {
+    std::lock_guard<std::mutex> clk(g_channel_mu);
+    auto it = g_channels.find(key);
+    if (it == g_channels.end()) {
+      to_close = conn;  // entry replaced after a reconnect; ours to close
+    } else if (--it->second.users <= 0) {
+      to_close = it->second.conn;
+      g_channels.erase(it);
+      if (conn != nullptr && conn != to_close) conn->Close();
+    } else if (conn != nullptr && conn != it->second.conn) {
+      to_close = conn;  // we held a stale pre-reconnect connection
+    }
+  }
+  if (to_close != nullptr) to_close->Close();
 }
 
 Error
@@ -301,21 +342,20 @@ InferenceServerGrpcClient::Connected()
   // in-flight call or async callback still holds its shared_ptr.
   if (shared_channel_) {
     const std::string key = host_ + ":" + std::to_string(port_);
+    const bool first_attach = (conn_ == nullptr);
     {
       std::lock_guard<std::mutex> clk(g_channel_mu);
       auto it = g_channels.find(key);
-      if (it != g_channels.end()) {
-        auto cached = it->second.lock();
-        if (cached != nullptr && cached->IsOpen()) {
-          conn_ = cached;
-          // a later client's keepalive request applies to the shared
-          // channel (first effective enabler's interval wins)
-          if (keepalive_enabled_)
-            conn_->EnableKeepAlive(
-                keepalive_.keepalive_time_ms,
-                keepalive_.keepalive_timeout_ms);
-          return Error::Success();
-        }
+      if (it != g_channels.end() && it->second.conn->IsOpen()) {
+        if (first_attach) it->second.users++;
+        conn_ = it->second.conn;
+        // a later client's keepalive request applies to the shared
+        // channel (first effective enabler's interval wins)
+        if (keepalive_enabled_)
+          conn_->EnableKeepAlive(
+              keepalive_.keepalive_time_ms,
+              keepalive_.keepalive_timeout_ms);
+        return Error::Success();
       }
     }
     // Connect OUTSIDE the cache lock: a slow/unroutable host must not
@@ -326,21 +366,43 @@ InferenceServerGrpcClient::Connected()
     if (keepalive_enabled_)
       fresh->EnableKeepAlive(
           keepalive_.keepalive_time_ms, keepalive_.keepalive_timeout_ms);
-    std::lock_guard<std::mutex> clk(g_channel_mu);
-    auto it = g_channels.find(key);
-    if (it != g_channels.end()) {
-      auto raced = it->second.lock();
-      if (raced != nullptr && raced->IsOpen()) {
-        conn_ = raced;  // another thread won the connect race; use theirs
-        return Error::Success();
+    std::shared_ptr<h2::H2Connection> stale;
+    {
+      std::lock_guard<std::mutex> clk(g_channel_mu);
+      auto it = g_channels.find(key);
+      if (it != g_channels.end()) {
+        if (it->second.conn->IsOpen()) {
+          if (first_attach) it->second.users++;
+          conn_ = it->second.conn;  // another thread won the connect race
+          fresh->Close();
+          return Error::Success();
+        }
+        stale = it->second.conn;  // dead cached conn: close outside lock
+        it->second.conn = fresh;
+        if (first_attach) it->second.users++;
+      } else {
+        g_channels[key] = CachedChannel{fresh, 1};
       }
+      conn_ = fresh;
     }
-    g_channels[key] = fresh;
-    conn_ = fresh;
+    if (stale != nullptr) stale->Close();
     return Error::Success();
   }
+  // Close the dead connection BEFORE replacing it: Close joins its reader
+  // thread, so no in-flight async callback can end up holding its last
+  // strong reference on that thread (where ~H2Connection's join would be a
+  // self-join).  Its failure callbacks have all fired by now.
+  if (conn_ != nullptr) conn_->Close();
   conn_ = std::make_shared<h2::H2Connection>();
-  Error err = conn_->Connect(host_, port_);
+  Error err;
+  if (tls_enabled_) {
+    std::unique_ptr<ByteTransport> transport;
+    err = MakeTlsTransport(tls_config_, &transport);
+    if (err.IsOk())
+      err = conn_->ConnectWith(std::move(transport), host_, port_);
+  } else {
+    err = conn_->Connect(host_, port_);
+  }
   if (err.IsOk() && keepalive_enabled_)
     conn_->EnableKeepAlive(
         keepalive_.keepalive_time_ms, keepalive_.keepalive_timeout_ms);
@@ -810,15 +872,24 @@ InferenceServerGrpcClient::AsyncInfer(
   // fire the user callback (the reference's AsyncReqRepr + cq thread,
   // grpc_client.cc:1407-1504).  StartStream needs the callback before the
   // stream id exists, so the lambda reads it from a shared holder.  The
-  // lambda pins the connection so a reconnect cannot free it mid-callback.
+  // lambda holds only a WEAK connection reference: it runs on the reader
+  // thread, and an owning capture could make that thread drop the last
+  // strong reference — ~H2Connection would then self-join its own reader.
+  // While the callback runs the connection is alive by construction (the
+  // reader thread is inside it), and every strong holder (client, channel
+  // cache) Closes before releasing.
   auto conn_sp = Conn();
   auto* conn = conn_sp.get();
+  std::weak_ptr<h2::H2Connection> conn_wp = conn_sp;
   auto done = std::make_shared<std::atomic<bool>>(false);
   int32_t sid = 0;
   auto sid_holder = std::make_shared<std::atomic<int32_t>>(0);
   auto user_cb = std::make_shared<OnCompleteFn>(std::move(callback));
   err = conn->StartStream(
-      hdrs, false, &sid, [this, conn_sp, conn, done, sid_holder, user_cb]() {
+      hdrs, false, &sid, [this, conn_wp, done, sid_holder, user_cb]() {
+        auto pinned = conn_wp.lock();
+        if (pinned == nullptr) return;  // connection already torn down
+        auto* conn = pinned.get();
         const int32_t s = sid_holder->load();
         if (s == 0) return;
         auto stream = conn->GetStream(s);
@@ -1003,9 +1074,15 @@ InferenceServerGrpcClient::StartStream(
   stream_timeout_us_ = stream_timeout_us;
   auto conn_sp = Conn();
   auto* conn = conn_sp.get();
+  std::weak_ptr<h2::H2Connection> conn_wp = conn_sp;
   int32_t sid = 0;
-  err = conn->StartStream(hdrs, false, &sid, [this, conn_sp, conn]() {
+  err = conn->StartStream(hdrs, false, &sid, [this, conn_wp]() {
     // Reactor thread: drain complete stream messages, deliver results.
+    // Weak capture: an owning capture could drop the connection's last
+    // strong reference on its own reader thread (see AsyncInfer).
+    auto pinned = conn_wp.lock();
+    if (pinned == nullptr) return;
+    auto* conn = pinned.get();
     std::vector<InferResultPtr> ready;
     OnCompleteFn cb;
     {

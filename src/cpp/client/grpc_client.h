@@ -23,6 +23,7 @@
 
 #include "common.h"
 #include "inference.pb.h"
+#include "transport.h"
 
 namespace ctpu {
 namespace h2 {
@@ -201,12 +202,17 @@ class InferenceServerGrpcClient {
   bool shared_channel_ = false;  // cached-channel clients never Close()
   KeepAliveOptions keepalive_;
   bool keepalive_enabled_ = false;
+  bool tls_enabled_ = false;  // connections ride MakeTlsTransport
+  TlsConfig tls_config_;
   // shared_ptr: a reconnect swaps conn_ while requests may still be blocked
   // inside (or async callbacks may still reference) the old connection —
   // each call path pins its own reference.
   std::shared_ptr<h2::H2Connection> conn_;
   std::mutex conn_mu_;
   std::shared_ptr<h2::H2Connection> Conn();
+  // Cached-channel bookkeeping: decrement this url's share count; the last
+  // user (or a holder of a stale pre-reconnect connection) closes it.
+  void DropCachedUser(const std::shared_ptr<h2::H2Connection>& conn);
 
   // streaming state
   std::mutex stream_mu_;

@@ -1,0 +1,237 @@
+#include "transport.h"
+
+#include <errno.h>
+#include <fcntl.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <mutex>
+
+#ifdef CLIENT_TPU_ENABLE_TLS
+#include <openssl/err.h>
+#include <openssl/ssl.h>
+#endif
+
+namespace ctpu {
+
+namespace {
+
+class TcpTransport : public ByteTransport {
+ public:
+  ~TcpTransport() override { Close(); }
+
+  Error Connect(
+      const std::string& host, int port, int64_t timeout_ms) override
+  {
+    struct addrinfo hints;
+    memset(&hints, 0, sizeof(hints));
+    hints.ai_family = AF_UNSPEC;
+    hints.ai_socktype = SOCK_STREAM;
+    struct addrinfo* res = nullptr;
+    const std::string port_s = std::to_string(port);
+    if (getaddrinfo(host.c_str(), port_s.c_str(), &hints, &res) != 0 ||
+        res == nullptr) {
+      return Error("failed to resolve host '" + host + "'");
+    }
+    int fd = -1;
+    for (struct addrinfo* ai = res; ai != nullptr; ai = ai->ai_next) {
+      fd = socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+      if (fd < 0) continue;
+      const int fl = fcntl(fd, F_GETFL, 0);
+      fcntl(fd, F_SETFL, fl | O_NONBLOCK);
+      int rc = connect(fd, ai->ai_addr, ai->ai_addrlen);
+      if (rc != 0 && errno == EINPROGRESS) {
+        struct pollfd pfd = {fd, POLLOUT, 0};
+        rc = poll(&pfd, 1, static_cast<int>(timeout_ms));
+        int soerr = 0;
+        socklen_t slen = sizeof(soerr);
+        if (rc == 1 &&
+            getsockopt(fd, SOL_SOCKET, SO_ERROR, &soerr, &slen) == 0 &&
+            soerr == 0) {
+          rc = 0;
+        } else {
+          rc = -1;
+        }
+      }
+      if (rc == 0) {
+        fcntl(fd, F_SETFL, fl);  // back to blocking
+        break;
+      }
+      close(fd);
+      fd = -1;
+    }
+    freeaddrinfo(res);
+    if (fd < 0) {
+      return Error("failed to connect to '" + host + ":" + port_s + "'");
+    }
+    const int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    fd_ = fd;
+    return Error::Success();
+  }
+
+  ssize_t Read(void* buf, size_t len) override
+  {
+    while (true) {
+      const ssize_t n = recv(fd_, buf, len, 0);
+      if (n < 0 && errno == EINTR) continue;
+      return n;
+    }
+  }
+
+  ssize_t Write(const void* buf, size_t len) override
+  {
+    while (true) {
+      const ssize_t n = send(fd_, buf, len, MSG_NOSIGNAL);
+      if (n < 0 && errno == EINTR) continue;
+      return n;
+    }
+  }
+
+  void Shutdown() override
+  {
+    if (fd_ >= 0) shutdown(fd_, SHUT_RDWR);
+  }
+
+  void Close() override
+  {
+    if (fd_ >= 0) {
+      close(fd_);
+      fd_ = -1;
+    }
+  }
+
+  int fd() const { return fd_; }
+
+ private:
+  int fd_ = -1;
+};
+
+std::mutex g_factory_mu;
+TlsTransportFactory g_tls_factory;
+
+#ifdef CLIENT_TPU_ENABLE_TLS
+// Built-in OpenSSL transport: a TLS session over a TcpTransport-owned
+// socket.  Compiled only on OpenSSL-equipped toolchains — this image ships
+// no OpenSSL headers, so the path is validated there, not here.
+class OpenSslTransport : public ByteTransport {
+ public:
+  explicit OpenSslTransport(const TlsConfig& config) : config_(config) {}
+
+  ~OpenSslTransport() override
+  {
+    Close();
+    if (ssl_ != nullptr) SSL_free(ssl_);
+    if (ctx_ != nullptr) SSL_CTX_free(ctx_);
+  }
+
+  Error Connect(
+      const std::string& host, int port, int64_t timeout_ms) override
+  {
+    Error err = tcp_.Connect(host, port, timeout_ms);
+    if (!err.IsOk()) return err;
+    SSL_library_init();
+    ctx_ = SSL_CTX_new(TLS_client_method());
+    if (ctx_ == nullptr) return Error("SSL_CTX_new failed");
+    if (!config_.root_certificates.empty()) {
+      if (SSL_CTX_load_verify_locations(
+              ctx_, config_.root_certificates.c_str(), nullptr) != 1)
+        return Error("failed to load root certificates");
+    } else {
+      SSL_CTX_set_default_verify_paths(ctx_);
+    }
+    if (!config_.certificate_chain.empty() &&
+        SSL_CTX_use_certificate_chain_file(
+            ctx_, config_.certificate_chain.c_str()) != 1)
+      return Error("failed to load certificate chain");
+    if (!config_.private_key.empty() &&
+        SSL_CTX_use_PrivateKey_file(
+            ctx_, config_.private_key.c_str(), SSL_FILETYPE_PEM) != 1)
+      return Error("failed to load private key");
+    SSL_CTX_set_verify(
+        ctx_,
+        config_.insecure_skip_verify ? SSL_VERIFY_NONE : SSL_VERIFY_PEER,
+        nullptr);
+    ssl_ = SSL_new(ctx_);
+    if (ssl_ == nullptr) return Error("SSL_new failed");
+    const std::string sni =
+        config_.server_name.empty() ? host : config_.server_name;
+    SSL_set_tlsext_host_name(ssl_, sni.c_str());
+    SSL_set_fd(ssl_, tcp_.fd());
+    if (SSL_connect(ssl_) != 1) {
+      return Error(
+          "TLS handshake with '" + host + "' failed: " +
+          std::string(ERR_error_string(ERR_get_error(), nullptr)));
+    }
+    return Error::Success();
+  }
+
+  ssize_t Read(void* buf, size_t len) override
+  {
+    const int n = SSL_read(ssl_, buf, static_cast<int>(len));
+    if (n > 0) return n;
+    const int e = SSL_get_error(ssl_, n);
+    return e == SSL_ERROR_ZERO_RETURN ? 0 : -1;
+  }
+
+  ssize_t Write(const void* buf, size_t len) override
+  {
+    const int n = SSL_write(ssl_, buf, static_cast<int>(len));
+    return n > 0 ? n : -1;
+  }
+
+  void Shutdown() override { tcp_.Shutdown(); }
+  void Close() override { tcp_.Close(); }
+
+ private:
+  TlsConfig config_;
+  TcpTransport tcp_;
+  SSL_CTX* ctx_ = nullptr;
+  SSL* ssl_ = nullptr;
+};
+#endif  // CLIENT_TPU_ENABLE_TLS
+
+}  // namespace
+
+std::unique_ptr<ByteTransport>
+MakeTcpTransport()
+{
+  return std::make_unique<TcpTransport>();
+}
+
+void
+SetTlsTransportFactory(TlsTransportFactory factory)
+{
+  std::lock_guard<std::mutex> lk(g_factory_mu);
+  g_tls_factory = std::move(factory);
+}
+
+Error
+MakeTlsTransport(const TlsConfig& config, std::unique_ptr<ByteTransport>* out)
+{
+  {
+    std::lock_guard<std::mutex> lk(g_factory_mu);
+    if (g_tls_factory) {
+      *out = g_tls_factory(config);
+      if (*out != nullptr) return Error::Success();
+      return Error("registered TLS transport factory returned null");
+    }
+  }
+#ifdef CLIENT_TPU_ENABLE_TLS
+  *out = std::make_unique<OpenSslTransport>(config);
+  return Error::Success();
+#else
+  return Error(
+      "TLS support is not compiled in: this toolchain ships no OpenSSL "
+      "headers; rebuild with -DCLIENT_TPU_ENABLE_TLS against an "
+      "OpenSSL-equipped toolchain, register a transport with "
+      "SetTlsTransportFactory, or terminate TLS in a local proxy");
+#endif
+}
+
+}  // namespace ctpu

@@ -1,0 +1,64 @@
+// Byte-transport seam for the native clients (reference http_client.h:46-87
+// HttpSslOptions / grpc_client.cc:119-129 SSL credentials).
+//
+// The clients speak to the wire through ByteTransport, so the TLS question
+// becomes "which transport?":
+//  - MakeTcpTransport(): the default plain-TCP transport (always built).
+//  - SetTlsTransportFactory(): the INJECTABLE seam — tests and deployments
+//    register a factory producing a TLS-wrapping transport (e.g. around a
+//    local TLS-terminating proxy, a vendored TLS library, or a corporate
+//    mTLS stack) without rebuilding this library.
+//  - CLIENT_TPU_ENABLE_TLS: an OpenSSL-backed transport compiled in when
+//    the toolchain has OpenSSL headers (this image's does not; the code
+//    path is exercised on OpenSSL-equipped rebuilds).
+// MakeTlsTransport resolves in that order: registered factory, then the
+// built-in OpenSSL transport, then a descriptive error.
+#pragma once
+
+#include <functional>
+#include <memory>
+#include <string>
+
+#include "common.h"
+
+namespace ctpu {
+
+// TLS parameters (superset of GrpcSslOptions/HttpSslOptions fields).
+struct TlsConfig {
+  std::string root_certificates;   // PEM path ("" = system default)
+  std::string private_key;         // PEM path (mTLS)
+  std::string certificate_chain;   // PEM path (mTLS)
+  bool insecure_skip_verify = false;
+  std::string server_name;         // SNI/verification override ("" = host)
+};
+
+class ByteTransport {
+ public:
+  virtual ~ByteTransport() = default;
+  // Establish the connection (TCP connect + any handshake).
+  virtual Error Connect(
+      const std::string& host, int port, int64_t timeout_ms) = 0;
+  // Blocking read; >0 bytes, 0 on orderly EOF, -1 on error (EINTR retried
+  // internally).
+  virtual ssize_t Read(void* buf, size_t len) = 0;
+  // Blocking write of up to len bytes; -1 on error.
+  virtual ssize_t Write(const void* buf, size_t len) = 0;
+  // Wake any blocked Read/Write (both directions); idempotent.
+  virtual void Shutdown() = 0;
+  virtual void Close() = 0;
+};
+
+std::unique_ptr<ByteTransport> MakeTcpTransport();
+
+using TlsTransportFactory =
+    std::function<std::unique_ptr<ByteTransport>(const TlsConfig&)>;
+
+// Register (or clear, with nullptr) the process-wide TLS transport factory.
+void SetTlsTransportFactory(TlsTransportFactory factory);
+
+// TLS transport: registered factory > built-in OpenSSL (when compiled with
+// CLIENT_TPU_ENABLE_TLS) > error explaining how to get one.
+Error MakeTlsTransport(
+    const TlsConfig& config, std::unique_ptr<ByteTransport>* out);
+
+}  // namespace ctpu

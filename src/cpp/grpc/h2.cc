@@ -89,52 +89,17 @@ Error
 H2Connection::Connect(
     const std::string& host, int port, int64_t connect_timeout_ms)
 {
-  struct addrinfo hints;
-  std::memset(&hints, 0, sizeof(hints));
-  hints.ai_family = AF_UNSPEC;
-  hints.ai_socktype = SOCK_STREAM;
-  struct addrinfo* res = nullptr;
-  const std::string port_s = std::to_string(port);
-  if (getaddrinfo(host.c_str(), port_s.c_str(), &hints, &res) != 0 ||
-      res == nullptr) {
-    return Error("failed to resolve host '" + host + "'");
-  }
-  int fd = -1;
-  for (struct addrinfo* ai = res; ai != nullptr; ai = ai->ai_next) {
-    fd = socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
-    if (fd < 0) continue;
-    // non-blocking connect with timeout
-    const int fl = fcntl(fd, F_GETFL, 0);
-    fcntl(fd, F_SETFL, fl | O_NONBLOCK);
-    int rc = connect(fd, ai->ai_addr, ai->ai_addrlen);
-    if (rc != 0 && errno == EINPROGRESS) {
-      struct pollfd pfd = {fd, POLLOUT, 0};
-      rc = poll(&pfd, 1, static_cast<int>(connect_timeout_ms));
-      int soerr = 0;
-      socklen_t slen = sizeof(soerr);
-      if (rc == 1 &&
-          getsockopt(fd, SOL_SOCKET, SO_ERROR, &soerr, &slen) == 0 &&
-          soerr == 0) {
-        rc = 0;
-      } else {
-        rc = -1;
-      }
-    }
-    if (rc == 0) {
-      fcntl(fd, F_SETFL, fl);  // back to blocking
-      break;
-    }
-    close(fd);
-    fd = -1;
-  }
-  freeaddrinfo(res);
-  if (fd < 0) {
-    return Error(
-        "failed to connect to '" + host + ":" + port_s + "'");
-  }
-  const int one = 1;
-  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-  fd_ = fd;
+  return ConnectWith(MakeTcpTransport(), host, port, connect_timeout_ms);
+}
+
+Error
+H2Connection::ConnectWith(
+    std::unique_ptr<ByteTransport> transport, const std::string& host,
+    int port, int64_t connect_timeout_ms)
+{
+  Error cerr = transport->Connect(host, port, connect_timeout_ms);
+  if (!cerr.IsOk()) return cerr;
+  transport_ = std::move(transport);
 
   // Client preface: magic + SETTINGS (push off, big stream windows), then a
   // connection-level WINDOW_UPDATE so large responses never stall.
@@ -157,8 +122,8 @@ H2Connection::Connect(
   Error err =
       WriteAll(reinterpret_cast<const uint8_t*>(buf.data()), buf.size());
   if (!err.IsOk()) {
-    close(fd_);
-    fd_ = -1;
+    transport_->Close();
+    transport_.reset();
     return err;
   }
   open_ = true;
@@ -229,24 +194,24 @@ H2Connection::Close()
 {
   {
     std::lock_guard<std::mutex> lk(mu_);
-    if (!open_ && fd_ < 0) return;
+    if (!open_ && transport_ == nullptr) return;
     open_ = false;
     keepalive_stop_ = true;
   }
   cv_.notify_all();
   if (keepalive_.joinable()) keepalive_.join();
-  if (fd_ >= 0) {
+  if (transport_ != nullptr) {
     // GOAWAY then hard shutdown; the reader thread unblocks on EOF/EPIPE.
     std::string payload;
     Put32(&payload, 0);  // last stream id
     Put32(&payload, 0);  // NO_ERROR
     WriteFrame(kGoaway, 0, 0, payload);
-    shutdown(fd_, SHUT_RDWR);
+    transport_->Shutdown();
   }
   if (reader_.joinable()) reader_.join();
-  if (fd_ >= 0) {
-    close(fd_);
-    fd_ = -1;
+  if (transport_ != nullptr) {
+    transport_->Close();
+    transport_.reset();
   }
 }
 
@@ -255,9 +220,9 @@ H2Connection::WriteAll(const uint8_t* buf, size_t len)
 {
   size_t off = 0;
   while (off < len) {
-    const ssize_t n = send(fd_, buf + off, len - off, MSG_NOSIGNAL);
+    if (transport_ == nullptr) return Error("h2 connection closed");
+    const ssize_t n = transport_->Write(buf + off, len - off);
     if (n <= 0) {
-      if (n < 0 && (errno == EINTR)) continue;
       return Error("h2 connection write failed: " +
                    std::string(std::strerror(errno)));
     }
@@ -525,9 +490,8 @@ H2Connection::ReaderLoop()
     // frame header
     size_t got = 0;
     while (got < 9) {
-      const ssize_t n = recv(fd_, hdr + got, 9 - got, 0);
+      const ssize_t n = transport_->Read(hdr + got, 9 - got);
       if (n <= 0) {
-        if (n < 0 && errno == EINTR) continue;
         FailConnection(
             got == 0 && n == 0 ? "h2 connection closed by peer"
                                : "h2 connection read failed");
@@ -547,9 +511,8 @@ H2Connection::ReaderLoop()
     buf.resize(len);
     size_t off = 0;
     while (off < len) {
-      const ssize_t n = recv(fd_, &buf[off], len - off, 0);
+      const ssize_t n = transport_->Read(&buf[off], len - off);
       if (n <= 0) {
-        if (n < 0 && errno == EINTR) continue;
         FailConnection("h2 connection read failed mid-frame");
         return;
       }

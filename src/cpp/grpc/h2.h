@@ -22,6 +22,7 @@
 #include <vector>
 
 #include "../client/common.h"
+#include "../client/transport.h"
 #include "hpack.h"
 
 namespace ctpu {
@@ -54,6 +55,11 @@ class H2Connection {
   // TCP connect + h2c preface/SETTINGS exchange; spawns the reader thread.
   Error Connect(
       const std::string& host, int port, int64_t connect_timeout_ms = 10000);
+  // Same, over a caller-supplied byte transport (the TLS seam —
+  // src/cpp/client/transport.h): the transport's Connect is called here.
+  Error ConnectWith(
+      std::unique_ptr<ByteTransport> transport, const std::string& host,
+      int port, int64_t connect_timeout_ms = 10000);
   void Close();
   bool IsOpen();
 
@@ -102,7 +108,7 @@ class H2Connection {
   void FailConnection(const std::string& msg);
   std::shared_ptr<Stream> StreamLocked(int32_t sid);
 
-  int fd_ = -1;
+  std::unique_ptr<ByteTransport> transport_;
   std::thread reader_;
   std::thread keepalive_;
   std::mutex mu_;                  // stream table + windows + hpack_rx_

@@ -432,6 +432,34 @@ TestCompression(tc::InferenceServerGrpcClient* client)
 }
 
 static void
+TestTlsTransportSeam(const std::string& url)
+{
+  // Without a TLS transport (no OpenSSL in this toolchain, no factory
+  // registered), the SSL Create must fail with the descriptive diagnostic.
+  tc::GrpcSslOptions ssl;
+  std::unique_ptr<tc::InferenceServerGrpcClient> tls_client;
+  tc::Error e = tc::InferenceServerGrpcClient::Create(&tls_client, url, ssl);
+  CHECK(!e.IsOk());
+  CHECK(e.Message().find("TLS") != std::string::npos);
+
+  // Injectable seam: register a transport factory (here a pass-through TCP
+  // transport standing in for a TLS library / TLS-terminating proxy hop)
+  // and the SAME Create + request path works end to end — proving the ssl
+  // option plumbing and the per-connection transport wiring, which is
+  // everything an OpenSSL-equipped rebuild adds code to.
+  tc::SetTlsTransportFactory(
+      [](const tc::TlsConfig&) { return tc::MakeTcpTransport(); });
+  e = tc::InferenceServerGrpcClient::Create(&tls_client, url, ssl);
+  CHECK_OK(e);
+  if (e.IsOk()) {
+    tc::InferResult* result = nullptr;
+    CHECK_OK(DoInfer(tls_client.get(), "simple", &result));
+    delete result;
+  }
+  tc::SetTlsTransportFactory(nullptr);
+}
+
+static void
 TestKeepAliveAndChannelCache(const std::string& url)
 {
   // keepalive: pings every 200ms must not disturb request traffic
@@ -486,6 +514,7 @@ main(int argc, char** argv)
   TestInferMulti(client.get());
   TestCompression(client.get());
   TestKeepAliveAndChannelCache(url);
+  TestTlsTransportSeam(url);
 
   std::cout << g_checks << " checks, " << g_failures << " failures"
             << std::endl;

@@ -143,11 +143,89 @@ public class InferenceServerClient implements AutoCloseable {
     return infer(modelName, "", inputs, outputs, "");
   }
 
+  /**
+   * Asynchronous inference (parity with the reference's HttpAsyncClient
+   * transport, reference InferenceServerClient.java:59-221): the request
+   * rides {@code HttpClient.sendAsync} on the client's executor, so many
+   * requests can be in flight with no thread-per-request.  The future
+   * completes with the parsed result or exceptionally with an
+   * {@link InferenceException}.
+   */
+  public java.util.concurrent.CompletableFuture<InferResult> asyncInfer(
+      String modelName, List<InferInput> inputs,
+      List<InferRequestedOutput> outputs) {
+    return asyncInfer(modelName, "", inputs, outputs, "");
+  }
+
+  public java.util.concurrent.CompletableFuture<InferResult> asyncInfer(
+      String modelName, String modelVersion, List<InferInput> inputs,
+      List<InferRequestedOutput> outputs, String requestId) {
+    EncodedRequest encoded;
+    try {
+      encoded = encodeInfer(requestId, inputs, outputs);
+    } catch (RuntimeException e) {
+      return java.util.concurrent.CompletableFuture.failedFuture(
+          new InferenceException("failed to encode request: " + e, e));
+    }
+    String path = "/v2/models/" + enc(modelName)
+        + (modelVersion.isEmpty() ? "" : "/versions/" + modelVersion)
+        + "/infer";
+    HttpRequest.Builder builder =
+        HttpRequest.newBuilder(URI.create(baseUrl + path))
+            .timeout(requestTimeout)
+            .POST(HttpRequest.BodyPublishers.ofByteArray(encoded.body))
+            .header("Content-Type", "application/octet-stream")
+            .header(
+                "Inference-Header-Content-Length",
+                Integer.toString(encoded.headerLength));
+    return http.sendAsync(
+            builder.build(), HttpResponse.BodyHandlers.ofByteArray())
+        .thenApply(r -> {
+          try {
+            check(r);
+            int respHeaderLen = 0;
+            String lengthHeader = r.headers()
+                .firstValue("inference-header-content-length").orElse("");
+            if (!lengthHeader.isEmpty()) {
+              respHeaderLen = Integer.parseInt(lengthHeader);
+            }
+            return new InferResult(r.body(), respHeaderLen);
+          } catch (InferenceException e) {
+            throw new java.util.concurrent.CompletionException(e);
+          }
+        });
+  }
+
   public InferResult infer(
       String modelName, String modelVersion, List<InferInput> inputs,
       List<InferRequestedOutput> outputs, String requestId)
       throws InferenceException {
-    // JSON header
+    // one request/response pipeline: the sync call is the async call joined
+    try {
+      return asyncInfer(modelName, modelVersion, inputs, outputs, requestId)
+          .join();
+    } catch (java.util.concurrent.CompletionException e) {
+      if (e.getCause() instanceof InferenceException) {
+        throw (InferenceException) e.getCause();
+      }
+      throw new InferenceException("infer failed: " + e.getCause(), e);
+    }
+  }
+
+  /** Binary-extension request body: JSON header + raw tensors appended. */
+  private static final class EncodedRequest {
+    final byte[] body;
+    final int headerLength;
+
+    EncodedRequest(byte[] body, int headerLength) {
+      this.body = body;
+      this.headerLength = headerLength;
+    }
+  }
+
+  private static EncodedRequest encodeInfer(
+      String requestId, List<InferInput> inputs,
+      List<InferRequestedOutput> outputs) {
     Map<String, Object> header = new LinkedHashMap<>();
     if (!requestId.isEmpty()) header.put("id", requestId);
     List<Object> ins = new ArrayList<>();
@@ -189,24 +267,7 @@ public class InferenceServerClient implements AutoCloseable {
       System.arraycopy(b, 0, body, cursor, b.length);
       cursor += b.length;
     }
-
-    String path = "/v2/models/" + enc(modelName)
-        + (modelVersion.isEmpty() ? "" : "/versions/" + modelVersion)
-        + "/infer";
-    Map<String, String> headers = new LinkedHashMap<>();
-    headers.put("Content-Type", "application/octet-stream");
-    headers.put(
-        "Inference-Header-Content-Length",
-        Integer.toString(headerBytes.length));
-    HttpResponse<byte[]> r = post(path, body, headers);
-    check(r);
-    int respHeaderLen = 0;
-    String lengthHeader =
-        r.headers().firstValue("inference-header-content-length").orElse("");
-    if (!lengthHeader.isEmpty()) {
-      respHeaderLen = Integer.parseInt(lengthHeader);
-    }
-    return new InferResult(r.body(), respHeaderLen);
+    return new EncodedRequest(body, headerBytes.length);
   }
 
   // ---- plumbing -----------------------------------------------------------

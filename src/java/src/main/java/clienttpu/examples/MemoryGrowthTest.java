@@ -1,0 +1,78 @@
+// Long-running inference loop watching for client-side memory growth —
+// parity with reference src/java/.../examples/MemoryGrowthTest.java: run N
+// iterations against a live server, sample heap usage before/after (with
+// forced GC), and fail when the retained heap grows beyond a tolerance.
+//   java clienttpu.examples.MemoryGrowthTest <host:port> [iterations]
+package clienttpu.examples;
+
+import clienttpu.DataType;
+import clienttpu.InferInput;
+import clienttpu.InferRequestedOutput;
+import clienttpu.InferResult;
+import clienttpu.InferenceServerClient;
+import java.util.List;
+
+public final class MemoryGrowthTest {
+  private MemoryGrowthTest() {}
+
+  private static long retainedHeap() {
+    Runtime rt = Runtime.getRuntime();
+    for (int i = 0; i < 3; i++) {
+      rt.gc();
+      try {
+        Thread.sleep(50);
+      } catch (InterruptedException e) {
+        Thread.currentThread().interrupt();
+      }
+    }
+    return rt.totalMemory() - rt.freeMemory();
+  }
+
+  public static void main(String[] args) throws Exception {
+    String url = args.length > 0 ? args[0] : "localhost:8000";
+    int iterations = args.length > 1 ? Integer.parseInt(args[1]) : 500;
+
+    try (InferenceServerClient client = new InferenceServerClient(url)) {
+      int[] data0 = new int[16];
+      int[] data1 = new int[16];
+      for (int i = 0; i < 16; i++) {
+        data0[i] = i;
+        data1[i] = 1;
+      }
+      InferInput in0 = new InferInput("INPUT0", new long[] {1, 16}, DataType.INT32);
+      InferInput in1 = new InferInput("INPUT1", new long[] {1, 16}, DataType.INT32);
+      in0.setData(data0);
+      in1.setData(data1);
+      List<InferInput> inputs = List.of(in0, in1);
+      List<InferRequestedOutput> outputs =
+          List.of(new InferRequestedOutput("OUTPUT0"));
+
+      // warm the transport + JIT before the baseline sample
+      for (int i = 0; i < 20; i++) {
+        client.infer("simple", inputs, outputs);
+      }
+      long before = retainedHeap();
+      for (int i = 0; i < iterations; i++) {
+        InferResult result = client.infer("simple", inputs, outputs);
+        int[] sum = result.getOutputAsInt("OUTPUT0");
+        if (sum[3] != data0[3] + data1[3]) {
+          System.err.println("FAIL: wrong result at iteration " + i);
+          System.exit(1);
+        }
+      }
+      long after = retainedHeap();
+      long growth = after - before;
+      System.out.println(
+          "iterations=" + iterations + " heap_before=" + before
+          + " heap_after=" + after + " growth_bytes=" + growth);
+      // tolerance: 8MB of retained growth over the run indicates a leak in
+      // the client (each request is ~1KB; transient garbage is collected
+      // by retainedHeap()'s forced GCs)
+      if (growth > 8L * 1024 * 1024) {
+        System.err.println("FAIL: client memory growth " + growth + " bytes");
+        System.exit(1);
+      }
+      System.out.println("PASS: MemoryGrowthTest");
+    }
+  }
+}

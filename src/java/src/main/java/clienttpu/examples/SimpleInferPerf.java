@@ -1,0 +1,84 @@
+// Async throughput/latency smoke for the Java client — parity with
+// reference src/java/.../examples/SimpleInferPerf.java: keep `concurrency`
+// requests in flight via asyncInfer for a fixed request count, then report
+// infer/sec and latency percentiles.
+//   java clienttpu.examples.SimpleInferPerf <host:port> [requests] [concurrency]
+package clienttpu.examples;
+
+import clienttpu.DataType;
+import clienttpu.InferInput;
+import clienttpu.InferRequestedOutput;
+import clienttpu.InferenceServerClient;
+import java.util.ArrayList;
+import java.util.Collections;
+import java.util.List;
+import java.util.concurrent.CompletableFuture;
+import java.util.concurrent.Semaphore;
+import java.util.concurrent.atomic.AtomicInteger;
+
+public final class SimpleInferPerf {
+  private SimpleInferPerf() {}
+
+  public static void main(String[] args) throws Exception {
+    String url = args.length > 0 ? args[0] : "localhost:8000";
+    int requests = args.length > 1 ? Integer.parseInt(args[1]) : 200;
+    int concurrency = args.length > 2 ? Integer.parseInt(args[2]) : 8;
+
+    try (InferenceServerClient client = new InferenceServerClient(url)) {
+      int[] data0 = new int[16];
+      int[] data1 = new int[16];
+      for (int i = 0; i < 16; i++) {
+        data0[i] = i;
+        data1[i] = 2 * i;
+      }
+      InferInput in0 = new InferInput("INPUT0", new long[] {1, 16}, DataType.INT32);
+      InferInput in1 = new InferInput("INPUT1", new long[] {1, 16}, DataType.INT32);
+      in0.setData(data0);
+      in1.setData(data1);
+      List<InferInput> inputs = List.of(in0, in1);
+      List<InferRequestedOutput> outputs =
+          List.of(new InferRequestedOutput("OUTPUT0"));
+
+      // warm up
+      for (int i = 0; i < 10; i++) {
+        client.infer("simple", inputs, outputs);
+      }
+
+      Semaphore slots = new Semaphore(concurrency);
+      AtomicInteger failures = new AtomicInteger();
+      List<Long> latenciesNs = Collections.synchronizedList(new ArrayList<>());
+      List<CompletableFuture<?>> pending = new ArrayList<>();
+      long start = System.nanoTime();
+      for (int i = 0; i < requests; i++) {
+        slots.acquire();
+        long t0 = System.nanoTime();
+        CompletableFuture<?> f =
+            client.asyncInfer("simple", inputs, outputs)
+                .whenComplete((result, error) -> {
+                  latenciesNs.add(System.nanoTime() - t0);
+                  if (error != null) failures.incrementAndGet();
+                  slots.release();
+                });
+        pending.add(f);
+      }
+      CompletableFuture.allOf(pending.toArray(new CompletableFuture[0]))
+          .exceptionally(e -> null).join();
+      double elapsedS = (System.nanoTime() - start) / 1e9;
+
+      List<Long> sorted = new ArrayList<>(latenciesNs);
+      Collections.sort(sorted);
+      long p50 = sorted.get(sorted.size() / 2);
+      long p99 = sorted.get(Math.min(sorted.size() - 1, sorted.size() * 99 / 100));
+      System.out.printf(
+          "requests=%d concurrency=%d throughput=%.1f infer/sec "
+              + "p50=%.2fms p99=%.2fms failures=%d%n",
+          requests, concurrency, requests / elapsedS, p50 / 1e6, p99 / 1e6,
+          failures.get());
+      if (failures.get() > 0) {
+        System.err.println("FAIL: " + failures.get() + " request failures");
+        System.exit(1);
+      }
+      System.out.println("PASS: SimpleInferPerf");
+    }
+  }
+}

@@ -95,10 +95,70 @@ class TestTorchServeBackend:
             mgr.cleanup()
 
 
+class TestTfServeGrpcBackend:
+    """The TFSERVE kind speaks gRPC PredictionService (reference
+    tfserve_grpc_client.cc) against the hermetic fake service."""
+
+    @pytest.fixture()
+    def tfs_grpc(self):
+        from client_tpu.perf.fake_endpoints import fake_tfserving_grpc
+
+        with fake_tfserving_grpc(["half_plus_two"]) as s:
+            yield s
+
+    def _backend(self, service):
+        return ClientBackendFactory.create(
+            BackendKind.TFSERVE, url=service.url, input_shape=[1, 4]
+        )
+
+    def test_status_and_metadata(self, tfs_grpc):
+        be = self._backend(tfs_grpc)
+        assert be.model_ready("half_plus_two")
+        assert not be.model_ready("nope")
+        meta = be.model_metadata("half_plus_two")
+        assert meta["platform"] == "tensorflow_serving"
+        assert meta["versions"] == ["1"]
+        be.close()
+
+    def test_predict_roundtrip(self, tfs_grpc):
+        be = self._backend(tfs_grpc)
+        arr = np.array([[1.0, 2.0, 3.0, 4.0]], dtype=np.float32)
+        inp = be.infer_input_cls("input", [1, 4], "FP32")
+        inp.set_data_from_numpy(arr)
+        result = be.infer("half_plus_two", [inp])
+        np.testing.assert_allclose(
+            result.as_numpy("output"), [[10.0]], rtol=1e-6
+        )
+        assert tfs_grpc.request_count == 1
+        be.close()
+
+    def test_unknown_model_is_error(self, tfs_grpc):
+        be = self._backend(tfs_grpc)
+        inp = be.infer_input_cls("input", [1, 4], "FP32")
+        inp.set_data_from_numpy(np.zeros((1, 4), np.float32))
+        with pytest.raises(InferenceServerException, match="Servable"):
+            be.infer("nope", [inp])
+        be.close()
+
+
+def test_perf_cli_tfserve_grpc_hermetic_sweep():
+    """`--service-kind tfserve --hermetic` drives the gRPC PredictionService
+    fake end-to-end through the full harness."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "client_tpu.perf", "-m", "half_plus_two",
+         "--service-kind", "tfserve", "--hermetic",
+         "--shape", "input:1,8", "--concurrency-range", "1:1:1",
+         "--measurement-interval", "400", "--max-trials", "4"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "Best: concurrency=" in proc.stdout
+
+
 class TestTfServeBackend:
     def _backend(self, tfserving):
         return ClientBackendFactory.create(
-            BackendKind.TFSERVE, url=tfserving.url, input_shape=[1, 4]
+            BackendKind.TFSERVE_REST, url=tfserving.url, input_shape=[1, 4]
         )
 
     def test_metadata(self, tfserving):

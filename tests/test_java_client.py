@@ -1,0 +1,73 @@
+"""Java client acceptance: compile src/java and run its mains against a
+live in-process server (reference src/java/library + examples —
+SimpleInferClient, MemoryGrowthTest, SimpleInferPerf).  Skipped when no JDK
+is on PATH (this image ships none); on a JDK-equipped machine the suite
+compiles and exercises the sync + async transports end to end.
+"""
+
+import os
+import shutil
+import subprocess
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_CLASSES = os.path.join(_REPO, "build", "java", "classes")
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("javac") is None or shutil.which("java") is None,
+    reason="no JDK on PATH",
+)
+
+
+@pytest.fixture(scope="module")
+def java_classes():
+    proc = subprocess.run(
+        ["make", "java"], cwd=_REPO, capture_output=True, text=True,
+        timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert os.path.isdir(_CLASSES)
+    return _CLASSES
+
+
+@pytest.fixture(scope="module")
+def server():
+    from client_tpu.serve import Server
+
+    with Server(http_port=0) as srv:
+        yield srv
+
+
+def _run_main(classes, main, *args, timeout=120):
+    return subprocess.run(
+        ["java", "-cp", classes, main, *args],
+        capture_output=True, text=True, timeout=timeout,
+    )
+
+
+def test_simple_infer(java_classes, server):
+    proc = _run_main(
+        java_classes, "clienttpu.SimpleInferClient", server.http_address
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "PASS: java simple infer" in proc.stdout
+
+
+def test_memory_growth(java_classes, server):
+    proc = _run_main(
+        java_classes, "clienttpu.examples.MemoryGrowthTest",
+        server.http_address, "200",
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "PASS: MemoryGrowthTest" in proc.stdout
+
+
+def test_async_infer_perf(java_classes, server):
+    proc = _run_main(
+        java_classes, "clienttpu.examples.SimpleInferPerf",
+        server.http_address, "100", "8",
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "PASS: SimpleInferPerf" in proc.stdout
+    assert "infer/sec" in proc.stdout

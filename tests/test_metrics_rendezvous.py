@@ -79,6 +79,38 @@ class TestMetricsEndpoint:
         agg = MetricsManager.summarize(snaps)
         assert agg["ctpu_tpu_memory_used_bytes"] == {"avg": 200.0, "max": 300.0}
 
+    def test_local_device_fallback_fills_blind_spot(self, server):
+        """A server exposing no TPU gauges (any third-party KServe server)
+        still yields device telemetry when the perf process is colocated
+        with the chip: scrape() merges the local PJRT snapshot for gauges
+        the server response lacks — server-reported values win."""
+        mm = MetricsManager(
+            f"http://{server.http_address}/metrics",
+            include_local_devices=True,
+        )
+        mm._local_snapshot = lambda: {
+            "ctpu_tpu_memory_used_bytes": [('{device="0",source="local"}', 7.0)],
+            "ctpu_inference_request_success": [('{source="local"}', -1.0)],
+        }
+        snap = mm.scrape()
+        # blind-spot gauge filled from the local runtime ...
+        assert snap["ctpu_tpu_memory_used_bytes"] == [
+            ('{device="0",source="local"}', 7.0)
+        ]
+        # ... but a gauge the server DID report is untouched
+        assert all(v >= 0 for _, v in snap["ctpu_inference_request_success"])
+
+    def test_local_device_snapshot_shape(self):
+        """local_device_snapshot returns prometheus-shaped entries (or {} on
+        runtimes exposing no memory_stats, e.g. the CPU test platform)."""
+        from client_tpu.perf.metrics_manager import local_device_snapshot
+
+        snap = local_device_snapshot()
+        for name, entries in snap.items():
+            assert name.startswith("ctpu_tpu_memory_")
+            for labels, value in entries:
+                assert labels.startswith("{") and value >= 0
+
 
 class TestRendezvous:
     def test_all_gather_and_consensus(self):
