@@ -78,7 +78,21 @@ GRPC_EXAMPLES := simple_grpc_infer_client \
 
 grpc_cpp: $(addprefix $(CPP_BUILD)/,$(GRPC_EXAMPLES)) \
           $(CPP_BUILD)/simple_grpc_tpushm_client \
-          $(CPP_BUILD)/cc_grpc_client_test $(CPP_BUILD)/hpack_unit_test
+          $(CPP_BUILD)/cc_grpc_client_test $(CPP_BUILD)/hpack_unit_test \
+          $(CPP_BUILD)/client_timeout_test $(CPP_BUILD)/memory_leak_test
+
+# Dual-protocol test binaries link both client stacks (shared objects
+# appear once: GRPC_OBJS already carries shm_utils.o and transport.o).
+MIXED_OBJS := $(GRPC_OBJS) $(CPP_BUILD)/json.o $(CPP_BUILD)/http_client.o \
+              $(CPP_BUILD)/http_reactor.o
+
+$(CPP_BUILD)/client_timeout_test: $(CPP_DIR)/tests/client_timeout_test.cc $(GRPC_OBJS) $(CLIENT_OBJS)
+	mkdir -p $(CPP_BUILD)
+	$(CXX) $(CXXFLAGS) -o $@ $< $(MIXED_OBJS) $(GRPC_INC) $(GRPC_LINK)
+
+$(CPP_BUILD)/memory_leak_test: $(CPP_DIR)/tests/memory_leak_test.cc $(GRPC_OBJS) $(CLIENT_OBJS)
+	mkdir -p $(CPP_BUILD)
+	$(CXX) $(CXXFLAGS) -o $@ $< $(MIXED_OBJS) $(GRPC_INC) $(GRPC_LINK)
 
 $(PB_CPP)/inference.pb.cc: $(PROTO_DIR)/inference.proto $(PROTO_DIR)/model_config.proto
 	mkdir -p $(PB_CPP)

@@ -102,15 +102,15 @@ class MetricsManager:
         except Exception:
             # A server with no /metrics endpoint at all is the PRIMARY
             # local-devices use case: the local snapshot must still flow.
+            # (On re-raise the polling loop counts the scrape error; the
+            # fallback success path counts it here — exactly once either way.)
             if not self.include_local_devices:
                 raise
-            self.scrape_errors += 1
-            snap = {}
             local = self._local_snapshot()
             if not local:
                 raise
-            snap.update(local)
-            return snap
+            self.scrape_errors += 1
+            return dict(local)
         if self.include_local_devices:
             for name, entries in self._local_snapshot().items():
                 # server-reported gauges win; local fills the blind spot

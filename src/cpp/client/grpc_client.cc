@@ -299,8 +299,7 @@ InferenceServerGrpcClient::~InferenceServerGrpcClient()
 {
   StopStream();
   if (conn_ == nullptr) {
-    if (shared_channel_) DropCachedUser(nullptr);
-    return;
+    return;  // never connected: nothing attached, no share count held
   }
   if (!shared_channel_) {
     conn_->Close();
@@ -308,7 +307,7 @@ InferenceServerGrpcClient::~InferenceServerGrpcClient()
   }
   // Cached channel: decrement the share count; the LAST user closes the
   // connection (from this client thread — never the reader's).
-  DropCachedUser(conn_);
+  if (attached_) DropCachedUser(conn_);
 }
 
 void
@@ -347,7 +346,10 @@ InferenceServerGrpcClient::Connected()
       std::lock_guard<std::mutex> clk(g_channel_mu);
       auto it = g_channels.find(key);
       if (it != g_channels.end() && it->second.conn->IsOpen()) {
-        if (first_attach) it->second.users++;
+        if (first_attach) {
+          it->second.users++;
+          attached_ = true;
+        }
         conn_ = it->second.conn;
         // a later client's keepalive request applies to the shared
         // channel (first effective enabler's interval wins)
@@ -372,16 +374,23 @@ InferenceServerGrpcClient::Connected()
       auto it = g_channels.find(key);
       if (it != g_channels.end()) {
         if (it->second.conn->IsOpen()) {
-          if (first_attach) it->second.users++;
+          if (first_attach) {
+            it->second.users++;
+            attached_ = true;
+          }
           conn_ = it->second.conn;  // another thread won the connect race
           fresh->Close();
           return Error::Success();
         }
         stale = it->second.conn;  // dead cached conn: close outside lock
         it->second.conn = fresh;
-        if (first_attach) it->second.users++;
+        if (first_attach) {
+          it->second.users++;
+          attached_ = true;
+        }
       } else {
         g_channels[key] = CachedChannel{fresh, 1};
+        attached_ = true;
       }
       conn_ = fresh;
     }

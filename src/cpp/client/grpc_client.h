@@ -200,6 +200,7 @@ class InferenceServerGrpcClient {
   int port_;
   bool verbose_;
   bool shared_channel_ = false;  // cached-channel clients never Close()
+  bool attached_ = false;  // holds a share count in the channel cache
   KeepAliveOptions keepalive_;
   bool keepalive_enabled_ = false;
   bool tls_enabled_ = false;  // connections ride MakeTlsTransport

@@ -159,8 +159,34 @@ Error
 InferenceServerHttpClient::Request(
     HttpResponse* response, const std::string& method, const std::string& uri,
     const std::string& body, const std::map<std::string, std::string>& headers,
-    RequestTimers* timers)
+    RequestTimers* timers, uint64_t timeout_us)
 {
+  // Whole-exchange deadline (the reference's CURLOPT_TIMEOUT_MS shape):
+  // every socket op gets only the REMAINING budget, so a server dripping
+  // bytes cannot stretch one request past client_timeout_us.
+  const auto deadline = std::chrono::steady_clock::now() +
+                        std::chrono::microseconds(timeout_us);
+  const auto set_socket_timeout = [&]() -> bool {
+    struct timeval tv;
+    if (timeout_us == 0) {
+      tv.tv_sec = 0;
+      tv.tv_usec = 0;  // zero timeval = wait forever
+    } else {
+      const auto remaining =
+          std::chrono::duration_cast<std::chrono::microseconds>(
+              deadline - std::chrono::steady_clock::now())
+              .count();
+      if (remaining <= 0) return false;  // budget exhausted
+      tv.tv_sec = static_cast<time_t>(remaining / 1000000);
+      tv.tv_usec = static_cast<suseconds_t>(remaining % 1000000);
+    }
+    setsockopt(fd_, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    setsockopt(fd_, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+    return true;
+  };
+  const auto timed_out = [] {
+    return errno == EAGAIN || errno == EWOULDBLOCK;
+  };
   for (int attempt = 0; attempt < 2; ++attempt) {
     // A request may only be retried when it was written to a REUSED
     // keep-alive connection and ZERO response bytes arrived: then the server
@@ -170,6 +196,12 @@ InferenceServerHttpClient::Request(
     const bool reused_connection = (fd_ >= 0);
     Error err = EnsureConnected();
     if (!err.IsOk()) return err;
+    // client_timeout_us bounds the WHOLE exchange; 0 restores "wait
+    // forever" (the fd is a reused keep-alive socket, so set it per request)
+    if (!set_socket_timeout()) {
+      CloseSocket();
+      return Error("client timeout exceeded");
+    }
 
     std::ostringstream req;
     req << method << " " << uri << " HTTP/1.1\r\n";
@@ -191,6 +223,10 @@ InferenceServerHttpClient::Request(
         ssize_t n = ::send(
             fd_, part->data() + sent, part->size() - sent, MSG_NOSIGNAL);
         if (n <= 0) {
+          if (n < 0 && timed_out()) {
+            CloseSocket();
+            return Error("client timeout exceeded while sending request");
+          }
           write_failed = true;
           break;
         }
@@ -218,9 +254,17 @@ InferenceServerHttpClient::Request(
     while (header_end == std::string::npos) {
       ssize_t n = ::recv(fd_, chunk, sizeof(chunk), 0);
       if (n <= 0) {
+        if (n < 0 && timed_out()) {
+          CloseSocket();
+          return Error("client timeout exceeded waiting for response");
+        }
         CloseSocket();
         read_closed = true;
         break;
+      }
+      if (!set_socket_timeout()) {
+        CloseSocket();
+        return Error("client timeout exceeded waiting for response");
       }
       buf.append(chunk, static_cast<size_t>(n));
       header_end = buf.find("\r\n\r\n");
@@ -277,7 +321,14 @@ InferenceServerHttpClient::Request(
       ssize_t n = ::recv(fd_, chunk, sizeof(chunk), 0);
       if (n <= 0) {
         CloseSocket();
+        if (n < 0 && timed_out()) {
+          return Error("client timeout exceeded reading response body");
+        }
         return Error("connection closed mid-body");
+      }
+      if (!set_socket_timeout()) {
+        CloseSocket();
+        return Error("client timeout exceeded reading response body");
       }
       response->body.append(chunk, static_cast<size_t>(n));
     }
@@ -824,7 +875,8 @@ InferenceServerHttpClient::Infer(
         response_compression == CompressionType::GZIP ? "gzip" : "deflate";
   }
   HttpResponse r;
-  err = Request(&r, "POST", uri, body, headers, &timers);
+  err = Request(&r, "POST", uri, body, headers, &timers,
+                options.client_timeout_us);
   if (!err.IsOk()) return err;
   if (r.status != 200) return ErrorFromResponse(r);
   const auto enc = r.headers.find("content-encoding");

@@ -134,11 +134,14 @@ class InferenceServerHttpClient {
 
  private:
   InferenceServerHttpClient(const std::string& url, bool verbose);
+  // timeout_us > 0 bounds the whole exchange via socket send/recv timeouts
+  // (InferOptions.client_timeout_us); an expiry closes the connection (the
+  // response could still arrive later) and surfaces a timeout error.
   Error Request(
       HttpResponse* response, const std::string& method,
       const std::string& uri, const std::string& body,
       const std::map<std::string, std::string>& headers = {},
-      RequestTimers* timers = nullptr);
+      RequestTimers* timers = nullptr, uint64_t timeout_us = 0);
   Error EnsureConnected();
   void CloseSocket();
   void UpdateStat(const RequestTimers& timers);

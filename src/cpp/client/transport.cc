@@ -162,8 +162,28 @@ class OpenSslTransport : public ByteTransport {
     const std::string sni =
         config_.server_name.empty() ? host : config_.server_name;
     SSL_set_tlsext_host_name(ssl_, sni.c_str());
+    if (!config_.insecure_skip_verify &&
+        SSL_set1_host(ssl_, sni.c_str()) != 1) {
+      return Error("failed to pin TLS verification hostname");
+    }
+    // bound the handshake too: the TCP connect timeout only covers connect()
+    if (timeout_ms > 0) {
+      struct timeval tv;
+      tv.tv_sec = static_cast<time_t>(timeout_ms / 1000);
+      tv.tv_usec = static_cast<suseconds_t>((timeout_ms % 1000) * 1000);
+      setsockopt(tcp_.fd(), SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+      setsockopt(tcp_.fd(), SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+    }
     SSL_set_fd(ssl_, tcp_.fd());
-    if (SSL_connect(ssl_) != 1) {
+    const int hs = SSL_connect(ssl_);
+    if (timeout_ms > 0) {
+      struct timeval tv;
+      tv.tv_sec = 0;
+      tv.tv_usec = 0;
+      setsockopt(tcp_.fd(), SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+      setsockopt(tcp_.fd(), SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+    }
+    if (hs != 1) {
       return Error(
           "TLS handshake with '" + host + "' failed: " +
           std::string(ERR_error_string(ERR_get_error(), nullptr)));

@@ -1,0 +1,147 @@
+// Client-timeout behavior across both native clients — the analog of
+// reference src/c++/tests/client_timeout_test.cc: a microscopic
+// client_timeout on a deliberately slow model must surface a clean timeout
+// error (sync AND async paths), a generous timeout must succeed, and the
+// client must remain fully usable afterwards.
+//   client_timeout_test <http_host:port> <grpc_host:port>
+#include <chrono>
+#include <condition_variable>
+#include <cstring>
+#include <iostream>
+#include <memory>
+#include <mutex>
+#include <vector>
+
+#include "grpc_client.h"
+#include "http_client.h"
+
+namespace tc = ctpu;
+
+static int g_failures = 0;
+static int g_checks = 0;
+
+#define CHECK(cond)                                                         \
+  do {                                                                      \
+    g_checks++;                                                             \
+    if (!(cond)) {                                                          \
+      g_failures++;                                                         \
+      std::cerr << "FAIL " << __FILE__ << ":" << __LINE__ << "  " << #cond  \
+                << std::endl;                                               \
+    }                                                                       \
+  } while (false)
+
+// slow_identity takes ~50ms per request; 5ms must time out, 5s must not.
+static constexpr uint64_t kTinyUs = 5 * 1000;
+static constexpr uint64_t kAmpleUs = 5 * 1000 * 1000;
+
+static tc::InferInput
+MakeInput()
+{
+  static int32_t value = 42;
+  tc::InferInput input("INPUT0", {1}, "INT32");
+  input.AppendRaw(reinterpret_cast<const uint8_t*>(&value), sizeof(value));
+  return input;
+}
+
+static tc::Error
+SyncInfer(tc::InferenceServerGrpcClient* client, uint64_t timeout_us)
+{
+  tc::InferInput input = MakeInput();
+  tc::InferRequestedOutput output("OUTPUT0");
+  tc::InferOptions options("slow_identity");
+  options.client_timeout_us = timeout_us;
+  tc::InferResult* result = nullptr;
+  tc::Error err = client->Infer(&result, options, {&input}, {&output});
+  delete result;
+  return err;
+}
+
+static tc::Error
+SyncInfer(tc::InferenceServerHttpClient* client, uint64_t timeout_us)
+{
+  tc::InferInput input = MakeInput();
+  tc::InferRequestedOutput output("OUTPUT0");
+  tc::InferOptions options("slow_identity");
+  options.client_timeout_us = timeout_us;
+  tc::InferResultPtr result;
+  return client->Infer(&result, options, {&input}, {&output});
+}
+
+template <typename ClientT>
+static void
+TestSyncTimeout(ClientT* client)
+{
+  // tiny deadline: must fail, and promptly (well under the 5s ample bound)
+  const auto t0 = std::chrono::steady_clock::now();
+  tc::Error err = SyncInfer(client, kTinyUs);
+  const auto elapsed = std::chrono::duration_cast<std::chrono::milliseconds>(
+      std::chrono::steady_clock::now() - t0);
+  CHECK(!err.IsOk());
+  CHECK(elapsed.count() < 2000);
+  // ample deadline: same client recovers and succeeds
+  CHECK(SyncInfer(client, kAmpleUs).IsOk());
+  // no deadline at all still succeeds
+  CHECK(SyncInfer(client, 0).IsOk());
+}
+
+static void
+TestGrpcAsyncTimeout(tc::InferenceServerGrpcClient* client)
+{
+  std::mutex mu;
+  std::condition_variable cv;
+  bool done = false;
+  tc::Error status;
+  tc::InferInput input = MakeInput();
+  tc::InferRequestedOutput output("OUTPUT0");
+  tc::InferOptions options("slow_identity");
+  options.client_timeout_us = kTinyUs;
+  tc::Error err = client->AsyncInfer(
+      [&](tc::InferResultPtr result) {
+        std::lock_guard<std::mutex> lk(mu);
+        status = result->RequestStatus();
+        done = true;
+        cv.notify_all();
+      },
+      options, {&input}, {&output});
+  CHECK(err.IsOk());
+  std::unique_lock<std::mutex> lk(mu);
+  const bool fired =
+      cv.wait_for(lk, std::chrono::seconds(30), [&] { return done; });
+  CHECK(fired);
+  CHECK(!status.IsOk());  // the tiny deadline must surface as an error
+  lk.unlock();
+  // the client (and its connection) must still serve after the timeout
+  CHECK(SyncInfer(client, kAmpleUs).IsOk());
+}
+
+int
+main(int argc, char** argv)
+{
+  const std::string http_url = argc > 1 ? argv[1] : "localhost:8000";
+  const std::string grpc_url = argc > 2 ? argv[2] : "localhost:8001";
+
+  std::unique_ptr<tc::InferenceServerHttpClient> http_client;
+  if (!tc::InferenceServerHttpClient::Create(&http_client, http_url)
+           .IsOk()) {
+    std::cerr << "http create failed" << std::endl;
+    return 1;
+  }
+  std::unique_ptr<tc::InferenceServerGrpcClient> grpc_client;
+  if (!tc::InferenceServerGrpcClient::Create(&grpc_client, grpc_url)
+           .IsOk()) {
+    std::cerr << "grpc create failed" << std::endl;
+    return 1;
+  }
+
+  TestSyncTimeout(http_client.get());
+  TestSyncTimeout(grpc_client.get());
+  TestGrpcAsyncTimeout(grpc_client.get());
+
+  std::cout << g_checks << " checks, " << g_failures << " failures"
+            << std::endl;
+  if (g_failures == 0) {
+    std::cout << "PASS: client_timeout_test" << std::endl;
+    return 0;
+  }
+  return 1;
+}

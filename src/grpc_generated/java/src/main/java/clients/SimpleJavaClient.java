@@ -1,0 +1,90 @@
+// Java gRPC example over generated KServe-v2 stubs (the analog of the
+// reference's src/grpc_generated/java example): drives the add/sub "simple"
+// model with raw tensor contents and verifies the arithmetic.
+//   mvn exec:java -Dexec.mainClass=clients.SimpleJavaClient -Dexec.args="host:port"
+package clients;
+
+import com.google.protobuf.ByteString;
+import inference.GRPCInferenceServiceGrpc;
+import inference.Inference.InferTensorContents;
+import inference.Inference.ModelInferRequest;
+import inference.Inference.ModelInferResponse;
+import inference.Inference.ServerLiveRequest;
+import inference.Inference.ServerLiveResponse;
+import io.grpc.ManagedChannel;
+import io.grpc.ManagedChannelBuilder;
+import java.nio.ByteBuffer;
+import java.nio.ByteOrder;
+
+public final class SimpleJavaClient {
+  private SimpleJavaClient() {}
+
+  private static ByteString int32Tensor(int[] values) {
+    ByteBuffer buf =
+        ByteBuffer.allocate(values.length * 4).order(ByteOrder.LITTLE_ENDIAN);
+    for (int v : values) buf.putInt(v);
+    buf.flip();
+    return ByteString.copyFrom(buf);
+  }
+
+  public static void main(String[] args) throws Exception {
+    String target = args.length > 0 ? args[0] : "localhost:8001";
+    ManagedChannel channel =
+        ManagedChannelBuilder.forTarget(target).usePlaintext().build();
+    try {
+      GRPCInferenceServiceGrpc.GRPCInferenceServiceBlockingStub stub =
+          GRPCInferenceServiceGrpc.newBlockingStub(channel);
+
+      ServerLiveResponse live =
+          stub.serverLive(ServerLiveRequest.getDefaultInstance());
+      if (!live.getLive()) {
+        System.err.println("error: server not live");
+        System.exit(1);
+      }
+
+      int[] input0 = new int[16];
+      int[] input1 = new int[16];
+      for (int i = 0; i < 16; i++) {
+        input0[i] = i;
+        input1[i] = 1;
+      }
+      ModelInferRequest request =
+          ModelInferRequest.newBuilder()
+              .setModelName("simple")
+              .addInputs(
+                  ModelInferRequest.InferInputTensor.newBuilder()
+                      .setName("INPUT0")
+                      .setDatatype("INT32")
+                      .addShape(1)
+                      .addShape(16))
+              .addInputs(
+                  ModelInferRequest.InferInputTensor.newBuilder()
+                      .setName("INPUT1")
+                      .setDatatype("INT32")
+                      .addShape(1)
+                      .addShape(16))
+              .addRawInputContents(int32Tensor(input0))
+              .addRawInputContents(int32Tensor(input1))
+              .build();
+      ModelInferResponse response = stub.modelInfer(request);
+
+      ByteBuffer sum = response.getRawOutputContents(0).asReadOnlyByteBuffer()
+                           .order(ByteOrder.LITTLE_ENDIAN);
+      ByteBuffer diff = response.getRawOutputContents(1).asReadOnlyByteBuffer()
+                            .order(ByteOrder.LITTLE_ENDIAN);
+      for (int i = 0; i < 16; i++) {
+        int s = sum.getInt();
+        int d = diff.getInt();
+        System.out.printf("%d + %d = %d, %d - %d = %d%n",
+            input0[i], input1[i], s, input0[i], input1[i], d);
+        if (s != input0[i] + input1[i] || d != input0[i] - input1[i]) {
+          System.err.println("error: wrong arithmetic");
+          System.exit(1);
+        }
+      }
+      System.out.println("PASS: java grpc stubs");
+    } finally {
+      channel.shutdownNow();
+    }
+  }
+}

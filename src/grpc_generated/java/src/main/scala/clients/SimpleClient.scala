@@ -1,0 +1,77 @@
+// Scala gRPC example over the same generated KServe-v2 stubs (the analog
+// of the reference's src/grpc_generated/java Scala example): grpc-java's
+// blocking stub used from Scala — no separate ScalaPB toolchain needed.
+//   mvn exec:java -Dexec.mainClass=clients.SimpleClient -Dexec.args="host:port"
+package clients
+
+import com.google.protobuf.ByteString
+import inference.GRPCInferenceServiceGrpc
+import inference.Inference.{ModelInferRequest, ServerLiveRequest}
+import io.grpc.ManagedChannelBuilder
+import java.nio.{ByteBuffer, ByteOrder}
+
+object SimpleClient {
+  private def int32Tensor(values: Array[Int]): ByteString = {
+    val buf =
+      ByteBuffer.allocate(values.length * 4).order(ByteOrder.LITTLE_ENDIAN)
+    values.foreach(buf.putInt)
+    buf.flip()
+    ByteString.copyFrom(buf)
+  }
+
+  def main(args: Array[String]): Unit = {
+    val target = if (args.nonEmpty) args(0) else "localhost:8001"
+    val channel =
+      ManagedChannelBuilder.forTarget(target).usePlaintext().build()
+    try {
+      val stub = GRPCInferenceServiceGrpc.newBlockingStub(channel)
+      require(
+        stub.serverLive(ServerLiveRequest.getDefaultInstance).getLive,
+        "server not live")
+
+      val input0 = Array.tabulate(16)(identity)
+      val input1 = Array.fill(16)(1)
+      val request = ModelInferRequest
+        .newBuilder()
+        .setModelName("simple")
+        .addInputs(
+          ModelInferRequest.InferInputTensor
+            .newBuilder()
+            .setName("INPUT0")
+            .setDatatype("INT32")
+            .addShape(1)
+            .addShape(16))
+        .addInputs(
+          ModelInferRequest.InferInputTensor
+            .newBuilder()
+            .setName("INPUT1")
+            .setDatatype("INT32")
+            .addShape(1)
+            .addShape(16))
+        .addRawInputContents(int32Tensor(input0))
+        .addRawInputContents(int32Tensor(input1))
+        .build()
+      val response = stub.modelInfer(request)
+
+      val sum = response
+        .getRawOutputContents(0)
+        .asReadOnlyByteBuffer()
+        .order(ByteOrder.LITTLE_ENDIAN)
+      val diff = response
+        .getRawOutputContents(1)
+        .asReadOnlyByteBuffer()
+        .order(ByteOrder.LITTLE_ENDIAN)
+      for (i <- 0 until 16) {
+        val s = sum.getInt()
+        val d = diff.getInt()
+        println(s"${input0(i)} + ${input1(i)} = $s, " +
+          s"${input0(i)} - ${input1(i)} = $d")
+        require(s == input0(i) + input1(i), "wrong sum")
+        require(d == input0(i) - input1(i), "wrong diff")
+      }
+      println("PASS: scala grpc stubs")
+    } finally {
+      channel.shutdownNow()
+    }
+  }
+}

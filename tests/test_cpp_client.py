@@ -122,3 +122,41 @@ def test_native_grpc_examples(grpc_server):
         )
         assert proc.returncode == 0, exe + ": " + proc.stdout + proc.stderr
         assert "PASS" in proc.stdout, exe
+
+
+@pytest.fixture(scope="module")
+def dual_server():
+    with Server(http_port=0, grpc_port=0) as s:
+        yield s
+
+
+@needs_grpc_cpp
+def test_client_timeout_suite(dual_server):
+    """Timeout behavior for both native clients (reference
+    src/c++/tests/client_timeout_test.cc): a microscopic client_timeout on
+    slow_identity errors promptly on sync HTTP, sync gRPC, and async gRPC;
+    ample/absent deadlines succeed; the client stays usable afterwards."""
+    exe = os.path.join(_BUILD, "client_timeout_test")
+    if not os.path.exists(exe):
+        pytest.skip("client_timeout_test not built")
+    proc = subprocess.run(
+        [exe, dual_server.http_address, dual_server.grpc_address],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "PASS: client_timeout_test" in proc.stdout
+
+
+@needs_grpc_cpp
+def test_memory_leak_suite(dual_server):
+    """RSS-stability loop across both protocols, reused-client and
+    fresh-client-per-iteration modes (reference memory_leak_test.cc)."""
+    exe = os.path.join(_BUILD, "memory_leak_test")
+    if not os.path.exists(exe):
+        pytest.skip("memory_leak_test not built")
+    proc = subprocess.run(
+        [exe, dual_server.http_address, dual_server.grpc_address, "100"],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "PASS: memory_leak_test" in proc.stdout
