@@ -45,6 +45,9 @@ def build_parser():
     p.add_argument("-x", "--model-version", default="")
     p.add_argument("-u", "--url", default="localhost:8001")
     p.add_argument("-i", "--protocol", choices=["grpc", "http"], default="grpc")
+    p.add_argument("-a", "--async", dest="async_mode", action="store_true",
+                   help="async concurrency slots on one event loop over "
+                        "grpc.aio (reference -a; stateless gRPC only)")
     p.add_argument("--service-kind",
                    choices=["triton", "torchserve", "tfserve",
                             "tfserve_rest"],
@@ -309,6 +312,10 @@ def main(argv=None):
         )
         latency_limit_us = args.latency_threshold * 1e3 or None
 
+        if args.async_mode and (args.request_intervals
+                                or args.request_rate_range):
+            sys.exit("error: --async applies to concurrency mode only "
+                     "(request-rate/interval schedules use worker threads)")
         if args.request_intervals:
             manager = CustomLoadManager(
                 intervals_file=args.request_intervals, **common
@@ -316,6 +323,21 @@ def main(argv=None):
         elif args.request_rate_range:
             manager = RequestRateManager(
                 distribution=args.request_distribution, **common
+            )
+        elif args.async_mode:
+            from client_tpu.perf.load_manager import AsyncConcurrencyManager
+
+            if (args.hermetic or kind != BackendKind.TRITON_GRPC
+                    or args.sequence):
+                sys.exit("error: --async requires a socket gRPC server and "
+                         "a stateless workload (sequences ride streaming)")
+            manager = AsyncConcurrencyManager(
+                url=args.url,
+                data_loader=loader,
+                data_manager=data_manager,
+                model_name=args.model_name,
+                model_version=args.model_version,
+                max_threads=args.max_threads,
             )
         else:
             manager = ConcurrencyManager(**common)

@@ -171,7 +171,11 @@ InferenceServerHttpClient::Request(
     if (timeout_us == 0) {
       tv.tv_sec = 0;
       tv.tv_usec = 0;  // zero timeval = wait forever
-    } else {
+      setsockopt(fd_, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+      setsockopt(fd_, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+      return true;
+    }
+    {
       const auto remaining =
           std::chrono::duration_cast<std::chrono::microseconds>(
               deadline - std::chrono::steady_clock::now())
@@ -183,6 +187,11 @@ InferenceServerHttpClient::Request(
     setsockopt(fd_, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
     setsockopt(fd_, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
     return true;
+  };
+  const auto rearm_or_timeout = [&]() -> bool {
+    // keep the remaining-budget invariant between partial socket ops;
+    // no-op (single redundant-free path) when no deadline is set
+    return timeout_us == 0 || set_socket_timeout();
   };
   const auto timed_out = [] {
     return errno == EAGAIN || errno == EWOULDBLOCK;
@@ -231,6 +240,10 @@ InferenceServerHttpClient::Request(
           break;
         }
         sent += static_cast<size_t>(n);
+        if (!rearm_or_timeout()) {
+          CloseSocket();
+          return Error("client timeout exceeded while sending request");
+        }
       }
       if (write_failed) break;
     }
@@ -262,7 +275,7 @@ InferenceServerHttpClient::Request(
         read_closed = true;
         break;
       }
-      if (!set_socket_timeout()) {
+      if (!rearm_or_timeout()) {
         CloseSocket();
         return Error("client timeout exceeded waiting for response");
       }
@@ -320,13 +333,14 @@ InferenceServerHttpClient::Request(
     while (response->body.size() < content_length) {
       ssize_t n = ::recv(fd_, chunk, sizeof(chunk), 0);
       if (n <= 0) {
+        const bool was_timeout = n < 0 && timed_out();  // before close clobbers errno
         CloseSocket();
-        if (n < 0 && timed_out()) {
+        if (was_timeout) {
           return Error("client timeout exceeded reading response body");
         }
         return Error("connection closed mid-body");
       }
-      if (!set_socket_timeout()) {
+      if (!rearm_or_timeout()) {
         CloseSocket();
         return Error("client timeout exceeded reading response body");
       }

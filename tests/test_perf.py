@@ -22,6 +22,7 @@ from client_tpu.perf import (
     SequenceManager,
     create_infer_data_manager,
 )
+from client_tpu.perf.infer_data import InferDataManager
 from client_tpu.perf.load_manager import RequestRecord
 from client_tpu.utils import InferenceServerException
 
@@ -827,3 +828,67 @@ class TestProcPool:
         loader = ShapeOnlyLoader(1, [1])
         assert loader.num_steps(0) == 1
         assert loader.get_expected_outputs(0, 0) == {}
+
+
+class TestAsyncConcurrencyManager:
+    """Async InferContext slots over grpc.aio (reference -a/--async)."""
+
+    def test_async_slots_drive_requests(self):
+        from client_tpu.perf.load_manager import AsyncConcurrencyManager
+        from client_tpu.serve import Server
+
+        with Server(grpc_port=0) as server:
+            control = ClientBackendFactory.create(
+                BackendKind.TRITON_GRPC, url=server.grpc_address
+            )
+            meta = control.model_metadata("simple")
+            inputs_meta = [
+                {"name": m["name"], "datatype": m["datatype"],
+                 "shape": [1 if d == -1 else d for d in m["shape"]]}
+                for m in meta["inputs"]
+            ]
+            outputs_meta = [dict(m) for m in meta["outputs"]]
+            loader = DataLoader(inputs_meta, batch_size=1)
+            loader.generate_data()
+            mgr_dm = InferDataManager(
+                control, loader, inputs_meta, outputs_meta
+            )
+            mgr_dm.init()
+            manager = AsyncConcurrencyManager(
+                url=server.grpc_address,
+                data_loader=loader,
+                data_manager=mgr_dm,
+                model_name="simple",
+                max_threads=16,
+            )
+            try:
+                manager.change_concurrency_level(8)
+                time.sleep(1.0)
+                manager.check_health()
+                records = manager.swap_timestamps()
+                assert len(records) > 8
+                assert all(r.ok for r in records)
+                # reconfigure to a lower level works (slot teardown + restart)
+                manager.change_concurrency_level(2)
+                time.sleep(0.4)
+                assert manager.get_and_reset_num_sent() > 0
+            finally:
+                manager.cleanup()
+            control.close()
+
+    def test_cli_async_mode(self):
+        import subprocess
+        import sys
+
+        from client_tpu.serve import Server
+
+        with Server(grpc_port=0) as server:
+            proc = subprocess.run(
+                [sys.executable, "-m", "client_tpu.perf", "-m", "simple",
+                 "-u", server.grpc_address, "-i", "grpc", "--async",
+                 "--concurrency-range", "4:4:1",
+                 "--measurement-interval", "500", "--max-trials", "4"],
+                capture_output=True, text=True, timeout=120,
+            )
+            assert proc.returncode == 0, proc.stdout + proc.stderr
+            assert "Best: concurrency=" in proc.stdout
