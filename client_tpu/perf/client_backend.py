@@ -351,7 +351,7 @@ class _InprocessBackend(ClientBackend):
                 for o in outputs
             ]
         result = self._engine.execute(model_name, model_version, request, binary)
-        if isinstance(result, list):  # decoupled: list of (response, blobs)
+        if not isinstance(result, tuple):  # decoupled stream (generator/list)
             return [_EngineResult(r, b) for r, b in result]
         response, blobs = result
         return _EngineResult(response, blobs)

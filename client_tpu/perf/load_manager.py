@@ -398,7 +398,7 @@ class AsyncConcurrencyManager(LoadManager):
             for ctx_id in range(concurrency):
                 stat = ThreadStat()
                 stop = threading.Event()
-                task = asyncio.get_event_loop().create_task(
+                task = asyncio.get_running_loop().create_task(
                     self._slot(ctx_id, stat, stop)
                 )
                 slots.append((task, stat, stop))
@@ -447,6 +447,11 @@ class AsyncConcurrencyManager(LoadManager):
                 if stat.fatal is not None:
                     raise stat.fatal
             if task.done() and not stop.is_set():
+                exc = None
+                if not task.cancelled():
+                    exc = task.exception()  # the slot's real failure
+                if exc is not None:
+                    raise exc
                 raise InferenceServerException(
                     "an async load slot exited unexpectedly"
                 )

@@ -359,7 +359,7 @@ class _Handlers:
             result = self.engine.execute(
                 request.model_name, request.model_version, req, binary
             )
-            if isinstance(result, list):
+            if not isinstance(result, tuple):  # list/generator = decoupled
                 raise InferenceServerException(
                     f"model '{request.model_name}' is decoupled; use "
                     "ModelStreamInfer",
@@ -379,7 +379,9 @@ class _Handlers:
                 result = self.engine.execute(
                     request.model_name, request.model_version, req, binary
                 )
-                responses = result if isinstance(result, list) else [result]
+                # a decoupled result streams lazily (generator): each
+                # response reaches the wire as the model produces it
+                responses = [result] if isinstance(result, tuple) else result
                 for response_json, blobs in responses:
                     yield pb.ModelStreamInferResponse(
                         infer_response=_dict_to_response(

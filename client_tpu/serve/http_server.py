@@ -274,14 +274,15 @@ class _Handler(BaseHTTPRequestHandler):
             body, int(header_length) if header_length is not None else None
         )
         result = self.engine.execute(model, version, request, binary)
-        if isinstance(result, list):
-            if len(result) != 1:
+        if not isinstance(result, tuple):  # decoupled stream (generator/list)
+            responses = list(result)
+            if len(responses) != 1:
                 raise InferenceServerException(
                     f"model '{model}' is decoupled; HTTP requires exactly one "
-                    f"response but got {len(result)} — use gRPC streaming",
+                    f"response but got {len(responses)} — use gRPC streaming",
                     status="400",
                 )
-            result = result[0]
+            result = responses[0]
         response_json, blobs = result
         body, json_size = _codec.build_infer_response_body(response_json, blobs)
         headers = {}

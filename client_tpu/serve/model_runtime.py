@@ -752,19 +752,15 @@ class InferenceEngine:
                 stats.record_request_success(time.monotonic_ns() - t0)
                 return rendered
             if model.decoupled:
-                responses = []
-                with self.busy:
-                    result = model.fn(inputs, params, context)
-                    for partial in result:
-                        responses.append(
-                            self._render_response(
-                                model, model_version, request, partial
-                            )
-                        )
-                # One request = one statistics entry regardless of response count.
-                t1 = time.monotonic_ns()
-                stats.record(True, t1 - t0, t1 - t_in1, t_in1 - t_in0, 0)
-                return responses
+                # LAZY stream: responses render as the model produces them,
+                # so the first token reaches the wire at first-token time —
+                # materializing the whole generation first would make
+                # time-to-first-token equal total generation time (64 host-
+                # driven decode steps over a tunneled chip = seconds).
+                return self._decoupled_stream(
+                    model, model_version, request, inputs, params, context,
+                    stats, t0, t_in0, t_in1,
+                )
             # Direct path: the busy span opens at dispatch and is closed by
             # the observer at device completion (async results) or right
             # after rendering (host results already materialized) — duty
@@ -796,6 +792,49 @@ class InferenceEngine:
             raise InferenceServerException(
                 f"{model_name}: execution failed: {e}", status="500", debug_details=e
             ) from e
+
+    def _decoupled_stream(self, model, model_version, request, inputs,
+                          params, context, stats, t0, t_in0, t_in1):
+        """Generator of (response_dict, blobs) for a decoupled model.
+
+        Exactly one statistics entry per request: success at exhaustion,
+        failure on a model error OR an abandoned stream (consumer cancel /
+        GC closes the generator mid-flight).  The busy span covers only the
+        model's production time (each next() + render), never the suspension
+        at yield — a slow-reading client must not inflate the duty cycle."""
+        recorded = False
+        try:
+            gen = model.fn(inputs, params, context)
+            while True:
+                self.busy.begin()
+                try:
+                    try:
+                        partial = next(gen)
+                    except StopIteration:
+                        break
+                    rendered = self._render_response(
+                        model, model_version, request, partial
+                    )
+                finally:
+                    self.busy.end()
+                yield rendered
+            t1 = time.monotonic_ns()
+            stats.record(True, t1 - t0, t1 - t_in1, t_in1 - t_in0, 0)
+            recorded = True
+        except InferenceServerException:
+            stats.record(False, time.monotonic_ns() - t0, 0, 0, 0)
+            recorded = True
+            raise
+        except Exception as e:
+            stats.record(False, time.monotonic_ns() - t0, 0, 0, 0)
+            recorded = True
+            raise InferenceServerException(
+                f"{model.name}: execution failed: {e}",
+                status="500", debug_details=e,
+            ) from e
+        finally:
+            if not recorded:  # abandoned mid-stream (GeneratorExit/GC)
+                stats.record(False, time.monotonic_ns() - t0, 0, 0, 0)
 
     def _run_ensemble(self, model, inputs):
         """Chain composing models per ensemble_scheduling (the reference's

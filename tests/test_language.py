@@ -173,3 +173,59 @@ def test_sampling_temperature_param(runner):
     # different seeds give different samples (overwhelmingly likely)
     sampled2 = list(runner.stream(encode_text("xy"), 5, temperature=1.5, seed=8))
     assert sampled != sampled2 or sampled != greedy
+
+
+def test_decoupled_responses_stream_lazily():
+    """Each decoupled response must reach the wire as the model produces it:
+    time-to-first-response stays far below total stream time (a buffering
+    engine would make TTFT equal full generation time — seconds per request
+    for LLM token streaming on a remote chip)."""
+    import time
+
+    from client_tpu.serve.model_runtime import (
+        InferenceEngine,
+        Model,
+        TensorSpec,
+    )
+
+    delay_s = 0.15
+
+    def fn(inputs, params, ctx):
+        for i in range(4):
+            time.sleep(delay_s)
+            yield {"OUT": np.array([i], dtype=np.int32)}
+
+    model = Model(
+        "slow_stream",
+        inputs=[TensorSpec("IN", "INT32", [1])],
+        outputs=[TensorSpec("OUT", "INT32", [1])],
+        fn=fn,
+        decoupled=True,
+    )
+    engine = InferenceEngine(models=[model])
+    try:
+        request = {
+            "id": "",
+            "parameters": {},
+            "inputs": [
+                {"name": "IN", "datatype": "INT32", "shape": [1],
+                 "data": [4]}
+            ],
+        }
+        t0 = time.perf_counter()
+        stream = engine.execute("slow_stream", "", request, b"")
+        arrival = []
+        values = []
+        for response_json, blobs in stream:
+            arrival.append(time.perf_counter() - t0)
+            values.append(response_json["outputs"][0]["data"][0])
+        assert values == [0, 1, 2, 3]
+        # first response arrives ~1 delay in; a buffering engine would make
+        # it arrive only after all 4 delays
+        assert arrival[0] < 2.5 * delay_s, arrival
+        assert arrival[-1] >= 3.5 * delay_s, arrival
+        # one statistics entry per completed request, recorded at exhaustion
+        stats = engine.statistics("slow_stream")[0]["inference_stats"]
+        assert stats["success"]["count"] == 1
+    finally:
+        engine.close()
