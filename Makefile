@@ -79,7 +79,13 @@ GRPC_EXAMPLES := simple_grpc_infer_client \
 grpc_cpp: $(addprefix $(CPP_BUILD)/,$(GRPC_EXAMPLES)) \
           $(CPP_BUILD)/simple_grpc_tpushm_client \
           $(CPP_BUILD)/cc_grpc_client_test $(CPP_BUILD)/hpack_unit_test \
-          $(CPP_BUILD)/client_timeout_test $(CPP_BUILD)/memory_leak_test
+          $(CPP_BUILD)/client_timeout_test $(CPP_BUILD)/memory_leak_test \
+          $(CPP_BUILD)/perf_worker
+
+# native load-generation worker (the perf harness's C++ engine)
+$(CPP_BUILD)/perf_worker: $(CPP_DIR)/perf/perf_worker.cc $(GRPC_OBJS)
+	mkdir -p $(CPP_BUILD)
+	$(CXX) $(CXXFLAGS) -o $@ $< $(GRPC_OBJS) $(GRPC_INC) $(GRPC_LINK)
 
 # Dual-protocol test binaries link both client stacks (shared objects
 # appear once: GRPC_OBJS already carries shm_utils.o and transport.o).

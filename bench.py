@@ -222,6 +222,59 @@ def _run_tpu_shm_multiproc(server, processes=4, concurrency=CONCURRENCY):
         h.close()
 
 
+def _run_tpu_shm_native(server, concurrency=CONCURRENCY):
+    """TPU-shm load from the NATIVE C++ worker (build/cpp/perf_worker):
+    async InferContexts on one multiplexed connection, zero GIL in the
+    instrument — the reference perf_analyzer's load shape.  Regions are
+    created/registered by this (Python) coordinator; the worker references
+    them by name."""
+    from client_tpu.perf.native_worker import (
+        native_worker_available,
+        run_native_worker,
+    )
+
+    if not native_worker_available():
+        return None
+    h = _Harness(
+        server.grpc_address, "cnn_classifier", "tpu", 1,
+        output_shm_bytes=_OUT_BYTES,
+    )
+    try:
+        from client_tpu.perf.procpool import export_region_specs
+
+        input_specs, output_specs = export_region_specs(
+            h.data_manager, h.data_manager._inputs_meta, h.loader
+        )
+        shm_inputs = [
+            (name, datatype, shape, region, nbytes)
+            for name, shape, datatype, region, nbytes in input_specs[(0, 0)]
+        ]
+        shm_outputs = [
+            (name, region, nbytes)
+            for name, region, nbytes in output_specs
+            if region
+        ]
+        report = run_native_worker(
+            server.grpc_address, "cnn_classifier",
+            concurrency=concurrency, duration_s=MEASURE_S,
+            warmup_s=WARMUP_S, shm_inputs=shm_inputs,
+            shm_outputs=shm_outputs,
+        )
+        h.data_manager.sync_outputs()  # drain: completed device work only
+        # no duty cycle here: the observable span would include subprocess
+        # spawn/connect/drain, which is not comparable to the windowed
+        # python/multiproc duty figures printed next to it
+        return {
+            "infer_per_sec": report["throughput"],
+            "p50_ms": report["p50_us"] / 1e3,
+            "p99_ms": report["p99_us"] / 1e3,
+            "n": report["ok"],
+            "errors": report["errors"],
+        }
+    finally:
+        h.close()
+
+
 def _run_tpu_shm(server, concurrency=CONCURRENCY, completion_sync=False,
                  batch_size=1):
     """TPU-shm mode through the harness; headline = drained completion."""
@@ -416,6 +469,7 @@ def main():
     ).start()
     try:
         tpu = _run_tpu_shm(server)
+        tpu_nw = _run_tpu_shm_native(server, concurrency=CONCURRENCY)
         tpu_mp = _run_tpu_shm_multiproc(server, processes=4,
                                         concurrency=CONCURRENCY)
         tpu_b8 = _run_tpu_shm(server, concurrency=8, batch_size=8)
@@ -448,6 +502,14 @@ def main():
         "requests": tpu["n"],
         "concurrency": CONCURRENCY,
         "duty_cycle_pct": tpu["duty_cycle_pct"],
+        # NATIVE C++ load generation (build/cpp/perf_worker): async
+        # InferContexts on one multiplexed connection, no GIL in the
+        # instrument — the strongest measure of what the server sustains
+        **({
+            "nw_infer_per_sec": round(tpu_nw["infer_per_sec"], 2),
+            "nw_p50_ms": round(tpu_nw["p50_ms"], 3),
+            "nw_p99_ms": round(tpu_nw["p99_ms"], 3),
+        } if tpu_nw else {}),
         # separate-process load generation (client_tpu.perf.procpool):
         # the server keeps its GIL; clients reference regions by name
         "mp_infer_per_sec": round(tpu_mp["infer_per_sec"], 2),

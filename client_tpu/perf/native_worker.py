@@ -1,0 +1,69 @@
+"""Driver for the native C++ load-generation worker (build/cpp/perf_worker).
+
+The binary is the harness's C++ engine — the reference perf_analyzer's
+native load path (perf_analyzer.cc:56-424): N async InferContexts
+multiplexed on one HTTP/2 connection, completed by its reactor thread.  No
+GIL anywhere near the measurement; the Python side only assembles arguments
+and parses the one-line JSON report.
+
+TPU-shm loads compose with region-by-name referencing exactly like
+procpool: the coordinator (Python, owns jax) creates and registers the
+regions; the native worker sends requests that reference them by name.
+"""
+
+import json
+import os
+import subprocess
+
+from client_tpu.utils import InferenceServerException
+
+_DEFAULT_BINARY = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "build", "cpp", "perf_worker",
+)
+
+
+def native_worker_available(binary=None):
+    return os.path.exists(binary or _DEFAULT_BINARY)
+
+
+def run_native_worker(url, model_name, *, concurrency, duration_s,
+                      warmup_s=1.0, wire_inputs=(), shm_inputs=(),
+                      shm_outputs=(), binary=None, timeout_s=None):
+    """One fixed-concurrency native measurement.
+
+    wire_inputs: [(name, datatype, shape)] — random bytes generated in the
+    worker.  shm_inputs: [(name, datatype, shape, region, nbytes)].
+    shm_outputs: [(name, region, nbytes)].  Returns the worker's report
+    dict: ok/errors/elapsed_s/throughput/p50_us/.../avg_us.
+    """
+    binary = binary or _DEFAULT_BINARY
+    if not os.path.exists(binary):
+        raise InferenceServerException(
+            f"native perf worker not built: {binary} (run `make`)"
+        )
+    cmd = [binary, "-u", url, "-m", model_name, "-c", str(concurrency),
+           "-d", str(duration_s), "-w", str(warmup_s)]
+    for name, datatype, shape in wire_inputs:
+        dims = ",".join(str(int(d)) for d in shape)
+        cmd += ["--wire-input", f"{name}:{datatype}:{dims}"]
+    for name, datatype, shape, region, nbytes in shm_inputs:
+        dims = ",".join(str(int(d)) for d in shape)
+        cmd += ["--shm-input", f"{name}:{datatype}:{dims}:{region}:{nbytes}"]
+    for name, region, nbytes in shm_outputs:
+        cmd += ["--shm-output", f"{name}:{region}:{nbytes}"]
+    proc = subprocess.run(
+        cmd, capture_output=True, text=True,
+        timeout=timeout_s or (warmup_s + duration_s + 90),
+    )
+    if proc.returncode != 0:
+        raise InferenceServerException(
+            f"native perf worker failed ({proc.returncode}): "
+            f"{proc.stderr.strip() or proc.stdout.strip()}"
+        )
+    try:
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+    except (json.JSONDecodeError, IndexError) as e:
+        raise InferenceServerException(
+            f"malformed native worker report: {proc.stdout!r}"
+        ) from e

@@ -1,0 +1,347 @@
+// Native load-generation worker — the C++ engine behind the perf harness
+// (the role of the reference's perf_analyzer core: perf_analyzer.cc:56-424
+// concurrency manager + concurrency_worker.cc hot loop + async
+// InferContext slots, infer_context.cc:103-150), re-shaped for this
+// framework: N outstanding AsyncInfer contexts multiplexed on ONE
+// HTTP/2 connection and completed by its reactor thread — no GIL, no
+// thread-per-request.  The Python CLI drives it as a subprocess
+// (client_tpu/perf/native_worker.py) and merges its records.
+//
+//   perf_worker -u host:port -m model -c concurrency -d seconds
+//               [-w warmup_seconds] [-b batch]
+//               [--wire-input NAME:DTYPE:d1,d2,...]...
+//               [--shm-input NAME:DTYPE:d1,d2:REGION:NBYTES]...
+//               [--shm-output NAME:REGION:NBYTES]...
+//
+// Prints ONE JSON line:
+//   {"ok": N, "errors": N, "elapsed_s": F, "throughput": F,
+//    "p50_us": F, "p90_us": F, "p95_us": F, "p99_us": F, "avg_us": F}
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <memory>
+#include <mutex>
+#include <random>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "grpc_client.h"
+
+namespace tc = ctpu;
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+struct TensorArg {
+  std::string name;
+  std::string datatype;
+  std::vector<int64_t> shape;
+  std::string region;  // shm variants
+  size_t nbytes = 0;
+};
+
+std::vector<std::string>
+Split(const std::string& s, char sep)
+{
+  std::vector<std::string> out;
+  std::istringstream in(s);
+  std::string part;
+  while (std::getline(in, part, sep)) out.push_back(part);
+  return out;
+}
+
+bool
+ParseTensorArg(const std::string& text, bool shm, bool output, TensorArg* out)
+{
+  const auto parts = Split(text, ':');
+  if (output) {  // NAME:REGION:NBYTES
+    if (parts.size() != 3) return false;
+    out->name = parts[0];
+    out->region = parts[1];
+    out->nbytes = std::stoull(parts[2]);
+    return true;
+  }
+  if (parts.size() != (shm ? 5u : 3u)) return false;
+  out->name = parts[0];
+  out->datatype = parts[1];
+  for (const auto& d : Split(parts[2], ',')) out->shape.push_back(std::stoll(d));
+  if (shm) {
+    out->region = parts[3];
+    out->nbytes = std::stoull(parts[4]);
+  }
+  return true;
+}
+
+size_t
+DtypeSize(const std::string& datatype)
+{
+  if (datatype == "FP64" || datatype == "INT64" || datatype == "UINT64")
+    return 8;
+  if (datatype == "FP32" || datatype == "INT32" || datatype == "UINT32")
+    return 4;
+  if (datatype == "FP16" || datatype == "BF16" || datatype == "INT16" ||
+      datatype == "UINT16")
+    return 2;
+  return 1;
+}
+
+struct Record {
+  int64_t start_ns;
+  int64_t end_ns;
+  bool ok;
+};
+
+class Driver {
+ public:
+  Driver(tc::InferenceServerGrpcClient* client, tc::InferOptions options,
+         std::vector<tc::InferInput*> inputs,
+         std::vector<const tc::InferRequestedOutput*> outputs)
+      : client_(client), options_(std::move(options)),
+        inputs_(std::move(inputs)), outputs_(std::move(outputs))
+  {
+  }
+
+  // Returns false when the drain timed out with requests still in flight
+  // (the caller must not destroy this Driver: the reactor can still fire).
+  bool Run(int concurrency, double warmup_s, double duration_s)
+  {
+    stop_.store(false);
+    const auto t_warm_end =
+        Clock::now() + std::chrono::duration<double>(warmup_s);
+    for (int i = 0; i < concurrency; ++i) Pump();
+    std::this_thread::sleep_until(t_warm_end);
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      records_.clear();  // warmup requests don't count
+    }
+    window_start_ = Now();
+    std::this_thread::sleep_for(std::chrono::duration<double>(duration_s));
+    stop_.store(true);
+    window_end_ = Now();
+    // drain: wait for every outstanding context to complete
+    std::unique_lock<std::mutex> lk(mu_);
+    return drained_.wait_for(
+        lk, std::chrono::seconds(60), [&] { return outstanding_ == 0; });
+  }
+
+  void Report()
+  {
+    std::vector<Record> records;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      records = records_;
+    }
+    std::vector<double> lat_us;
+    size_t ok = 0, errors = 0;
+    for (const auto& r : records) {
+      // count only requests completing inside the window (the profiler's
+      // ValidLatencyMeasurement clip)
+      if (r.end_ns < window_start_ || r.end_ns > window_end_) continue;
+      if (!r.ok) {
+        errors++;
+        continue;
+      }
+      ok++;
+      lat_us.push_back((r.end_ns - r.start_ns) / 1e3);
+    }
+    std::sort(lat_us.begin(), lat_us.end());
+    const double elapsed_s = (window_end_ - window_start_) / 1e9;
+    const auto pct = [&](double p) -> double {
+      if (lat_us.empty()) return 0.0;
+      // nearest-rank: ceil(p/100 * N) - 1, clamped
+      const double rank = p / 100.0 * static_cast<double>(lat_us.size());
+      size_t idx = static_cast<size_t>(rank);
+      if (idx < rank + 1e-9 && idx * 1.0 != rank) idx += 1;  // ceil
+      if (idx > 0) idx -= 1;
+      idx = std::min(idx, lat_us.size() - 1);
+      return lat_us[idx];
+    };
+    double avg = 0;
+    for (const double v : lat_us) avg += v;
+    if (!lat_us.empty()) avg /= lat_us.size();
+    std::printf(
+        "{\"ok\": %zu, \"errors\": %zu, \"elapsed_s\": %.3f, "
+        "\"throughput\": %.2f, \"p50_us\": %.1f, \"p90_us\": %.1f, "
+        "\"p95_us\": %.1f, \"p99_us\": %.1f, \"avg_us\": %.1f}\n",
+        ok, errors, elapsed_s, elapsed_s > 0 ? ok / elapsed_s : 0.0,
+        pct(50), pct(90), pct(95), pct(99), avg);
+  }
+
+ private:
+  static int64_t Now()
+  {
+    return std::chrono::duration_cast<std::chrono::nanoseconds>(
+               Clock::now().time_since_epoch())
+        .count();
+  }
+
+  // (Re)arm one slot.  Iterative: a synchronous AsyncInfer failure (e.g.
+  // the server died and reconnects keep failing) records the error, backs
+  // off, and retries in THIS loop — never by recursion through Complete,
+  // which would grow the stack one frame pair per failed attempt.
+  void Pump()
+  {
+    while (!stop_.load()) {
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        outstanding_++;
+      }
+      const int64_t start = Now();
+      tc::Error err = client_->AsyncInfer(
+          [this, start](tc::InferResultPtr result) {
+            Complete(start, result->RequestStatus().IsOk());
+          },
+          options_, inputs_, outputs_);
+      if (err.IsOk()) return;  // armed; its completion re-enters Pump once
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        records_.push_back({start, Now(), false});
+        outstanding_--;
+        if (outstanding_ == 0) drained_.notify_all();
+      }
+      std::this_thread::sleep_for(std::chrono::milliseconds(2));
+    }
+  }
+
+  void Complete(int64_t start, bool ok)
+  {
+    bool resubmit;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      records_.push_back({start, Now(), ok});
+      outstanding_--;
+      resubmit = !stop_.load();
+      if (outstanding_ == 0) drained_.notify_all();
+    }
+    // keep the slot occupied: completion immediately re-arms the context
+    // (concurrency_worker.cc's hot loop)
+    if (resubmit) Pump();
+  }
+
+  tc::InferenceServerGrpcClient* client_;
+  tc::InferOptions options_;
+  std::vector<tc::InferInput*> inputs_;
+  std::vector<const tc::InferRequestedOutput*> outputs_;
+  std::mutex mu_;
+  std::condition_variable drained_;
+  std::vector<Record> records_;
+  int outstanding_ = 0;
+  std::atomic<bool> stop_{false};
+  int64_t window_start_ = 0;
+  int64_t window_end_ = 0;
+};
+
+}  // namespace
+
+int
+main(int argc, char** argv)
+{
+  std::string url = "localhost:8001";
+  std::string model;
+  int concurrency = 1;
+  double duration_s = 5.0, warmup_s = 1.0;
+  std::vector<TensorArg> wire_inputs, shm_inputs, shm_outputs;
+  for (int i = 1; i < argc; ++i) {
+    const std::string arg = argv[i];
+    const auto next = [&]() -> const char* {
+      return i + 1 < argc ? argv[++i] : "";
+    };
+    if (arg == "-u") {
+      url = next();
+    } else if (arg == "-m") {
+      model = next();
+    } else if (arg == "-c") {
+      concurrency = std::stoi(next());
+    } else if (arg == "-d") {
+      duration_s = std::stod(next());
+    } else if (arg == "-w") {
+      warmup_s = std::stod(next());
+    } else if (arg == "--wire-input" || arg == "--shm-input" ||
+               arg == "--shm-output") {
+      TensorArg tensor;
+      const bool shm = arg != "--wire-input";
+      const bool output = arg == "--shm-output";
+      if (!ParseTensorArg(next(), shm, output, &tensor)) {
+        std::fprintf(stderr, "malformed %s\n", arg.c_str());
+        return 2;
+      }
+      (output ? shm_outputs : (shm ? shm_inputs : wire_inputs))
+          .push_back(std::move(tensor));
+    } else {
+      std::fprintf(stderr, "unknown argument %s\n", arg.c_str());
+      return 2;
+    }
+  }
+  if (model.empty()) {
+    std::fprintf(stderr, "usage: perf_worker -u url -m model -c N -d secs\n");
+    return 2;
+  }
+
+  std::unique_ptr<tc::InferenceServerGrpcClient> client;
+  tc::Error err = tc::InferenceServerGrpcClient::Create(&client, url);
+  if (!err.IsOk()) {
+    std::fprintf(stderr, "create failed: %s\n", err.Message().c_str());
+    return 1;
+  }
+
+  // prepared request objects, reused for every send (the reference prepares
+  // infer data once per context)
+  std::vector<std::unique_ptr<tc::InferInput>> owned_inputs;
+  std::vector<std::string> payloads;
+  std::vector<tc::InferInput*> inputs;
+  std::mt19937 rng(42);
+  for (const auto& tensor : wire_inputs) {
+    size_t elems = 1;
+    for (const int64_t d : tensor.shape) elems *= static_cast<size_t>(d);
+    payloads.emplace_back();
+    std::string& payload = payloads.back();
+    payload.resize(elems * DtypeSize(tensor.datatype));
+    for (char& b : payload) b = static_cast<char>(rng() & 0x3f);
+    auto input = std::make_unique<tc::InferInput>(
+        tensor.name, tensor.shape, tensor.datatype);
+    input->AppendRaw(
+        reinterpret_cast<const uint8_t*>(payload.data()), payload.size());
+    inputs.push_back(input.get());
+    owned_inputs.push_back(std::move(input));
+  }
+  for (const auto& tensor : shm_inputs) {
+    auto input = std::make_unique<tc::InferInput>(
+        tensor.name, tensor.shape, tensor.datatype);
+    input->SetSharedMemory(tensor.region, tensor.nbytes);
+    inputs.push_back(input.get());
+    owned_inputs.push_back(std::move(input));
+  }
+  std::vector<std::unique_ptr<tc::InferRequestedOutput>> owned_outputs;
+  std::vector<const tc::InferRequestedOutput*> outputs;
+  for (const auto& tensor : shm_outputs) {
+    auto output = std::make_unique<tc::InferRequestedOutput>(tensor.name);
+    output->SetSharedMemory(tensor.region, tensor.nbytes);
+    outputs.push_back(output.get());
+    owned_outputs.push_back(std::move(output));
+  }
+  if (inputs.empty()) {
+    std::fprintf(stderr, "no inputs configured\n");
+    return 2;
+  }
+
+  tc::InferOptions options(model);
+  Driver driver(client.get(), options, inputs, outputs);
+  const bool drained = driver.Run(concurrency, warmup_s, duration_s);
+  driver.Report();
+  if (!drained) {
+    // requests still in flight: the reactor may yet fire completions that
+    // touch the Driver — skip destructors entirely rather than free state
+    // under a live callback (and signal the partial drain to the caller)
+    std::fprintf(stderr, "warning: drain timed out; exiting hard\n");
+    std::fflush(stdout);
+    std::_Exit(3);
+  }
+  return 0;
+}

@@ -160,3 +160,27 @@ def test_memory_leak_suite(dual_server):
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "PASS: memory_leak_test" in proc.stdout
+
+
+@needs_grpc_cpp
+def test_native_perf_worker(dual_server):
+    """The native C++ load engine (build/cpp/perf_worker — the reference
+    perf_analyzer's async-InferContext load shape) drives a live server and
+    reports sane JSON through the python driver."""
+    from client_tpu.perf.native_worker import (
+        native_worker_available,
+        run_native_worker,
+    )
+
+    if not native_worker_available():
+        pytest.skip("perf_worker not built")
+    report = run_native_worker(
+        dual_server.grpc_address, "simple",
+        concurrency=8, duration_s=1.5, warmup_s=0.3,
+        wire_inputs=[("INPUT0", "INT32", [1, 16]),
+                     ("INPUT1", "INT32", [1, 16])],
+    )
+    assert report["errors"] == 0
+    assert report["ok"] > 50
+    assert report["throughput"] > 0
+    assert 0 < report["p50_us"] <= report["p99_us"]
