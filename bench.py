@@ -254,12 +254,16 @@ def _run_tpu_shm_native(server, concurrency=CONCURRENCY):
             for name, region, nbytes in output_specs
             if region
         ]
-        report = run_native_worker(
-            server.grpc_address, "cnn_classifier",
-            concurrency=concurrency, duration_s=MEASURE_S,
-            warmup_s=WARMUP_S, shm_inputs=shm_inputs,
-            shm_outputs=shm_outputs,
-        )
+        try:
+            report = run_native_worker(
+                server.grpc_address, "cnn_classifier",
+                concurrency=concurrency, duration_s=MEASURE_S,
+                warmup_s=WARMUP_S, shm_inputs=shm_inputs,
+                shm_outputs=shm_outputs,
+            )
+        except Exception as e:  # crash/drain-timeout: python headline stands
+            print(f"native worker unavailable: {e}", file=sys.stderr)
+            return None
         h.data_manager.sync_outputs()  # drain: completed device work only
         # no duty cycle here: the observable span would include subprocess
         # spawn/connect/drain, which is not comparable to the windowed
@@ -484,6 +488,10 @@ def main():
     finally:
         server.stop()
 
+    # Headline instrument: the native C++ worker when built (GIL-free async
+    # contexts — measures the SERVER, not the client); the python-harness
+    # number stays alongside as sp_* for r1-r3 comparability.
+    headline = tpu_nw if tpu_nw else tpu
     image_bytes = 3 * IMAGE_SIZE * IMAGE_SIZE * 4
     # Ceiling = the better of the probe estimate and what the wire path
     # itself achieved: a serial 20MB probe can under-read a fluctuating
@@ -493,15 +501,24 @@ def main():
     wire_ceiling = max(link["link_h2d_mbps"], achieved_mbps) * 1e6 / image_bytes
     result = {
         "metric": "infer_throughput_cnn224_grpc_tpushm",
-        "value": round(tpu["infer_per_sec"], 2),
+        "value": round(headline["infer_per_sec"], 2),
         "unit": "infer/sec",
-        "vs_baseline": round(tpu["infer_per_sec"] / _REF_INFER_PER_SEC, 3),
-        "harness": "client_tpu.perf profile_completion (drain-corrected)",
-        "p50_ms": round(tpu["p50_ms"], 3),
-        "p99_ms": round(tpu["p99_ms"], 3),
-        "requests": tpu["n"],
+        "vs_baseline": round(
+            headline["infer_per_sec"] / _REF_INFER_PER_SEC, 3
+        ),
+        "harness": (
+            "native perf_worker (async InferContexts, drain-synced)"
+            if tpu_nw else
+            "client_tpu.perf profile_completion (drain-corrected)"
+        ),
+        "p50_ms": round(headline["p50_ms"], 3),
+        "p99_ms": round(headline["p99_ms"], 3),
+        "requests": headline["n"],
         "concurrency": CONCURRENCY,
         "duty_cycle_pct": tpu["duty_cycle_pct"],
+        # python-harness instrument (the r1-r3 headline), same config
+        "sp_infer_per_sec": round(tpu["infer_per_sec"], 2),
+        "sp_p50_ms": round(tpu["p50_ms"], 3),
         # NATIVE C++ load generation (build/cpp/perf_worker): async
         # InferContexts on one multiplexed connection, no GIL in the
         # instrument — the strongest measure of what the server sustains

@@ -8,7 +8,7 @@
 // (client_tpu/perf/native_worker.py) and merges its records.
 //
 //   perf_worker -u host:port -m model -c concurrency -d seconds
-//               [-w warmup_seconds] [-b batch]
+//               [-w warmup_seconds]
 //               [--wire-input NAME:DTYPE:d1,d2,...]...
 //               [--shm-input NAME:DTYPE:d1,d2:REGION:NBYTES]...
 //               [--shm-output NAME:REGION:NBYTES]...
@@ -17,6 +17,7 @@
 //   {"ok": N, "errors": N, "elapsed_s": F, "throughput": F,
 //    "p50_us": F, "p90_us": F, "p95_us": F, "p99_us": F, "avg_us": F}
 #include <algorithm>
+#include <cmath>
 #include <atomic>
 #include <chrono>
 #include <condition_variable>
@@ -115,7 +116,17 @@ class Driver {
     stop_.store(false);
     const auto t_warm_end =
         Clock::now() + std::chrono::duration<double>(warmup_s);
-    for (int i = 0; i < concurrency; ++i) Pump();
+    // ALL submissions run on this pump thread, never on the connection's
+    // reactor thread: a completion callback that re-armed inline would run
+    // SendData on the reader — which must stay free to process the
+    // WINDOW_UPDATE frames SendData waits for (self-deadlock for any body
+    // larger than the h2 flow-control window).
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      rearm_pending_ = concurrency;
+    }
+    pump_ = std::thread([this] { PumpLoop(); });
+    pump_cv_.notify_all();
     std::this_thread::sleep_until(t_warm_end);
     {
       std::lock_guard<std::mutex> lk(mu_);
@@ -125,7 +136,13 @@ class Driver {
     std::this_thread::sleep_for(std::chrono::duration<double>(duration_s));
     stop_.store(true);
     window_end_ = Now();
-    // drain: wait for every outstanding context to complete
+    // stop the pump first: after it joins, nothing submits anymore ...
+    pump_cv_.notify_all();
+    if (pump_.joinable()) pump_.join();
+    // ... then drain every outstanding context.  Completions touch members
+    // only under mu_, and the final unlock happens-before this wait
+    // observes outstanding_ == 0, so returning (and destroying the Driver)
+    // after a successful drain is safe.
     std::unique_lock<std::mutex> lk(mu_);
     return drained_.wait_for(
         lk, std::chrono::seconds(60), [&] { return outstanding_ == 0; });
@@ -156,12 +173,10 @@ class Driver {
     const auto pct = [&](double p) -> double {
       if (lat_us.empty()) return 0.0;
       // nearest-rank: ceil(p/100 * N) - 1, clamped
-      const double rank = p / 100.0 * static_cast<double>(lat_us.size());
-      size_t idx = static_cast<size_t>(rank);
-      if (idx < rank + 1e-9 && idx * 1.0 != rank) idx += 1;  // ceil
-      if (idx > 0) idx -= 1;
-      idx = std::min(idx, lat_us.size() - 1);
-      return lat_us[idx];
+      const double rank =
+          std::ceil(p / 100.0 * static_cast<double>(lat_us.size()));
+      const size_t idx = rank >= 1.0 ? static_cast<size_t>(rank) - 1 : 0;
+      return lat_us[std::min(idx, lat_us.size() - 1)];
     };
     double avg = 0;
     for (const double v : lat_us) avg += v;
@@ -182,15 +197,20 @@ class Driver {
         .count();
   }
 
-  // (Re)arm one slot.  Iterative: a synchronous AsyncInfer failure (e.g.
-  // the server died and reconnects keep failing) records the error, backs
-  // off, and retries in THIS loop — never by recursion through Complete,
-  // which would grow the stack one frame pair per failed attempt.
-  void Pump()
+  // Pump thread: arms a slot whenever a completion (or startup) leaves one
+  // empty.  A synchronous AsyncInfer failure (server died, reconnects keep
+  // failing) records the error and retries after a backoff — iteratively,
+  // on this thread, never on the reactor.
+  void PumpLoop()
   {
-    while (!stop_.load()) {
+    while (true) {
       {
-        std::lock_guard<std::mutex> lk(mu_);
+        std::unique_lock<std::mutex> lk(mu_);
+        pump_cv_.wait(lk, [&] {
+          return rearm_pending_ > 0 || stop_.load();
+        });
+        if (stop_.load()) return;
+        rearm_pending_--;
         outstanding_++;
       }
       const int64_t start = Now();
@@ -199,11 +219,12 @@ class Driver {
             Complete(start, result->RequestStatus().IsOk());
           },
           options_, inputs_, outputs_);
-      if (err.IsOk()) return;  // armed; its completion re-enters Pump once
+      if (err.IsOk()) continue;
       {
         std::lock_guard<std::mutex> lk(mu_);
         records_.push_back({start, Now(), false});
         outstanding_--;
+        rearm_pending_++;  // the slot still needs arming
         if (outstanding_ == 0) drained_.notify_all();
       }
       std::this_thread::sleep_for(std::chrono::milliseconds(2));
@@ -212,17 +233,16 @@ class Driver {
 
   void Complete(int64_t start, bool ok)
   {
-    bool resubmit;
-    {
-      std::lock_guard<std::mutex> lk(mu_);
-      records_.push_back({start, Now(), ok});
-      outstanding_--;
-      resubmit = !stop_.load();
-      if (outstanding_ == 0) drained_.notify_all();
+    std::lock_guard<std::mutex> lk(mu_);
+    records_.push_back({start, Now(), ok});
+    outstanding_--;
+    if (!stop_.load()) {
+      // hand the empty slot to the pump thread (concurrency_worker.cc's
+      // hot loop, minus the reactor-thread re-arm hazard)
+      rearm_pending_++;
+      pump_cv_.notify_one();
     }
-    // keep the slot occupied: completion immediately re-arms the context
-    // (concurrency_worker.cc's hot loop)
-    if (resubmit) Pump();
+    if (outstanding_ == 0) drained_.notify_all();
   }
 
   tc::InferenceServerGrpcClient* client_;
@@ -231,8 +251,11 @@ class Driver {
   std::vector<const tc::InferRequestedOutput*> outputs_;
   std::mutex mu_;
   std::condition_variable drained_;
+  std::condition_variable pump_cv_;
+  std::thread pump_;
   std::vector<Record> records_;
   int outstanding_ = 0;
+  int rearm_pending_ = 0;
   std::atomic<bool> stop_{false};
   int64_t window_start_ = 0;
   int64_t window_end_ = 0;
