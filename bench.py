@@ -539,6 +539,12 @@ def main():
         "b8_request_p50_ms": round(tpu_b8["p50_ms"], 3),
         "c4_infer_per_sec": round(tpu_c4["infer_per_sec"], 2),
         "c4_p50_ms": round(tpu_c4["p50_ms"], 3),
+        # Trajectory note (VERDICT r3 weak #1): the r1/r2 c4 headlines were
+        # ack-rate through profile_concurrency's time windows with NO drain
+        # correction — dispatch acks counted as completions, overstating
+        # low-concurrency throughput.  Every r3+ figure above is
+        # drain-corrected profile_completion; compare across r3+ only.
+        "c4_note": "r1/r2 c4 were ack-based (drain-inflated); r3+ drain-corrected",
         "sync_infer_per_sec": round(tpu_sync["infer_per_sec"], 2),
         "sync_p50_ms": round(tpu_sync["p50_ms"], 3),
         "sync_p99_ms": round(tpu_sync["p99_ms"], 3),
