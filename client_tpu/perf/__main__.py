@@ -48,6 +48,11 @@ def build_parser():
     p.add_argument("-a", "--async", dest="async_mode", action="store_true",
                    help="async concurrency slots on one event loop over "
                         "grpc.aio (reference -a; stateless gRPC only)")
+    p.add_argument("--native-loadgen", action="store_true",
+                   help="generate load with the native C++ engine "
+                        "(build/cpp/perf_worker: async InferContexts on one "
+                        "connection, no GIL in the instrument); concurrency "
+                        "mode over socket gRPC, wire or TPU-shm inputs")
     p.add_argument("--service-kind",
                    choices=["triton", "torchserve", "tfserve",
                             "tfserve_rest"],
@@ -147,6 +152,71 @@ def build_parser():
                    help="rank-0 coordinator host:port")
     p.add_argument("-v", "--verbose", action="store_true")
     return p
+
+
+def _run_native_loadgen(args, control, loader, data_manager):
+    """Concurrency sweep driven by the native C++ engine (perf_worker):
+    region setup and metadata live here (this process owns jax); the
+    measurement loop is pure C++."""
+    from client_tpu.perf.infer_data import _ShmInferDataManagerBase
+    from client_tpu.perf.native_worker import run_native_worker
+    from client_tpu.utils import np_to_triton_dtype
+
+    try:
+        wire_inputs, shm_inputs, shm_outputs = [], [], []
+        step0 = loader.get_input_data(0, 0)
+        if isinstance(data_manager, _ShmInferDataManagerBase):
+            for name, td in step0.items():
+                region, nbytes = data_manager._regions[(0, 0, name)]
+                shm_inputs.append((
+                    name, np_to_triton_dtype(td.array.dtype),
+                    list(td.array.shape), region, nbytes,
+                ))
+            for name, (region, nbytes) in data_manager._out_regions.items():
+                shm_outputs.append((name, region, nbytes))
+        else:
+            for name, td in step0.items():
+                wire_inputs.append((
+                    name, np_to_triton_dtype(td.array.dtype),
+                    list(td.array.shape),
+                ))
+
+        start, end, step = _parse_range(args.concurrency_range or "1", int)
+        duration_s = max(args.measurement_interval / 1e3, 0.5)
+        best = None
+        errors = 0
+        c = start
+        while c <= end:
+            report = run_native_worker(
+                args.url, args.model_name, concurrency=c,
+                duration_s=duration_s, warmup_s=1.0,
+                wire_inputs=wire_inputs, shm_inputs=shm_inputs,
+                shm_outputs=shm_outputs,
+            )
+            errors += report["errors"]
+            print(
+                f"Concurrency: {c}, throughput: "
+                f"{report['throughput']:.1f} infer/sec (native), "
+                f"p50 {report['p50_us']:.0f} usec, "
+                f"p99 {report['p99_us']:.0f} usec, "
+                f"errors {report['errors']}"
+            )
+            if best is None or report["throughput"] > best[1]["throughput"]:
+                best = (c, report)
+            c += step
+        if best is not None:
+            print(
+                f"Best: concurrency={best[0]} -> "
+                f"{best[1]['throughput']:.1f} infer/sec, "
+                f"avg latency {best[1]['avg_us']:.0f} usec"
+            )
+        return 0 if best is not None and errors == 0 else 1
+    finally:
+        data_manager.cleanup()
+        try:
+            control.close()
+        except Exception:
+            pass
 
 
 def main(argv=None):
@@ -316,6 +386,39 @@ def main(argv=None):
                                 or args.request_rate_range):
             sys.exit("error: --async applies to concurrency mode only "
                      "(request-rate/interval schedules use worker threads)")
+        if args.native_loadgen:
+            if (args.hermetic or kind != BackendKind.TRITON_GRPC
+                    or args.sequence or args.async_mode
+                    or args.request_intervals or args.request_rate_range):
+                sys.exit("error: --native-loadgen is concurrency mode over "
+                         "a socket gRPC server, stateless, sync CLI path")
+            # modes the native sweep does not implement fail LOUDLY rather
+            # than silently measuring something else
+            unsupported = [
+                ("-f/--filename", args.filename),
+                ("--latency-threshold", args.latency_threshold),
+                ("--binary-search", args.binary_search),
+                ("--collect-metrics", args.collect_metrics),
+                ("--world-size > 1", args.world_size > 1),
+                ("--measurement-mode count_windows",
+                 args.measurement_mode == "count_windows"),
+            ]
+            offending = [name for name, on in unsupported if on]
+            if offending:
+                sys.exit("error: --native-loadgen does not support: "
+                         + ", ".join(offending))
+            if args.shared_memory == "none" and args.input_data not in (
+                    None, "random"):
+                sys.exit("error: --native-loadgen wire mode generates "
+                         "random tensor bytes; custom --input-data is "
+                         "honored via --shared-memory system/tpu (regions "
+                         "are staged with the real data)")
+            if (loader.num_streams != 1 or loader.num_steps(0) != 1):
+                sys.exit("error: --native-loadgen repeats one fixed request "
+                         "(stream 0, step 0); dataset rotation needs the "
+                         "python load engine")
+            return _run_native_loadgen(args, control, loader, data_manager)
+
         if args.request_intervals:
             manager = CustomLoadManager(
                 intervals_file=args.request_intervals, **common

@@ -184,3 +184,25 @@ def test_native_perf_worker(dual_server):
     assert report["ok"] > 50
     assert report["throughput"] > 0
     assert 0 < report["p50_us"] <= report["p99_us"]
+
+
+@needs_grpc_cpp
+def test_perf_cli_native_loadgen(dual_server):
+    """`python -m client_tpu.perf --native-loadgen` sweeps concurrency with
+    the C++ engine (region setup python-side, measurement loop native)."""
+    import subprocess
+    import sys
+
+    from client_tpu.perf.native_worker import native_worker_available
+
+    if not native_worker_available():
+        pytest.skip("perf_worker not built")
+    proc = subprocess.run(
+        [sys.executable, "-m", "client_tpu.perf", "-m", "simple",
+         "-u", dual_server.grpc_address, "--native-loadgen",
+         "--concurrency-range", "2:4:2", "--measurement-interval", "600"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "(native)" in proc.stdout
+    assert "Best: concurrency=" in proc.stdout
