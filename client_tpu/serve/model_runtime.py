@@ -100,6 +100,7 @@ class Model:
         fused_batching=False,
         max_fused_arity=8,
         ensemble_steps=None,
+        flops_per_item=None,
     ):
         self.name = name
         self.inputs = list(inputs)
@@ -126,6 +127,10 @@ class Model:
         # "output_map" {composing->ensemble tensor}}].  fn is ignored; the
         # engine chains the composing models (execute -> per-model stats).
         self.ensemble_steps = list(ensemble_steps or [])
+        # FLOPs of one forward item (batch row) — lets harnesses report
+        # achieved TFLOP/s and MFU (reference perf_analyzer reports only
+        # protocol rates; compute accounting is a TPU-charter addition).
+        self.flops_per_item = flops_per_item
         self.config_override = None  # set by repository load with config param
         self.file_overrides = {}
 
@@ -169,6 +174,11 @@ class Model:
             cfg["model_transaction_policy"] = {"decoupled": True}
         if self.stateful:
             cfg["sequence_batching"] = {"max_sequence_idle_microseconds": 60000000}
+        if self.flops_per_item:
+            # Triton-style config parameters map (string_value entries)
+            cfg["parameters"] = {
+                "flops_per_item": {"string_value": str(int(self.flops_per_item))}
+            }
         if self.ensemble_steps:
             cfg["ensemble_scheduling"] = {
                 "step": [

@@ -1,9 +1,9 @@
 """JAX/TPU model zoo for the in-process server (flagship models).
 
-``model_sets("builtin,jax,language")`` is the single set-name resolver used
-by the serve and perf CLIs; ``jax_models()`` is the vision set used by
-bench.py, ``language_models()`` the tokenizer→streaming-LM stack of BASELINE
-config 5.
+``model_sets("builtin,jax,resnet,language")`` is the single set-name resolver
+used by the serve and perf CLIs; ``jax_models()`` is the small-CNN vision set
+used by bench.py, ``resnet_models()`` the resnet50 of BASELINE config 3, and
+``language_models()`` the tokenizer→streaming-LM stack of BASELINE config 5.
 """
 
 from client_tpu.utils import InferenceServerException
@@ -14,18 +14,24 @@ def jax_models():
     return [cnn_classifier_model()]
 
 
+def resnet_models():
+    from client_tpu.serve.models.vision import resnet50_model
+    return [resnet50_model()]
+
+
 def language_models():
     from client_tpu.serve.models.language import language_models as _lm
     return _lm()
 
 
 def model_sets(names):
-    """Resolve a comma-separated set list (builtin,jax,language) to models."""
+    """Resolve a comma-separated set list (builtin,jax,resnet,language)."""
     from client_tpu.serve.builtins import default_models
 
     loaders = {
         "builtin": default_models,
         "jax": jax_models,
+        "resnet": resnet_models,
         "language": language_models,
     }
     models = []

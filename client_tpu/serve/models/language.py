@@ -122,7 +122,7 @@ class _LmRunner:
         key = jax.random.PRNGKey(seed) if temperature > 0 else None
         for tok in tfm.generate(
             self.params, self.cfg, tokens, max_tokens,
-            temperature=temperature, key=key,
+            temperature=temperature, key=key, stop_tokens=(_EOS,),
         ):
             yield tok
             if tok == _EOS:

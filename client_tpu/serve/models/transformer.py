@@ -22,6 +22,7 @@ the decode loop is a fixed-shape program with `lax.dynamic_update_slice` cache
 writes; sharding is annotation-only (GSPMD inserts the collectives).
 """
 
+import collections
 import dataclasses
 import functools
 
@@ -496,12 +497,31 @@ def _jitted_steps(cfg):
     )
 
 
-def generate(params, cfg, prompt, max_new_tokens, temperature=0.0, key=None):
+def generate(params, cfg, prompt, max_new_tokens, temperature=0.0, key=None,
+             readback_depth=8, stop_tokens=()):
     """Greedy/sampled generation; yields one int token id at a time.
 
     Python-level loop over jitted prefill/decode steps — each yield maps to
     one decoupled KServe response in the streaming serving path.  Generation
-    stops early if the KV cache fills (prompt_len + new tokens > cfg.max_seq).
+    stops early if the KV cache fills (prompt_len + new tokens > cfg.max_seq)
+    or a ``stop_tokens`` id is produced (the stop token is still yielded).
+
+    The decode loop is pipelined: step i's token is selected on device and
+    its D2H copy started with ``copy_to_host_async`` while decode step i+1
+    is dispatched, keeping up to ``readback_depth`` readbacks in flight.
+    Token selection stays on device, so the compute schedule — and the token
+    stream — is identical to the serial order (``readback_depth=0``); only
+    the host-side readback is deferred.  Over a high-RTT link this lifts the
+    per-token cost from one full round trip (the blocking ``np.asarray`` in
+    the serial loop) to ~RTT/depth, and on a local chip it overlaps readback
+    with decode compute.
+
+    Cost of the pipeline: a stop token is only *known* on host one readback
+    latency after its decode step ran, so up to ``readback_depth`` decode
+    steps past the stop get dispatched and discarded.  That waste is
+    information-theoretic for any scheme that keeps the link busy (the host
+    cannot know sooner), and bounded by depth; ``readback_depth=0`` restores
+    the strict serial no-waste schedule.
     """
     prompt = jnp.asarray(prompt, jnp.int32)
     if prompt.ndim == 1:
@@ -514,6 +534,9 @@ def generate(params, cfg, prompt, max_new_tokens, temperature=0.0, key=None):
     cache = init_cache(cfg, prompt.shape[0])
     prefill_fn, decode_fn = _jitted_steps(cfg)
     logits, cache = prefill_fn(params, prompt, cache=cache)
+    depth = max(int(readback_depth), 0)
+    stop = frozenset(int(t) for t in stop_tokens)
+    pending = collections.deque()
     for i in range(max_new_tokens):
         if temperature > 0.0:
             key, sub = jax.random.split(key)
@@ -521,5 +544,18 @@ def generate(params, cfg, prompt, max_new_tokens, temperature=0.0, key=None):
         else:
             token = jnp.argmax(logits, axis=-1)
         token = token.astype(jnp.int32)
-        yield int(np.asarray(token)[0])
-        logits, cache = decode_fn(params, token, cache=cache)
+        if hasattr(token, "copy_to_host_async"):
+            token.copy_to_host_async()
+        pending.append(token)
+        if i + 1 < max_new_tokens:
+            logits, cache = decode_fn(params, token, cache=cache)
+        while len(pending) > depth:
+            t = int(np.asarray(pending.popleft())[0])
+            yield t
+            if t in stop:
+                return  # stop dispatching; in-flight steps are discarded
+    while pending:
+        t = int(np.asarray(pending.popleft())[0])
+        yield t
+        if t in stop:
+            return

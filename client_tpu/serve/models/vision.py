@@ -62,6 +62,22 @@ def _cnn_forward(params, x):
     return (h @ params["head"]).astype(jnp.float32)
 
 
+def _conv_flops(out_ch, in_ch, kh, kw, out_h, out_w):
+    # one MAC = 2 FLOPs; elementwise (relu/scale/add) is noise next to this
+    return 2 * out_ch * in_ch * kh * kw * out_h * out_w
+
+
+def cnn_flops_per_image(image_size=224, channels=(32, 64, 128, 256),
+                        in_ch=3, num_classes=_NUM_CLASSES):
+    """Analytic forward FLOPs for one image through the small CNN."""
+    flops, hw, prev = 0, image_size, in_ch
+    for ch in channels:
+        hw = (hw + 1) // 2  # stride-2 SAME conv
+        flops += _conv_flops(ch, prev, 3, 3, hw, hw)
+        prev = ch
+    return flops + 2 * prev * num_classes
+
+
 class CnnClassifier:
     """Jitted CNN classifier servable; accepts any batch of 224x224 RGB."""
 
@@ -101,4 +117,160 @@ def cnn_classifier_model(
         batch_device_inputs=True,
         fused_batching=True,
         max_fused_arity=16,
+        flops_per_item=cnn_flops_per_image(image_size),
+    )
+
+
+# ---------------------------------------------------------------------------
+# ResNet-50 (BASELINE.md config 3: perf_analyzer concurrency sweep on
+# resnet50 with TPU HBM input tensors).  Real bottleneck residual blocks at
+# the standard [3,4,6,3] depth — 4.09 GMACs = ~8.2 GFLOP per 224x224 image
+# (the commonly cited "4.1 GFLOPs" counts MACs), so a
+# throughput number on this model is a *compute* statement (MFU), not a
+# protocol statement.  Inference-only: batch norm folds into the per-channel
+# scales (s1..s3, stem_scale) at serving time.
+# ---------------------------------------------------------------------------
+
+# Single source of stage geometry: (mid_channels, n_blocks, first_stride)
+# per stage.  _init_resnet_params, _resnet_forward and
+# resnet50_flops_per_image all derive from this — change it in one place.
+_RESNET50_STAGES = ((64, 3, 1), (128, 4, 2), (256, 6, 2), (512, 3, 2))
+
+
+def _he(key, shape, fan_in):
+    return jax.random.normal(key, shape, jnp.bfloat16) * float(
+        np.sqrt(2.0 / fan_in)
+    )
+
+
+def _init_resnet_params(key, in_ch=3, num_classes=_NUM_CLASSES,
+                        stages=_RESNET50_STAGES):
+    """Bottleneck ResNet-50 parameters: stem 7x7/2 + maxpool, then stages of
+    (mid_ch, n_blocks, first_stride) bottlenecks (1x1 -> 3x3 -> 1x1 with a
+    4x expansion), ending in a 1000-way linear head."""
+    keys = iter(jax.random.split(key, 256))
+    params = {
+        "stem": _he(next(keys), (64, in_ch, 7, 7), in_ch * 49),
+        "stem_scale": jnp.ones((64, 1, 1), jnp.bfloat16),
+        "stages": [],
+    }
+    prev = 64
+    for mid, n_blocks, first_stride in stages:
+        out = mid * 4
+        blocks = []
+        for b in range(n_blocks):
+            stride = first_stride if b == 0 else 1
+            block = {
+                "w1": _he(next(keys), (mid, prev, 1, 1), prev),
+                "s1": jnp.ones((mid, 1, 1), jnp.bfloat16),
+                "w2": _he(next(keys), (mid, mid, 3, 3), mid * 9),
+                "s2": jnp.ones((mid, 1, 1), jnp.bfloat16),
+                "w3": _he(next(keys), (out, mid, 1, 1), mid),
+                "s3": jnp.ones((out, 1, 1), jnp.bfloat16),
+            }
+            if prev != out or stride != 1:
+                block["proj"] = _he(next(keys), (out, prev, 1, 1), prev)
+            blocks.append(block)
+            prev = out
+        params["stages"].append(blocks)
+    params["head_w"] = _he(next(keys), (prev, num_classes), prev)
+    params["head_b"] = jnp.zeros((num_classes,), jnp.bfloat16)
+    return params
+
+
+def _bottleneck(block, x, stride):
+    h = jax.nn.relu(_conv(x, block["w1"]) * block["s1"])
+    h = jax.nn.relu(_conv(h, block["w2"], stride=stride) * block["s2"])
+    h = _conv(h, block["w3"]) * block["s3"]
+    skip = x if "proj" not in block else _conv(x, block["proj"], stride=stride)
+    return jax.nn.relu(h + skip)
+
+
+def _resnet_forward(params, x, stage_strides=None):
+    # strides are structural (static under jit tracing), not pytree leaves —
+    # conv window_strides must be concrete.  Custom-`stages` params need a
+    # matching stage_strides; the default follows _RESNET50_STAGES.
+    strides = stage_strides or tuple(s for _, _, s in _RESNET50_STAGES)
+    # x: [N, 3, H, W] float32 -> scores [N, num_classes] float32
+    h = x.astype(jnp.bfloat16)
+    h = jax.nn.relu(_conv(h, params["stem"], stride=2) * params["stem_scale"])
+    h = jax.lax.reduce_window(
+        h, -jnp.inf, jax.lax.max,
+        window_dimensions=(1, 1, 3, 3),
+        window_strides=(1, 1, 2, 2),
+        padding="SAME",
+    )
+    for si, blocks in enumerate(params["stages"]):
+        for bi, block in enumerate(blocks):
+            h = _bottleneck(block, h, strides[si] if bi == 0 else 1)
+    h = jnp.mean(h, axis=(2, 3))
+    return (h @ params["head_w"] + params["head_b"]).astype(jnp.float32)
+
+
+def resnet50_flops_per_image(image_size=224, in_ch=3,
+                             num_classes=_NUM_CLASSES,
+                             stages=_RESNET50_STAGES):
+    """Analytic forward FLOPs for one image, 2*MAC convention (convs +
+    head): ~8.18e9 for 224px — i.e. 4.09 GMACs, matching torchvision's
+    resnet50 profile.  MFU divides this by a peak quoted in FLOP/s, so the
+    2*MAC convention is the consistent numerator."""
+    def conv_out(hw, stride):
+        return (hw + stride - 1) // stride
+
+    flops = 0
+    hw = conv_out(image_size, 2)  # stem 7x7/2
+    flops += _conv_flops(64, in_ch, 7, 7, hw, hw)
+    hw = conv_out(hw, 2)  # maxpool/2
+    prev = 64
+    for mid, n_blocks, first_stride in stages:
+        out = mid * 4
+        for b in range(n_blocks):
+            stride = first_stride if b == 0 else 1
+            # 1x1 reduce runs at the INPUT resolution, the 3x3 at the output
+            flops += _conv_flops(mid, prev, 1, 1, hw, hw)
+            hw_out = conv_out(hw, stride)
+            flops += _conv_flops(mid, mid, 3, 3, hw_out, hw_out)
+            flops += _conv_flops(out, mid, 1, 1, hw_out, hw_out)
+            if prev != out or stride != 1:
+                flops += _conv_flops(out, prev, 1, 1, hw_out, hw_out)
+            prev = out
+            hw = hw_out
+    return flops + 2 * prev * num_classes
+
+
+class ResNet50Classifier:
+    """Jitted bottleneck ResNet-50 servable (~8.2 GFLOP / 224px image)."""
+
+    def __init__(self, image_size=224, seed=0):
+        self.image_size = image_size
+        self.params = _init_resnet_params(jax.random.PRNGKey(seed))
+        self._forward = jax.jit(_resnet_forward)
+
+    def __call__(self, inputs, params, ctx):
+        x = jnp.asarray(inputs["INPUT0"])
+        return {"OUTPUT0": self._forward(self.params, x)}
+
+
+def resnet50_model(
+    name="resnet50", image_size=224, max_batch_size=64, warmup=False
+):
+    """Servable ResNet-50 (BASELINE.md config 3's model, rebuilt natively in
+    JAX rather than loaded from ONNX).  Reference analog: the resnet50
+    concurrency sweep perf_analyzer README documents; cited in SURVEY §6."""
+    runner = ResNet50Classifier(image_size)
+    labels = [f"class_{i}" for i in range(_NUM_CLASSES)]
+    return Model(
+        name,
+        inputs=[TensorSpec("INPUT0", "FP32", [-1, 3, image_size, image_size])],
+        outputs=[TensorSpec("OUTPUT0", "FP32", [-1, _NUM_CLASSES], labels=labels)],
+        fn=runner,
+        platform="jax",
+        backend="jax",
+        max_batch_size=max_batch_size,
+        dynamic_batching=True,
+        warmup=warmup,
+        batch_device_inputs=True,
+        fused_batching=True,
+        max_fused_arity=16,
+        flops_per_item=resnet50_flops_per_image(image_size),
     )

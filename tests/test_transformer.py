@@ -105,3 +105,27 @@ def test_generate_streams_tokens(params):
     )
     assert len(toks) == 4
     assert all(0 <= t < CFG.vocab_size for t in toks)
+
+
+def test_generate_pipelined_matches_serial(params):
+    """Deferring the D2H readback must not change the token stream."""
+    serial = list(
+        tfm.generate(params, CFG, prompt=[5, 9], max_new_tokens=12,
+                     readback_depth=0)
+    )
+    for depth in (1, 4, 32):
+        pipelined = list(
+            tfm.generate(params, CFG, prompt=[5, 9], max_new_tokens=12,
+                         readback_depth=depth)
+        )
+        assert pipelined == serial
+
+
+def test_generate_pipelined_matches_serial_sampled(params):
+    """Sampling path: the key-split schedule is per-step, so the stream is
+    depth-invariant there too."""
+    kw = dict(prompt=[3, 4, 5], max_new_tokens=10, temperature=0.7,
+              key=jax.random.PRNGKey(7))
+    serial = list(tfm.generate(params, CFG, readback_depth=0, **kw))
+    pipelined = list(tfm.generate(params, CFG, readback_depth=8, **kw))
+    assert pipelined == serial
