@@ -155,11 +155,17 @@ def build_parser():
 
 
 def _run_native_loadgen(args, control, loader, data_manager):
-    """Concurrency sweep driven by the native C++ engine (perf_worker):
-    region setup and metadata live here (this process owns jax); the
-    measurement loop is pure C++."""
+    """Load sweep driven by the native C++ engine (perf_worker): region
+    setup and metadata live here (this process owns jax); the measurement
+    loop is pure C++.  Sweeps concurrency, request rate
+    (--request-rate-range, constant/poisson), or stateful sequences
+    (--sequence) — each level runs one worker long enough for the
+    stability loop over its per-window records."""
     from client_tpu.perf.infer_data import _ShmInferDataManagerBase
-    from client_tpu.perf.native_worker import run_native_worker
+    from client_tpu.perf.native_worker import (
+        native_windows_stable,
+        run_native_worker,
+    )
     from client_tpu.utils import np_to_triton_dtype
 
     try:
@@ -181,32 +187,76 @@ def _run_native_loadgen(args, control, loader, data_manager):
                     list(td.array.shape),
                 ))
 
-        start, end, step = _parse_range(args.concurrency_range or "1", int)
-        duration_s = max(args.measurement_interval / 1e3, 0.5)
+        window_s = max(args.measurement_interval / 1e3, 0.5)
+        # enough windows for the 3-window stability check without letting
+        # default settings balloon a level past ~6 windows
+        n_windows = max(3, min(args.max_trials, 6))
+        threshold = args.stability_percentage / 100.0
+
+        if args.request_rate_range:
+            start, end, step = _parse_range(args.request_rate_range, float)
+            # index-based levels: float accumulation (r += step) can skip
+            # the final level to rounding (0.1+0.1+0.1 > 0.3)
+            n_levels = int(round((end - start) / step)) + 1 if step else 1
+            levels = []
+            for i in range(max(n_levels, 1)):
+                r = start + i * step
+                if r > end * (1 + 1e-9):
+                    break
+                levels.append(("Request rate", r, {
+                    "request_rate": r,
+                    "distribution": args.request_distribution,
+                    "concurrency": args.max_threads,
+                }))
+        else:
+            start, end, step = _parse_range(args.concurrency_range or "1", int)
+            label = "Sequences" if args.sequence else "Concurrency"
+            levels = []
+            c = start
+            while c <= end:
+                kw = ({"sequences": c, "seq_steps": args.sequence_length,
+                       "concurrency": 1}
+                      if args.sequence else {"concurrency": c})
+                levels.append((label, c, kw))
+                c += step
+
         best = None
         errors = 0
-        c = start
-        while c <= end:
+        for label, level, kw in levels:
             report = run_native_worker(
-                args.url, args.model_name, concurrency=c,
-                duration_s=duration_s, warmup_s=1.0,
+                args.url, args.model_name,
+                duration_s=window_s * n_windows, warmup_s=1.0,
+                window_interval_s=window_s,
+                completion_sync=args.tpu_shm_sync,
                 wire_inputs=wire_inputs, shm_inputs=shm_inputs,
-                shm_outputs=shm_outputs,
+                shm_outputs=shm_outputs, **kw,
             )
             errors += report["errors"]
+            windows = report.get("windows", [])
+            stable = native_windows_stable(windows, threshold)
+            if stable:
+                tail = windows[-3:]
+                report["stable_throughput"] = round(
+                    sum(w["throughput"] for w in tail) / 3, 2
+                )
+            delayed = (f", delayed {report['delayed']}"
+                       if report.get("delayed") else "")
             print(
-                f"Concurrency: {c}, throughput: "
+                f"{label}: {level:g}, throughput: "
                 f"{report['throughput']:.1f} infer/sec (native), "
                 f"p50 {report['p50_us']:.0f} usec, "
                 f"p99 {report['p99_us']:.0f} usec, "
-                f"errors {report['errors']}"
+                f"errors {report['errors']}{delayed}, "
+                f"{'stable' if stable else 'UNSTABLE'} over "
+                f"{len(windows)} windows"
             )
             if best is None or report["throughput"] > best[1]["throughput"]:
-                best = (c, report)
-            c += step
+                best = (level, report)
         if best is not None:
+            name = ("rate" if args.request_rate_range
+                    else "sequences" if args.sequence else "concurrency")
             print(
-                f"Best: concurrency={best[0]} -> "
+                f"Best: {name}={best[0]:g} -> "
                 f"{best[1]['throughput']:.1f} infer/sec, "
                 f"avg latency {best[1]['avg_us']:.0f} usec"
             )
@@ -388,10 +438,11 @@ def main(argv=None):
                      "(request-rate/interval schedules use worker threads)")
         if args.native_loadgen:
             if (args.hermetic or kind != BackendKind.TRITON_GRPC
-                    or args.sequence or args.async_mode
-                    or args.request_intervals or args.request_rate_range):
-                sys.exit("error: --native-loadgen is concurrency mode over "
-                         "a socket gRPC server, stateless, sync CLI path")
+                    or args.async_mode or args.request_intervals):
+                sys.exit("error: --native-loadgen drives a socket gRPC "
+                         "server (concurrency, --request-rate-range, or "
+                         "--sequence mode); interval-file replay and "
+                         "--async use the python engine")
             # modes the native sweep does not implement fail LOUDLY rather
             # than silently measuring something else
             unsupported = [
@@ -407,6 +458,10 @@ def main(argv=None):
             if offending:
                 sys.exit("error: --native-loadgen does not support: "
                          + ", ".join(offending))
+            if args.request_rate_range and args.sequence:
+                sys.exit("error: --native-loadgen sequence mode is "
+                         "closed-loop; pick --request-rate-range OR "
+                         "--sequence")
             if args.shared_memory == "none" and args.input_data not in (
                     None, "random"):
                 sys.exit("error: --native-loadgen wire mode generates "

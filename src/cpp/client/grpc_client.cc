@@ -3,8 +3,10 @@
 #include <zlib.h>
 
 #include <atomic>
+#include <cstdlib>
 #include <cstring>
 #include <sstream>
+#include <vector>
 
 #include "../grpc/h2.h"
 
@@ -92,10 +94,15 @@ ApplyCompression(
   return Error::Success();
 }
 
-// Shared channel cache (reference grpc_client.cc:79-120: one channel per
-// url with an explicit share count).  The map holds STRONG references and
-// the count tracks clients created with use_cached_channel for that url;
-// the last departing client Closes the connection from its own thread.
+// Shared channel cache (reference grpc_client.cc:79-120: channels per url
+// with an explicit max share count).  Each url maps to a LIST of channel
+// slots; a slot is shared by at most MaxChannelShareCount() clients (env
+// CLIENT_TPU_GRPC_CHANNEL_MAX_SHARE_COUNT, default 6 — the reference's
+// TRITON_CLIENT_GRPC_CHANNEL_MAX_SHARE_COUNT analog), so heavy fan-out
+// spreads over several real connections instead of serializing on one
+// h2 session.  The map holds STRONG references and the count tracks
+// clients created with use_cached_channel for that url; the last departing
+// client of a slot Closes the connection from its own thread.
 // (Async completion lambdas hold only weak refs — see AsyncInfer — so a
 // connection's final strong reference is never dropped on its own reader
 // thread, where ~H2Connection's reader join would be a self-join.)
@@ -104,7 +111,20 @@ struct CachedChannel {
   int users = 0;
 };
 std::mutex g_channel_mu;
-std::map<std::string, CachedChannel> g_channels;
+std::map<std::string, std::vector<CachedChannel>> g_channels;
+
+int
+MaxChannelShareCount()
+{
+  // read per call (not latched): cheap next to a connect, and lets tests
+  // and long-lived processes adjust the fan-out policy
+  const char* v = std::getenv("CLIENT_TPU_GRPC_CHANNEL_MAX_SHARE_COUNT");
+  if (v != nullptr) {
+    const int n = std::atoi(v);
+    if (n > 0) return n;
+  }
+  return 6;  // reference default (grpc_client.cc:89-91)
+}
 
 std::string
 PercentDecode(const std::string& in)
@@ -184,6 +204,14 @@ SetParam(
 }
 
 }  // namespace
+
+int
+CachedChannelCountForTesting(const std::string& host_port)
+{
+  std::lock_guard<std::mutex> clk(g_channel_mu);
+  auto it = g_channels.find(host_port);
+  return it == g_channels.end() ? 0 : static_cast<int>(it->second.size());
+}
 
 Error
 ParseGrpcInferResult(
@@ -321,12 +349,21 @@ InferenceServerGrpcClient::DropCachedUser(
     auto it = g_channels.find(key);
     if (it == g_channels.end()) {
       to_close = conn;  // entry replaced after a reconnect; ours to close
-    } else if (--it->second.users <= 0) {
-      to_close = it->second.conn;
-      g_channels.erase(it);
-      if (conn != nullptr && conn != to_close) conn->Close();
-    } else if (conn != nullptr && conn != it->second.conn) {
-      to_close = conn;  // we held a stale pre-reconnect connection
+    } else {
+      auto& slots = it->second;
+      bool found = false;
+      for (auto sit = slots.begin(); sit != slots.end(); ++sit) {
+        if (sit->conn == conn) {
+          found = true;
+          if (--sit->users <= 0) {
+            to_close = sit->conn;
+            slots.erase(sit);
+          }
+          break;
+        }
+      }
+      if (!found) to_close = conn;  // our slot was pruned after a reconnect
+      if (slots.empty()) g_channels.erase(it);
     }
   }
   if (to_close != nullptr) to_close->Close();
@@ -341,60 +378,74 @@ InferenceServerGrpcClient::Connected()
   // in-flight call or async callback still holds its shared_ptr.
   if (shared_channel_) {
     const std::string key = host_ + ":" + std::to_string(port_);
-    const bool first_attach = (conn_ == nullptr);
+    if (conn_ != nullptr && attached_) {
+      // Reconnect: leave the dead slot first (closing it if we were its
+      // last user) so share counts stay exact before re-attaching below.
+      auto dead = conn_;
+      conn_ = nullptr;
+      attached_ = false;
+      DropCachedUser(dead);
+    }
+    const int max_share = MaxChannelShareCount();
     {
       std::lock_guard<std::mutex> clk(g_channel_mu);
       auto it = g_channels.find(key);
-      if (it != g_channels.end() && it->second.conn->IsOpen()) {
-        if (first_attach) {
-          it->second.users++;
-          attached_ = true;
+      if (it != g_channels.end()) {
+        for (auto& slot : it->second) {
+          if (slot.conn->IsOpen() && slot.users < max_share) {
+            slot.users++;
+            attached_ = true;
+            conn_ = slot.conn;
+            // a later client's keepalive request applies to the shared
+            // channel (first effective enabler's interval wins)
+            if (keepalive_enabled_)
+              conn_->EnableKeepAlive(
+                  keepalive_.keepalive_time_ms,
+                  keepalive_.keepalive_timeout_ms);
+            return Error::Success();
+          }
         }
-        conn_ = it->second.conn;
-        // a later client's keepalive request applies to the shared
-        // channel (first effective enabler's interval wins)
-        if (keepalive_enabled_)
-          conn_->EnableKeepAlive(
-              keepalive_.keepalive_time_ms,
-              keepalive_.keepalive_timeout_ms);
-        return Error::Success();
       }
     }
-    // Connect OUTSIDE the cache lock: a slow/unroutable host must not
-    // stall every cached-channel client process-wide.
+    // No attachable slot (none yet, all dead, or all at the share cap):
+    // connect a new channel OUTSIDE the cache lock — a slow/unroutable
+    // host must not stall every cached-channel client process-wide.
     auto fresh = std::make_shared<h2::H2Connection>();
     Error err = fresh->Connect(host_, port_);
     if (!err.IsOk()) return err;
     if (keepalive_enabled_)
       fresh->EnableKeepAlive(
           keepalive_.keepalive_time_ms, keepalive_.keepalive_timeout_ms);
-    std::shared_ptr<h2::H2Connection> stale;
+    std::shared_ptr<h2::H2Connection> lost_race;
     {
       std::lock_guard<std::mutex> clk(g_channel_mu);
-      auto it = g_channels.find(key);
-      if (it != g_channels.end()) {
-        if (it->second.conn->IsOpen()) {
-          if (first_attach) {
-            it->second.users++;
-            attached_ = true;
-          }
-          conn_ = it->second.conn;  // another thread won the connect race
-          fresh->Close();
-          return Error::Success();
+      auto& slots = g_channels[key];
+      // prune slots nobody holds whose connection died meanwhile
+      for (auto sit = slots.begin(); sit != slots.end();) {
+        if (sit->users <= 0 && !sit->conn->IsOpen()) {
+          sit = slots.erase(sit);
+        } else {
+          ++sit;
         }
-        stale = it->second.conn;  // dead cached conn: close outside lock
-        it->second.conn = fresh;
-        if (first_attach) {
-          it->second.users++;
-          attached_ = true;
-        }
-      } else {
-        g_channels[key] = CachedChannel{fresh, 1};
-        attached_ = true;
       }
-      conn_ = fresh;
+      // another thread may have opened an attachable slot while we
+      // connected; adopt it and discard ours to keep the channel count low
+      for (auto& slot : slots) {
+        if (slot.conn->IsOpen() && slot.users < max_share) {
+          slot.users++;
+          attached_ = true;
+          conn_ = slot.conn;
+          lost_race = fresh;
+          break;
+        }
+      }
+      if (lost_race == nullptr) {
+        slots.push_back(CachedChannel{fresh, 1});
+        attached_ = true;
+        conn_ = fresh;
+      }
     }
-    if (stale != nullptr) stale->Close();
+    if (lost_race != nullptr) lost_race->Close();
     return Error::Success();
   }
   // Close the dead connection BEFORE replacing it: Close joins its reader

@@ -63,9 +63,12 @@ class InferenceServerGrpcClient {
       std::unique_ptr<InferenceServerGrpcClient>* client,
       const std::string& url, bool verbose = false);
   // Keepalive + channel-cache variant (reference grpc_client.cc:79-120
-  // NewGrpcChannel: one shared channel per url with a share count).  With
-  // use_cached_channel, clients for the same url multiplex one
-  // H2Connection; the connection closes when its last user is destroyed.
+  // NewGrpcChannel: shared channels per url with a share count).  With
+  // use_cached_channel, clients for the same url multiplex cached
+  // H2Connections, at most CLIENT_TPU_GRPC_CHANNEL_MAX_SHARE_COUNT clients
+  // per connection (env var, default 6 — the reference's
+  // TRITON_CLIENT_GRPC_CHANNEL_MAX_SHARE_COUNT analog); each connection
+  // closes when its last user is destroyed.
   static Error Create(
       std::unique_ptr<InferenceServerGrpcClient>* client,
       const std::string& url, const KeepAliveOptions& keepalive,
@@ -230,5 +233,9 @@ class InferenceServerGrpcClient {
 // Convenience mirrors of the reference's free helpers.
 Error ParseGrpcInferResult(
     const inference::ModelInferResponse& response, InferResult** result);
+
+// Number of cached-channel slots currently held for "host:port" — test
+// observability for the share-count distribution policy; not a public API.
+int CachedChannelCountForTesting(const std::string& host_port);
 
 }  // namespace ctpu

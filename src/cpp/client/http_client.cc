@@ -52,7 +52,12 @@ InferenceServerHttpClient::Create(
 {
   client->reset(new InferenceServerHttpClient(server_url, verbose));
   if ((*client)->port_ == 0) {
+    client->reset();
     return Error("malformed server url '" + server_url + "' (want host:port)");
+  }
+  if (server_url.rfind("https://", 0) == 0) {
+    // https scheme on the plain overload: default TLS options
+    return EnableTls(client, HttpSslOptions());
   }
   return Error::Success();
 }
@@ -63,20 +68,37 @@ InferenceServerHttpClient::Create(
     const std::string& server_url, const HttpSslOptions& ssl_options,
     bool verbose)
 {
-#ifdef CLIENT_TPU_ENABLE_TLS
-  (void)ssl_options;
-  return Error(
-      "CLIENT_TPU_ENABLE_TLS is defined but no TLS transport is linked in "
-      "this build");
-#else
-  (void)ssl_options;
-  (void)verbose;
-  client->reset();
-  return Error(
-      "TLS support is not compiled in: this toolchain ships no OpenSSL "
-      "headers; rebuild with -DCLIENT_TPU_ENABLE_TLS against an "
-      "OpenSSL-equipped toolchain, or terminate TLS in a local proxy");
-#endif
+  client->reset(new InferenceServerHttpClient(server_url, verbose));
+  if ((*client)->port_ == 0) {
+    client->reset();
+    return Error("malformed server url '" + server_url + "' (want host:port)");
+  }
+  return EnableTls(client, ssl_options);
+}
+
+Error
+InferenceServerHttpClient::EnableTls(
+    std::unique_ptr<InferenceServerHttpClient>* client,
+    const HttpSslOptions& ssl_options)
+{
+  // Same fail-fast shape as the gRPC client: resolve a transport NOW so a
+  // build/deployment without any TLS provider errors at Create, not on the
+  // first request (reference fails at channel creation too).
+  TlsConfig config;
+  config.root_certificates = ssl_options.ca_info;
+  config.private_key = ssl_options.key;
+  config.certificate_chain = ssl_options.cert;
+  config.insecure_skip_verify =
+      !(ssl_options.verify_peer || ssl_options.verify_host);
+  std::unique_ptr<ByteTransport> probe;
+  Error err = MakeTlsTransport(config, &probe);
+  if (!err.IsOk()) {
+    client->reset();
+    return err;
+  }
+  (*client)->tls_enabled_ = true;
+  (*client)->tls_config_ = config;
+  return Error::Success();
 }
 
 InferenceServerHttpClient::InferenceServerHttpClient(
@@ -122,12 +144,45 @@ InferenceServerHttpClient::CloseSocket()
     ::close(fd_);
     fd_ = -1;
   }
+  if (transport_ != nullptr) {
+    transport_->Close();
+    transport_.reset();
+  }
+}
+
+bool
+InferenceServerHttpClient::Connected() const
+{
+  return tls_enabled_ ? transport_ != nullptr : fd_ >= 0;
+}
+
+ssize_t
+InferenceServerHttpClient::IoSend(const void* buf, size_t len)
+{
+  if (tls_enabled_) return transport_->Write(buf, len);
+  return ::send(fd_, buf, len, MSG_NOSIGNAL);
+}
+
+ssize_t
+InferenceServerHttpClient::IoRecv(void* buf, size_t len)
+{
+  if (tls_enabled_) return transport_->Read(buf, len);
+  return ::recv(fd_, buf, len, 0);
 }
 
 Error
 InferenceServerHttpClient::EnsureConnected()
 {
-  if (fd_ >= 0) return Error::Success();
+  if (Connected()) return Error::Success();
+  if (tls_enabled_) {
+    std::unique_ptr<ByteTransport> t;
+    Error err = MakeTlsTransport(tls_config_, &t);
+    if (!err.IsOk()) return err;
+    err = t->Connect(host_, port_, /*timeout_ms=*/30000);
+    if (!err.IsOk()) return err;
+    transport_ = std::move(t);
+    return Error::Success();
+  }
   struct addrinfo hints = {};
   hints.ai_family = AF_UNSPEC;
   hints.ai_socktype = SOCK_STREAM;
@@ -167,6 +222,12 @@ InferenceServerHttpClient::Request(
   const auto deadline = std::chrono::steady_clock::now() +
                         std::chrono::microseconds(timeout_us);
   const auto set_socket_timeout = [&]() -> bool {
+    if (tls_enabled_) {
+      // the transport owns its socket; the whole-exchange budget is only
+      // enforced between ops (see header note on TLS timeout granularity)
+      return timeout_us == 0 ||
+             std::chrono::steady_clock::now() < deadline;
+    }
     struct timeval tv;
     if (timeout_us == 0) {
       tv.tv_sec = 0;
@@ -202,7 +263,7 @@ InferenceServerHttpClient::Request(
     // closed the idle connection before reading our request, so it cannot
     // have executed.  A drop on a fresh connection, or after any response
     // byte, may mean the request already ran — retrying would double-infer.
-    const bool reused_connection = (fd_ >= 0);
+    const bool reused_connection = Connected();
     Error err = EnsureConnected();
     if (!err.IsOk()) return err;
     // client_timeout_us bounds the WHOLE exchange; 0 restores "wait
@@ -229,8 +290,7 @@ InferenceServerHttpClient::Request(
     for (const std::string* part : parts) {
       size_t sent = 0;
       while (sent < part->size()) {
-        ssize_t n = ::send(
-            fd_, part->data() + sent, part->size() - sent, MSG_NOSIGNAL);
+        ssize_t n = IoSend(part->data() + sent, part->size() - sent);
         if (n <= 0) {
           if (n < 0 && timed_out()) {
             CloseSocket();
@@ -265,7 +325,7 @@ InferenceServerHttpClient::Request(
     char chunk[8192];
     bool read_closed = false;
     while (header_end == std::string::npos) {
-      ssize_t n = ::recv(fd_, chunk, sizeof(chunk), 0);
+      ssize_t n = IoRecv(chunk, sizeof(chunk));
       if (n <= 0) {
         if (n < 0 && timed_out()) {
           CloseSocket();
@@ -331,7 +391,7 @@ InferenceServerHttpClient::Request(
     }
     response->body = buf.substr(header_end + 4);
     while (response->body.size() < content_length) {
-      ssize_t n = ::recv(fd_, chunk, sizeof(chunk), 0);
+      ssize_t n = IoRecv(chunk, sizeof(chunk));
       if (n <= 0) {
         const bool was_timeout = n < 0 && timed_out();  // before close clobbers errno
         CloseSocket();
@@ -1039,6 +1099,11 @@ InferenceServerHttpClient::AsyncInfer(
 {
   if (callback == nullptr)
     return Error("AsyncInfer requires a completion callback");
+  if (tls_enabled_) {
+    return Error(
+        "AsyncInfer is not supported on TLS connections (the epoll reactor "
+        "is fd-based); use Infer, or terminate TLS in a local proxy");
+  }
   {
     std::lock_guard<std::mutex> lk(reactor_mu_);
     if (reactor_ == nullptr) {

@@ -14,6 +14,7 @@
 
 #include "common.h"
 #include "json.h"
+#include "transport.h"
 
 #include <mutex>
 
@@ -27,10 +28,16 @@ struct HttpResponse {
   std::string body;
 };
 
-// TLS options (reference http_client.h:46-87 HttpSslOptions).  The API is
-// declared for parity, but this build environment ships no OpenSSL headers:
-// the TLS Create overload returns an error unless the library was compiled
-// with -DCLIENT_TPU_ENABLE_TLS against an OpenSSL-equipped toolchain.
+// TLS options (reference http_client.h:46-87 HttpSslOptions).  TLS rides
+// the ByteTransport seam (transport.h): Create resolves a transport via
+// MakeTlsTransport — a factory registered with SetTlsTransportFactory, or
+// the built-in OpenSSL transport on CLIENT_TPU_ENABLE_TLS builds — and
+// errors helpfully when neither exists.  Sync requests run over the TLS
+// transport; the epoll-reactor async path is fd-based, so AsyncInfer on a
+// TLS client returns a descriptive error (use Infer, or terminate TLS in a
+// local proxy for async workloads).  client_timeout_us granularity on TLS
+// connections is per-connect (the transport owns its socket options), not
+// per-read as on plain TCP.
 struct HttpSslOptions {
   bool verify_peer = true;
   bool verify_host = true;
@@ -44,7 +51,8 @@ class InferenceServerHttpClient {
   static Error Create(
       std::unique_ptr<InferenceServerHttpClient>* client,
       const std::string& server_url, bool verbose = false);
-  // HTTPS variant; see HttpSslOptions for the gating note.
+  // HTTPS variant (also selected by an "https://" url on the plain Create);
+  // see HttpSslOptions for the transport-seam note.
   static Error Create(
       std::unique_ptr<InferenceServerHttpClient>* client,
       const std::string& server_url, const HttpSslOptions& ssl_options,
@@ -84,8 +92,7 @@ class InferenceServerHttpClient {
 
   // Compression algorithms for the infer body (reference http_client.h
   // Infer(..., request_compression_algorithm, response_compression_algorithm)
-  // — gzip/deflate via zlib; TLS is out of scope in this image, compression
-  // is not).
+  // — gzip/deflate via zlib).
   enum class CompressionType { NONE, DEFLATE, GZIP };
 
   Error Infer(
@@ -150,10 +157,21 @@ class InferenceServerHttpClient {
       const std::string& uri, const std::string& body,
       json::ValuePtr* out = nullptr);
 
+  static Error EnableTls(
+      std::unique_ptr<InferenceServerHttpClient>* client,
+      const HttpSslOptions& ssl_options);
+  // raw send/recv over fd_ (plain TCP) or transport_ (TLS)
+  ssize_t IoSend(const void* buf, size_t len);
+  ssize_t IoRecv(void* buf, size_t len);
+  bool Connected() const;
+
   std::string host_;
   int port_ = 0;
   int fd_ = -1;
   bool verbose_ = false;
+  bool tls_enabled_ = false;
+  TlsConfig tls_config_;
+  std::unique_ptr<ByteTransport> transport_;  // TLS connections only
   std::mutex reactor_mu_;
   std::unique_ptr<HttpReactor> reactor_;  // created on first AsyncInfer
 

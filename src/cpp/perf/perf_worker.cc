@@ -1,21 +1,31 @@
 // Native load-generation worker — the C++ engine behind the perf harness
 // (the role of the reference's perf_analyzer core: perf_analyzer.cc:56-424
 // concurrency manager + concurrency_worker.cc hot loop + async
-// InferContext slots, infer_context.cc:103-150), re-shaped for this
-// framework: N outstanding AsyncInfer contexts multiplexed on ONE
-// HTTP/2 connection and completed by its reactor thread — no GIL, no
-// thread-per-request.  The Python CLI drives it as a subprocess
-// (client_tpu/perf/native_worker.py) and merges its records.
+// InferContext slots; request_rate_worker.h:51-118 schedule generation),
+// re-shaped for this framework: N outstanding AsyncInfer contexts
+// multiplexed on ONE HTTP/2 connection and completed by its reactor
+// thread — no GIL, no thread-per-request.  The Python CLI drives it as a
+// subprocess (client_tpu/perf/native_worker.py) and merges its records.
 //
 //   perf_worker -u host:port -m model -c concurrency -d seconds
 //               [-w warmup_seconds]
+//               [-r rate_per_sec] [--distribution constant|poisson]
+//               [--window-interval seconds]      (per-window JSON lines)
+//               [--completion-sync]              (wire outputs: latency
+//                                                 covers compute + D2H)
+//               [--sequences N] [--seq-steps M]  (bidi sequence streaming)
 //               [--wire-input NAME:DTYPE:d1,d2,...]...
 //               [--shm-input NAME:DTYPE:d1,d2:REGION:NBYTES]...
 //               [--shm-output NAME:REGION:NBYTES]...
 //
-// Prints ONE JSON line:
-//   {"ok": N, "errors": N, "elapsed_s": F, "throughput": F,
-//    "p50_us": F, "p90_us": F, "p95_us": F, "p99_us": F, "avg_us": F}
+// Per-window lines (only with --window-interval): the Python profiler's
+// stability loop (inference_profiler.h:365-399 shape) consumes these live:
+//   {"window": K, "ok": N, "errors": N, "throughput": F,
+//    "p50_us": F, "p99_us": F}
+// Final line:
+//   {"ok": N, "errors": N, "delayed": N, "elapsed_s": F, "throughput": F,
+//    "p50_us": F, "p90_us": F, "p95_us": F, "p99_us": F, "avg_us": F,
+//    "mode": "concurrency|rate|sequence"}
 #include <algorithm>
 #include <cmath>
 #include <atomic>
@@ -24,6 +34,8 @@
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
+#include <deque>
+#include <map>
 #include <memory>
 #include <mutex>
 #include <random>
@@ -99,13 +111,154 @@ struct Record {
   bool ok;
 };
 
+int64_t
+Now()
+{
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(
+             Clock::now().time_since_epoch())
+      .count();
+}
+
+double
+Percentile(const std::vector<double>& sorted, double p)
+{
+  if (sorted.empty()) return 0.0;
+  // nearest-rank: ceil(p/100 * N) - 1, clamped
+  const double rank =
+      std::ceil(p / 100.0 * static_cast<double>(sorted.size()));
+  const size_t idx = rank >= 1.0 ? static_cast<size_t>(rank) - 1 : 0;
+  return sorted[std::min(idx, sorted.size() - 1)];
+}
+
+// Shared measurement state: completion records plus the optional
+// per-window reporter thread (the profiler's Measure-window feed).
+class Recorder {
+ public:
+  void Push(int64_t start, int64_t end, bool ok)
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    records_.push_back({start, end, ok});
+  }
+
+  void ClearForMeasurement()
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    records_.clear();
+    reported_idx_ = 0;
+  }
+
+  void StartWindows(double interval_s)
+  {
+    if (interval_s <= 0) return;
+    windows_stop_.store(false);
+    reporter_ = std::thread([this, interval_s] {
+      int window = 0;
+      auto next = Clock::now() + std::chrono::duration<double>(interval_s);
+      std::unique_lock<std::mutex> lk(mu_);
+      while (!windows_cv_.wait_until(
+                 lk, next, [&] { return windows_stop_.load(); })) {
+        next += std::chrono::duration<double>(interval_s);
+        std::vector<double> lat_us;
+        size_t ok = 0, errors = 0;
+        for (size_t i = reported_idx_; i < records_.size(); ++i) {
+          const Record& r = records_[i];
+          if (!r.ok) {
+            errors++;
+            continue;
+          }
+          ok++;
+          lat_us.push_back((r.end_ns - r.start_ns) / 1e3);
+        }
+        reported_idx_ = records_.size();
+        std::sort(lat_us.begin(), lat_us.end());
+        // print outside the lock so a slow pipe cannot stall completions
+        lk.unlock();
+        std::printf(
+            "{\"window\": %d, \"ok\": %zu, \"errors\": %zu, "
+            "\"throughput\": %.2f, \"p50_us\": %.1f, \"p99_us\": %.1f}\n",
+            window++, ok, errors, ok / interval_s, Percentile(lat_us, 50),
+            Percentile(lat_us, 99));
+        std::fflush(stdout);
+        lk.lock();
+      }
+    });
+  }
+
+  void StopWindows()
+  {
+    {
+      // store+notify under mu_: a notify between the reporter's predicate
+      // check and its block would otherwise be lost, stalling join until
+      // the next window tick
+      std::lock_guard<std::mutex> lk(mu_);
+      windows_stop_.store(true);
+    }
+    windows_cv_.notify_all();
+    if (reporter_.joinable()) reporter_.join();
+  }
+
+  void Report(
+      int64_t window_start, int64_t window_end, size_t delayed,
+      const char* mode)
+  {
+    std::vector<Record> records;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      records = records_;
+    }
+    std::vector<double> lat_us;
+    size_t ok = 0, errors = 0;
+    for (const auto& r : records) {
+      // count only requests completing inside the window (the profiler's
+      // ValidLatencyMeasurement clip)
+      if (r.end_ns < window_start || r.end_ns > window_end) continue;
+      if (!r.ok) {
+        errors++;
+        continue;
+      }
+      ok++;
+      lat_us.push_back((r.end_ns - r.start_ns) / 1e3);
+    }
+    std::sort(lat_us.begin(), lat_us.end());
+    const double elapsed_s = (window_end - window_start) / 1e9;
+    double avg = 0;
+    for (const double v : lat_us) avg += v;
+    if (!lat_us.empty()) avg /= lat_us.size();
+    std::printf(
+        "{\"ok\": %zu, \"errors\": %zu, \"delayed\": %zu, "
+        "\"elapsed_s\": %.3f, \"throughput\": %.2f, \"p50_us\": %.1f, "
+        "\"p90_us\": %.1f, \"p95_us\": %.1f, \"p99_us\": %.1f, "
+        "\"avg_us\": %.1f, \"mode\": \"%s\"}\n",
+        ok, errors, delayed, elapsed_s,
+        elapsed_s > 0 ? ok / elapsed_s : 0.0, Percentile(lat_us, 50),
+        Percentile(lat_us, 90), Percentile(lat_us, 95),
+        Percentile(lat_us, 99), avg, mode);
+  }
+
+ private:
+  std::mutex mu_;
+  std::vector<Record> records_;
+  size_t reported_idx_ = 0;
+  std::thread reporter_;
+  std::condition_variable windows_cv_;
+  std::atomic<bool> windows_stop_{false};
+};
+
 class Driver {
  public:
+  // rate <= 0: closed-loop fixed concurrency (concurrency_worker.cc's
+  // shape).  rate > 0: open-loop schedule at `rate` req/s with constant or
+  // poisson inter-arrivals (request_rate_worker.h:51-118); `concurrency`
+  // then caps outstanding requests, and sends falling behind schedule are
+  // counted as delayed (reference --max-trials delayed accounting).
   Driver(tc::InferenceServerGrpcClient* client, tc::InferOptions options,
          std::vector<tc::InferInput*> inputs,
-         std::vector<const tc::InferRequestedOutput*> outputs)
+         std::vector<const tc::InferRequestedOutput*> outputs, double rate,
+         bool poisson, double window_interval_s)
       : client_(client), options_(std::move(options)),
-        inputs_(std::move(inputs)), outputs_(std::move(outputs))
+        inputs_(std::move(inputs)), outputs_(std::move(outputs)),
+        rate_(rate), poisson_(poisson),
+        window_interval_s_(window_interval_s), rng_(12345)
   {
   }
 
@@ -123,19 +276,24 @@ class Driver {
     // larger than the h2 flow-control window).
     {
       std::lock_guard<std::mutex> lk(mu_);
-      rearm_pending_ = concurrency;
+      slots_free_ = concurrency;
     }
     pump_ = std::thread([this] { PumpLoop(); });
     pump_cv_.notify_all();
     std::this_thread::sleep_until(t_warm_end);
-    {
-      std::lock_guard<std::mutex> lk(mu_);
-      records_.clear();  // warmup requests don't count
-    }
+    recorder_.ClearForMeasurement();
+    delayed_.store(0);
     window_start_ = Now();
+    recorder_.StartWindows(window_interval_s_);
     std::this_thread::sleep_for(std::chrono::duration<double>(duration_s));
-    stop_.store(true);
+    {
+      // store under mu_ so the pump can't lose the wakeup between its
+      // predicate check and blocking
+      std::lock_guard<std::mutex> lk(mu_);
+      stop_.store(true);
+    }
     window_end_ = Now();
+    recorder_.StopWindows();
     // stop the pump first: after it joins, nothing submits anymore ...
     pump_cv_.notify_all();
     if (pump_.joinable()) pump_.join();
@@ -148,69 +306,52 @@ class Driver {
         lk, std::chrono::seconds(60), [&] { return outstanding_ == 0; });
   }
 
-  void Report()
+  void Report(const char* mode)
   {
-    std::vector<Record> records;
-    {
-      std::lock_guard<std::mutex> lk(mu_);
-      records = records_;
-    }
-    std::vector<double> lat_us;
-    size_t ok = 0, errors = 0;
-    for (const auto& r : records) {
-      // count only requests completing inside the window (the profiler's
-      // ValidLatencyMeasurement clip)
-      if (r.end_ns < window_start_ || r.end_ns > window_end_) continue;
-      if (!r.ok) {
-        errors++;
-        continue;
-      }
-      ok++;
-      lat_us.push_back((r.end_ns - r.start_ns) / 1e3);
-    }
-    std::sort(lat_us.begin(), lat_us.end());
-    const double elapsed_s = (window_end_ - window_start_) / 1e9;
-    const auto pct = [&](double p) -> double {
-      if (lat_us.empty()) return 0.0;
-      // nearest-rank: ceil(p/100 * N) - 1, clamped
-      const double rank =
-          std::ceil(p / 100.0 * static_cast<double>(lat_us.size()));
-      const size_t idx = rank >= 1.0 ? static_cast<size_t>(rank) - 1 : 0;
-      return lat_us[std::min(idx, lat_us.size() - 1)];
-    };
-    double avg = 0;
-    for (const double v : lat_us) avg += v;
-    if (!lat_us.empty()) avg /= lat_us.size();
-    std::printf(
-        "{\"ok\": %zu, \"errors\": %zu, \"elapsed_s\": %.3f, "
-        "\"throughput\": %.2f, \"p50_us\": %.1f, \"p90_us\": %.1f, "
-        "\"p95_us\": %.1f, \"p99_us\": %.1f, \"avg_us\": %.1f}\n",
-        ok, errors, elapsed_s, elapsed_s > 0 ? ok / elapsed_s : 0.0,
-        pct(50), pct(90), pct(95), pct(99), avg);
+    recorder_.Report(window_start_, window_end_, delayed_.load(), mode);
   }
 
  private:
-  static int64_t Now()
-  {
-    return std::chrono::duration_cast<std::chrono::nanoseconds>(
-               Clock::now().time_since_epoch())
-        .count();
-  }
-
-  // Pump thread: arms a slot whenever a completion (or startup) leaves one
-  // empty.  A synchronous AsyncInfer failure (server died, reconnects keep
-  // failing) records the error and retries after a backoff — iteratively,
-  // on this thread, never on the reactor.
+  // Closed loop: send whenever a slot frees.  Open loop (rate mode): wait
+  // for the next schedule tick AND a free slot; a tick that finds no free
+  // slot (or fires late) counts the request as delayed but still sends it,
+  // so the achieved rate degrades visibly instead of silently re-timing.
   void PumpLoop()
   {
+    auto next_send = Clock::now();
+    std::exponential_distribution<double> exp_dist(rate_ > 0 ? rate_ : 1.0);
     while (true) {
-      {
-        std::unique_lock<std::mutex> lk(mu_);
-        pump_cv_.wait(lk, [&] {
-          return rearm_pending_ > 0 || stop_.load();
-        });
+      if (rate_ > 0) {
+        // open-loop schedule: wait to the tick even with slots free —
+        // interruptibly, so a stop at measurement end doesn't block join
+        // for a full inter-arrival interval at low rates
+        {
+          std::unique_lock<std::mutex> lk(mu_);
+          pump_cv_.wait_until(lk, next_send, [&] { return stop_.load(); });
+        }
         if (stop_.load()) return;
-        rearm_pending_--;
+        const auto behind =
+            std::chrono::duration_cast<std::chrono::milliseconds>(
+                Clock::now() - next_send)
+                .count();
+        bool slot_waited = false;
+        {
+          std::unique_lock<std::mutex> lk(mu_);
+          if (slots_free_ == 0) slot_waited = true;
+          pump_cv_.wait(lk, [&] { return slots_free_ > 0 || stop_.load(); });
+          if (stop_.load()) return;
+          slots_free_--;
+          outstanding_++;
+        }
+        if (behind > 1 || slot_waited) delayed_.fetch_add(1);
+        next_send += std::chrono::duration_cast<Clock::duration>(
+            std::chrono::duration<double>(
+                poisson_ ? exp_dist(rng_) : 1.0 / rate_));
+      } else {
+        std::unique_lock<std::mutex> lk(mu_);
+        pump_cv_.wait(lk, [&] { return slots_free_ > 0 || stop_.load(); });
+        if (stop_.load()) return;
+        slots_free_--;
         outstanding_++;
       }
       const int64_t start = Now();
@@ -220,11 +361,11 @@ class Driver {
           },
           options_, inputs_, outputs_);
       if (err.IsOk()) continue;
+      recorder_.Push(start, Now(), false);
       {
         std::lock_guard<std::mutex> lk(mu_);
-        records_.push_back({start, Now(), false});
         outstanding_--;
-        rearm_pending_++;  // the slot still needs arming
+        slots_free_++;  // the slot still needs arming
         if (outstanding_ == 0) drained_.notify_all();
       }
       std::this_thread::sleep_for(std::chrono::milliseconds(2));
@@ -233,13 +374,13 @@ class Driver {
 
   void Complete(int64_t start, bool ok)
   {
+    recorder_.Push(start, Now(), ok);
     std::lock_guard<std::mutex> lk(mu_);
-    records_.push_back({start, Now(), ok});
     outstanding_--;
     if (!stop_.load()) {
       // hand the empty slot to the pump thread (concurrency_worker.cc's
       // hot loop, minus the reactor-thread re-arm hazard)
-      rearm_pending_++;
+      slots_free_++;
       pump_cv_.notify_one();
     }
     if (outstanding_ == 0) drained_.notify_all();
@@ -249,13 +390,181 @@ class Driver {
   tc::InferOptions options_;
   std::vector<tc::InferInput*> inputs_;
   std::vector<const tc::InferRequestedOutput*> outputs_;
+  Recorder recorder_;
   std::mutex mu_;
   std::condition_variable drained_;
   std::condition_variable pump_cv_;
   std::thread pump_;
-  std::vector<Record> records_;
   int outstanding_ = 0;
-  int rearm_pending_ = 0;
+  int slots_free_ = 0;
+  std::atomic<bool> stop_{false};
+  std::atomic<size_t> delayed_{0};
+  double rate_ = 0.0;
+  bool poisson_ = false;
+  double window_interval_s_ = 0.0;
+  std::mt19937 rng_;
+  int64_t window_start_ = 0;
+  int64_t window_end_ = 0;
+};
+
+// Sequence streaming over the bidi ModelStreamInfer stream (the reference's
+// sequence workload: sequence_manager.h:46-132 id allocation + the
+// simple_grpc_sequence_stream_infer_client shape).  N stateful sequences
+// run closed-loop: each response re-arms that sequence's next step via the
+// pump thread (stream writes must never run on the reactor).  A sequence
+// reaching seq_steps sends sequence_end and restarts under a fresh id.
+class SequenceRunner {
+ public:
+  SequenceRunner(tc::InferenceServerGrpcClient* client,
+                 const std::string& model,
+                 std::vector<tc::InferInput*> inputs,
+                 std::vector<const tc::InferRequestedOutput*> outputs,
+                 int n_sequences, int seq_steps, double window_interval_s)
+      : client_(client), model_(model), inputs_(std::move(inputs)),
+        outputs_(std::move(outputs)), n_sequences_(n_sequences),
+        seq_steps_(seq_steps), window_interval_s_(window_interval_s)
+  {
+  }
+
+  bool Run(double warmup_s, double duration_s)
+  {
+    stop_.store(false);
+    tc::Error err = client_->StartStream(
+        [this](tc::InferResultPtr result) { OnResponse(std::move(result)); });
+    if (!err.IsOk()) {
+      std::fprintf(stderr, "stream start failed: %s\n",
+                   err.Message().c_str());
+      return false;
+    }
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      next_seq_id_ = 1;
+      for (int i = 0; i < n_sequences_; ++i) {
+        ready_.push_back(SeqState{next_seq_id_++, 0});
+      }
+    }
+    pump_ = std::thread([this] { PumpLoop(); });
+    pump_cv_.notify_all();
+    std::this_thread::sleep_for(std::chrono::duration<double>(warmup_s));
+    recorder_.ClearForMeasurement();
+    window_start_ = Now();
+    recorder_.StartWindows(window_interval_s_);
+    std::this_thread::sleep_for(std::chrono::duration<double>(duration_s));
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      stop_.store(true);
+    }
+    window_end_ = Now();
+    recorder_.StopWindows();
+    pump_cv_.notify_all();
+    if (pump_.joinable()) pump_.join();
+    // drain: every in-flight step either completes or the stream errors out
+    bool drained;
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      drained = drained_.wait_for(
+          lk, std::chrono::seconds(60), [&] { return in_flight_.empty(); });
+    }
+    client_->StopStream();
+    return drained;
+  }
+
+  void Report() { recorder_.Report(window_start_, window_end_, 0, "sequence"); }
+
+ private:
+  struct SeqState {
+    uint64_t id;
+    int step;
+  };
+
+  void PumpLoop()
+  {
+    while (true) {
+      SeqState st;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        pump_cv_.wait(lk, [&] { return !ready_.empty() || stop_.load(); });
+        if (stop_.load()) return;
+        st = ready_.front();
+        ready_.pop_front();
+      }
+      tc::InferOptions options(model_);
+      options.sequence_id = st.id;
+      options.sequence_start = (st.step == 0);
+      options.sequence_end = (st.step == seq_steps_ - 1);
+      options.request_id =
+          std::to_string(st.id) + "-" + std::to_string(st.step);
+      const int64_t start = Now();
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        in_flight_[options.request_id] = {start, st};
+      }
+      tc::Error err = client_->AsyncStreamInfer(options, inputs_, outputs_);
+      if (!err.IsOk()) {
+        {
+          std::lock_guard<std::mutex> lk(mu_);
+          in_flight_.erase(options.request_id);
+          recorder_.Push(start, Now(), false);
+          if (!stop_.load()) ready_.push_back(SeqState{next_seq_id_++, 0});
+          if (in_flight_.empty()) drained_.notify_all();
+        }
+        // a dead stream fails instantly: back off so the rest of the run
+        // degrades gracefully instead of busy-spinning error records
+        std::this_thread::sleep_for(std::chrono::milliseconds(2));
+      }
+    }
+  }
+
+  void OnResponse(tc::InferResultPtr result)
+  {
+    const bool ok = result->RequestStatus().IsOk();
+    const std::string id = ok ? result->Id() : std::string();
+    SeqState st{0, 0};
+    int64_t start = 0;
+    bool matched = false;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      auto it = id.empty() ? in_flight_.end() : in_flight_.find(id);
+      if (it == in_flight_.end() && !in_flight_.empty() && !ok) {
+        it = in_flight_.begin();  // stream-level error: charge oldest
+      }
+      if (it != in_flight_.end()) {
+        start = it->second.first;
+        st = it->second.second;
+        matched = true;
+        in_flight_.erase(it);
+      }
+      if (matched) {
+        recorder_.Push(start, Now(), ok);
+        if (!stop_.load()) {
+          // re-arm: next step of this sequence, or a fresh sequence
+          if (ok && st.step + 1 < seq_steps_) {
+            ready_.push_back(SeqState{st.id, st.step + 1});
+          } else {
+            ready_.push_back(SeqState{next_seq_id_++, 0});
+          }
+          pump_cv_.notify_one();
+        }
+      }
+      if (in_flight_.empty()) drained_.notify_all();
+    }
+  }
+
+  tc::InferenceServerGrpcClient* client_;
+  std::string model_;
+  std::vector<tc::InferInput*> inputs_;
+  std::vector<const tc::InferRequestedOutput*> outputs_;
+  int n_sequences_;
+  int seq_steps_;
+  double window_interval_s_;
+  Recorder recorder_;
+  std::mutex mu_;
+  std::condition_variable pump_cv_;
+  std::condition_variable drained_;
+  std::thread pump_;
+  std::deque<SeqState> ready_;
+  std::map<std::string, std::pair<int64_t, SeqState>> in_flight_;
+  uint64_t next_seq_id_ = 1;
   std::atomic<bool> stop_{false};
   int64_t window_start_ = 0;
   int64_t window_end_ = 0;
@@ -270,6 +579,11 @@ main(int argc, char** argv)
   std::string model;
   int concurrency = 1;
   double duration_s = 5.0, warmup_s = 1.0;
+  double rate = 0.0;
+  bool poisson = false;
+  double window_interval_s = 0.0;
+  bool completion_sync = false;
+  int sequences = 0, seq_steps = 8;
   std::vector<TensorArg> wire_inputs, shm_inputs, shm_outputs;
   for (int i = 1; i < argc; ++i) {
     const std::string arg = argv[i];
@@ -286,6 +600,23 @@ main(int argc, char** argv)
       duration_s = std::stod(next());
     } else if (arg == "-w") {
       warmup_s = std::stod(next());
+    } else if (arg == "-r") {
+      rate = std::stod(next());
+    } else if (arg == "--distribution") {
+      const std::string d = next();
+      if (d != "constant" && d != "poisson") {
+        std::fprintf(stderr, "unknown distribution %s\n", d.c_str());
+        return 2;
+      }
+      poisson = (d == "poisson");
+    } else if (arg == "--window-interval") {
+      window_interval_s = std::stod(next());
+    } else if (arg == "--completion-sync") {
+      completion_sync = true;
+    } else if (arg == "--sequences") {
+      sequences = std::stoi(next());
+    } else if (arg == "--seq-steps") {
+      seq_steps = std::stoi(next());
     } else if (arg == "--wire-input" || arg == "--shm-input" ||
                arg == "--shm-output") {
       TensorArg tensor;
@@ -345,7 +676,14 @@ main(int argc, char** argv)
   std::vector<const tc::InferRequestedOutput*> outputs;
   for (const auto& tensor : shm_outputs) {
     auto output = std::make_unique<tc::InferRequestedOutput>(tensor.name);
-    output->SetSharedMemory(tensor.region, tensor.nbytes);
+    if (completion_sync) {
+      // wire output: the server must materialize (device compute + D2H)
+      // before responding, so the recorded latency is COMPLETION latency —
+      // the RequestTimers-true number (reference common.h:521-601) — not a
+      // dispatch ack into a shm region
+    } else {
+      output->SetSharedMemory(tensor.region, tensor.nbytes);
+    }
     outputs.push_back(output.get());
     owned_outputs.push_back(std::move(output));
   }
@@ -354,10 +692,26 @@ main(int argc, char** argv)
     return 2;
   }
 
+  if (sequences > 0) {
+    SequenceRunner runner(
+        client.get(), model, inputs, outputs, sequences, seq_steps,
+        window_interval_s);
+    const bool drained = runner.Run(warmup_s, duration_s);
+    runner.Report();
+    if (!drained) {
+      std::fprintf(stderr, "warning: sequence drain timed out\n");
+      std::fflush(stdout);
+      std::_Exit(3);
+    }
+    return 0;
+  }
+
   tc::InferOptions options(model);
-  Driver driver(client.get(), options, inputs, outputs);
+  Driver driver(
+      client.get(), options, inputs, outputs, rate, poisson,
+      window_interval_s);
   const bool drained = driver.Run(concurrency, warmup_s, duration_s);
-  driver.Report();
+  driver.Report(rate > 0 ? "rate" : "concurrency");
   if (!drained) {
     // requests still in flight: the reactor may yet fire completions that
     // touch the Driver — skip destructors entirely rather than free state

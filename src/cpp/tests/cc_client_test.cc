@@ -392,6 +392,45 @@ TestStatistics(tc::InferenceServerHttpClient* client)
   CHECK(stats->Get("model_stats") != nullptr);
 }
 
+static void
+TestTlsTransportSeam(const std::string& url)
+{
+  // Without a TLS transport (no OpenSSL in this toolchain, no factory
+  // registered), the SSL Create must fail with the descriptive diagnostic —
+  // at Create, not on the first request.
+  tc::HttpSslOptions ssl;
+  std::unique_ptr<tc::InferenceServerHttpClient> tls_client;
+  tc::Error e = tc::InferenceServerHttpClient::Create(&tls_client, url, ssl);
+  CHECK(!e.IsOk());
+  CHECK(e.Message().find("TLS") != std::string::npos);
+
+  // https:// scheme on the plain Create takes the same gate
+  e = tc::InferenceServerHttpClient::Create(&tls_client, "https://" + url);
+  CHECK(!e.IsOk());
+
+  // Injectable seam (mirror of the gRPC suite's TestTlsTransportSeam):
+  // register a pass-through TCP transport standing in for a TLS library —
+  // the SAME Create + sync request path must then work end to end.
+  tc::SetTlsTransportFactory(
+      [](const tc::TlsConfig&) { return tc::MakeTcpTransport(); });
+  e = tc::InferenceServerHttpClient::Create(&tls_client, url, ssl);
+  CHECK_OK(e);
+  if (e.IsOk()) {
+    TestInfer(tls_client.get());
+    // async on a TLS client is rejected with a helpful error, not a hang
+    tc::InferInput i0("INPUT0", {1, 16}, "INT32");
+    tc::InferInput i1("INPUT1", {1, 16}, "INT32");
+    std::vector<int32_t> in0(16), in1(16);
+    FillInputs(in0, in1, i0, i1);
+    tc::InferOptions options("simple");
+    e = tls_client->AsyncInfer(
+        [](tc::InferResultPtr, tc::Error) {}, options, {&i0, &i1});
+    CHECK(!e.IsOk());
+    CHECK(e.Message().find("TLS") != std::string::npos);
+  }
+  tc::SetTlsTransportFactory(nullptr);
+}
+
 int
 main(int argc, char** argv)
 {
@@ -415,6 +454,7 @@ main(int argc, char** argv)
   TestInferMulti(client.get());
   TestModelControl(client.get());
   TestStatistics(client.get());
+  TestTlsTransportSeam(url);
 
   std::cout << (g_checks - g_failures) << "/" << g_checks << " checks passed"
             << std::endl;

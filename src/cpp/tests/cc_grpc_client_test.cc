@@ -490,6 +490,33 @@ TestKeepAliveAndChannelCache(const std::string& url)
   tc::InferResult* r2 = nullptr;
   CHECK_OK(DoInfer(c2.get(), "simple", &r2));
   delete r2;
+  // channel attach is lazy (first RPC); by now only c2 holds its slot
+  CHECK(tc::CachedChannelCountForTesting(url) == 1);
+  c2.reset();
+  CHECK(tc::CachedChannelCountForTesting(url) == 0);  // last user closed it
+
+  // share-count policy (reference TRITON_CLIENT_GRPC_CHANNEL_MAX_SHARE_COUNT
+  // analog): with the cap at 2, three cached clients must spread over two
+  // real connections; all stay usable; teardown drains every slot
+  setenv("CLIENT_TPU_GRPC_CHANNEL_MAX_SHARE_COUNT", "2", 1);
+  std::unique_ptr<tc::InferenceServerGrpcClient> s1, s2, s3;
+  CHECK_OK(tc::InferenceServerGrpcClient::Create(
+      &s1, url, tc::KeepAliveOptions(), /*use_cached_channel=*/true));
+  CHECK_OK(tc::InferenceServerGrpcClient::Create(
+      &s2, url, tc::KeepAliveOptions(), /*use_cached_channel=*/true));
+  CHECK_OK(tc::InferenceServerGrpcClient::Create(
+      &s3, url, tc::KeepAliveOptions(), /*use_cached_channel=*/true));
+  for (auto* c : {s1.get(), s2.get(), s3.get()}) {
+    tc::InferResult* r = nullptr;
+    CHECK_OK(DoInfer(c, "simple", &r));
+    delete r;
+  }
+  CHECK(tc::CachedChannelCountForTesting(url) == 2);
+  s1.reset();
+  s2.reset();
+  s3.reset();
+  CHECK(tc::CachedChannelCountForTesting(url) == 0);
+  unsetenv("CLIENT_TPU_GRPC_CHANNEL_MAX_SHARE_COUNT");
 }
 
 int

@@ -187,6 +187,84 @@ def test_native_perf_worker(dual_server):
 
 
 @needs_grpc_cpp
+def test_native_perf_worker_rate_mode(dual_server):
+    """Open-loop request-rate scheduling in the native engine (reference
+    request_rate_worker.h:51-118): achieved throughput tracks the requested
+    rate; poisson mode works; the report carries the delayed count."""
+    from client_tpu.perf.native_worker import (
+        native_worker_available,
+        run_native_worker,
+    )
+
+    if not native_worker_available():
+        pytest.skip("perf_worker not built")
+    for distribution in ("constant", "poisson"):
+        report = run_native_worker(
+            dual_server.grpc_address, "simple",
+            concurrency=8, duration_s=2.0, warmup_s=0.3,
+            request_rate=100.0, distribution=distribution,
+            wire_inputs=[("INPUT0", "INT32", [1, 16]),
+                         ("INPUT1", "INT32", [1, 16])],
+        )
+        assert report["mode"] == "rate"
+        assert report["errors"] == 0
+        assert "delayed" in report
+        # the server turns these around in <1ms, so the achieved rate
+        # should sit near the schedule (loose band: CI timers jitter)
+        assert 60.0 < report["throughput"] < 140.0, (distribution, report)
+
+
+@needs_grpc_cpp
+def test_native_perf_worker_windows(dual_server):
+    """--window-interval emits per-window JSON lines the python driver
+    surfaces as report['windows'] — the stability-loop feed."""
+    from client_tpu.perf.native_worker import (
+        native_worker_available,
+        run_native_worker,
+    )
+
+    if not native_worker_available():
+        pytest.skip("perf_worker not built")
+    report = run_native_worker(
+        dual_server.grpc_address, "simple",
+        concurrency=4, duration_s=2.0, warmup_s=0.3,
+        window_interval_s=0.5,
+        wire_inputs=[("INPUT0", "INT32", [1, 16]),
+                     ("INPUT1", "INT32", [1, 16])],
+    )
+    assert report["ok"] > 0
+    windows = report.get("windows", [])
+    assert len(windows) >= 2
+    for w in windows:
+        assert w["throughput"] > 0
+        assert 0 < w["p50_us"] <= w["p99_us"]
+
+
+@needs_grpc_cpp
+def test_native_perf_worker_sequences(dual_server):
+    """Bidi sequence streaming in the native engine (the reference's
+    sequence workload over one ModelStreamInfer stream): stateful sequences
+    complete with correct protocol flags and report message latencies."""
+    from client_tpu.perf.native_worker import (
+        native_worker_available,
+        run_native_worker,
+    )
+
+    if not native_worker_available():
+        pytest.skip("perf_worker not built")
+    report = run_native_worker(
+        dual_server.grpc_address, "simple_sequence",
+        concurrency=1, duration_s=2.0, warmup_s=0.3,
+        sequences=4, seq_steps=5,
+        wire_inputs=[("INPUT", "INT32", [1])],
+    )
+    assert report["mode"] == "sequence"
+    assert report["errors"] == 0
+    assert report["ok"] > 50
+    assert 0 < report["p50_us"] <= report["p99_us"]
+
+
+@needs_grpc_cpp
 def test_perf_cli_native_loadgen(dual_server):
     """`python -m client_tpu.perf --native-loadgen` sweeps concurrency with
     the C++ engine (region setup python-side, measurement loop native)."""
@@ -206,3 +284,38 @@ def test_perf_cli_native_loadgen(dual_server):
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "(native)" in proc.stdout
     assert "Best: concurrency=" in proc.stdout
+    assert "windows" in proc.stdout  # stability-qualified levels
+
+
+@needs_grpc_cpp
+def test_perf_cli_native_rate_and_sequence(dual_server):
+    """--native-loadgen with --request-rate-range (constant schedule) and
+    with --sequence both ride the C++ engine end to end."""
+    import subprocess
+    import sys
+
+    from client_tpu.perf.native_worker import native_worker_available
+
+    if not native_worker_available():
+        pytest.skip("perf_worker not built")
+    proc = subprocess.run(
+        [sys.executable, "-m", "client_tpu.perf", "-m", "simple",
+         "-u", dual_server.grpc_address, "--native-loadgen",
+         "--request-rate-range", "50:100:50",
+         "--measurement-interval", "600"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "Request rate: 50" in proc.stdout
+    assert "Best: rate=" in proc.stdout
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "client_tpu.perf", "-m", "simple_sequence",
+         "-u", dual_server.grpc_address, "--native-loadgen", "--sequence",
+         "--sequence-length", "5", "--concurrency-range", "4",
+         "--measurement-interval", "600"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "Sequences: 4" in proc.stdout
+    assert "Best: sequences=" in proc.stdout
