@@ -122,6 +122,12 @@ def build_parser():
                         "used/total/peak) each scrape — device telemetry "
                         "when the server under test exposes no TPU metrics "
                         "(requires colocation with the chip)")
+    p.add_argument("--probe-device-utilization", action="store_true",
+                   help="estimate device utilization by timing a tiny probe "
+                        "kernel each scrape (queue-delay sampling; trusts "
+                        "nothing the server reports; requires colocation "
+                        "with the chip) — summarized per window as "
+                        "ctpu_probe_utilization_pct in the report/CSV")
     # SSL/TLS (reference command_line_parser.h SSL option block; names match)
     p.add_argument("--ssl-grpc-use-ssl", action="store_true",
                    help="use an SSL-encrypted gRPC channel")
@@ -500,6 +506,40 @@ def main(argv=None):
         else:
             manager = ConcurrencyManager(**common)
 
+        # metrics (and the utilization probe's jax import + kernel compile)
+        # come BEFORE the rendezvous barrier so multi-rank measurement
+        # windows stay aligned after the barrier releases
+        metrics = None
+        if ((args.collect_local_tpu_metrics or args.probe_device_utilization)
+                and not args.collect_metrics):
+            print("warning: --collect-local-tpu-metrics/"
+                  "--probe-device-utilization have no effect without "
+                  "--collect-metrics", file=sys.stderr)
+        if args.collect_metrics:
+            from client_tpu.perf.metrics_manager import (
+                DeviceUtilizationProbe,
+                MetricsManager,
+            )
+
+            if args.hermetic:
+                print("warning: --collect-metrics needs a socket server; "
+                      "ignored with --hermetic", file=sys.stderr)
+            else:
+                probe = None
+                if args.probe_device_utilization:
+                    try:
+                        probe = DeviceUtilizationProbe()
+                    except Exception as e:
+                        print(f"warning: utilization probe unavailable: {e}",
+                              file=sys.stderr)
+                url = args.metrics_url or f"http://{args.url}/metrics"
+                metrics = MetricsManager(
+                    url, interval_s=args.metrics_interval / 1e3,
+                    include_local_devices=args.collect_local_tpu_metrics,
+                    utilization_probe=probe,
+                ).start()
+
+
         rendezvous = None
         if args.world_size > 1:
             from client_tpu.perf.rendezvous import Rendezvous
@@ -508,23 +548,6 @@ def main(argv=None):
                 args.rank, args.world_size, args.rendezvous_addr
             )
             rendezvous.barrier()  # start measuring together (MPIBarrierWorld)
-
-        metrics = None
-        if args.collect_local_tpu_metrics and not args.collect_metrics:
-            print("warning: --collect-local-tpu-metrics has no effect "
-                  "without --collect-metrics", file=sys.stderr)
-        if args.collect_metrics:
-            from client_tpu.perf.metrics_manager import MetricsManager
-
-            if args.hermetic:
-                print("warning: --collect-metrics needs a socket server; "
-                      "ignored with --hermetic", file=sys.stderr)
-            else:
-                url = args.metrics_url or f"http://{args.url}/metrics"
-                metrics = MetricsManager(
-                    url, interval_s=args.metrics_interval / 1e3,
-                    include_local_devices=args.collect_local_tpu_metrics,
-                ).start()
 
         profiler = InferenceProfiler(
             manager,

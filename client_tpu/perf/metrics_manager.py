@@ -78,13 +78,64 @@ def local_device_snapshot():
     return out
 
 
+class DeviceUtilizationProbe:
+    """Server-independent device utilization estimator.
+
+    Dispatches a microscopic jitted kernel on the LOCAL chip and times its
+    completion: when another process's work occupies the device, the probe
+    queues behind it, so probe latency beyond the idle baseline samples the
+    device's queue delay directly.  This trusts nothing the server under
+    test reports — the blind spot the reference has for non-Triton servers
+    (its nv_gpu_utilization comes from Triton's own /metrics;
+    metrics_manager.h:44-91).
+
+    Per sample: queue delay in us, and a busy flag (latency >
+    busy_factor × idle baseline).  A window of samples summarizes as
+    ``ctpu_probe_utilization_pct`` = busy percent — an *estimate*: probes
+    are point samples, so short kernels can slip between them, and on a
+    high-RTT tunneled device the link jitter widens the baseline band
+    (busy_factor is deliberately 2x).
+    """
+
+    def __init__(self, busy_factor=2.0, baseline_samples=8):
+        import time
+
+        import jax
+
+        self.busy_factor = busy_factor
+        device = jax.local_devices()[0]
+        self.device_id = device.id
+        self._x = jax.device_put(np.float32(1.0), device)
+        self._fn = jax.jit(lambda x: x + np.float32(1.0))
+        float(self._fn(self._x))  # compile outside the baseline
+        lats = []
+        for _ in range(baseline_samples):
+            t0 = time.perf_counter()
+            float(self._fn(self._x))
+            lats.append(time.perf_counter() - t0)
+        # min: the emptiest-queue observation is the best idle estimate
+        self.baseline_s = max(min(lats), 1e-6)
+
+    def sample(self):
+        """One probe: (queue_delay_us, busy 0/1)."""
+        import time
+
+        t0 = time.perf_counter()
+        float(self._fn(self._x))
+        lat = time.perf_counter() - t0
+        delay_us = max(0.0, (lat - self.baseline_s) * 1e6)
+        busy = 1.0 if lat > self.busy_factor * self.baseline_s else 0.0
+        return delay_us, busy
+
+
 class MetricsManager:
     def __init__(self, metrics_url, interval_s=1.0, timeout_s=5.0,
-                 include_local_devices=False):
+                 include_local_devices=False, utilization_probe=None):
         self.metrics_url = metrics_url
         self.interval_s = interval_s
         self.timeout_s = timeout_s
         self.include_local_devices = include_local_devices
+        self.utilization_probe = utilization_probe
         self._snapshots = []
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -101,22 +152,40 @@ class MetricsManager:
                 )
         except Exception:
             # A server with no /metrics endpoint at all is the PRIMARY
-            # local-devices use case: the local snapshot must still flow.
-            # (On re-raise the polling loop counts the scrape error; the
-            # fallback success path counts it here — exactly once either way.)
-            if not self.include_local_devices:
+            # local-telemetry use case: the local snapshot and the
+            # utilization probe must still flow.  (On re-raise the polling
+            # loop counts the scrape error; the fallback success path
+            # counts it here — exactly once either way.)
+            if not self.include_local_devices and self.utilization_probe is None:
                 raise
-            local = self._local_snapshot()
+            local = dict(
+                self._local_snapshot() if self.include_local_devices else {}
+            )
+            self._probe_into(local)
             if not local:
                 raise
             self.scrape_errors += 1
-            return dict(local)
+            return local
         if self.include_local_devices:
             for name, entries in self._local_snapshot().items():
                 # server-reported gauges win; local fills the blind spot
                 if name not in snap:
                     snap[name] = entries
+        self._probe_into(snap)
         return snap
+
+    def _probe_into(self, snap):
+        if self.utilization_probe is None:
+            return
+        try:
+            delay_us, busy = self.utilization_probe.sample()
+        except Exception:
+            return
+        labels = (
+            f'{{device="{self.utilization_probe.device_id}",source="probe"}}'
+        )
+        snap["ctpu_probe_queue_delay_us"] = [(labels, delay_us)]
+        snap["ctpu_probe_busy"] = [(labels, busy)]
 
     _local_snapshot = staticmethod(local_device_snapshot)
 
@@ -153,7 +222,8 @@ class MetricsManager:
     @staticmethod
     def summarize(snapshots, gauges=("ctpu_tpu_memory_used_bytes",
                                      "ctpu_tpu_memory_total_bytes",
-                                     "ctpu_tpu_memory_peak_bytes")):
+                                     "ctpu_tpu_memory_peak_bytes",
+                                     "ctpu_probe_queue_delay_us")):
         """Max/avg per gauge over the window's snapshots (the reference
         merges per-GPU utilization/memory the same way)."""
         summary = {}
@@ -167,9 +237,23 @@ class MetricsManager:
                     "avg": float(np.mean(values)),
                     "max": float(np.max(values)),
                 }
+        # utilization gauges are emitted in PERCENT: the report renders
+        # tpu_metrics with :.0f, which would flatten a 0-1 fraction to 0/1
         util = MetricsManager.utilization(snapshots)
         if util is not None:
-            summary["ctpu_server_utilization"] = {"avg": util, "max": util}
+            summary["ctpu_server_utilization_pct"] = {
+                "avg": util * 100.0, "max": util * 100.0,
+            }
+        # probe-based estimate: fraction of window probes that found the
+        # device busy — utilization without trusting the server under test
+        busy = [
+            v for snap in snapshots for _, v in snap.get("ctpu_probe_busy", [])
+        ]
+        if busy:
+            summary["ctpu_probe_utilization_pct"] = {
+                "avg": float(np.mean(busy)) * 100.0,
+                "max": float(np.max(busy)) * 100.0,
+            }
         return summary
 
     @staticmethod

@@ -64,7 +64,9 @@ def print_summary(results, percentile=None):
 
 
 def write_csv(path, results, verbose=False):
-    """CSV export; column set follows report_writer.cc."""
+    """CSV export; column set follows report_writer.cc, plus one avg/max
+    column pair per collected tpu_metrics gauge (the reference appends GPU
+    metric columns the same way)."""
     fields = [
         "Level", "Inferences/Second", "Client Send Rate",
         "Avg latency", "p50 latency", "p90 latency", "p95 latency",
@@ -76,6 +78,9 @@ def write_csv(path, results, verbose=False):
             "Server Queue", "Server Compute Input", "Server Compute Infer",
             "Server Compute Output",
         ]
+    gauges = sorted({g for s in results for g in s.tpu_metrics})
+    for gauge in gauges:
+        fields += [f"{gauge} (avg)", f"{gauge} (max)"]
     with open(path, "w", newline="") as f:
         w = csv.writer(f)
         w.writerow(fields)
@@ -103,4 +108,8 @@ def write_csv(path, results, verbose=False):
                     f"{srv.get('compute_infer_ns', 0) / cnt / 1e3:.0f}",
                     f"{srv.get('compute_output_ns', 0) / cnt / 1e3:.0f}",
                 ]
+            for gauge in gauges:
+                agg = s.tpu_metrics.get(gauge)
+                row += ([f"{agg['avg']:.1f}", f"{agg['max']:.1f}"]
+                        if agg else ["", ""])
             w.writerow(row)

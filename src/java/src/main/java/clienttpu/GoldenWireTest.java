@@ -1,0 +1,149 @@
+// Golden-wire verification for the Java client (no server needed): the
+// committed bytes in tests/golden/ were produced by the PYTHON client and
+// the in-process server (tests/test_golden_wire.py keeps them current);
+// this main asserts the Java client speaks the same KServe HTTP binary
+// protocol — so the Java path is machine-checked on any JDK-equipped
+// machine, offline.  Reference protocol:
+// src/java/src/main/java/triton/client/InferenceServerClient.java:59-221.
+//
+//   java clienttpu.GoldenWireTest <path-to-tests/golden>
+//
+// Checks:
+//  1. encodeInfer() on the golden scenario yields a header JSON that is
+//     CANONICALLY equal to the golden request header (two independent JSON
+//     writers need not agree on key order/whitespace byte-for-byte) and a
+//     binary section that IS byte-identical.
+//  2. The golden response parses to the exact expected tensors
+//     (OUTPUT0 = INPUT0+INPUT1, OUTPUT1 = INPUT0-INPUT1).
+package clienttpu;
+
+import java.nio.charset.StandardCharsets;
+import java.nio.file.Files;
+import java.nio.file.Path;
+import java.util.ArrayList;
+import java.util.Arrays;
+import java.util.List;
+import java.util.Map;
+import java.util.TreeMap;
+
+public final class GoldenWireTest {
+  private static int checks = 0;
+  private static int failures = 0;
+
+  private static void check(boolean ok, String what) {
+    checks++;
+    if (!ok) {
+      failures++;
+      System.out.println("FAIL " + what);
+    }
+  }
+
+  /** Canonical form: objects with sorted keys, no whitespace — makes two
+   * independently ordered JSON headers comparable. */
+  @SuppressWarnings("unchecked")
+  private static String canonical(Object value) {
+    if (value instanceof Map) {
+      TreeMap<String, Object> sorted =
+          new TreeMap<>((Map<String, Object>) value);
+      StringBuilder sb = new StringBuilder("{");
+      boolean first = true;
+      for (Map.Entry<String, Object> e : sorted.entrySet()) {
+        if (!first) sb.append(',');
+        first = false;
+        sb.append(Json.escape(e.getKey())).append(':')
+            .append(canonical(e.getValue()));
+      }
+      return sb.append('}').toString();
+    }
+    if (value instanceof List) {
+      StringBuilder sb = new StringBuilder("[");
+      List<Object> list = (List<Object>) value;
+      for (int i = 0; i < list.size(); i++) {
+        if (i > 0) sb.append(',');
+        sb.append(canonical(list.get(i)));
+      }
+      return sb.append(']').toString();
+    }
+    // numbers: golden (python) writes ints; Json.parse yields Long — align
+    // any integral Double to Long so 64 == 64.0 canonically
+    if (value instanceof Double && ((Double) value) == Math.floor((Double) value)
+        && !((Double) value).isInfinite()) {
+      return Long.toString(((Double) value).longValue());
+    }
+    if (value instanceof long[]) {
+      List<Object> boxed = new ArrayList<>();
+      for (long v : (long[]) value) boxed.add(v);
+      return canonical(boxed);
+    }
+    return Json.write(value);
+  }
+
+  public static void main(String[] args) throws Exception {
+    Path golden = Path.of(args.length > 0 ? args[0] : "tests/golden");
+    byte[] goldenRequest =
+        Files.readAllBytes(golden.resolve("kserve_infer_request.bin"));
+    byte[] goldenResponse =
+        Files.readAllBytes(golden.resolve("kserve_infer_response.bin"));
+    Map<String, Object> meta = Json.parseObject(Files.readString(
+        golden.resolve("kserve_infer.meta.json"), StandardCharsets.UTF_8));
+    int reqHeaderLen = ((Long) meta.get("request_header_length")).intValue();
+    int respHeaderLen = ((Long) meta.get("response_header_length")).intValue();
+
+    // -- 1. request encoding matches the Python client's bytes ------------
+    int[] in0 = new int[16];
+    int[] in1 = new int[16];
+    for (int i = 0; i < 16; i++) {
+      in0[i] = i;
+      in1[i] = i + 1;
+    }
+    InferInput i0 = new InferInput("INPUT0", new long[] {1, 16}, DataType.INT32);
+    i0.setData(in0);
+    InferInput i1 = new InferInput("INPUT1", new long[] {1, 16}, DataType.INT32);
+    i1.setData(in1);
+    List<InferRequestedOutput> outs = Arrays.asList(
+        new InferRequestedOutput("OUTPUT0", true, 0),
+        new InferRequestedOutput("OUTPUT1", true, 0));
+    InferenceServerClient.EncodedRequest encoded =
+        InferenceServerClient.encodeInfer(
+            "golden-1", Arrays.asList(i0, i1), outs);
+
+    String goldenHeader =
+        new String(goldenRequest, 0, reqHeaderLen, StandardCharsets.UTF_8);
+    String javaHeader = new String(
+        encoded.body, 0, encoded.headerLength, StandardCharsets.UTF_8);
+    check(
+        canonical(Json.parseObject(goldenHeader))
+            .equals(canonical(Json.parseObject(javaHeader))),
+        "request header JSON canonically equal\n  golden: " + goldenHeader
+            + "\n  java:   " + javaHeader);
+    byte[] goldenBinary = Arrays.copyOfRange(
+        goldenRequest, reqHeaderLen, goldenRequest.length);
+    byte[] javaBinary = Arrays.copyOfRange(
+        encoded.body, encoded.headerLength, encoded.body.length);
+    check(Arrays.equals(goldenBinary, javaBinary),
+        "request binary section byte-identical");
+
+    // -- 2. golden response parses to the exact tensors -------------------
+    InferResult result = new InferResult(goldenResponse, respHeaderLen);
+    check("simple".equals(result.getModelName()), "response model name");
+    check("golden-1".equals(result.getId()), "response id echo");
+    int[] sum = result.getOutputAsInt("OUTPUT0");
+    int[] diff = result.getOutputAsInt("OUTPUT1");
+    check(sum.length == 16 && diff.length == 16, "output lengths");
+    boolean valuesOk = true;
+    for (int i = 0; i < 16; i++) {
+      valuesOk &= sum[i] == in0[i] + in1[i] && diff[i] == in0[i] - in1[i];
+    }
+    check(valuesOk, "response tensor values (sum/diff)");
+    check(Arrays.equals(
+              result.getShape("OUTPUT0"), new long[] {1, 16}),
+        "response shape");
+
+    System.out.println(checks + " checks, " + failures + " failures");
+    if (failures == 0) {
+      System.out.println("PASS: java golden wire");
+      return;
+    }
+    System.exit(1);
+  }
+}

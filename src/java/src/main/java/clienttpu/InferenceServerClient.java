@@ -212,8 +212,12 @@ public class InferenceServerClient implements AutoCloseable {
     }
   }
 
-  /** Binary-extension request body: JSON header + raw tensors appended. */
-  private static final class EncodedRequest {
+  /**
+   * Binary-extension request body: JSON header + raw tensors appended.
+   * Package-visible so GoldenWireTest can assert the encoding against the
+   * Python-generated golden bytes (tests/golden/).
+   */
+  static final class EncodedRequest {
     final byte[] body;
     final int headerLength;
 
@@ -223,7 +227,7 @@ public class InferenceServerClient implements AutoCloseable {
     }
   }
 
-  private static EncodedRequest encodeInfer(
+  static EncodedRequest encodeInfer(
       String requestId, List<InferInput> inputs,
       List<InferRequestedOutput> outputs) {
     Map<String, Object> header = new LinkedHashMap<>();

@@ -71,3 +71,16 @@ def test_async_infer_perf(java_classes, server):
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "PASS: SimpleInferPerf" in proc.stdout
     assert "infer/sec" in proc.stdout
+
+
+def test_golden_wire(java_classes):
+    """No server needed: the Java client's encoding is asserted against the
+    Python-generated golden bytes (tests/golden/, kept current by
+    tests/test_golden_wire.py) — request binary section byte-identical,
+    header JSON canonically equal, response parsed to exact values."""
+    proc = _run_main(
+        java_classes, "clienttpu.GoldenWireTest",
+        os.path.join(_REPO, "tests", "golden"),
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "PASS: java golden wire" in proc.stdout

@@ -111,6 +111,48 @@ class TestMetricsEndpoint:
             for labels, value in entries:
                 assert labels.startswith("{") and value >= 0
 
+    def test_device_utilization_probe_samples(self):
+        """The probe times a real jitted kernel on the local device: idle
+        baseline positive, samples well-formed (delay >= 0, busy in {0,1})."""
+        from client_tpu.perf.metrics_manager import DeviceUtilizationProbe
+
+        probe = DeviceUtilizationProbe()
+        assert probe.baseline_s > 0
+        for _ in range(5):
+            delay_us, busy = probe.sample()
+            assert delay_us >= 0.0
+            assert busy in (0.0, 1.0)
+
+    def test_probe_gauges_flow_through_scrape_and_summary(self, server):
+        """Probe samples ride every scrape — including the no-/metrics
+        fallback path — and summarize() emits ctpu_probe_utilization_pct
+        (busy percent) without trusting anything the server reported."""
+        from client_tpu.perf.metrics_manager import DeviceUtilizationProbe
+
+        probe = DeviceUtilizationProbe()
+        mm = MetricsManager(
+            f"http://{server.http_address}/metrics",
+            utilization_probe=probe,
+        )
+        snap = mm.scrape()
+        assert "ctpu_probe_queue_delay_us" in snap
+        assert "ctpu_probe_busy" in snap
+        assert 'source="probe"' in snap["ctpu_probe_busy"][0][0]
+
+        # server with no /metrics endpoint at all: probe still flows
+        mm_dead = MetricsManager(
+            "http://127.0.0.1:9/metrics", timeout_s=0.2,
+            utilization_probe=probe,
+        )
+        fallback = mm_dead.scrape()
+        assert "ctpu_probe_busy" in fallback
+        assert mm_dead.scrape_errors == 1
+
+        agg = MetricsManager.summarize([snap, fallback])
+        assert "ctpu_probe_utilization_pct" in agg
+        assert 0.0 <= agg["ctpu_probe_utilization_pct"]["avg"] <= 100.0
+        assert "ctpu_probe_queue_delay_us" in agg
+
 
 class TestRendezvous:
     def test_all_gather_and_consensus(self):
