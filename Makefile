@@ -7,7 +7,7 @@ CXXFLAGS ?= -O2 -fPIC -Wall -std=c++17
 NATIVE_OUT := client_tpu/utils/shared_memory
 TPUSHM_OUT := client_tpu/utils/tpu_shared_memory
 
-.PHONY: all protos native cpp clean test asan java
+.PHONY: all protos native cpp clean test asan java java-bindings
 
 all: protos native cpp
 
@@ -22,6 +22,20 @@ java:
 	  echo "java client compiled to $(JAVA_BUILD)"; \
 	else \
 	  echo "javac not found: skipping java client build"; \
+	fi
+
+# ---- Java FFM bindings over the C shm ABI (needs JDK >= 22) ---------------
+JAVA_BINDINGS_SRC := $(shell find src/java-api-bindings/java -name '*.java' 2>/dev/null)
+JAVA_BINDINGS_BUILD := build/java-bindings/classes
+
+java-bindings:
+	@if command -v javac >/dev/null 2>&1 && \
+	    [ "$$(javac --version | sed 's/[^0-9]*\([0-9]*\).*/\1/')" -ge 22 ]; then \
+	  mkdir -p $(JAVA_BINDINGS_BUILD) && \
+	  javac -d $(JAVA_BINDINGS_BUILD) $(JAVA_BINDINGS_SRC) && \
+	  echo "java ffm bindings compiled to $(JAVA_BINDINGS_BUILD)"; \
+	else \
+	  echo "javac >= 22 not found: skipping java ffm bindings"; \
 	fi
 
 # ---- native C++ client library + examples + integration test -------------

@@ -48,7 +48,7 @@ def run_native_worker(url, model_name, *, concurrency, duration_s,
                       shm_outputs=(), binary=None, timeout_s=None,
                       request_rate=0.0, distribution="constant",
                       window_interval_s=0.0, completion_sync=False,
-                      sequences=0, seq_steps=8):
+                      sequences=0, seq_steps=8, decoupled=False):
     """One native measurement (fixed concurrency, request-rate schedule, or
     bidi sequence streaming).
 
@@ -62,7 +62,13 @@ def run_native_worker(url, model_name, *, concurrency, duration_s,
     completion_sync requests wire outputs instead of shm outputs, so every
     recorded latency covers device compute + D2H (completion, not ack).
     sequences > 0 drives that many stateful sequences of seq_steps over the
-    bidi stream instead of unary AsyncInfer.
+    bidi stream instead of unary AsyncInfer.  decoupled drives
+    N-responses-per-request streaming (the LLM token-stream shape): latency
+    samples are time-to-first-response, completion rides the
+    triton_final_response marker, and the report carries the total content
+    ``responses`` count.  wire_inputs entries may carry a constant fill as
+    a 4th element (name, datatype, shape, value) — required for decoupled
+    models whose input encodes the response count.
 
     Returns the worker's final report dict (ok/errors/delayed/elapsed_s/
     throughput/p50_us/.../avg_us/mode); with window_interval_s > 0 the
@@ -84,9 +90,13 @@ def run_native_worker(url, model_name, *, concurrency, duration_s,
         cmd += ["--completion-sync"]
     if sequences > 0:
         cmd += ["--sequences", str(sequences), "--seq-steps", str(seq_steps)]
-    for name, datatype, shape in wire_inputs:
+    if decoupled:
+        cmd += ["--decoupled"]
+    for entry in wire_inputs:
+        name, datatype, shape = entry[0], entry[1], entry[2]
         dims = ",".join(str(int(d)) for d in shape)
-        cmd += ["--wire-input", f"{name}:{datatype}:{dims}"]
+        fill = f"={int(entry[3])}" if len(entry) > 3 else ""
+        cmd += ["--wire-input", f"{name}:{datatype}:{dims}{fill}"]
     for name, datatype, shape, region, nbytes in shm_inputs:
         dims = ",".join(str(int(d)) for d in shape)
         cmd += ["--shm-input", f"{name}:{datatype}:{dims}:{region}:{nbytes}"]

@@ -254,10 +254,11 @@ class ModelBatcher:
         }
         if self._use_fused():
             # one compile per (arity, part-rows): groups of k single-row
-            # requests (the concurrency-sweep shape) and, when the batch
-            # budget allows, k eight-row requests (the batched-client shape,
-            # reference perf_analyzer -b)
-            for rows in (1, 8):
+            # requests (the concurrency-sweep shape) plus k-part groups of
+            # the batched-client row sizes (reference perf_analyzer -b
+            # 8/32).  Larger rows cap arity at max_batch//rows, so the
+            # extra row sizes add only a handful of executables.
+            for rows in (1, 8, 32):
                 if rows > self.max_batch:
                     continue
                 part = {

@@ -111,6 +111,17 @@ def _contents_to_array(tensor):
     return _np_from_json_data(values, tensor.datatype, list(tensor.shape))
 
 
+def _set_infer_param(proto_params, key, value):
+    """Python value -> InferParameter oneof (bool checked before int:
+    bool is an int subclass)."""
+    if isinstance(value, bool):
+        proto_params[key].bool_param = value
+    elif isinstance(value, int):
+        proto_params[key].int64_param = value
+    else:
+        proto_params[key].string_param = str(value)
+
+
 def _dict_to_response(model_name, model_version, response_json, blobs):
     """Engine response dict + blobs -> ModelInferResponse proto."""
     response = pb.ModelInferResponse(
@@ -118,6 +129,9 @@ def _dict_to_response(model_name, model_version, response_json, blobs):
         model_version=response_json.get("model_version", model_version),
         id=response_json.get("id", ""),
     )
+    # response-level parameters (decoupled final markers etc.)
+    for key, value in (response_json.get("parameters", {}) or {}).items():
+        _set_infer_param(response.parameters, key, value)
     # raw_output_contents must align positionally with non-shm outputs, so
     # interleave binary blobs and any JSON-data fallbacks in output order.
     raws = []
@@ -131,12 +145,7 @@ def _dict_to_response(model_name, model_version, response_json, blobs):
         for key, value in eparams.items():
             if key == "binary_data_size":
                 continue
-            if isinstance(value, bool):
-                out.parameters[key].bool_param = value
-            elif isinstance(value, int):
-                out.parameters[key].int64_param = value
-            else:
-                out.parameters[key].string_param = str(value)
+            _set_infer_param(out.parameters, key, value)
         if "binary_data_size" in eparams:
             raws.append(blobs[blob_cursor])
             blob_cursor += 1

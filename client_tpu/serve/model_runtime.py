@@ -813,6 +813,12 @@ class InferenceEngine:
         model's production time (each next() + render), never the suspension
         at yield — a slow-reading client must not inflate the duty cycle."""
         recorded = False
+        # Triton's decoupled completion protocol: every response carries
+        # triton_final_response=false; when the request set
+        # triton_enable_empty_final_response, the stream ends with one
+        # extra EMPTY response marked triton_final_response=true so the
+        # client can detect completion without model-specific EOS logic.
+        want_final = bool(params.get("triton_enable_empty_final_response"))
         try:
             gen = model.fn(inputs, params, context)
             while True:
@@ -825,9 +831,22 @@ class InferenceEngine:
                     rendered = self._render_response(
                         model, model_version, request, partial
                     )
+                    rendered[0]["parameters"] = {
+                        "triton_final_response": False
+                    }
                 finally:
                     self.busy.end()
                 yield rendered
+            if want_final:
+                final = {
+                    "model_name": model.name,
+                    "model_version": model_version or model.versions[-1],
+                    "outputs": [],
+                    "parameters": {"triton_final_response": True},
+                }
+                if request.get("id"):
+                    final["id"] = request["id"]
+                yield final, []
             t1 = time.monotonic_ns()
             stats.record(True, t1 - t0, t1 - t_in1, t_in1 - t_in0, 0)
             recorded = True

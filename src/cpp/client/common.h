@@ -56,6 +56,11 @@ struct InferOptions {
   uint64_t priority = 0;
   uint64_t timeout_us = 0;       // server-side request timeout
   uint64_t client_timeout_us = 0;  // client-side socket deadline
+  // Decoupled streams: ask the server to append one EMPTY response marked
+  // triton_final_response=true when the request's stream completes, so the
+  // client detects completion without model-specific EOS knowledge
+  // (reference triton_enable_empty_final_response parameter).
+  bool enable_empty_final_response = false;
 };
 
 // Per-client aggregate of request timers (reference common.h:94-115
@@ -257,11 +262,17 @@ class InferResult {
   // common.h InferResult::RequestStatus).
   const Error& RequestStatus() const { return error_; }
 
+  // Decoupled streams: true on the final marker response
+  // (triton_final_response=true; see InferOptions
+  // enable_empty_final_response).
+  bool IsFinalResponse() const { return is_final_response_; }
+
   std::string model_name_;
   std::string id_;
   std::map<std::string, Output> outputs_;
   std::string body_;  // owns the raw response bytes
   Error error_;
+  bool is_final_response_ = false;
 };
 using InferResultPtr = std::shared_ptr<InferResult>;
 

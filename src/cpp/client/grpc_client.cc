@@ -220,6 +220,11 @@ ParseGrpcInferResult(
   auto* res = new InferResult();
   res->model_name_ = response.model_name();
   res->id_ = response.id();
+  {
+    const auto it = response.parameters().find("triton_final_response");
+    if (it != response.parameters().end())
+      res->is_final_response_ = it->second.bool_param();
+  }
   // Raw output bytes move into body_; Output.data points into it.
   size_t total = 0;
   for (const auto& raw : response.raw_output_contents()) total += raw.size();
@@ -803,6 +808,8 @@ InferenceServerGrpcClient::BuildInferRequest(
     SetParam(params, "priority", static_cast<int64_t>(options.priority));
   if (options.timeout_us != 0)
     SetParam(params, "timeout", static_cast<int64_t>(options.timeout_us));
+  if (options.enable_empty_final_response)
+    SetParam(params, "triton_enable_empty_final_response", true);
 
   for (const InferInput* input : inputs) {
     auto* tensor = request->add_inputs();

@@ -14,7 +14,10 @@
 //               [--completion-sync]              (wire outputs: latency
 //                                                 covers compute + D2H)
 //               [--sequences N] [--seq-steps M]  (bidi sequence streaming)
-//               [--wire-input NAME:DTYPE:d1,d2,...]...
+//               [--decoupled]                    (N-responses-per-request
+//                                                 streaming: TTFT latency,
+//                                                 final-marker completion)
+//               [--wire-input NAME:DTYPE:d1,d2,...[=VALUE]]...
 //               [--shm-input NAME:DTYPE:d1,d2:REGION:NBYTES]...
 //               [--shm-output NAME:REGION:NBYTES]...
 //
@@ -58,6 +61,8 @@ struct TensorArg {
   std::vector<int64_t> shape;
   std::string region;  // shm variants
   size_t nbytes = 0;
+  bool has_fill = false;  // --wire-input NAME:DTYPE:dims=VALUE
+  int64_t fill_value = 0;
 };
 
 std::vector<std::string>
@@ -84,7 +89,19 @@ ParseTensorArg(const std::string& text, bool shm, bool output, TensorArg* out)
   if (parts.size() != (shm ? 5u : 3u)) return false;
   out->name = parts[0];
   out->datatype = parts[1];
-  for (const auto& d : Split(parts[2], ',')) out->shape.push_back(std::stoll(d));
+  std::string dims = parts[2];
+  if (!shm) {
+    // optional "=VALUE" suffix: constant fill instead of random bytes
+    // (decoupled models read a response count from the input, so the
+    // payload must be a controlled value)
+    const auto eq = dims.find('=');
+    if (eq != std::string::npos) {
+      out->has_fill = true;
+      out->fill_value = std::stoll(dims.substr(eq + 1));
+      dims = dims.substr(0, eq);
+    }
+  }
+  for (const auto& d : Split(dims, ',')) out->shape.push_back(std::stoll(d));
   if (shm) {
     out->region = parts[3];
     out->nbytes = std::stoull(parts[4]);
@@ -199,7 +216,7 @@ class Recorder {
 
   void Report(
       int64_t window_start, int64_t window_end, size_t delayed,
-      const char* mode)
+      const char* mode, const std::string& extra_json = "")
   {
     std::vector<Record> records;
     {
@@ -228,11 +245,11 @@ class Recorder {
         "{\"ok\": %zu, \"errors\": %zu, \"delayed\": %zu, "
         "\"elapsed_s\": %.3f, \"throughput\": %.2f, \"p50_us\": %.1f, "
         "\"p90_us\": %.1f, \"p95_us\": %.1f, \"p99_us\": %.1f, "
-        "\"avg_us\": %.1f, \"mode\": \"%s\"}\n",
+        "\"avg_us\": %.1f, %s\"mode\": \"%s\"}\n",
         ok, errors, delayed, elapsed_s,
         elapsed_s > 0 ? ok / elapsed_s : 0.0, Percentile(lat_us, 50),
         Percentile(lat_us, 90), Percentile(lat_us, 95),
-        Percentile(lat_us, 99), avg, mode);
+        Percentile(lat_us, 99), avg, extra_json.c_str(), mode);
   }
 
  private:
@@ -426,7 +443,9 @@ class SequenceRunner {
   {
   }
 
-  bool Run(double warmup_s, double duration_s)
+  // 0 = measured and drained; 1 = stream never started (no measurement);
+  // 3 = measured but the drain timed out (in-flight callbacks may fire).
+  int Run(double warmup_s, double duration_s)
   {
     stop_.store(false);
     tc::Error err = client_->StartStream(
@@ -434,7 +453,7 @@ class SequenceRunner {
     if (!err.IsOk()) {
       std::fprintf(stderr, "stream start failed: %s\n",
                    err.Message().c_str());
-      return false;
+      return 1;
     }
     {
       std::lock_guard<std::mutex> lk(mu_);
@@ -466,7 +485,7 @@ class SequenceRunner {
           lk, std::chrono::seconds(60), [&] { return in_flight_.empty(); });
     }
     client_->StopStream();
-    return drained;
+    return drained ? 0 : 3;
   }
 
   void Report() { recorder_.Report(window_start_, window_end_, 0, "sequence"); }
@@ -518,7 +537,10 @@ class SequenceRunner {
   void OnResponse(tc::InferResultPtr result)
   {
     const bool ok = result->RequestStatus().IsOk();
-    const std::string id = ok ? result->Id() : std::string();
+    // error results still carry the request id when the failure was
+    // per-request (grpc_client fills it); only id-less stream-level
+    // errors fall back to charging an arbitrary in-flight entry
+    const std::string id = result->Id();
     SeqState st{0, 0};
     int64_t start = 0;
     bool matched = false;
@@ -526,7 +548,7 @@ class SequenceRunner {
       std::lock_guard<std::mutex> lk(mu_);
       auto it = id.empty() ? in_flight_.end() : in_flight_.find(id);
       if (it == in_flight_.end() && !in_flight_.empty() && !ok) {
-        it = in_flight_.begin();  // stream-level error: charge oldest
+        it = in_flight_.begin();  // id-less stream error: charge any entry
       }
       if (it != in_flight_.end()) {
         start = it->second.first;
@@ -570,6 +592,162 @@ class SequenceRunner {
   int64_t window_end_ = 0;
 };
 
+// Decoupled (N-responses-per-request) streaming load: LLM token-stream
+// shape (reference measures FIRST-response latency for decoupled models,
+// perf_analyzer.cc:334-337; completion detection rides the
+// triton_final_response marker requested via enable_empty_final_response).
+// `concurrency` decoupled requests stay outstanding; each final marker
+// re-arms its slot through the pump thread.  Recorded latency per request
+// is time-to-first-response; ok counts completed requests; the report
+// carries the total (token) response count.
+class DecoupledRunner {
+ public:
+  DecoupledRunner(tc::InferenceServerGrpcClient* client,
+                  const std::string& model,
+                  std::vector<tc::InferInput*> inputs, int concurrency,
+                  double window_interval_s)
+      : client_(client), model_(model), inputs_(std::move(inputs)),
+        concurrency_(concurrency), window_interval_s_(window_interval_s)
+  {
+  }
+
+  // 0 = measured and drained; 1 = stream never started (no measurement);
+  // 3 = measured but the drain timed out (in-flight callbacks may fire).
+  int Run(double warmup_s, double duration_s)
+  {
+    stop_.store(false);
+    tc::Error err = client_->StartStream(
+        [this](tc::InferResultPtr result) { OnResponse(std::move(result)); });
+    if (!err.IsOk()) {
+      std::fprintf(stderr, "stream start failed: %s\n",
+                   err.Message().c_str());
+      return 1;
+    }
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      slots_free_ = concurrency_;
+    }
+    pump_ = std::thread([this] { PumpLoop(); });
+    pump_cv_.notify_all();
+    std::this_thread::sleep_for(std::chrono::duration<double>(warmup_s));
+    recorder_.ClearForMeasurement();
+    responses_.store(0);
+    window_start_ = Now();
+    recorder_.StartWindows(window_interval_s_);
+    std::this_thread::sleep_for(std::chrono::duration<double>(duration_s));
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      stop_.store(true);
+    }
+    window_end_ = Now();
+    recorder_.StopWindows();
+    pump_cv_.notify_all();
+    if (pump_.joinable()) pump_.join();
+    bool drained;
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      drained = drained_.wait_for(
+          lk, std::chrono::seconds(60), [&] { return in_flight_.empty(); });
+    }
+    client_->StopStream();
+    return drained ? 0 : 3;
+  }
+
+  void Report()
+  {
+    recorder_.Report(
+        window_start_, window_end_, 0, "decoupled",
+        "\"responses\": " + std::to_string(responses_.load()) + ", ");
+  }
+
+ private:
+  struct Flight {
+    int64_t start_ns;
+    int64_t first_response_ns = 0;
+  };
+
+  void PumpLoop()
+  {
+    while (true) {
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        pump_cv_.wait(lk, [&] { return slots_free_ > 0 || stop_.load(); });
+        if (stop_.load()) return;
+        slots_free_--;
+      }
+      tc::InferOptions options(model_);
+      options.enable_empty_final_response = true;
+      options.request_id = "d-" + std::to_string(next_id_++);
+      const int64_t start = Now();
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        in_flight_[options.request_id] = Flight{start, 0};
+      }
+      tc::Error err = client_->AsyncStreamInfer(options, inputs_);
+      if (!err.IsOk()) {
+        {
+          std::lock_guard<std::mutex> lk(mu_);
+          in_flight_.erase(options.request_id);
+          recorder_.Push(start, Now(), false);
+          if (!stop_.load()) slots_free_++;
+          if (in_flight_.empty()) drained_.notify_all();
+        }
+        std::this_thread::sleep_for(std::chrono::milliseconds(2));
+      }
+    }
+  }
+
+  void OnResponse(tc::InferResultPtr result)
+  {
+    const bool ok = result->RequestStatus().IsOk();
+    // per-request errors keep their id (grpc_client fills it); only
+    // id-less stream-level errors fall back to an arbitrary entry
+    const std::string id = result->Id();
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = id.empty() ? in_flight_.end() : in_flight_.find(id);
+    if (it == in_flight_.end() && !in_flight_.empty() && !ok) {
+      it = in_flight_.begin();  // id-less stream error: charge any entry
+    }
+    if (it == in_flight_.end()) return;
+    if (ok && !result->IsFinalResponse()) {
+      responses_.fetch_add(1);  // content responses only, not the marker
+    }
+    if (it->second.first_response_ns == 0) {
+      it->second.first_response_ns = Now();
+    }
+    if (result->IsFinalResponse() || !ok) {
+      // latency sample = time to FIRST response (reference decoupled
+      // semantics); the final marker closes the request
+      recorder_.Push(
+          it->second.start_ns, it->second.first_response_ns, ok);
+      in_flight_.erase(it);
+      if (!stop_.load()) {
+        slots_free_++;
+        pump_cv_.notify_one();
+      }
+      if (in_flight_.empty()) drained_.notify_all();
+    }
+  }
+
+  tc::InferenceServerGrpcClient* client_;
+  std::string model_;
+  std::vector<tc::InferInput*> inputs_;
+  int concurrency_;
+  double window_interval_s_;
+  Recorder recorder_;
+  std::mutex mu_;
+  std::condition_variable pump_cv_;
+  std::condition_variable drained_;
+  std::thread pump_;
+  std::map<std::string, Flight> in_flight_;
+  int slots_free_ = 0;
+  uint64_t next_id_ = 1;
+  std::atomic<bool> stop_{false};
+  std::atomic<size_t> responses_{0};
+  int64_t window_start_ = 0;
+  int64_t window_end_ = 0;
+};
+
 }  // namespace
 
 int
@@ -583,6 +761,7 @@ main(int argc, char** argv)
   bool poisson = false;
   double window_interval_s = 0.0;
   bool completion_sync = false;
+  bool decoupled = false;
   int sequences = 0, seq_steps = 8;
   std::vector<TensorArg> wire_inputs, shm_inputs, shm_outputs;
   for (int i = 1; i < argc; ++i) {
@@ -617,6 +796,8 @@ main(int argc, char** argv)
       sequences = std::stoi(next());
     } else if (arg == "--seq-steps") {
       seq_steps = std::stoi(next());
+    } else if (arg == "--decoupled") {
+      decoupled = true;
     } else if (arg == "--wire-input" || arg == "--shm-input" ||
                arg == "--shm-output") {
       TensorArg tensor;
@@ -656,8 +837,19 @@ main(int argc, char** argv)
     for (const int64_t d : tensor.shape) elems *= static_cast<size_t>(d);
     payloads.emplace_back();
     std::string& payload = payloads.back();
-    payload.resize(elems * DtypeSize(tensor.datatype));
-    for (char& b : payload) b = static_cast<char>(rng() & 0x3f);
+    const size_t elem_size = DtypeSize(tensor.datatype);
+    payload.resize(elems * elem_size);
+    if (tensor.has_fill) {
+      // little-endian constant per element, truncated to the dtype width
+      for (size_t e = 0; e < elems; ++e) {
+        for (size_t b = 0; b < elem_size; ++b) {
+          payload[e * elem_size + b] = static_cast<char>(
+              (static_cast<uint64_t>(tensor.fill_value) >> (8 * b)) & 0xff);
+        }
+      }
+    } else {
+      for (char& b : payload) b = static_cast<char>(rng() & 0x3f);
+    }
     auto input = std::make_unique<tc::InferInput>(
         tensor.name, tensor.shape, tensor.datatype);
     input->AppendRaw(
@@ -696,10 +888,25 @@ main(int argc, char** argv)
     SequenceRunner runner(
         client.get(), model, inputs, outputs, sequences, seq_steps,
         window_interval_s);
-    const bool drained = runner.Run(warmup_s, duration_s);
+    const int rc = runner.Run(warmup_s, duration_s);
+    if (rc == 1) return 1;  // stream never started: no report to print
     runner.Report();
-    if (!drained) {
+    if (rc == 3) {
       std::fprintf(stderr, "warning: sequence drain timed out\n");
+      std::fflush(stdout);
+      std::_Exit(3);
+    }
+    return 0;
+  }
+
+  if (decoupled) {
+    DecoupledRunner runner(
+        client.get(), model, inputs, concurrency, window_interval_s);
+    const int rc = runner.Run(warmup_s, duration_s);
+    if (rc == 1) return 1;
+    runner.Report();
+    if (rc == 3) {
+      std::fprintf(stderr, "warning: decoupled drain timed out\n");
       std::fflush(stdout);
       std::_Exit(3);
     }

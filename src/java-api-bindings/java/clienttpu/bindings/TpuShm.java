@@ -1,0 +1,180 @@
+// Java bindings for the framework's C shared-memory ABI — the analog of the
+// reference's java-api-bindings (which JavaCPP-binds the server's C API,
+// src/java-api-bindings/scripts/install_dependencies_and_build.sh).  This
+// framework's bindable C seam is the shared-memory transport
+// (src/cpp/shm/cshm.cc `TpuShm*` exports in libcshm_tpu.so): a JVM process
+// maps the same POSIX region a client_tpu server/client uses and exchanges
+// tensors zero-copy, then references the region by name over the Java HTTP
+// client (src/java/clienttpu).
+//
+// Implemented with java.lang.foreign (FFM, finalized in JDK 22) — no JNI
+// compile step, no JavaCPP dependency.  Compile with `make java-bindings`
+// (skipped automatically on older JDKs).
+package clienttpu.bindings;
+
+import java.lang.foreign.Arena;
+import java.lang.foreign.FunctionDescriptor;
+import java.lang.foreign.Linker;
+import java.lang.foreign.MemorySegment;
+import java.lang.foreign.SymbolLookup;
+import java.lang.foreign.ValueLayout;
+import java.lang.invoke.MethodHandle;
+import java.nio.file.Path;
+
+public final class TpuShm {
+  private final Linker linker = Linker.nativeLinker();
+  private final MethodHandle create;
+  private final MethodHandle open;
+  private final MethodHandle write;
+  private final MethodHandle read;
+  private final MethodHandle byteSize;
+  private final MethodHandle close;
+  private final MethodHandle lastError;
+
+  public TpuShm(Path library) {
+    SymbolLookup lib = SymbolLookup.libraryLookup(library, Arena.global());
+    create = handle(lib, "TpuShmCreate",
+        FunctionDescriptor.of(ValueLayout.ADDRESS, ValueLayout.ADDRESS,
+            ValueLayout.JAVA_LONG));
+    open = handle(lib, "TpuShmOpen",
+        FunctionDescriptor.of(ValueLayout.ADDRESS, ValueLayout.ADDRESS,
+            ValueLayout.JAVA_LONG, ValueLayout.JAVA_LONG));
+    write = handle(lib, "TpuShmWrite",
+        FunctionDescriptor.of(ValueLayout.JAVA_INT, ValueLayout.ADDRESS,
+            ValueLayout.JAVA_LONG, ValueLayout.ADDRESS,
+            ValueLayout.JAVA_LONG));
+    read = handle(lib, "TpuShmRead",
+        FunctionDescriptor.of(ValueLayout.JAVA_INT, ValueLayout.ADDRESS,
+            ValueLayout.JAVA_LONG, ValueLayout.ADDRESS,
+            ValueLayout.JAVA_LONG));
+    byteSize = handle(lib, "TpuShmByteSize",
+        FunctionDescriptor.of(ValueLayout.JAVA_LONG, ValueLayout.ADDRESS));
+    close = handle(lib, "TpuShmClose",
+        FunctionDescriptor.of(ValueLayout.JAVA_INT, ValueLayout.ADDRESS,
+            ValueLayout.JAVA_INT));
+    lastError = handle(lib, "TpuShmLastError",
+        FunctionDescriptor.of(ValueLayout.ADDRESS));
+  }
+
+  private MethodHandle handle(
+      SymbolLookup lib, String name, FunctionDescriptor descriptor) {
+    return linker.downcallHandle(
+        lib.find(name).orElseThrow(
+            () -> new IllegalStateException("missing symbol " + name)),
+        descriptor);
+  }
+
+  private String lastError() {
+    try {
+      MemorySegment msg = (MemorySegment) lastError.invoke();
+      return msg.reinterpret(4096).getString(0);
+    } catch (Throwable t) {
+      return "(error message unavailable: " + t + ")";
+    }
+  }
+
+  /** One mapped region; close() unmaps (keeping the key for other users). */
+  public final class Region implements AutoCloseable {
+    private MemorySegment handle;
+
+    private Region(MemorySegment handle) {
+      this.handle = handle;
+    }
+
+    public long byteSize() {
+      try {
+        return (long) byteSize.invoke(handle);
+      } catch (Throwable t) {
+        throw new IllegalStateException(t);
+      }
+    }
+
+    public void write(long offset, byte[] data) {
+      try (Arena arena = Arena.ofConfined()) {
+        MemorySegment src = arena.allocate(data.length);
+        MemorySegment.copy(data, 0, src, ValueLayout.JAVA_BYTE, 0,
+            data.length);
+        int rc = (int) TpuShm.this.write.invoke(
+            handle, offset, src, (long) data.length);
+        if (rc != 0) {
+          throw new IllegalStateException("TpuShmWrite: " + lastError());
+        }
+      } catch (Throwable t) {
+        throw asRuntime(t);
+      }
+    }
+
+    public byte[] read(long offset, int length) {
+      try (Arena arena = Arena.ofConfined()) {
+        MemorySegment dst = arena.allocate(length);
+        int rc = (int) TpuShm.this.read.invoke(
+            handle, offset, dst, (long) length);
+        if (rc != 0) {
+          throw new IllegalStateException("TpuShmRead: " + lastError());
+        }
+        byte[] out = new byte[length];
+        MemorySegment.copy(dst, ValueLayout.JAVA_BYTE, 0, out, 0, length);
+        return out;
+      } catch (Throwable t) {
+        throw asRuntime(t);
+      }
+    }
+
+    /** Unmap; keepKey leaves the shm key linked for other processes. */
+    public void close(boolean keepKey) {
+      if (handle == null) {
+        return;
+      }
+      try {
+        int rc = (int) TpuShm.this.close.invoke(handle, keepKey ? 1 : 0);
+        if (rc != 0) {
+          throw new IllegalStateException("TpuShmClose: " + lastError());
+        }
+      } catch (Throwable t) {
+        throw asRuntime(t);
+      } finally {
+        handle = null;
+      }
+    }
+
+    @Override
+    public void close() {
+      close(true);
+    }
+  }
+
+  public Region create(String key, long byteSizeBytes) {
+    return regionFrom(invokeFactory(create, key, byteSizeBytes, null),
+        "TpuShmCreate");
+  }
+
+  public Region open(String key, long byteSizeBytes, long offset) {
+    return regionFrom(invokeFactory(open, key, byteSizeBytes, offset),
+        "TpuShmOpen");
+  }
+
+  private MemorySegment invokeFactory(
+      MethodHandle factory, String key, long size, Long offset) {
+    try (Arena arena = Arena.ofConfined()) {
+      MemorySegment ckey = arena.allocateFrom(key);
+      return offset == null
+          ? (MemorySegment) factory.invoke(ckey, size)
+          : (MemorySegment) factory.invoke(ckey, size, (long) offset);
+    } catch (Throwable t) {
+      throw asRuntime(t);
+    }
+  }
+
+  private Region regionFrom(MemorySegment handle, String what) {
+    if (handle == null || handle.equals(MemorySegment.NULL)) {
+      throw new IllegalStateException(what + ": " + lastError());
+    }
+    return new Region(handle);
+  }
+
+  private static RuntimeException asRuntime(Throwable t) {
+    return t instanceof RuntimeException
+        ? (RuntimeException) t
+        : new IllegalStateException(t);
+  }
+}

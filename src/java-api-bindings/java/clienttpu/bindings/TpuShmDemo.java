@@ -1,0 +1,67 @@
+// Exercises the FFM bindings (TpuShm.java) against libcshm_tpu.so.
+//
+//   java clienttpu.bindings.TpuShmDemo <libcshm_tpu.so> selftest
+//   java clienttpu.bindings.TpuShmDemo <libcshm_tpu.so> exchange <key> <size>
+//
+// selftest: create a region, write a pattern, read it back, destroy.
+// exchange: open an EXISTING region (created by the Python side in
+// tests/test_java_client.py), print its contents as hex, then overwrite
+// every byte with (byte XOR 0x5A) — the Python side then verifies the
+// transform, proving both directions cross the JVM/native boundary and the
+// two runtimes really shared one mapping.
+package clienttpu.bindings;
+
+import java.nio.file.Path;
+
+public final class TpuShmDemo {
+  public static void main(String[] args) {
+    if (args.length < 2) {
+      System.err.println("usage: TpuShmDemo <lib> selftest|exchange ...");
+      System.exit(2);
+    }
+    TpuShm shm = new TpuShm(Path.of(args[0]));
+    switch (args[1]) {
+      case "selftest" -> selftest(shm);
+      case "exchange" -> exchange(shm, args[2], Long.parseLong(args[3]));
+      default -> {
+        System.err.println("unknown mode " + args[1]);
+        System.exit(2);
+      }
+    }
+  }
+
+  private static void selftest(TpuShm shm) {
+    String key = "/jffm-selftest-" + ProcessHandle.current().pid();
+    try (TpuShm.Region region = shm.create(key, 256)) {
+      byte[] pattern = new byte[256];
+      for (int i = 0; i < pattern.length; i++) {
+        pattern[i] = (byte) (i * 7);
+      }
+      region.write(0, pattern);
+      byte[] back = region.read(0, pattern.length);
+      if (region.byteSize() != 256 || !java.util.Arrays.equals(pattern, back)) {
+        System.out.println("FAIL selftest: readback mismatch");
+        System.exit(1);
+      }
+      region.close(false);  // drop the key: nothing else uses it
+    }
+    System.out.println("PASS: java ffm shm selftest");
+  }
+
+  private static void exchange(TpuShm shm, String key, long size) {
+    try (TpuShm.Region region = shm.open(key, size, 0)) {
+      byte[] data = region.read(0, (int) size);
+      StringBuilder hex = new StringBuilder();
+      for (byte b : data) {
+        hex.append(String.format("%02x", b));
+      }
+      System.out.println("read-hex " + hex);
+      byte[] transformed = new byte[data.length];
+      for (int i = 0; i < data.length; i++) {
+        transformed[i] = (byte) (data[i] ^ 0x5A);
+      }
+      region.write(0, transformed);
+    }
+    System.out.println("PASS: java ffm shm exchange");
+  }
+}

@@ -265,6 +265,35 @@ def test_native_perf_worker_sequences(dual_server):
 
 
 @needs_grpc_cpp
+def test_native_perf_worker_decoupled(dual_server):
+    """Decoupled streaming in the native engine: each request to
+    repeat_int32 (IN=5 via constant fill) yields 5 responses + the
+    triton_final_response marker; latency is time-to-first-response and
+    the report counts the content responses."""
+    from client_tpu.perf.native_worker import (
+        native_worker_available,
+        run_native_worker,
+    )
+
+    if not native_worker_available():
+        pytest.skip("perf_worker not built")
+    report = run_native_worker(
+        dual_server.grpc_address, "repeat_int32",
+        concurrency=4, duration_s=2.0, warmup_s=0.3,
+        decoupled=True,
+        wire_inputs=[("IN", "INT32", [1], 5)],
+    )
+    assert report["mode"] == "decoupled"
+    assert report["errors"] == 0
+    assert report["ok"] > 20
+    # ~5 content responses per completed request.  Up to `concurrency`
+    # requests straddle the warmup/measurement reset with some of their
+    # responses counted pre-reset, so the exact bound is (ok - c) * 5.
+    assert report["responses"] >= max(report["ok"] - 4, 1) * 5
+    assert 0 < report["p50_us"] <= report["p99_us"]
+
+
+@needs_grpc_cpp
 def test_perf_cli_native_loadgen(dual_server):
     """`python -m client_tpu.perf --native-loadgen` sweeps concurrency with
     the C++ engine (region setup python-side, measurement loop native)."""

@@ -73,6 +73,71 @@ def test_async_infer_perf(java_classes, server):
     assert "infer/sec" in proc.stdout
 
 
+def _javac_major():
+    out = subprocess.run(
+        ["javac", "--version"], capture_output=True, text=True
+    ).stdout
+    digits = "".join(c for c in out.split()[-1].split(".")[0] if c.isdigit())
+    return int(digits or 0)
+
+
+@pytest.fixture(scope="module")
+def java_bindings_classes():
+    if _javac_major() < 22:
+        pytest.skip("java FFM bindings need JDK >= 22")
+    proc = subprocess.run(
+        ["make", "java-bindings"], cwd=_REPO, capture_output=True, text=True,
+        timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr
+    classes = os.path.join(_REPO, "build", "java-bindings", "classes")
+    assert os.path.isdir(classes)
+    return classes
+
+
+_CSHM = os.path.join(
+    _REPO, "client_tpu", "utils", "shared_memory", "libcshm_tpu.so"
+)
+
+
+def test_ffm_shm_selftest(java_bindings_classes):
+    """The java.lang.foreign bindings (src/java-api-bindings/java) drive the
+    C shm ABI end to end in-process: create, write, readback, destroy."""
+    proc = _run_main(
+        java_bindings_classes, "clienttpu.bindings.TpuShmDemo",
+        _CSHM, "selftest",
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "PASS: java ffm shm selftest" in proc.stdout
+
+
+def test_ffm_shm_cross_language_exchange(java_bindings_classes):
+    """Python creates a POSIX shm region; the JVM opens the SAME region via
+    the FFM bindings, reads the pattern, and writes back each byte XOR 0x5A;
+    Python verifies the transform — both directions crossed the
+    JVM<->native boundary on one shared mapping."""
+    import numpy as np
+
+    from client_tpu.utils import shared_memory as cshm
+
+    key = f"/jffm-x-{os.getpid()}"
+    pattern = np.arange(64, dtype=np.uint8)
+    handle = cshm.create_shared_memory_region("jffm", key, 64)
+    try:
+        cshm.set_shared_memory_region(handle, [pattern])
+        proc = _run_main(
+            java_bindings_classes, "clienttpu.bindings.TpuShmDemo",
+            _CSHM, "exchange", key, "64",
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "read-hex " + pattern.tobytes().hex() in proc.stdout
+        assert "PASS: java ffm shm exchange" in proc.stdout
+        back = cshm.get_contents_as_numpy(handle, np.uint8, [64])
+        np.testing.assert_array_equal(back, pattern ^ 0x5A)
+    finally:
+        cshm.destroy_shared_memory_region(handle)
+
+
 def test_golden_wire(java_classes):
     """No server needed: the Java client's encoding is asserted against the
     Python-generated golden bytes (tests/golden/, kept current by

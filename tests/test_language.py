@@ -138,6 +138,44 @@ def test_lm_streaming_over_grpc(client, model_name):
     assert all(0 <= t < 258 for t in tokens)
 
 
+def test_decoupled_final_response_protocol(client):
+    """Triton's decoupled completion protocol: every streamed response is
+    marked triton_final_response=false, and with
+    enable_empty_final_response the stream ends with one extra EMPTY
+    response marked true — completion detection without model-specific EOS
+    logic (reference grpc/__init__.py triton_enable_empty_final_response)."""
+    results = queue.Queue()
+    client.start_stream(
+        callback=lambda result, error: results.put((result, error))
+    )
+    prompt = encode_text("abc")
+    t_in = grpcclient.InferInput("TOKENS", [len(prompt)], "INT32")
+    t_in.set_data_from_numpy(prompt)
+    m_in = grpcclient.InferInput("MAX_TOKENS", [1], "INT32")
+    m_in.set_data_from_numpy(np.array([4], dtype=np.int32))
+    client.async_stream_infer(
+        "lm_streaming", [t_in, m_in], enable_empty_final_response=True
+    )
+    seen_final = False
+    token_responses = 0
+    for _ in range(12):
+        result, error = results.get(timeout=30)
+        assert error is None
+        params = result.get_response().parameters
+        is_final = params["triton_final_response"].bool_param
+        if is_final:
+            # the final marker response is EMPTY
+            assert result.as_numpy("TOKEN") is None
+            seen_final = True
+            break
+        assert params["triton_final_response"].bool_param is False
+        assert result.as_numpy("TOKEN") is not None
+        token_responses += 1
+    client.stop_stream()
+    assert seen_final
+    assert token_responses >= 1
+
+
 def test_lm_streaming_deterministic(runner):
     a = list(runner.stream(encode_text("abc"), 5))
     b = list(runner.stream(encode_text("abc"), 5))
