@@ -462,6 +462,110 @@ def _run_seq_stream(server, n_sequences=8, steps=25):
     }
 
 
+def _run_seq_native(server, n_sequences=8, steps=25):
+    """Config 4 on the NATIVE engine: stateful sequences over one bidi
+    stream driven by perf_worker --sequences (GIL-free instrument; the
+    python-client seq_stream_* figures stay alongside)."""
+    from client_tpu.perf.native_worker import (
+        native_worker_available,
+        run_native_worker,
+    )
+
+    if not native_worker_available():
+        return {}
+    try:
+        report = run_native_worker(
+            server.grpc_address, "simple_sequence",
+            concurrency=1, duration_s=4.0, warmup_s=1.0,
+            sequences=n_sequences, seq_steps=steps,
+            wire_inputs=[("INPUT", "INT32", [1], 1)],
+        )
+    except Exception as e:
+        print(f"native sequence run unavailable: {e}", file=sys.stderr)
+        return {}
+    return {
+        "seq_native_msgs_per_sec": round(report["throughput"], 2),
+        "seq_native_p50_ms": round(report["p50_us"] / 1e3, 3),
+        "seq_native_p99_ms": round(report["p99_us"] / 1e3, 3),
+    }
+
+
+def _run_lm_native(server, concurrency=4, max_tokens=32, prompt_len=8,
+                   model_name="lm_streaming_int8", key_prefix="lm_native"):
+    """Config 5 on the NATIVE engine: CONCURRENT decoupled LM token streams
+    via perf_worker --decoupled.  Aggregate tokens/sec across streams is
+    the capacity number the single-stream python lm_tokens_per_sec cannot
+    show.  Run on lm_streaming_int8 (per-request decode: streams serialize)
+    and lm_streaming_batched (continuous batching: streams share one
+    batched decode tick — models/continuous.py), the pair that shows what
+    continuous batching buys."""
+    import client_tpu.grpc as grpcclient
+
+    from client_tpu.perf.native_worker import (
+        native_worker_available,
+        run_native_worker,
+    )
+
+    if not native_worker_available():
+        return {}
+    # prewarm the shape-keyed jit for THIS prompt/max_tokens shape from
+    # python so the native window measures serving, not the compiler —
+    # degrading to {} on any failure like every other native config (one
+    # broken model must not discard the rest of the bench)
+    import queue
+
+    try:
+        with grpcclient.InferenceServerClient(server.grpc_address) as client:
+            results = queue.Queue()
+            client.start_stream(
+                callback=lambda result, error: results.put((result, error))
+            )
+            t_in = grpcclient.InferInput("TOKENS", [prompt_len], "INT32")
+            t_in.set_data_from_numpy(np.full(prompt_len, 5, dtype=np.int32))
+            m_in = grpcclient.InferInput("MAX_TOKENS", [1], "INT32")
+            m_in.set_data_from_numpy(np.array([max_tokens], dtype=np.int32))
+            client.async_stream_infer(
+                model_name, [t_in, m_in],
+                enable_empty_final_response=True,
+            )
+            while True:
+                r, e = results.get(timeout=600)
+                if e is not None:
+                    raise RuntimeError(f"LM prewarm error: {e}")
+                params = r.get_response().parameters
+                if params["triton_final_response"].bool_param:
+                    break
+            client.stop_stream()
+    except Exception as e:
+        print(f"native LM prewarm unavailable ({model_name}): {e}",
+              file=sys.stderr)
+        return {}
+    try:
+        report = run_native_worker(
+            server.grpc_address, model_name,
+            concurrency=concurrency, duration_s=MEASURE_S, warmup_s=2.0,
+            decoupled=True,
+            wire_inputs=[
+                ("TOKENS", "INT32", [prompt_len], 5),
+                ("MAX_TOKENS", "INT32", [1], max_tokens),
+            ],
+        )
+    except Exception as e:
+        print(f"native LM run unavailable: {e}", file=sys.stderr)
+        return {}
+    return {
+        # content responses ARE tokens (one KServe response per token).
+        # The counter includes the post-window drain tail of in-flight
+        # streams (bounded by concurrency*max_tokens, ~1-3% here).
+        f"{key_prefix}_tokens_per_sec": round(
+            report["responses"] / report["elapsed_s"], 2
+        ) if report.get("elapsed_s") else 0.0,
+        f"{key_prefix}_streams": concurrency,
+        f"{key_prefix}_ttft_p50_ms": round(report["p50_us"] / 1e3, 2),
+        f"{key_prefix}_requests": report["ok"],
+    }
+
+
 def _lm_prompt(i):
     # zero-padded so EVERY prompt (and the warmup) encodes to the same
     # token shape — the LM forward is shape-keyed jit
@@ -616,7 +720,17 @@ def main():
         wire = _run_wire(server, "cnn_classifier", WIRE_CONCURRENCY)
         wire_small = _run_wire(server, "cnn_small", WIRE_CONCURRENCY)
         seq = _run_seq_stream(server)
+        seq_native = _run_seq_native(server)
         lm = _run_lm_stream(server)
+        lm_native = _run_lm_native(server)
+        # continuous batching: same weights, concurrent streams SHARE one
+        # batched decode tick (serve/models/continuous.py) — 8 streams into
+        # 8 lanes; one link round-trip carries 8 tokens, so aggregate
+        # tokens/s scales where per-stream decode pays a round-trip each
+        lm_batched = _run_lm_native(
+            server, model_name="lm_streaming_batched", concurrency=8,
+            key_prefix="lm_batched",
+        )
     finally:
         server.stop()
 
@@ -782,7 +896,10 @@ def main():
         "wire_small64_infer_per_sec": round(wire_small["infer_per_sec"], 2),
         "wire_small64_p50_ms": round(wire_small["p50_ms"], 3),
         **seq,
+        **seq_native,
         **lm,
+        **lm_native,
+        **lm_batched,
         **link,
     }
     result["sync_floor_rtt_ms"] = link["link_rtt_ms"]

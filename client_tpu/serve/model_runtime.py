@@ -133,6 +133,8 @@ class Model:
         self.flops_per_item = flops_per_item
         self.config_override = None  # set by repository load with config param
         self.file_overrides = {}
+        # optional resource-release hook, called by InferenceEngine.close()
+        self.closer = None
 
     def metadata(self):
         return {
@@ -1118,8 +1120,18 @@ class InferenceEngine:
         with self._lock:
             batchers = list(self._batchers.values())
             self._batchers.clear()
+            models = list(self._models.values())
         for batcher in batchers:
             batcher.close()
+        # model-owned resources (e.g. the continuous-batching scheduler's
+        # thread + device cache) release with the engine, not the process
+        for model in models:
+            closer = getattr(model, "closer", None)
+            if closer is not None:
+                try:
+                    closer()
+                except Exception:
+                    pass
         self._busy_observer.close()
         self.shm.close()
 

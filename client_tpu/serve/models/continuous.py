@@ -1,0 +1,323 @@
+"""Slot-based continuous batching for decoupled LM token streaming.
+
+The Orca/vLLM idea in its static-shape TPU form: a fixed batch of
+``max_slots`` decode lanes runs ONE jitted ``decode_step`` per tick across
+every active stream.  ``transformer.decode_step`` is already per-row
+batched with heterogeneous positions (``cache["len"]`` is ``[B]``; rope,
+the KV scatter, and the attention mask are all per-row), so concurrent
+streams share each matmul instead of serializing whole decode programs —
+aggregate tokens/sec scales with active lanes, where per-request decode
+(one ``generate()`` per stream) stays flat.
+
+TPU-first constraints honored:
+- Static shapes everywhere: the lane count is fixed at construction; idle
+  lanes compute masked garbage that nobody reads (no dynamic batch growth,
+  no recompiles).  Admission splices a prefilled request's KV rows into the
+  batched cache with ``dynamic_update_slice`` at a *traced* slot index —
+  one executable regardless of slot.
+- Async dispatch: the scheduler thread dispatches decode ticks ahead of
+  readback; per-tick token vectors drain through a ``copy_to_host_async``
+  pipeline exactly like ``transformer.generate`` (depth ``readback_depth``),
+  so a high-RTT link bounds throughput at ~depth ticks/RTT, not 1/RTT.
+- Greedy selection stays on device (argmax inside the jitted tick).
+
+Reference analog: none — the reference is a client; its Llama config
+(BASELINE config 5) points at a server whose continuous batching lives in
+the backend.  Here the TPU-native server owns it.
+"""
+
+import functools
+import itertools
+import queue
+import threading
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from client_tpu.serve.models import transformer as tfm
+
+# sentinel object closing a stream's token queue
+_CLOSE = object()
+
+
+class _Slot:
+    __slots__ = ("gen", "active", "queue", "remaining", "produced")
+
+    def __init__(self):
+        self.gen = 0        # bumped on every (re)assignment and cancel
+        self.active = False
+        self.queue = None   # per-request token queue
+        self.remaining = 0  # tokens still to produce
+        self.produced = 0
+
+
+class ContinuousLmScheduler:
+    """Continuous-batching decode scheduler over a fixed lane count.
+
+    ``submit(prompt_tokens, max_tokens)`` returns a ``queue.Queue`` that
+    yields int token ids and finally the ``CLOSE`` sentinel; ``cancel``
+    releases a lane early (abandoned client streams).  Greedy decoding
+    only — the batched tick selects argmax on device; per-request
+    temperature would need per-lane RNG lanes (future work).
+    """
+
+    CLOSE = _CLOSE
+
+    def __init__(self, params, cfg, max_slots=4, readback_depth=8,
+                 eos_id=None, check_prompt=None):
+        self.params = params
+        self.cfg = cfg
+        self.max_slots = int(max_slots)
+        self.depth = max(int(readback_depth), 0)
+        self.eos_id = eos_id
+        self.check_prompt = check_prompt  # optional prompt validator
+        self._slots = [_Slot() for _ in range(self.max_slots)]
+        self._pending = []  # (prompt np.int32[1,T], max_tokens, q)
+        self._cv = threading.Condition()
+        self._closed = False
+
+        # device state allocates lazily with the thread: a Server that
+        # never routes a request here must not pin HBM for the lane cache
+        self._cache = None
+        self._tokens = None
+        self._prefill = jax.jit(functools.partial(tfm.prefill, cfg=cfg))
+
+        n_layers = cfg.n_layers
+
+        def adopt(cache, single, tokens, slot, first_token):
+            """Splice a prefilled batch-1 cache into lane ``slot`` and set
+            its next input token — slot is a traced index, one executable."""
+            out = {
+                "k": [
+                    lax.dynamic_update_slice(
+                        cache["k"][i], single["k"][i], (slot, 0, 0, 0)
+                    )
+                    for i in range(n_layers)
+                ],
+                "v": [
+                    lax.dynamic_update_slice(
+                        cache["v"][i], single["v"][i], (slot, 0, 0, 0)
+                    )
+                    for i in range(n_layers)
+                ],
+                "len": cache["len"].at[slot].set(single["len"][0]),
+            }
+            return out, tokens.at[slot].set(first_token)
+
+        self._adopt = jax.jit(adopt)
+
+        def tick(params, tokens, cache):
+            logits, cache = tfm.decode_step(params, tokens, cfg=cfg,
+                                            cache=cache)
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+
+        self._tick = jax.jit(tick)
+        self._thread = None  # started lazily on the first submit
+
+    def _ensure_thread_locked(self):
+        if self._thread is None:
+            self._cache = tfm.init_cache(self.cfg, self.max_slots)
+            self._tokens = jnp.zeros((self.max_slots,), jnp.int32)
+            self._thread = threading.Thread(
+                target=self._loop, name="lm-continuous-batcher", daemon=True
+            )
+            self._thread.start()
+
+    # -- request side ------------------------------------------------------
+
+    def submit(self, prompt_tokens, max_tokens):
+        """Returns (token_queue, handle); the queue ends with CLOSE."""
+        prompt = np.asarray(prompt_tokens, np.int32).reshape(1, -1)
+        # clamp like generate(): slot i's token goes to prompt_len + i
+        max_tokens = min(int(max_tokens),
+                         self.cfg.max_seq - prompt.shape[1])
+        q = queue.Queue()
+        if max_tokens <= 0:
+            q.put(_CLOSE)
+            return q, None
+        entry = [prompt, max_tokens, q, None]  # [3] = (slot, gen) once admitted
+        with self._cv:
+            if self._closed:
+                q.put(_CLOSE)
+                return q, None
+            self._ensure_thread_locked()
+            self._pending.append(entry)
+            self._cv.notify_all()
+        return q, entry
+
+    def cancel(self, handle):
+        """Release a stream early (consumer went away)."""
+        if handle is None:
+            return
+        with self._cv:
+            if handle in self._pending:
+                self._pending.remove(handle)
+                return
+            placed = handle[3]
+            if placed is None:
+                return
+            slot_idx, gen = placed
+            slot = self._slots[slot_idx]
+            if slot.active and slot.gen == gen:
+                slot.active = False
+                slot.gen += 1  # in-flight ticks for this lane drop on drain
+
+    def close(self):
+        with self._cv:
+            self._closed = True
+            for entry in self._pending:
+                entry[2].put(_CLOSE)
+            self._pending.clear()
+            for slot in self._slots:
+                if slot.active:
+                    slot.active = False
+                    slot.gen += 1
+                    slot.queue.put(_CLOSE)
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+
+    # -- scheduler loop ----------------------------------------------------
+
+    def _admit_locked(self):
+        """Move pending requests into free lanes (prefill + splice)."""
+        admitted = False
+        for slot_idx, slot in enumerate(self._slots):
+            if not self._pending or slot.active:
+                continue
+            prompt, max_tokens, q, _ = entry = self._pending.pop(0)
+            single = tfm.init_cache(self.cfg, 1)
+            logits, single = self._prefill(self.params, jnp.asarray(prompt),
+                                           cache=single)
+            first = jnp.argmax(logits, axis=-1).astype(jnp.int32)[0]
+            self._cache, self._tokens = self._adopt(
+                self._cache, single, self._tokens, slot_idx, first
+            )
+            slot.gen += 1
+            slot.active = True
+            slot.queue = q
+            slot.remaining = max_tokens
+            slot.produced = 0
+            entry[3] = (slot_idx, slot.gen)
+            # the prefill's own first token streams through the readback
+            # pipeline like every tick token (single-lane entry)
+            if hasattr(first, "copy_to_host_async"):
+                first.copy_to_host_async()
+            self._inflight.append((first, ((slot_idx, slot.gen),)))
+            admitted = True
+        return admitted
+
+    def _drain_one(self):
+        tokens_dev, snapshot = self._inflight.popleft()
+        vals = np.asarray(tokens_dev).reshape(-1)
+        with self._cv:
+            for slot_idx, gen in snapshot:
+                slot = self._slots[slot_idx]
+                if not slot.active or slot.gen != gen:
+                    continue  # cancelled/finished lane: stale tick token
+                # full ticks carry one token PER LANE (index by slot);
+                # single-lane prefill entries carry exactly one value
+                token = int(vals[slot_idx]) if vals.size > 1 else int(vals[0])
+                slot.queue.put(token)
+                slot.produced += 1
+                done = (
+                    slot.produced >= slot.remaining
+                    or (self.eos_id is not None and token == self.eos_id)
+                )
+                if done:
+                    slot.queue.put(_CLOSE)
+                    slot.active = False
+                    slot.gen += 1
+
+    def _loop(self):
+        try:
+            self._loop_inner()
+        except Exception:
+            # a dying scheduler must never strand consumers on q.get()
+            with self._cv:
+                for entry in self._pending:
+                    entry[2].put(_CLOSE)
+                self._pending.clear()
+                for slot in self._slots:
+                    if slot.active:
+                        slot.active = False
+                        slot.gen += 1
+                        slot.queue.put(_CLOSE)
+                self._closed = True
+            raise
+
+    def _loop_inner(self):
+        from collections import deque
+
+        self._inflight = deque()
+        while True:
+            with self._cv:
+                if self._closed:
+                    break
+                self._admit_locked()
+                active = [
+                    (i, s.gen) for i, s in enumerate(self._slots) if s.active
+                ]
+                if not active and not self._pending:
+                    if self._inflight:
+                        pass  # fall through to drain the tail
+                    else:
+                        self._cv.wait(timeout=0.1)
+                        continue
+            if active:
+                self._tokens, self._cache = self._tick(
+                    self.params, self._tokens, self._cache
+                )
+                if hasattr(self._tokens, "copy_to_host_async"):
+                    self._tokens.copy_to_host_async()
+                # full-batch snapshot: entry i maps to vals[slot_idx]
+                self._inflight.append(
+                    (self._tokens,
+                     tuple((slot_idx, gen) for slot_idx, gen in active))
+                )
+            while len(self._inflight) > (self.depth if active else 0):
+                self._drain_one()
+        # shutdown: drop the in-flight tail (queues already closed)
+        self._inflight.clear()
+
+
+class BatchedLmRunner:
+    """Drop-in ``stream()`` provider backed by ContinuousLmScheduler —
+    signature-compatible with language._LmRunner.stream so the batched
+    model reuses lm_streaming_model verbatim.  Greedy-only: the batched
+    tick argmaxes on device, so a sampled request is rejected with a clear
+    400 instead of silently decoding greedily."""
+
+    def __init__(self, params, cfg, max_slots=4, eos_id=None,
+                 check_prompt=None):
+        self.cfg = cfg
+        self.scheduler = ContinuousLmScheduler(
+            params, cfg, max_slots=max_slots, eos_id=eos_id,
+            check_prompt=check_prompt,
+        )
+
+    def stream(self, tokens, max_tokens, temperature=0.0, seed=0):
+        if temperature and float(temperature) > 0.0:
+            from client_tpu.utils import InferenceServerException
+
+            raise InferenceServerException(
+                "the continuous-batching LM decodes greedily (batched "
+                "on-device argmax); use lm_streaming for sampled "
+                "generation", status="400",
+            )
+        if self.scheduler.check_prompt is not None:
+            self.scheduler.check_prompt(
+                int(np.asarray(tokens).reshape(-1).shape[0])
+            )
+        q, handle = self.scheduler.submit(tokens, max_tokens)
+        try:
+            while True:
+                tok = q.get()
+                if tok is _CLOSE:
+                    return
+                yield tok
+        finally:
+            self.scheduler.cancel(handle)

@@ -165,6 +165,27 @@ def lm_streaming_model(name="lm_streaming", runner=None):
     )
 
 
+def lm_streaming_batched_model(name="lm_streaming_batched", runner=None,
+                               max_slots=8):
+    """Decoupled LM with CONTINUOUS BATCHING: concurrent streams share one
+    batched decode tick per token step (models/continuous.py), so aggregate
+    tokens/sec scales with active streams instead of serializing whole
+    per-request decode programs.  Greedy decoding (the scheduler's batched
+    argmax); same request/response surface as lm_streaming — the model IS
+    lm_streaming_model with the batched runner behind it."""
+    from client_tpu.serve.models.continuous import BatchedLmRunner
+
+    base = runner or _LmRunner()
+    batched = BatchedLmRunner(
+        base.params, base.cfg, max_slots=max_slots, eos_id=_EOS,
+        check_prompt=base.check_prompt,
+    )
+    model = lm_streaming_model(name=name, runner=batched)
+    # the scheduler's thread + lane KV cache release with the engine
+    model.closer = batched.scheduler.close
+    return model
+
+
 def text_ensemble_model(name="text_generator", runner=None):
     """End-to-end ensemble: BYTES prompt -> streamed BYTES pieces.
 
@@ -213,5 +234,6 @@ def language_models(shared_runner=True):
         detokenizer_model(),
         lm_streaming_model(runner=runner),
         lm_streaming_model(name="lm_streaming_int8", runner=int8_runner),
+        lm_streaming_batched_model(runner=int8_runner),
         text_ensemble_model(runner=runner),
     ]

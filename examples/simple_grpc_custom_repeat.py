@@ -1,7 +1,10 @@
 #!/usr/bin/env python
 """Decoupled N-response model over the bidi stream — parity with the
 reference simple_grpc_custom_repeat.py: one request to repeat_int32
-yields --repeat-count responses."""
+yields --repeat-count responses.  Completion uses Triton's decoupled
+protocol: the request asks for an empty final response
+(enable_empty_final_response) and the consumer stops on the
+triton_final_response=true marker instead of counting responses."""
 
 import argparse
 import os
@@ -36,12 +39,17 @@ def main():
             client.start_stream(lambda result, error: results.put((result, error)))
             inp = grpcclient.InferInput("IN", [1], "INT32")
             inp.set_data_from_numpy(np.array([args.repeat_count], dtype=np.int32))
-            client.async_stream_infer("repeat_int32", [inp])
+            client.async_stream_infer(
+                "repeat_int32", [inp], enable_empty_final_response=True
+            )
             got = []
-            for _ in range(args.repeat_count):
+            while True:
                 result, error = results.get(timeout=30)
                 if error is not None:
                     sys.exit(f"error: {error}")
+                params = result.get_response().parameters
+                if params["triton_final_response"].bool_param:
+                    break  # empty completion marker, not a content response
                 got.append(int(result.as_numpy("OUT")[0]))
             client.stop_stream()
             if got != list(range(args.repeat_count)):

@@ -249,6 +249,52 @@ TestSequenceStream(tc::InferenceServerGrpcClient* client)
 }
 
 static void
+TestDecoupledFinalResponse(tc::InferenceServerGrpcClient* client)
+{
+  // Triton's decoupled completion protocol: with
+  // enable_empty_final_response the N content responses (marked
+  // IsFinalResponse()==false) are followed by one EMPTY response marked
+  // true — the model-agnostic stream terminator.
+  std::mutex mu;
+  std::condition_variable cv;
+  std::vector<int32_t> values;
+  bool saw_final = false;
+  bool final_had_outputs = false;
+  CHECK_OK(client->StartStream([&](tc::InferResultPtr result) {
+    std::lock_guard<std::mutex> lk(mu);
+    if (result->IsFinalResponse()) {
+      saw_final = true;
+      final_had_outputs = !result->Outputs().empty();
+    } else {
+      const uint8_t* data = nullptr;
+      size_t nbytes = 0;
+      if (result->RequestStatus().IsOk() &&
+          result->RawData("OUT", &data, &nbytes).IsOk()) {
+        values.push_back(*reinterpret_cast<const int32_t*>(data));
+      }
+    }
+    cv.notify_all();
+  }));
+  int32_t n = 4;
+  tc::InferInput input("IN", {1}, "INT32");
+  input.AppendRaw(reinterpret_cast<const uint8_t*>(&n), sizeof(n));
+  tc::InferOptions options("repeat_int32");
+  options.enable_empty_final_response = true;
+  CHECK_OK(client->AsyncStreamInfer(options, {&input}));
+  {
+    std::unique_lock<std::mutex> lk(mu);
+    cv.wait_for(lk, std::chrono::seconds(30), [&] { return saw_final; });
+  }
+  CHECK_OK(client->StopStream());
+  CHECK(saw_final);
+  CHECK(!final_had_outputs);
+  CHECK(values.size() == 4);
+  for (int i = 0; i < static_cast<int>(values.size()); ++i) {
+    CHECK(values[i] == i);
+  }
+}
+
+static void
 TestStringSequenceId(tc::InferenceServerGrpcClient* client)
 {
   // unary infer over the sequence protocol with a string correlation id
@@ -534,6 +580,7 @@ main(int argc, char** argv)
   TestInferErrors(client.get());
   TestAsyncInfer(client.get());
   TestSequenceStream(client.get());
+  TestDecoupledFinalResponse(client.get());
   TestStringSequenceId(client.get());
   TestStatistics(client.get());
   TestSharedMemoryVerbs(client.get());
