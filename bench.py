@@ -566,6 +566,71 @@ def _run_lm_native(server, concurrency=4, max_tokens=32, prompt_len=8,
     }
 
 
+def _run_lm_inproc(n_streams=8, max_tokens=32):
+    """IN-PROCESS decode instruments (the TRITON_C_API analog: measure the
+    ENGINE, zero protocol): aggregate tokens/s for n_streams concurrent
+    per-request generate() threads vs the same streams through the
+    continuous-batching scheduler.  Over a tunneled chip the socket/GIL
+    serving path can flatten both to the same number; this pair shows the
+    decode engines themselves (batched uses one link round-trip per
+    lane-batch of tokens, per-request pays one per token)."""
+    import threading
+
+    from client_tpu.serve.models import transformer as tfm
+    from client_tpu.serve.models.continuous import ContinuousLmScheduler
+    from client_tpu.serve.models.language import _LmRunner
+
+    base = _LmRunner(quantize=True)
+    params, cfg = base.params, base.cfg
+    prompt = [5] * 8
+    list(tfm.generate(params, cfg, prompt, 4))  # warm
+
+    counts = []
+
+    def worker():
+        # stop_tokens matches the batched leg's eos_id AND the real serving
+        # path (_LmRunner.stream), so both legs measure the same workload
+        counts.append(
+            len(list(tfm.generate(params, cfg, prompt, max_tokens,
+                                  stop_tokens=(257,))))
+        )
+
+    threads = [threading.Thread(target=worker) for _ in range(n_streams)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    serial_rate = sum(counts) / (time.perf_counter() - t0)
+
+    sched = ContinuousLmScheduler(
+        params, cfg, max_slots=n_streams, eos_id=257
+    )
+    try:
+        warm_q, _ = sched.submit(prompt, 4)
+        while warm_q.get() is not ContinuousLmScheduler.CLOSE:
+            pass
+        total = 0
+        t0 = time.perf_counter()
+        for _ in range(3):
+            qs = [sched.submit(prompt, max_tokens)[0]
+                  for _ in range(n_streams)]
+            for q in qs:
+                while True:
+                    tok = q.get(timeout=300)
+                    if tok is ContinuousLmScheduler.CLOSE:
+                        break
+                    total += 1
+        batched_rate = total / (time.perf_counter() - t0)
+    finally:
+        sched.close()
+    return {
+        "lm_inproc_serial_tokens_per_sec": round(serial_rate, 1),
+        "lm_inproc_batched_tokens_per_sec": round(batched_rate, 1),
+        "lm_inproc_streams": n_streams,
+    }
+
+
 def _lm_prompt(i):
     # zero-padded so EVERY prompt (and the warmup) encodes to the same
     # token shape — the LM forward is shape-keyed jit
@@ -733,6 +798,11 @@ def main():
         )
     finally:
         server.stop()
+    try:
+        lm_inproc = _run_lm_inproc()
+    except Exception as e:
+        print(f"in-process LM instruments unavailable: {e}", file=sys.stderr)
+        lm_inproc = {}
 
     # Headline instrument: the native C++ worker when built (GIL-free async
     # contexts — measures the SERVER, not the client); the python-harness
@@ -900,6 +970,7 @@ def main():
         **lm,
         **lm_native,
         **lm_batched,
+        **lm_inproc,
         **link,
     }
     result["sync_floor_rtt_ms"] = link["link_rtt_ms"]

@@ -27,7 +27,6 @@ the backend.  Here the TPU-native server owns it.
 """
 
 import functools
-import itertools
 import queue
 import threading
 
@@ -153,9 +152,13 @@ class ContinuousLmScheduler:
         if handle is None:
             return
         with self._cv:
-            if handle in self._pending:
-                self._pending.remove(handle)
-                return
+            # identity scan: entries hold numpy prompts, so `in`/`remove`
+            # (which compare element-wise) would raise on array equality
+            for i, entry in enumerate(self._pending):
+                if entry is handle:
+                    entry[2].put(_CLOSE)  # a reader must not hang on get()
+                    del self._pending[i]
+                    return
             placed = handle[3]
             if placed is None:
                 return
@@ -165,17 +168,21 @@ class ContinuousLmScheduler:
                 slot.active = False
                 slot.gen += 1  # in-flight ticks for this lane drop on drain
 
+    def _release_all_locked(self):
+        """Close every pending and active stream queue (caller holds _cv)."""
+        for entry in self._pending:
+            entry[2].put(_CLOSE)
+        self._pending.clear()
+        for slot in self._slots:
+            if slot.active:
+                slot.active = False
+                slot.gen += 1
+                slot.queue.put(_CLOSE)
+
     def close(self):
         with self._cv:
             self._closed = True
-            for entry in self._pending:
-                entry[2].put(_CLOSE)
-            self._pending.clear()
-            for slot in self._slots:
-                if slot.active:
-                    slot.active = False
-                    slot.gen += 1
-                    slot.queue.put(_CLOSE)
+            self._release_all_locked()
             self._cv.notify_all()
         if self._thread is not None:
             self._thread.join(timeout=30)
@@ -238,14 +245,7 @@ class ContinuousLmScheduler:
         except Exception:
             # a dying scheduler must never strand consumers on q.get()
             with self._cv:
-                for entry in self._pending:
-                    entry[2].put(_CLOSE)
-                self._pending.clear()
-                for slot in self._slots:
-                    if slot.active:
-                        slot.active = False
-                        slot.gen += 1
-                        slot.queue.put(_CLOSE)
+                self._release_all_locked()
                 self._closed = True
             raise
 

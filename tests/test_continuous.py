@@ -87,6 +87,23 @@ def test_cancel_frees_lane(params):
         sched.close()
 
 
+def test_cancel_with_pending_queue(params):
+    """cancel() must work by identity while other requests are PENDING —
+    entry lists hold numpy prompts, so naive `in`/`remove` membership would
+    raise numpy's ambiguous-truth ValueError (regression)."""
+    sched = ContinuousLmScheduler(params, CFG, max_slots=1)
+    try:
+        q1, h1 = sched.submit([1, 2, 3], 20)
+        q2, h2 = sched.submit([1, 2, 3], 6)  # same-shape prompt, queued
+        q3, h3 = sched.submit([9], 6)        # different-shape prompt, queued
+        assert q1.get(timeout=60) is not ContinuousLmScheduler.CLOSE
+        sched.cancel(h1)   # active lane, pending entries present
+        sched.cancel(h3)   # pending entry, removed by identity
+        assert _collect(q2) == _serial(params, [1, 2, 3], 6)
+    finally:
+        sched.close()
+
+
 def test_eos_stops_stream(params):
     """An eos_id token terminates the stream (still yielded) and frees
     the lane."""
