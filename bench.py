@@ -578,7 +578,7 @@ def _run_lm_inproc(n_streams=8, max_tokens=32):
 
     from client_tpu.serve.models import transformer as tfm
     from client_tpu.serve.models.continuous import ContinuousLmScheduler
-    from client_tpu.serve.models.language import _LmRunner
+    from client_tpu.serve.models.language import _EOS, _LmRunner
 
     base = _LmRunner(quantize=True)
     params, cfg = base.params, base.cfg
@@ -592,7 +592,7 @@ def _run_lm_inproc(n_streams=8, max_tokens=32):
         # path (_LmRunner.stream), so both legs measure the same workload
         counts.append(
             len(list(tfm.generate(params, cfg, prompt, max_tokens,
-                                  stop_tokens=(257,))))
+                                  stop_tokens=(_EOS,))))
         )
 
     threads = [threading.Thread(target=worker) for _ in range(n_streams)]
@@ -604,7 +604,7 @@ def _run_lm_inproc(n_streams=8, max_tokens=32):
     serial_rate = sum(counts) / (time.perf_counter() - t0)
 
     sched = ContinuousLmScheduler(
-        params, cfg, max_slots=n_streams, eos_id=257
+        params, cfg, max_slots=n_streams, eos_id=_EOS
     )
     try:
         warm_q, _ = sched.submit(prompt, 4)
@@ -747,62 +747,92 @@ def main():
         grpc_port=0,
         with_default_models=False,
     ).start()
+    def attempt(label, fn, *args, **kwargs):
+        """Run one non-headline config; a stalled tunnel or dead subprocess
+        degrades THAT config to None/{} instead of discarding the rest of
+        the bench (the headline `tpu` run alone stays fatal)."""
+        try:
+            return fn(*args, **kwargs)
+        except Exception as e:
+            print(f"bench config '{label}' unavailable: {e}",
+                  file=sys.stderr)
+            return None
+
     try:
         tpu = _run_tpu_shm(server)
-        tpu_nw = _run_tpu_shm_native(server, concurrency=CONCURRENCY)
+        tpu_nw = attempt(
+            "nw", _run_tpu_shm_native, server, concurrency=CONCURRENCY
+        )
         # completion-true native latencies (VERDICT r4 weak #6): wire
         # outputs force compute + D2H into every recorded latency
-        tpu_nw_sync = _run_tpu_shm_native(
-            server, concurrency=CONCURRENCY, completion_sync=True
+        tpu_nw_sync = attempt(
+            "nw_sync", _run_tpu_shm_native, server,
+            concurrency=CONCURRENCY, completion_sync=True,
         )
-        tpu_mp = _run_tpu_shm_multiproc(server, processes=4,
-                                        concurrency=CONCURRENCY)
-        tpu_b8 = _run_tpu_shm(server, concurrency=8, batch_size=8)
-        tpu_c4 = _run_tpu_shm(server, concurrency=CONCURRENCY_LOW)
-        tpu_sync = _run_tpu_shm(
-            server, concurrency=CONCURRENCY_LOW, completion_sync=True
+        tpu_mp = attempt(
+            "mp", _run_tpu_shm_multiproc, server, processes=4,
+            concurrency=CONCURRENCY,
+        )
+        tpu_b8 = attempt(
+            "b8", _run_tpu_shm, server, concurrency=8, batch_size=8
+        )
+        tpu_c4 = attempt(
+            "c4", _run_tpu_shm, server, concurrency=CONCURRENCY_LOW
+        )
+        tpu_sync = attempt(
+            "sync", _run_tpu_shm, server, concurrency=CONCURRENCY_LOW,
+            completion_sync=True,
         )
         # BASELINE config 3: the resnet50-class model — throughput here is a
         # compute statement (see resnet50_mfu_pct), not a protocol statement
-        rn = _run_tpu_shm(server, model_name="resnet50")
-        rn_b8 = _run_tpu_shm(
-            server, concurrency=8, batch_size=8, model_name="resnet50"
+        rn = attempt(
+            "resnet50", _run_tpu_shm, server, model_name="resnet50"
+        )
+        rn_b8 = attempt(
+            "resnet50_b8", _run_tpu_shm, server, concurrency=8,
+            batch_size=8, model_name="resnet50",
         )
         # batch 32 x concurrency 4: 64-row fused device batches — the MXU's
         # preferred shape; this is the peak-MFU configuration
-        rn_b32 = _run_tpu_shm(
-            server, concurrency=4, batch_size=32, model_name="resnet50"
+        rn_b32 = attempt(
+            "resnet50_b32", _run_tpu_shm, server, concurrency=4,
+            batch_size=32, model_name="resnet50",
         )
         # BASELINE configs 1-2's other halves: system shared memory and the
         # HTTP protocol on the same model/concurrency as the tpushm headline
-        sysshm = _run_sys_shm(server, concurrency=CONCURRENCY)
-        http_wire = _run_wire(
-            server, "cnn_classifier", WIRE_CONCURRENCY, protocol="http"
+        sysshm = attempt(
+            "sys", _run_sys_shm, server, concurrency=CONCURRENCY
         )
-        http_sys = _run_sys_shm(
-            server, concurrency=CONCURRENCY, protocol="http"
+        http_wire = attempt(
+            "http", _run_wire, server, "cnn_classifier", WIRE_CONCURRENCY,
+            protocol="http",
         )
-        wire = _run_wire(server, "cnn_classifier", WIRE_CONCURRENCY)
-        wire_small = _run_wire(server, "cnn_small", WIRE_CONCURRENCY)
-        seq = _run_seq_stream(server)
-        seq_native = _run_seq_native(server)
-        lm = _run_lm_stream(server)
-        lm_native = _run_lm_native(server)
+        http_sys = attempt(
+            "http_sys", _run_sys_shm, server, concurrency=CONCURRENCY,
+            protocol="http",
+        )
+        wire = attempt(
+            "wire", _run_wire, server, "cnn_classifier", WIRE_CONCURRENCY
+        )
+        wire_small = attempt(
+            "wire_small", _run_wire, server, "cnn_small", WIRE_CONCURRENCY
+        )
+        seq = attempt("seq", _run_seq_stream, server) or {}
+        seq_native = attempt("seq_native", _run_seq_native, server) or {}
+        lm = attempt("lm", _run_lm_stream, server) or {}
+        lm_native = attempt("lm_native", _run_lm_native, server) or {}
         # continuous batching: same weights, concurrent streams SHARE one
         # batched decode tick (serve/models/continuous.py) — 8 streams into
         # 8 lanes; one link round-trip carries 8 tokens, so aggregate
         # tokens/s scales where per-stream decode pays a round-trip each
-        lm_batched = _run_lm_native(
-            server, model_name="lm_streaming_batched", concurrency=8,
+        lm_batched = attempt(
+            "lm_batched", _run_lm_native, server,
+            model_name="lm_streaming_batched", concurrency=8,
             key_prefix="lm_batched",
-        )
+        ) or {}
     finally:
         server.stop()
-    try:
-        lm_inproc = _run_lm_inproc()
-    except Exception as e:
-        print(f"in-process LM instruments unavailable: {e}", file=sys.stderr)
-        lm_inproc = {}
+    lm_inproc = attempt("lm_inproc", _run_lm_inproc) or {}
 
     # Headline instrument: the native C++ worker when built (GIL-free async
     # contexts — measures the SERVER, not the client); the python-harness
@@ -817,7 +847,9 @@ def main():
     # itself achieved: a serial 20MB probe can under-read a fluctuating
     # tunnel that request pipelining then out-performs (saturation stays
     # <= 100% and means "fraction of demonstrated link capability").
-    achieved_mbps = wire["infer_per_sec"] * image_bytes / 1e6
+    achieved_mbps = (
+        wire["infer_per_sec"] * image_bytes / 1e6 if wire else 0.0
+    )
     wire_ceiling = max(link["link_h2d_mbps"], achieved_mbps) * 1e6 / image_bytes
     result = {
         "metric": "infer_throughput_cnn224_grpc_tpushm",
@@ -884,87 +916,117 @@ def main():
         } if tpu_nw_sync else {}),
         # separate-process load generation (client_tpu.perf.procpool):
         # the server keeps its GIL; clients reference regions by name
-        "mp_infer_per_sec": round(tpu_mp["infer_per_sec"], 2),
-        "mp_p50_ms": round(tpu_mp["p50_ms"], 3),
-        "mp_processes": tpu_mp["processes"],
-        "mp_duty_cycle_pct": tpu_mp["duty_cycle_pct"],
-        "mp_delta_vs_prev": _delta_pct(
-            tpu_mp["infer_per_sec"], prev, "mp_infer_per_sec"
-        ),
+        **({
+            "mp_infer_per_sec": round(tpu_mp["infer_per_sec"], 2),
+            "mp_p50_ms": round(tpu_mp["p50_ms"], 3),
+            "mp_processes": tpu_mp["processes"],
+            "mp_duty_cycle_pct": tpu_mp["duty_cycle_pct"],
+            "mp_delta_vs_prev": _delta_pct(
+                tpu_mp["infer_per_sec"], prev, "mp_infer_per_sec"
+            ),
+        } if tpu_mp else {}),
         # batched clients (reference perf_analyzer -b): rows/sec through the
         # same path — device throughput past the per-request RPC ceiling
-        "b8_rows_per_sec": round(tpu_b8["infer_per_sec"] * 8, 2),
-        "b8_request_p50_ms": round(tpu_b8["p50_ms"], 3),
-        "b8_mfu_pct": _mfu_pct(
-            tpu_b8["infer_per_sec"] * 8, cnn_flops, peak_tflops
-        ),
+        **({
+            "b8_rows_per_sec": round(tpu_b8["infer_per_sec"] * 8, 2),
+            "b8_request_p50_ms": round(tpu_b8["p50_ms"], 3),
+            "b8_mfu_pct": _mfu_pct(
+                tpu_b8["infer_per_sec"] * 8, cnn_flops, peak_tflops
+            ),
+        } if tpu_b8 else {}),
         # BASELINE config 3: resnet50 (8.18 GFLOP/image, 2*MAC) — the
         # compute-bound benchmark; MFU here is the chip-efficiency claim
-        "resnet50_infer_per_sec": round(rn["infer_per_sec"], 2),
-        "resnet50_p50_ms": round(rn["p50_ms"], 3),
-        "resnet50_p99_ms": round(rn["p99_ms"], 3),
-        "resnet50_duty_cycle_pct": rn["duty_cycle_pct"],
-        "resnet50_tflops": round(rn["infer_per_sec"] * rn_flops / 1e12, 3),
-        "resnet50_mfu_pct": _mfu_pct(
-            rn["infer_per_sec"], rn_flops, peak_tflops
-        ),
-        "resnet50_b8_rows_per_sec": round(rn_b8["infer_per_sec"] * 8, 2),
-        "resnet50_b8_request_p50_ms": round(rn_b8["p50_ms"], 3),
-        "resnet50_b8_tflops": round(
-            rn_b8["infer_per_sec"] * 8 * rn_flops / 1e12, 3
-        ),
-        "resnet50_b8_mfu_pct": _mfu_pct(
-            rn_b8["infer_per_sec"] * 8, rn_flops, peak_tflops
-        ),
-        "resnet50_b32_rows_per_sec": round(rn_b32["infer_per_sec"] * 32, 2),
-        "resnet50_b32_request_p50_ms": round(rn_b32["p50_ms"], 3),
-        "resnet50_b32_tflops": round(
-            rn_b32["infer_per_sec"] * 32 * rn_flops / 1e12, 3
-        ),
-        "resnet50_b32_mfu_pct": _mfu_pct(
-            rn_b32["infer_per_sec"] * 32, rn_flops, peak_tflops
-        ),
+        **({
+            "resnet50_infer_per_sec": round(rn["infer_per_sec"], 2),
+            "resnet50_p50_ms": round(rn["p50_ms"], 3),
+            "resnet50_p99_ms": round(rn["p99_ms"], 3),
+            "resnet50_duty_cycle_pct": rn["duty_cycle_pct"],
+            "resnet50_tflops": round(
+                rn["infer_per_sec"] * rn_flops / 1e12, 3
+            ),
+            "resnet50_mfu_pct": _mfu_pct(
+                rn["infer_per_sec"], rn_flops, peak_tflops
+            ),
+        } if rn else {}),
+        **({
+            "resnet50_b8_rows_per_sec": round(rn_b8["infer_per_sec"] * 8, 2),
+            "resnet50_b8_request_p50_ms": round(rn_b8["p50_ms"], 3),
+            "resnet50_b8_tflops": round(
+                rn_b8["infer_per_sec"] * 8 * rn_flops / 1e12, 3
+            ),
+            "resnet50_b8_mfu_pct": _mfu_pct(
+                rn_b8["infer_per_sec"] * 8, rn_flops, peak_tflops
+            ),
+        } if rn_b8 else {}),
+        **({
+            "resnet50_b32_rows_per_sec": round(
+                rn_b32["infer_per_sec"] * 32, 2
+            ),
+            "resnet50_b32_request_p50_ms": round(rn_b32["p50_ms"], 3),
+            "resnet50_b32_tflops": round(
+                rn_b32["infer_per_sec"] * 32 * rn_flops / 1e12, 3
+            ),
+            "resnet50_b32_mfu_pct": _mfu_pct(
+                rn_b32["infer_per_sec"] * 32, rn_flops, peak_tflops
+            ),
+        } if rn_b32 else {}),
         # the north-star comparison's other half (BASELINE configs 1-2):
         # system shared memory and HTTP on the same model/concurrency
-        "sys_infer_per_sec": round(sysshm["infer_per_sec"], 2),
-        "sys_p50_ms": round(sysshm["p50_ms"], 3),
-        "sys_p99_ms": round(sysshm["p99_ms"], 3),
-        "http_infer_per_sec": round(http_wire["infer_per_sec"], 2),
-        "http_p50_ms": round(http_wire["p50_ms"], 3),
-        "http_sys_infer_per_sec": round(http_sys["infer_per_sec"], 2),
-        "http_sys_p50_ms": round(http_sys["p50_ms"], 3),
-        "tpushm_vs_sysshm": round(
-            headline["infer_per_sec"] / sysshm["infer_per_sec"], 2
-        ) if sysshm["infer_per_sec"] else None,
-        "c4_infer_per_sec": round(tpu_c4["infer_per_sec"], 2),
-        "c4_p50_ms": round(tpu_c4["p50_ms"], 3),
+        **({
+            "sys_infer_per_sec": round(sysshm["infer_per_sec"], 2),
+            "sys_p50_ms": round(sysshm["p50_ms"], 3),
+            "sys_p99_ms": round(sysshm["p99_ms"], 3),
+            "tpushm_vs_sysshm": round(
+                headline["infer_per_sec"] / sysshm["infer_per_sec"], 2
+            ) if sysshm["infer_per_sec"] else None,
+        } if sysshm else {}),
+        **({
+            "http_infer_per_sec": round(http_wire["infer_per_sec"], 2),
+            "http_p50_ms": round(http_wire["p50_ms"], 3),
+        } if http_wire else {}),
+        **({
+            "http_sys_infer_per_sec": round(http_sys["infer_per_sec"], 2),
+            "http_sys_p50_ms": round(http_sys["p50_ms"], 3),
+        } if http_sys else {}),
+        **({
+            "c4_infer_per_sec": round(tpu_c4["infer_per_sec"], 2),
+            "c4_p50_ms": round(tpu_c4["p50_ms"], 3),
+        } if tpu_c4 else {}),
         # Trajectory note (VERDICT r3 weak #1): the r1/r2 c4 headlines were
         # ack-rate through profile_concurrency's time windows with NO drain
         # correction — dispatch acks counted as completions, overstating
         # low-concurrency throughput.  Every r3+ figure above is
         # drain-corrected profile_completion; compare across r3+ only.
         "c4_note": "r1/r2 c4 were ack-based (drain-inflated); r3+ drain-corrected",
-        "sync_infer_per_sec": round(tpu_sync["infer_per_sec"], 2),
-        "sync_p50_ms": round(tpu_sync["p50_ms"], 3),
-        "sync_p99_ms": round(tpu_sync["p99_ms"], 3),
-        # sync floor: every per-request completion observation costs >= 1
-        # host<->device link round trip (link_rtt_ms below); on a TPU VM the
-        # same path's floor is PCIe-class (sub-ms)
-        "sync_floor_rtt_ms": None,  # filled from link below
-        "wire_infer_per_sec": round(wire["infer_per_sec"], 2),
-        "wire_p50_ms": round(wire["p50_ms"], 3),
-        "wire_concurrency": WIRE_CONCURRENCY,
-        "wire_link_saturation_pct": round(
-            100.0 * wire["infer_per_sec"] / wire_ceiling, 1
-        ),
-        # the uncapped ratio vs the serial 20MB probe (can exceed 100% when
-        # request pipelining out-performs the serial probe; the capped
-        # figure above then proves only "wire >= probe" — VERDICT r4 weak #4)
-        "wire_vs_probe_pct": round(
-            100.0 * achieved_mbps / link["link_h2d_mbps"], 1
-        ) if link["link_h2d_mbps"] else None,
-        "wire_small64_infer_per_sec": round(wire_small["infer_per_sec"], 2),
-        "wire_small64_p50_ms": round(wire_small["p50_ms"], 3),
+        **({
+            "sync_infer_per_sec": round(tpu_sync["infer_per_sec"], 2),
+            "sync_p50_ms": round(tpu_sync["p50_ms"], 3),
+            "sync_p99_ms": round(tpu_sync["p99_ms"], 3),
+            # sync floor: every per-request completion observation costs
+            # >= 1 host<->device link round trip (link_rtt_ms below); on a
+            # TPU VM the same path's floor is PCIe-class (sub-ms)
+            "sync_floor_rtt_ms": link["link_rtt_ms"],
+        } if tpu_sync else {}),
+        **({
+            "wire_infer_per_sec": round(wire["infer_per_sec"], 2),
+            "wire_p50_ms": round(wire["p50_ms"], 3),
+            "wire_concurrency": WIRE_CONCURRENCY,
+            "wire_link_saturation_pct": round(
+                100.0 * wire["infer_per_sec"] / wire_ceiling, 1
+            ),
+            # the uncapped ratio vs the serial 20MB probe (can exceed 100%
+            # when request pipelining out-performs the serial probe; the
+            # capped figure above then proves only "wire >= probe")
+            "wire_vs_probe_pct": round(
+                100.0 * achieved_mbps / link["link_h2d_mbps"], 1
+            ) if link["link_h2d_mbps"] else None,
+        } if wire else {}),
+        **({
+            "wire_small64_infer_per_sec": round(
+                wire_small["infer_per_sec"], 2
+            ),
+            "wire_small64_p50_ms": round(wire_small["p50_ms"], 3),
+        } if wire_small else {}),
         **seq,
         **seq_native,
         **lm,
@@ -973,8 +1035,8 @@ def main():
         **lm_inproc,
         **link,
     }
-    result["sync_floor_rtt_ms"] = link["link_rtt_ms"]
-    result["lm_token_floor_rtt_ms"] = link["link_rtt_ms"]
+    if lm:
+        result["lm_token_floor_rtt_ms"] = link["link_rtt_ms"]
     print(json.dumps(result))
     return 0 if tpu["n"] and not tpu["errors"] else 1
 
