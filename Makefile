@@ -1,4 +1,12 @@
 # Build orchestration for client_tpu: proto codegen + native libraries.
+#
+# Quality gates:
+#   make lint   tpu-lint static analysis (client_tpu/analysis): concurrency
+#               & numpy-semantics rules grown from this repo's shipped bugs.
+#               Runs over client_tpu/ AND tests/; exits non-zero on any
+#               finding not grandfathered in analysis/baseline.json.
+#               Suppress in place with `# tpulint: disable=RULE` + rationale.
+#   make test   ASAN native tests + the python suite.
 
 PROTO_DIR := proto
 PB_OUT := client_tpu/_proto
@@ -7,7 +15,10 @@ CXXFLAGS ?= -O2 -fPIC -Wall -std=c++17
 NATIVE_OUT := client_tpu/utils/shared_memory
 TPUSHM_OUT := client_tpu/utils/tpu_shared_memory
 
-.PHONY: all protos native cpp clean test asan java java-bindings
+.PHONY: all protos native cpp clean test asan java java-bindings lint
+
+lint:
+	python -m client_tpu.analysis client_tpu tests
 
 all: protos native cpp
 

@@ -1,0 +1,23 @@
+"""tpu-lint: AST-based concurrency & array-semantics analyzer.
+
+Encodes this repo's recurring bug shapes as enforced rules — numpy
+truthiness in control flow, blocking calls in async bodies, device
+dispatch under scheduler locks, streaming queues abandoned without their
+close sentinel, loop-less ``Condition.wait``, and unlocked writes to
+thread-shared state.  Run ``python -m client_tpu.analysis [paths]``
+(exits non-zero on findings) or ``make lint``.
+
+Pure stdlib on purpose: the gate must run anywhere the repo checks out,
+with or without jax present.
+"""
+
+from client_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    REGISTRY,
+    Rule,
+    scan_paths,
+    scan_source,
+)
+from client_tpu.analysis import rules as _rules  # noqa: F401  (registers)
+
+__all__ = ["Finding", "REGISTRY", "Rule", "scan_paths", "scan_source"]

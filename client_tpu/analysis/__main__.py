@@ -1,0 +1,110 @@
+"""CLI: ``python -m client_tpu.analysis [paths...]``.
+
+Exit codes: 0 clean (after baseline filtering), 1 findings, 2 analyzer
+usage/internal error.  ``make lint`` runs this over ``client_tpu tests``.
+"""
+
+import argparse
+import os
+import sys
+
+from client_tpu.analysis import REGISTRY, scan_paths
+from client_tpu.analysis import baseline as baseline_mod
+from client_tpu.analysis import report
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="python -m client_tpu.analysis",
+        description=(
+            "tpu-lint: concurrency & array-semantics rules grown from "
+            "this repo's shipped bugs"
+        ),
+    )
+    parser.add_argument(
+        "paths", nargs="*", default=["client_tpu", "tests"],
+        help="files or directories to scan (default: client_tpu tests)",
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="machine-readable output"
+    )
+    parser.add_argument(
+        "--baseline", default=baseline_mod.DEFAULT_BASELINE,
+        help="baseline file of grandfathered findings",
+    )
+    parser.add_argument(
+        "--no-baseline", action="store_true",
+        help="report every finding, ignoring the baseline",
+    )
+    parser.add_argument(
+        "--write-baseline", action="store_true",
+        help="regenerate the baseline from the current findings and exit 0",
+    )
+    parser.add_argument(
+        "--rules", default="",
+        help="comma-separated rule ids to run (default: all)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule catalog and exit",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        print(report.render_rules(REGISTRY))
+        return 0
+
+    rules = REGISTRY
+    if args.rules:
+        wanted = {r.strip().upper() for r in args.rules.split(",")}
+        unknown = wanted - set(REGISTRY)
+        if unknown:
+            print(
+                f"tpu-lint: unknown rule(s): {', '.join(sorted(unknown))}",
+                file=sys.stderr,
+            )
+            return 2
+        rules = {k: v for k, v in REGISTRY.items() if k in wanted}
+
+    missing = [p for p in args.paths if not os.path.exists(p)]
+    if missing:
+        # a typo'd path must not turn the gate into a silent green no-op
+        print(
+            f"tpu-lint: no such path(s): {', '.join(missing)}",
+            file=sys.stderr,
+        )
+        return 2
+
+    findings = scan_paths(args.paths, rules=rules)
+
+    if args.write_baseline:
+        if args.rules or args.paths != parser.get_default("paths"):
+            # a filtered scan would overwrite the whole file and silently
+            # drop every other rule's/path's grandfathered entries
+            print(
+                "tpu-lint: --write-baseline requires a full default scan "
+                "(no --rules, default paths)",
+                file=sys.stderr,
+            )
+            return 2
+        baseline_mod.save(args.baseline, findings)
+        print(
+            f"tpu-lint: wrote {len(findings)} finding(s) to "
+            f"{args.baseline}"
+        )
+        return 0
+
+    baseline = (
+        {} if args.no_baseline else baseline_mod.load(args.baseline)
+    )
+    new, old = baseline_mod.filter_findings(findings, baseline)
+
+    if args.json:
+        print(report.render_json(new, old, rules))
+    else:
+        print(report.render_text(new, old, rules))
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,172 @@
+"""tpu-lint core: findings, the rule registry, suppressions, file driver.
+
+Pure stdlib (``ast`` + ``tokenize``-free regex comments) so the analyzer
+runs in any environment the repo does — no jax, no numpy, no third-party
+lint framework.  Each rule encodes an invariant this codebase has actually
+shipped a bug against; see ``rules.py`` for the catalog and README
+"Static analysis (tpu-lint)" for the rationale per rule.
+"""
+
+import ast
+import dataclasses
+import os
+import re
+
+# ``# tpulint: disable=RULE-A,RULE-B`` or a bare ``# tpulint: disable``
+# (all rules).  On a code line it suppresses that line; on a comment-only
+# line it suppresses the line below (so a rationale can sit above the
+# statement it excuses).
+_SUPPRESS_RE = re.compile(
+    r"#\s*tpulint:\s*disable(?:=(?P<rules>[A-Za-z0-9_,\- ]+))?"
+)
+_ALL = "*"
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    snippet: str  # stripped source line: the baseline's drift-stable key
+
+    def key(self):
+        """Baseline identity: stable across pure line-number drift."""
+        return (self.path, self.rule, self.snippet)
+
+    def to_dict(self):
+        return dataclasses.asdict(self)
+
+    def render(self):
+        return (
+            f"{self.path}:{self.line}:{self.col}: "
+            f"{self.rule} {self.message}"
+        )
+
+
+class Rule:
+    """Base class: subclasses set ``id``/``rationale`` and implement
+    ``check(tree, lines, path) -> iterable[Finding]``."""
+
+    id = ""
+    rationale = ""
+
+    def finding(self, path, lines, node, message):
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        snippet = ""
+        if 1 <= line <= len(lines):
+            snippet = lines[line - 1].strip()
+        return Finding(self.id, path, line, col, message, snippet)
+
+    def check(self, tree, lines, path):  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+REGISTRY = {}
+
+
+def register(cls):
+    """Class decorator adding a rule to the global registry."""
+    REGISTRY[cls.id] = cls()
+    return cls
+
+
+def parse_suppressions(lines):
+    """Map line number -> set of suppressed rule ids ('*' = all)."""
+    out = {}
+    for i, text in enumerate(lines, start=1):
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        rules = m.group("rules")
+        ids = (
+            {_ALL}
+            if not rules
+            else {r.strip().upper() for r in rules.split(",") if r.strip()}
+        )
+        target = i
+        if text.lstrip().startswith("#"):
+            target = i + 1  # comment-only line covers the next line
+        out.setdefault(target, set()).update(ids)
+        out.setdefault(i, set()).update(ids)
+    return out
+
+
+def scan_source(source, path, rules=None):
+    """Run every (or the given) rule over one file's source text."""
+    active = list((rules if rules is not None else REGISTRY).values())
+    lines = source.splitlines()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [
+            Finding(
+                "PARSE-ERROR", path, e.lineno or 1, e.offset or 0,
+                f"could not parse: {e.msg}", "",
+            )
+        ]
+    suppressed = parse_suppressions(lines)
+    findings = []
+    reported = set()  # one finding per (rule, line): passes can overlap
+    for rule in active:
+        for f in rule.check(tree, lines, path):
+            ids = suppressed.get(f.line, ())
+            if _ALL in ids or f.rule.upper() in ids:
+                continue
+            if (f.rule, f.line) in reported:
+                continue
+            reported.add((f.rule, f.line))
+            findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def iter_python_files(paths, exclude_parts=("analysis_fixtures",)):
+    """Yield .py files under the given files/directories, skipping any
+    whose path contains an excluded component (lint fixtures hold
+    intentional violations)."""
+    seen = set()
+    for root in paths:
+        if os.path.isfile(root):
+            # an explicitly named file is always scanned — the exclusion
+            # only guards directory walks (fixtures hold intentional
+            # violations but must be scannable on demand)
+            norm = os.path.normpath(root)
+            if norm not in seen:
+                seen.add(norm)
+                yield norm
+            continue
+        # exclusion applies BELOW the named root only (the dirnames
+        # pruning): explicitly passing an excluded directory (e.g. the
+        # fixtures) scans it — same no-silent-green principle as the
+        # missing-path CLI error
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = sorted(
+                d for d in dirnames
+                if d not in exclude_parts and d != "__pycache__"
+            )
+            for f in sorted(filenames):
+                if not f.endswith(".py"):
+                    continue
+                norm = os.path.normpath(os.path.join(dirpath, f))
+                if norm in seen:
+                    continue
+                seen.add(norm)
+                yield norm
+
+
+def scan_paths(paths, rules=None, exclude_parts=("analysis_fixtures",)):
+    findings = []
+    for path in iter_python_files(paths, exclude_parts):
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                source = fh.read()
+        except OSError as e:
+            findings.append(
+                Finding("READ-ERROR", path, 1, 0, f"unreadable: {e}", "")
+            )
+            continue
+        findings.extend(scan_source(source, path, rules))
+    return findings

@@ -1,0 +1,51 @@
+"""tpu-lint reporters: human text and machine JSON."""
+
+import json
+
+
+def render_text(new, grandfathered, rules):
+    """Return the human report as a string (one finding per line)."""
+    lines = []
+    for f in new:
+        lines.append(f.render())
+    if new:
+        lines.append("")
+    counts = {}
+    for f in new:
+        counts[f.rule] = counts.get(f.rule, 0) + 1
+    summary = ", ".join(f"{r}:{n}" for r, n in sorted(counts.items()))
+    lines.append(
+        f"tpu-lint: {len(new)} finding(s)"
+        + (f" [{summary}]" if summary else "")
+        + (
+            f", {len(grandfathered)} grandfathered (baseline)"
+            if grandfathered
+            else ""
+        )
+    )
+    return "\n".join(lines)
+
+
+def render_json(new, grandfathered, rules):
+    return json.dumps(
+        {
+            "findings": [f.to_dict() for f in new],
+            "grandfathered": [f.to_dict() for f in grandfathered],
+            "rules": {
+                rule.id: rule.rationale for rule in rules.values()
+            },
+            "count": len(new),
+        },
+        indent=2,
+    )
+
+
+def render_rules(rules):
+    lines = ["tpu-lint rule catalog:"]
+    for rule_id in sorted(rules):
+        lines.append(f"  {rule_id:15s} {rules[rule_id].rationale}")
+    lines.append(
+        "suppress in place with `# tpulint: disable=RULE` (same line or "
+        "a comment line above)"
+    )
+    return "\n".join(lines)

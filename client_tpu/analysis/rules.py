@@ -1,0 +1,756 @@
+"""tpu-lint rule catalog.
+
+Every rule encodes an invariant this repo has actually shipped (or nearly
+shipped) a bug against — the rationale strings name the incident.  All
+rules are lexical/AST heuristics: they prefer missing an exotic variant
+over drowning the gate in false positives, and every finding can be
+waived in place with ``# tpulint: disable=RULE`` plus a rationale.
+"""
+
+import ast
+import re
+
+from client_tpu.analysis.core import Rule, register
+
+# receivers that look like a mutex/condvar (last dotted segment)
+_LOCKISH_RE = re.compile(r"(?i)(lock|mutex|cv|cond)")
+# receivers that look specifically like a condition variable
+_CVLIKE_RE = re.compile(r"(?i)(^|_)(cv|cond|condition)s?$")
+# numpy array-producing module functions (np./numpy. namespaces)
+_NP_ARRAY_FNS = {
+    "asarray", "array", "zeros", "ones", "empty", "full", "arange",
+    "linspace", "concatenate", "stack", "frombuffer", "where", "reshape",
+    "copy", "asanyarray", "atleast_1d", "squeeze",
+}
+# device-dispatch callees beyond jit-bound names (last dotted segment)
+_DISPATCH_HINTS = {"prefill", "decode_step", "block_until_ready"}
+_DISPATCH_FULL = {
+    "jax.device_put", "jax.device_get", "jax.block_until_ready",
+}
+# blocking callees never allowed in an async def body
+_ASYNC_BLOCKING_FULL = {
+    "time.sleep",
+    "subprocess.run", "subprocess.call", "subprocess.check_output",
+    "subprocess.check_call",
+    "socket.create_connection",
+}
+_ASYNC_BLOCKING_PREFIXES = ("requests.",)
+# queue.Queue constructors whose get/put block without a timeout
+_QUEUE_CTORS = {
+    "queue.Queue", "queue.SimpleQueue", "queue.LifoQueue",
+    "queue.PriorityQueue", "Queue", "SimpleQueue",
+}
+
+
+def _expr_text(node):
+    """Dotted text for Name/Attribute chains ('self._cv'); None otherwise."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _expr_text(node.value)
+        if base is None:
+            return None
+        return base + "." + node.attr
+    return None
+
+
+def _last_segment(text):
+    return text.rsplit(".", 1)[-1] if text else ""
+
+
+def _walk_no_functions(node):
+    """Yield descendants without crossing into nested function bodies."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        child = stack.pop()
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+            continue
+        yield child
+        stack.extend(ast.iter_child_nodes(child))
+
+
+def _functions(tree):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _is_lockish_with(node):
+    if not isinstance(node, (ast.With, ast.AsyncWith)):
+        return False
+    for item in node.items:
+        ctx = item.context_expr
+        if isinstance(ctx, ast.Call):
+            ctx = ctx.func
+        text = _expr_text(ctx)
+        if text and _LOCKISH_RE.search(_last_segment(text)):
+            return True
+    return False
+
+
+@register
+class NpyTruthRule(Rule):
+    """NPY-TRUTH — numpy values in truthiness / membership positions.
+
+    ``bool(array)`` raises ("truth value of an array is ambiguous") and
+    list membership / ``remove`` compare elementwise — the exact crash
+    fixed in commit a2654c4 (``cancel()`` did ``handle in self._pending``
+    over entries holding numpy prompts).  Tracks names assigned from
+    np/jnp array producers in the same function, plus list/tuple literals
+    containing them (containers compare elementwise too).
+    """
+
+    id = "NPY-TRUTH"
+    rationale = (
+        "numpy truthiness raises and membership compares elementwise "
+        "(the a2654c4 cancel() crash)"
+    )
+
+    def _is_numpy_expr(self, node):
+        if not isinstance(node, ast.Call):
+            return False
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            base = func.value
+            if isinstance(base, ast.Name):
+                if base.id == "jnp":
+                    return True
+                if base.id in ("np", "numpy"):
+                    return func.attr in _NP_ARRAY_FNS
+            # method chain on a numpy expression: np.asarray(x).reshape(...)
+            if isinstance(base, ast.Call) and self._is_numpy_expr(base):
+                return True
+        return False
+
+    def _collect_taint(self, fn):
+        # two passes: container taint depends on the full array-name set
+        # (tree walk order is not statement order)
+        assigns = [
+            node
+            for node in _walk_no_functions(fn)
+            if isinstance(node, ast.Assign)
+        ]
+        arrays, containers = set(), set()
+        for node in assigns:
+            targets = [
+                t.id for t in node.targets if isinstance(t, ast.Name)
+            ]
+            if targets and self._is_numpy_expr(node.value):
+                arrays.update(targets)
+        for node in assigns:
+            targets = [
+                t.id for t in node.targets if isinstance(t, ast.Name)
+            ]
+            if (
+                targets
+                and isinstance(node.value, (ast.List, ast.Tuple))
+                and any(
+                    isinstance(el, ast.Name) and el.id in arrays
+                    for el in node.value.elts
+                )
+            ):
+                containers.update(targets)
+        return arrays, containers
+
+    def _tainted(self, node, arrays, containers):
+        if isinstance(node, ast.Name):
+            return node.id in arrays or node.id in containers
+        return self._is_numpy_expr(node)
+
+    def _array_tainted(self, node, arrays):
+        if isinstance(node, ast.Name):
+            return node.id in arrays
+        return self._is_numpy_expr(node)
+
+    def check(self, tree, lines, path):
+        findings = []
+        for fn in list(_functions(tree)) + [tree]:
+            arrays, containers = self._collect_taint(fn)
+            if not arrays and not containers:
+                continue
+            for node in _walk_no_functions(fn):
+                findings.extend(
+                    self._check_node(node, arrays, containers, path, lines)
+                )
+        for cls in ast.walk(tree):
+            if isinstance(cls, ast.ClassDef):
+                findings.extend(self._check_class_attrs(cls, lines, path))
+        return findings
+
+    def _check_class_attrs(self, cls, lines, path):
+        """Cross-method taint: a self-attribute collection that any method
+        appends numpy-bearing entries into makes EVERY membership/remove
+        over it elementwise — the exact a2654c4 cancel() crash, where the
+        numpy-bearing handle arrived as a parameter and only submit()
+        showed the taint."""
+        methods = [
+            n for n in ast.walk(cls)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        npy_attrs = set()
+        for fn in methods:
+            arrays, containers = self._collect_taint(fn)
+            if not arrays and not containers:
+                continue
+            for node in _walk_no_functions(fn):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("append", "insert", "add")
+                ):
+                    continue
+                recv = _expr_text(node.func.value)
+                if (
+                    recv
+                    and recv.startswith("self.")
+                    and any(
+                        isinstance(a, ast.Name)
+                        and (a.id in arrays or a.id in containers)
+                        for a in node.args
+                    )
+                ):
+                    npy_attrs.add(recv)
+        if not npy_attrs:
+            return []
+        out = []
+        for fn in methods:
+            for node in _walk_no_functions(fn):
+                if isinstance(node, ast.Compare) and any(
+                    isinstance(op, (ast.In, ast.NotIn)) for op in node.ops
+                ):
+                    sides = [node.left] + list(node.comparators)
+                    hit = next(
+                        (
+                            _expr_text(s)
+                            for s in sides
+                            if _expr_text(s) in npy_attrs
+                        ),
+                        None,
+                    )
+                    if hit:
+                        out.append(self.finding(
+                            path, lines, node,
+                            f"membership over {hit}, which holds "
+                            "numpy-bearing entries: compares elementwise "
+                            "and raises (scan by identity instead)",
+                        ))
+                if (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("remove", "index", "count")
+                    and _expr_text(node.func.value) in npy_attrs
+                ):
+                    out.append(self.finding(
+                        path, lines, node,
+                        f".{node.func.attr}() on "
+                        f"{_expr_text(node.func.value)}, which holds "
+                        "numpy-bearing entries: compares elementwise and "
+                        "raises (scan by identity instead)",
+                    ))
+        return out
+
+    def _check_node(self, node, arrays, containers, path, lines):
+        out = []
+        # truthiness: if/while/ternary/assert/not/and/or over a raw array
+        tests = []
+        if isinstance(node, (ast.If, ast.While, ast.IfExp)):
+            tests.append(node.test)
+        elif isinstance(node, ast.Assert):
+            tests.append(node.test)
+        for test in tests:
+            operands = (
+                test.values if isinstance(test, ast.BoolOp) else [test]
+            )
+            for op in operands:
+                if isinstance(op, ast.UnaryOp) and isinstance(
+                    op.op, ast.Not
+                ):
+                    op = op.operand
+                if self._array_tainted(op, arrays):
+                    out.append(self.finding(
+                        path, lines, node,
+                        "numpy value used for truthiness (ambiguous "
+                        "bool raises at runtime)",
+                    ))
+        if isinstance(node, ast.Call):
+            func = node.func
+            # bool(arr)
+            if (
+                isinstance(func, ast.Name) and func.id == "bool"
+                and node.args
+                and self._array_tainted(node.args[0], arrays)
+            ):
+                out.append(self.finding(
+                    path, lines, node,
+                    "bool() over a numpy value raises (ambiguous truth)",
+                ))
+            # pending.remove(arr) / .index / .count compare elementwise
+            if (
+                isinstance(func, ast.Attribute)
+                and func.attr in ("remove", "index", "count")
+                and node.args
+                and self._tainted(node.args[0], arrays, containers)
+            ):
+                out.append(self.finding(
+                    path, lines, node,
+                    f".{func.attr}() with a numpy-bearing argument "
+                    "compares elementwise and raises on match ambiguity",
+                ))
+        if isinstance(node, ast.Compare) and any(
+            isinstance(op, (ast.In, ast.NotIn)) for op in node.ops
+        ):
+            sides = [node.left] + list(node.comparators)
+            if any(self._tainted(s, arrays, containers) for s in sides):
+                out.append(self.finding(
+                    path, lines, node,
+                    "membership test over numpy-bearing values compares "
+                    "elementwise (scan by identity instead)",
+                ))
+        return out
+
+
+@register
+class AsyncBlockRule(Rule):
+    """ASYNC-BLOCK — blocking calls inside ``async def`` bodies.
+
+    One blocking call inside the aio clients or the serving event loop
+    stalls every coroutine sharing that loop.  Flags time.sleep /
+    requests.* / subprocess.* and timeout-less queue.Queue get/put on
+    queues constructed in the same function or bound to ``self`` in the
+    same class.
+    """
+
+    id = "ASYNC-BLOCK"
+    rationale = (
+        "a blocking call in an async body stalls the whole event loop "
+        "(aio clients, serve/)"
+    )
+
+    @staticmethod
+    def _queue_call_blocks(call, bounded):
+        """True when a queue .get/.put call can block indefinitely.
+
+        Signatures: ``get(block=True, timeout=None)`` and
+        ``put(item, block=True, timeout=None)`` — the positional slots
+        differ by one, ``block=False`` never blocks, and ``put`` on an
+        unbounded queue (no maxsize at construction) never blocks.
+        """
+        if call.func.attr == "put" and not bounded:
+            return False
+        kwargs = {kw.arg: kw.value for kw in call.keywords}
+        first = 0 if call.func.attr == "get" else 1  # skip put's item
+        positional = call.args[first:]
+        block = kwargs.get("block", positional[0] if positional else None)
+        if isinstance(block, ast.Constant) and block.value is False:
+            return False  # non-blocking variant
+        has_timeout = "timeout" in kwargs or len(positional) >= 2
+        return not has_timeout
+
+    @staticmethod
+    def _ctor_is_bounded(ctor):
+        """queue.Queue(maxsize>0) blocks on put; bare/0 never does."""
+        sized = list(ctor.args) + [
+            kw.value for kw in ctor.keywords if kw.arg == "maxsize"
+        ]
+        if not sized:
+            return False
+        arg = sized[0]
+        return not (isinstance(arg, ast.Constant) and arg.value == 0)
+
+    def _queue_attrs(self, cls):
+        attrs = {}
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                if _expr_text(node.value.func) in _QUEUE_CTORS:
+                    for t in node.targets:
+                        text = _expr_text(t)
+                        if text and text.startswith("self."):
+                            attrs[text] = self._ctor_is_bounded(node.value)
+        return attrs
+
+    def check(self, tree, lines, path):
+        findings = []
+        class_queue_attrs = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                qattrs = self._queue_attrs(node)
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.AsyncFunctionDef):
+                        class_queue_attrs[id(sub)] = qattrs
+        for fn in _functions(tree):
+            if not isinstance(fn, ast.AsyncFunctionDef):
+                continue
+            qnames = {
+                t.id: self._ctor_is_bounded(node.value)
+                for node in _walk_no_functions(fn)
+                if isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)
+                and _expr_text(node.value.func) in _QUEUE_CTORS
+                for t in node.targets
+                if isinstance(t, ast.Name)
+            }
+            qattrs = class_queue_attrs.get(id(fn), {})
+            for node in _walk_no_functions(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                text = _expr_text(node.func) or ""
+                if text in _ASYNC_BLOCKING_FULL or text.startswith(
+                    _ASYNC_BLOCKING_PREFIXES
+                ):
+                    findings.append(self.finding(
+                        path, lines, node,
+                        f"blocking call {text}() inside async def "
+                        f"{fn.name} stalls the event loop",
+                    ))
+                    continue
+                if isinstance(node.func, ast.Attribute) and node.func.attr in (
+                    "get", "put"
+                ):
+                    recv = _expr_text(node.func.value)
+                    is_queue = recv in qnames or recv in qattrs
+                    bounded = qnames.get(recv, qattrs.get(recv, False))
+                    if is_queue and self._queue_call_blocks(node, bounded):
+                        findings.append(self.finding(
+                            path, lines, node,
+                            f"sync {recv}.{node.func.attr}() without "
+                            f"timeout inside async def {fn.name} blocks "
+                            "the event loop",
+                        ))
+        return findings
+
+
+@register
+class LockDispatchRule(Rule):
+    """LOCK-DISPATCH — device dispatch while holding a scheduler lock.
+
+    jax.jit compiles per novel input signature; a dispatch under
+    ``with self._cv:`` holds the lock for a full XLA compile (seconds)
+    and head-of-line-blocks every other thread (the pre-fix
+    ``_admit_locked`` prefill in serve/models/continuous.py).  Lock-held
+    regions are lexical ``with *lock/cv/cond:`` bodies plus whole methods
+    named ``*_locked`` (this codebase's caller-holds-the-lock
+    convention).  Dispatch callees are names bound from ``jax.jit(...)``
+    anywhere in the module, jax.device_put/get/block_until_ready, and
+    the prefill/decode_step hint names.
+    """
+
+    id = "LOCK-DISPATCH"
+    rationale = (
+        "device dispatch under a lock head-of-line-blocks every waiter "
+        "for a full XLA compile (continuous.py _admit_locked)"
+    )
+
+    def _jit_bound(self, tree):
+        bound = set()
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            value = node.value
+            if not isinstance(value, ast.Call):
+                continue
+            func_text = _expr_text(value.func) or ""
+            if func_text in ("jax.jit", "jit", "jax.pmap", "pmap"):
+                for t in node.targets:
+                    text = _expr_text(t)
+                    if text:
+                        bound.add(text)
+        return bound
+
+    def _is_dispatch(self, call, jit_bound):
+        text = _expr_text(call.func)
+        if not text:
+            return None
+        if text in jit_bound:
+            return f"jit-compiled callable {text}()"
+        if text in _DISPATCH_FULL:
+            return f"{text}()"
+        if _last_segment(text) in _DISPATCH_HINTS:
+            return f"device-dispatch {text}()"
+        return None
+
+    def check(self, tree, lines, path):
+        jit_bound = self._jit_bound(tree)
+        findings = []
+        regions = []
+        for node in ast.walk(tree):
+            if _is_lockish_with(node):
+                regions.append((node, "with-lock block"))
+            elif isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ) and node.name.endswith("_locked"):
+                regions.append((node, f"lock-held method {node.name}"))
+        seen = set()
+        for region, where in regions:
+            for node in ast.walk(region):
+                if not isinstance(node, ast.Call) or id(node) in seen:
+                    continue
+                what = self._is_dispatch(node, jit_bound)
+                if what:
+                    seen.add(id(node))
+                    findings.append(self.finding(
+                        path, lines, node,
+                        f"{what} dispatched inside {where}: holds the "
+                        "lock across a potential XLA compile — move the "
+                        "dispatch outside the critical section",
+                    ))
+        return findings
+
+
+@register
+class QueueSentinelRule(Rule):
+    """QUEUE-SENTINEL — deactivating a streaming slot without closing
+    its queue.
+
+    A per-request token queue's reader blocks on ``get()`` until the
+    close sentinel arrives; any path that flips ``<slot>.active = False``
+    without a ``<slot>.queue.put(...)`` in the same branch strands that
+    reader forever (the pre-fix active-slot branch of ``cancel()`` in
+    serve/models/continuous.py).  Applies to receivers that have a
+    ``.queue`` attribute somewhere in the same module.
+    """
+
+    id = "QUEUE-SENTINEL"
+    rationale = (
+        "slot deactivated without enqueueing the close sentinel strands "
+        "the stream reader (continuous.py cancel() on an active slot)"
+    )
+
+    def check(self, tree, lines, path):
+        queue_receivers = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Attribute) and node.attr == "queue":
+                text = _expr_text(node.value)
+                if text:
+                    queue_receivers.add(text)
+        if not queue_receivers:
+            return []
+
+        # constructor bodies initialize .active = False; that is not a
+        # deactivation and has no reader to strand yet
+        in_init = set()
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.FunctionDef)
+                and node.name == "__init__"
+            ):
+                for sub in ast.walk(node):
+                    in_init.add(id(sub))
+
+        # map each statement to its containing body list (its branch)
+        blocks = {}
+        for node in ast.walk(tree):
+            for field in ("body", "orelse", "finalbody"):
+                body = getattr(node, field, None)
+                if isinstance(body, list):
+                    for stmt in body:
+                        blocks[id(stmt)] = body
+
+        def block_has_close(body, recv):
+            put_text = recv + ".queue.put"
+            for stmt in body:
+                for sub in ast.walk(stmt):
+                    if (
+                        isinstance(sub, ast.Call)
+                        and _expr_text(sub.func) == put_text
+                    ):
+                        return True
+            return False
+
+        findings = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Assign) or id(node) in in_init:
+                continue
+            for target in node.targets:
+                if not (
+                    isinstance(target, ast.Attribute)
+                    and target.attr == "active"
+                ):
+                    continue
+                if not (
+                    isinstance(node.value, ast.Constant)
+                    and node.value.value is False
+                ):
+                    continue
+                recv = _expr_text(target.value)
+                if recv not in queue_receivers:
+                    continue
+                body = blocks.get(id(node))
+                if body is not None and not block_has_close(body, recv):
+                    findings.append(self.finding(
+                        path, lines, node,
+                        f"{recv}.active = False without "
+                        f"{recv}.queue.put(<close sentinel>) in the same "
+                        "branch: a queue reader will hang on get()",
+                    ))
+        return findings
+
+
+@register
+class CvWaitLoopRule(Rule):
+    """CV-WAIT-LOOP — ``Condition.wait()`` outside a predicate loop.
+
+    Condition variables wake spuriously and predicates can be consumed
+    by other waiters: every cv-like ``.wait()`` must sit inside a loop
+    that re-checks its predicate (or use ``wait_for``).  Receivers are
+    matched by name (``*_cv``, ``*_cond``, ``condition``).
+    """
+
+    id = "CV-WAIT-LOOP"
+    rationale = (
+        "cv.wait() without an enclosing predicate loop misses wakeups "
+        "and acts on stale state"
+    )
+
+    def check(self, tree, lines, path):
+        findings = []
+        for fn in list(_functions(tree)) + [tree]:
+            loops = set()
+            for node in _walk_no_functions(fn):
+                if isinstance(node, (ast.While, ast.For)):
+                    loops.add(id(node))
+                    for sub in ast.walk(node):
+                        loops.add(id(sub))
+            for node in _walk_no_functions(fn):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "wait"
+                ):
+                    continue
+                recv = _expr_text(node.func.value)
+                if not recv or not _CVLIKE_RE.search(_last_segment(recv)):
+                    continue
+                if id(node) not in loops:
+                    findings.append(self.finding(
+                        path, lines, node,
+                        f"{recv}.wait() outside a predicate re-check "
+                        "loop: wrap in `while <predicate>:` or use "
+                        "wait_for()",
+                    ))
+        return findings
+
+
+@register
+class SharedMutRule(Rule):
+    """SHARED-MUT — unlocked mutation of state shared with a spawned
+    thread.
+
+    For every class that spawns ``threading.Thread(target=self.<m>)``,
+    the attributes that thread closure touches are shared state: any
+    assignment to them from OTHER methods must happen under a lock
+    (lexically inside ``with *lock/cv/cond:`` or in a ``*_locked``
+    method, this repo's caller-holds-the-lock convention), or in
+    ``__init__`` before the thread can exist.
+    """
+
+    id = "SHARED-MUT"
+    rationale = (
+        "writes racing a scheduler/worker thread corrupt state "
+        "invisibly; every cross-thread write needs the lock"
+    )
+
+    def _thread_targets(self, cls):
+        targets = set()
+        for node in ast.walk(cls):
+            if not isinstance(node, ast.Call):
+                continue
+            func_text = _expr_text(node.func) or ""
+            if not func_text.endswith("Thread"):
+                continue
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    text = _expr_text(kw.value) or ""
+                    if text.startswith("self."):
+                        targets.add(text[len("self."):])
+        return targets
+
+    def check(self, tree, lines, path):
+        findings = []
+        for cls in ast.walk(tree):
+            if isinstance(cls, ast.ClassDef):
+                findings.extend(self._check_class(cls, lines, path))
+        return findings
+
+    def _check_class(self, cls, lines, path):
+        methods = {
+            node.name: node
+            for node in cls.body
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        closure = set()
+        frontier = [m for m in self._thread_targets(cls) if m in methods]
+        if not frontier:
+            return []
+        while frontier:
+            name = frontier.pop()
+            if name in closure:
+                continue
+            closure.add(name)
+            for node in ast.walk(methods[name]):
+                if (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "self"
+                    and node.func.attr in methods
+                ):
+                    frontier.append(node.func.attr)
+
+        shared = set()
+        for name in closure:
+            for node in ast.walk(methods[name]):
+                if (
+                    isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "self"
+                    and node.attr not in methods
+                ):
+                    shared.add(node.attr)
+        if not shared:
+            return []
+
+        findings = []
+        for name, fn in methods.items():
+            if name in closure or name == "__init__":
+                continue
+            if name.endswith("_locked"):
+                continue  # caller holds the lock by convention
+            locked_nodes = set()
+            for node in ast.walk(fn):
+                if _is_lockish_with(node):
+                    for sub in ast.walk(node):
+                        locked_nodes.add(id(sub))
+            for node in ast.walk(fn):
+                if not isinstance(node, (ast.Assign, ast.AugAssign)):
+                    continue
+                if id(node) in locked_nodes:
+                    continue
+                targets = (
+                    node.targets
+                    if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                flat = []
+                for t in targets:
+                    flat.extend(
+                        t.elts if isinstance(t, (ast.Tuple, ast.List))
+                        else [t]
+                    )
+                for t in flat:
+                    if (
+                        isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                        and t.attr in shared
+                    ):
+                        findings.append(self.finding(
+                            path, lines, node,
+                            f"self.{t.attr} is touched by the "
+                            f"{'/'.join(sorted(closure))} thread closure "
+                            f"but written here ({name}) without the "
+                            "lock",
+                        ))
+        return findings

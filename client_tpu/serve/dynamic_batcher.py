@@ -103,6 +103,11 @@ def _fused_group_fn(model_fn):
             for name, ps in parts.items()
         }
         out = model_fn(batched, {}, None)
+        # reserved response-params key: a traced fn's dict would be a
+        # trace-time constant (stale across calls) and jnp.split chokes on
+        # it — fused models cannot set per-response parameters; drop it
+        if isinstance(out, dict):
+            out.pop("__parameters__", None)
         sizes = [int(p.shape[0]) for p in next(iter(parts.values()))]
         offs = list(np.cumsum(sizes[:-1]))
         return {
@@ -607,6 +612,13 @@ class ModelBatcher:
                     p.event.set()
                 watch = per_part
             else:
+                # batch-wide response parameters replicate, never slice
+                # (reserved "__parameters__" result key)
+                extra_params = (
+                    result.pop("__parameters__", None)
+                    if isinstance(result, dict)
+                    else None
+                )
                 offset = 0
                 for p in group:
                     # whole-buffer pass-through when one request fills the
@@ -617,6 +629,8 @@ class ModelBatcher:
                         else _device_split(arr, offset, p.rows)
                         for name, arr in result.items()
                     }
+                    if extra_params is not None:
+                        p.result["__parameters__"] = extra_params
                     offset += p.rows
                     p.event.set()
                 watch = result
@@ -648,12 +662,18 @@ class ModelBatcher:
                 self._busy.end()  # wire results landed host-side
                 busy_open = False
             t_inf = time.monotonic_ns()
+            # response-level parameters (reserved "__parameters__" result
+            # key) are batch-wide, not row-sliceable: replicate them onto
+            # every request's split instead of slicing a dict
+            extra_params = host.pop("__parameters__", None)
             offset = 0
             for p in group:
                 p.result = {
                     name: arr[offset : offset + p.rows]
                     for name, arr in host.items()
                 }
+                if extra_params is not None:
+                    p.result["__parameters__"] = extra_params
                 offset += p.rows
                 p.event.set()
             with self._cond:

@@ -833,9 +833,12 @@ class InferenceEngine:
                     rendered = self._render_response(
                         model, model_version, request, partial
                     )
-                    rendered[0]["parameters"] = {
-                        "triton_final_response": False
-                    }
+                    # merge, don't overwrite: the model (via the reserved
+                    # "__parameters__" result key) or the render step may
+                    # have set response-level parameters of its own
+                    rendered[0].setdefault("parameters", {})[
+                        "triton_final_response"
+                    ] = False
                 finally:
                     self.busy.end()
                 yield rendered
@@ -1051,7 +1054,7 @@ class InferenceEngine:
         outputs_json = []
         blobs = []
         for name, params in selection:
-            if name not in result_arrays:
+            if name == "__parameters__" or name not in result_arrays:
                 raise InferenceServerException(
                     f"unexpected inference output '{name}' for model "
                     f"'{model.name}'",
@@ -1112,6 +1115,14 @@ class InferenceEngine:
             "model_version": model_version or model.versions[-1],
             "outputs": outputs_json,
         }
+        # reserved result key: a model sets response-level parameters by
+        # including "__parameters__": {...} beside its output tensors
+        # (never selected as a tensor above; both servers forward them).
+        # Not available to fused_batching models: their fn is traced, so
+        # the dict would be a trace-time constant (the fused path drops it)
+        extra_params = result_arrays.get("__parameters__")
+        if extra_params:
+            response["parameters"] = dict(extra_params)
         if request.get("id"):
             response["id"] = request["id"]
         return response, blobs

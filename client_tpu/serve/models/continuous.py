@@ -41,6 +41,10 @@ from client_tpu.serve.models import transformer as tfm
 # sentinel object closing a stream's token queue
 _CLOSE = object()
 
+# placed-marker for a handle cancelled while its prefill dispatch was in
+# flight (admission runs outside _cv); _admit sees it and closes the queue
+_CANCELLED = object()
+
 
 class _Slot:
     __slots__ = ("gen", "active", "queue", "remaining", "produced")
@@ -161,12 +165,19 @@ class ContinuousLmScheduler:
                     return
             placed = handle[3]
             if placed is None:
+                # popped from _pending but not yet admitted: the prefill
+                # dispatch is running outside _cv right now.  Mark the
+                # handle; _admit closes the queue once the dispatch returns.
+                handle[3] = _CANCELLED
+                return
+            if placed is _CANCELLED:
                 return
             slot_idx, gen = placed
             slot = self._slots[slot_idx]
             if slot.active and slot.gen == gen:
                 slot.active = False
                 slot.gen += 1  # in-flight ticks for this lane drop on drain
+                slot.queue.put(_CLOSE)  # a reader must not hang on get()
 
     def _release_all_locked(self):
         """Close every pending and active stream queue (caller holds _cv)."""
@@ -189,33 +200,64 @@ class ContinuousLmScheduler:
 
     # -- scheduler loop ----------------------------------------------------
 
-    def _admit_locked(self):
-        """Move pending requests into free lanes (prefill + splice)."""
-        admitted = False
-        for slot_idx, slot in enumerate(self._slots):
-            if not self._pending or slot.active:
-                continue
-            prompt, max_tokens, q, _ = entry = self._pending.pop(0)
-            single = tfm.init_cache(self.cfg, 1)
-            logits, single = self._prefill(self.params, jnp.asarray(prompt),
-                                           cache=single)
-            first = jnp.argmax(logits, axis=-1).astype(jnp.int32)[0]
-            self._cache, self._tokens = self._adopt(
-                self._cache, single, self._tokens, slot_idx, first
-            )
-            slot.gen += 1
-            slot.active = True
-            slot.queue = q
-            slot.remaining = max_tokens
-            slot.produced = 0
-            entry[3] = (slot_idx, slot.gen)
-            # the prefill's own first token streams through the readback
-            # pipeline like every tick token (single-lane entry)
-            if hasattr(first, "copy_to_host_async"):
-                first.copy_to_host_async()
-            self._inflight.append((first, ((slot_idx, slot.gen),)))
-            admitted = True
-        return admitted
+    def _admit(self):
+        """Move pending requests into free lanes (prefill + splice).
+
+        The prefill dispatch runs OUTSIDE _cv: jax.jit compiles a fresh
+        prefill executable per distinct prompt length, so a novel-length
+        prompt would otherwise hold the lock for a full XLA compile
+        (seconds) and head-of-line-block every submit()/cancel() caller.
+        Only the pending-pop and slot bookkeeping need the lock — the
+        device state (_cache/_tokens) is scheduler-thread-private.  Lanes
+        admit one at a time; the scheduler is the only admitter, so a
+        reserved slot_idx cannot be stolen while the lock is dropped.
+        """
+        while True:
+            with self._cv:
+                if self._closed or not self._pending:
+                    return
+                slot_idx = next(
+                    (i for i, s in enumerate(self._slots) if not s.active),
+                    None,
+                )
+                if slot_idx is None:
+                    return
+                entry = self._pending.pop(0)
+                prompt, max_tokens, q = entry[0], entry[1], entry[2]
+            try:
+                single = tfm.init_cache(self.cfg, 1)
+                logits, single = self._prefill(
+                    self.params, jnp.asarray(prompt), cache=single
+                )
+                first = jnp.argmax(logits, axis=-1).astype(jnp.int32)[0]
+                self._cache, self._tokens = self._adopt(
+                    self._cache, single, self._tokens, slot_idx, first
+                )
+            except BaseException:
+                # the entry is in neither _pending nor a slot here, so the
+                # crash handler's _release_all_locked cannot see it — close
+                # its stream before the exception kills the scheduler
+                q.put(_CLOSE)
+                raise
+            with self._cv:
+                if self._closed or entry[3] is _CANCELLED:
+                    # consumer went away (or shutdown) during the dispatch:
+                    # close the stream and leave the lane free — the spliced
+                    # cache rows are inert, like any idle lane's garbage
+                    q.put(_CLOSE)
+                    continue
+                slot = self._slots[slot_idx]
+                slot.gen += 1
+                slot.active = True
+                slot.queue = q
+                slot.remaining = max_tokens
+                slot.produced = 0
+                entry[3] = (slot_idx, slot.gen)
+                # the prefill's own first token streams through the readback
+                # pipeline like every tick token (single-lane entry)
+                if hasattr(first, "copy_to_host_async"):
+                    first.copy_to_host_async()
+                self._inflight.append((first, ((slot_idx, slot.gen),)))
 
     def _drain_one(self):
         tokens_dev, snapshot = self._inflight.popleft()
@@ -254,10 +296,10 @@ class ContinuousLmScheduler:
 
         self._inflight = deque()
         while True:
+            self._admit()  # takes/releases _cv itself; prefill outside it
             with self._cv:
                 if self._closed:
                     break
-                self._admit_locked()
                 active = [
                     (i, s.gen) for i, s in enumerate(self._slots) if s.active
                 ]

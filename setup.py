@@ -35,6 +35,7 @@ setup(
     packages=find_packages(include=["client_tpu", "client_tpu.*"]),
     package_data={
         "client_tpu.utils.shared_memory": ["libcshm_tpu.so"],
+        "client_tpu.analysis": ["baseline.json"],
     },
     python_requires=">=3.9",
     install_requires=["numpy>=1.22", "urllib3>=1.26", "protobuf>=3.19"],
@@ -47,6 +48,7 @@ setup(
         "console_scripts": [
             "client-tpu-perf=client_tpu.perf.__main__:main",
             "client-tpu-serve=client_tpu.serve.__main__:main",
+            "client-tpu-lint=client_tpu.analysis.__main__:main",
         ],
     },
 )

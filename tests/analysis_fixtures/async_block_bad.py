@@ -1,0 +1,32 @@
+"""ASYNC-BLOCK violations: blocking calls inside async bodies — each one
+stalls every coroutine sharing the event loop (the aio clients multiplex
+all in-flight infers on one loop)."""
+
+import queue
+import time
+
+import requests
+
+
+class AioClient:
+    def __init__(self):
+        self._results = queue.Queue()
+
+    async def infer_with_backoff(self, request):
+        time.sleep(0.5)  # stalls the loop; use asyncio.sleep
+        return request
+
+    async def fetch_metadata(self, url):
+        return requests.get(url)  # sync HTTP inside async
+
+    async def next_result(self):
+        return self._results.get()  # timeout-less queue get on the loop
+
+    async def local_queue_roundtrip(self, item):
+        q = queue.Queue()
+        q.put(item)  # unbounded put never blocks: NOT flagged
+        return q.get()  # blocks the loop if racing producers
+
+    async def explicit_blocking_put(self, item):
+        q = queue.Queue(maxsize=1)
+        q.put(item, True)  # bounded + positional block=True: blocks

@@ -1,0 +1,40 @@
+"""ASYNC-BLOCK clean samples: awaited primitives, bounded queue ops, and
+blocking calls that live in *sync* helpers (fine — they run on worker
+threads)."""
+
+import asyncio
+import queue
+import time
+
+
+class AioClient:
+    def __init__(self):
+        self._results = queue.Queue()
+
+    async def infer_with_backoff(self, request):
+        await asyncio.sleep(0.5)
+        return request
+
+    async def next_result(self):
+        # bounded wait: worst case surfaces as queue.Empty, not a wedge
+        return self._results.get(timeout=30)
+
+    async def poll_result(self):
+        return self._results.get_nowait()
+
+    async def poll_result_positional(self):
+        return self._results.get(False)  # block=False never blocks
+
+    async def put_with_timeout(self, item):
+        self._results.put(item, True, 5)  # positional timeout bounds it
+
+    async def unbounded_put(self, item):
+        self._results.put(item)  # queue.Queue() without maxsize: no block
+
+    async def bounded_put_with_timeout(self, item):
+        q = queue.Queue(maxsize=4)
+        q.put(item, timeout=5)
+
+    def sync_helper(self):
+        time.sleep(0.01)  # sync context: allowed
+        return self._results.get()

@@ -1,0 +1,16 @@
+"""CV-WAIT-LOOP violation: a condition wait with no predicate re-check
+loop — spurious wakeups and consumed predicates act on stale state."""
+
+import threading
+
+
+class Batcher:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._queue = []
+
+    def take(self):
+        with self._cv:
+            if not self._queue:
+                self._cv.wait()  # woken with the queue still empty
+            return self._queue.pop(0)

@@ -1,0 +1,26 @@
+"""CV-WAIT-LOOP clean samples: predicate loops, wait_for, and Event.wait
+(events latch, so the loop rule does not apply to them)."""
+
+import threading
+
+
+class Batcher:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._cond = threading.Condition()
+        self._queue = []
+        self._stop = threading.Event()
+
+    def take(self):
+        with self._cv:
+            while not self._queue:
+                self._cv.wait()
+            return self._queue.pop(0)
+
+    def take_with_timeout(self, timeout):
+        with self._cond:
+            self._cond.wait_for(lambda: self._queue, timeout=timeout)
+            return self._queue.pop(0) if self._queue else None
+
+    def join(self):
+        self._stop.wait()  # Event receiver: not cv-like, out of scope

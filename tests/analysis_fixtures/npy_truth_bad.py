@@ -1,0 +1,39 @@
+"""NPY-TRUTH violations, modeled on the a2654c4 cancel() crash: entries
+holding numpy prompts hit list membership / remove, which compare
+elementwise and raise "truth value of an array is ambiguous"."""
+
+import queue
+
+import numpy as np
+
+
+class Scheduler:
+    def __init__(self):
+        self._pending = []
+
+    def submit_and_dedup(self, prompt_tokens, max_tokens):
+        prompt = np.asarray(prompt_tokens, np.int32).reshape(1, -1)
+        entry = [prompt, max_tokens, queue.Queue()]
+        if entry in self._pending:  # elementwise compare -> ValueError
+            self._pending.remove(entry)  # same crash on the remove
+        self._pending.append(entry)
+        return entry
+
+    def cancel(self, handle):
+        # the EXACT pre-a2654c4 shape: the numpy-bearing handle arrives as
+        # a parameter; only submit_and_dedup above shows the taint, so the
+        # class-level pass must connect them
+        if handle in self._pending:
+            self._pending.remove(handle)
+
+    def has_tokens(self, prompt_tokens):
+        arr = np.asarray(prompt_tokens, np.int32)
+        if arr:  # ambiguous truth: raises for size != 1
+            return True
+        return bool(arr)  # same crash, spelled explicitly
+
+    def wait_until_nonempty(self, prompt_tokens):
+        arr = np.array(prompt_tokens)
+        while not arr:  # ambiguous truth in the loop predicate
+            arr = np.array(prompt_tokens)
+        assert arr  # and in the assert

@@ -1,0 +1,32 @@
+"""NPY-TRUTH clean samples: the post-a2654c4 idioms — identity scans for
+membership, explicit .size/.any()/len() for truthiness."""
+
+import numpy as np
+
+
+class Scheduler:
+    def __init__(self):
+        self._pending = []
+
+    def cancel(self, handle):
+        # identity scan: entries hold numpy prompts, so `in`/`remove`
+        # would compare element-wise
+        for i, entry in enumerate(self._pending):
+            if entry is handle:
+                del self._pending[i]
+                return
+
+    def has_tokens(self, prompt_tokens):
+        arr = np.asarray(prompt_tokens, np.int32)
+        if arr.size:  # explicit emptiness
+            return True
+        if len(arr):
+            return True
+        return bool(arr.any())  # explicit reduction
+
+    def scalar_flags_are_fine(self, n):
+        count = int(n)
+        if count:  # plain int: not numpy-tainted
+            return True
+        flags = [True, False]
+        return count in [1, 2] and flags  # plain containers: fine

@@ -1,0 +1,56 @@
+"""PRE-FIX _admit_locked from serve/models/continuous.py (this PR's
+ADVICE medium finding): the jit prefill + adopt dispatches run while the
+caller holds the scheduler condition lock — a novel-length prompt holds
+_cv for the full XLA compile and head-of-line-blocks every submit(),
+cancel(), and decode tick.  LOCK-DISPATCH must flag both dispatches via
+the *_locked method-name convention AND the inline `with self._cv:`
+variant below.
+"""
+
+import functools
+import threading
+
+import jax
+import jax.numpy as jnp
+
+from some_model import adopt, prefill, tick  # noqa: F401 (fixture only)
+
+
+class Scheduler:
+    def __init__(self, params, cfg):
+        self.params = params
+        self._cv = threading.Condition()
+        self._pending = []
+        self._slots = []
+        self._prefill = jax.jit(functools.partial(prefill, cfg=cfg))
+        self._adopt = jax.jit(adopt)
+        self._tick = jax.jit(tick)
+
+    def _admit_locked(self):
+        """Move pending requests into free lanes (prefill + splice)."""
+        admitted = False
+        for slot_idx, slot in enumerate(self._slots):
+            if not self._pending or slot.active:
+                continue
+            prompt, max_tokens, q, _ = entry = self._pending.pop(0)
+            single = {}
+            logits, single = self._prefill(self.params, jnp.asarray(prompt),
+                                           cache=single)
+            first = jnp.argmax(logits, axis=-1).astype(jnp.int32)[0]
+            self._cache, self._tokens = self._adopt(
+                self._cache, single, self._tokens, slot_idx, first
+            )
+            slot.active = True
+            slot.queue = q
+            admitted = True
+        return admitted
+
+    def _loop_inner(self):
+        while True:
+            with self._cv:
+                if self._closed:
+                    break
+                # inline variant: tick dispatched under the lock
+                self._tokens, self._cache = self._tick(
+                    self.params, self._tokens, self._cache
+                )

@@ -1,0 +1,46 @@
+"""PRE-FIX cancel() from serve/models/continuous.py (this PR's ADVICE
+low finding): the active-slot branch frees the lane but never enqueues
+the close sentinel, stranding any public-API reader on queue.get().
+QUEUE-SENTINEL must flag the `slot.active = False` in cancel(); the
+close()/_release_all_locked path (put in the same branch) must stay
+clean.  Kept verbatim-shaped so the rule is proven against the real bug.
+"""
+
+_CLOSE = object()
+
+
+class Scheduler:
+    def __init__(self):
+        self._pending = []
+        self._slots = []
+        self._cv = None
+
+    def cancel(self, handle):
+        """Release a stream early (consumer went away)."""
+        if handle is None:
+            return
+        with self._cv:
+            for i, entry in enumerate(self._pending):
+                if entry is handle:
+                    entry[2].put(_CLOSE)
+                    del self._pending[i]
+                    return
+            placed = handle[3]
+            if placed is None:
+                return
+            slot_idx, gen = placed
+            slot = self._slots[slot_idx]
+            if slot.active and slot.gen == gen:
+                slot.active = False
+                slot.gen += 1  # in-flight ticks for this lane drop on drain
+
+    def _release_all_locked(self):
+        """Close every pending and active stream queue (caller holds _cv)."""
+        for entry in self._pending:
+            entry[2].put(_CLOSE)
+        self._pending.clear()
+        for slot in self._slots:
+            if slot.active:
+                slot.active = False
+                slot.gen += 1
+                slot.queue.put(_CLOSE)

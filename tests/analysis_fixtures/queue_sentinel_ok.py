@@ -1,0 +1,27 @@
+"""QUEUE-SENTINEL clean samples: every deactivation closes the stream
+queue in the same branch; constructor initialization is exempt."""
+
+_CLOSE = object()
+
+
+class _Slot:
+    def __init__(self):
+        self.active = False  # initialization, not a deactivation
+        self.queue = None
+
+
+class Scheduler:
+    def __init__(self):
+        self._slots = []
+
+    def finish(self, slot):
+        slot.queue.put(_CLOSE)
+        slot.active = False
+        slot.gen += 1
+
+    def release_all(self):
+        for slot in self._slots:
+            if slot.active:
+                slot.active = False
+                slot.gen += 1
+                slot.queue.put(_CLOSE)

@@ -1,0 +1,26 @@
+"""SHARED-MUT violation: state the worker thread iterates is reassigned
+from the request side without taking the lock — the thread can read a
+half-updated view or lose the write entirely."""
+
+import threading
+
+
+class Batcher:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._backlog = []
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def _loop(self):
+        while True:
+            with self._cv:
+                while not self._backlog:
+                    self._cv.wait()
+                batch, self._backlog = self._backlog, []
+            self._dispatch(batch)
+
+    def _dispatch(self, batch):
+        pass
+
+    def reset(self):
+        self._backlog = []  # races the worker: no lock held

@@ -1,0 +1,39 @@
+"""SHARED-MUT clean samples: every cross-thread write happens under the
+condition lock, in __init__ (before the thread exists), or in a
+*_locked method whose caller holds the lock by convention."""
+
+import threading
+
+
+class Batcher:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._backlog = []
+        self._closed = False
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def _loop(self):
+        while True:
+            with self._cv:
+                while not self._backlog and not self._closed:
+                    self._cv.wait()
+                if self._closed:
+                    return
+                batch, self._backlog = self._backlog, []
+            self._dispatch(batch)
+
+    def _dispatch(self, batch):
+        pass
+
+    def reset(self):
+        with self._cv:
+            self._backlog = []
+            self._cv.notify_all()
+
+    def _drain_locked(self):
+        self._backlog = []  # caller holds _cv (naming convention)
+
+    def close(self):
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()

@@ -1,0 +1,24 @@
+"""Suppression samples: real violations waived in place with
+`# tpulint: disable=RULE` — same-line and comment-line-above forms."""
+
+import threading
+import time
+
+import numpy as np
+
+
+class Scheduler:
+    def __init__(self):
+        self._cv = threading.Condition()
+
+    def has_tokens(self, prompt_tokens):
+        arr = np.asarray(prompt_tokens, np.int32)
+        if arr:  # tpulint: disable=NPY-TRUTH
+            return True
+        # single-waiter cv with a latched predicate; loop not needed here
+        # tpulint: disable=CV-WAIT-LOOP
+        self._cv.wait()
+        return False
+
+    async def blanket_waiver(self):
+        time.sleep(0.1)  # tpulint: disable

@@ -1,0 +1,257 @@
+"""tpu-lint (client_tpu/analysis): each rule proven against the real bug
+it encodes — hit on the known-violation fixture, silent on the clean
+twin — plus suppression comments, the baseline ratchet, the CLI gate,
+and the requirement that the repo's own tree scans clean."""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+from client_tpu.analysis import REGISTRY, scan_paths, scan_source
+from client_tpu.analysis import baseline as baseline_mod
+from client_tpu.analysis.baseline import filter_findings
+
+FIXTURES = Path(__file__).parent / "analysis_fixtures"
+ROOT = Path(__file__).parent.parent
+
+
+def _scan(name):
+    path = FIXTURES / name
+    return scan_source(path.read_text(), str(path))
+
+
+def _rules_hit(findings):
+    return sorted({f.rule for f in findings})
+
+
+def test_registry_has_all_rules():
+    assert set(REGISTRY) >= {
+        "NPY-TRUTH", "ASYNC-BLOCK", "LOCK-DISPATCH", "QUEUE-SENTINEL",
+        "CV-WAIT-LOOP", "SHARED-MUT",
+    }
+    assert len(REGISTRY) >= 6
+    for rule in REGISTRY.values():
+        assert rule.rationale  # every rule documents its motivating bug
+
+
+# -- per-rule hits and misses ---------------------------------------------
+
+def test_npy_truth_hits():
+    findings = _scan("npy_truth_bad.py")
+    assert _rules_hit(findings) == ["NPY-TRUTH"]
+    # membership, remove, if-truthiness, bool(), while-not, assert, plus
+    # the cross-method a2654c4 cancel() shape (membership + remove over a
+    # numpy-bearing self-attribute, taint visible only in submit)
+    assert len(findings) == 8
+    cancel_hits = [f for f in findings if "self._pending" in f.message]
+    assert len(cancel_hits) >= 2
+
+
+def test_npy_truth_clean():
+    assert _scan("npy_truth_ok.py") == []
+
+
+def test_async_block_hits():
+    findings = _scan("async_block_bad.py")
+    assert _rules_hit(findings) == ["ASYNC-BLOCK"]
+    # time.sleep, requests.get, self-queue get, local q.get, and the
+    # bounded positional block=True put (unbounded puts never block)
+    assert len(findings) == 5
+
+
+def test_async_block_clean():
+    assert _scan("async_block_ok.py") == []
+
+
+def test_lock_dispatch_hits_prefix_admit():
+    """The rule is proven against the real pre-fix _admit_locked: both
+    jit dispatches under the *_locked convention plus the inline
+    with-self._cv tick."""
+    findings = _scan("prefix_admit_lock_dispatch.py")
+    assert _rules_hit(findings) == ["LOCK-DISPATCH"]
+    assert len(findings) == 3
+    messages = " ".join(f.message for f in findings)
+    assert "self._prefill" in messages
+    assert "self._adopt" in messages
+    assert "self._tick" in messages
+
+
+def test_lock_dispatch_clean():
+    assert _scan("lock_dispatch_ok.py") == []
+
+
+def test_queue_sentinel_hits_prefix_cancel():
+    """The rule is proven against the real pre-fix cancel(): the
+    active-slot branch deactivates without closing the stream queue; the
+    release-all path (put in the same branch) stays clean."""
+    findings = _scan("prefix_cancel_queue_sentinel.py")
+    assert _rules_hit(findings) == ["QUEUE-SENTINEL"]
+    assert len(findings) == 1
+    assert "slot.active = False" in findings[0].snippet
+
+
+def test_queue_sentinel_clean():
+    assert _scan("queue_sentinel_ok.py") == []
+
+
+def test_cv_wait_loop_hits():
+    findings = _scan("cv_wait_bad.py")
+    assert _rules_hit(findings) == ["CV-WAIT-LOOP"]
+    assert len(findings) == 1
+
+
+def test_cv_wait_loop_clean():
+    assert _scan("cv_wait_ok.py") == []
+
+
+def test_shared_mut_hits():
+    findings = _scan("shared_mut_bad.py")
+    assert _rules_hit(findings) == ["SHARED-MUT"]
+    assert len(findings) == 1
+    assert "_backlog" in findings[0].message
+
+
+def test_shared_mut_clean():
+    assert _scan("shared_mut_ok.py") == []
+
+
+def test_current_continuous_passes_every_rule():
+    """The post-fix scheduler is the motivating module: it must scan
+    clean (cancel closes active queues; prefill dispatch left the lock)."""
+    assert scan_paths(
+        [str(ROOT / "client_tpu" / "serve" / "models" / "continuous.py")]
+    ) == []
+
+
+# -- suppression ----------------------------------------------------------
+
+def test_suppression_comments():
+    assert _scan("suppressed_ok.py") == []
+
+
+def test_suppression_is_per_rule():
+    src = (FIXTURES / "cv_wait_bad.py").read_text()
+    # waiving a DIFFERENT rule must not silence the finding
+    src = src.replace(
+        "self._cv.wait()", "self._cv.wait()  # tpulint: disable=NPY-TRUTH"
+    )
+    findings = scan_source(src, "cv_wait_bad.py")
+    assert _rules_hit(findings) == ["CV-WAIT-LOOP"]
+
+
+def test_parse_error_is_reported():
+    findings = scan_source("def broken(:\n", "broken.py")
+    assert _rules_hit(findings) == ["PARSE-ERROR"]
+
+
+# -- baseline ratchet -----------------------------------------------------
+
+def test_baseline_ratchet(tmp_path):
+    findings = _scan("prefix_cancel_queue_sentinel.py")
+    assert findings
+    baseline_path = tmp_path / "baseline.json"
+    baseline_mod.save(str(baseline_path), findings)
+    counter = baseline_mod.load(str(baseline_path))
+
+    # grandfathered finding passes
+    new, old = filter_findings(findings, counter)
+    assert new == [] and len(old) == len(findings)
+
+    # a finding NOT in the baseline fails
+    extra = _scan("cv_wait_bad.py")
+    new, old = filter_findings(findings + extra, counter)
+    assert [f.rule for f in new] == ["CV-WAIT-LOOP"]
+
+    # the ratchet never grows: a second occurrence of a baselined line
+    # beyond its recorded count is new
+    new, old = filter_findings(findings + findings, counter)
+    assert len(new) == len(findings) and len(old) == len(findings)
+
+
+def test_committed_baseline_loads():
+    counter = baseline_mod.load(baseline_mod.DEFAULT_BASELINE)
+    assert sum(counter.values()) >= 0  # well-formed (possibly empty)
+
+
+# -- CLI gate -------------------------------------------------------------
+
+def _cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "client_tpu.analysis", *args],
+        cwd=str(ROOT), capture_output=True, text=True, timeout=120,
+    )
+
+
+def test_cli_exits_nonzero_on_findings():
+    proc = _cli(
+        "tests/analysis_fixtures/prefix_cancel_queue_sentinel.py",
+        "tests/analysis_fixtures/prefix_admit_lock_dispatch.py",
+        "--no-baseline",
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "QUEUE-SENTINEL" in proc.stdout
+    assert "LOCK-DISPATCH" in proc.stdout
+
+
+def test_cli_repo_tree_is_clean():
+    """The acceptance gate: the post-fix tree (sources AND tests) holds
+    every invariant the rules encode."""
+    proc = _cli("client_tpu", "tests")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_json_output():
+    proc = _cli(
+        "tests/analysis_fixtures/cv_wait_bad.py", "--json", "--no-baseline"
+    )
+    assert proc.returncode == 1
+    payload = json.loads(proc.stdout)
+    assert payload["count"] == 1
+    assert payload["findings"][0]["rule"] == "CV-WAIT-LOOP"
+    assert "CV-WAIT-LOOP" in payload["rules"]
+
+
+def test_cli_rule_selection_and_catalog():
+    proc = _cli("--list-rules")
+    assert proc.returncode == 0
+    for rule_id in REGISTRY:
+        assert rule_id in proc.stdout
+    # selecting only an unrelated rule silences the cv finding
+    proc = _cli(
+        "tests/analysis_fixtures/cv_wait_bad.py", "--rules", "NPY-TRUTH",
+        "--no-baseline",
+    )
+    assert proc.returncode == 0
+    proc = _cli("--rules", "NOT-A-RULE")
+    assert proc.returncode == 2
+
+
+def test_cli_missing_path_is_an_error():
+    """A typo'd path must fail loudly (exit 2), not scan nothing and
+    report a green gate."""
+    proc = _cli("no_such_dir_anywhere", "--no-baseline")
+    assert proc.returncode == 2
+    assert "no such path" in proc.stderr
+
+
+def test_fixtures_are_excluded_from_tree_scans():
+    findings = scan_paths([str(Path("tests"))])
+    assert all("analysis_fixtures" not in f.path for f in findings)
+
+
+def test_write_baseline_rejects_filtered_scans():
+    """A --rules- or path-filtered scan must not regenerate the baseline:
+    it would silently drop every other rule's grandfathered entries."""
+    proc = _cli("client_tpu", "--write-baseline")
+    assert proc.returncode == 2
+    proc = _cli("--rules", "NPY-TRUTH", "--write-baseline")
+    assert proc.returncode == 2
+
+
+def test_explicitly_named_excluded_dir_is_scanned():
+    """Exclusion guards tree walks only: naming the fixtures dir directly
+    must scan it (findings, exit 1), not report a silent green no-op."""
+    proc = _cli("tests/analysis_fixtures", "--no-baseline")
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "QUEUE-SENTINEL" in proc.stdout

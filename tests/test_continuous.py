@@ -4,6 +4,7 @@ cancels, and scale the serving path over concurrent streams."""
 
 import queue
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -100,6 +101,101 @@ def test_cancel_with_pending_queue(params):
         sched.cancel(h1)   # active lane, pending entries present
         sched.cancel(h3)   # pending entry, removed by identity
         assert _collect(q2) == _serial(params, [1, 2, 3], 6)
+    finally:
+        sched.close()
+
+
+def test_cancel_active_slot_closes_queue(params):
+    """cancel() on an ADMITTED request must enqueue CLOSE on the slot's
+    queue: a public-API consumer reading the queue directly (not the
+    abandoning BatchedLmRunner generator) must never hang on get()."""
+    sched = ContinuousLmScheduler(params, CFG, max_slots=1)
+    try:
+        q, h = sched.submit([1, 2, 3], 30)
+        assert q.get(timeout=60) is not ContinuousLmScheduler.CLOSE
+        sched.cancel(h)
+        # drain whatever was in flight; the stream MUST terminate
+        while True:
+            tok = q.get(timeout=10)  # pre-fix: hangs forever here
+            if tok is ContinuousLmScheduler.CLOSE:
+                break
+        sched.cancel(h)  # idempotent: double-cancel of a released lane
+    finally:
+        sched.close()
+
+
+class _GatedPrefill:
+    """Wraps a scheduler's jitted prefill so tests can hold the dispatch
+    open and observe what the scheduler lock does meanwhile."""
+
+    def __init__(self, real):
+        self.real = real
+        self.entered = threading.Event()
+        self.release = threading.Event()
+
+    def __call__(self, *args, **kwargs):
+        self.entered.set()
+        assert self.release.wait(timeout=60)
+        return self.real(*args, **kwargs)
+
+
+def test_submit_not_blocked_by_slow_prefill(params):
+    """A slow (cold-compile) prefill must not head-of-line-block submit():
+    the admission dispatch runs outside _cv (regression for the pre-fix
+    _admit_locked, which held the condition lock across the compile)."""
+    sched = ContinuousLmScheduler(params, CFG, max_slots=2)
+    gate = _GatedPrefill(sched._prefill)
+    sched._prefill = gate
+    try:
+        q1, _ = sched.submit([1, 2, 3], 4)
+        assert gate.entered.wait(timeout=60)
+        # scheduler thread is inside the prefill dispatch right now; the
+        # lock must be free for new submissions and cancels
+        t0 = time.monotonic()
+        q2, h2 = sched.submit([4, 5], 3)
+        sched.cancel(None)
+        submit_latency = time.monotonic() - t0
+        gate.release.set()
+        assert submit_latency < 1.0, submit_latency
+        assert _collect(q1) == _serial(params, [1, 2, 3], 4)
+        assert _collect(q2) == _serial(params, [4, 5], 3)
+    finally:
+        gate.release.set()
+        sched.close()
+
+
+def test_cancel_during_prefill_closes_stream(params):
+    """cancel() racing the (unlocked) prefill dispatch: the stream still
+    terminates with CLOSE and the lane comes back free."""
+    sched = ContinuousLmScheduler(params, CFG, max_slots=1)
+    gate = _GatedPrefill(sched._prefill)
+    sched._prefill = gate
+    try:
+        q1, h1 = sched.submit([1, 2, 3], 8)
+        assert gate.entered.wait(timeout=60)
+        sched.cancel(h1)  # mid-admission: entry popped, not yet placed
+        gate.release.set()
+        assert _collect(q1) == []  # closed without tokens, reader released
+        q2, _ = sched.submit([4, 5], 3)
+        assert _collect(q2) == _serial(params, [4, 5], 3)
+    finally:
+        gate.release.set()
+        sched.close()
+
+
+def test_failing_prefill_does_not_strand_reader(params):
+    """If the admission dispatch itself dies (device OOM / XLA failure on
+    a cold compile), the popped entry's reader must still get CLOSE — it
+    is in neither _pending nor a slot when the crash handler runs."""
+    sched = ContinuousLmScheduler(params, CFG, max_slots=1)
+
+    def exploding_prefill(*a, **kw):
+        raise RuntimeError("XLA compile failed")
+
+    sched._prefill = exploding_prefill
+    try:
+        q, _ = sched.submit([1, 2, 3], 4)
+        assert _collect(q) == []  # stream closed, no tokens, no hang
     finally:
         sched.close()
 

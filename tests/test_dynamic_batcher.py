@@ -106,6 +106,79 @@ def test_concurrent_requests_fuse_and_split_correctly():
     engine.close()
 
 
+def test_batched_model_response_parameters_replicate():
+    """A batched model's reserved "__parameters__" result key is
+    batch-wide: the split replicates it to every request instead of
+    row-slicing the dict (which raised and 500'd the whole group)."""
+    record = []
+
+    def fn(inputs, params, ctx):
+        record.append(int(inputs["IN"].shape[0]))
+        return {
+            "OUT": inputs["IN"] * 2.0,
+            "__parameters__": {"engine_pass": 1, "batched": True},
+        }
+
+    model = Model(
+        "echo2x",
+        inputs=[TensorSpec("IN", "FP32", [-1, 4])],
+        outputs=[TensorSpec("OUT", "FP32", [-1, 4])],
+        fn=fn,
+        max_batch_size=8,
+        dynamic_batching=True,
+        max_queue_delay_us=20000,
+    )
+    engine = InferenceEngine(models=[model])
+    n_threads = 4
+    arrays = [
+        np.full((1, 4), float(i), dtype=np.float32) for i in range(n_threads)
+    ]
+    responses = [None] * n_threads
+    blobs_out = [None] * n_threads
+    barrier = threading.Barrier(n_threads)
+
+    def run(i):
+        req, raw = _request(arrays[i])
+        barrier.wait()
+        responses[i], blobs_out[i] = engine.execute("echo2x", "", req, raw)
+
+    threads = [
+        threading.Thread(target=run, args=(i,)) for i in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for i in range(n_threads):
+        got = np.frombuffer(blobs_out[i][0], dtype=np.float32).reshape(1, 4)
+        np.testing.assert_array_equal(got, arrays[i] * 2.0)
+        assert responses[i]["parameters"] == {
+            "engine_pass": 1, "batched": True,
+        }
+        # the reserved key never leaks as an output tensor
+        assert [o["name"] for o in responses[i]["outputs"]] == ["OUT"]
+    engine.close()
+
+
+def test_fused_group_fn_drops_response_parameters():
+    """fused_batching traces the model fn, so a "__parameters__" dict
+    would be a trace-time constant; the fused splitter drops it instead
+    of crashing the whole group in jnp.split."""
+    import jax.numpy as jnp
+
+    from client_tpu.serve.dynamic_batcher import _fused_group_fn
+
+    def fn(inputs, params, ctx):
+        return {"OUT": inputs["IN"] * 2.0, "__parameters__": {"n": 1}}
+
+    fused = _fused_group_fn(fn)
+    parts = {"IN": (jnp.ones((1, 4)), jnp.full((1, 4), 2.0))}
+    out = fused(parts)
+    assert set(out) == {"OUT"}
+    np.testing.assert_array_equal(np.asarray(out["OUT"][0]), np.full((1, 4), 2.0))
+    np.testing.assert_array_equal(np.asarray(out["OUT"][1]), np.full((1, 4), 4.0))
+
+
 def test_multi_row_requests_batch():
     record = []
     engine = InferenceEngine(models=[_echo_model(record)])

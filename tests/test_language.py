@@ -267,3 +267,58 @@ def test_decoupled_responses_stream_lazily():
         assert stats["success"]["count"] == 1
     finally:
         engine.close()
+
+
+def test_decoupled_model_response_parameters_survive():
+    """A model-set response-level parameter (reserved "__parameters__"
+    result key) must survive the decoupled stream: the engine merges its
+    triton_final_response marker into the model's parameters instead of
+    replacing them (regression: the pre-fix code overwrote the dict)."""
+    from client_tpu.serve.model_runtime import (
+        InferenceEngine,
+        Model,
+        TensorSpec,
+    )
+
+    def fn(inputs, params, ctx):
+        for i in range(3):
+            yield {
+                "OUT": np.array([i], dtype=np.int32),
+                "__parameters__": {"sequence_index": i, "my_flag": True},
+            }
+
+    model = Model(
+        "param_stream",
+        inputs=[TensorSpec("IN", "INT32", [1])],
+        outputs=[TensorSpec("OUT", "INT32", [1])],
+        fn=fn,
+        decoupled=True,
+    )
+    engine = InferenceEngine(models=[model])
+    try:
+        request = {
+            "id": "",
+            "parameters": {},
+            "inputs": [
+                {"name": "IN", "datatype": "INT32", "shape": [1],
+                 "data": [4]}
+            ],
+        }
+        seen = []
+        for response_json, _ in engine.execute("param_stream", "", request,
+                                               b""):
+            seen.append(response_json["parameters"])
+        assert [p["sequence_index"] for p in seen] == [0, 1, 2]
+        assert all(p["my_flag"] is True for p in seen)
+        # the completion-protocol marker is merged in beside them
+        assert all(p["triton_final_response"] is False for p in seen)
+        # the reserved key is not a requestable output tensor
+        from client_tpu.utils import InferenceServerException
+
+        bad = dict(request)
+        bad["outputs"] = [{"name": "__parameters__"}]
+        with pytest.raises(InferenceServerException):
+            for _ in engine.execute("param_stream", "", bad, b""):
+                pass
+    finally:
+        engine.close()
