@@ -40,6 +40,8 @@ _QUEUE_CTORS = {
     "queue.Queue", "queue.SimpleQueue", "queue.LifoQueue",
     "queue.PriorityQueue", "Queue", "SimpleQueue",
 }
+# names that hold a point-in-time budget (deadline semantics)
+_DEADLINE_NAME_RE = re.compile(r"(?i)(deadline|expires?|expiry|_until$|^until$)")
 
 
 def _expr_text(node):
@@ -629,6 +631,76 @@ class CvWaitLoopRule(Rule):
                         f"{recv}.wait() outside a predicate re-check "
                         "loop: wrap in `while <predicate>:` or use "
                         "wait_for()",
+                    ))
+        return findings
+
+
+@register
+class TimeWallRule(Rule):
+    """TIME-WALL — deadlines computed from the wall clock.
+
+    ``time.time()`` jumps under NTP slew/step and DST-adjacent clock
+    management; a deadline derived from it can expire instantly (every
+    in-flight wait aborts) or never (a drain that hangs).  Every
+    point-in-time budget must come from ``time.monotonic()`` — the
+    invariant the resilience layer's Deadline/backoff code is built on.
+    Flags (a) assignments of ``time.time()``-derived values to
+    deadline-named targets and (b) comparisons between ``time.time()``
+    and a deadline-named value.  Wall-clock *timestamps* (metrics, log
+    fields) are untouched: the rule keys on deadline naming.
+    """
+
+    id = "TIME-WALL"
+    rationale = (
+        "a wall-clock deadline jumps with NTP adjustment: expires "
+        "instantly or never (use time.monotonic())"
+    )
+
+    @staticmethod
+    def _has_wall_clock_call(node):
+        for sub in ast.walk(node):
+            if (
+                isinstance(sub, ast.Call)
+                and _expr_text(sub.func) == "time.time"
+            ):
+                return True
+        return False
+
+    @staticmethod
+    def _is_deadline_name(node):
+        text = _expr_text(node)
+        return bool(text and _DEADLINE_NAME_RE.search(_last_segment(text)))
+
+    def check(self, tree, lines, path):
+        findings = []
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                if node.value is None:  # bare annotation: no computation
+                    continue
+                targets = (
+                    node.targets
+                    if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                if any(self._is_deadline_name(t) for t in targets) and (
+                    self._has_wall_clock_call(node.value)
+                ):
+                    findings.append(self.finding(
+                        path, lines, node,
+                        "deadline computed from time.time(): wall-clock "
+                        "jumps (NTP) break the budget — use "
+                        "time.monotonic()",
+                    ))
+            elif isinstance(node, ast.Compare):
+                sides = [node.left] + list(node.comparators)
+                has_wall = any(self._has_wall_clock_call(s) for s in sides)
+                has_deadline = any(self._is_deadline_name(s) for s in sides)
+                if has_wall and has_deadline:
+                    findings.append(self.finding(
+                        path, lines, node,
+                        "deadline compared against time.time(): wall-clock "
+                        "jumps (NTP) break the budget — use "
+                        "time.monotonic()",
                     ))
         return findings
 

@@ -15,6 +15,7 @@ import threading
 
 import grpc
 
+from client_tpu import resilience as _resilience
 from client_tpu._grpc_infer import (  # noqa: F401  (re-exported API surface)
     InferResult,
     build_infer_request,
@@ -130,6 +131,16 @@ def _metadata(headers):
     return tuple((k.lower(), str(v)) for k, v in (headers or {}).items())
 
 
+def _attempt_timeout(client_timeout, deadline_remaining_s):
+    """Per-attempt RPC timeout: the caller's client_timeout capped by the
+    retry deadline's remaining budget (shared by the sync and aio clients)."""
+    if deadline_remaining_s is None:
+        return client_timeout
+    if client_timeout is None:
+        return max(deadline_remaining_s, 1e-3)
+    return max(min(client_timeout, deadline_remaining_s), 1e-3)
+
+
 class _InferStream:
     """One bidirectional ModelStreamInfer stream.
 
@@ -211,6 +222,7 @@ class InferenceServerClient:
         creds=None,
         keepalive_options=None,
         channel_args=None,
+        retry_policy=None,
     ):
         options = _channel_options(keepalive_options, channel_args)
         if creds is not None:
@@ -233,6 +245,10 @@ class InferenceServerClient:
         self._stubs = build_stubs(self._channel)
         self._verbose = verbose
         self._stream = None
+        # Opt-in resilience for unary RPCs (client_tpu.resilience.RetryPolicy);
+        # None keeps the original single-attempt behavior.  Streaming RPCs
+        # are never retried (replay would re-send every queued request).
+        self._retry_policy = retry_policy
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -247,6 +263,16 @@ class InferenceServerClient:
         self.close()
 
     def _call(self, name, request, headers=None, client_timeout=None, **kwargs):
+        if self._retry_policy is None:
+            return self._call_once(name, request, headers, client_timeout, **kwargs)
+
+        def attempt(timeout_s):
+            timeout = _attempt_timeout(client_timeout, timeout_s)
+            return self._call_once(name, request, headers, timeout, **kwargs)
+
+        return _resilience.call_with_retry(attempt, self._retry_policy)
+
+    def _call_once(self, name, request, headers=None, client_timeout=None, **kwargs):
         if self._verbose:
             print(f"{name}, metadata {headers}\n{request}")
         try:
@@ -271,22 +297,37 @@ class InferenceServerClient:
         return json_format.MessageToDict(response, preserving_proto_field_name=True)
 
     # -- health --------------------------------------------------------------
+    # Health verbs answer False on transport errors instead of raising
+    # (tritonclient reference semantics): probes must be safe to poll
+    # against a down server.  They bypass the retry policy (_call_once) —
+    # an unavailable answer IS the probe result, not a failure to retry.
 
     def is_server_live(self, headers=None, client_timeout=None):
-        return self._call(
-            "ServerLive", pb.ServerLiveRequest(), headers, client_timeout
-        ).live
+        try:
+            return self._call_once(
+                "ServerLive", pb.ServerLiveRequest(), headers, client_timeout
+            ).live
+        except InferenceServerException:
+            return False
 
     def is_server_ready(self, headers=None, client_timeout=None):
-        return self._call(
-            "ServerReady", pb.ServerReadyRequest(), headers, client_timeout
-        ).ready
+        try:
+            return self._call_once(
+                "ServerReady", pb.ServerReadyRequest(), headers, client_timeout
+            ).ready
+        except InferenceServerException:
+            return False
 
     def is_model_ready(
         self, model_name, model_version="", headers=None, client_timeout=None
     ):
         request = pb.ModelReadyRequest(name=model_name, version=model_version)
-        return self._call("ModelReady", request, headers, client_timeout).ready
+        try:
+            return self._call_once(
+                "ModelReady", request, headers, client_timeout
+            ).ready
+        except InferenceServerException:
+            return False
 
     # -- metadata / config ---------------------------------------------------
 

@@ -8,6 +8,7 @@ the bidirectional ModelStreamInfer stream and yields (result, error) tuples.
 
 import grpc
 
+from client_tpu import resilience as _resilience
 from client_tpu._grpc_infer import (  # noqa: F401
     InferResult,
     build_infer_request,
@@ -17,6 +18,7 @@ from client_tpu._infer_types import InferInput, InferRequestedOutput  # noqa: F4
 from client_tpu._proto import inference_pb2 as pb
 from client_tpu.grpc import (
     KeepAliveOptions,  # noqa: F401
+    _attempt_timeout,
     _channel_options,
     _grpc_compression,
     _metadata,
@@ -47,6 +49,7 @@ class InferenceServerClient:
         creds=None,
         keepalive_options=None,
         channel_args=None,
+        retry_policy=None,
     ):
         options = _channel_options(keepalive_options, channel_args)
         if creds is not None:
@@ -68,6 +71,10 @@ class InferenceServerClient:
             self._channel = grpc.aio.insecure_channel(url, options=options)
         self._stubs = build_stubs(self._channel)
         self._verbose = verbose
+        # Opt-in resilience for unary RPCs; None keeps single-attempt
+        # behavior.  stream_infer is never retried (replay would re-send
+        # every request the iterator already produced).
+        self._retry_policy = retry_policy
 
     async def close(self):
         await self._channel.close()
@@ -79,6 +86,16 @@ class InferenceServerClient:
         await self.close()
 
     async def _call(self, name, request, headers=None, client_timeout=None, **kw):
+        if self._retry_policy is None:
+            return await self._call_once(name, request, headers, client_timeout, **kw)
+
+        async def attempt(timeout_s):
+            timeout = _attempt_timeout(client_timeout, timeout_s)
+            return await self._call_once(name, request, headers, timeout, **kw)
+
+        return await _resilience.acall_with_retry(attempt, self._retry_policy)
+
+    async def _call_once(self, name, request, headers=None, client_timeout=None, **kw):
         if self._verbose:
             print(f"{name}, metadata {headers}\n{request}")
         try:
@@ -100,26 +117,41 @@ class InferenceServerClient:
         return json_format.MessageToDict(response, preserving_proto_field_name=True)
 
     # -- health --------------------------------------------------------------
+    # Health verbs answer False on transport errors instead of raising
+    # (tritonclient reference semantics): probes must be safe to poll
+    # against a down server.  They bypass the retry policy (_call_once) —
+    # an unavailable answer IS the probe result, not a failure to retry.
 
     async def is_server_live(self, headers=None, client_timeout=None):
-        r = await self._call("ServerLive", pb.ServerLiveRequest(), headers, client_timeout)
+        try:
+            r = await self._call_once(
+                "ServerLive", pb.ServerLiveRequest(), headers, client_timeout
+            )
+        except InferenceServerException:
+            return False
         return r.live
 
     async def is_server_ready(self, headers=None, client_timeout=None):
-        r = await self._call(
-            "ServerReady", pb.ServerReadyRequest(), headers, client_timeout
-        )
+        try:
+            r = await self._call_once(
+                "ServerReady", pb.ServerReadyRequest(), headers, client_timeout
+            )
+        except InferenceServerException:
+            return False
         return r.ready
 
     async def is_model_ready(
         self, model_name, model_version="", headers=None, client_timeout=None
     ):
-        r = await self._call(
-            "ModelReady",
-            pb.ModelReadyRequest(name=model_name, version=model_version),
-            headers,
-            client_timeout,
-        )
+        try:
+            r = await self._call_once(
+                "ModelReady",
+                pb.ModelReadyRequest(name=model_name, version=model_version),
+                headers,
+                client_timeout,
+            )
+        except InferenceServerException:
+            return False
         return r.ready
 
     # -- metadata / config / repository --------------------------------------

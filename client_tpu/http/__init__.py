@@ -18,6 +18,7 @@ from urllib.parse import quote, urlencode
 import urllib3
 
 from client_tpu import _codec
+from client_tpu import resilience as _resilience
 from client_tpu._infer_types import (  # noqa: F401  (re-exported API surface)
     InferInput,
     InferRequestedOutput,
@@ -38,14 +39,21 @@ __all__ = [
 ]
 
 
-def _get_error_from_response(response_body, status):
+def _get_error_from_response(response_body, status, headers=None):
     try:
         msg = json.loads(response_body.decode("utf-8", errors="replace")).get(
             "error", response_body.decode("utf-8", errors="replace")
         )
     except Exception:
         msg = response_body.decode("utf-8", errors="replace")
-    return InferenceServerException(msg=msg, status=str(status))
+    exc = InferenceServerException(msg=msg, status=str(status))
+    retry_after = (headers or {}).get("Retry-After")
+    if retry_after is not None:
+        try:
+            exc.retry_after_s = float(retry_after)
+        except ValueError:
+            pass  # HTTP-date form: ignore, the backoff schedule applies
+    return exc
 
 
 class InferAsyncRequest:
@@ -145,6 +153,7 @@ class InferenceServerClient:
         ssl=False,
         ssl_context=None,
         insecure=False,
+        retry_policy=None,
     ):
         if "://" in url:
             scheme, _, rest = url.partition("://")
@@ -168,6 +177,10 @@ class InferenceServerClient:
             retries=False,
             **pool_kwargs,
         )
+        # Opt-in resilience: a client_tpu.resilience.RetryPolicy routes
+        # every request through retry/backoff/deadline/circuit-breaker.
+        # None (the default) keeps the original single-attempt behavior.
+        self._retry_policy = retry_policy
         self._executor = None  # lazily created for async_infer
 
     # -- lifecycle ----------------------------------------------------------
@@ -193,11 +206,35 @@ class InferenceServerClient:
     # -- low-level request helpers -----------------------------------------
 
     def _request(self, method, uri, headers=None, query_params=None, body=None):
+        if self._retry_policy is None:
+            return self._request_once(method, uri, headers, query_params, body)
+
+        def attempt(timeout_s):
+            response = self._request_once(
+                method, uri, headers, query_params, body, timeout_s
+            )
+            # Overload statuses become exceptions so the retry loop sees
+            # them (with the server's Retry-After hint attached); retries
+            # exhausted -> the same exception _raise_if_error would build.
+            if str(response.status) in self._retry_policy.retryable_statuses:
+                raise _get_error_from_response(
+                    response.data, response.status, response.headers
+                )
+            return response
+
+        return _resilience.call_with_retry(attempt, self._retry_policy)
+
+    def _request_once(
+        self, method, uri, headers=None, query_params=None, body=None, timeout_s=None
+    ):
         url = f"{self._base_url}/{uri}"
         if query_params:
             url += "?" + urlencode(query_params, doseq=True)
         if self._verbose:
             print(f"{method} {url}, headers {headers}")
+        kwargs = {}
+        if timeout_s is not None:  # deadline-derived per-attempt timeout
+            kwargs["timeout"] = urllib3.Timeout(total=max(timeout_s, 1e-3))
         try:
             response = self._pool.request(
                 method,
@@ -206,6 +243,7 @@ class InferenceServerClient:
                 headers=headers,
                 preload_content=True,
                 decode_content=False,
+                **kwargs,
             )
         except InferenceServerException:
             raise
@@ -235,21 +273,30 @@ class InferenceServerClient:
         return json.loads(content.decode("utf-8")) if content else {}
 
     # -- health -------------------------------------------------------------
+    # Health verbs answer False on transport/connection errors instead of
+    # raising (tritonclient reference semantics): an unreachable server IS
+    # not-live/not-ready, and health probes must be safe to poll.  They
+    # bypass the retry policy — a draining server's 503 readiness answer
+    # is the answer, not a failure to retry through.
+
+    def _probe(self, uri, headers, query_params):
+        try:
+            r = self._request_once("GET", uri, headers, query_params)
+        except InferenceServerException:
+            return False
+        return r.status == 200
 
     def is_server_live(self, headers=None, query_params=None):
-        r = self._get("v2/health/live", headers, query_params)
-        return r.status == 200
+        return self._probe("v2/health/live", headers, query_params)
 
     def is_server_ready(self, headers=None, query_params=None):
-        r = self._get("v2/health/ready", headers, query_params)
-        return r.status == 200
+        return self._probe("v2/health/ready", headers, query_params)
 
     def is_model_ready(self, model_name, model_version="", headers=None, query_params=None):
         uri = f"v2/models/{quote(model_name, safe='')}"
         if model_version:
             uri += f"/versions/{model_version}"
-        r = self._get(uri + "/ready", headers, query_params)
-        return r.status == 200
+        return self._probe(uri + "/ready", headers, query_params)
 
     # -- metadata / config ---------------------------------------------------
 

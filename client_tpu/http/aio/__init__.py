@@ -4,6 +4,7 @@ Capability parity with ``tritonclient.http.aio`` (reference
 src/python/library/tritonclient/http/aio/__init__.py:64-786).
 """
 
+import asyncio
 import base64
 import json
 from urllib.parse import quote
@@ -11,8 +12,12 @@ from urllib.parse import quote
 import aiohttp
 
 from client_tpu import _codec
+from client_tpu import resilience as _resilience
 from client_tpu._infer_types import InferInput, InferRequestedOutput  # noqa: F401
-from client_tpu.http import InferResult  # same response parsing as sync
+from client_tpu.http import (  # same response/error parsing as sync
+    InferResult,
+    _get_error_from_response,
+)
 from client_tpu.utils import InferenceServerException, raise_error
 
 __all__ = [
@@ -34,6 +39,7 @@ class InferenceServerClient:
         conn_timeout=60.0,
         ssl=False,
         ssl_context=None,
+        retry_policy=None,
     ):
         if "://" in url:
             scheme, _, rest = url.partition("://")
@@ -49,6 +55,9 @@ class InferenceServerClient:
             timeout=aiohttp.ClientTimeout(total=conn_timeout),
             auto_decompress=False,
         )
+        # Opt-in resilience (client_tpu.resilience.RetryPolicy); None keeps
+        # the original single-attempt behavior.
+        self._retry_policy = retry_policy
 
     async def close(self):
         await self._session.close()
@@ -60,30 +69,58 @@ class InferenceServerClient:
         await self.close()
 
     async def _get(self, uri, headers=None, query_params=None):
-        if self._verbose:
-            print(f"GET {self._base_url}/{uri}")
-        return await self._session.get(
-            f"{self._base_url}/{uri}", headers=headers, params=query_params
-        )
+        return await self._request("GET", uri, headers, query_params)
 
     async def _post(self, uri, body=b"", headers=None, query_params=None):
+        return await self._request("POST", uri, headers, query_params, body)
+
+    async def _request(self, method, uri, headers=None, query_params=None, body=b""):
+        if self._retry_policy is None:
+            return await self._request_once(method, uri, headers, query_params, body)
+
+        async def attempt(timeout_s):
+            response = await self._request_once(
+                method, uri, headers, query_params, body, timeout_s
+            )
+            # Overload statuses become exceptions for the retry loop (with
+            # the Retry-After hint); the body read happens inside the
+            # attempt so a mid-body truncation is retried too (aiohttp
+            # caches it, later read() calls return the same bytes).
+            if str(response.status) in self._retry_policy.retryable_statuses:
+                raise await self._error_from_response(response)
+            await response.read()
+            return response
+
+        return await _resilience.acall_with_retry(attempt, self._retry_policy)
+
+    async def _request_once(
+        self, method, uri, headers=None, query_params=None, body=b"", timeout_s=None
+    ):
         if self._verbose:
-            print(f"POST {self._base_url}/{uri}")
+            print(f"{method} {self._base_url}/{uri}")
+        kwargs = {}
+        if timeout_s is not None:  # deadline-derived per-attempt timeout
+            kwargs["timeout"] = aiohttp.ClientTimeout(total=max(timeout_s, 1e-3))
+        if method == "GET":
+            return await self._session.get(
+                f"{self._base_url}/{uri}", headers=headers, params=query_params,
+                **kwargs,
+            )
         return await self._session.post(
-            f"{self._base_url}/{uri}", data=body, headers=headers, params=query_params
+            f"{self._base_url}/{uri}", data=body, headers=headers,
+            params=query_params, **kwargs,
         )
 
     @staticmethod
-    async def _raise_if_error(response):
+    async def _error_from_response(response):
+        body = await response.read()
+        # same error extraction + Retry-After parsing as the sync client
+        return _get_error_from_response(body, response.status, response.headers)
+
+    @classmethod
+    async def _raise_if_error(cls, response):
         if response.status != 200:
-            body = await response.read()
-            try:
-                msg = json.loads(body.decode("utf-8", errors="replace")).get(
-                    "error", body.decode("utf-8", errors="replace")
-                )
-            except Exception:
-                msg = body.decode("utf-8", errors="replace")
-            raise InferenceServerException(msg=msg, status=str(response.status))
+            raise await cls._error_from_response(response)
 
     @staticmethod
     async def _json_or_raise(response):
@@ -94,14 +131,30 @@ class InferenceServerClient:
         return json.loads(body.decode("utf-8")) if body else {}
 
     # -- health --------------------------------------------------------------
+    # Health verbs answer False on transport/connection errors instead of
+    # raising (tritonclient reference semantics): health probes must be
+    # safe to poll against a down server.  They bypass the retry policy —
+    # a draining server's 503 readiness answer is the answer.
+
+    _HEALTH_ERRORS = (
+        InferenceServerException,
+        aiohttp.ClientError,
+        asyncio.TimeoutError,
+        OSError,
+    )
+
+    async def _probe(self, uri, headers, query_params):
+        try:
+            r = await self._request_once("GET", uri, headers, query_params)
+        except self._HEALTH_ERRORS:
+            return False
+        return r.status == 200
 
     async def is_server_live(self, headers=None, query_params=None):
-        r = await self._get("v2/health/live", headers, query_params)
-        return r.status == 200
+        return await self._probe("v2/health/live", headers, query_params)
 
     async def is_server_ready(self, headers=None, query_params=None):
-        r = await self._get("v2/health/ready", headers, query_params)
-        return r.status == 200
+        return await self._probe("v2/health/ready", headers, query_params)
 
     async def is_model_ready(
         self, model_name, model_version="", headers=None, query_params=None
@@ -109,8 +162,7 @@ class InferenceServerClient:
         uri = f"v2/models/{quote(model_name, safe='')}"
         if model_version:
             uri += f"/versions/{model_version}"
-        r = await self._get(uri + "/ready", headers, query_params)
-        return r.status == 200
+        return await self._probe(uri + "/ready", headers, query_params)
 
     # -- metadata / config / repository --------------------------------------
 

@@ -21,6 +21,7 @@ import socket
 import struct
 import time
 
+from client_tpu.resilience import backoff_delays
 from client_tpu.utils import InferenceServerException
 
 
@@ -122,6 +123,11 @@ class Rendezvous:
     def _connect(self, timeout_s):
         deadline = time.monotonic() + timeout_s
         last_err = None
+        # Jittered exponential backoff between attempts: rank 0 binding
+        # late is normal, but hammering ECONNREFUSED in a tight loop burns
+        # a core per waiting rank, and N ranks retrying in lockstep arrive
+        # as a thundering herd the moment the port opens.
+        delays = backoff_delays(initial_s=0.05, multiplier=2.0, max_s=1.0)
         while time.monotonic() < deadline:
             try:
                 sock = socket.create_connection(
@@ -139,7 +145,9 @@ class Rendezvous:
                 return
             except OSError as e:
                 last_err = e
-                time.sleep(0.25)
+                time.sleep(
+                    min(next(delays), max(deadline - time.monotonic(), 0.0))
+                )
         raise InferenceServerException(
             f"unable to reach rendezvous at {self._host}:{self._port}: "
             f"{last_err}"

@@ -1,0 +1,380 @@
+"""Transport-agnostic resilience policies: retry, deadline, circuit breaker.
+
+The four clients (``client_tpu.http``, ``client_tpu.http.aio``,
+``client_tpu.grpc``, ``client_tpu.grpc.aio``) accept an opt-in
+``retry_policy=RetryPolicy(...)`` constructor argument and route every
+unary call through :func:`call_with_retry` / :func:`acall_with_retry`.
+The server side (``client_tpu.serve``) sheds overload with *retryable*
+503/``UNAVAILABLE`` errors, so client retries and server shedding compose:
+a shed request backs off and lands once the queue drains.
+
+Design points (the battle-tested shape — AWS architecture blog "Exponential
+Backoff And Jitter", gRPC retry design):
+
+- **Exponential backoff with full jitter**: attempt ``k`` sleeps
+  ``uniform(0, min(max_backoff, initial * multiplier**k))``.  Full jitter
+  decorrelates client herds — a fleet of clients retrying a recovering
+  server must not arrive in lockstep waves.
+- **Retryable classification**: connection-level failures (refused, reset,
+  timed out, truncated) and explicit overload statuses (HTTP 429/503, gRPC
+  ``UNAVAILABLE``/``RESOURCE_EXHAUSTED``).  Application errors (bad input,
+  unknown model, INTERNAL) never retry — replaying them wastes the budget
+  and can double-apply side effects.
+- **Retry-After**: a server-provided hint (``exc.retry_after_s``, parsed
+  from the HTTP ``Retry-After`` header) overrides the computed backoff,
+  capped at ``max_backoff_s`` so a hostile/buggy hint cannot park the
+  client.
+- **Deadline budget**: one :class:`Deadline` caps the *total* wall time
+  across all attempts and derives each attempt's transport timeout from
+  what remains — N attempts never multiply the caller's patience by N,
+  and a backoff that would outlive the budget short-circuits to the final
+  error immediately (no retry storm, no useless terminal sleep).
+- **Circuit breaker**: per-endpoint closed → open → half-open.  After
+  ``failure_threshold`` consecutive failures the breaker opens and calls
+  fail fast (no socket work) for ``reset_timeout_s``; then exactly one
+  probe is allowed through (half-open) and its outcome closes or re-opens
+  the circuit.  Share one breaker across the clients that target one
+  endpoint; never share it across endpoints.
+
+All deadlines are computed from ``time.monotonic()`` — wall-clock
+(``time.time()``) deadlines jump under NTP adjustment (tpu-lint TIME-WALL).
+"""
+
+import asyncio
+import random
+import threading
+import time
+
+from client_tpu.utils import InferenceServerException
+
+__all__ = [
+    "RetryPolicy",
+    "Deadline",
+    "CircuitBreaker",
+    "CircuitOpenError",
+    "call_with_retry",
+    "acall_with_retry",
+    "is_connection_error",
+    "backoff_delays",
+]
+
+# Overload / transient statuses worth retrying.  HTTP codes arrive as
+# decimal strings (the HTTP clients stringify response.status); gRPC codes
+# as StatusCode names.  DEADLINE_EXCEEDED is the gRPC spelling of a
+# per-attempt timeout (the HTTP clients surface the same event as a
+# wrapped transport timeout): retryable, with the attempt budget and the
+# policy Deadline bounding the total spend.
+RETRYABLE_STATUSES = frozenset(
+    {"429", "503", "UNAVAILABLE", "RESOURCE_EXHAUSTED", "DEADLINE_EXCEEDED"}
+)
+
+# Transport exception types whose module marks them as connection-level.
+# Checked by module prefix so this module imports neither urllib3, aiohttp,
+# nor grpc (transport-agnostic; any subset may be absent at runtime).
+_CONN_MODULE_PREFIXES = ("urllib3", "aiohttp", "http.client", "grpc")
+
+
+def is_connection_error(exc):
+    """Whether *exc* is a connection-level transport failure.
+
+    Covers OSError (refused/reset/unreachable), timeouts, and the
+    transport libraries' wrapper hierarchies (urllib3 ProtocolError et al.
+    do not derive from OSError).
+    """
+    if isinstance(exc, (ConnectionError, TimeoutError, OSError)):
+        return True
+    if isinstance(exc, (asyncio.TimeoutError, EOFError)):
+        return True
+    mod = type(exc).__module__ or ""
+    return mod.startswith(_CONN_MODULE_PREFIXES)
+
+
+class CircuitOpenError(InferenceServerException):
+    """Fast-fail raised while a circuit breaker is open.
+
+    Subclasses InferenceServerException so callers' existing error handling
+    sees the familiar type; ``status`` is the retryable 503 so a *different*
+    endpoint's policy layered above may still route around it.
+    """
+
+    def __init__(self, msg):
+        super().__init__(msg=msg, status="503")
+
+
+class Deadline:
+    """A monotonic wall-time budget shared across retry attempts.
+
+    ``remaining()`` is what is left; ``attempt_timeout(cap)`` derives one
+    attempt's transport timeout (never exceeding the budget, optionally
+    capped by the caller's own per-try timeout).
+    """
+
+    def __init__(self, budget_s):
+        if budget_s is None or budget_s <= 0:
+            raise ValueError(f"deadline budget must be positive, got {budget_s!r}")
+        self.budget_s = float(budget_s)
+        self._expires = time.monotonic() + self.budget_s
+
+    def remaining(self):
+        return self._expires - time.monotonic()
+
+    def expired(self):
+        return self.remaining() <= 0
+
+    def attempt_timeout(self, cap=None):
+        """Per-attempt transport timeout from the remaining budget."""
+        remaining = max(self.remaining(), 0.0)
+        if cap is not None:
+            return min(remaining, cap)
+        return remaining
+
+
+class CircuitBreaker:
+    """Per-endpoint circuit breaker: closed → open → half-open.
+
+    Thread-safe (one lock, no blocking inside it), usable from both the
+    sync clients and coroutine code.  ``before_attempt()`` raises
+    :class:`CircuitOpenError` while open; after ``reset_timeout_s`` one
+    probe passes (half-open) and its outcome decides the next state.
+    """
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half-open"
+
+    def __init__(self, failure_threshold=5, reset_timeout_s=30.0, name=""):
+        self.failure_threshold = int(failure_threshold)
+        self.reset_timeout_s = float(reset_timeout_s)
+        self.name = name
+        self._lock = threading.Lock()
+        self._state = self.CLOSED
+        self._failures = 0
+        self._opened_at = 0.0
+        self._probing = False  # a half-open probe is in flight
+
+    @property
+    def state(self):
+        with self._lock:
+            return self._state
+
+    def _fast_fail(self):
+        raise CircuitOpenError(
+            f"circuit breaker{f' {self.name!r}' if self.name else ''} "
+            f"is open ({self._failures} consecutive failures); "
+            f"fast-failing for {self.reset_timeout_s:g}s"
+        )
+
+    def before_attempt(self):
+        """Gate one attempt; raises CircuitOpenError without touching the
+        network while the circuit is open and the cooldown has not passed.
+        After the cooldown exactly ONE probe passes — concurrent callers
+        keep fast-failing until that probe's outcome is recorded (no
+        thundering herd onto a recovering endpoint)."""
+        with self._lock:
+            if self._state == self.OPEN:
+                if time.monotonic() - self._opened_at < self.reset_timeout_s:
+                    self._fast_fail()
+                self._state = self.HALF_OPEN
+                self._probing = True
+            elif self._state == self.HALF_OPEN and self._probing:
+                self._fast_fail()
+
+    def record_success(self):
+        with self._lock:
+            self._failures = 0
+            self._state = self.CLOSED
+            self._probing = False
+
+    def record_failure(self):
+        with self._lock:
+            self._failures += 1
+            self._probing = False
+            if self._state == self.HALF_OPEN or self._failures >= self.failure_threshold:
+                self._state = self.OPEN
+                self._opened_at = time.monotonic()
+
+
+class RetryPolicy:
+    """Retry/backoff/deadline policy for one client's unary calls.
+
+    Parameters
+    ----------
+    max_attempts : total tries including the first (1 = no retry).
+    initial_backoff_s, backoff_multiplier, max_backoff_s : the exponential
+        schedule jittered by ``jitter``.
+    jitter : True for full jitter (uniform(0, delay)); False for the bare
+        exponential (deterministic — useful in tests).
+    retryable_statuses : status strings (HTTP codes / gRPC code names)
+        classified retryable in addition to connection errors.
+    deadline_s : total wall-time budget across attempts (None = unbounded).
+    circuit_breaker : optional CircuitBreaker shared by calls through this
+        policy.
+    """
+
+    def __init__(
+        self,
+        max_attempts=4,
+        initial_backoff_s=0.05,
+        backoff_multiplier=2.0,
+        max_backoff_s=2.0,
+        jitter=True,
+        retryable_statuses=RETRYABLE_STATUSES,
+        deadline_s=None,
+        circuit_breaker=None,
+        rng=None,
+    ):
+        if max_attempts < 1:
+            raise ValueError(f"max_attempts must be >= 1, got {max_attempts}")
+        self.max_attempts = int(max_attempts)
+        self.initial_backoff_s = float(initial_backoff_s)
+        self.backoff_multiplier = float(backoff_multiplier)
+        self.max_backoff_s = float(max_backoff_s)
+        self.jitter = bool(jitter)
+        self.retryable_statuses = frozenset(retryable_statuses)
+        self.deadline_s = deadline_s
+        self.circuit_breaker = circuit_breaker
+        self._rng = rng or random.Random()
+
+    # -- classification ----------------------------------------------------
+
+    def retryable(self, exc):
+        """Whether one failed attempt is worth retrying."""
+        if isinstance(exc, CircuitOpenError):
+            return False  # fast-fail is the point; do not spin on the breaker
+        if isinstance(exc, InferenceServerException):
+            status = exc.status()
+            if status is not None:
+                return str(status) in self.retryable_statuses
+            details = exc.debug_details()
+            return details is not None and is_connection_error(details)
+        return is_connection_error(exc)
+
+    # -- schedule ----------------------------------------------------------
+
+    def backoff_s(self, attempt):
+        """Sleep before retry number *attempt* (0-based)."""
+        delay = min(
+            self.max_backoff_s,
+            self.initial_backoff_s * (self.backoff_multiplier ** attempt),
+        )
+        if self.jitter:
+            delay = self._rng.uniform(0.0, delay)
+        return delay
+
+    def delay_for(self, exc, attempt):
+        """Backoff for this retry, honoring the server's Retry-After hint
+        (capped at max_backoff_s — a bad hint must not park the client)."""
+        hint = getattr(exc, "retry_after_s", None)
+        if hint is not None:
+            try:
+                return min(float(hint), self.max_backoff_s)
+            except (TypeError, ValueError):
+                pass
+        return self.backoff_s(attempt)
+
+    def new_deadline(self):
+        return Deadline(self.deadline_s) if self.deadline_s else None
+
+
+def backoff_delays(initial_s=0.05, multiplier=2.0, max_s=2.0, rng=None):
+    """Infinite generator of full-jitter exponential delays.
+
+    The reusable loop shape for ad-hoc retry sites (e.g. the perf
+    rendezvous connect loop) that don't need the full policy object.
+    """
+    rng = rng or random.Random()
+    delay = initial_s
+    while True:
+        yield rng.uniform(0.0, delay)
+        delay = min(delay * multiplier, max_s)
+
+
+def _record_outcome(breaker, retryable):
+    """Breaker accounting for one failed attempt: only transport/overload
+    failures count against the circuit.  A non-retryable application error
+    (bad input, unknown model) means the endpoint answered — that is
+    evidence of health, and must not open the circuit against a healthy
+    server (or strand a half-open probe)."""
+    if breaker is None:
+        return
+    if retryable:
+        breaker.record_failure()
+    else:
+        breaker.record_success()
+
+
+def _next_step(policy, deadline, exc, attempt, retryable):
+    """Shared retry decision: returns the backoff sleep, or raises *exc*
+    when the classification, attempt budget, or deadline budget says stop."""
+    if not retryable or attempt + 1 >= policy.max_attempts:
+        raise exc
+    delay = policy.delay_for(exc, attempt)
+    if deadline is not None:
+        remaining = deadline.remaining()
+        # a backoff that would outlive the budget is a guaranteed-dead
+        # retry: surface the real error now instead of sleeping into it
+        if remaining <= 0 or delay >= remaining:
+            raise exc
+    return delay
+
+
+def call_with_retry(fn, policy):
+    """Run ``fn(attempt_timeout_s_or_None)`` under *policy* (sync).
+
+    *fn* receives the per-attempt transport timeout derived from the
+    policy's deadline (None when the policy has no deadline) and must raise
+    on failure — including application-level retryable statuses the caller
+    wants retried (e.g. an HTTP 503 response mapped to an exception).
+    """
+    deadline = policy.new_deadline()
+    breaker = policy.circuit_breaker
+    attempt = 0
+    while True:
+        if breaker is not None:
+            breaker.before_attempt()
+        try:
+            result = fn(deadline.attempt_timeout() if deadline else None)
+        except CircuitOpenError:
+            raise
+        except Exception as exc:
+            retryable = policy.retryable(exc)
+            _record_outcome(breaker, retryable)
+            if breaker is not None and breaker.state == CircuitBreaker.OPEN:
+                # this failure opened (or re-opened) the circuit: further
+                # retries would only fast-fail after a pointless backoff —
+                # surface the real error now
+                raise
+            delay = _next_step(policy, deadline, exc, attempt, retryable)
+            attempt += 1
+            time.sleep(delay)
+        else:
+            if breaker is not None:
+                breaker.record_success()
+            return result
+
+
+async def acall_with_retry(fn, policy):
+    """Async twin of :func:`call_with_retry`; ``fn`` is a coroutine
+    function taking the derived per-attempt timeout."""
+    deadline = policy.new_deadline()
+    breaker = policy.circuit_breaker
+    attempt = 0
+    while True:
+        if breaker is not None:
+            breaker.before_attempt()
+        try:
+            result = await fn(deadline.attempt_timeout() if deadline else None)
+        except CircuitOpenError:
+            raise
+        except Exception as exc:
+            retryable = policy.retryable(exc)
+            _record_outcome(breaker, retryable)
+            if breaker is not None and breaker.state == CircuitBreaker.OPEN:
+                # failure opened the circuit: surface the real error now
+                # instead of backing off into a guaranteed fast-fail
+                raise
+            delay = _next_step(policy, deadline, exc, attempt, retryable)
+            attempt += 1
+            await asyncio.sleep(delay)
+        else:
+            if breaker is not None:
+                breaker.record_success()
+            return result

@@ -30,11 +30,12 @@ class Server:
         host="127.0.0.1",
         verbose=False,
         with_default_models=True,
+        max_inflight=None,
     ):
         all_models = list(models or [])
         if with_default_models:
             all_models.extend(default_models())
-        self.engine = InferenceEngine(all_models)
+        self.engine = InferenceEngine(all_models, max_inflight=max_inflight)
         self._http = None
         self._grpc = None
         self._http_port = http_port
@@ -70,6 +71,16 @@ class Server:
         if self._grpc:
             self._grpc.stop()
         self.engine.close()
+
+    def drain(self, timeout_s=None):
+        """Graceful shutdown: flip ``/v2/health/ready`` (and gRPC
+        ServerReady) to not-ready, reject new inference with retryable
+        503/UNAVAILABLE, finish in-flight work, then stop both frontends.
+        Returns True when every in-flight request finished within
+        *timeout_s*."""
+        drained = self.engine.drain(timeout_s)
+        self.stop()
+        return drained
 
     def __enter__(self):
         return self.start()

@@ -146,12 +146,16 @@ class ModelBatcher:
     single padded forward pass and splits the host-materialized outputs."""
 
     def __init__(self, model, stats, max_queue_delay_s=0.003, busy=None,
-                 pipeline_depth=4):
+                 pipeline_depth=4, max_queue_depth=None):
         self.model = model
         self.stats = stats
         self._busy = busy  # engine BusyTracker (duty-cycle metric), optional
         self.max_batch = max(int(model.max_batch_size), 1)
         self.max_queue_delay_s = max_queue_delay_s
+        # Admission control: requests beyond this queue depth are shed with
+        # a retryable 503 instead of growing the queue (and the tail
+        # latency) without bound.  None = unbounded.
+        self.max_queue_depth = max_queue_depth
         # Device groups with a jax-pure fn fuse concat+forward+split into ONE
         # jitted dispatch (see _fused_jit); arity is capped so the executable
         # set stays warmable.
@@ -317,6 +321,19 @@ class ModelBatcher:
                 raise InferenceServerException(
                     f"model '{self.model.name}' is shutting down", status="500"
                 )
+            if (
+                self.max_queue_depth is not None
+                and len(self._queue) >= self.max_queue_depth
+            ):
+                # Retryable overload: the client's retry policy backs off
+                # and re-submits once the queue drains (503 == UNAVAILABLE
+                # on the gRPC frontend).
+                raise InferenceServerException(
+                    f"model '{self.model.name}' queue is full "
+                    f"({len(self._queue)} >= {self.max_queue_depth} queued); "
+                    "retry after backoff",
+                    status="503",
+                )
             self._queue.append(pending)
             self._cond.notify()
         pending.event.wait()
@@ -324,17 +341,21 @@ class ModelBatcher:
             raise pending.error
         return pending.result
 
-    def close(self):
+    def close(self, shutdown_timeout_s=30.0):
+        # One deadline budget shared across every shutdown phase (batcher
+        # join, host-completion drain, observer close) — three independent
+        # 30s waits made worst-case close() take 90s; the caller's budget
+        # now bounds the whole shutdown.
+        deadline = time.monotonic() + shutdown_timeout_s
         with self._cond:
             self._closed = True
             self._cond.notify_all()
-        self._thread.join(timeout=30)
+        self._thread.join(timeout=max(deadline - time.monotonic(), 0.0))
         # Host completion tasks for batches already dispatched should finish
         # before leftovers are failed — their requests are _active, not
         # queued.  Bounded: a task wedged on a stalled device must not hang
         # close() (the workers are daemon threads; queued requests still get
         # their shutdown error below).
-        deadline = time.monotonic() + 30
         with self._host_cv:
             self._host_closed = True
             self._host_cv.notify_all()
@@ -343,7 +364,7 @@ class ModelBatcher:
                 if remaining <= 0:
                     break
                 self._host_cv.wait(timeout=remaining)
-        self._observer.close(timeout=30)
+        self._observer.close(timeout=max(deadline - time.monotonic(), 0.0))
         # Fail anything still queued.  Drained under the lock so a batcher
         # thread that outlived the join timeout (e.g. blocked in a cold
         # compile) cannot race the deque; items it already popped are its to

@@ -21,6 +21,10 @@ from client_tpu._infer_types import _np_from_json_data
 _STATUS_MAP = {
     "400": grpc.StatusCode.INVALID_ARGUMENT,
     "404": grpc.StatusCode.NOT_FOUND,
+    # retryable overload/drain shedding: UNAVAILABLE is the status gRPC
+    # clients (incl. client_tpu.resilience retry policies) retry on
+    "429": grpc.StatusCode.RESOURCE_EXHAUSTED,
+    "503": grpc.StatusCode.UNAVAILABLE,
     "500": grpc.StatusCode.INTERNAL,
     "501": grpc.StatusCode.UNIMPLEMENTED,
 }
@@ -169,7 +173,8 @@ class _Handlers:
         return pb.ServerLiveResponse(live=True)
 
     def ServerReady(self, request, context):
-        return pb.ServerReadyResponse(ready=True)
+        # drain() flips readiness false so load balancers stop routing here
+        return pb.ServerReadyResponse(ready=self.engine.ready())
 
     def ModelReady(self, request, context):
         return pb.ModelReadyResponse(
@@ -369,6 +374,8 @@ class _Handlers:
                 request.model_name, request.model_version, req, binary
             )
             if not isinstance(result, tuple):  # list/generator = decoupled
+                if hasattr(result, "close"):
+                    result.close()  # release its in-flight admission slot
                 raise InferenceServerException(
                     f"model '{request.model_name}' is decoupled; use "
                     "ModelStreamInfer",

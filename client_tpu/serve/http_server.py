@@ -76,7 +76,13 @@ class _Handler(BaseHTTPRequestHandler):
             if isinstance(exc, InferenceServerException)
             else str(exc)
         )
-        self._send(status, json.dumps({"error": msg}).encode("utf-8"))
+        headers = None
+        if status in (429, 503):
+            # overload/drain shedding is retryable: tell well-behaved
+            # clients when to come back (client retry policies cap this
+            # hint at their own max backoff)
+            headers = {"Retry-After": "1"}
+        self._send(status, json.dumps({"error": msg}).encode("utf-8"), headers)
 
     # -- request routing -----------------------------------------------------
 
@@ -110,7 +116,13 @@ class _Handler(BaseHTTPRequestHandler):
         if path == "/v2/health/live":
             return self._send(200)
         if path == "/v2/health/ready":
-            return self._send(200)
+            # drain() flips readiness false so load balancers stop routing
+            # here while in-flight work finishes
+            if eng.ready():
+                return self._send(200)
+            return self._send(
+                503, json.dumps({"error": "server is draining"}).encode("utf-8")
+            )
         if path == "/metrics":
             from client_tpu.serve.metrics import render_metrics
 
@@ -275,7 +287,7 @@ class _Handler(BaseHTTPRequestHandler):
         )
         result = self.engine.execute(model, version, request, binary)
         if not isinstance(result, tuple):  # decoupled stream (generator/list)
-            responses = list(result)
+            responses = list(result)  # consuming it releases its admission slot
             if len(responses) != 1:
                 raise InferenceServerException(
                     f"model '{model}' is decoupled; HTTP requires exactly one "

@@ -99,6 +99,7 @@ class Model:
         batch_device_inputs=False,
         fused_batching=False,
         max_fused_arity=8,
+        max_queue_depth=None,
         ensemble_steps=None,
         flops_per_item=None,
     ):
@@ -122,6 +123,9 @@ class Model:
         # split into one jitted dispatch (dynamic_batcher._fused_group_fn).
         self.fused_batching = fused_batching
         self.max_fused_arity = max_fused_arity  # cap on fused group parts
+        # Dynamic-batcher admission: queued requests beyond this depth are
+        # shed with a retryable 503 (None = unbounded queue).
+        self.max_queue_depth = max_queue_depth
         # Config-driven ensemble (reference ensemble_scheduling): ordered
         # steps [{"model_name", "input_map" {composing<-ensemble tensor},
         # "output_map" {composing->ensemble tensor}}].  fn is ignored; the
@@ -593,15 +597,74 @@ class BusyTracker:
             return busy
 
 
-class InferenceEngine:
-    """Model repository + request execution shared by the HTTP/gRPC frontends."""
+class _InflightStream:
+    """Iterator adapter releasing one in-flight slot exactly once, when
+    the wrapped decoupled-response generator is exhausted, fails, is
+    closed, or is garbage-collected.  A plain wrapper generator would leak
+    the slot when never started (its ``finally`` would not run) — e.g. a
+    frontend that rejects the request before iterating."""
 
-    def __init__(self, models=None, strict_model_config=True, max_sequence_idle_s=60.0):
+    def __init__(self, gen, release):
+        self._gen = gen
+        self._release = release
+        self._done = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            return next(self._gen)
+        except BaseException:  # StopIteration included: stream is over
+            self._finish()
+            raise
+
+    def close(self):
+        try:
+            self._gen.close()
+        finally:
+            self._finish()
+
+    def _finish(self):
+        if not self._done:
+            self._done = True
+            self._release()
+
+    def __del__(self):
+        try:
+            self._finish()
+        except Exception:  # pragma: no cover - interpreter teardown
+            pass
+
+
+class InferenceEngine:
+    """Model repository + request execution shared by the HTTP/gRPC frontends.
+
+    Overload admission control (``max_inflight``) and graceful drain
+    (:meth:`drain`) both shed work with a *retryable* 503/``UNAVAILABLE``
+    so client-side retry policies (client_tpu.resilience) and server-side
+    shedding compose: a shed request backs off and lands once capacity
+    returns or on another replica.
+    """
+
+    def __init__(
+        self,
+        models=None,
+        strict_model_config=True,
+        max_sequence_idle_s=60.0,
+        max_inflight=None,
+    ):
         self._lock = threading.Lock()
         self._models = {}
         self._ready = {}
         self._stats = {}
         self._batchers = {}
+        # Admission control: cap on concurrently executing requests (None =
+        # unbounded).  Work beyond the cap is rejected retryably (503).
+        self.max_inflight = max_inflight
+        self._inflight = 0
+        self._draining = False
+        self._flight_cv = threading.Condition()
         self.busy = BusyTracker()
         self._busy_observer = CompletionObserver(name="busy-observer")
         self.shm = SharedMemoryRegistry()
@@ -722,15 +785,81 @@ class InferenceEngine:
                 )
             return stats
 
+    # lifecycle: readiness / drain ------------------------------------------
+
+    def ready(self):
+        """Server-level readiness: False once drain() has begun (the load
+        balancer's signal to stop routing here)."""
+        with self._flight_cv:
+            return not self._draining
+
+    def drain(self, timeout_s=None):
+        """Graceful drain: stop admitting new work (readiness flips false,
+        new requests are rejected with retryable 503), then wait for every
+        in-flight request to finish.  Returns True when fully drained
+        within *timeout_s* (None = wait indefinitely)."""
+        deadline = (
+            time.monotonic() + timeout_s if timeout_s is not None else None
+        )
+        with self._flight_cv:
+            self._draining = True
+            while self._inflight:
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return False
+                self._flight_cv.wait(timeout=remaining)
+        return True
+
+    def _admit(self):
+        """One request enters execution, or is shed with a retryable 503."""
+        with self._flight_cv:
+            if self._draining:
+                raise InferenceServerException(
+                    "server is draining and not accepting new requests",
+                    status="503",
+                )
+            if self.max_inflight is not None and self._inflight >= self.max_inflight:
+                raise InferenceServerException(
+                    f"server overloaded: {self._inflight} requests in flight "
+                    f"(limit {self.max_inflight}); retry after backoff",
+                    status="503",
+                )
+            self._inflight += 1
+
+    def _release(self):
+        with self._flight_cv:
+            self._inflight -= 1
+            self._flight_cv.notify_all()
+
     # execution ------------------------------------------------------------
 
     def execute(self, model_name, model_version, request, binary_section):
-        """Run one inference request.
+        """Run one inference request through admission control.
 
         *request* is the JSON-form header dict; *binary_section* the raw bytes
         after the header. Returns (response_dict, binary_blobs) — for decoupled
         models, a list of such tuples.
         """
+        self._admit()
+        streamed = False
+        try:
+            result = self._execute_admitted(
+                model_name, model_version, request, binary_section
+            )
+            if not isinstance(result, (tuple, list)):  # decoupled generator
+                streamed = True
+                # the stream stays counted as in-flight until the consumer
+                # exhausts, closes, or drops it — drain must not cut a
+                # stream mid-generation
+                return _InflightStream(result, self._release)
+            return result
+        finally:
+            if not streamed:
+                self._release()
+
+    def _execute_admitted(self, model_name, model_version, request, binary_section):
         model = self.get_model(model_name, model_version)
         stats = self._stats[model_name]
         t0 = time.monotonic_ns()
@@ -934,6 +1063,7 @@ class InferenceEngine:
                     self._stats[model.name],
                     max_queue_delay_s=model.max_queue_delay_us / 1e6,
                     busy=self.busy,
+                    max_queue_depth=model.max_queue_depth,
                 )
                 self._batchers[model.name] = batcher
             return batcher

@@ -1,0 +1,6 @@
+"""Test harnesses for exercising the client/server stack under failure.
+
+``client_tpu.testing.faults`` holds the in-process chaos TCP proxy and the
+server-side fault hooks that tests/test_resilience.py drives the
+resilience policies (client_tpu.resilience) through.
+"""

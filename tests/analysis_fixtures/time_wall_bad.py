@@ -1,0 +1,27 @@
+"""TIME-WALL fixture: deadlines derived from the wall clock.
+
+The shape that breaks under NTP adjustment: a deadline computed from
+``time.time()`` can expire instantly (clock steps forward) or never
+(clock steps back) — every timed wait keyed on it misbehaves.
+"""
+
+import time
+
+
+def wait_for(predicate, timeout_s):
+    deadline = time.time() + timeout_s  # BAD: wall-clock deadline
+    while not predicate():
+        if time.time() > deadline:  # BAD: wall-clock comparison
+            return False
+        time.sleep(0.01)
+    return True
+
+
+class Drainer:
+    def drain(self, timeout_s):
+        self._expires = time.time() + timeout_s  # BAD: wall-clock expiry
+        return self._expires
+
+    def schedule(self, timeout_s):
+        deadline: float = time.time() + timeout_s  # BAD: annotated form
+        return deadline

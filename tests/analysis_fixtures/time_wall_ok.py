@@ -1,0 +1,22 @@
+"""TIME-WALL clean twin: monotonic deadlines; wall clock only as data.
+
+``time.time()`` is fine for *timestamps* (metrics, log fields) — the
+rule keys on deadline semantics, not on the call itself.
+"""
+
+import time
+
+
+def wait_for(predicate, timeout_s):
+    deadline = time.monotonic() + timeout_s  # monotonic budget
+    while not predicate():
+        if time.monotonic() > deadline:
+            return False
+        time.sleep(0.01)
+    return True
+
+
+def scrape_metrics(stats):
+    # wall-clock timestamps are data, not deadlines
+    last_inference_ms = int(time.time() * 1000)
+    return {"last_inference": last_inference_ms, "count": stats}

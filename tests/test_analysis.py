@@ -28,9 +28,9 @@ def _rules_hit(findings):
 def test_registry_has_all_rules():
     assert set(REGISTRY) >= {
         "NPY-TRUTH", "ASYNC-BLOCK", "LOCK-DISPATCH", "QUEUE-SENTINEL",
-        "CV-WAIT-LOOP", "SHARED-MUT",
+        "CV-WAIT-LOOP", "SHARED-MUT", "TIME-WALL",
     }
-    assert len(REGISTRY) >= 6
+    assert len(REGISTRY) >= 7
     for rule in REGISTRY.values():
         assert rule.rationale  # every rule documents its motivating bug
 
@@ -114,6 +114,19 @@ def test_shared_mut_hits():
 
 def test_shared_mut_clean():
     assert _scan("shared_mut_ok.py") == []
+
+
+def test_time_wall_hits():
+    findings = _scan("time_wall_bad.py")
+    assert _rules_hit(findings) == ["TIME-WALL"]
+    # the wall-clock deadline assignment, its comparison, the
+    # attribute-expiry assignment, and the annotated-assignment form
+    assert len(findings) == 4
+
+
+def test_time_wall_clean():
+    # monotonic deadlines and wall-clock *timestamps* both scan clean
+    assert _scan("time_wall_ok.py") == []
 
 
 def test_current_continuous_passes_every_rule():

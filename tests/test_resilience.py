@@ -1,0 +1,620 @@
+"""Resilience layer under real injected faults, on both transports.
+
+Every scenario drives a real client through a real failure: the chaos TCP
+proxy (client_tpu.testing.faults.FaultProxy) injects transport faults on
+live sockets, and the server-side hooks inject application-level overload
+and slowness.  Covered fault scenarios:
+
+1. connect delay (retry under a deadline still succeeds)
+2. error-N-times-then-succeed (connection resets, HTTP + gRPC, sync + aio)
+3. persistent connection refusal (attempts and wall time bounded by Deadline)
+4. mid-stream disconnect (gRPC streaming callback gets the error, no hung
+   reader thread)
+5. response byte truncation (HTTP mid-body cut is retried)
+6. overload 503 shedding (engine admission + batcher queue depth), and its
+   composition with client retries
+7. circuit-open fast-fail
+8. drain-while-busy (ready flips false, in-flight finishes, new work shed)
+"""
+
+import asyncio
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import client_tpu.grpc as grpcclient
+import client_tpu.http as httpclient
+from client_tpu.resilience import (
+    CircuitBreaker,
+    CircuitOpenError,
+    Deadline,
+    RetryPolicy,
+    call_with_retry,
+)
+from client_tpu.serve import Model, Server, TensorSpec
+from client_tpu.testing.faults import FailNTimes, FaultProxy, GatedFn
+from client_tpu.utils import InferenceServerException
+
+# a port from the dynamic range with nothing listening (bound-and-released
+# ports are not reused immediately by the kernel)
+def _closed_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _echo_model(name="echo", fn=None, width=4):
+    def echo(inputs, params, ctx):
+        return {"OUT": inputs["IN"]}
+
+    return Model(
+        name,
+        inputs=[TensorSpec("IN", "INT32", [-1, width])],
+        outputs=[TensorSpec("OUT", "INT32", [-1, width])],
+        fn=fn or echo,
+        max_batch_size=8,
+    )
+
+
+def _echo_inputs(mod):
+    data = np.arange(4, dtype=np.int32).reshape(1, 4)
+    inp = mod.InferInput("IN", [1, 4], "INT32")
+    inp.set_data_from_numpy(data)
+    return [inp], data
+
+
+def _fast_policy(**kw):
+    kw.setdefault("max_attempts", 5)
+    kw.setdefault("initial_backoff_s", 0.02)
+    kw.setdefault("max_backoff_s", 0.1)
+    return RetryPolicy(**kw)
+
+
+def _run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+@pytest.fixture(scope="module")
+def server():
+    models = [_echo_model(), _echo_model("echo_big", width=1024)]
+    with Server(models=models, grpc_port=0) as s:
+        yield s
+
+
+# -- policy unit behavior ---------------------------------------------------
+
+
+class TestPolicyUnits:
+    def test_deadline_bounds_attempts_and_wall_time(self):
+        """Acceptance: under a persistent failure, total attempts and wall
+        time stay bounded by the configured Deadline — no retry storm."""
+        calls = []
+
+        def always_down(timeout_s):
+            calls.append(timeout_s)
+            raise ConnectionRefusedError("injected: endpoint down")
+
+        policy = RetryPolicy(
+            max_attempts=100,  # deliberately generous: the deadline must bind
+            initial_backoff_s=0.05,
+            backoff_multiplier=2.0,
+            max_backoff_s=0.2,
+            jitter=False,
+            deadline_s=0.5,
+        )
+        t0 = time.monotonic()
+        with pytest.raises(ConnectionRefusedError):
+            call_with_retry(always_down, policy)
+        elapsed = time.monotonic() - t0
+        # backoffs 0.05+0.1+0.2+0.2... within a 0.5s budget allow at most
+        # a handful of attempts, and the loop never sleeps past the budget
+        assert elapsed < 1.0
+        assert 2 <= len(calls) <= 6
+        # each attempt's timeout was derived from the remaining budget
+        assert all(t is not None and t <= 0.5 + 1e-6 for t in calls)
+        assert calls[0] > calls[-1]
+
+    def test_retry_after_hint_is_honored_and_capped(self):
+        policy = _fast_policy(max_attempts=2, max_backoff_s=0.05)
+        exc = InferenceServerException("busy", status="503")
+        exc.retry_after_s = 30.0  # hostile hint: capped at max_backoff_s
+        assert policy.delay_for(exc, 0) == 0.05
+        exc.retry_after_s = 0.01
+        assert policy.delay_for(exc, 0) == 0.01
+
+    def test_non_retryable_fails_immediately(self):
+        calls = []
+
+        def bad_request(timeout_s):
+            calls.append(1)
+            raise InferenceServerException("no such model", status="400")
+
+        with pytest.raises(InferenceServerException, match="no such model"):
+            call_with_retry(bad_request, _fast_policy())
+        assert len(calls) == 1
+
+    def test_circuit_breaker_transitions(self):
+        breaker = CircuitBreaker(failure_threshold=2, reset_timeout_s=0.1)
+        assert breaker.state == CircuitBreaker.CLOSED
+        breaker.record_failure()
+        breaker.before_attempt()  # still closed below threshold
+        breaker.record_failure()
+        assert breaker.state == CircuitBreaker.OPEN
+        with pytest.raises(CircuitOpenError):
+            breaker.before_attempt()
+        time.sleep(0.12)
+        breaker.before_attempt()  # half-open probe allowed
+        assert breaker.state == CircuitBreaker.HALF_OPEN
+        breaker.record_failure()  # probe failed: straight back to open
+        assert breaker.state == CircuitBreaker.OPEN
+        time.sleep(0.12)
+        breaker.before_attempt()
+        breaker.record_success()
+        assert breaker.state == CircuitBreaker.CLOSED
+
+    def test_non_retryable_errors_do_not_trip_breaker(self):
+        """A 4xx application error proves the endpoint answered: it must
+        not open the circuit against a healthy server."""
+        breaker = CircuitBreaker(failure_threshold=2, reset_timeout_s=60.0)
+        policy = _fast_policy(max_attempts=1, circuit_breaker=breaker)
+
+        def bad_request(timeout_s):
+            raise InferenceServerException("no such model", status="400")
+
+        for _ in range(5):
+            with pytest.raises(InferenceServerException, match="no such model"):
+                call_with_retry(bad_request, policy)
+        assert breaker.state == CircuitBreaker.CLOSED
+
+    def test_half_open_admits_single_probe(self):
+        """Concurrent callers keep fast-failing while the one half-open
+        probe is in flight — no herd onto a recovering endpoint."""
+        breaker = CircuitBreaker(failure_threshold=1, reset_timeout_s=0.05)
+        breaker.record_failure()
+        assert breaker.state == CircuitBreaker.OPEN
+        time.sleep(0.06)
+        breaker.before_attempt()  # the probe passes
+        with pytest.raises(CircuitOpenError):
+            breaker.before_attempt()  # a concurrent caller does not
+        breaker.record_success()
+        breaker.before_attempt()
+        assert breaker.state == CircuitBreaker.CLOSED
+
+    def test_deadline_expiry(self):
+        d = Deadline(0.05)
+        assert not d.expired()
+        assert 0 < d.attempt_timeout() <= 0.05
+        time.sleep(0.06)
+        assert d.expired()
+        assert d.attempt_timeout() == 0.0
+
+
+# -- scenario 1+2: delay and error-then-succeed over HTTP -------------------
+
+
+class TestHttpFaults:
+    def test_error_then_succeed(self, server):
+        with FaultProxy(server.http_address) as proxy:
+            proxy.reset_next_connections(2)
+            with httpclient.InferenceServerClient(
+                proxy.address, retry_policy=_fast_policy()
+            ) as client:
+                inputs, data = _echo_inputs(httpclient)
+                result = client.infer("echo", inputs)
+                np.testing.assert_array_equal(result.as_numpy("OUT"), data)
+            assert proxy.connections >= 3  # two resets + the success
+
+    def test_connect_delay_within_deadline(self, server):
+        with FaultProxy(server.http_address) as proxy:
+            proxy.set_delay(0.1)
+            with httpclient.InferenceServerClient(
+                proxy.address, retry_policy=_fast_policy(deadline_s=5.0)
+            ) as client:
+                inputs, data = _echo_inputs(httpclient)
+                result = client.infer("echo", inputs)
+                np.testing.assert_array_equal(result.as_numpy("OUT"), data)
+
+    def test_truncated_response_is_retried(self, server):
+        with FaultProxy(server.http_address) as proxy:
+            # Cut the first connection's response mid-BODY (the 4 KiB binary
+            # tensor guarantees the cut lands past the HTTP headers, where
+            # the Content-Length mismatch is a hard transport error —
+            # truncating inside the headers can parse as an empty 200);
+            # the retry's fresh connection passes through intact.
+            proxy.cut_responses_after(600, times=1)
+            with httpclient.InferenceServerClient(
+                proxy.address, retry_policy=_fast_policy()
+            ) as client:
+                data = np.arange(1024, dtype=np.int32).reshape(1, 1024)
+                inp = httpclient.InferInput("IN", [1, 1024], "INT32")
+                inp.set_data_from_numpy(data)
+                outputs = [httpclient.InferRequestedOutput("OUT", binary_data=True)]
+                result = client.infer("echo_big", [inp], outputs=outputs)
+                np.testing.assert_array_equal(result.as_numpy("OUT"), data)
+            assert proxy.connections >= 2
+
+    def test_persistent_refusal_bounded_by_deadline(self, server):
+        with FaultProxy(server.http_address) as proxy:
+            proxy.refuse_connections(True)
+            policy = _fast_policy(max_attempts=50, deadline_s=0.6)
+            with httpclient.InferenceServerClient(
+                proxy.address, retry_policy=policy
+            ) as client:
+                inputs, _ = _echo_inputs(httpclient)
+                t0 = time.monotonic()
+                with pytest.raises(InferenceServerException):
+                    client.infer("echo", inputs)
+                elapsed = time.monotonic() - t0
+            assert elapsed < 2.0  # deadline bound, not 50 attempts' worth
+            assert proxy.connections <= 30
+
+    def test_without_policy_behavior_unchanged(self, server):
+        with FaultProxy(server.http_address) as proxy:
+            proxy.reset_next_connections(1)
+            with httpclient.InferenceServerClient(proxy.address) as client:
+                inputs, _ = _echo_inputs(httpclient)
+                with pytest.raises(InferenceServerException):
+                    client.infer("echo", inputs)  # single attempt: fails
+            assert proxy.connections == 1
+
+
+# -- scenario 2 over gRPC (sync + aio) --------------------------------------
+
+# After a connection failure the channel sits in TRANSIENT_FAILURE for its
+# own reconnect backoff; shrink it so the retry policy's attempts map to
+# real reconnects instead of burning against the cached channel state.
+_FAST_RECONNECT = [
+    ("grpc.initial_reconnect_backoff_ms", 50),
+    ("grpc.min_reconnect_backoff_ms", 50),
+    ("grpc.max_reconnect_backoff_ms", 100),
+]
+
+
+def _grpc_policy():
+    return RetryPolicy(
+        max_attempts=6, initial_backoff_s=0.1, max_backoff_s=0.2, jitter=False
+    )
+
+
+class TestGrpcFaults:
+    def test_error_then_succeed(self, server):
+        with FaultProxy(server.grpc_address) as proxy:
+            proxy.reset_next_connections(1)
+            with grpcclient.InferenceServerClient(
+                proxy.address,
+                retry_policy=_grpc_policy(),
+                channel_args=_FAST_RECONNECT,
+            ) as client:
+                inputs, data = _echo_inputs(grpcclient)
+                result = client.infer("echo", inputs)
+                np.testing.assert_array_equal(result.as_numpy("OUT"), data)
+
+    def test_aio_error_then_succeed(self, server):
+        import client_tpu.grpc.aio as aiogrpc
+
+        async def flow(proxy):
+            proxy.reset_next_connections(1)
+            async with aiogrpc.InferenceServerClient(
+                proxy.address,
+                retry_policy=_grpc_policy(),
+                channel_args=_FAST_RECONNECT,
+            ) as client:
+                inputs, data = _echo_inputs(aiogrpc)
+                result = await client.infer("echo", inputs)
+                np.testing.assert_array_equal(result.as_numpy("OUT"), data)
+
+        with FaultProxy(server.grpc_address) as proxy:
+            _run(flow(proxy))
+
+    def test_midstream_disconnect_reaches_stream_callback(self, server):
+        """Satellite: a mid-stream disconnect must surface to the stream
+        callback as an error and leave no hung reader thread."""
+        with FaultProxy(server.grpc_address) as proxy:
+            client = grpcclient.InferenceServerClient(proxy.address)
+            events = []
+            got_event = threading.Event()
+
+            def callback(result, error):
+                events.append((result, error))
+                got_event.set()
+
+            client.start_stream(callback)
+            inputs, data = _echo_inputs(grpcclient)
+            client.async_stream_infer("echo", inputs)
+            assert got_event.wait(timeout=10)  # first response arrived
+            result, error = events[0]
+            assert error is None
+            np.testing.assert_array_equal(result.as_numpy("OUT"), data)
+
+            got_event.clear()
+            proxy.kill_active()  # mid-stream disconnect
+            assert got_event.wait(timeout=10)
+            result, error = events[-1]
+            assert error is not None
+            assert isinstance(error, InferenceServerException)
+
+            handler = client._stream._handler
+            client.stop_stream()
+            handler.join(timeout=5)
+            assert not handler.is_alive()  # no hung reader thread
+            client.close()
+
+
+# -- aio HTTP ---------------------------------------------------------------
+
+
+class TestHttpAioFaults:
+    def test_error_then_succeed(self, server):
+        import client_tpu.http.aio as aiohttpclient
+
+        async def flow(proxy):
+            proxy.reset_next_connections(2)
+            async with aiohttpclient.InferenceServerClient(
+                proxy.address, retry_policy=_fast_policy()
+            ) as client:
+                inputs, data = _echo_inputs(aiohttpclient)
+                result = await client.infer("echo", inputs)
+                np.testing.assert_array_equal(result.as_numpy("OUT"), data)
+
+        with FaultProxy(server.http_address) as proxy:
+            _run(flow(proxy))
+
+
+# -- scenario 6: overload shedding + composition with retries ---------------
+
+
+class TestOverload:
+    def test_engine_admission_sheds_with_retryable_503(self):
+        gated = GatedFn(lambda inputs, params, ctx: {"OUT": inputs["IN"]})
+        with Server(
+            models=[_echo_model("gated", fn=gated)],
+            with_default_models=False,
+            max_inflight=1,
+        ) as server:
+            with httpclient.InferenceServerClient(
+                server.http_address, concurrency=2
+            ) as client:
+                inputs, _ = _echo_inputs(httpclient)
+                first = client.async_infer("gated", inputs)
+                assert gated.entered.wait(timeout=10)
+                # capacity is taken: the second request is shed retryably
+                with pytest.raises(InferenceServerException) as exc_info:
+                    client.infer("gated", inputs)
+                assert exc_info.value.status() == "503"
+                assert "overloaded" in str(exc_info.value)
+                gated.release()
+                first.get_result(timeout=10)  # in-flight work completed
+
+    def test_client_retries_compose_with_server_shedding(self):
+        gated = GatedFn(lambda inputs, params, ctx: {"OUT": inputs["IN"]})
+        with Server(
+            models=[_echo_model("gated", fn=gated)],
+            with_default_models=False,
+            max_inflight=1,
+        ) as server:
+            with httpclient.InferenceServerClient(
+                server.http_address,
+                concurrency=2,
+                retry_policy=_fast_policy(max_attempts=40, max_backoff_s=0.05),
+            ) as client:
+                inputs, data = _echo_inputs(httpclient)
+                first = client.async_infer("gated", inputs)
+                assert gated.entered.wait(timeout=10)
+                # the retrying client keeps backing off while the slot is
+                # held, and lands once it frees
+                releaser = threading.Timer(0.2, gated.release)
+                releaser.start()
+                try:
+                    result = client.infer("gated", inputs)
+                finally:
+                    releaser.cancel()
+                np.testing.assert_array_equal(result.as_numpy("OUT"), data)
+                first.get_result(timeout=10)
+
+    def test_batcher_queue_depth_sheds(self):
+        gated = GatedFn(lambda inputs, params, ctx: {"OUT": inputs["IN"]})
+        model = _echo_model("batched", fn=gated)
+        model.dynamic_batching = True
+        model.max_queue_depth = 1
+        with Server(models=[model], with_default_models=False) as server:
+            with httpclient.InferenceServerClient(
+                server.http_address, concurrency=4
+            ) as client:
+                inputs, _ = _echo_inputs(httpclient)
+                # wave 1 occupies the batcher thread inside model.fn ...
+                first = client.async_infer("batched", inputs)
+                assert gated.entered.wait(timeout=10)
+                # ... so of wave 2, exactly one fits the depth-1 queue and
+                # the rest shed with the retryable 503
+                wave = [client.async_infer("batched", inputs) for _ in range(4)]
+                # shed responses return immediately; wait until the three
+                # rejections are in before releasing the gate (releasing
+                # early would let the batcher drain the queue under them)
+                deadline = time.monotonic() + 10
+                while (
+                    sum(w._future.done() for w in wave) < 3
+                    and time.monotonic() < deadline
+                ):
+                    time.sleep(0.01)
+                gated.release()
+                outcomes = []
+                for p in [first] + wave:
+                    try:
+                        p.get_result(timeout=15)
+                        outcomes.append("ok")
+                    except InferenceServerException as e:
+                        outcomes.append(e.status())
+                assert outcomes[0] == "ok"  # dispatched work completed
+                assert "503" in outcomes[1:]
+                assert "ok" in outcomes[1:]  # the queued one landed too
+
+
+# -- scenario 7: circuit breaker fast-fail ----------------------------------
+
+
+class TestCircuitBreaker:
+    def test_open_circuit_fast_fails_without_network(self):
+        port = _closed_port()
+        breaker = CircuitBreaker(failure_threshold=2, reset_timeout_s=60.0)
+        policy = _fast_policy(max_attempts=1, circuit_breaker=breaker)
+        with httpclient.InferenceServerClient(
+            f"127.0.0.1:{port}", retry_policy=policy
+        ) as client:
+            inputs, _ = _echo_inputs(httpclient)
+            for _ in range(2):  # trip the breaker
+                with pytest.raises(InferenceServerException):
+                    client.infer("echo", inputs)
+            assert breaker.state == CircuitBreaker.OPEN
+            t0 = time.monotonic()
+            with pytest.raises(CircuitOpenError, match="circuit breaker"):
+                client.infer("echo", inputs)
+            # fast-fail: no connect attempt, no backoff sleep
+            assert time.monotonic() - t0 < 0.05
+
+
+# -- scenario 8: graceful drain ---------------------------------------------
+
+
+class TestDrain:
+    def test_drain_while_busy(self):
+        gated = GatedFn(lambda inputs, params, ctx: {"OUT": inputs["IN"]})
+        server = Server(
+            models=[_echo_model("gated", fn=gated)],
+            with_default_models=False,
+            grpc_port=0,
+        ).start()
+        http = httpclient.InferenceServerClient(server.http_address, concurrency=2)
+        grpc_client = grpcclient.InferenceServerClient(server.grpc_address)
+        try:
+            assert http.is_server_ready()
+            assert grpc_client.is_server_ready()
+            inputs, data = _echo_inputs(httpclient)
+            inflight = http.async_infer("gated", inputs)
+            assert gated.entered.wait(timeout=10)
+
+            drained = []
+            drainer = threading.Thread(
+                target=lambda: drained.append(server.engine.drain(timeout_s=20))
+            )
+            drainer.start()
+            deadline = time.monotonic() + 5
+            while http.is_server_ready() and time.monotonic() < deadline:
+                time.sleep(0.01)
+            # readiness flipped on BOTH frontends while work is in flight
+            assert not http.is_server_ready()
+            assert not grpc_client.is_server_ready()
+            assert http.is_server_live()  # live stays true: process is up
+
+            # new work is shed with the retryable 503/UNAVAILABLE
+            with pytest.raises(InferenceServerException) as http_exc:
+                http.infer("gated", inputs)
+            assert http_exc.value.status() == "503"
+            g_inputs, _ = _echo_inputs(grpcclient)
+            with pytest.raises(InferenceServerException) as grpc_exc:
+                grpc_client.infer("gated", g_inputs)
+            assert grpc_exc.value.status() == "UNAVAILABLE"
+
+            gated.release()
+            drainer.join(timeout=20)
+            assert drained == [True]  # fully drained within budget
+            result = inflight.get_result(timeout=10)  # in-flight completed
+            np.testing.assert_array_equal(result.as_numpy("OUT"), data)
+        finally:
+            http.close()
+            grpc_client.close()
+            server.stop()
+
+    def test_unary_decoupled_rejection_does_not_leak_inflight(self):
+        """A decoupled model called over unary RPC is rejected before its
+        response stream is iterated; the admission slot must be released
+        anyway (a leak here wedges max_inflight and hangs drain)."""
+
+        def gen_fn(inputs, params, ctx):
+            yield {"OUT": inputs["IN"]}
+
+        model = _echo_model("dec", fn=gen_fn)
+        model.decoupled = True
+        with Server(
+            models=[model],
+            with_default_models=False,
+            grpc_port=0,
+            max_inflight=1,
+        ) as server:
+            with grpcclient.InferenceServerClient(server.grpc_address) as client:
+                inputs, _ = _echo_inputs(grpcclient)
+                for _ in range(3):  # with a leak, call 2+ would 503
+                    with pytest.raises(
+                        InferenceServerException, match="decoupled"
+                    ):
+                        client.infer("dec", inputs)
+            assert server.engine.drain(timeout_s=2.0) is True
+
+    def test_drain_timeout_reports_false(self):
+        gated = GatedFn(lambda inputs, params, ctx: {"OUT": inputs["IN"]})
+        with Server(
+            models=[_echo_model("gated", fn=gated)], with_default_models=False
+        ) as server:
+            with httpclient.InferenceServerClient(
+                server.http_address, concurrency=2
+            ) as client:
+                inputs, _ = _echo_inputs(httpclient)
+                inflight = client.async_infer("gated", inputs)
+                assert gated.entered.wait(timeout=10)
+                t0 = time.monotonic()
+                assert server.engine.drain(timeout_s=0.2) is False
+                assert time.monotonic() - t0 < 2.0
+                gated.release()
+                inflight.get_result(timeout=10)
+
+
+# -- satellite: health verbs answer False against a dead endpoint -----------
+
+
+class TestHealthParity:
+    def test_http_sync_health_false_on_closed_port(self):
+        url = f"127.0.0.1:{_closed_port()}"
+        with httpclient.InferenceServerClient(url) as client:
+            assert client.is_server_live() is False
+            assert client.is_server_ready() is False
+            assert client.is_model_ready("echo") is False
+
+    def test_grpc_sync_health_false_on_closed_port(self):
+        url = f"127.0.0.1:{_closed_port()}"
+        with grpcclient.InferenceServerClient(url) as client:
+            assert client.is_server_live() is False
+            assert client.is_server_ready() is False
+            assert client.is_model_ready("echo") is False
+
+    def test_http_aio_health_false_on_closed_port(self):
+        import client_tpu.http.aio as aiohttpclient
+
+        async def flow():
+            url = f"127.0.0.1:{_closed_port()}"
+            async with aiohttpclient.InferenceServerClient(url) as client:
+                assert await client.is_server_live() is False
+                assert await client.is_server_ready() is False
+                assert await client.is_model_ready("echo") is False
+
+        _run(flow())
+
+    def test_grpc_aio_health_false_on_closed_port(self):
+        import client_tpu.grpc.aio as aiogrpc
+
+        async def flow():
+            url = f"127.0.0.1:{_closed_port()}"
+            async with aiogrpc.InferenceServerClient(url) as client:
+                assert await client.is_server_live() is False
+                assert await client.is_server_ready() is False
+                assert await client.is_model_ready("echo") is False
+
+        _run(flow())
