@@ -42,6 +42,11 @@ _QUEUE_CTORS = {
 }
 # names that hold a point-in-time budget (deadline semantics)
 _DEADLINE_NAME_RE = re.compile(r"(?i)(deadline|expires?|expiry|_until$|^until$)")
+# Prometheus label position: an f-string constant part ending with
+# `label="` right before an interpolated value
+_LABEL_OPEN_RE = re.compile(r'[A-Za-z_][A-Za-z0-9_]*="$')
+# sanctioned escape helpers for label values (serve/metrics.escape_label)
+_LABEL_ESCAPERS = {"escape_label", "_escape_label"}
 
 
 def _expr_text(node):
@@ -702,6 +707,59 @@ class TimeWallRule(Rule):
                         "jumps (NTP) break the budget — use "
                         "time.monotonic()",
                     ))
+        return findings
+
+
+@register
+class MetricLabelRule(Rule):
+    """METRIC-LABEL — unescaped interpolation into Prometheus label values.
+
+    The text exposition format reserves ``\\``, ``"`` and newline inside
+    quoted label values; an f-string that drops a model/version name into
+    ``{model="..."}`` unescaped lets one hostile (or merely creative) model
+    name corrupt the whole /metrics payload — the serve/metrics.py bug this
+    PR fixed.  Flags any f-string FormattedValue whose preceding constant
+    part ends in ``label="`` unless the value is wrapped in the sanctioned
+    escape helper (``escape_label``).
+    """
+
+    id = "METRIC-LABEL"
+    rationale = (
+        "a quote/backslash/newline interpolated into a Prometheus label "
+        "corrupts the exposition format (wrap the value in escape_label())"
+    )
+
+    def check(self, tree, lines, path):
+        findings = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.JoinedStr):
+                continue
+            prev_const = ""
+            for part in node.values:
+                if isinstance(part, ast.Constant) and isinstance(
+                    part.value, str
+                ):
+                    prev_const = part.value
+                    continue
+                if isinstance(part, ast.FormattedValue):
+                    if _LABEL_OPEN_RE.search(prev_const):
+                        escaper = ""
+                        if isinstance(part.value, ast.Call):
+                            escaper = _last_segment(
+                                _expr_text(part.value.func) or ""
+                            )
+                        if escaper not in _LABEL_ESCAPERS:
+                            label = _LABEL_OPEN_RE.search(prev_const).group()
+                            what = _expr_text(part.value) or "<expression>"
+                            findings.append(self.finding(
+                                path, lines, part,
+                                f"f-string interpolates {what} into the "
+                                f"Prometheus label position {label}...\" "
+                                "without escape_label(): a quote/backslash/"
+                                "newline in the value corrupts the "
+                                "exposition format",
+                            ))
+                    prev_const = ""
         return findings
 
 

@@ -16,6 +16,7 @@ import threading
 import grpc
 
 from client_tpu import resilience as _resilience
+from client_tpu import tracing as _tracing
 from client_tpu._grpc_infer import (  # noqa: F401  (re-exported API surface)
     InferResult,
     build_infer_request,
@@ -223,6 +224,7 @@ class InferenceServerClient:
         keepalive_options=None,
         channel_args=None,
         retry_policy=None,
+        tracer=None,
     ):
         options = _channel_options(keepalive_options, channel_args)
         if creds is not None:
@@ -249,6 +251,9 @@ class InferenceServerClient:
         # None keeps the original single-attempt behavior.  Streaming RPCs
         # are never retried (replay would re-send every queued request).
         self._retry_policy = retry_policy
+        # Opt-in tracing (client_tpu.tracing.ClientTracer): client spans +
+        # traceparent propagation over gRPC metadata.
+        self._tracer = tracer
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -262,15 +267,29 @@ class InferenceServerClient:
     def __exit__(self, *exc):
         self.close()
 
-    def _call(self, name, request, headers=None, client_timeout=None, **kwargs):
+    def _call(self, name, request, headers=None, client_timeout=None,
+              trace=None, **kwargs):
         if self._retry_policy is None:
-            return self._call_once(name, request, headers, client_timeout, **kwargs)
+            return self._attempt_once(
+                name, request, headers, client_timeout, trace, **kwargs
+            )
 
         def attempt(timeout_s):
             timeout = _attempt_timeout(client_timeout, timeout_s)
-            return self._call_once(name, request, headers, timeout, **kwargs)
+            return self._attempt_once(
+                name, request, headers, timeout, trace, **kwargs
+            )
 
         return _resilience.call_with_retry(attempt, self._retry_policy)
+
+    def _attempt_once(self, name, request, headers, client_timeout, trace,
+                      **kwargs):
+        """One RPC attempt in a trace attempt span — retries show as
+        repeated ATTEMPT_START/ATTEMPT_END pairs."""
+        with _tracing.attempt_span(trace):
+            return self._call_once(
+                name, request, headers, client_timeout, **kwargs
+            )
 
     def _call_once(self, name, request, headers=None, client_timeout=None, **kwargs):
         if self._verbose:
@@ -542,27 +561,33 @@ class InferenceServerClient:
         compression_algorithm=None,
         parameters=None,
     ):
-        request = build_infer_request(
-            model_name,
-            inputs,
-            model_version,
-            outputs,
-            request_id,
-            sequence_id,
-            sequence_start,
-            sequence_end,
-            priority,
-            timeout,
-            parameters,
-        )
-        response = self._call(
-            "ModelInfer",
-            request,
-            headers,
-            client_timeout,
-            compression=_grpc_compression(compression_algorithm),
-        )
-        return InferResult(response)
+        with _tracing.client_span(self._tracer, model_name) as trace:
+            request = build_infer_request(
+                model_name,
+                inputs,
+                model_version,
+                outputs,
+                request_id,
+                sequence_id,
+                sequence_start,
+                sequence_end,
+                priority,
+                timeout,
+                parameters,
+            )
+            if trace is not None:
+                trace.event("CLIENT_SERIALIZE_END")
+                headers = dict(headers or {})
+                headers["traceparent"] = trace.traceparent()
+            response = self._call(
+                "ModelInfer",
+                request,
+                headers,
+                client_timeout,
+                trace=trace,
+                compression=_grpc_compression(compression_algorithm),
+            )
+            return InferResult(response)
 
     def async_infer(
         self,

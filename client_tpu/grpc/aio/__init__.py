@@ -9,6 +9,7 @@ the bidirectional ModelStreamInfer stream and yields (result, error) tuples.
 import grpc
 
 from client_tpu import resilience as _resilience
+from client_tpu import tracing as _tracing
 from client_tpu._grpc_infer import (  # noqa: F401
     InferResult,
     build_infer_request,
@@ -50,6 +51,7 @@ class InferenceServerClient:
         keepalive_options=None,
         channel_args=None,
         retry_policy=None,
+        tracer=None,
     ):
         options = _channel_options(keepalive_options, channel_args)
         if creds is not None:
@@ -75,6 +77,9 @@ class InferenceServerClient:
         # behavior.  stream_infer is never retried (replay would re-send
         # every request the iterator already produced).
         self._retry_policy = retry_policy
+        # Opt-in tracing (client_tpu.tracing.ClientTracer): client spans +
+        # traceparent propagation over gRPC metadata.
+        self._tracer = tracer
 
     async def close(self):
         await self._channel.close()
@@ -85,15 +90,29 @@ class InferenceServerClient:
     async def __aexit__(self, *exc):
         await self.close()
 
-    async def _call(self, name, request, headers=None, client_timeout=None, **kw):
+    async def _call(self, name, request, headers=None, client_timeout=None,
+                    trace=None, **kw):
         if self._retry_policy is None:
-            return await self._call_once(name, request, headers, client_timeout, **kw)
+            return await self._attempt_once(
+                name, request, headers, client_timeout, trace, **kw
+            )
 
         async def attempt(timeout_s):
             timeout = _attempt_timeout(client_timeout, timeout_s)
-            return await self._call_once(name, request, headers, timeout, **kw)
+            return await self._attempt_once(
+                name, request, headers, timeout, trace, **kw
+            )
 
         return await _resilience.acall_with_retry(attempt, self._retry_policy)
+
+    async def _attempt_once(self, name, request, headers, client_timeout,
+                            trace, **kw):
+        """One RPC attempt in a trace attempt span — retries show as
+        repeated ATTEMPT_START/ATTEMPT_END pairs."""
+        with _tracing.attempt_span(trace):
+            return await self._call_once(
+                name, request, headers, client_timeout, **kw
+            )
 
     async def _call_once(self, name, request, headers=None, client_timeout=None, **kw):
         if self._verbose:
@@ -360,27 +379,33 @@ class InferenceServerClient:
         compression_algorithm=None,
         parameters=None,
     ):
-        request = build_infer_request(
-            model_name,
-            inputs,
-            model_version,
-            outputs,
-            request_id,
-            sequence_id,
-            sequence_start,
-            sequence_end,
-            priority,
-            timeout,
-            parameters,
-        )
-        response = await self._call(
-            "ModelInfer",
-            request,
-            headers,
-            client_timeout,
-            compression=_grpc_compression(compression_algorithm),
-        )
-        return InferResult(response)
+        with _tracing.client_span(self._tracer, model_name) as trace:
+            request = build_infer_request(
+                model_name,
+                inputs,
+                model_version,
+                outputs,
+                request_id,
+                sequence_id,
+                sequence_start,
+                sequence_end,
+                priority,
+                timeout,
+                parameters,
+            )
+            if trace is not None:
+                trace.event("CLIENT_SERIALIZE_END")
+                headers = dict(headers or {})
+                headers["traceparent"] = trace.traceparent()
+            response = await self._call(
+                "ModelInfer",
+                request,
+                headers,
+                client_timeout,
+                trace=trace,
+                compression=_grpc_compression(compression_algorithm),
+            )
+            return InferResult(response)
 
     def stream_infer(
         self,

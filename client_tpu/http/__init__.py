@@ -19,6 +19,7 @@ import urllib3
 
 from client_tpu import _codec
 from client_tpu import resilience as _resilience
+from client_tpu import tracing as _tracing
 from client_tpu._infer_types import (  # noqa: F401  (re-exported API surface)
     InferInput,
     InferRequestedOutput,
@@ -154,6 +155,7 @@ class InferenceServerClient:
         ssl_context=None,
         insecure=False,
         retry_policy=None,
+        tracer=None,
     ):
         if "://" in url:
             scheme, _, rest = url.partition("://")
@@ -181,6 +183,10 @@ class InferenceServerClient:
         # every request through retry/backoff/deadline/circuit-breaker.
         # None (the default) keeps the original single-attempt behavior.
         self._retry_policy = retry_policy
+        # Opt-in tracing: a client_tpu.tracing.ClientTracer samples infer
+        # calls, records client spans, and propagates a W3C traceparent so
+        # the server's trace joins under the same trace id.
+        self._tracer = tracer
         self._executor = None  # lazily created for async_infer
 
     # -- lifecycle ----------------------------------------------------------
@@ -205,13 +211,16 @@ class InferenceServerClient:
 
     # -- low-level request helpers -----------------------------------------
 
-    def _request(self, method, uri, headers=None, query_params=None, body=None):
+    def _request(self, method, uri, headers=None, query_params=None, body=None,
+                 trace=None):
         if self._retry_policy is None:
-            return self._request_once(method, uri, headers, query_params, body)
+            return self._attempt_once(
+                method, uri, headers, query_params, body, None, trace
+            )
 
         def attempt(timeout_s):
-            response = self._request_once(
-                method, uri, headers, query_params, body, timeout_s
+            response = self._attempt_once(
+                method, uri, headers, query_params, body, timeout_s, trace
             )
             # Overload statuses become exceptions so the retry loop sees
             # them (with the server's Retry-After hint attached); retries
@@ -223,6 +232,15 @@ class InferenceServerClient:
             return response
 
         return _resilience.call_with_retry(attempt, self._retry_policy)
+
+    def _attempt_once(self, method, uri, headers, query_params, body,
+                      timeout_s, trace):
+        """One transport attempt in a trace attempt span — retries show as
+        repeated ATTEMPT_START/ATTEMPT_END pairs."""
+        with _tracing.attempt_span(trace):
+            return self._request_once(
+                method, uri, headers, query_params, body, timeout_s
+            )
 
     def _request_once(
         self, method, uri, headers=None, query_params=None, body=None, timeout_s=None
@@ -558,39 +576,47 @@ class InferenceServerClient:
         parameters=None,
     ):
         """Run one synchronous inference; returns InferResult."""
-        body, json_size = _codec.build_infer_request_body(
-            inputs,
-            outputs,
-            request_id,
-            sequence_id,
-            sequence_start,
-            sequence_end,
-            priority,
-            timeout,
-            parameters,
-        )
-        request_headers = dict(headers) if headers else {}
-        if json_size is not None:
-            request_headers["Inference-Header-Content-Length"] = str(json_size)
-        body = _codec.compress(body, request_compression_algorithm)
-        if request_compression_algorithm:
-            request_headers["Content-Encoding"] = request_compression_algorithm
-        if response_compression_algorithm:
-            request_headers["Accept-Encoding"] = response_compression_algorithm
+        with _tracing.client_span(self._tracer, model_name) as trace:
+            body, json_size = _codec.build_infer_request_body(
+                inputs,
+                outputs,
+                request_id,
+                sequence_id,
+                sequence_start,
+                sequence_end,
+                priority,
+                timeout,
+                parameters,
+            )
+            request_headers = dict(headers) if headers else {}
+            if json_size is not None:
+                request_headers["Inference-Header-Content-Length"] = str(json_size)
+            body = _codec.compress(body, request_compression_algorithm)
+            if request_compression_algorithm:
+                request_headers["Content-Encoding"] = request_compression_algorithm
+            if response_compression_algorithm:
+                request_headers["Accept-Encoding"] = response_compression_algorithm
+            if trace is not None:
+                trace.event("CLIENT_SERIALIZE_END")
+                request_headers["traceparent"] = trace.traceparent()
 
-        uri = f"v2/models/{quote(model_name, safe='')}"
-        if model_version:
-            uri += f"/versions/{model_version}"
-        uri += "/infer"
-        response = self._post(uri, body, request_headers, query_params)
-        self._raise_if_error(response)
-        header_length = response.headers.get("Inference-Header-Content-Length")
-        return InferResult.from_response_body(
-            response.data,
-            self._verbose,
-            int(header_length) if header_length is not None else None,
-            response.headers.get("Content-Encoding"),
-        )
+            uri = f"v2/models/{quote(model_name, safe='')}"
+            if model_version:
+                uri += f"/versions/{model_version}"
+            uri += "/infer"
+            response = self._request(
+                "POST", uri, request_headers, query_params, body, trace=trace
+            )
+            self._raise_if_error(response)
+            header_length = response.headers.get(
+                "Inference-Header-Content-Length"
+            )
+            return InferResult.from_response_body(
+                response.data,
+                self._verbose,
+                int(header_length) if header_length is not None else None,
+                response.headers.get("Content-Encoding"),
+            )
 
     def async_infer(self, model_name, inputs, **kwargs):
         """Submit inference on the worker pool; returns InferAsyncRequest.

@@ -13,6 +13,7 @@ import aiohttp
 
 from client_tpu import _codec
 from client_tpu import resilience as _resilience
+from client_tpu import tracing as _tracing
 from client_tpu._infer_types import InferInput, InferRequestedOutput  # noqa: F401
 from client_tpu.http import (  # same response/error parsing as sync
     InferResult,
@@ -40,6 +41,7 @@ class InferenceServerClient:
         ssl=False,
         ssl_context=None,
         retry_policy=None,
+        tracer=None,
     ):
         if "://" in url:
             scheme, _, rest = url.partition("://")
@@ -58,6 +60,9 @@ class InferenceServerClient:
         # Opt-in resilience (client_tpu.resilience.RetryPolicy); None keeps
         # the original single-attempt behavior.
         self._retry_policy = retry_policy
+        # Opt-in tracing (client_tpu.tracing.ClientTracer): client spans +
+        # traceparent propagation, same semantics as the sync client.
+        self._tracer = tracer
 
     async def close(self):
         await self._session.close()
@@ -74,13 +79,16 @@ class InferenceServerClient:
     async def _post(self, uri, body=b"", headers=None, query_params=None):
         return await self._request("POST", uri, headers, query_params, body)
 
-    async def _request(self, method, uri, headers=None, query_params=None, body=b""):
+    async def _request(self, method, uri, headers=None, query_params=None,
+                       body=b"", trace=None):
         if self._retry_policy is None:
-            return await self._request_once(method, uri, headers, query_params, body)
+            return await self._attempt_once(
+                method, uri, headers, query_params, body, None, trace
+            )
 
         async def attempt(timeout_s):
-            response = await self._request_once(
-                method, uri, headers, query_params, body, timeout_s
+            response = await self._attempt_once(
+                method, uri, headers, query_params, body, timeout_s, trace
             )
             # Overload statuses become exceptions for the retry loop (with
             # the Retry-After hint); the body read happens inside the
@@ -92,6 +100,15 @@ class InferenceServerClient:
             return response
 
         return await _resilience.acall_with_retry(attempt, self._retry_policy)
+
+    async def _attempt_once(self, method, uri, headers, query_params, body,
+                            timeout_s, trace):
+        """One transport attempt in a trace attempt span — retries show as
+        repeated ATTEMPT_START/ATTEMPT_END pairs."""
+        with _tracing.attempt_span(trace):
+            return await self._request_once(
+                method, uri, headers, query_params, body, timeout_s
+            )
 
     async def _request_once(
         self, method, uri, headers=None, query_params=None, body=b"", timeout_s=None
@@ -390,36 +407,44 @@ class InferenceServerClient:
         response_compression_algorithm=None,
         parameters=None,
     ):
-        body, json_size = _codec.build_infer_request_body(
-            inputs,
-            outputs,
-            request_id,
-            sequence_id,
-            sequence_start,
-            sequence_end,
-            priority,
-            timeout,
-            parameters,
-        )
-        request_headers = dict(headers) if headers else {}
-        if json_size is not None:
-            request_headers["Inference-Header-Content-Length"] = str(json_size)
-        body = _codec.compress(body, request_compression_algorithm)
-        if request_compression_algorithm:
-            request_headers["Content-Encoding"] = request_compression_algorithm
-        if response_compression_algorithm:
-            request_headers["Accept-Encoding"] = response_compression_algorithm
-        uri = f"v2/models/{quote(model_name, safe='')}"
-        if model_version:
-            uri += f"/versions/{model_version}"
-        uri += "/infer"
-        response = await self._post(uri, body, request_headers, query_params)
-        await self._raise_if_error(response)
-        data = await response.read()
-        header_length = response.headers.get("Inference-Header-Content-Length")
-        return InferResult.from_response_body(
-            data,
-            self._verbose,
-            int(header_length) if header_length is not None else None,
-            response.headers.get("Content-Encoding"),
-        )
+        with _tracing.client_span(self._tracer, model_name) as trace:
+            body, json_size = _codec.build_infer_request_body(
+                inputs,
+                outputs,
+                request_id,
+                sequence_id,
+                sequence_start,
+                sequence_end,
+                priority,
+                timeout,
+                parameters,
+            )
+            request_headers = dict(headers) if headers else {}
+            if json_size is not None:
+                request_headers["Inference-Header-Content-Length"] = str(json_size)
+            body = _codec.compress(body, request_compression_algorithm)
+            if request_compression_algorithm:
+                request_headers["Content-Encoding"] = request_compression_algorithm
+            if response_compression_algorithm:
+                request_headers["Accept-Encoding"] = response_compression_algorithm
+            if trace is not None:
+                trace.event("CLIENT_SERIALIZE_END")
+                request_headers["traceparent"] = trace.traceparent()
+            uri = f"v2/models/{quote(model_name, safe='')}"
+            if model_version:
+                uri += f"/versions/{model_version}"
+            uri += "/infer"
+            response = await self._request(
+                "POST", uri, request_headers, query_params, body, trace=trace
+            )
+            await self._raise_if_error(response)
+            data = await response.read()
+            header_length = response.headers.get(
+                "Inference-Header-Content-Length"
+            )
+            return InferResult.from_response_body(
+                data,
+                self._verbose,
+                int(header_length) if header_length is not None else None,
+                response.headers.get("Content-Encoding"),
+            )

@@ -309,10 +309,9 @@ class _InprocessBackend(ClientBackend):
         self._engine = engine
 
     def update_trace_settings(self, model_name="", settings=None):
-        self._engine.trace_settings.update(
-            {k: v for k, v in (settings or {}).items() if v is not None}
-        )
-        return dict(self._engine.trace_settings)
+        # same normalization point the socket frontends use, so the
+        # hermetic path round-trips the identical schema
+        return dict(self._engine.update_trace_settings(settings or {}))
 
     def model_metadata(self, model_name, model_version=""):
         return self._engine.get_model(model_name, model_version).metadata()

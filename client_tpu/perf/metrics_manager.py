@@ -14,6 +14,8 @@ import urllib.request
 
 import numpy as np
 
+from client_tpu.utils import escape_label
+
 
 def parse_prometheus(text):
     """Prometheus text format -> {metric_name: [(labels_str, value), ...]}."""
@@ -57,7 +59,7 @@ def local_device_snapshot():
             stats = None
         if not stats:
             continue
-        labels = f'{{device="{d.id}",source="local"}}'
+        labels = f'{{device="{escape_label(d.id)}",source="local"}}'
         used = stats.get("bytes_in_use")
         limit = stats.get("bytes_limit") or stats.get(
             "bytes_reservable_limit"
@@ -182,7 +184,8 @@ class MetricsManager:
         except Exception:
             return
         labels = (
-            f'{{device="{self.utilization_probe.device_id}",source="probe"}}'
+            f'{{device="{escape_label(self.utilization_probe.device_id)}"'
+            ',source="probe"}'
         )
         snap["ctpu_probe_queue_delay_us"] = [(labels, delay_us)]
         snap["ctpu_probe_busy"] = [(labels, busy)]
@@ -254,7 +257,53 @@ class MetricsManager:
                 "avg": float(np.mean(busy)) * 100.0,
                 "max": float(np.max(busy)) * 100.0,
             }
+        summary.update(MetricsManager.server_breakdown(snapshots))
         return summary
+
+    @staticmethod
+    def server_breakdown(snapshots):
+        """Server-side per-inference phase breakdown over the window.
+
+        Deltas the cumulative ``ctpu_inference_{queue,compute_*}_duration_us``
+        counters (summed across models) between the window's first and last
+        scrape and divides by the successful-request delta — so the perf
+        report shows where server time went (queue vs compute) next to the
+        client-observed latency, the reference perf_analyzer's
+        server-side-breakdown column set."""
+
+        def total(snap, name):
+            return sum(v for _, v in snap.get(name, []))
+
+        if len(snapshots) < 2:
+            return {}
+        first, last = snapshots[0], snapshots[-1]
+        d_requests = total(last, "ctpu_inference_request_success") - total(
+            first, "ctpu_inference_request_success"
+        )
+        if d_requests <= 0:
+            return {}
+        out = {}
+        for phase in ("queue", "compute_input", "compute_infer",
+                      "compute_output"):
+            metric = f"ctpu_inference_{phase}_duration_us"
+            if metric not in last:
+                continue
+            avg = (total(last, metric) - total(first, metric)) / d_requests
+            # real max: worst per-infer rate over consecutive scrape
+            # intervals (reporting max==avg would hide window spikes)
+            worst = avg
+            for a, b in zip(snapshots, snapshots[1:]):
+                d_req = total(b, "ctpu_inference_request_success") - total(
+                    a, "ctpu_inference_request_success"
+                )
+                if d_req <= 0:
+                    continue
+                rate = (total(b, metric) - total(a, metric)) / d_req
+                worst = max(worst, rate)
+            out[f"ctpu_server_{phase}_us_per_infer"] = {
+                "avg": avg, "max": worst,
+            }
+        return out
 
     @staticmethod
     def utilization(snapshots):

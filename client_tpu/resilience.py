@@ -129,6 +129,20 @@ class Deadline:
         return remaining
 
 
+def _notify(observer, method, *args):
+    """Invoke an optional observer hook; observers are best-effort and
+    must never break the call path (or a lock-free state transition)."""
+    if observer is None:
+        return
+    fn = getattr(observer, method, None)
+    if fn is None:
+        return
+    try:
+        fn(*args)
+    except Exception:
+        pass
+
+
 class CircuitBreaker:
     """Per-endpoint circuit breaker: closed → open → half-open.
 
@@ -136,19 +150,33 @@ class CircuitBreaker:
     sync clients and coroutine code.  ``before_attempt()`` raises
     :class:`CircuitOpenError` while open; after ``reset_timeout_s`` one
     probe passes (half-open) and its outcome decides the next state.
+
+    ``observer`` (optional) receives ``on_state_change(old, new)`` on
+    every transition — outside the breaker lock — so metrics (e.g.
+    ``client_tpu.serve.metrics.ResilienceMetricsObserver``) and logging
+    can watch the circuit without touching its hot path.
     """
 
     CLOSED, OPEN, HALF_OPEN = "closed", "open", "half-open"
 
-    def __init__(self, failure_threshold=5, reset_timeout_s=30.0, name=""):
+    def __init__(self, failure_threshold=5, reset_timeout_s=30.0, name="",
+                 observer=None):
         self.failure_threshold = int(failure_threshold)
         self.reset_timeout_s = float(reset_timeout_s)
         self.name = name
+        self.observer = observer
         self._lock = threading.Lock()
         self._state = self.CLOSED
         self._failures = 0
         self._opened_at = 0.0
         self._probing = False  # a half-open probe is in flight
+        # Transition delivery: stamped under _lock, delivered outside it
+        # under _notify_lock, stale deliveries dropped — so a preempted
+        # thread can never report an older transition after a newer one
+        # (which would wedge a state gauge at the wrong value).
+        self._transition_seq = 0
+        self._delivered_seq = 0
+        self._notify_lock = threading.Lock()
 
     @property
     def state(self):
@@ -162,34 +190,66 @@ class CircuitBreaker:
             f"fast-failing for {self.reset_timeout_s:g}s"
         )
 
+    def _deliver(self, seq, old, new):
+        """Deliver one stamped transition, dropping it if a newer one was
+        already delivered (a preempted thread must not overwrite a fresher
+        observer state — e.g. park a circuit-state gauge at 'open' after
+        the breaker already closed again)."""
+        if seq is None:
+            return
+        with self._notify_lock:
+            if seq <= self._delivered_seq:
+                return
+            self._delivered_seq = seq
+            _notify(self.observer, "on_state_change", old, new)
+
     def before_attempt(self):
         """Gate one attempt; raises CircuitOpenError without touching the
         network while the circuit is open and the cooldown has not passed.
         After the cooldown exactly ONE probe passes — concurrent callers
         keep fast-failing until that probe's outcome is recorded (no
         thundering herd onto a recovering endpoint)."""
+        transition = None
         with self._lock:
             if self._state == self.OPEN:
                 if time.monotonic() - self._opened_at < self.reset_timeout_s:
                     self._fast_fail()
+                self._transition_seq += 1
+                transition = (self._transition_seq, self._state, self.HALF_OPEN)
                 self._state = self.HALF_OPEN
                 self._probing = True
             elif self._state == self.HALF_OPEN and self._probing:
                 self._fast_fail()
+        if transition is not None:
+            self._deliver(*transition)
 
     def record_success(self):
+        transition = None
         with self._lock:
+            old = self._state
             self._failures = 0
             self._state = self.CLOSED
             self._probing = False
+            if old != self.CLOSED:
+                self._transition_seq += 1
+                transition = (self._transition_seq, old, self.CLOSED)
+        if transition is not None:
+            self._deliver(*transition)
 
     def record_failure(self):
+        transition = None
         with self._lock:
+            old = self._state
             self._failures += 1
             self._probing = False
             if self._state == self.HALF_OPEN or self._failures >= self.failure_threshold:
                 self._state = self.OPEN
                 self._opened_at = time.monotonic()
+                if old != self.OPEN:
+                    self._transition_seq += 1
+                    transition = (self._transition_seq, old, self.OPEN)
+        if transition is not None:
+            self._deliver(*transition)
 
 
 class RetryPolicy:
@@ -207,6 +267,12 @@ class RetryPolicy:
     deadline_s : total wall-time budget across attempts (None = unbounded).
     circuit_breaker : optional CircuitBreaker shared by calls through this
         policy.
+    observer : optional hook object; any subset of ``on_backoff(attempt,
+        delay_s, exc)`` (a retry is about to sleep), ``on_giveup(attempt,
+        exc)`` (the policy stopped retrying), and ``on_success(attempt)``
+        is called — best-effort, never on the raising path's stack state.
+        ``client_tpu.serve.metrics.ResilienceMetricsObserver`` feeds these
+        into the /metrics retry counters.
     """
 
     def __init__(
@@ -220,6 +286,7 @@ class RetryPolicy:
         deadline_s=None,
         circuit_breaker=None,
         rng=None,
+        observer=None,
     ):
         if max_attempts < 1:
             raise ValueError(f"max_attempts must be >= 1, got {max_attempts}")
@@ -231,6 +298,7 @@ class RetryPolicy:
         self.retryable_statuses = frozenset(retryable_statuses)
         self.deadline_s = deadline_s
         self.circuit_breaker = circuit_breaker
+        self.observer = observer
         self._rng = rng or random.Random()
 
     # -- classification ----------------------------------------------------
@@ -305,6 +373,7 @@ def _next_step(policy, deadline, exc, attempt, retryable):
     """Shared retry decision: returns the backoff sleep, or raises *exc*
     when the classification, attempt budget, or deadline budget says stop."""
     if not retryable or attempt + 1 >= policy.max_attempts:
+        _notify(policy.observer, "on_giveup", attempt, exc)
         raise exc
     delay = policy.delay_for(exc, attempt)
     if deadline is not None:
@@ -312,7 +381,9 @@ def _next_step(policy, deadline, exc, attempt, retryable):
         # a backoff that would outlive the budget is a guaranteed-dead
         # retry: surface the real error now instead of sleeping into it
         if remaining <= 0 or delay >= remaining:
+            _notify(policy.observer, "on_giveup", attempt, exc)
             raise exc
+    _notify(policy.observer, "on_backoff", attempt, delay, exc)
     return delay
 
 
@@ -341,6 +412,7 @@ def call_with_retry(fn, policy):
                 # this failure opened (or re-opened) the circuit: further
                 # retries would only fast-fail after a pointless backoff —
                 # surface the real error now
+                _notify(policy.observer, "on_giveup", attempt, exc)
                 raise
             delay = _next_step(policy, deadline, exc, attempt, retryable)
             attempt += 1
@@ -348,6 +420,7 @@ def call_with_retry(fn, policy):
         else:
             if breaker is not None:
                 breaker.record_success()
+            _notify(policy.observer, "on_success", attempt)
             return result
 
 
@@ -370,6 +443,7 @@ async def acall_with_retry(fn, policy):
             if breaker is not None and breaker.state == CircuitBreaker.OPEN:
                 # failure opened the circuit: surface the real error now
                 # instead of backing off into a guaranteed fast-fail
+                _notify(policy.observer, "on_giveup", attempt, exc)
                 raise
             delay = _next_step(policy, deadline, exc, attempt, retryable)
             attempt += 1
@@ -377,4 +451,5 @@ async def acall_with_retry(fn, policy):
         else:
             if breaker is not None:
                 breaker.record_success()
+            _notify(policy.observer, "on_success", attempt)
             return result

@@ -129,9 +129,10 @@ def _device_split(arr, offset, rows):
 
 
 class _Pending:
-    __slots__ = ("inputs", "rows", "signature", "event", "result", "error", "t_enq")
+    __slots__ = ("inputs", "rows", "signature", "event", "result", "error",
+                 "t_enq", "trace")
 
-    def __init__(self, inputs, rows, signature):
+    def __init__(self, inputs, rows, signature, trace=None):
         self.inputs = inputs
         self.rows = rows
         self.signature = signature
@@ -139,6 +140,7 @@ class _Pending:
         self.result = None
         self.error = None
         self.t_enq = time.monotonic_ns()
+        self.trace = trace  # optional RequestTrace (queue/compute events)
 
 
 class ModelBatcher:
@@ -146,10 +148,11 @@ class ModelBatcher:
     single padded forward pass and splits the host-materialized outputs."""
 
     def __init__(self, model, stats, max_queue_delay_s=0.003, busy=None,
-                 pipeline_depth=4, max_queue_depth=None):
+                 pipeline_depth=4, max_queue_depth=None, registry=None):
         self.model = model
         self.stats = stats
         self._busy = busy  # engine BusyTracker (duty-cycle metric), optional
+        self._registry = registry  # engine metrics Registry (shed counters)
         self.max_batch = max(int(model.max_batch_size), 1)
         self.max_queue_delay_s = max_queue_delay_s
         # Admission control: requests beyond this queue depth are shed with
@@ -296,7 +299,12 @@ class ModelBatcher:
 
     # -- request side -----------------------------------------------------
 
-    def submit(self, inputs):
+    def queue_depth(self):
+        """Requests currently waiting in the queue (/metrics gauge)."""
+        with self._cond:
+            return len(self._queue)
+
+    def submit(self, inputs, trace=None):
         """Block until the batched execution finishes; return this request's
         slice of the outputs — host numpy arrays for wire groups, live device
         slices for device (TPU-shm) groups."""
@@ -315,7 +323,7 @@ class ModelBatcher:
             # of cold XLA compile on the request path) — groups stay
             # row-uniform so every composition is a warmed executable
             signature += (rows,)
-        pending = _Pending(inputs, rows, signature)
+        pending = _Pending(inputs, rows, signature, trace)
         with self._cond:
             if self._closed:
                 raise InferenceServerException(
@@ -328,6 +336,16 @@ class ModelBatcher:
                 # Retryable overload: the client's retry policy backs off
                 # and re-submits once the queue drains (503 == UNAVAILABLE
                 # on the gRPC frontend).
+                # one consistent label set across the family: the _admit
+                # sheds (overload/draining) carry only {reason}, so no
+                # model label here either — a by-model aggregation would
+                # silently split the family otherwise
+                if self._registry is not None:
+                    self._registry.inc(
+                        "ctpu_requests_shed_total",
+                        {"reason": "queue_full"},
+                        help_="Requests shed with a retryable 503",
+                    )
                 raise InferenceServerException(
                     f"model '{self.model.name}' queue is full "
                     f"({len(self._queue)} >= {self.max_queue_depth} queued); "
@@ -563,6 +581,11 @@ class ModelBatcher:
         The engine duty-cycle span opens here and closes in _complete/_fail:
         the device is considered busy from issue until results land."""
         t0 = time.monotonic_ns()
+        w_dispatch = time.time_ns()
+        for p in group:
+            if p.trace is not None:
+                p.trace.event("QUEUE_END", w_dispatch)
+                p.trace.event("COMPUTE_START", w_dispatch)
         if self._busy is not None:
             self._busy.begin()
         try:
@@ -622,6 +645,7 @@ class ModelBatcher:
         completion (busy span + semaphore close there), or None on failure
         (the group is already notified)."""
         try:
+            w_done = time.time_ns()
             if isinstance(result, tuple) and result[0] == "fused":
                 # per-part output arrays came straight out of the jitted
                 # dispatch — hand them over, nothing left to do on host
@@ -630,6 +654,10 @@ class ModelBatcher:
                     p.result = {
                         name: parts[i] for name, parts in per_part.items()
                     }
+                    # trace events land BEFORE the waiter wakes: the request
+                    # thread completes/exports the trace as soon as it runs
+                    if p.trace is not None:
+                        p.trace.event("COMPUTE_END", w_done)
                     p.event.set()
                 watch = per_part
             else:
@@ -653,6 +681,8 @@ class ModelBatcher:
                     if extra_params is not None:
                         p.result["__parameters__"] = extra_params
                     offset += p.rows
+                    if p.trace is not None:
+                        p.trace.event("COMPUTE_END", w_done)
                     p.event.set()
                 watch = result
             with self._cond:
@@ -664,6 +694,7 @@ class ModelBatcher:
                 input_ns=t_in - t0,
                 output_ns=0,
                 queue_ns=sum(t_in - p.t_enq for p in group),
+                queue_ns_each=[t_in - p.t_enq for p in group],
             )
             return watch
         except Exception as e:  # noqa: BLE001 - failure propagates per-request
@@ -687,6 +718,7 @@ class ModelBatcher:
             # key) are batch-wide, not row-sliceable: replicate them onto
             # every request's split instead of slicing a dict
             extra_params = host.pop("__parameters__", None)
+            w_done = time.time_ns()
             offset = 0
             for p in group:
                 p.result = {
@@ -696,6 +728,8 @@ class ModelBatcher:
                 if extra_params is not None:
                     p.result["__parameters__"] = extra_params
                 offset += p.rows
+                if p.trace is not None:
+                    p.trace.event("COMPUTE_END", w_done)
                 p.event.set()
             with self._cond:
                 self._active.difference_update(group)
@@ -707,6 +741,7 @@ class ModelBatcher:
                 input_ns=t_in - t0,
                 output_ns=t1 - t_inf,
                 queue_ns=queue_ns,
+                queue_ns_each=[t_in - p.t_enq for p in group],
             )
         except Exception as e:  # noqa: BLE001 - failure propagates per-request
             if busy_open:

@@ -271,11 +271,18 @@ class _Handlers:
     def TraceSetting(self, request, context):
         settings = self.engine.trace_settings
         if request.settings:
+            updates = {}
             for key, value in request.settings.items():
                 values = list(value.value)
                 if not values:
                     continue
-                settings[key] = values if key == "trace_level" else values[0]
+                updates[key] = values if key == "trace_level" else values[0]
+            try:
+                # same normalization point as the HTTP verb, so settings
+                # round-trip identically over both protocols
+                settings = self.engine.update_trace_settings(updates)
+            except InferenceServerException as e:
+                _abort(context, e)
         response = pb.TraceSettingResponse()
         for key, value in settings.items():
             values = value if isinstance(value, list) else [str(value)]
@@ -367,11 +374,28 @@ class _Handlers:
 
     # inference --------------------------------------------------------------
 
+    def _sample_trace(self, request, context):
+        """A RequestTrace for this RPC (or None), joined to the client's
+        trace id via the traceparent metadata entry when present."""
+        traceparent = None
+        for key, value in context.invocation_metadata() or ():
+            if key == "traceparent":
+                traceparent = value
+                break
+        return self.engine.tracer.sample(
+            traceparent, model_name=request.model_name,
+            model_version=request.model_version, protocol="grpc",
+        )
+
     def ModelInfer(self, request, context):
+        trace = self._sample_trace(request, context)
+        if trace is not None:
+            trace.event("REQUEST_START")
         try:
             req, binary = _request_to_dict(request)
             result = self.engine.execute(
-                request.model_name, request.model_version, req, binary
+                request.model_name, request.model_version, req, binary,
+                trace=trace,
             )
             if not isinstance(result, tuple):  # list/generator = decoupled
                 if hasattr(result, "close"):
@@ -382,18 +406,30 @@ class _Handlers:
                     status="400",
                 )
             response_json, blobs = result
-            return _dict_to_response(
+            response = _dict_to_response(
                 request.model_name, request.model_version, response_json, blobs
             )
+            if trace is not None:
+                trace.event("RESPONSE_SENT")
+            return response
         except InferenceServerException as e:
+            if trace is not None:
+                trace.error = str(e)
             _abort(context, e)
+        finally:
+            if trace is not None:
+                self.engine.tracer.complete(trace)
 
     def ModelStreamInfer(self, request_iterator, context):
         for request in request_iterator:
+            trace = self._sample_trace(request, context)
+            if trace is not None:
+                trace.event("REQUEST_START")
             try:
                 req, binary = _request_to_dict(request)
                 result = self.engine.execute(
-                    request.model_name, request.model_version, req, binary
+                    request.model_name, request.model_version, req, binary,
+                    trace=trace,
                 )
                 # a decoupled result streams lazily (generator): each
                 # response reaches the wire as the model produces it
@@ -407,15 +443,24 @@ class _Handlers:
                             blobs,
                         )
                     )
+                if trace is not None:
+                    trace.event("RESPONSE_SENT")
             except InferenceServerException as e:
                 # ModelStreamInferResponse carries only a message string, so
                 # the status rides as a "[<status>] " prefix (str(e) form);
                 # the client strips it back into InferenceServerException.status
+                if trace is not None:
+                    trace.error = str(e)
                 err = pb.ModelStreamInferResponse(error_message=str(e))
                 err.infer_response.id = request.id
                 yield err
             except Exception as e:  # pragma: no cover - defensive
+                if trace is not None:
+                    trace.error = str(e)
                 yield pb.ModelStreamInferResponse(error_message=str(e))
+            finally:
+                if trace is not None:
+                    self.engine.tracer.complete(trace)
 
 
 class GrpcFrontend:

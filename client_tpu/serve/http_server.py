@@ -212,17 +212,11 @@ class _Handler(BaseHTTPRequestHandler):
             return self._send_json(eng.log_settings)
         if path == "/v2/trace/setting":
             settings = json.loads(self._post_body.decode("utf-8") or "{}")
-            eng.trace_settings.update(
-                {k: v for k, v in settings.items() if v is not None}
-            )
-            return self._send_json(eng.trace_settings)
+            return self._send_json(eng.update_trace_settings(settings))
         m = _MODEL_URI.match(path)
         if m and (m.group("rest") or "") == "/trace/setting":
             settings = json.loads(self._post_body.decode("utf-8") or "{}")
-            eng.trace_settings.update(
-                {k: v for k, v in settings.items() if v is not None}
-            )
-            return self._send_json(eng.trace_settings)
+            return self._send_json(eng.update_trace_settings(settings))
         if m and (m.group("rest") or "") == "/infer":
             return self._infer(
                 unquote(m.group("model")),
@@ -285,28 +279,52 @@ class _Handler(BaseHTTPRequestHandler):
         request, binary = _codec.parse_infer_request_body(
             body, int(header_length) if header_length is not None else None
         )
-        result = self.engine.execute(model, version, request, binary)
-        if not isinstance(result, tuple):  # decoupled stream (generator/list)
-            responses = list(result)  # consuming it releases its admission slot
-            if len(responses) != 1:
-                raise InferenceServerException(
-                    f"model '{model}' is decoupled; HTTP requires exactly one "
-                    f"response but got {len(responses)} — use gRPC streaming",
-                    status="400",
-                )
-            result = responses[0]
-        response_json, blobs = result
-        body, json_size = _codec.build_infer_response_body(response_json, blobs)
-        headers = {}
-        if json_size is not None:
-            headers["Inference-Header-Content-Length"] = str(json_size)
-        accept = (self.headers.get("Accept-Encoding") or "").lower()
-        for algo in ("gzip", "deflate"):
-            if algo in accept:
-                body = _codec.compress(body, algo)
-                headers["Content-Encoding"] = algo
-                break
-        return self._send(200, body, headers)
+        # request tracing: joins the client's trace id when the request
+        # carries a W3C traceparent header (see serve/tracing.py)
+        trace = self.engine.tracer.sample(
+            self.headers.get("traceparent"), model_name=model,
+            model_version=version, protocol="http",
+        )
+        if trace is not None:
+            trace.event("REQUEST_START")
+        try:
+            result = self.engine.execute(
+                model, version, request, binary, trace=trace
+            )
+            if not isinstance(result, tuple):  # decoupled (generator/list)
+                # consuming it releases its admission slot
+                responses = list(result)
+                if len(responses) != 1:
+                    raise InferenceServerException(
+                        f"model '{model}' is decoupled; HTTP requires exactly "
+                        f"one response but got {len(responses)} — use gRPC "
+                        "streaming",
+                        status="400",
+                    )
+                result = responses[0]
+            response_json, blobs = result
+            body, json_size = _codec.build_infer_response_body(
+                response_json, blobs
+            )
+            headers = {}
+            if json_size is not None:
+                headers["Inference-Header-Content-Length"] = str(json_size)
+            accept = (self.headers.get("Accept-Encoding") or "").lower()
+            for algo in ("gzip", "deflate"):
+                if algo in accept:
+                    body = _codec.compress(body, algo)
+                    headers["Content-Encoding"] = algo
+                    break
+            self._send(200, body, headers)
+            if trace is not None:
+                trace.event("RESPONSE_SENT")
+        except Exception as e:
+            if trace is not None:
+                trace.error = str(e)
+            raise
+        finally:
+            if trace is not None:
+                self.engine.tracer.complete(trace)
 
 
 class HttpFrontend:

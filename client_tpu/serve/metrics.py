@@ -2,23 +2,237 @@
 
 The TPU-native analog of Triton's GPU metrics endpoint (the reference's
 MetricsManager scrapes ``nv_gpu_utilization`` / ``nv_gpu_memory_*`` from the
-server's /metrics — reference metrics_manager.h:44-91): per-model inference
-counters and durations from the engine's statistics, plus per-TPU-device HBM
-usage via ``device.memory_stats()`` where the PJRT runtime exposes it (the
-tunneled axon platform reports none; real TPU VMs report bytes_in_use /
-bytes_limit).
+server's /metrics — reference metrics_manager.h:44-91), grown into the full
+observability surface:
+
+- per-model counters (success/failure/inference counts, success AND failure
+  cumulative durations, the per-phase queue/compute_input/compute_infer/
+  compute_output breakdown the statistics extension measures),
+- per-model latency **histograms** (request duration, queue time) and the
+  batch-size distribution,
+- live gauges (batcher queue depth per model, in-flight requests, draining),
+- resilience counters (requests shed with retryable 503s, drain events) and
+  — when clients in this process attach a :class:`ResilienceMetricsObserver`
+  to their retry policy / circuit breaker — client-side retry counters and
+  per-endpoint circuit state,
+- per-TPU-device HBM usage via ``device.memory_stats()`` where the PJRT
+  runtime exposes it.
+
+Every label value passes through :func:`escape_label`: the exposition format
+reserves ``\\``, ``"`` and newline inside quoted label values, and a model
+name containing any of them must not corrupt the whole scrape.
 """
 
+import bisect
+import threading
 import time
 
+from client_tpu.utils import escape_label  # noqa: F401  (canonical re-export)
 
-def _device_lines(lines):
+# Request/queue duration buckets (microseconds) and batch-size buckets.
+DURATION_BUCKETS_US = (
+    50, 100, 250, 500, 1000, 2500, 5000, 10000, 25000, 50000,
+    100000, 250000, 500000, 1000000, 2500000, 10000000,
+)
+BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128)
+
+# CircuitBreaker state -> gauge value (closed/half-open/open).
+CIRCUIT_STATE_VALUES = {"closed": 0, "half-open": 1, "open": 2}
+
+
+def format_labels(labels):
+    """{'model': 'm'} -> '{model="m"}' with every value escaped."""
+    if not labels:
+        return ""
+    inner = ",".join(
+        f'{k}="{escape_label(v)}"' for k, v in sorted(labels.items())
+    )
+    return "{" + inner + "}"
+
+
+class Histogram:
+    """Fixed-bucket histogram (Prometheus semantics: cumulative buckets at
+    render time, plus sum and count).  Not internally locked — callers
+    (ModelStats) guard observations with their own lock."""
+
+    __slots__ = ("buckets", "counts", "sum", "count")
+
+    def __init__(self, buckets=DURATION_BUCKETS_US):
+        self.buckets = tuple(buckets)
+        self.counts = [0] * (len(self.buckets) + 1)  # +Inf tail
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value):
+        self.counts[bisect.bisect_left(self.buckets, value)] += 1
+        self.sum += value
+        self.count += 1
+
+    def snapshot(self):
+        """(bucket_bounds, cumulative_counts, sum, count)."""
+        cumulative = []
+        total = 0
+        for c in self.counts:
+            total += c
+            cumulative.append(total)
+        return self.buckets, cumulative, self.sum, self.count
+
+
+class Registry:
+    """Thread-safe counter/gauge registry rendering to exposition format.
+
+    One instance per engine holds server-side series (sheds, drain); the
+    module-level :data:`RESILIENCE` registry holds client-side series fed
+    by :class:`ResilienceMetricsObserver` so in-process clients' retry and
+    circuit activity is scrapeable from the same /metrics payload.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._families = {}  # name -> {"type","help","samples":{labels:v}}
+
+    def _family(self, name, type_, help_):
+        fam = self._families.get(name)
+        if fam is None:
+            fam = {"type": type_, "help": help_, "samples": {}}
+            self._families[name] = fam
+        return fam
+
+    def inc(self, name, labels=None, value=1, help_=""):
+        key = format_labels(labels)
+        with self._lock:
+            samples = self._family(name, "counter", help_)["samples"]
+            samples[key] = samples.get(key, 0) + value
+
+    def set(self, name, labels=None, value=0.0, help_=""):
+        key = format_labels(labels)
+        with self._lock:
+            self._family(name, "gauge", help_)["samples"][key] = value
+
+    def get(self, name, labels=None):
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None:
+                return None
+            return fam["samples"].get(format_labels(labels))
+
+    def render_into(self, lines):
+        with self._lock:
+            for name in sorted(self._families):
+                fam = self._families[name]
+                lines.append(f"# HELP {name} {fam['help'] or name}")
+                lines.append(f"# TYPE {name} {fam['type']}")
+                for labels, value in sorted(fam["samples"].items()):
+                    lines.append(f"{name}{labels} {_fmt(value)}")
+
+
+# Client-side resilience series (retries, circuit state) for clients
+# living in the same process as the server — the hermetic/in-process
+# deployment this framework's fake-server role serves.
+RESILIENCE = Registry()
+
+
+class ResilienceMetricsObserver:
+    """Adapter feeding resilience events into a metrics registry.
+
+    Attach one instance per endpoint as BOTH the retry-policy observer and
+    the circuit-breaker observer::
+
+        obs = ResilienceMetricsObserver("127.0.0.1:8000")
+        breaker = CircuitBreaker(observer=obs)
+        policy = RetryPolicy(circuit_breaker=breaker, observer=obs)
+    """
+
+    def __init__(self, endpoint, registry=None):
+        self.endpoint = endpoint
+        self.registry = registry if registry is not None else RESILIENCE
+        self.registry.set(
+            "ctpu_client_circuit_state", {"endpoint": endpoint}, 0,
+            help_="Circuit breaker state per endpoint "
+                  "(0=closed, 1=half-open, 2=open)",
+        )
+
+    # retry-policy hooks -----------------------------------------------------
+
+    def on_backoff(self, attempt, delay_s, exc):
+        self.registry.inc(
+            "ctpu_client_retries_total", {"endpoint": self.endpoint},
+            help_="Client retry attempts (one per backoff sleep)",
+        )
+
+    def on_giveup(self, attempt, exc):
+        self.registry.inc(
+            "ctpu_client_request_failures_total",
+            {"endpoint": self.endpoint},
+            help_="Client calls that exhausted their retry policy",
+        )
+
+    def on_success(self, attempt):
+        pass
+
+    # circuit-breaker hook ---------------------------------------------------
+
+    def on_state_change(self, old, new):
+        self.registry.set(
+            "ctpu_client_circuit_state", {"endpoint": self.endpoint},
+            CIRCUIT_STATE_VALUES.get(new, -1),
+            help_="Circuit breaker state per endpoint "
+                  "(0=closed, 1=half-open, 2=open)",
+        )
+        self.registry.inc(
+            "ctpu_client_circuit_transitions_total",
+            {"endpoint": self.endpoint, "to": new},
+            help_="Circuit breaker state transitions",
+        )
+
+
+def _fmt(value):
+    if isinstance(value, float) and not value.is_integer():
+        return f"{value:.6f}"
+    return str(int(value))
+
+
+class _FamilyBuffer:
+    """Groups samples per metric family so the exposition output keeps all
+    lines of one family contiguous (required by the text format — parsers
+    keying families by name reject or drop interleaved groups)."""
+
+    def __init__(self):
+        self._families = {}  # name -> [type, help, [sample lines]]
+
+    def declare(self, name, type_, help_):
+        self._families.setdefault(name, [type_, help_, []])
+
+    def add(self, name, labels, value):
+        self._families[name][2].append(
+            f"{name}{format_labels(labels)} {_fmt(value)}"
+        )
+
+    def add_raw(self, name, line):
+        self._families[name][2].append(line)
+
+    def emit(self, lines):
+        for name, (type_, help_, samples) in self._families.items():
+            lines.append(f"# HELP {name} {help_}")
+            lines.append(f"# TYPE {name} {type_}")
+            lines.extend(samples)
+
+
+def _device_lines(buf):
+    # Only report devices when jax is already loaded: a server actually
+    # serving jax models has it imported; forcing the import (and backend
+    # init — seconds) inside the /metrics handler would stall the first
+    # scrape of every numpy-only server past typical scraper timeouts.
+    import sys
+
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return
     try:
-        import jax
-
         devices = jax.devices()
     except Exception:
         return
+    declared = False
     for d in devices:
         try:
             stats = d.memory_stats()
@@ -26,72 +240,164 @@ def _device_lines(lines):
             stats = None
         if not stats:
             continue
-        labels = f'{{device="{d.id}",kind="{d.device_kind}"}}'
+        labels = {"device": d.id, "kind": d.device_kind}
         used = stats.get("bytes_in_use")
         limit = stats.get("bytes_limit") or stats.get("bytes_reservable_limit")
         peak = stats.get("peak_bytes_in_use")
+        if not declared and (
+            used is not None or limit is not None or peak is not None
+        ):
+            declared = True
+            buf.declare(
+                "ctpu_tpu_memory_used_bytes", "gauge",
+                "Device HBM bytes in use",
+            )
+            buf.declare(
+                "ctpu_tpu_memory_total_bytes", "gauge",
+                "Device HBM byte capacity",
+            )
+            buf.declare(
+                "ctpu_tpu_memory_peak_bytes", "gauge",
+                "Peak device HBM bytes",
+            )
         if used is not None:
-            lines.append(
-                f"ctpu_tpu_memory_used_bytes{labels} {used}"
-            )
+            buf.add("ctpu_tpu_memory_used_bytes", labels, used)
         if limit is not None:
-            lines.append(
-                f"ctpu_tpu_memory_total_bytes{labels} {limit}"
-            )
+            buf.add("ctpu_tpu_memory_total_bytes", labels, limit)
         if peak is not None:
-            lines.append(
-                f"ctpu_tpu_memory_peak_bytes{labels} {peak}"
-            )
+            buf.add("ctpu_tpu_memory_peak_bytes", labels, peak)
+
+
+def _histogram_lines(buf, name, labels, snapshot):
+    buckets, cumulative, total, count = snapshot
+    for bound, c in zip(buckets, cumulative[:-1]):
+        le = format_labels(dict(labels, le=bound))
+        buf.add_raw(name, f"{name}_bucket{le} {c}")
+    inf = format_labels(dict(labels, le="+Inf"))
+    buf.add_raw(name, f"{name}_bucket{inf} {cumulative[-1]}")
+    lbl = format_labels(labels)
+    buf.add_raw(name, f"{name}_sum{lbl} {_fmt(total)}")
+    buf.add_raw(name, f"{name}_count{lbl} {count}")
+
+
+_COUNTER_HELP = [
+    ("ctpu_inference_request_success", "Successful inference requests"),
+    ("ctpu_inference_request_failure", "Failed inference requests"),
+    ("ctpu_inference_count", "Inferences performed (batch aware)"),
+    ("ctpu_inference_exec_count", "Model executions (batches count once)"),
+    ("ctpu_inference_duration_us",
+     "Cumulative successful request duration"),
+    ("ctpu_inference_fail_duration_us",
+     "Cumulative failed request duration"),
+    ("ctpu_inference_queue_duration_us",
+     "Cumulative scheduling-queue wait"),
+    ("ctpu_inference_compute_input_duration_us",
+     "Cumulative input-preparation time"),
+    ("ctpu_inference_compute_infer_duration_us",
+     "Cumulative model-execution time"),
+    ("ctpu_inference_compute_output_duration_us",
+     "Cumulative output-rendering time"),
+]
+
+_HISTOGRAM_HELP = [
+    ("ctpu_request_duration_us",
+     "Per-request end-to-end duration distribution"),
+    ("ctpu_queue_duration_us",
+     "Per-request dynamic-batcher queue-time distribution"),
+    ("ctpu_batch_size", "Execution batch-size (rows) distribution"),
+]
 
 
 def render_metrics(engine):
-    """The /metrics payload (Prometheus text exposition format)."""
-    lines = [
-        "# HELP ctpu_inference_request_success Successful inference requests",
-        "# TYPE ctpu_inference_request_success counter",
-        "# HELP ctpu_inference_request_failure Failed inference requests",
-        "# TYPE ctpu_inference_request_failure counter",
-        "# HELP ctpu_inference_count Inferences performed (batch aware)",
-        "# TYPE ctpu_inference_count counter",
-        "# HELP ctpu_inference_duration_us Cumulative request duration",
-        "# TYPE ctpu_inference_duration_us counter",
-        "# HELP ctpu_tpu_memory_used_bytes Device HBM bytes in use",
-        "# TYPE ctpu_tpu_memory_used_bytes gauge",
-        "# HELP ctpu_server_busy_ns Wall-clock ns with >=1 model execution in"
-        " flight (duty cycle: rate(ctpu_server_busy_ns)/1e9 = utilization)",
-        "# TYPE ctpu_server_busy_ns counter",
-    ]
+    """The /metrics payload (Prometheus text exposition format).
+
+    All samples of one metric family are emitted as a single contiguous
+    block (HELP/TYPE then every sample) — the text format requires it, and
+    family-keyed parsers drop or reject interleaved groups."""
+    buf = _FamilyBuffer()
+    for name, help_ in _COUNTER_HELP:
+        buf.declare(name, "counter", help_)
     stats = engine.statistics()
     # engine.statistics() returns the HTTP-format bare list of model entries
     model_stats = stats if isinstance(stats, list) else stats.get(
         "model_stats", []
     )
     for ms in model_stats:
-        model = ms.get("name", "")
-        version = ms.get("version", "")
-        labels = f'{{model="{model}",version="{version}"}}'
+        labels = {"model": ms.get("name", ""), "version": ms.get("version", "")}
         agg = ms.get("inference_stats", {})
         success = agg.get("success", {})
         fail = agg.get("fail", {})
-        lines.append(
-            f"ctpu_inference_request_success{labels} "
-            f"{int(success.get('count', 0))}"
+        buf.add(
+            "ctpu_inference_request_success", labels,
+            int(success.get("count", 0)),
         )
-        lines.append(
-            f"ctpu_inference_request_failure{labels} "
-            f"{int(fail.get('count', 0))}"
+        buf.add(
+            "ctpu_inference_request_failure", labels,
+            int(fail.get("count", 0)),
         )
-        lines.append(
-            f"ctpu_inference_count{labels} "
-            f"{int(ms.get('inference_count', 0))}"
+        buf.add("ctpu_inference_count", labels, int(ms.get("inference_count", 0)))
+        buf.add(
+            "ctpu_inference_exec_count", labels,
+            int(ms.get("execution_count", 0)),
         )
-        lines.append(
-            f"ctpu_inference_duration_us{labels} "
-            f"{int(success.get('ns', 0)) // 1000}"
+        buf.add(
+            "ctpu_inference_duration_us", labels,
+            int(success.get("ns", 0)) // 1000,
         )
-    _device_lines(lines)
+        buf.add(
+            "ctpu_inference_fail_duration_us", labels,
+            int(fail.get("ns", 0)) // 1000,
+        )
+        for phase in ("queue", "compute_input", "compute_infer",
+                      "compute_output"):
+            buf.add(
+                f"ctpu_inference_{phase}_duration_us", labels,
+                int(agg.get(phase, {}).get("ns", 0)) // 1000,
+            )
+    # per-model histograms (request/queue durations, batch sizes)
+    for name, help_ in _HISTOGRAM_HELP:
+        buf.declare(name, "histogram", help_)
+    for name, version, model_stats_obj in engine.stats_objects():
+        labels = {"model": name, "version": version}
+        request_us, queue_us, batch_rows = model_stats_obj.histograms()
+        _histogram_lines(buf, "ctpu_request_duration_us", labels, request_us)
+        _histogram_lines(buf, "ctpu_queue_duration_us", labels, queue_us)
+        _histogram_lines(buf, "ctpu_batch_size", labels, batch_rows)
+    # live gauges: scheduler queue depth, in-flight work, drain state
+    buf.declare(
+        "ctpu_queue_depth", "gauge",
+        "Requests waiting in the dynamic batcher",
+    )
+    for name, depth in sorted(engine.queue_depths().items()):
+        buf.add("ctpu_queue_depth", {"model": name}, depth)
+    buf.declare(
+        "ctpu_inflight_requests", "gauge", "Requests currently executing"
+    )
+    buf.add("ctpu_inflight_requests", None, engine.inflight_count())
+    buf.declare("ctpu_draining", "gauge", "1 once graceful drain has begun")
+    buf.add("ctpu_draining", None, 0 if engine.ready() else 1)
+    _device_lines(buf)
     busy = getattr(engine, "busy", None)
     if busy is not None:
-        lines.append(f"ctpu_server_busy_ns {busy.busy_ns()}")
-    lines.append(f"ctpu_scrape_timestamp_seconds {time.time():.3f}")
+        buf.declare(
+            "ctpu_server_busy_ns", "counter",
+            "Wall-clock ns with >=1 model execution in flight (duty cycle: "
+            "rate(ctpu_server_busy_ns)/1e9 = utilization)",
+        )
+        buf.add("ctpu_server_busy_ns", None, busy.busy_ns())
+    buf.declare(
+        "ctpu_scrape_timestamp_seconds", "gauge",
+        "Wall time of this scrape",
+    )
+    buf.add_raw(
+        "ctpu_scrape_timestamp_seconds",
+        f"ctpu_scrape_timestamp_seconds {time.time():.3f}",
+    )
+    lines = []
+    buf.emit(lines)
+    # engine-side resilience counters (sheds, drain events) + any client
+    # resilience series registered in this process — each registry renders
+    # its families as contiguous blocks of its own
+    engine.metrics.render_into(lines)
+    RESILIENCE.render_into(lines)
     return "\n".join(lines) + "\n"

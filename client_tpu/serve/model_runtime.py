@@ -29,6 +29,16 @@ from client_tpu.utils import (
 )
 from client_tpu._infer_types import _np_from_json_data
 from client_tpu.serve._completion import CompletionObserver
+from client_tpu.serve.metrics import (
+    BATCH_BUCKETS,
+    Histogram,
+    Registry,
+)
+from client_tpu.serve.tracing import (
+    TRACE_SETTING_DEFAULTS,
+    Tracer,
+    normalize_trace_settings,
+)
 
 SERVER_NAME = "client_tpu.serve"
 SERVER_VERSION = "0.1.0"
@@ -220,9 +230,16 @@ class ModelStats:
         self.compute_input_ns = 0
         self.compute_output_ns = 0
         self.queue_ns = 0
+        # distributions behind the /metrics histograms: per-request
+        # end-to-end duration (success AND failure), per-request batcher
+        # queue time, and execution batch size
+        self.request_us = Histogram()
+        self.queue_us = Histogram()
+        self.batch_rows = Histogram(BATCH_BUCKETS)
 
     def record(self, ok, total_ns, infer_ns, input_ns, output_ns, batch=1):
         with self.lock:
+            self.request_us.observe(total_ns / 1000)
             if ok:
                 self.inference_count += batch
                 self.execution_count += 1
@@ -231,12 +248,14 @@ class ModelStats:
                 self.compute_infer_ns += infer_ns
                 self.compute_input_ns += input_ns
                 self.compute_output_ns += output_ns
+                self.batch_rows.observe(batch)
                 self.last_inference_ms = int(time.time() * 1000)
             else:
                 self.fail_count += 1
                 self.fail_ns += total_ns
 
-    def record_batched(self, rows, infer_ns, input_ns, output_ns, queue_ns):
+    def record_batched(self, rows, infer_ns, input_ns, output_ns, queue_ns,
+                       queue_ns_each=None):
         """One dynamic-batched execution.  Per-request success outcomes are
         recorded separately by record_request_success once rendering finishes;
         failures go through record(False, ...) in execute()."""
@@ -247,6 +266,9 @@ class ModelStats:
             self.compute_input_ns += input_ns
             self.compute_output_ns += output_ns
             self.queue_ns += queue_ns
+            self.batch_rows.observe(rows)
+            for q_ns in queue_ns_each or ():
+                self.queue_us.observe(q_ns / 1000)
             self.last_inference_ms = int(time.time() * 1000)
 
     def record_request_success(self, total_ns):
@@ -256,6 +278,16 @@ class ModelStats:
         with self.lock:
             self.success_count += 1
             self.success_ns += total_ns
+            self.request_us.observe(total_ns / 1000)
+
+    def histograms(self):
+        """Snapshots of (request_us, queue_us, batch_rows) for /metrics."""
+        with self.lock:
+            return (
+                self.request_us.snapshot(),
+                self.queue_us.snapshot(),
+                self.batch_rows.snapshot(),
+            )
 
     def to_json(self, name, version):
         with self.lock:
@@ -671,12 +703,14 @@ class InferenceEngine:
         self._sequences = {}
         self.max_sequence_idle_s = max_sequence_idle_s
         self.trace_settings = {
-            "trace_file": "",
-            "trace_level": ["OFF"],
-            "trace_rate": "1000",
-            "trace_count": "-1",
-            "log_frequency": "0",
+            k: (list(v) if isinstance(v, list) else v)
+            for k, v in TRACE_SETTING_DEFAULTS.items()
         }
+        # request tracing (trace extension) + resilience counters: the
+        # tracer reads trace_settings live; the registry collects shed and
+        # drain counters for /metrics
+        self.tracer = Tracer(self.trace_settings)
+        self.metrics = Registry()
         self.log_settings = {
             "log_file": "",
             "log_info": True,
@@ -785,6 +819,38 @@ class InferenceEngine:
                 )
             return stats
 
+    def stats_objects(self):
+        """(name, version, ModelStats) per model, for /metrics histograms."""
+        with self._lock:
+            return [
+                (n, model.versions[-1], self._stats[n])
+                for n, model in sorted(self._models.items())
+            ]
+
+    # observability: trace settings / live gauges ----------------------------
+
+    def update_trace_settings(self, updates):
+        """Apply a trace-settings update through the canonical schema (the
+        single normalization point both frontends share — see
+        serve/tracing.normalize_trace_settings) and return the settings."""
+        normalized = normalize_trace_settings(updates)
+        with self._lock:
+            self.trace_settings.update(normalized)
+        if "trace_count" in normalized:
+            # the reference trace API restarts the budget on update
+            self.tracer.reset_budget()
+        return self.trace_settings
+
+    def queue_depths(self):
+        """Dynamic-batcher queue depth per model (live gauge)."""
+        with self._lock:
+            batchers = dict(self._batchers)
+        return {name: b.queue_depth() for name, b in batchers.items()}
+
+    def inflight_count(self):
+        with self._flight_cv:
+            return self._inflight
+
     # lifecycle: readiness / drain ------------------------------------------
 
     def ready(self):
@@ -801,6 +867,10 @@ class InferenceEngine:
         deadline = (
             time.monotonic() + timeout_s if timeout_s is not None else None
         )
+        self.metrics.inc(
+            "ctpu_drain_total",
+            help_="Graceful drains initiated",
+        )
         with self._flight_cv:
             self._draining = True
             while self._inflight:
@@ -814,19 +884,33 @@ class InferenceEngine:
 
     def _admit(self):
         """One request enters execution, or is shed with a retryable 503."""
+        shed_reason = None
         with self._flight_cv:
             if self._draining:
-                raise InferenceServerException(
-                    "server is draining and not accepting new requests",
-                    status="503",
-                )
-            if self.max_inflight is not None and self._inflight >= self.max_inflight:
-                raise InferenceServerException(
-                    f"server overloaded: {self._inflight} requests in flight "
-                    f"(limit {self.max_inflight}); retry after backoff",
-                    status="503",
-                )
-            self._inflight += 1
+                shed_reason = "draining"
+            elif (
+                self.max_inflight is not None
+                and self._inflight >= self.max_inflight
+            ):
+                shed_reason = "overload"
+            else:
+                self._inflight += 1
+        if shed_reason is None:
+            return
+        self.metrics.inc(
+            "ctpu_requests_shed_total", {"reason": shed_reason},
+            help_="Requests shed with a retryable 503",
+        )
+        if shed_reason == "draining":
+            raise InferenceServerException(
+                "server is draining and not accepting new requests",
+                status="503",
+            )
+        raise InferenceServerException(
+            f"server overloaded: {self._inflight} requests in flight "
+            f"(limit {self.max_inflight}); retry after backoff",
+            status="503",
+        )
 
     def _release(self):
         with self._flight_cv:
@@ -835,18 +919,23 @@ class InferenceEngine:
 
     # execution ------------------------------------------------------------
 
-    def execute(self, model_name, model_version, request, binary_section):
+    def execute(self, model_name, model_version, request, binary_section,
+                trace=None):
         """Run one inference request through admission control.
 
         *request* is the JSON-form header dict; *binary_section* the raw bytes
         after the header. Returns (response_dict, binary_blobs) — for decoupled
-        models, a list of such tuples.
+        models, a list of such tuples.  *trace* is an optional RequestTrace
+        the frontend sampled; the engine (and the dynamic batcher) record the
+        queue/compute timeline onto it.
         """
+        if trace is not None:
+            trace.event("QUEUE_START")
         self._admit()
         streamed = False
         try:
             result = self._execute_admitted(
-                model_name, model_version, request, binary_section
+                model_name, model_version, request, binary_section, trace
             )
             if not isinstance(result, (tuple, list)):  # decoupled generator
                 streamed = True
@@ -859,39 +948,58 @@ class InferenceEngine:
             if not streamed:
                 self._release()
 
-    def _execute_admitted(self, model_name, model_version, request, binary_section):
+    def _execute_admitted(self, model_name, model_version, request,
+                          binary_section, trace=None):
         model = self.get_model(model_name, model_version)
         stats = self._stats[model_name]
         t0 = time.monotonic_ns()
         try:
             t_in0 = time.monotonic_ns()
+            # trace timestamps use the wall clock (comparable with client
+            # spans); queue/compute events are emitted once the scheduling
+            # path is known — the batcher owns them on the batched path
+            w_in0 = time.time_ns() if trace is not None else 0
             inputs = self._gather_inputs(model, request, binary_section)
             params = request.get("parameters", {}) or {}
             context = self._sequence_context(params)
             t_in1 = time.monotonic_ns()
+            w_in1 = time.time_ns() if trace is not None else 0
             if model.ensemble_steps:
+                if trace is not None:
+                    trace.event("QUEUE_END", w_in0)
+                    trace.event("COMPUTE_START", w_in0)
+                    trace.event("COMPUTE_INPUT_END", w_in1)
                 result = self._run_ensemble(model, inputs)
                 t_inf1 = time.monotonic_ns()
+                if trace is not None:
+                    trace.event("COMPUTE_OUTPUT_START")
                 rendered = self._render_response(
                     model, model_version, request, result
                 )
                 t1 = time.monotonic_ns()
+                if trace is not None:
+                    trace.event("COMPUTE_END")
                 stats.record(
                     True, t1 - t0, t_inf1 - t_in1, t_in1 - t_in0, t1 - t_inf1,
                     batch=_batch_of(model, request),
                 )
                 return rendered
             if _batchable_request(model, inputs, params, context, request):
-                # The batcher records execution-level statistics; per-request
-                # success is recorded here, and any failure (batched execution
-                # or rendering) falls through to the except clauses below so
-                # it is counted exactly once.
-                result = self._batcher_for(model).submit(inputs)
+                # The batcher records execution-level statistics (and the
+                # trace's QUEUE_END/COMPUTE_* events at dispatch/completion);
+                # per-request success is recorded here, and any failure
+                # (batched execution or rendering) falls through to the
+                # except clauses below so it is counted exactly once.
+                result = self._batcher_for(model).submit(inputs, trace=trace)
                 rendered = self._render_response(
                     model, model_version, request, result
                 )
                 stats.record_request_success(time.monotonic_ns() - t0)
                 return rendered
+            if trace is not None:
+                trace.event("QUEUE_END", w_in0)
+                trace.event("COMPUTE_START", w_in0)
+                trace.event("COMPUTE_INPUT_END", w_in1)
             if model.decoupled:
                 # LAZY stream: responses render as the model produces them,
                 # so the first token reaches the wire at first-token time —
@@ -900,7 +1008,7 @@ class InferenceEngine:
                 # driven decode steps over a tunneled chip = seconds).
                 return self._decoupled_stream(
                     model, model_version, request, inputs, params, context,
-                    stats, t0, t_in0, t_in1,
+                    stats, t0, t_in0, t_in1, trace,
                 )
             # Direct path: the busy span opens at dispatch and is closed by
             # the observer at device completion (async results) or right
@@ -911,6 +1019,8 @@ class InferenceEngine:
             try:
                 result = model.fn(inputs, params, context)
                 t_inf1 = time.monotonic_ns()
+                if trace is not None:
+                    trace.event("COMPUTE_OUTPUT_START")
                 rendered = self._render_response(
                     model, model_version, request, result
                 )
@@ -920,6 +1030,8 @@ class InferenceEngine:
                 if not watched:
                     self.busy.end()
             t1 = time.monotonic_ns()
+            if trace is not None:
+                trace.event("COMPUTE_END")
             stats.record(
                 True, t1 - t0, t_inf1 - t_in1, t_in1 - t_in0, t1 - t_inf1,
                 batch=_batch_of(model, request),
@@ -935,7 +1047,8 @@ class InferenceEngine:
             ) from e
 
     def _decoupled_stream(self, model, model_version, request, inputs,
-                          params, context, stats, t0, t_in0, t_in1):
+                          params, context, stats, t0, t_in0, t_in1,
+                          trace=None):
         """Generator of (response_dict, blobs) for a decoupled model.
 
         Exactly one statistics entry per request: success at exhaustion,
@@ -982,6 +1095,8 @@ class InferenceEngine:
                     final["id"] = request["id"]
                 yield final, []
             t1 = time.monotonic_ns()
+            if trace is not None:
+                trace.event("COMPUTE_END")
             stats.record(True, t1 - t0, t1 - t_in1, t_in1 - t_in0, 0)
             recorded = True
         except InferenceServerException:
@@ -1064,6 +1179,7 @@ class InferenceEngine:
                     max_queue_delay_s=model.max_queue_delay_us / 1e6,
                     busy=self.busy,
                     max_queue_depth=model.max_queue_depth,
+                    registry=self.metrics,
                 )
                 self._batchers[model.name] = batcher
             return batcher
@@ -1274,6 +1390,7 @@ class InferenceEngine:
                 except Exception:
                     pass
         self._busy_observer.close()
+        self.tracer.flush()  # buffered trace records reach trace_file
         self.shm.close()
 
 

@@ -1,0 +1,230 @@
+"""Server-side request tracing: per-request timelines behind trace_settings.
+
+The server half of the end-to-end tracing subsystem (the client half —
+traceparent generation and client spans — lives in ``client_tpu.tracing``).
+The engine owns one :class:`Tracer`; the HTTP/gRPC frontends sample a
+:class:`RequestTrace` per inference request (joining the client's trace id
+when a ``traceparent`` header/metadata entry arrives) and the engine and
+dynamic batcher record the timeline:
+
+    REQUEST_START -> QUEUE_START -> QUEUE_END -> COMPUTE_START ->
+    COMPUTE_INPUT_END -> COMPUTE_OUTPUT_START -> COMPUTE_END ->
+    RESPONSE_SENT
+
+(the timestamp names Triton's trace API emits for its queue/compute
+breakdown; batched requests get their QUEUE_END/COMPUTE_* from the
+batcher at dispatch/completion time).
+
+Sampling honors the engine's ``trace_settings`` exactly as the reference
+trace extension defines them: ``trace_level`` ([\"OFF\"] disables),
+``trace_rate`` (trace the first of every N requests), ``trace_count``
+(budget of traces, -1 unlimited, resets when updated), ``trace_file``
+(JSON-lines export, one Triton-shaped record per trace) and
+``log_frequency`` (buffer N records between file flushes; 0 flushes per
+trace).
+"""
+
+import collections
+import threading
+
+from client_tpu.tracing import (
+    append_trace_record,
+    format_traceparent,
+    gen_span_id,
+    gen_trace_id,
+    parse_traceparent,
+)
+from client_tpu.tracing import ClientTrace as _SpanBase
+from client_tpu.utils import InferenceServerException
+
+__all__ = [
+    "RequestTrace",
+    "Tracer",
+    "TRACE_SETTING_DEFAULTS",
+    "normalize_trace_settings",
+]
+
+TRACE_LEVELS = ("OFF", "TIMESTAMPS", "TENSORS")
+
+TRACE_SETTING_DEFAULTS = {
+    "trace_file": "",
+    "trace_level": ["OFF"],
+    "trace_rate": "1000",
+    "trace_count": "-1",
+    "log_frequency": "0",
+}
+
+_INT_KEYS = ("trace_rate", "trace_count", "log_frequency")
+
+
+def normalize_trace_settings(updates):
+    """Canonicalize a trace-settings update to the wire schema both
+    protocols round-trip: ``trace_level`` is a list of level names,
+    every numeric setting is the decimal *string* of an int, and
+    ``trace_file`` is a string.  Raises a 400 on malformed values so a
+    bad update is rejected rather than half-applied."""
+    normalized = {}
+    for key, value in (updates or {}).items():
+        if value is None:
+            continue  # present-but-empty: leave the current value alone
+        if key == "trace_level":
+            levels = value if isinstance(value, (list, tuple)) else [value]
+            levels = [str(lv).upper() for lv in levels]
+            bad = [lv for lv in levels if lv not in TRACE_LEVELS]
+            if bad or not levels:
+                raise InferenceServerException(
+                    f"invalid trace_level {bad or levels}: levels are "
+                    f"{list(TRACE_LEVELS)}",
+                    status="400",
+                )
+            normalized[key] = levels
+        elif key in _INT_KEYS:
+            if isinstance(value, (list, tuple)):
+                value = value[0] if value else ""
+            try:
+                normalized[key] = str(int(str(value)))
+            except ValueError:
+                raise InferenceServerException(
+                    f"invalid {key} {value!r}: expected an integer",
+                    status="400",
+                ) from None
+        elif key == "trace_file":
+            if isinstance(value, (list, tuple)):
+                value = value[0] if value else ""
+            normalized[key] = str(value)
+        else:
+            raise InferenceServerException(
+                f"unknown trace setting {key!r}", status="400"
+            )
+    return normalized
+
+
+class RequestTrace(_SpanBase):
+    """One traced server-side request (a span joined to the client's
+    trace id when the request carried a traceparent)."""
+
+    def __init__(self, trace_id, span_id, parent_span_id=None,
+                 model_name="", model_version="", protocol="", seq=0):
+        super().__init__(trace_id, span_id, model_name)
+        self.parent_span_id = parent_span_id
+        self.model_version = model_version
+        self.protocol = protocol
+        self.seq = seq
+
+    def traceparent(self):
+        return format_traceparent(self.trace_id, self.span_id)
+
+    def to_json(self):
+        record = {
+            "id": self.seq,
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_span_id": self.parent_span_id,
+            "source": "server",
+            "protocol": self.protocol,
+            "model_name": self.model_name,
+            "model_version": self.model_version,
+            "timestamps": list(self.timestamps),
+        }
+        if self.error:
+            record["error"] = self.error
+        return record
+
+
+class Tracer:
+    """Samples, collects, and exports per-request server traces.
+
+    Reads the engine's live ``trace_settings`` dict on every sample so
+    settings updates apply immediately; thread-safe (frontend handler
+    threads sample concurrently)."""
+
+    def __init__(self, settings, max_traces=1000):
+        self._settings = settings  # the engine's live trace_settings dict
+        self._lock = threading.Lock()
+        self._seen = 0
+        self._used = 0  # traces taken against the trace_count budget
+        self._seq = 0
+        self._pending_flush = []
+        self.completed = collections.deque(maxlen=max_traces)
+
+    def enabled(self):
+        levels = self._settings.get("trace_level") or ["OFF"]
+        return any(str(lv).upper() != "OFF" for lv in levels)
+
+    def reset_budget(self):
+        """Restart the trace_count budget (called when the setting is
+        updated, matching the reference trace API's count semantics)."""
+        with self._lock:
+            self._used = 0
+
+    @staticmethod
+    def _int_setting(settings, key, default):
+        try:
+            return int(str(settings.get(key, default)))
+        except (TypeError, ValueError):
+            return default
+
+    def sample(self, traceparent=None, model_name="", model_version="",
+               protocol=""):
+        """A RequestTrace for this request, or None (tracing off, request
+        not sampled, or budget exhausted)."""
+        if not self.enabled():
+            return None
+        rate = max(self._int_setting(self._settings, "trace_rate", 1), 1)
+        count = self._int_setting(self._settings, "trace_count", -1)
+        with self._lock:
+            seen = self._seen
+            self._seen += 1
+            if seen % rate:
+                return None
+            if 0 <= count <= self._used:
+                return None
+            self._used += 1
+            self._seq += 1
+            seq = self._seq
+        parent = parse_traceparent(traceparent)
+        if parent is not None:
+            trace_id, parent_span = parent
+        else:
+            trace_id, parent_span = gen_trace_id(), None
+        return RequestTrace(
+            trace_id, gen_span_id(), parent_span_id=parent_span,
+            model_name=model_name, model_version=model_version,
+            protocol=protocol, seq=seq,
+        )
+
+    def complete(self, trace):
+        """Record a finished trace; export per log_frequency."""
+        if trace is None:
+            return
+        trace_file = self._settings.get("trace_file") or ""
+        log_frequency = max(
+            self._int_setting(self._settings, "log_frequency", 0), 0
+        )
+        to_write = []
+        with self._lock:
+            self.completed.append(trace)
+            if trace_file:
+                self._pending_flush.append(trace.to_json())
+                if len(self._pending_flush) >= max(log_frequency, 1):
+                    to_write = self._pending_flush
+                    self._pending_flush = []
+        self._write(trace_file, to_write)
+
+    def flush(self):
+        """Force any buffered records to the trace file (engine close)."""
+        trace_file = self._settings.get("trace_file") or ""
+        with self._lock:
+            to_write = self._pending_flush
+            self._pending_flush = []
+        self._write(trace_file, to_write)
+
+    @staticmethod
+    def _write(trace_file, records):
+        if not trace_file or not records:
+            return
+        try:
+            for record in records:
+                append_trace_record(trace_file, record)
+        except OSError:
+            pass  # tracing must never fail the request path

@@ -28,6 +28,7 @@ __all__ = [
     "raise_error",
     "np_to_triton_dtype",
     "triton_to_np_dtype",
+    "escape_label",
     "serialize_byte_tensor",
     "deserialize_bytes_tensor",
     "serialize_bf16_tensor",
@@ -71,6 +72,20 @@ class InferenceServerException(Exception):
 def raise_error(msg):
     """Raise an InferenceServerException with *msg* and no status."""
     raise InferenceServerException(msg=msg)
+
+
+def escape_label(value):
+    """Escape a Prometheus label value (backslash, quote, newline).
+
+    Lives here (a leaf module both halves already import) so the server's
+    /metrics renderer and the client-side perf scraper share one escaper
+    without perf pulling in the serving stack."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
 
 
 # KServe-v2 datatype string <-> numpy dtype tables. The wire names are the

@@ -1,0 +1,14 @@
+"""METRIC-LABEL fixture: the pre-fix serve/metrics.py label rendering —
+model/version names interpolated into label positions unescaped, so a
+model named ``evil"name`` corrupts the whole /metrics payload."""
+
+
+def render_model_lines(model, version, count):
+    lines = []
+    labels = f'{{model="{model}",version="{version}"}}'
+    lines.append(f"ctpu_inference_request_success{labels} {count}")
+    return lines
+
+
+def render_device_line(device_id, used):
+    return f'ctpu_tpu_memory_used_bytes{{device="{device_id}"}} {used}'

@@ -1,0 +1,18 @@
+"""METRIC-LABEL clean twin: label values pass through escape_label();
+interpolations OUTSIDE label positions (sample values, metric suffixes)
+are untouched by the rule."""
+
+from client_tpu.serve.metrics import escape_label
+
+
+def render_model_lines(model, version, count):
+    lines = []
+    labels = f'{{model="{escape_label(model)}",version="{escape_label(version)}"}}'
+    # value position (after the closing brace) needs no escaping
+    lines.append(f"ctpu_inference_request_success{labels} {count}")
+    return lines
+
+
+def render_plain(name, value):
+    # no label position at all: plain interpolation stays clean
+    return f"ctpu_{name}_total {value}"

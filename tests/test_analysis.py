@@ -28,9 +28,9 @@ def _rules_hit(findings):
 def test_registry_has_all_rules():
     assert set(REGISTRY) >= {
         "NPY-TRUTH", "ASYNC-BLOCK", "LOCK-DISPATCH", "QUEUE-SENTINEL",
-        "CV-WAIT-LOOP", "SHARED-MUT", "TIME-WALL",
+        "CV-WAIT-LOOP", "SHARED-MUT", "TIME-WALL", "METRIC-LABEL",
     }
-    assert len(REGISTRY) >= 7
+    assert len(REGISTRY) >= 8
     for rule in REGISTRY.values():
         assert rule.rationale  # every rule documents its motivating bug
 
@@ -127,6 +127,33 @@ def test_time_wall_hits():
 def test_time_wall_clean():
     # monotonic deadlines and wall-clock *timestamps* both scan clean
     assert _scan("time_wall_ok.py") == []
+
+
+def test_metric_label_hits():
+    """The rule is proven against the pre-fix serve/metrics.py shape:
+    model/version/device names interpolated into label positions without
+    the escape helper."""
+    findings = _scan("metric_label_bad.py")
+    assert _rules_hit(findings) == ["METRIC-LABEL"]
+    # one per offending line (core reports one finding per rule+line):
+    # the model/version labels f-string and the device-id one
+    assert len(findings) == 2
+    messages = " ".join(f.message for f in findings)
+    assert "model" in messages and "device_id" in messages
+
+
+def test_metric_label_clean():
+    # escape_label()-wrapped label values and non-label interpolations
+    # (sample values, metric name suffixes) both scan clean
+    assert _scan("metric_label_ok.py") == []
+
+
+def test_current_metrics_module_passes_metric_label():
+    """The post-fix metrics renderer is the motivating module: every label
+    value goes through escape_label()."""
+    assert scan_paths(
+        [str(ROOT / "client_tpu" / "serve" / "metrics.py")]
+    ) == []
 
 
 def test_current_continuous_passes_every_rule():

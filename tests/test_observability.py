@@ -1,0 +1,560 @@
+"""End-to-end tracing + metrics surface (the observability layer).
+
+Covers:
+- the full /metrics payload through a small text-exposition parser
+  (HELP/TYPE for every sample family, label escaping for hostile model
+  names, monotonic counters across requests, histograms, gauges),
+- one traced inference through each frontend producing client + server
+  spans under a single shared trace id with properly ordered timestamps,
+- trace_rate sampling, trace_count exhaustion, and the disabled default
+  (no trace file, no samples),
+- trace_settings schema fidelity over both protocols,
+- resilience instrumentation: shed/drain counters, retry attempt spans,
+  and the RetryPolicy/CircuitBreaker observer hooks feeding the registry.
+"""
+
+import re
+import urllib.request
+
+import numpy as np
+import pytest
+
+import client_tpu.grpc as grpcclient
+import client_tpu.http as httpclient
+from client_tpu import resilience
+from client_tpu.serve import Model, Server, TensorSpec
+from client_tpu.serve.metrics import (
+    Registry,
+    ResilienceMetricsObserver,
+    escape_label,
+    render_metrics,
+)
+from client_tpu.tracing import ClientTracer, parse_traceparent, read_trace_file
+from client_tpu.utils import InferenceServerException
+
+NASTY = 'evil"model\\rogue'  # quote + backslash in a label value
+
+
+def _nasty_model():
+    def fn(inputs, params, ctx):
+        return {"OUT": inputs["IN"]}
+
+    return Model(
+        NASTY,
+        inputs=[TensorSpec("IN", "FP32", [-1])],
+        outputs=[TensorSpec("OUT", "FP32", [-1])],
+        fn=fn,
+    )
+
+
+def _infer_simple(client, n=1):
+    inputs = [
+        httpclient.InferInput("INPUT0", [1, 16], "INT32"),
+        httpclient.InferInput("INPUT1", [1, 16], "INT32"),
+    ]
+    inputs[0].set_data_from_numpy(np.ones((1, 16), np.int32))
+    inputs[1].set_data_from_numpy(np.ones((1, 16), np.int32))
+    for _ in range(n):
+        client.infer("simple", inputs)
+
+
+def _grpc_infer_simple(client, n=1):
+    inputs = [
+        grpcclient.InferInput("INPUT0", [1, 16], "INT32"),
+        grpcclient.InferInput("INPUT1", [1, 16], "INT32"),
+    ]
+    inputs[0].set_data_from_numpy(np.ones((1, 16), np.int32))
+    inputs[1].set_data_from_numpy(np.ones((1, 16), np.int32))
+    for _ in range(n):
+        client.infer("simple", inputs)
+
+
+# -- exposition-format parser ----------------------------------------------
+
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _unescape(value):
+    return (
+        value.replace("\\n", "\n").replace('\\"', '"').replace("\\\\", "\\")
+    )
+
+
+def parse_exposition(text):
+    """Prometheus text format -> {family: {help, type, samples}} where
+    samples is a list of (sample_name, labels_dict, float_value)."""
+    meta = {}
+    samples = []
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            name, help_ = line[len("# HELP "):].split(" ", 1)
+            meta.setdefault(name, {})["help"] = help_
+            continue
+        if line.startswith("# TYPE "):
+            name, type_ = line[len("# TYPE "):].split(" ", 1)
+            meta.setdefault(name, {})["type"] = type_
+            continue
+        assert not line.startswith("#"), f"unknown comment line: {line!r}"
+        name_part, _, value_part = line.rpartition(" ")
+        value = float(value_part)  # malformed lines fail loudly here
+        if "{" in name_part:
+            name, labels_part = name_part.split("{", 1)
+            assert labels_part.endswith("}"), f"unterminated labels: {line!r}"
+            labels = {
+                k: _unescape(v)
+                for k, v in _LABEL_RE.findall(labels_part[:-1])
+            }
+        else:
+            name, labels = name_part, {}
+        samples.append((name, labels, value))
+    families = {}
+    for name, labels, value in samples:
+        family = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[: -len(suffix)] in meta:
+                family = name[: -len(suffix)]
+                break
+        families.setdefault(family, {"samples": []})
+        families[family]["samples"].append((name, labels, value))
+    for family, info in families.items():
+        info.update(meta.get(family, {}))
+    return families
+
+
+def _scrape(server):
+    url = f"http://{server.http_address}/metrics"
+    return urllib.request.urlopen(url).read().decode()
+
+
+class TestMetricsSurface:
+    @pytest.fixture(scope="class")
+    def server(self):
+        with Server(models=[_nasty_model()], http_port=0, grpc_port=0) as s:
+            yield s
+
+    def test_every_sample_family_has_help_and_type(self, server):
+        families = parse_exposition(_scrape(server))
+        assert families  # payload is non-trivial
+        for family, info in families.items():
+            assert info.get("help"), f"{family} missing # HELP"
+            assert info.get("type"), f"{family} missing # TYPE"
+
+    def test_families_are_contiguous(self, server):
+        """All samples of one family form a single block — the exposition
+        format forbids interleaving families (family-keyed parsers drop or
+        reject split groups)."""
+        import itertools
+
+        text = _scrape(server)
+        meta = {
+            line.split(" ", 3)[2]
+            for line in text.splitlines()
+            if line.startswith("# TYPE ")
+        }
+
+        def family_of(sample_name):
+            for suffix in ("_bucket", "_sum", "_count"):
+                if (
+                    sample_name.endswith(suffix)
+                    and sample_name[: -len(suffix)] in meta
+                ):
+                    return sample_name[: -len(suffix)]
+            return sample_name
+
+        seq = [
+            family_of(line.split("{")[0].split(" ")[0])
+            for line in text.splitlines()
+            if line.strip() and not line.startswith("#")
+        ]
+        runs = [k for k, _ in itertools.groupby(seq)]
+        assert len(runs) == len(set(runs)), (
+            f"interleaved metric families: {runs}"
+        )
+
+    def test_histogram_gauge_counter_series_present(self, server):
+        families = parse_exposition(_scrape(server))
+        assert families["ctpu_request_duration_us"]["type"] == "histogram"
+        assert families["ctpu_queue_duration_us"]["type"] == "histogram"
+        assert families["ctpu_batch_size"]["type"] == "histogram"
+        assert families["ctpu_inflight_requests"]["type"] == "gauge"
+        assert families["ctpu_draining"]["type"] == "gauge"
+        assert families["ctpu_inference_request_success"]["type"] == "counter"
+        # the fail-side and per-phase cumulative series reach /metrics
+        for name in (
+            "ctpu_inference_fail_duration_us",
+            "ctpu_inference_queue_duration_us",
+            "ctpu_inference_compute_input_duration_us",
+            "ctpu_inference_compute_infer_duration_us",
+            "ctpu_inference_compute_output_duration_us",
+        ):
+            assert families[name]["type"] == "counter"
+            assert families[name]["samples"]
+
+    def test_label_escaping_round_trips_hostile_model_name(self, server):
+        text = _scrape(server)
+        # escaped on the wire ...
+        assert escape_label(NASTY) in text
+        assert NASTY not in text.replace(escape_label(NASTY), "")
+        # ... and the parser recovers the original name from every family
+        families = parse_exposition(text)
+        success = families["ctpu_inference_request_success"]["samples"]
+        assert any(labels.get("model") == NASTY for _, labels, _ in success)
+        buckets = families["ctpu_request_duration_us"]["samples"]
+        assert any(labels.get("model") == NASTY for _, labels, _ in buckets)
+
+    def test_counters_and_histograms_monotonic_across_requests(self, server):
+        def snapshot():
+            families = parse_exposition(_scrape(server))
+
+            def value(family, name_suffix=""):
+                return sum(
+                    v
+                    for name, labels, v in families[family]["samples"]
+                    if labels.get("model") == "simple"
+                    and name.endswith(name_suffix)
+                )
+
+            return (
+                value("ctpu_inference_request_success"),
+                value("ctpu_request_duration_us", "_count"),
+                value("ctpu_request_duration_us", "_sum"),
+            )
+
+        before = snapshot()
+        with httpclient.InferenceServerClient(server.http_address) as c:
+            _infer_simple(c, n=3)
+        after = snapshot()
+        assert after[0] - before[0] == 3
+        assert after[1] - before[1] == 3
+        assert after[2] > before[2]
+
+    def test_failure_series_accumulate(self, server):
+        families = parse_exposition(_scrape(server))
+
+        def fail_count():
+            return sum(
+                v
+                for _, labels, v in parse_exposition(_scrape(server))[
+                    "ctpu_inference_request_failure"
+                ]["samples"]
+                if labels.get("model") == "simple"
+            )
+
+        del families
+        before = fail_count()
+        with httpclient.InferenceServerClient(server.http_address) as c:
+            inputs = [httpclient.InferInput("INPUT0", [1, 16], "INT32")]
+            inputs[0].set_data_from_numpy(np.ones((1, 16), np.int32))
+            with pytest.raises(InferenceServerException):
+                c.infer("simple", inputs)  # missing INPUT1
+        assert fail_count() == before + 1
+
+    def test_metrics_manager_scrapes_new_series(self, server):
+        from client_tpu.perf.metrics_manager import MetricsManager
+
+        with httpclient.InferenceServerClient(server.http_address) as c:
+            _infer_simple(c, n=2)
+        mm = MetricsManager(f"http://{server.http_address}/metrics")
+        first = mm.scrape()
+        with httpclient.InferenceServerClient(server.http_address) as c:
+            _infer_simple(c, n=4)
+        last = mm.scrape()
+        assert "ctpu_inference_compute_infer_duration_us" in last
+        assert "ctpu_request_duration_us_count" in last
+        breakdown = MetricsManager.server_breakdown([first, last])
+        assert "ctpu_server_compute_infer_us_per_infer" in breakdown
+        assert breakdown["ctpu_server_compute_infer_us_per_infer"]["avg"] >= 0
+        # summarize() folds the breakdown into the per-window summary the
+        # perf report renders
+        summary = MetricsManager.summarize([first, last])
+        assert "ctpu_server_queue_us_per_infer" in summary
+
+
+class TestShedAndDrainCounters:
+    def test_overload_shed_counter(self):
+        with Server(http_port=0, max_inflight=0) as s:
+            with httpclient.InferenceServerClient(s.http_address) as c:
+                with pytest.raises(InferenceServerException):
+                    _infer_simple(c)
+            families = parse_exposition(_scrape(s))
+            sheds = families["ctpu_requests_shed_total"]["samples"]
+            assert any(
+                labels.get("reason") == "overload" and v >= 1
+                for _, labels, v in sheds
+            )
+
+    def test_drain_flips_gauge_and_counts(self):
+        s = Server(http_port=0).start()
+        try:
+            assert s.engine.drain(timeout_s=5.0)
+            text = render_metrics(s.engine)
+            families = parse_exposition(text)
+            assert families["ctpu_draining"]["samples"][0][2] == 1
+            drains = families["ctpu_drain_total"]["samples"]
+            assert drains and drains[0][2] >= 1
+        finally:
+            s.stop()
+
+
+class TestResilienceObservers:
+    def test_retry_observer_counts_backoffs_and_giveup(self):
+        registry = Registry()
+        obs = ResilienceMetricsObserver("ep1", registry=registry)
+        policy = resilience.RetryPolicy(
+            max_attempts=3, initial_backoff_s=0.001, jitter=False,
+            observer=obs,
+        )
+
+        def always_503(_timeout):
+            raise InferenceServerException("overloaded", status="503")
+
+        with pytest.raises(InferenceServerException):
+            resilience.call_with_retry(always_503, policy)
+        assert registry.get(
+            "ctpu_client_retries_total", {"endpoint": "ep1"}
+        ) == 2  # 3 attempts = 2 backoffs
+        assert registry.get(
+            "ctpu_client_request_failures_total", {"endpoint": "ep1"}
+        ) == 1
+
+    def test_circuit_observer_tracks_state_gauge(self):
+        registry = Registry()
+        obs = ResilienceMetricsObserver("ep2", registry=registry)
+        breaker = resilience.CircuitBreaker(
+            failure_threshold=2, reset_timeout_s=60.0, observer=obs
+        )
+        state = lambda: registry.get(  # noqa: E731 - tiny accessor
+            "ctpu_client_circuit_state", {"endpoint": "ep2"}
+        )
+        assert state() == 0  # closed at registration
+        breaker.record_failure()
+        assert state() == 0
+        breaker.record_failure()  # threshold reached -> open
+        assert state() == 2
+        assert registry.get(
+            "ctpu_client_circuit_transitions_total",
+            {"endpoint": "ep2", "to": "open"},
+        ) == 1
+        breaker.record_success()
+        assert state() == 0
+
+
+class TestEndToEndTracing:
+    def _enable(self, server, trace_file, **overrides):
+        settings = {
+            "trace_level": ["TIMESTAMPS"],
+            "trace_rate": "1",
+            "trace_count": "-1",
+            "trace_file": trace_file,
+        }
+        settings.update(overrides)
+        with httpclient.InferenceServerClient(server.http_address) as c:
+            c.update_trace_settings(settings=settings)
+
+    @staticmethod
+    def _by_name(record):
+        return {t["name"]: t["ns"] for t in record["timestamps"]}
+
+    def _assert_joined(self, records):
+        """One shared trace id; client attempt brackets the server span;
+        server queue -> compute timestamps properly ordered."""
+        assert {r["trace_id"] for r in records} == {
+            records[0]["trace_id"]
+        }
+        client = next(r for r in records if r["source"] == "client")
+        server = next(r for r in records if r["source"] == "server")
+        # the traceparent the client propagated is the server's parent span
+        assert server["parent_span_id"] == client["span_id"]
+        ct = self._by_name(client)
+        st = self._by_name(server)
+        assert ct["CLIENT_REQUEST_START"] <= ct["CLIENT_ATTEMPT_START"]
+        assert ct["CLIENT_ATTEMPT_START"] <= st["REQUEST_START"]
+        assert (
+            st["REQUEST_START"]
+            <= st["QUEUE_START"]
+            <= st["QUEUE_END"]
+            <= st["COMPUTE_START"]
+            <= st["COMPUTE_END"]
+        )
+        assert st["COMPUTE_END"] <= ct["CLIENT_REQUEST_END"]
+
+    def test_http_infer_joins_client_and_server_spans(self, tmp_path):
+        trace_file = str(tmp_path / "trace.jsonl")
+        with Server(http_port=0) as s:
+            self._enable(s, trace_file)
+            tracer = ClientTracer(trace_file=trace_file)
+            with httpclient.InferenceServerClient(
+                s.http_address, tracer=tracer
+            ) as c:
+                _infer_simple(c)
+        records = read_trace_file(trace_file)
+        assert len(records) == 2
+        assert {r["source"] for r in records} == {"client", "server"}
+        self._assert_joined(records)
+        server = next(r for r in records if r["source"] == "server")
+        assert server["protocol"] == "http"
+        assert server["model_name"] == "simple"
+
+    def test_grpc_infer_joins_client_and_server_spans(self, tmp_path):
+        trace_file = str(tmp_path / "trace.jsonl")
+        with Server(http_port=0, grpc_port=0) as s:
+            self._enable(s, trace_file)
+            tracer = ClientTracer(trace_file=trace_file)
+            with grpcclient.InferenceServerClient(
+                s.grpc_address, tracer=tracer
+            ) as c:
+                _grpc_infer_simple(c)
+        records = read_trace_file(trace_file)
+        assert len(records) == 2
+        self._assert_joined(records)
+        server = next(r for r in records if r["source"] == "server")
+        assert server["protocol"] == "grpc"
+
+    def test_trace_rate_samples_first_of_every_n(self, tmp_path):
+        trace_file = str(tmp_path / "trace.jsonl")
+        with Server(http_port=0) as s:
+            self._enable(s, trace_file, trace_rate="3")
+            with httpclient.InferenceServerClient(s.http_address) as c:
+                _infer_simple(c, n=6)
+        records = read_trace_file(trace_file)
+        assert len(records) == 2  # requests 1 and 4 of 6
+
+    def test_trace_count_budget_exhausts(self, tmp_path):
+        trace_file = str(tmp_path / "trace.jsonl")
+        with Server(http_port=0) as s:
+            self._enable(s, trace_file, trace_count="1")
+            with httpclient.InferenceServerClient(s.http_address) as c:
+                _infer_simple(c, n=3)
+            assert len(read_trace_file(trace_file)) == 1
+            # updating trace_count restarts the budget
+            self._enable(s, trace_file, trace_count="1")
+            with httpclient.InferenceServerClient(s.http_address) as c:
+                _infer_simple(c, n=2)
+        assert len(read_trace_file(trace_file)) == 2
+
+    def test_failed_request_records_error_on_both_spans(self, tmp_path):
+        trace_file = str(tmp_path / "trace.jsonl")
+        with Server(http_port=0) as s:
+            self._enable(s, trace_file)
+            tracer = ClientTracer(trace_file=trace_file)
+            with httpclient.InferenceServerClient(
+                s.http_address, tracer=tracer
+            ) as c:
+                inputs = [httpclient.InferInput("INPUT0", [1, 16], "INT32")]
+                inputs[0].set_data_from_numpy(np.ones((1, 16), np.int32))
+                with pytest.raises(InferenceServerException):
+                    c.infer("simple", inputs)  # missing INPUT1
+        records = read_trace_file(trace_file)
+        assert len(records) == 2
+        for record in records:
+            assert "INPUT1" in record.get("error", ""), record
+
+    def test_tracing_disabled_by_default_writes_nothing(self, tmp_path):
+        trace_file = tmp_path / "trace.jsonl"
+        with Server(http_port=0) as s:
+            with httpclient.InferenceServerClient(s.http_address) as c:
+                _infer_simple(c, n=2)
+            assert not s.engine.tracer.completed
+        assert not trace_file.exists()
+
+    def test_retry_attempts_join_one_trace(self, tmp_path):
+        """A shed-then-retried request shows BOTH attempts as client spans
+        under the same trace id, plus the server span of the attempt that
+        landed; the shed is counted in /metrics."""
+        trace_file = str(tmp_path / "trace.jsonl")
+        with Server(http_port=0) as s:
+            self._enable(s, trace_file)
+            s.engine.max_inflight = 0  # next request is shed (503)
+
+            class _Unshed:
+                def on_backoff(self, attempt, delay_s, exc):
+                    s.engine.max_inflight = None  # recover before the retry
+
+            policy = resilience.RetryPolicy(
+                max_attempts=3, initial_backoff_s=0.01, jitter=False,
+                observer=_Unshed(),
+            )
+            tracer = ClientTracer(trace_file=trace_file)
+            with httpclient.InferenceServerClient(
+                s.http_address, retry_policy=policy, tracer=tracer
+            ) as c:
+                _infer_simple(c)
+            families = parse_exposition(_scrape(s))
+            sheds = families["ctpu_requests_shed_total"]["samples"]
+            assert any(
+                labels.get("reason") == "overload" and v >= 1
+                for _, labels, v in sheds
+            )
+        records = read_trace_file(trace_file)
+        client = next(r for r in records if r["source"] == "client")
+        attempts = [
+            t for t in client["timestamps"]
+            if t["name"] == "CLIENT_ATTEMPT_START"
+        ]
+        assert len(attempts) == 2  # the shed attempt + the one that landed
+        # both server-side samples (shed requests are not traced past the
+        # frontend? they ARE: sampled before execute) share the trace id
+        assert {r["trace_id"] for r in records} == {client["trace_id"]}
+
+
+class TestTraceSettingsFidelity:
+    def test_settings_round_trip_identically_over_both_protocols(self):
+        with Server(http_port=0, grpc_port=0) as s:
+            with httpclient.InferenceServerClient(s.http_address) as hc:
+                # ints and bare strings normalize to the canonical schema
+                updated = hc.update_trace_settings(
+                    settings={"trace_rate": 250, "trace_level": "timestamps"}
+                )
+                assert updated["trace_rate"] == "250"
+                assert updated["trace_level"] == ["TIMESTAMPS"]
+                http_view = hc.get_trace_settings()
+            with grpcclient.InferenceServerClient(s.grpc_address) as gc:
+                response = gc.get_trace_settings()
+                grpc_view = {
+                    key: list(value.value)
+                    for key, value in response.settings.items()
+                }
+            # identical values over both protocols (gRPC's wire type is
+            # list-of-string for every setting; trace_level IS the list)
+            assert grpc_view["trace_level"] == http_view["trace_level"]
+            assert grpc_view["trace_rate"] == [http_view["trace_rate"]]
+            assert grpc_view["trace_count"] == [http_view["trace_count"]]
+            # and a gRPC update is visible identically over HTTP
+            with grpcclient.InferenceServerClient(s.grpc_address) as gc:
+                gc.update_trace_settings(
+                    settings={"trace_rate": 99, "trace_level": ["TENSORS"]}
+                )
+            with httpclient.InferenceServerClient(s.http_address) as hc:
+                got = hc.get_trace_settings()
+            assert got["trace_rate"] == "99"
+            assert got["trace_level"] == ["TENSORS"]
+
+    def test_malformed_settings_rejected_over_both_protocols(self):
+        with Server(http_port=0, grpc_port=0) as s:
+            with httpclient.InferenceServerClient(s.http_address) as hc:
+                with pytest.raises(InferenceServerException):
+                    hc.update_trace_settings(settings={"trace_rate": "lots"})
+                with pytest.raises(InferenceServerException):
+                    hc.update_trace_settings(
+                        settings={"trace_level": ["LOUD"]}
+                    )
+            with grpcclient.InferenceServerClient(s.grpc_address) as gc:
+                with pytest.raises(InferenceServerException):
+                    gc.update_trace_settings(settings={"trace_rate": "lots"})
+            # a rejected update leaves the settings untouched
+            with httpclient.InferenceServerClient(s.http_address) as hc:
+                assert hc.get_trace_settings()["trace_rate"] == "1000"
+
+
+class TestTraceparentHelpers:
+    def test_parse_round_trip(self):
+        tracer = ClientTracer()
+        trace = tracer.sample("m")
+        parsed = parse_traceparent(trace.traceparent())
+        assert parsed == (trace.trace_id, trace.span_id)
+
+    def test_malformed_headers_are_ignored(self):
+        for bad in ("", None, "zz", "00-short-span-01", "oo-" + "0" * 53):
+            assert parse_traceparent(bad) is None
