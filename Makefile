@@ -7,6 +7,8 @@
 #               finding not grandfathered in analysis/baseline.json.
 #               Suppress in place with `# tpulint: disable=RULE` + rationale.
 #   make test   ASAN native tests + the python suite.
+#   make check  the PR gate, reproduced locally: make lint + the tier-1
+#               pytest command (ROADMAP.md "Tier-1 verify").
 
 PROTO_DIR := proto
 PB_OUT := client_tpu/_proto
@@ -15,10 +17,17 @@ CXXFLAGS ?= -O2 -fPIC -Wall -std=c++17
 NATIVE_OUT := client_tpu/utils/shared_memory
 TPUSHM_OUT := client_tpu/utils/tpu_shared_memory
 
-.PHONY: all protos native cpp clean test asan java java-bindings lint
+.PHONY: all protos native cpp clean test asan java java-bindings lint check
 
 lint:
 	python -m client_tpu.analysis client_tpu tests
+
+# One command = the PR gate: static analysis, then the tier-1 suite with
+# the exact flags ROADMAP.md's "Tier-1 verify" runs.
+check: lint
+	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+	    --continue-on-collection-errors -p no:cacheprovider \
+	    -p no:xdist -p no:randomly
 
 all: protos native cpp
 

@@ -11,6 +11,8 @@ transport replaced by a libtpu/XLA-PJRT device-buffer path:
 - ``client_tpu.utils.tpu_shared_memory``         — TPU HBM device-buffer regions
 - ``client_tpu.serve``                           — in-process KServe-v2 server with a
   JAX/TPU execution runtime (hermetic test double *and* a real TPU serving path)
+- ``client_tpu.balance``                         — client-side replica set: health/circuit-
+  aware load balancing + failover across server replicas
 - ``client_tpu.perf``                            — perf_analyzer-class load generator
 """
 

@@ -1,0 +1,50 @@
+"""Client-side replica set: health/circuit-aware load balancing.
+
+Turns N independent KServe-v2 endpoints into one logical service for all
+four clients (sync/aio × HTTP/gRPC):
+
+- :class:`EndpointPool` — endpoint registry with per-endpoint circuit
+  breaker, health state machine (fed by background readiness probes and
+  per-request outcomes), routing weight, and live inflight count.
+- Policies (:mod:`client_tpu.balance.policy`) — round-robin,
+  least-inflight, power-of-two-choices, weighted — behind one
+  ``pick(candidates, request_ctx)`` interface.
+- :class:`ReplicatedClient` / :class:`AsyncReplicatedClient` — the
+  existing client API over a pool: every request (and every retry
+  attempt, which excludes the failed endpoint) routes to a different
+  healthy replica, respecting drain and open circuits.
+
+Built on the resilience layer (`client_tpu.resilience`:
+``call_with_failover``, ``CircuitBreakerRegistry``) and observable
+through the metrics (`serve.metrics.BalancerMetricsObserver`) and tracing
+(endpoint-stamped CLIENT_ATTEMPT spans) surfaces.  See README
+"Replication & load balancing".
+"""
+
+from client_tpu.balance.policy import (
+    LeastInflight,
+    Policy,
+    PowerOfTwoChoices,
+    RoundRobin,
+    Weighted,
+    make_policy,
+)
+from client_tpu.balance.pool import Endpoint, EndpointPool, Lease
+from client_tpu.balance.replicated import (
+    AsyncReplicatedClient,
+    ReplicatedClient,
+)
+
+__all__ = [
+    "Endpoint",
+    "EndpointPool",
+    "Lease",
+    "Policy",
+    "RoundRobin",
+    "LeastInflight",
+    "PowerOfTwoChoices",
+    "Weighted",
+    "make_policy",
+    "ReplicatedClient",
+    "AsyncReplicatedClient",
+]

@@ -1,0 +1,137 @@
+"""Load-balancing policies: which healthy replica gets the next request.
+
+One interface — ``pick(candidates, request_ctx)`` over the pool's eligible
+:class:`~client_tpu.balance.pool.Endpoint` objects — behind four shapes:
+
+- **round-robin**: strict rotation; the right default when replicas are
+  homogeneous and requests are similar-sized.
+- **least-inflight**: route to the replica with the fewest outstanding
+  requests; adapts to heterogeneous replicas and long-tailed request
+  durations (a slow replica accumulates inflight and stops receiving).
+- **power-of-two-choices**: sample two random replicas, take the less
+  loaded (Mitzenmacher) — least-inflight's adaptivity without the
+  herd-to-the-minimum behavior when many clients share stale load views.
+- **weighted**: stationary weighted-random split, for canaries and
+  capacity-skewed fleets.
+
+Policies are invoked with the pool lock held: they may keep unguarded
+internal state (the round-robin cursor), and they must never block or
+call back into the pool.
+"""
+
+import random
+
+from client_tpu.utils import InferenceServerException
+
+__all__ = [
+    "Policy",
+    "RoundRobin",
+    "LeastInflight",
+    "PowerOfTwoChoices",
+    "Weighted",
+    "make_policy",
+]
+
+
+class Policy:
+    """Picks one endpoint from the eligible candidates.
+
+    ``candidates`` is a non-empty list of Endpoint objects (already
+    filtered to routable ones); ``request_ctx`` is an optional dict of
+    request attributes (``model_name``, ...) for content-aware policies.
+    """
+
+    name = "policy"
+
+    def pick(self, candidates, request_ctx=None):
+        raise NotImplementedError
+
+
+class RoundRobin(Policy):
+    name = "round-robin"
+
+    def __init__(self):
+        self._cursor = 0
+
+    def pick(self, candidates, request_ctx=None):
+        # The candidate set shrinks and grows as health changes; a plain
+        # modular cursor still spreads load evenly within any stable set.
+        self._cursor = (self._cursor + 1) % (1 << 30)
+        return candidates[self._cursor % len(candidates)]
+
+
+class LeastInflight(Policy):
+    name = "least-inflight"
+
+    def __init__(self):
+        self._cursor = 0
+
+    def pick(self, candidates, request_ctx=None):
+        # rotate the tie-break start point so equal-load replicas share
+        # work instead of the first one absorbing every burst
+        self._cursor = (self._cursor + 1) % (1 << 30)
+        n = len(candidates)
+        best = None
+        for i in range(n):
+            candidate = candidates[(self._cursor + i) % n]
+            if best is None or candidate.inflight < best.inflight:
+                best = candidate
+        return best
+
+
+class PowerOfTwoChoices(Policy):
+    name = "power-of-two"
+
+    def __init__(self, rng=None):
+        self._rng = rng or random.Random()
+
+    def pick(self, candidates, request_ctx=None):
+        if len(candidates) == 1:
+            return candidates[0]
+        a, b = self._rng.sample(candidates, 2)
+        return a if a.inflight <= b.inflight else b
+
+
+class Weighted(Policy):
+    """Weighted-random split over ``Endpoint.weight`` (weight 0 removes an
+    endpoint from this policy's rotation without marking it unhealthy —
+    the canary-off switch)."""
+
+    name = "weighted"
+
+    def __init__(self, rng=None):
+        self._rng = rng or random.Random()
+
+    def pick(self, candidates, request_ctx=None):
+        weights = [max(float(e.weight), 0.0) for e in candidates]
+        total = sum(weights)
+        if total <= 0:  # all zero-weight: fall back to uniform
+            return self._rng.choice(candidates)
+        x = self._rng.uniform(0.0, total)
+        for endpoint, w in zip(candidates, weights):
+            x -= w
+            if x <= 0:
+                return endpoint
+        return candidates[-1]
+
+
+_POLICIES = {
+    RoundRobin.name: RoundRobin,
+    LeastInflight.name: LeastInflight,
+    PowerOfTwoChoices.name: PowerOfTwoChoices,
+    Weighted.name: Weighted,
+}
+
+
+def make_policy(spec):
+    """Policy instance from a name ('round-robin', 'least-inflight',
+    'power-of-two', 'weighted') or an already-built Policy."""
+    if isinstance(spec, Policy):
+        return spec
+    cls = _POLICIES.get(str(spec))
+    if cls is None:
+        raise InferenceServerException(
+            f"unknown balancing policy '{spec}' "
+            f"(choose from {sorted(_POLICIES)})"
+        )
+    return cls()

@@ -1,0 +1,438 @@
+"""Endpoint pool: N server replicas as one routable set.
+
+The pool owns, per endpoint: a :class:`~client_tpu.resilience.CircuitBreaker`
+(from a shared :class:`~client_tpu.resilience.CircuitBreakerRegistry`), a
+health state (READY / NOT_READY / UNREACHABLE — the ``server_state()``
+client verb's vocabulary), a routing weight, and a live inflight count.
+
+Health is fed from two directions:
+
+- **background readiness probes** (:meth:`EndpointPool.start_probes`): a
+  daemon thread polls each endpoint's readiness on an interval.  Probes are
+  what notice *drain* — a draining server still answers, with not-ready —
+  and what bring a recovered endpoint back without burning a request on it.
+- **per-request outcomes**: a successful response marks its endpoint READY
+  immediately; a connection-level failure marks it UNREACHABLE (only while
+  probing is active — without a prober nothing would ever un-mark it, so
+  the circuit breaker alone gates the endpoint then).
+
+Routing (:meth:`EndpointPool.lease`) filters to READY endpoints whose
+breaker admits an attempt (open circuits are skipped until their half-open
+probe), asks the policy to pick, and returns a *lease* whose
+``success()``/``failure()`` hooks feed the outcome back into inflight,
+breaker, and health state — the contract
+:func:`client_tpu.resilience.call_with_failover` drives.
+
+All endpoint state is guarded by one pool lock; policies run under it (and
+must not block — see policy.py).
+"""
+
+import threading
+
+from client_tpu.balance.policy import make_policy
+from client_tpu.resilience import (
+    CircuitBreaker,
+    CircuitBreakerRegistry,
+    CircuitOpenError,
+    NoHealthyEndpointError,
+    _notify,
+    is_connection_level,
+)
+from client_tpu.utils import (
+    SERVER_NOT_READY,
+    SERVER_READY,
+    SERVER_UNREACHABLE,
+)
+
+__all__ = ["Endpoint", "EndpointPool", "Lease"]
+
+
+class Endpoint:
+    """One replica: identity + routed-state (mutated under the pool lock)."""
+
+    def __init__(self, url, weight=1.0, breaker=None):
+        self.url = str(url)
+        self.weight = float(weight)
+        self.breaker = breaker if breaker is not None else CircuitBreaker(
+            name=self.url
+        )
+        # Optimistic start: an unprobed endpoint is routable until a probe
+        # or an outcome says otherwise (pessimistic start would blackhole
+        # a pool constructed before its servers finish binding).
+        self.state = SERVER_READY
+        self.inflight = 0
+        self.last_error = None
+        # State-change delivery ordering: transitions are stamped under the
+        # pool lock and delivered outside it with stale ones dropped, so a
+        # preempted thread can never park the endpoint-state gauge on an
+        # older value (same scheme as CircuitBreaker._deliver).
+        self._state_seq = 0
+        self._state_delivered = 0
+
+    def __repr__(self):
+        return (
+            f"Endpoint({self.url!r}, state={self.state}, "
+            f"inflight={self.inflight}, circuit={self.breaker.state})"
+        )
+
+
+class Lease:
+    """One routed attempt on one endpoint.
+
+    Exactly one of :meth:`success` / :meth:`failure` must be called to
+    release the inflight slot and record the outcome (the failover loop in
+    ``client_tpu.resilience`` does this).  ``key`` is the stable endpoint
+    identity the loop excludes on retry; ``last_candidate`` is True when no
+    other non-excluded healthy replica existed at pick time (so the loop
+    backs off instead of hammering a wrapped rotation).
+    """
+
+    __slots__ = ("_pool", "endpoint", "key", "last_candidate", "_done")
+
+    def __init__(self, pool, endpoint, last_candidate):
+        self._pool = pool
+        self.endpoint = endpoint
+        self.key = endpoint.url
+        self.last_candidate = last_candidate
+        self._done = False
+
+    @property
+    def url(self):
+        return self.endpoint.url
+
+    def success(self):
+        if not self._done:
+            self._done = True
+            self._pool._complete(self.endpoint, ok=True)
+
+    def failure(self, exc=None, retryable=True):
+        if not self._done:
+            self._done = True
+            self._pool._complete(
+                self.endpoint, ok=False, exc=exc, retryable=retryable
+            )
+
+    def release(self):
+        """Free the inflight slot WITHOUT health/breaker evidence — for
+        leases whose outcome says nothing about the endpoint (a finished
+        stream may end because the endpoint died; marking it READY would
+        route new work at a corpse until the next probe)."""
+        if not self._done:
+            self._done = True
+            self._pool._release(self.endpoint)
+
+
+class EndpointPool:
+    """Registry of replicas + health state machine + policy routing.
+
+    Parameters
+    ----------
+    endpoints : iterable of url strings, ``(url, weight)`` pairs, or
+        prebuilt :class:`Endpoint` objects.
+    policy : policy name or Policy instance (see balance/policy.py).
+    breakers : optional shared CircuitBreakerRegistry; one is created from
+        ``failure_threshold``/``reset_timeout_s`` when absent.
+    observer : optional hook object; any subset of ``on_route(url)``,
+        ``on_failover(url)`` (a retryable failure rotated the request off
+        this endpoint), and ``on_endpoint_state(url, state)`` is called —
+        ``client_tpu.serve.metrics.BalancerMetricsObserver`` feeds these
+        into per-endpoint /metrics series.
+    """
+
+    def __init__(self, endpoints, policy="round-robin", breakers=None,
+                 failure_threshold=5, reset_timeout_s=30.0, observer=None):
+        if breakers is None:
+            breakers = CircuitBreakerRegistry(
+                failure_threshold=failure_threshold,
+                reset_timeout_s=reset_timeout_s,
+            )
+        self.breakers = breakers
+        self.observer = observer
+        self._policy = make_policy(policy)
+        self._lock = threading.Lock()
+        self._endpoints = []
+        for spec in endpoints:
+            if isinstance(spec, Endpoint):
+                endpoint = spec
+            elif isinstance(spec, (tuple, list)):
+                url, weight = spec
+                endpoint = Endpoint(url, weight, breakers.get(str(url)))
+            else:
+                endpoint = Endpoint(spec, 1.0, breakers.get(str(spec)))
+            self._endpoints.append(endpoint)
+        # construction errors are programming errors, not the transient
+        # retryable NoHealthyEndpointError routing raises
+        if not self._endpoints:
+            raise ValueError("endpoint pool constructed empty")
+        seen = set()
+        for endpoint in self._endpoints:
+            if endpoint.url in seen:
+                raise ValueError(
+                    f"duplicate endpoint {endpoint.url!r} in pool"
+                )
+            seen.add(endpoint.url)
+        # probe plumbing (armed by start_probes; _probe_loop reads these)
+        self._probe = None
+        self._probe_interval_s = 0.0
+        self._prober = None
+        self._stop = threading.Event()
+        self._notify_lock = threading.Lock()
+
+    # -- introspection -------------------------------------------------------
+
+    def __len__(self):
+        return len(self._endpoints)
+
+    def urls(self):
+        return [e.url for e in self._endpoints]
+
+    def endpoints(self):
+        return list(self._endpoints)
+
+    def states(self):
+        with self._lock:
+            return {e.url: e.state for e in self._endpoints}
+
+    def snapshot(self):
+        """Per-endpoint routing view: state, inflight, circuit, weight."""
+        with self._lock:
+            return [
+                {
+                    "url": e.url,
+                    "state": e.state,
+                    "inflight": e.inflight,
+                    "weight": e.weight,
+                    "circuit": e.breaker.state,
+                }
+                for e in self._endpoints
+            ]
+
+    # -- health state machine ------------------------------------------------
+
+    def _deliver_state(self, endpoint, state, seq):
+        """Deliver one stamped state transition, dropping it if a newer one
+        was already delivered (out-of-order delivery would wedge the
+        endpoint-state gauge on a stale value forever, since changes only
+        notify on transitions)."""
+        if seq is None:
+            return
+        with self._notify_lock:
+            if seq <= endpoint._state_delivered:
+                return
+            endpoint._state_delivered = seq
+            _notify(self.observer, "on_endpoint_state", endpoint.url, state)
+
+    def set_state(self, url, state):
+        """Record a health observation for *url* (probe or admin)."""
+        if state not in (SERVER_READY, SERVER_NOT_READY, SERVER_UNREACHABLE):
+            raise ValueError(f"unknown endpoint state {state!r}")
+        transition = None
+        with self._lock:
+            for endpoint in self._endpoints:
+                if endpoint.url == url and endpoint.state != state:
+                    endpoint.state = state
+                    endpoint._state_seq += 1
+                    transition = (endpoint, state, endpoint._state_seq)
+        if transition is not None:
+            self._deliver_state(*transition)
+
+    def set_weight(self, url, weight):
+        with self._lock:
+            for endpoint in self._endpoints:
+                if endpoint.url == url:
+                    endpoint.weight = float(weight)
+
+    # -- probes --------------------------------------------------------------
+
+    def start_probes(self, probe, interval_s=2.0):
+        """Start the background readiness prober.
+
+        ``probe(url)`` must return one of the three state constants (the
+        clients' ``server_state()`` verb is exactly this shape) and should
+        bound its own transport timeout — a probe that can block forever
+        wedges the whole pool's (serial) prober.  Exceptions count as
+        UNREACHABLE.  Returns True when this call armed the prober, False
+        when one was already running; :meth:`close` stops it (and the pool
+        can be re-armed afterwards)."""
+        with self._lock:
+            if self._prober is not None:
+                return False
+            # Each prober generation gets ITS OWN stop event and probe fn
+            # as thread args: a zombie prober whose close() join timed out
+            # (stuck in a slow probe) still answers only to its own event
+            # and can never adopt a re-armed generation's probe.
+            stop = threading.Event()
+            self._stop = stop
+            self._probe = probe
+            self._probe_interval_s = float(interval_s)
+            prober = threading.Thread(
+                target=self._probe_loop, args=(probe, stop, float(interval_s)),
+                name="endpoint-pool-probe", daemon=True,
+            )
+            self._prober = prober
+        prober.start()
+        return True
+
+    def _probe_loop(self, probe, stop, interval_s):
+        while not stop.is_set():
+            for endpoint in self._endpoints:
+                if stop.is_set():
+                    return
+                try:
+                    state = probe(endpoint.url)
+                except Exception:
+                    state = SERVER_UNREACHABLE
+                if state not in (
+                    SERVER_READY, SERVER_NOT_READY, SERVER_UNREACHABLE
+                ):
+                    state = SERVER_UNREACHABLE  # a broken probe is no health
+                self.set_state(endpoint.url, state)
+            if stop.wait(interval_s):
+                return
+
+    def close(self):
+        with self._lock:
+            prober = self._prober
+            self._prober = None
+            # Clear the probe so the outcome-driven UNREACHABLE marking in
+            # _complete() stops too: with no prober left to recover an
+            # endpoint, one transient failure must not remove it forever.
+            self._probe = None
+            stop = self._stop
+        stop.set()
+        if prober is not None:
+            prober.join(timeout=5)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- routing -------------------------------------------------------------
+
+    def _routable_locked(self):
+        """Endpoints whose health admits new work (breaker gating happens
+        per-pick, where half-open single-probe semantics live)."""
+        return [e for e in self._endpoints if e.state == SERVER_READY]
+
+    def lease(self, excluded=(), request_ctx=None):
+        """Route one attempt: returns a :class:`Lease` on a healthy,
+        breaker-admitted endpoint, preferring ones not in *excluded*
+        (the failover loop's already-tried set).  Raises
+        :class:`NoHealthyEndpointError` when nothing is routable.
+
+        Breaker gating runs OUTSIDE the pool lock: ``before_attempt()``
+        can deliver an OPEN→HALF_OPEN observer transition, and an observer
+        that looks back at the pool (states/snapshot) under our lock would
+        deadlock."""
+        with self._lock:
+            routable = self._routable_locked()
+            if not routable:
+                raise NoHealthyEndpointError(
+                    f"no endpoint is routable: {self._describe_locked()}"
+                )
+            fresh = [e for e in routable if e.url not in excluded]
+            candidates = fresh or routable  # wrap once every replica tried
+            last_candidate = len(fresh) <= 1
+        fell_back = False
+        last_open = None
+        while True:
+            if not candidates:
+                if not fell_back and fresh and len(fresh) < len(routable):
+                    # every fresh candidate is circuit-blocked: fall back
+                    # to the already-tried remainder before giving up
+                    candidates = [e for e in routable if e.url in excluded]
+                    fell_back = True
+                    last_candidate = True
+                    continue
+                with self._lock:
+                    description = self._describe_locked()
+                raise NoHealthyEndpointError(
+                    "every routable endpoint is behind an open circuit: "
+                    f"{description}"
+                ) from last_open
+            with self._lock:
+                endpoint = self._policy.pick(candidates, request_ctx)
+            try:
+                # half-open single-probe gate: at most one caller gets
+                # through a cooled-down open circuit
+                endpoint.breaker.before_attempt()
+            except CircuitOpenError as exc:
+                last_open = exc
+                candidates = [e for e in candidates if e is not endpoint]
+                continue
+            with self._lock:
+                endpoint.inflight += 1
+            lease = Lease(self, endpoint, last_candidate)
+            break
+        _notify(self.observer, "on_route", lease.url)
+        return lease
+
+    def pick(self, request_ctx=None):
+        """Policy pick WITHOUT lease accounting — for external assignment
+        (e.g. binding perf workers to replicas).  Skips endpoints that are
+        unhealthy or behind a currently-open circuit; raises
+        :class:`NoHealthyEndpointError` when none qualify."""
+        with self._lock:
+            candidates = [
+                e for e in self._routable_locked()
+                if e.breaker.state != CircuitBreaker.OPEN
+            ]
+            if not candidates:
+                raise NoHealthyEndpointError(
+                    f"no endpoint is routable: {self._describe_locked()}"
+                )
+            return self._policy.pick(candidates, request_ctx)
+
+    def _describe_locked(self):
+        return ", ".join(
+            f"{e.url}={e.state}/{e.breaker.state}" for e in self._endpoints
+        )
+
+    # -- outcome accounting (Lease callbacks) --------------------------------
+
+    def _release(self, endpoint):
+        """Outcome-free inflight release (Lease.release)."""
+        with self._lock:
+            endpoint.inflight = max(endpoint.inflight - 1, 0)
+
+    def _complete(self, endpoint, ok, exc=None, retryable=True):
+        transition = None
+        with self._lock:
+            endpoint.inflight = max(endpoint.inflight - 1, 0)
+            if ok:
+                endpoint.last_error = None
+                if endpoint.state != SERVER_READY:
+                    endpoint.state = SERVER_READY
+                    endpoint._state_seq += 1
+                    transition = (endpoint, SERVER_READY, endpoint._state_seq)
+            else:
+                endpoint.last_error = exc
+                # UNREACHABLE needs BOTH: a connection-level failure (an
+                # answered 429/503 means the server is alive — overloaded
+                # or draining, never "dead") and an active prober to bring
+                # the endpoint back; with no prober the breaker's
+                # open/half-open cycle is the sole (self-recovering) gate.
+                if (
+                    retryable
+                    and is_connection_level(exc)
+                    and self._probe is not None
+                    and endpoint.state == SERVER_READY
+                ):
+                    endpoint.state = SERVER_UNREACHABLE
+                    endpoint._state_seq += 1
+                    transition = (
+                        endpoint, SERVER_UNREACHABLE, endpoint._state_seq
+                    )
+        # Breaker accounting outside the pool lock (the breaker has its
+        # own).  A non-retryable application error means the endpoint
+        # answered — evidence of health, never a circuit strike.
+        if ok or not retryable:
+            endpoint.breaker.record_success()
+        else:
+            endpoint.breaker.record_failure()
+        if not ok and retryable:
+            _notify(self.observer, "on_failover", endpoint.url)
+        if transition is not None:
+            self._deliver_state(*transition)

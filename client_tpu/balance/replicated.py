@@ -1,0 +1,523 @@
+"""Replicated clients: the existing client API over an EndpointPool.
+
+:class:`ReplicatedClient` (sync, HTTP or gRPC) and
+:class:`AsyncReplicatedClient` (asyncio, HTTP or gRPC) present the familiar
+``InferenceServerClient`` surface — ``infer``, the health and metadata
+verbs, the gRPC streaming entry points — but take a pool of endpoints in
+place of one URL and route every request through it:
+
+- each request (and each retry attempt) goes to a healthy replica picked
+  by the pool's policy; a failed attempt's endpoint is excluded so the
+  retry lands on a *different* replica (the failover hop is immediate
+  while an untried healthy replica exists — see
+  :func:`client_tpu.resilience.call_with_failover`);
+- drained replicas (ServerReady→false, observed by the background
+  readiness probes) stop receiving new work while their in-flight
+  requests finish;
+- open circuits are skipped until their half-open probe admits one
+  attempt;
+- with a ``tracer``, the whole request is one client span: every attempt
+  records its endpoint (the failover hop is visible as consecutive
+  CLIENT_ATTEMPT_START events with different endpoints) and the W3C
+  ``traceparent`` is propagated to whichever server serves each attempt,
+  so client and server spans join under one trace id.
+
+Streams are pinned: ``start_stream``/``stream_infer`` lease one healthy
+endpoint for the stream's lifetime (streams are never replayed — failing
+over mid-stream would re-send every queued request).
+"""
+
+import asyncio
+
+from client_tpu import resilience as _resilience
+from client_tpu import tracing as _tracing
+from client_tpu.balance.pool import EndpointPool
+from client_tpu.utils import SERVER_READY, raise_error
+
+__all__ = ["ReplicatedClient", "AsyncReplicatedClient"]
+
+_DEFAULT_PROBE_INTERVAL_S = 2.0
+# Background probes must be bounded: one black-holed endpoint would
+# otherwise wedge the pool's serial prober thread forever.
+_PROBE_TIMEOUT_S = 5.0
+
+
+def _default_factory(transport, aio):
+    if transport == "http":
+        if aio:
+            from client_tpu.http import aio as mod
+        else:
+            from client_tpu import http as mod
+    elif transport == "grpc":
+        if aio:
+            from client_tpu.grpc import aio as mod
+        else:
+            from client_tpu import grpc as mod
+    else:
+        raise_error(
+            f"unknown transport '{transport}' (choose 'http' or 'grpc')"
+        )
+    return mod.InferenceServerClient
+
+
+def _as_pool(pool_or_urls, policy):
+    if isinstance(pool_or_urls, EndpointPool):
+        return pool_or_urls, False
+    return EndpointPool(pool_or_urls, policy=policy), True
+
+
+def _attempt_timeout_kwargs(transport, kwargs, timeout_s):
+    """Cap the caller's per-request client timeout by the deadline-derived
+    per-attempt budget, in each transport's vocabulary (gRPC:
+    ``client_timeout``; HTTP: ``client_timeout_s``)."""
+    if timeout_s is None:
+        return kwargs
+    key = "client_timeout" if transport == "grpc" else "client_timeout_s"
+    combined = _resilience.combine_timeouts(kwargs.get(key), timeout_s)
+    # floor: an expired budget must not become a zero/negative transport
+    # timeout (all three transports reject those); the failover loop's
+    # deadline check raises right after the fast-failing attempt
+    kwargs[key] = max(combined, 1e-3)
+    return kwargs
+
+
+def _probe_fn(transport, client_for):
+    """A bounded ``probe(url)`` callable for EndpointPool.start_probes."""
+    if transport == "grpc":
+        return lambda url: client_for(url).server_state(
+            client_timeout=_PROBE_TIMEOUT_S
+        )
+    return lambda url: client_for(url).server_state(
+        timeout_s=_PROBE_TIMEOUT_S
+    )
+
+
+class ReplicatedClient:
+    """Synchronous replica-set client (HTTP or gRPC transport).
+
+    Parameters
+    ----------
+    pool : EndpointPool, or an iterable of endpoint URLs (a pool with
+        *policy* is built around it and owned/closed by this client).
+    transport : 'http' or 'grpc' — which client speaks to each replica.
+    policy : balancing policy for a URL-built pool (ignored when an
+        EndpointPool is passed; configure the pool directly then).
+    retry_policy : RetryPolicy governing attempts/backoff/deadline across
+        the failover loop.  Default: one attempt per replica plus one
+        (every replica gets a shot, then one wrapped retry).  The policy's
+        own ``circuit_breaker`` is unused — breakers are per-endpoint,
+        owned by the pool.
+    tracer : optional ClientTracer; see the module docstring.
+    probe_interval_s : readiness-probe period (None disables probing —
+        drain then goes unnoticed until requests fail).
+    client_factory : ``factory(url, **client_kwargs) -> client`` override.
+    client_kwargs : passed to every per-endpoint client constructor.
+    """
+
+    def __init__(self, pool, transport="http", policy="round-robin",
+                 retry_policy=None, tracer=None,
+                 probe_interval_s=_DEFAULT_PROBE_INTERVAL_S,
+                 client_factory=None, **client_kwargs):
+        self._pool, self._owns_pool = _as_pool(pool, policy)
+        self._transport = transport
+        self._factory = client_factory or _default_factory(transport, False)
+        self._clients = {
+            url: self._factory(url, **client_kwargs)
+            for url in self._pool.urls()
+        }
+        self._retry_policy = retry_policy or _resilience.RetryPolicy(
+            max_attempts=len(self._pool) + 1
+        )
+        self._tracer = tracer
+        self._stream_lease = None
+        # Whether close() must stop the pool's prober: always for a pool
+        # we built; for a caller-provided pool only when WE armed probes
+        # on it (they run through our clients, which close() closes).
+        self._stop_pool = self._owns_pool
+        if probe_interval_s:
+            armed = self._pool.start_probes(
+                _probe_fn(transport, self._clients.__getitem__),
+                interval_s=probe_interval_s,
+            )
+            self._stop_pool = self._stop_pool or armed
+
+    # -- lifecycle -----------------------------------------------------------
+
+    @property
+    def pool(self):
+        return self._pool
+
+    def close(self):
+        if self._stream_lease is not None:
+            self.stop_stream()
+        if self._stop_pool:
+            # stops the prober; a shared pool stays usable (its owner can
+            # re-arm probes with start_probes)
+            self._pool.close()
+        for client in self._clients.values():
+            try:
+                client.close()
+            except Exception:
+                pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- routing core --------------------------------------------------------
+
+    def _route(self, excluded):
+        return self._pool.lease(excluded)
+
+    def _routed(self, verb, *args, **kwargs):
+        """One management/metadata call, routed with failover.  On gRPC
+        the deadline-derived per-attempt timeout caps each verb's
+        ``client_timeout`` (every gRPC verb takes it); the HTTP verbs ride
+        their client's pool-level timeouts, which bound them too."""
+
+        def attempt(lease, timeout_s):
+            call_kwargs = dict(kwargs)
+            if self._transport == "grpc":
+                _attempt_timeout_kwargs("grpc", call_kwargs, timeout_s)
+            return getattr(self._clients[lease.url], verb)(
+                *args, **call_kwargs
+            )
+
+        return _resilience.call_with_failover(
+            attempt, self._retry_policy, self._route
+        )
+
+    # -- inference -----------------------------------------------------------
+
+    def infer(self, model_name, inputs, **kwargs):
+        """One inference, routed across the replica set with failover.
+
+        Accepts the underlying transport client's ``infer`` kwargs."""
+        with _tracing.client_span(self._tracer, model_name) as trace:
+            headers = dict(kwargs.pop("headers", None) or {})
+            if trace is not None:
+                headers["traceparent"] = trace.traceparent()
+
+            def attempt(lease, timeout_s):
+                call_kwargs = dict(kwargs)
+                if headers:
+                    call_kwargs["headers"] = headers
+                _attempt_timeout_kwargs(self._transport, call_kwargs,
+                                        timeout_s)
+                with _tracing.attempt_span(trace, endpoint=lease.url):
+                    return self._clients[lease.url].infer(
+                        model_name, inputs, **call_kwargs
+                    )
+
+            return _resilience.call_with_failover(
+                attempt, self._retry_policy, self._route
+            )
+
+    # -- health --------------------------------------------------------------
+    # "The service" is live/ready when ANY replica is; per-replica detail
+    # comes from server_states() (direct probes) / states() (pool view).
+
+    def is_server_live(self, **kwargs):
+        return any(
+            self._safe(client.is_server_live, **kwargs)
+            for client in self._clients.values()
+        )
+
+    def is_server_ready(self, **kwargs):
+        return any(
+            state == SERVER_READY
+            for state in self.server_states(**kwargs).values()
+        )
+
+    def is_model_ready(self, model_name, **kwargs):
+        return any(
+            self._safe(client.is_model_ready, model_name, **kwargs)
+            for client in self._clients.values()
+        )
+
+    def server_states(self, **kwargs):
+        """{url: READY/NOT_READY/UNREACHABLE} — one live probe per replica,
+        each bounded by the default probe timeout unless the caller passes
+        their own (a black-holed replica must not hang the sweep)."""
+        if not kwargs:
+            key = (
+                "client_timeout" if self._transport == "grpc" else "timeout_s"
+            )
+            kwargs = {key: _PROBE_TIMEOUT_S}
+        return {
+            url: client.server_state(**kwargs)
+            for url, client in self._clients.items()
+        }
+
+    def states(self):
+        """The pool's current (probe/outcome-fed) health view."""
+        return self._pool.states()
+
+    @staticmethod
+    def _safe(fn, *args, **kwargs):
+        try:
+            return fn(*args, **kwargs)
+        except Exception:
+            return False
+
+    # -- metadata / management (routed with failover) ------------------------
+
+    def get_server_metadata(self, *args, **kwargs):
+        return self._routed("get_server_metadata", *args, **kwargs)
+
+    def get_model_metadata(self, *args, **kwargs):
+        return self._routed("get_model_metadata", *args, **kwargs)
+
+    def get_model_config(self, *args, **kwargs):
+        return self._routed("get_model_config", *args, **kwargs)
+
+    def get_model_repository_index(self, *args, **kwargs):
+        return self._routed("get_model_repository_index", *args, **kwargs)
+
+    def get_inference_statistics(self, *args, **kwargs):
+        return self._routed("get_inference_statistics", *args, **kwargs)
+
+    def call(self, verb, *args, **kwargs):
+        """Escape hatch: route any other client verb with failover.  For
+        verbs with side effects on ONE replica (model load/unload, shm
+        registration) address the per-endpoint client directly instead:
+        ``client_for(url).load_model(...)``."""
+        return self._routed(verb, *args, **kwargs)
+
+    def client_for(self, url):
+        """The underlying per-endpoint client (single-replica verbs)."""
+        return self._clients[url]
+
+    # -- streaming (gRPC): pinned to one healthy replica ---------------------
+
+    def start_stream(self, callback, **kwargs):
+        if self._transport != "grpc":
+            raise_error("streaming requires the grpc transport")
+        if self._stream_lease is not None:
+            raise_error("cannot start another stream with one already active")
+        lease = self._pool.lease()
+        try:
+            self._clients[lease.url].start_stream(callback, **kwargs)
+        except Exception as exc:
+            lease.failure(exc, self._retry_policy.retryable(exc))
+            raise
+        self._stream_lease = lease
+
+    def async_stream_infer(self, *args, **kwargs):
+        if self._stream_lease is None:
+            raise_error("stream not available, call start_stream() first")
+        self._clients[self._stream_lease.url].async_stream_infer(
+            *args, **kwargs
+        )
+
+    def stop_stream(self, cancel_requests=False):
+        lease = self._stream_lease
+        if lease is None:
+            return
+        self._stream_lease = None
+        try:
+            self._clients[lease.url].stop_stream(cancel_requests)
+        finally:
+            # outcome-free: a stream may end BECAUSE the endpoint died, so
+            # releasing must not assert health (success would flip a
+            # drained/unreachable endpoint back to READY)
+            lease.release()
+
+
+class AsyncReplicatedClient:
+    """asyncio replica-set client (HTTP or gRPC transport).
+
+    Same routing semantics as :class:`ReplicatedClient`; per-endpoint
+    clients are created lazily inside the running event loop, and health
+    probing is on-demand (`await refresh_states()`) rather than a
+    background thread — outcome-driven state still routes around dead
+    replicas between refreshes.
+    """
+
+    def __init__(self, pool, transport="http", policy="round-robin",
+                 retry_policy=None, tracer=None, client_factory=None,
+                 **client_kwargs):
+        self._pool, self._owns_pool = _as_pool(pool, policy)
+        self._transport = transport
+        self._factory = client_factory or _default_factory(transport, True)
+        self._client_kwargs = client_kwargs
+        self._clients = {}
+        self._retry_policy = retry_policy or _resilience.RetryPolicy(
+            max_attempts=len(self._pool) + 1
+        )
+        self._tracer = tracer
+
+    @property
+    def pool(self):
+        return self._pool
+
+    def _client_for(self, url):
+        client = self._clients.get(url)
+        if client is None:
+            client = self._factory(url, **self._client_kwargs)
+            self._clients[url] = client
+        return client
+
+    async def close(self):
+        if self._owns_pool:
+            self._pool.close()
+        for client in self._clients.values():
+            try:
+                await client.close()
+            except Exception:
+                pass
+
+    async def __aenter__(self):
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.close()
+
+    # -- routing core --------------------------------------------------------
+
+    def _route(self, excluded):
+        return self._pool.lease(excluded)
+
+    async def _routed(self, verb, *args, **kwargs):
+        # same per-attempt timeout handling as the sync client's _routed
+        async def attempt(lease, timeout_s):
+            call_kwargs = dict(kwargs)
+            if self._transport == "grpc":
+                _attempt_timeout_kwargs("grpc", call_kwargs, timeout_s)
+            return await getattr(self._client_for(lease.url), verb)(
+                *args, **call_kwargs
+            )
+
+        return await _resilience.acall_with_failover(
+            attempt, self._retry_policy, self._route
+        )
+
+    # -- inference -----------------------------------------------------------
+
+    async def infer(self, model_name, inputs, **kwargs):
+        with _tracing.client_span(self._tracer, model_name) as trace:
+            headers = dict(kwargs.pop("headers", None) or {})
+            if trace is not None:
+                headers["traceparent"] = trace.traceparent()
+
+            async def attempt(lease, timeout_s):
+                call_kwargs = dict(kwargs)
+                if headers:
+                    call_kwargs["headers"] = headers
+                _attempt_timeout_kwargs(self._transport, call_kwargs,
+                                        timeout_s)
+                with _tracing.attempt_span(trace, endpoint=lease.url):
+                    return await self._client_for(lease.url).infer(
+                        model_name, inputs, **call_kwargs
+                    )
+
+            return await _resilience.acall_with_failover(
+                attempt, self._retry_policy, self._route
+            )
+
+    # -- health --------------------------------------------------------------
+
+    async def server_states(self, **kwargs):
+        """{url: state} — all replicas probed CONCURRENTLY, each bounded
+        by the default probe timeout unless the caller passes their own."""
+        if not kwargs:
+            key = (
+                "client_timeout" if self._transport == "grpc" else "timeout_s"
+            )
+            kwargs = {key: _PROBE_TIMEOUT_S}
+        urls = self._pool.urls()
+        states = await asyncio.gather(
+            *(self._client_for(url).server_state(**kwargs) for url in urls)
+        )
+        return dict(zip(urls, states))
+
+    async def refresh_states(self, **kwargs):
+        """Probe every replica once and feed the results into the pool
+        (the async analog of the sync client's background prober)."""
+        states = await self.server_states(**kwargs)
+        for url, state in states.items():
+            self._pool.set_state(url, state)
+        return states
+
+    async def is_server_live(self, **kwargs):
+        for url in self._pool.urls():
+            try:
+                if await self._client_for(url).is_server_live(**kwargs):
+                    return True
+            except Exception:
+                pass
+        return False
+
+    async def is_server_ready(self, **kwargs):
+        states = await self.server_states(**kwargs)
+        return any(state == SERVER_READY for state in states.values())
+
+    async def is_model_ready(self, model_name, **kwargs):
+        for url in self._pool.urls():
+            try:
+                if await self._client_for(url).is_model_ready(
+                    model_name, **kwargs
+                ):
+                    return True
+            except Exception:
+                pass
+        return False
+
+    def states(self):
+        return self._pool.states()
+
+    # -- metadata / management -----------------------------------------------
+
+    async def get_server_metadata(self, *args, **kwargs):
+        return await self._routed("get_server_metadata", *args, **kwargs)
+
+    async def get_model_metadata(self, *args, **kwargs):
+        return await self._routed("get_model_metadata", *args, **kwargs)
+
+    async def get_model_config(self, *args, **kwargs):
+        return await self._routed("get_model_config", *args, **kwargs)
+
+    async def get_model_repository_index(self, *args, **kwargs):
+        return await self._routed(
+            "get_model_repository_index", *args, **kwargs
+        )
+
+    async def get_inference_statistics(self, *args, **kwargs):
+        return await self._routed("get_inference_statistics", *args, **kwargs)
+
+    async def call(self, verb, *args, **kwargs):
+        return await self._routed(verb, *args, **kwargs)
+
+    def client_for(self, url):
+        return self._client_for(url)
+
+    # -- streaming (gRPC aio): pinned to one healthy replica -----------------
+
+    def stream_infer(self, inputs_iterator, **kwargs):
+        """Bidirectional stream over ONE leased healthy replica; the lease
+        is released when the response stream finishes (or when the caller
+        ``aclose()``s the returned generator — iterate or close it, an
+        abandoned un-iterated generator holds its inflight slot)."""
+        if self._transport != "grpc":
+            raise_error("streaming requires the grpc transport")
+        lease = self._pool.lease()
+        try:
+            stream = self._client_for(lease.url).stream_infer(
+                inputs_iterator, **kwargs
+            )
+        except Exception as exc:
+            lease.failure(exc, self._retry_policy.retryable(exc))
+            raise
+
+        async def _pinned():
+            try:
+                async for item in stream:
+                    yield item
+            finally:
+                # outcome-free (see ReplicatedClient.stop_stream): the
+                # stream may have ended because the endpoint died
+                lease.release()
+
+        return _pinned()

@@ -26,7 +26,13 @@ from client_tpu._grpc_service import build_stubs
 from client_tpu._infer_types import InferInput, InferRequestedOutput  # noqa: F401
 from client_tpu._proto import inference_pb2 as pb
 from client_tpu._proto import model_config_pb2  # noqa: F401
-from client_tpu.utils import InferenceServerException, raise_error
+from client_tpu.utils import (
+    SERVER_NOT_READY,
+    SERVER_READY,
+    SERVER_UNREACHABLE,
+    InferenceServerException,
+    raise_error,
+)
 
 __all__ = [
     "InferenceServerClient",
@@ -245,6 +251,7 @@ class InferenceServerClient:
         else:
             self._channel = grpc.insecure_channel(url, options=options)
         self._stubs = build_stubs(self._channel)
+        self._endpoint = url  # host:port identity (trace attempt spans)
         self._verbose = verbose
         self._stream = None
         # Opt-in resilience for unary RPCs (client_tpu.resilience.RetryPolicy);
@@ -286,7 +293,7 @@ class InferenceServerClient:
                       **kwargs):
         """One RPC attempt in a trace attempt span — retries show as
         repeated ATTEMPT_START/ATTEMPT_END pairs."""
-        with _tracing.attempt_span(trace):
+        with _tracing.attempt_span(trace, endpoint=self._endpoint):
             return self._call_once(
                 name, request, headers, client_timeout, **kwargs
             )
@@ -336,6 +343,20 @@ class InferenceServerClient:
             ).ready
         except InferenceServerException:
             return False
+
+    def server_state(self, headers=None, client_timeout=None):
+        """READY / NOT_READY / UNREACHABLE (client_tpu.utils constants).
+
+        A draining server ANSWERS the ServerReady RPC with ready=False
+        (NOT_READY); a dead one fails the RPC itself (UNREACHABLE) — the
+        distinction a replica set routes on."""
+        try:
+            r = self._call_once(
+                "ServerReady", pb.ServerReadyRequest(), headers, client_timeout
+            )
+        except InferenceServerException:
+            return SERVER_UNREACHABLE
+        return SERVER_READY if r.ready else SERVER_NOT_READY
 
     def is_model_ready(
         self, model_name, model_version="", headers=None, client_timeout=None

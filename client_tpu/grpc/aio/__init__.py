@@ -25,7 +25,13 @@ from client_tpu.grpc import (
     _metadata,
     raise_error_grpc,
 )
-from client_tpu.utils import InferenceServerException, raise_error
+from client_tpu.utils import (
+    SERVER_NOT_READY,
+    SERVER_READY,
+    SERVER_UNREACHABLE,
+    InferenceServerException,
+    raise_error,
+)
 
 __all__ = [
     "InferenceServerClient",
@@ -72,6 +78,7 @@ class InferenceServerClient:
         else:
             self._channel = grpc.aio.insecure_channel(url, options=options)
         self._stubs = build_stubs(self._channel)
+        self._endpoint = url  # host:port identity (trace attempt spans)
         self._verbose = verbose
         # Opt-in resilience for unary RPCs; None keeps single-attempt
         # behavior.  stream_infer is never retried (replay would re-send
@@ -109,7 +116,7 @@ class InferenceServerClient:
                             trace, **kw):
         """One RPC attempt in a trace attempt span — retries show as
         repeated ATTEMPT_START/ATTEMPT_END pairs."""
-        with _tracing.attempt_span(trace):
+        with _tracing.attempt_span(trace, endpoint=self._endpoint):
             return await self._call_once(
                 name, request, headers, client_timeout, **kw
             )
@@ -158,6 +165,18 @@ class InferenceServerClient:
         except InferenceServerException:
             return False
         return r.ready
+
+    async def server_state(self, headers=None, client_timeout=None):
+        """READY / NOT_READY / UNREACHABLE (client_tpu.utils constants) —
+        a draining server answers ready=False (NOT_READY), a dead one
+        fails the RPC (UNREACHABLE); same contract as the sync client."""
+        try:
+            r = await self._call_once(
+                "ServerReady", pb.ServerReadyRequest(), headers, client_timeout
+            )
+        except InferenceServerException:
+            return SERVER_UNREACHABLE
+        return SERVER_READY if r.ready else SERVER_NOT_READY
 
     async def is_model_ready(
         self, model_name, model_version="", headers=None, client_timeout=None

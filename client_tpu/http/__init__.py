@@ -26,6 +26,9 @@ from client_tpu._infer_types import (  # noqa: F401  (re-exported API surface)
     _np_from_json_data,
 )
 from client_tpu.utils import (
+    SERVER_NOT_READY,
+    SERVER_READY,
+    SERVER_UNREACHABLE,
     InferenceServerException,
     from_wire_bytes,
     raise_error,
@@ -165,6 +168,7 @@ class InferenceServerClient:
             ssl = ssl or scheme == "https"
         scheme = "https" if ssl else "http"
         self._base_url = f"{scheme}://{url}"
+        self._endpoint = url  # host:port identity (trace attempt spans)
         self._verbose = verbose
         self._concurrency = concurrency
         pool_kwargs = {}
@@ -212,15 +216,18 @@ class InferenceServerClient:
     # -- low-level request helpers -----------------------------------------
 
     def _request(self, method, uri, headers=None, query_params=None, body=None,
-                 trace=None):
+                 trace=None, client_timeout_s=None):
         if self._retry_policy is None:
             return self._attempt_once(
-                method, uri, headers, query_params, body, None, trace
+                method, uri, headers, query_params, body, client_timeout_s,
+                trace,
             )
 
         def attempt(timeout_s):
             response = self._attempt_once(
-                method, uri, headers, query_params, body, timeout_s, trace
+                method, uri, headers, query_params, body,
+                _resilience.combine_timeouts(timeout_s, client_timeout_s),
+                trace,
             )
             # Overload statuses become exceptions so the retry loop sees
             # them (with the server's Retry-After hint attached); retries
@@ -237,7 +244,7 @@ class InferenceServerClient:
                       timeout_s, trace):
         """One transport attempt in a trace attempt span — retries show as
         repeated ATTEMPT_START/ATTEMPT_END pairs."""
-        with _tracing.attempt_span(trace):
+        with _tracing.attempt_span(trace, endpoint=self._endpoint):
             return self._request_once(
                 method, uri, headers, query_params, body, timeout_s
             )
@@ -309,6 +316,22 @@ class InferenceServerClient:
 
     def is_server_ready(self, headers=None, query_params=None):
         return self._probe("v2/health/ready", headers, query_params)
+
+    def server_state(self, headers=None, query_params=None, timeout_s=None):
+        """READY / NOT_READY / UNREACHABLE (client_tpu.utils constants).
+
+        ``is_server_ready()`` collapses "answered not-ready" (draining) and
+        "never answered" (dead) into False; this keeps them apart so a
+        replica set can let a draining server finish its in-flight work
+        while routing a dead one straight to its circuit breaker.
+        ``timeout_s`` bounds the probe (background probers must not hang
+        on a black-holed endpoint)."""
+        try:
+            r = self._request_once("GET", "v2/health/ready", headers,
+                                   query_params, timeout_s=timeout_s)
+        except InferenceServerException:
+            return SERVER_UNREACHABLE
+        return SERVER_READY if r.status == 200 else SERVER_NOT_READY
 
     def is_model_ready(self, model_name, model_version="", headers=None, query_params=None):
         uri = f"v2/models/{quote(model_name, safe='')}"
@@ -574,8 +597,15 @@ class InferenceServerClient:
         request_compression_algorithm=None,
         response_compression_algorithm=None,
         parameters=None,
+        client_timeout_s=None,
     ):
-        """Run one synchronous inference; returns InferResult."""
+        """Run one synchronous inference; returns InferResult.
+
+        ``client_timeout_s`` bounds this request's transport time on the
+        client side (the gRPC clients' ``client_timeout`` analog; distinct
+        from ``timeout``, the KServe server-side budget in microseconds).
+        With a retry policy it caps each attempt alongside the policy's
+        deadline-derived budget."""
         with _tracing.client_span(self._tracer, model_name) as trace:
             body, json_size = _codec.build_infer_request_body(
                 inputs,
@@ -605,7 +635,8 @@ class InferenceServerClient:
                 uri += f"/versions/{model_version}"
             uri += "/infer"
             response = self._request(
-                "POST", uri, request_headers, query_params, body, trace=trace
+                "POST", uri, request_headers, query_params, body, trace=trace,
+                client_timeout_s=client_timeout_s,
             )
             self._raise_if_error(response)
             header_length = response.headers.get(

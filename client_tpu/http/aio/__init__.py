@@ -19,7 +19,13 @@ from client_tpu.http import (  # same response/error parsing as sync
     InferResult,
     _get_error_from_response,
 )
-from client_tpu.utils import InferenceServerException, raise_error
+from client_tpu.utils import (
+    SERVER_NOT_READY,
+    SERVER_READY,
+    SERVER_UNREACHABLE,
+    InferenceServerException,
+    raise_error,
+)
 
 __all__ = [
     "InferenceServerClient",
@@ -50,6 +56,7 @@ class InferenceServerClient:
             url = rest
             ssl = ssl or scheme == "https"
         self._base_url = f"{'https' if ssl else 'http'}://{url}"
+        self._endpoint = url  # host:port identity (trace attempt spans)
         self._verbose = verbose
         connector = aiohttp.TCPConnector(limit=conn_limit, ssl=ssl_context if ssl else False)
         self._session = aiohttp.ClientSession(
@@ -80,15 +87,18 @@ class InferenceServerClient:
         return await self._request("POST", uri, headers, query_params, body)
 
     async def _request(self, method, uri, headers=None, query_params=None,
-                       body=b"", trace=None):
+                       body=b"", trace=None, client_timeout_s=None):
         if self._retry_policy is None:
             return await self._attempt_once(
-                method, uri, headers, query_params, body, None, trace
+                method, uri, headers, query_params, body, client_timeout_s,
+                trace,
             )
 
         async def attempt(timeout_s):
             response = await self._attempt_once(
-                method, uri, headers, query_params, body, timeout_s, trace
+                method, uri, headers, query_params, body,
+                _resilience.combine_timeouts(timeout_s, client_timeout_s),
+                trace,
             )
             # Overload statuses become exceptions for the retry loop (with
             # the Retry-After hint); the body read happens inside the
@@ -105,7 +115,7 @@ class InferenceServerClient:
                             timeout_s, trace):
         """One transport attempt in a trace attempt span — retries show as
         repeated ATTEMPT_START/ATTEMPT_END pairs."""
-        with _tracing.attempt_span(trace):
+        with _tracing.attempt_span(trace, endpoint=self._endpoint):
             return await self._request_once(
                 method, uri, headers, query_params, body, timeout_s
             )
@@ -172,6 +182,21 @@ class InferenceServerClient:
 
     async def is_server_ready(self, headers=None, query_params=None):
         return await self._probe("v2/health/ready", headers, query_params)
+
+    async def server_state(self, headers=None, query_params=None,
+                           timeout_s=None):
+        """READY / NOT_READY / UNREACHABLE (client_tpu.utils constants) —
+        distinguishes a draining server (answered not-ready) from a dead
+        one (never answered); same contract as the sync client.
+        ``timeout_s`` bounds the probe."""
+        try:
+            r = await self._request_once(
+                "GET", "v2/health/ready", headers, query_params,
+                timeout_s=timeout_s,
+            )
+        except self._HEALTH_ERRORS:
+            return SERVER_UNREACHABLE
+        return SERVER_READY if r.status == 200 else SERVER_NOT_READY
 
     async def is_model_ready(
         self, model_name, model_version="", headers=None, query_params=None
@@ -406,6 +431,7 @@ class InferenceServerClient:
         request_compression_algorithm=None,
         response_compression_algorithm=None,
         parameters=None,
+        client_timeout_s=None,
     ):
         with _tracing.client_span(self._tracer, model_name) as trace:
             body, json_size = _codec.build_infer_request_body(
@@ -435,7 +461,8 @@ class InferenceServerClient:
                 uri += f"/versions/{model_version}"
             uri += "/infer"
             response = await self._request(
-                "POST", uri, request_headers, query_params, body, trace=trace
+                "POST", uri, request_headers, query_params, body, trace=trace,
+                client_timeout_s=client_timeout_s,
             )
             await self._raise_if_error(response)
             data = await response.read()

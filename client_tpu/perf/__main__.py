@@ -43,7 +43,10 @@ def build_parser():
     )
     p.add_argument("-m", "--model-name", required=True)
     p.add_argument("-x", "--model-version", default="")
-    p.add_argument("-u", "--url", default="localhost:8001")
+    p.add_argument("-u", "--url", default="localhost:8001",
+                   help="server address; a comma-separated list fans the "
+                        "load out across replicas (per-endpoint split in "
+                        "the summary)")
     p.add_argument("-i", "--protocol", choices=["grpc", "http"], default="grpc")
     p.add_argument("-a", "--async", dest="async_mode", action="store_true",
                    help="async concurrency slots on one event loop over "
@@ -278,6 +281,8 @@ def _run_native_loadgen(args, control, loader, data_manager):
 def main(argv=None):
     args = build_parser().parse_args(argv)
 
+    urls = [u.strip() for u in args.url.split(",") if u.strip()]
+
     shape_overrides = {}
     for item in args.shape:
         name, _, dims = item.partition(":")
@@ -335,6 +340,29 @@ def main(argv=None):
             else BackendKind.TRITON_HTTP
         )
 
+    # Multi-replica fan-out: workers are assigned round-robin across the
+    # --url list via an EndpointPool, and the summary reports a
+    # per-endpoint throughput/latency split.
+    replica_pool = None
+    if len(urls) > 1:
+        if (args.hermetic or args.native_loadgen or args.async_mode
+                or kind not in (BackendKind.TRITON_GRPC,
+                                BackendKind.TRITON_HTTP)):
+            sys.exit("error: a --url replica list drives the python load "
+                     "engine over socket HTTP/gRPC (not --hermetic, "
+                     "--native-loadgen, --async, or non-Triton "
+                     "--service-kind)")
+        if args.shared_memory != "none":
+            sys.exit("error: --shared-memory regions are registered on one "
+                     "server; they cannot fan out across a --url replica "
+                     "list")
+        if len(set(urls)) != len(urls):
+            sys.exit("error: duplicate endpoint in the --url replica list")
+        from client_tpu.balance import EndpointPool
+
+        replica_pool = EndpointPool(urls, policy="round-robin")
+        args.url = urls[0]  # control plane: metadata/statistics/trace
+
     ssl_options = None
     if args.protocol == "grpc" and args.ssl_grpc_use_ssl:
         ssl_options = {
@@ -357,8 +385,11 @@ def main(argv=None):
         }
 
     def backend_factory():
+        url = (
+            replica_pool.pick().url if replica_pool is not None else args.url
+        )
         return ClientBackendFactory.create(
-            kind, url=args.url, engine=engine, verbose=False,
+            kind, url=url, engine=engine, verbose=False,
             ssl_options=ssl_options, **backend_kwargs
         )
 

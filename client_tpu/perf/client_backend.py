@@ -47,6 +47,9 @@ class ClientBackend:
     """
 
     kind = None
+    # Replica identity for per-endpoint reporting: the url this backend
+    # instance is bound to (multi-replica runs assign one per worker).
+    endpoint = ""
 
     def model_metadata(self, model_name, model_version=""):
         raise NotImplementedError
@@ -122,6 +125,7 @@ class _GrpcBackend(ClientBackend):
 
         opts = ssl_options or {}
         self._mod = grpcclient
+        self.endpoint = url
         self._client = grpcclient.InferenceServerClient(
             url,
             verbose=verbose,
@@ -218,6 +222,7 @@ class _HttpBackend(_GrpcBackend):
                 ctx.check_hostname = False
                 ctx.verify_mode = _ssl.CERT_NONE
         self._mod = httpclient
+        self.endpoint = url
         self._client = httpclient.InferenceServerClient(
             url,
             verbose=verbose,

@@ -18,14 +18,19 @@ from client_tpu.utils import InferenceServerException
 
 
 class RequestRecord:
-    __slots__ = ("start_ns", "end_ns", "ok", "sequence_id", "delayed")
+    __slots__ = ("start_ns", "end_ns", "ok", "sequence_id", "delayed",
+                 "endpoint")
 
-    def __init__(self, start_ns, end_ns, ok, sequence_id=0, delayed=False):
+    def __init__(self, start_ns, end_ns, ok, sequence_id=0, delayed=False,
+                 endpoint=""):
         self.start_ns = start_ns
         self.end_ns = end_ns
         self.ok = ok
         self.sequence_id = sequence_id
         self.delayed = delayed
+        # replica this request was sent to (multi-replica runs report a
+        # per-endpoint throughput/latency split)
+        self.endpoint = endpoint
 
 
 class ThreadStat:
@@ -100,7 +105,10 @@ class InferContext:
         end = time.monotonic_ns()
         with self.stat.lock:
             self.stat.records.append(
-                RequestRecord(start, end, ok, seq_id, delayed)
+                RequestRecord(
+                    start, end, ok, seq_id, delayed,
+                    endpoint=self.backend.endpoint,
+                )
             )
 
     def _validate(self, result, stream_id, step_id):
@@ -377,7 +385,9 @@ class AsyncConcurrencyManager(LoadManager):
                 return
             end = time.monotonic_ns()
             with stat.lock:
-                stat.records.append(RequestRecord(start, end, ok))
+                stat.records.append(
+                    RequestRecord(start, end, ok, endpoint=self._url)
+                )
             self.count_sent()
 
     def change_concurrency_level(self, concurrency):

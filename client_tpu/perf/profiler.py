@@ -35,6 +35,9 @@ class PerfStatus:
         self.server_stats = {}
         self.ensemble_stats = {}  # composing model -> flat counter deltas
         self.tpu_metrics = {}  # gauge -> {avg, max} from MetricsManager
+        # multi-replica runs: endpoint -> {count, throughput, avg_us,
+        # p99_us, errors} (empty for single-endpoint runs)
+        self.per_endpoint = {}
         self.client_window_s = 0.0
         # Fraction of worker-slot wall time NOT spent inside a request —
         # harness bookkeeping + data rotation (reference "perf_analyzer
@@ -49,10 +52,11 @@ class PerfStatus:
 
 class Measurement:
     __slots__ = ("throughput", "latency_avg_ns", "latencies_ns", "errors",
-                 "delayed", "window_s", "send_rate", "busy_ns")
+                 "delayed", "window_s", "send_rate", "busy_ns",
+                 "per_endpoint")
 
     def __init__(self, throughput, latency_avg_ns, latencies_ns, errors,
-                 delayed, window_s, send_rate, busy_ns=0):
+                 delayed, window_s, send_rate, busy_ns=0, per_endpoint=None):
         self.throughput = throughput
         self.latency_avg_ns = latency_avg_ns
         self.latencies_ns = latencies_ns
@@ -61,6 +65,9 @@ class Measurement:
         self.window_s = window_s
         self.send_rate = send_rate
         self.busy_ns = busy_ns  # total in-request time across worker slots
+        # endpoint -> {"latencies_ns": ndarray, "errors": int} for this
+        # window (only populated when records carry endpoint identities)
+        self.per_endpoint = per_endpoint or {}
 
 
 class InferenceProfiler:
@@ -159,6 +166,23 @@ class InferenceProfiler:
             for r in records
             if r.end_ns <= window_end
         )
+        per_endpoint = {}
+        if any(r.endpoint for r in records):
+            for r in valid:
+                entry = per_endpoint.setdefault(
+                    r.endpoint, {"latencies_ns": [], "errors": 0}
+                )
+                entry["latencies_ns"].append(r.end_ns - r.start_ns)
+            for r in records:
+                if not r.ok:
+                    entry = per_endpoint.setdefault(
+                        r.endpoint, {"latencies_ns": [], "errors": 0}
+                    )
+                    entry["errors"] += 1
+            for entry in per_endpoint.values():
+                entry["latencies_ns"] = np.asarray(
+                    entry["latencies_ns"], np.int64
+                )
         return Measurement(
             throughput=len(valid) / window_s if window_s > 0 else 0.0,
             latency_avg_ns=float(lat.mean()) if lat.size else 0.0,
@@ -168,6 +192,7 @@ class InferenceProfiler:
             window_s=window_s,
             send_rate=sent / window_s if window_s > 0 else 0.0,
             busy_ns=int(busy),
+            per_endpoint=per_endpoint,
         )
 
     # -- stability loop ------------------------------------------------------
@@ -253,11 +278,46 @@ class InferenceProfiler:
             status.overhead_pct = round(
                 max(0.0, 100.0 * (1.0 - busy / total_slot_ns)), 2
             )
+        status.per_endpoint = self._per_endpoint_summary(window)
         if self.metrics is not None:
             status.tpu_metrics = self.metrics.summarize(
                 self.metrics.swap_snapshots()
             )
         return status
+
+    @staticmethod
+    def _per_endpoint_summary(window):
+        """Aggregate the windows' per-endpoint groups into the summary's
+        throughput/latency split (only meaningful past one endpoint)."""
+        endpoints = sorted({e for m in window for e in m.per_endpoint})
+        if len(endpoints) < 2:
+            return {}
+        total_s = sum(m.window_s for m in window)
+        out = {}
+        for endpoint in endpoints:
+            lat = [
+                m.per_endpoint[endpoint]["latencies_ns"]
+                for m in window
+                if endpoint in m.per_endpoint
+            ]
+            lat = (
+                np.concatenate([a for a in lat if a.size] or
+                               [np.array([], np.int64)])
+            )
+            errors = sum(
+                m.per_endpoint.get(endpoint, {}).get("errors", 0)
+                for m in window
+            )
+            out[endpoint] = {
+                "count": int(lat.size),
+                "throughput": lat.size / total_s if total_s > 0 else 0.0,
+                "avg_us": float(lat.mean()) / 1e3 if lat.size else 0.0,
+                "p99_us": (
+                    float(np.percentile(lat, 99)) / 1e3 if lat.size else 0.0
+                ),
+                "errors": int(errors),
+            }
+        return out
 
     def profile_completion(self, concurrency, window_s=8.0, warmup_s=2.0):
         """Drain-corrected completion throughput for asynchronous-dispatch

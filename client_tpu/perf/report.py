@@ -31,6 +31,14 @@ def print_summary(results, percentile=None):
             print(
                 f"    p{p} latency: {s.percentiles_us[p]:.0f} usec{governed}"
             )
+        for endpoint, ep in sorted(s.per_endpoint.items()):
+            failed = f", {ep['errors']} failed" if ep["errors"] else ""
+            print(
+                f"    endpoint {endpoint}: {ep['count']} ok, "
+                f"{ep['throughput']:.1f} infer/sec, "
+                f"avg {ep['avg_us']:.0f} usec, "
+                f"p99 {ep['p99_us']:.0f} usec{failed}"
+            )
         for gauge, agg in sorted(s.tpu_metrics.items()):
             print(
                 f"    {gauge}: avg {agg['avg']:.0f}, max {agg['max']:.0f}"

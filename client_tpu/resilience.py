@@ -51,12 +51,31 @@ __all__ = [
     "RetryPolicy",
     "Deadline",
     "CircuitBreaker",
+    "CircuitBreakerRegistry",
     "CircuitOpenError",
+    "NoHealthyEndpointError",
     "call_with_retry",
     "acall_with_retry",
+    "call_with_failover",
+    "acall_with_failover",
     "is_connection_error",
+    "is_connection_level",
     "backoff_delays",
+    "combine_timeouts",
 ]
+
+
+def combine_timeouts(a, b):
+    """Tighter of two optional timeouts in seconds (None = unbounded).
+
+    The one implementation of "cap a caller timeout by a deadline-derived
+    attempt budget" shared by the HTTP clients and the replica-set router.
+    """
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return min(a, b)
 
 # Overload / transient statuses worth retrying.  HTTP codes arrive as
 # decimal strings (the HTTP clients stringify response.status); gRPC codes
@@ -89,12 +108,39 @@ def is_connection_error(exc):
     return mod.startswith(_CONN_MODULE_PREFIXES)
 
 
+def is_connection_level(exc):
+    """Connection-level classification across wrapped and bare transport
+    exceptions: the endpoint never answered (dead/partitioned), as opposed
+    to an answered error (overload shed, drain, application failure).  The
+    one classifier shared by retry decisions and the replica-set pool's
+    UNREACHABLE marking."""
+    if exc is None:
+        return False
+    if isinstance(exc, InferenceServerException):
+        details = exc.debug_details()
+        return details is not None and is_connection_error(details)
+    return is_connection_error(exc)
+
+
 class CircuitOpenError(InferenceServerException):
     """Fast-fail raised while a circuit breaker is open.
 
     Subclasses InferenceServerException so callers' existing error handling
     sees the familiar type; ``status`` is the retryable 503 so a *different*
     endpoint's policy layered above may still route around it.
+    """
+
+    def __init__(self, msg):
+        super().__init__(msg=msg, status="503")
+
+
+class NoHealthyEndpointError(InferenceServerException):
+    """Raised when a replica-set router has no endpoint to offer.
+
+    Every endpoint is drained, unreachable, or behind an open circuit.
+    ``status`` is the retryable 503: the condition is transient by
+    construction (circuits half-open, drained replicas come back), so a
+    retry layer above may keep backing off into the router.
     """
 
     def __init__(self, msg):
@@ -252,6 +298,54 @@ class CircuitBreaker:
             self._deliver(*transition)
 
 
+class CircuitBreakerRegistry:
+    """Per-endpoint :class:`CircuitBreaker` instances sharing one config.
+
+    A replica set needs one breaker *per endpoint* (sharing a breaker
+    across endpoints would let one dead replica open the circuit against
+    its healthy peers); this registry creates them on demand, keyed by the
+    endpoint string, all with the same thresholds.
+
+    ``observer_factory(endpoint)`` (optional) builds the per-endpoint
+    observer each new breaker is born with — e.g.
+    ``client_tpu.serve.metrics.ResilienceMetricsObserver`` so every
+    endpoint's circuit state lands on /metrics under its own label.
+    """
+
+    def __init__(self, failure_threshold=5, reset_timeout_s=30.0,
+                 observer_factory=None):
+        self.failure_threshold = failure_threshold
+        self.reset_timeout_s = reset_timeout_s
+        self._observer_factory = observer_factory
+        self._lock = threading.Lock()
+        self._breakers = {}
+
+    def get(self, endpoint):
+        with self._lock:
+            breaker = self._breakers.get(endpoint)
+            if breaker is None:
+                observer = (
+                    self._observer_factory(endpoint)
+                    if self._observer_factory is not None
+                    else None
+                )
+                breaker = CircuitBreaker(
+                    failure_threshold=self.failure_threshold,
+                    reset_timeout_s=self.reset_timeout_s,
+                    name=endpoint,
+                    observer=observer,
+                )
+                self._breakers[endpoint] = breaker
+            return breaker
+
+    def states(self):
+        """{endpoint: state} snapshot (the state reads take each breaker's
+        own lock; the registry lock only guards the dict)."""
+        with self._lock:
+            breakers = dict(self._breakers)
+        return {endpoint: b.state for endpoint, b in breakers.items()}
+
+
 class RetryPolicy:
     """Retry/backoff/deadline policy for one client's unary calls.
 
@@ -311,9 +405,7 @@ class RetryPolicy:
             status = exc.status()
             if status is not None:
                 return str(status) in self.retryable_statuses
-            details = exc.debug_details()
-            return details is not None and is_connection_error(details)
-        return is_connection_error(exc)
+        return is_connection_level(exc)
 
     # -- schedule ----------------------------------------------------------
 
@@ -420,6 +512,135 @@ def call_with_retry(fn, policy):
         else:
             if breaker is not None:
                 breaker.record_success()
+            _notify(policy.observer, "on_success", attempt)
+            return result
+
+
+def _failover_step(policy, deadline, exc, attempt, retryable, fresh):
+    """Retry decision for one failed *routed* attempt: returns the backoff
+    sleep before the next attempt, or raises *exc* when the classification,
+    attempt budget, or deadline budget says stop.
+
+    ``fresh`` is True when the router still has an untried healthy replica
+    for this request: the failover hop to it is immediate (sleeping in
+    front of a different, healthy endpoint only adds latency).  Once the
+    request has cycled through every candidate the normal backoff schedule
+    applies — hammering replicas that all just failed is the retry storm
+    the schedule exists to prevent."""
+    if not retryable or attempt + 1 >= policy.max_attempts:
+        _notify(policy.observer, "on_giveup", attempt, exc)
+        raise exc
+    delay = 0.0 if fresh else policy.delay_for(exc, attempt)
+    if deadline is not None:
+        remaining = deadline.remaining()
+        if remaining <= 0 or (delay > 0 and delay >= remaining):
+            _notify(policy.observer, "on_giveup", attempt, exc)
+            raise exc
+    _notify(policy.observer, "on_backoff", attempt, delay, exc)
+    return delay
+
+
+def call_with_failover(fn, policy, route):
+    """Run one logical request under *policy*, rotating endpoints per attempt.
+
+    The replica-set twin of :func:`call_with_retry`: instead of retrying one
+    fixed endpoint, every attempt is routed —
+
+    - ``route(excluded_keys)`` returns a *lease*: an object with ``key``
+      (stable endpoint identity for exclusion), ``last_candidate`` (True
+      when no other non-excluded healthy endpoint existed at pick time),
+      and ``success()`` / ``failure(exc, retryable)`` outcome hooks (the
+      router's inflight/breaker/health accounting).  It raises
+      :class:`NoHealthyEndpointError` when nothing is routable.
+    - ``fn(lease, attempt_timeout_s_or_None)`` performs one transport
+      attempt against ``lease.endpoint`` and raises on failure.
+
+    A failed attempt's endpoint is excluded from the next ``route()`` call,
+    so a retry lands on a different healthy replica while one exists (and
+    the hop is immediate — see :func:`_failover_step`); when every
+    candidate has been tried the exclusions wrap and the backoff schedule
+    takes over.  ``NoHealthyEndpointError`` from the router is itself
+    retried on the schedule (circuits half-open, drained replicas return)
+    until the attempt or deadline budget runs out.
+    """
+    deadline = policy.new_deadline()
+    excluded = []
+    attempt = 0
+    last_exc = None
+    while True:
+        try:
+            lease = route(tuple(excluded))
+        except NoHealthyEndpointError as exc:
+            if last_exc is not None:
+                exc.__cause__ = last_exc
+            delay = _failover_step(policy, deadline, exc, attempt,
+                                   retryable=True, fresh=False)
+            attempt += 1
+            time.sleep(delay)
+            excluded = []  # the endpoint set may have recovered: retry all
+            continue
+        try:
+            result = fn(lease, deadline.attempt_timeout() if deadline else None)
+        except Exception as exc:
+            retryable = policy.retryable(exc)
+            lease.failure(exc, retryable)
+            last_exc = exc
+            fresh = not lease.last_candidate
+            if lease.key not in excluded:
+                excluded.append(lease.key)
+            else:  # wrapped onto an already-tried replica: restart rotation
+                excluded = [lease.key]
+            delay = _failover_step(policy, deadline, exc, attempt, retryable,
+                                   fresh)
+            attempt += 1
+            if delay > 0:
+                time.sleep(delay)
+        else:
+            lease.success()
+            _notify(policy.observer, "on_success", attempt)
+            return result
+
+
+async def acall_with_failover(fn, policy, route):
+    """Async twin of :func:`call_with_failover`; ``fn`` is a coroutine
+    function ``fn(lease, timeout)``; ``route`` stays synchronous (endpoint
+    selection never blocks)."""
+    deadline = policy.new_deadline()
+    excluded = []
+    attempt = 0
+    last_exc = None
+    while True:
+        try:
+            lease = route(tuple(excluded))
+        except NoHealthyEndpointError as exc:
+            if last_exc is not None:
+                exc.__cause__ = last_exc
+            delay = _failover_step(policy, deadline, exc, attempt,
+                                   retryable=True, fresh=False)
+            attempt += 1
+            await asyncio.sleep(delay)
+            excluded = []
+            continue
+        try:
+            result = await fn(
+                lease, deadline.attempt_timeout() if deadline else None
+            )
+        except Exception as exc:
+            retryable = policy.retryable(exc)
+            lease.failure(exc, retryable)
+            last_exc = exc
+            fresh = not lease.last_candidate
+            if lease.key not in excluded:
+                excluded.append(lease.key)
+            else:
+                excluded = [lease.key]
+            delay = _failover_step(policy, deadline, exc, attempt, retryable,
+                                   fresh)
+            attempt += 1
+            if delay > 0:
+                await asyncio.sleep(delay)
+        else:
+            lease.success()
             _notify(policy.observer, "on_success", attempt)
             return result
 

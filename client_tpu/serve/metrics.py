@@ -39,6 +39,9 @@ BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128)
 # CircuitBreaker state -> gauge value (closed/half-open/open).
 CIRCUIT_STATE_VALUES = {"closed": 0, "half-open": 1, "open": 2}
 
+# Endpoint health state -> gauge value (client_tpu.utils server states).
+ENDPOINT_STATE_VALUES = {"READY": 0, "NOT_READY": 1, "UNREACHABLE": 2}
+
 
 def format_labels(labels):
     """{'model': 'm'} -> '{model="m"}' with every value escaped."""
@@ -183,6 +186,47 @@ class ResilienceMetricsObserver:
             "ctpu_client_circuit_transitions_total",
             {"endpoint": self.endpoint, "to": new},
             help_="Circuit breaker state transitions",
+        )
+
+
+class BalancerMetricsObserver:
+    """Adapter feeding replica-set routing events into a metrics registry.
+
+    Attach one instance as the ``observer`` of a
+    ``client_tpu.balance.EndpointPool``::
+
+        obs = BalancerMetricsObserver()
+        pool = EndpointPool(urls, observer=obs)
+
+    Series (all per-endpoint): ``ctpu_client_routed_total`` (requests the
+    balancer sent to each replica — the convergence proof when replicas
+    die), ``ctpu_client_failovers_total`` (attempts that failed retryably
+    on a replica and rotated off it), and ``ctpu_client_endpoint_state``
+    (the pool's READY/NOT_READY/UNREACHABLE health view).
+    """
+
+    def __init__(self, registry=None):
+        self.registry = registry if registry is not None else RESILIENCE
+
+    def on_route(self, endpoint):
+        self.registry.inc(
+            "ctpu_client_routed_total", {"endpoint": endpoint},
+            help_="Requests routed to each replica by the client balancer",
+        )
+
+    def on_failover(self, endpoint):
+        self.registry.inc(
+            "ctpu_client_failovers_total", {"endpoint": endpoint},
+            help_="Attempts that failed retryably on a replica and were "
+                  "failed over",
+        )
+
+    def on_endpoint_state(self, endpoint, state):
+        self.registry.set(
+            "ctpu_client_endpoint_state", {"endpoint": endpoint},
+            ENDPOINT_STATE_VALUES.get(state, -1),
+            help_="Pool health view per endpoint "
+                  "(0=ready, 1=not-ready/draining, 2=unreachable)",
         )
 
 

@@ -95,10 +95,11 @@ class ClientTrace:
         self.timestamps = []
         self.error = None
 
-    def event(self, name, ns=None):
-        self.timestamps.append(
-            {"name": name, "ns": time.time_ns() if ns is None else ns}
-        )
+    def event(self, name, ns=None, endpoint=None):
+        record = {"name": name, "ns": time.time_ns() if ns is None else ns}
+        if endpoint:
+            record["endpoint"] = endpoint
+        self.timestamps.append(record)
 
     def traceparent(self):
         return format_traceparent(self.trace_id, self.span_id)
@@ -108,6 +109,15 @@ class ClientTrace:
         return sum(
             1 for t in self.timestamps if t["name"] == "CLIENT_ATTEMPT_START"
         )
+
+    def attempt_endpoints(self):
+        """Endpoint of each transport attempt, in order — a replica-set
+        failover shows as consecutive attempts on different endpoints."""
+        return [
+            t.get("endpoint", "")
+            for t in self.timestamps
+            if t["name"] == "CLIENT_ATTEMPT_START"
+        ]
 
     def to_json(self):
         record = {
@@ -147,18 +157,20 @@ def client_span(tracer, model_name):
 
 
 @contextlib.contextmanager
-def attempt_span(trace):
+def attempt_span(trace, endpoint=None):
     """Bracket one transport attempt with CLIENT_ATTEMPT_START/END (a
     no-op when the request is untraced) — retries through the resilience
-    layer show as repeated pairs on the same trace."""
+    layer show as repeated pairs on the same trace.  ``endpoint`` stamps
+    the attempt with the replica it targeted, so a replica-set failover
+    hop is visible as consecutive attempts on different endpoints."""
     if trace is None:
         yield
         return
-    trace.event("CLIENT_ATTEMPT_START")
+    trace.event("CLIENT_ATTEMPT_START", endpoint=endpoint)
     try:
         yield
     finally:
-        trace.event("CLIENT_ATTEMPT_END")
+        trace.event("CLIENT_ATTEMPT_END", endpoint=endpoint)
 
 
 class ClientTracer:

@@ -34,7 +34,21 @@ __all__ = [
     "serialize_bf16_tensor",
     "deserialize_bf16_tensor",
     "serialized_byte_size",
+    "SERVER_READY",
+    "SERVER_NOT_READY",
+    "SERVER_UNREACHABLE",
 ]
+
+# Server health states reported by the clients' ``server_state()`` verb.
+# ``is_server_ready()`` keeps its boolean contract; these distinguish the
+# two reasons it can answer False — a *draining* server that answered
+# not-ready (finish in-flight work, expect recovery or planned removal)
+# versus a *dead* one that never answered (route away, open the circuit).
+# The distinction is what lets a replica set treat drain and death
+# differently (client_tpu.balance).
+SERVER_READY = "READY"
+SERVER_NOT_READY = "NOT_READY"
+SERVER_UNREACHABLE = "UNREACHABLE"
 
 
 class InferenceServerException(Exception):

@@ -1,0 +1,33 @@
+"""SHARED-MUT violation, balancer-shaped: the endpoint pool's health
+prober thread reads shared routing state that request-side methods write
+without the pool lock — a probe can observe (or clobber) a half-applied
+drain mark, and the router keeps sending traffic at a replica the admin
+just pulled."""
+
+import threading
+
+
+class EndpointPool:
+    def __init__(self, urls):
+        self._lock = threading.Lock()
+        self._states = {url: "READY" for url in urls}
+        self._draining = False
+        self._prober = threading.Thread(target=self._probe_loop, daemon=True)
+
+    def _probe_loop(self):
+        while True:
+            with self._lock:
+                if self._draining:
+                    return
+                snapshot = dict(self._states)
+            self._refresh(snapshot)
+
+    def _refresh(self, snapshot):
+        pass
+
+    def mark_drained(self, url):
+        # races the prober's snapshot copy: no lock held
+        self._states = {**self._states, url: "NOT_READY"}
+
+    def shutdown(self):
+        self._draining = True  # races the prober's exit check: no lock

@@ -1,0 +1,32 @@
+"""SHARED-MUT clean twin of the balancer fixture: every endpoint-state
+write the prober thread can observe happens under the pool lock (the
+shape client_tpu/balance/pool.py ships)."""
+
+import threading
+
+
+class EndpointPool:
+    def __init__(self, urls):
+        self._lock = threading.Lock()
+        self._states = {url: "READY" for url in urls}
+        self._draining = False
+        self._prober = threading.Thread(target=self._probe_loop, daemon=True)
+
+    def _probe_loop(self):
+        while True:
+            with self._lock:
+                if self._draining:
+                    return
+                snapshot = dict(self._states)
+            self._refresh(snapshot)
+
+    def _refresh(self, snapshot):
+        pass
+
+    def mark_drained(self, url):
+        with self._lock:
+            self._states = {**self._states, url: "NOT_READY"}
+
+    def shutdown(self):
+        with self._lock:
+            self._draining = True

@@ -116,6 +116,20 @@ def test_shared_mut_clean():
     assert _scan("shared_mut_ok.py") == []
 
 
+def test_shared_mut_pool_hits():
+    """Balancer-motivated shape: endpoint-pool health state written from
+    request-side methods while the prober thread reads it."""
+    findings = _scan("shared_mut_pool_bad.py")
+    assert _rules_hit(findings) == ["SHARED-MUT"]
+    assert len(findings) == 2
+    messages = " ".join(f.message for f in findings)
+    assert "_states" in messages and "_draining" in messages
+
+
+def test_shared_mut_pool_clean():
+    assert _scan("shared_mut_pool_ok.py") == []
+
+
 def test_time_wall_hits():
     findings = _scan("time_wall_bad.py")
     assert _rules_hit(findings) == ["TIME-WALL"]

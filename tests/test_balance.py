@@ -1,0 +1,939 @@
+"""Replica-set balancing (client_tpu.balance) under real injected chaos.
+
+Unit layers: policy selection, pool health/breaker/exclusion routing, the
+resilience failover loop's rotation and budget semantics.  The acceptance
+scenario runs three real in-process servers behind the replicated client,
+kills one mid-load through the chaos TCP proxy and drains another, and
+requires zero client-visible errors, all traffic converging on the
+survivor (per-endpoint routed counters prove it), and a shared-trace-id
+record of the failover hop.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import client_tpu.grpc as grpcclient
+import client_tpu.http as httpclient
+from client_tpu.balance import (
+    AsyncReplicatedClient,
+    EndpointPool,
+    LeastInflight,
+    PowerOfTwoChoices,
+    ReplicatedClient,
+    RoundRobin,
+    Weighted,
+    make_policy,
+)
+from client_tpu.balance.pool import Endpoint
+from client_tpu.resilience import (
+    CircuitBreaker,
+    CircuitBreakerRegistry,
+    NoHealthyEndpointError,
+    RetryPolicy,
+    call_with_failover,
+)
+from client_tpu.serve import Model, Server, TensorSpec
+from client_tpu.serve.metrics import BalancerMetricsObserver, Registry
+from client_tpu.testing.faults import FaultProxy
+from client_tpu.tracing import ClientTracer, read_trace_file
+from client_tpu.utils import (
+    SERVER_NOT_READY,
+    SERVER_READY,
+    SERVER_UNREACHABLE,
+    InferenceServerException,
+)
+
+
+def _echo_model(name="echo", fn=None):
+    def echo(inputs, params, ctx):
+        return {"OUT": inputs["IN"]}
+
+    return Model(
+        name,
+        inputs=[TensorSpec("IN", "INT32", [-1, 4])],
+        outputs=[TensorSpec("OUT", "INT32", [-1, 4])],
+        fn=fn or echo,
+        max_batch_size=8,
+    )
+
+
+def _echo_inputs(mod):
+    data = np.arange(4, dtype=np.int32).reshape(1, 4)
+    inp = mod.InferInput("IN", [1, 4], "INT32")
+    inp.set_data_from_numpy(data)
+    return [inp], data
+
+
+def _fast_policy(**kw):
+    kw.setdefault("max_attempts", 6)
+    kw.setdefault("initial_backoff_s", 0.02)
+    kw.setdefault("max_backoff_s", 0.1)
+    return RetryPolicy(**kw)
+
+
+def _endpoints(n):
+    return [Endpoint(f"ep{i}") for i in range(n)]
+
+
+# -- policies ----------------------------------------------------------------
+
+
+class TestPolicies:
+    def test_round_robin_cycles(self):
+        eps = _endpoints(3)
+        policy = RoundRobin()
+        picks = [policy.pick(eps) for _ in range(6)]
+        assert sorted(p.url for p in picks) == sorted(
+            [e.url for e in eps] * 2
+        )
+
+    def test_least_inflight_picks_min(self):
+        eps = _endpoints(3)
+        eps[0].inflight = 5
+        eps[1].inflight = 1
+        eps[2].inflight = 3
+        policy = LeastInflight()
+        assert all(policy.pick(eps) is eps[1] for _ in range(4))
+
+    def test_least_inflight_rotates_ties(self):
+        eps = _endpoints(3)
+        policy = LeastInflight()
+        picks = {policy.pick(eps).url for _ in range(6)}
+        assert picks == {e.url for e in eps}
+
+    def test_power_of_two_prefers_less_loaded(self):
+        import random
+
+        eps = _endpoints(2)
+        eps[0].inflight = 10
+        policy = PowerOfTwoChoices(rng=random.Random(7))
+        assert all(policy.pick(eps) is eps[1] for _ in range(20))
+
+    def test_weighted_respects_zero_weight(self):
+        import random
+
+        eps = _endpoints(3)
+        eps[1].weight = 0.0
+        policy = Weighted(rng=random.Random(3))
+        picks = [policy.pick(eps) for _ in range(200)]
+        assert eps[1] not in picks
+        assert eps[0] in picks and eps[2] in picks
+
+    def test_make_policy_rejects_unknown(self):
+        with pytest.raises(InferenceServerException, match="unknown"):
+            make_policy("fastest-wins")
+        assert make_policy("power-of-two").name == "power-of-two"
+        rr = RoundRobin()
+        assert make_policy(rr) is rr
+
+
+# -- pool routing ------------------------------------------------------------
+
+
+class TestEndpointPool:
+    def test_lease_skips_drained_endpoint(self):
+        pool = EndpointPool(["a", "b", "c"])
+        pool.set_state("b", SERVER_NOT_READY)
+        for _ in range(9):
+            lease = pool.lease()
+            assert lease.url != "b"
+            lease.success()
+
+    def test_lease_accounts_inflight(self):
+        pool = EndpointPool(["a", "b"], policy="least-inflight")
+        l1 = pool.lease()
+        l2 = pool.lease()
+        assert {l1.url, l2.url} == {"a", "b"}  # spread by inflight
+        assert all(s["inflight"] == 1 for s in pool.snapshot())
+        l1.success()
+        l2.failure(ConnectionResetError("x"), retryable=True)
+        assert all(s["inflight"] == 0 for s in pool.snapshot())
+
+    def test_lease_prefers_fresh_then_wraps(self):
+        pool = EndpointPool(["a", "b"])
+        lease = pool.lease(excluded=("a",))
+        assert lease.url == "b"
+        assert lease.last_candidate  # 'b' was the only fresh candidate
+        lease.success()
+        wrapped = pool.lease(excluded=("a", "b"))
+        assert wrapped.last_candidate
+        wrapped.success()
+
+    def test_all_drained_raises(self):
+        pool = EndpointPool(["a", "b"])
+        pool.set_state("a", SERVER_NOT_READY)
+        pool.set_state("b", SERVER_UNREACHABLE)
+        with pytest.raises(NoHealthyEndpointError):
+            pool.lease()
+
+    def test_open_circuit_is_skipped_then_half_open_probes(self):
+        pool = EndpointPool(
+            ["a", "b"], failure_threshold=1, reset_timeout_s=0.08
+        )
+        pool.lease(excluded=("b",)).failure(
+            ConnectionResetError("down"), retryable=True
+        )
+        assert pool.breakers.get("a").state == CircuitBreaker.OPEN
+        for _ in range(4):  # open circuit never routed
+            lease = pool.lease()
+            assert lease.url == "b"
+            lease.success()
+        time.sleep(0.1)
+        # cooldown passed: 'a' may be probed again (half-open), and its
+        # probe succeeding closes the circuit
+        seen = set()
+        for _ in range(6):
+            lease = pool.lease()
+            seen.add(lease.url)
+            lease.success()
+        assert seen == {"a", "b"}
+        assert pool.breakers.get("a").state == CircuitBreaker.CLOSED
+
+    def test_every_circuit_open_raises(self):
+        pool = EndpointPool(["a"], failure_threshold=1, reset_timeout_s=60.0)
+        pool.lease().failure(ConnectionResetError("down"), retryable=True)
+        with pytest.raises(NoHealthyEndpointError, match="open"):
+            pool.lease()
+
+    def test_outcome_marks_unreachable_only_while_probing(self):
+        pool = EndpointPool(["a", "b"])
+        pool.lease(excluded=("b",)).failure(
+            ConnectionResetError("x"), retryable=True
+        )
+        assert pool.states()["a"] == SERVER_READY  # no prober: breaker only
+        states = {"a": SERVER_READY, "b": SERVER_READY}
+        pool.start_probes(lambda url: states[url], interval_s=30.0)
+        pool.lease(excluded=("b",)).failure(
+            ConnectionResetError("x"), retryable=True
+        )
+        assert pool.states()["a"] == SERVER_UNREACHABLE
+        pool.close()
+
+    def test_probe_loop_feeds_state_machine(self):
+        states = {"a": SERVER_READY, "b": SERVER_READY}
+        pool = EndpointPool(["a", "b"])
+        pool.start_probes(lambda url: states[url], interval_s=0.02)
+        states["b"] = SERVER_NOT_READY  # drain observed by probe
+        deadline = time.monotonic() + 5
+        while (
+            pool.states()["b"] != SERVER_NOT_READY
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.01)
+        assert pool.states()["b"] == SERVER_NOT_READY
+        states["b"] = SERVER_READY  # recovery observed too
+        while (
+            pool.states()["b"] != SERVER_READY
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.01)
+        assert pool.states()["b"] == SERVER_READY
+        pool.close()
+
+    def test_shared_breaker_registry_across_pools(self):
+        registry = CircuitBreakerRegistry(
+            failure_threshold=1, reset_timeout_s=60.0
+        )
+        pool1 = EndpointPool(["a", "b"], breakers=registry)
+        EndpointPool(["a", "c"], breakers=registry)
+        pool1.lease(excluded=("b",)).failure(
+            ConnectionResetError("x"), retryable=True
+        )
+        # the same endpoint's breaker is shared; others are independent
+        assert registry.states() == {
+            "a": CircuitBreaker.OPEN,
+            "b": CircuitBreaker.CLOSED,
+            "c": CircuitBreaker.CLOSED,
+        }
+
+    def test_construction_errors_are_not_retryable_routing_errors(self):
+        # config mistakes raise ValueError, not the transient 503-status
+        # NoHealthyEndpointError a retry layer would spin on
+        with pytest.raises(ValueError, match="duplicate"):
+            EndpointPool(["a", "a"])
+        with pytest.raises(ValueError, match="empty"):
+            EndpointPool([])
+
+    def test_answered_errors_never_mark_unreachable(self):
+        """An answered 503/429 (overload shed, drain) is evidence the
+        server is ALIVE: only connection-level failures may flip the
+        health state, even while probing is active."""
+        pool = EndpointPool(["a", "b"])
+        pool.start_probes(lambda url: SERVER_READY, 30.0)
+        shed = InferenceServerException("server overloaded", status="503")
+        pool.lease(excluded=("b",)).failure(shed, retryable=True)
+        assert pool.states()["a"] == SERVER_READY
+        dead = InferenceServerException(
+            "connection refused", status="503",
+            debug_details=ConnectionRefusedError("refused"),
+        )
+        pool.lease(excluded=("b",)).failure(dead, retryable=True)
+        assert pool.states()["a"] == SERVER_UNREACHABLE
+        pool.close()
+
+
+# -- the failover loop (pure, no sockets) ------------------------------------
+
+
+class _FakeLease:
+    def __init__(self, key, last_candidate=False):
+        self.key = key
+        self.last_candidate = last_candidate
+        self.outcome = None
+
+    def success(self):
+        self.outcome = "ok"
+
+    def failure(self, exc, retryable):
+        self.outcome = ("fail", retryable)
+
+
+class TestFailoverLoop:
+    def test_rotates_to_fresh_replica_immediately(self):
+        leases = {}
+
+        def route(excluded):
+            url = "b" if "a" in excluded else "a"
+            leases[url] = _FakeLease(url)
+            return leases[url]
+
+        def fn(lease, timeout_s):
+            if lease.key == "a":
+                raise ConnectionRefusedError("a is down")
+            return "served-by-" + lease.key
+
+        policy = _fast_policy(jitter=False, initial_backoff_s=0.5)
+        t0 = time.monotonic()
+        assert call_with_failover(fn, policy, route) == "served-by-b"
+        # the hop to the fresh replica must NOT pay the 0.5s backoff
+        assert time.monotonic() - t0 < 0.2
+        assert leases["a"].outcome == ("fail", True)
+        assert leases["b"].outcome == "ok"
+
+    def test_wrapped_rotation_backs_off(self):
+        calls = []
+
+        def route(excluded):
+            return _FakeLease("only", last_candidate=True)
+
+        def fn(lease, timeout_s):
+            calls.append(time.monotonic())
+            if len(calls) < 3:
+                raise ConnectionRefusedError("flaky")
+            return "ok"
+
+        policy = _fast_policy(jitter=False, initial_backoff_s=0.05,
+                              max_backoff_s=0.05)
+        assert call_with_failover(fn, policy, route) == "ok"
+        assert len(calls) == 3
+        assert calls[-1] - calls[0] >= 0.08  # two backoffs applied
+
+    def test_non_retryable_fails_without_rotation(self):
+        routed = []
+
+        def route(excluded):
+            lease = _FakeLease(f"ep{len(routed)}")
+            routed.append(lease)
+            return lease
+
+        def fn(lease, timeout_s):
+            raise InferenceServerException("bad input", status="400")
+
+        with pytest.raises(InferenceServerException, match="bad input"):
+            call_with_failover(fn, _fast_policy(), route)
+        assert len(routed) == 1
+        assert routed[0].outcome == ("fail", False)
+
+    def test_no_healthy_endpoint_is_retried_then_raised(self):
+        calls = []
+
+        def route(excluded):
+            calls.append(excluded)
+            raise NoHealthyEndpointError("all down")
+
+        policy = _fast_policy(max_attempts=3, jitter=False,
+                              initial_backoff_s=0.01)
+        with pytest.raises(NoHealthyEndpointError):
+            call_with_failover(lambda lease, t: None, policy, route)
+        assert len(calls) == 3
+
+    def test_deadline_bounds_failover_storm(self):
+        def route(excluded):
+            return _FakeLease("ep", last_candidate=True)
+
+        def fn(lease, timeout_s):
+            raise ConnectionRefusedError("down")
+
+        policy = RetryPolicy(
+            max_attempts=1000, initial_backoff_s=0.02, max_backoff_s=0.05,
+            jitter=False, deadline_s=0.3,
+        )
+        t0 = time.monotonic()
+        with pytest.raises(ConnectionRefusedError):
+            call_with_failover(fn, policy, route)
+        assert time.monotonic() - t0 < 1.0
+
+
+# -- replicated clients over real servers ------------------------------------
+
+
+def _start_servers(n, grpc=False):
+    return [
+        Server(
+            models=[_echo_model()], with_default_models=False,
+            grpc_port=0 if grpc else None,
+        ).start()
+        for _ in range(n)
+    ]
+
+
+_FAST_RECONNECT = [
+    ("grpc.initial_reconnect_backoff_ms", 50),
+    ("grpc.min_reconnect_backoff_ms", 50),
+    ("grpc.max_reconnect_backoff_ms", 100),
+]
+
+
+class TestReplicatedClient:
+    def test_http_round_robin_spreads_and_reports(self):
+        servers = _start_servers(2)
+        registry = Registry()
+        pool = EndpointPool(
+            [s.http_address for s in servers],
+            observer=BalancerMetricsObserver(registry),
+        )
+        try:
+            with ReplicatedClient(
+                pool, transport="http", probe_interval_s=None
+            ) as client:
+                inputs, data = _echo_inputs(httpclient)
+                for _ in range(6):
+                    result = client.infer("echo", inputs)
+                    np.testing.assert_array_equal(
+                        result.as_numpy("OUT"), data
+                    )
+                for s in servers:
+                    assert registry.get(
+                        "ctpu_client_routed_total",
+                        {"endpoint": s.http_address},
+                    ) == 3
+                assert client.is_server_ready()
+                assert client.is_model_ready("echo")
+                meta = client.get_server_metadata()
+                assert "name" in meta
+        finally:
+            for s in servers:
+                s.stop()
+
+    def test_grpc_failover_records_hop_on_one_trace(self):
+        servers = _start_servers(2, grpc=True)
+        proxy = FaultProxy(servers[0].grpc_address)
+        tracer = ClientTracer()
+        try:
+            with ReplicatedClient(
+                [proxy.address, servers[1].grpc_address],
+                transport="grpc",
+                probe_interval_s=None,  # the request itself must discover
+                tracer=tracer,
+                retry_policy=_fast_policy(jitter=False),
+                channel_args=_FAST_RECONNECT,
+            ) as client:
+                inputs, data = _echo_inputs(grpcclient)
+                result = client.infer("echo", inputs)  # warm both channels
+                proxy.refuse_connections(True)
+                proxy.kill_active()
+                for _ in range(4):
+                    result = client.infer("echo", inputs)
+                    np.testing.assert_array_equal(
+                        result.as_numpy("OUT"), data
+                    )
+                hops = [
+                    t.attempt_endpoints()
+                    for t in tracer.traces
+                    if len(set(t.attempt_endpoints())) > 1
+                ]
+                assert hops, "no trace recorded a failover hop"
+                assert hops[0][0] == proxy.address
+                assert hops[0][-1] == servers[1].grpc_address
+        finally:
+            proxy.close()
+            for s in servers:
+                s.stop()
+
+    def test_streaming_pins_one_healthy_replica(self):
+        servers = _start_servers(2, grpc=True)
+        try:
+            with ReplicatedClient(
+                [s.grpc_address for s in servers],
+                transport="grpc",
+                probe_interval_s=None,
+            ) as client:
+                events = []
+                got = threading.Event()
+
+                def callback(result, error):
+                    events.append((result, error))
+                    got.set()
+
+                client.start_stream(callback)
+                pinned = client._stream_lease.url
+                assert pinned in [s.grpc_address for s in servers]
+                inputs, data = _echo_inputs(grpcclient)
+                client.async_stream_infer("echo", inputs)
+                assert got.wait(timeout=10)
+                result, error = events[0]
+                assert error is None
+                np.testing.assert_array_equal(result.as_numpy("OUT"), data)
+                client.stop_stream()
+                assert all(
+                    s["inflight"] == 0 for s in client.pool.snapshot()
+                )
+        finally:
+            for s in servers:
+                s.stop()
+
+    def test_aio_http_failover(self):
+        import asyncio
+
+        import client_tpu.http.aio as aiohttpclient
+
+        servers = _start_servers(2)
+        proxy = FaultProxy(servers[0].http_address)
+
+        async def flow():
+            client = AsyncReplicatedClient(
+                [proxy.address, servers[1].http_address],
+                transport="http",
+                retry_policy=_fast_policy(jitter=False),
+            )
+            try:
+                inputs, data = _echo_inputs(aiohttpclient)
+                result = await client.infer("echo", inputs)
+                proxy.refuse_connections(True)
+                proxy.kill_active()
+                for _ in range(4):
+                    result = await client.infer("echo", inputs)
+                    np.testing.assert_array_equal(
+                        result.as_numpy("OUT"), data
+                    )
+                states = await client.refresh_states()
+                assert states[proxy.address] == SERVER_UNREACHABLE
+                assert states[servers[1].http_address] == SERVER_READY
+                assert await client.is_server_ready()
+            finally:
+                await client.close()
+
+        loop = asyncio.new_event_loop()
+        try:
+            loop.run_until_complete(flow())
+        finally:
+            loop.close()
+            proxy.close()
+            for s in servers:
+                s.stop()
+
+
+class TestTimeoutsAndOwnership:
+    def test_http_client_timeout_s_bounds_the_attempt(self):
+        """The HTTP clients' new client-side per-request timeout: a stalled
+        endpoint must fail the attempt at the bound, not at the pool-level
+        60s default."""
+        server = _start_servers(1)[0]
+        proxy = FaultProxy(server.http_address)
+        proxy.set_delay(3.0)  # hold every connection before bridging
+        try:
+            with httpclient.InferenceServerClient(proxy.address) as client:
+                inputs, _ = _echo_inputs(httpclient)
+                t0 = time.monotonic()
+                with pytest.raises(InferenceServerException):
+                    client.infer("echo", inputs, client_timeout_s=0.2)
+                assert time.monotonic() - t0 < 1.5
+        finally:
+            proxy.close()
+            server.stop()
+
+    def test_replicated_http_times_out_stalled_replica_and_fails_over(self):
+        """A replica that accepts connections but stalls must not eat the
+        whole failover budget: the per-attempt timeout aborts it and the
+        retry lands on the healthy replica."""
+        servers = _start_servers(2)
+        proxy = FaultProxy(servers[0].http_address)
+        proxy.set_delay(10.0)  # black-hole-ish: accepts, then stalls
+        try:
+            with ReplicatedClient(
+                [proxy.address, servers[1].http_address],
+                transport="http",
+                policy="round-robin",
+                probe_interval_s=None,
+                retry_policy=RetryPolicy(
+                    max_attempts=4, initial_backoff_s=0.02,
+                    max_backoff_s=0.1, deadline_s=5.0,
+                ),
+            ) as client:
+                inputs, data = _echo_inputs(httpclient)
+                t0 = time.monotonic()
+                for _ in range(2):  # round-robin guarantees a stalled pick
+                    result = client.infer(
+                        "echo", inputs, client_timeout_s=0.3
+                    )
+                    np.testing.assert_array_equal(
+                        result.as_numpy("OUT"), data
+                    )
+                assert time.monotonic() - t0 < 4.0
+        finally:
+            proxy.close()
+            for s in servers:
+                s.stop()
+
+    def test_caller_owned_pool_survives_client_close(self):
+        servers = _start_servers(2)
+        pool = EndpointPool([s.http_address for s in servers])
+        try:
+            client = ReplicatedClient(
+                pool, transport="http", probe_interval_s=None
+            )
+            inputs, _ = _echo_inputs(httpclient)
+            client.infer("echo", inputs)
+            client.close()
+            # the shared pool is untouched: still routable, still armable
+            lease = pool.lease()
+            lease.success()
+            assert pool.start_probes(lambda url: SERVER_READY,
+                                     interval_s=30.0) is True
+        finally:
+            pool.close()
+            for s in servers:
+                s.stop()
+
+    def test_owned_pool_probes_stop_on_close(self):
+        servers = _start_servers(1)
+        client = ReplicatedClient(
+            [servers[0].http_address], transport="http",
+            probe_interval_s=0.05,
+        )
+        try:
+            prober = client.pool._prober
+            assert prober is not None and prober.is_alive()
+            client.close()
+            assert client.pool._prober is None
+            assert not prober.is_alive()
+        finally:
+            servers[0].stop()
+
+    def test_pool_close_is_rearmable(self):
+        pool = EndpointPool(["a"])
+        assert pool.start_probes(lambda url: SERVER_READY, 30.0) is True
+        assert pool.start_probes(lambda url: SERVER_READY, 30.0) is False
+        pool.close()
+        assert pool.start_probes(lambda url: SERVER_READY, 30.0) is True
+        pool.close()
+
+    def test_no_unreachable_marking_after_probes_stop(self):
+        """Once close() stops the prober, a transient retryable failure
+        must not strand an endpoint UNREACHABLE (nothing is left to
+        recover it; the breaker alone gates then)."""
+        pool = EndpointPool(["a", "b"])
+        pool.start_probes(lambda url: SERVER_READY, 30.0)
+        pool.close()
+        pool.lease(excluded=("b",)).failure(
+            ConnectionResetError("x"), retryable=True
+        )
+        assert pool.states()["a"] == SERVER_READY
+
+    def test_breaker_observer_may_read_pool_during_lease(self):
+        """lease() delivers breaker transitions OUTSIDE the pool lock: an
+        observer that looks back at the pool must not deadlock."""
+        seen = []
+        pool_ref = []
+
+        class PoolReadingObserver:
+            def on_state_change(self, old, new):
+                # would deadlock if delivered under the pool lock
+                seen.append((new, pool_ref[0].states()))
+
+        registry = CircuitBreakerRegistry(
+            failure_threshold=1, reset_timeout_s=0.05,
+            observer_factory=lambda endpoint: PoolReadingObserver(),
+        )
+        pool = EndpointPool(["a"], breakers=registry)
+        pool_ref.append(pool)
+        pool.lease().failure(ConnectionResetError("x"), retryable=True)
+        time.sleep(0.06)
+        result = []
+        worker = threading.Thread(
+            target=lambda: result.append(pool.lease())
+        )
+        worker.start()
+        worker.join(timeout=5)
+        assert not worker.is_alive(), "lease() deadlocked on the observer"
+        result[0].success()
+        assert any(state == "half-open" for state, _ in seen)
+
+
+# -- drain vs death distinction (satellite) ----------------------------------
+
+
+class TestServerStateVerb:
+    def test_http_and_grpc_three_states(self):
+        server = Server(
+            models=[_echo_model()], with_default_models=False, grpc_port=0
+        ).start()
+        http = httpclient.InferenceServerClient(server.http_address)
+        grpc_c = grpcclient.InferenceServerClient(server.grpc_address)
+        try:
+            assert http.server_state() == SERVER_READY
+            assert grpc_c.server_state() == SERVER_READY
+            server.engine.drain(timeout_s=5)  # frontends stay up
+            assert http.server_state() == SERVER_NOT_READY
+            assert grpc_c.server_state() == SERVER_NOT_READY
+            assert http.is_server_ready() is False  # bool contract intact
+            assert grpc_c.is_server_ready() is False
+        finally:
+            http.close()
+            grpc_c.close()
+            server.stop()
+        # frontends gone: the same probes now answer UNREACHABLE
+        http = httpclient.InferenceServerClient(server.http_address)
+        try:
+            assert http.server_state() == SERVER_UNREACHABLE
+        finally:
+            http.close()
+
+    def test_aio_three_states(self):
+        import asyncio
+
+        import client_tpu.grpc.aio as aiogrpc
+        import client_tpu.http.aio as aiohttpclient
+
+        server = Server(
+            models=[_echo_model()], with_default_models=False, grpc_port=0
+        ).start()
+
+        async def flow():
+            async with aiohttpclient.InferenceServerClient(
+                server.http_address
+            ) as http, aiogrpc.InferenceServerClient(
+                server.grpc_address
+            ) as grpc_c:
+                assert await http.server_state() == SERVER_READY
+                assert await grpc_c.server_state() == SERVER_READY
+                server.engine.drain(timeout_s=5)
+                assert await http.server_state() == SERVER_NOT_READY
+                assert await grpc_c.server_state() == SERVER_NOT_READY
+
+        loop = asyncio.new_event_loop()
+        try:
+            loop.run_until_complete(flow())
+        finally:
+            loop.close()
+            server.stop()
+
+
+# -- acceptance: chaos over three replicas -----------------------------------
+
+
+class TestChaosReplicaSet:
+    def test_kill_one_drain_one_under_load(self, tmp_path):
+        """Three replicas under concurrent load; one dies mid-load (chaos
+        proxy), one drains gracefully.  Zero client-visible errors, all
+        traffic converges on the survivor, metrics and traces prove it."""
+        servers = _start_servers(3)
+        proxy = FaultProxy(servers[0].http_address)  # replica A: the victim
+        url_a = proxy.address
+        url_b = servers[1].http_address  # replica B: drained mid-load
+        url_c = servers[2].http_address  # replica C: survivor
+        trace_file = str(tmp_path / "trace.jsonl")
+        registry = Registry()
+        pool = EndpointPool(
+            [url_a, url_b, url_c],
+            policy="least-inflight",
+            observer=BalancerMetricsObserver(registry),
+            failure_threshold=2,
+            reset_timeout_s=60.0,
+        )
+        tracer = ClientTracer(trace_file=trace_file, max_traces=10000)
+        client = ReplicatedClient(
+            pool,
+            transport="http",
+            tracer=tracer,
+            probe_interval_s=0.05,
+            retry_policy=RetryPolicy(
+                max_attempts=8, initial_backoff_s=0.02, max_backoff_s=0.2,
+                deadline_s=20.0,
+            ),
+        )
+        errors = []
+        done = [0]
+        lock = threading.Lock()
+
+        def worker():
+            inputs, data = _echo_inputs(httpclient)
+            for _ in range(40):
+                try:
+                    result = client.infer("echo", inputs)
+                    np.testing.assert_array_equal(
+                        result.as_numpy("OUT"), data
+                    )
+                except Exception as exc:  # noqa: BLE001 - recorded, asserted
+                    with lock:
+                        errors.append(exc)
+                with lock:
+                    done[0] += 1
+
+        threads = [threading.Thread(target=worker) for _ in range(4)]
+        try:
+            for t in threads:
+                t.start()
+            # let all three replicas take traffic, then kill A hard
+            deadline = time.monotonic() + 10
+            while done[0] < 30 and time.monotonic() < deadline:
+                time.sleep(0.005)
+            proxy.refuse_connections(True)
+            proxy.kill_active()
+            # and drain B gracefully while requests are still flowing
+            time.sleep(0.1)
+            assert servers[1].engine.drain(timeout_s=10) is True
+            for t in threads:
+                t.join(timeout=60)
+            assert not any(t.is_alive() for t in threads)
+
+            # 1) zero non-retryable client errors: every request landed
+            assert errors == []
+            assert done[0] == 160
+
+            # 2) the pool learned both conditions, each with the right state
+            deadline = time.monotonic() + 5
+            while (
+                client.states() != {
+                    url_a: SERVER_UNREACHABLE,
+                    url_b: SERVER_NOT_READY,
+                    url_c: SERVER_READY,
+                }
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.02)
+            assert client.states() == {
+                url_a: SERVER_UNREACHABLE,
+                url_b: SERVER_NOT_READY,
+                url_c: SERVER_READY,
+            }
+
+            # 3) convergence: new traffic routes ONLY to the survivor
+            def routed(url):
+                return registry.get(
+                    "ctpu_client_routed_total", {"endpoint": url}
+                ) or 0
+
+            before = {u: routed(u) for u in (url_a, url_b, url_c)}
+            inputs, _ = _echo_inputs(httpclient)
+            for _ in range(10):
+                client.infer("echo", inputs)
+            assert routed(url_a) == before[url_a]
+            assert routed(url_b) == before[url_b]
+            assert routed(url_c) == before[url_c] + 10
+            # every replica carried load before the chaos
+            assert before[url_a] > 0 and before[url_b] > 0
+
+            # 4) the kill produced recorded failovers off replica A
+            assert (
+                registry.get(
+                    "ctpu_client_failovers_total", {"endpoint": url_a}
+                )
+                >= 1
+            )
+            # and the endpoint-state gauge mirrors the pool view
+            assert registry.get(
+                "ctpu_client_endpoint_state", {"endpoint": url_a}
+            ) == 2
+            assert registry.get(
+                "ctpu_client_endpoint_state", {"endpoint": url_b}
+            ) == 1
+
+            # 5) the failover hop is on the trace timeline: some span holds
+            # consecutive attempts on different endpoints under ONE trace id
+            hop_traces = [
+                t for t in tracer.traces
+                if len(set(t.attempt_endpoints())) > 1
+            ]
+            assert hop_traces, "no trace recorded a failover hop"
+            hop = hop_traces[0]
+            assert hop.attempt_endpoints()[0] != hop.attempt_endpoints()[-1]
+            # the exported records carry the same trace id and endpoints
+            exported = [
+                r for r in read_trace_file(trace_file)
+                if r["trace_id"] == hop.trace_id
+            ]
+            assert len(exported) == 1
+            starts = [
+                t for t in exported[0]["timestamps"]
+                if t["name"] == "CLIENT_ATTEMPT_START"
+            ]
+            assert len({t.get("endpoint") for t in starts}) > 1
+        finally:
+            client.close()
+            proxy.close()
+            for s in servers:
+                s.stop()
+
+    def test_failover_hop_joins_server_span_under_one_trace_id(
+        self, tmp_path
+    ):
+        """The surviving replica's server span joins the client's failover
+        span under the same trace id — the hop AND the successful landing
+        are one timeline."""
+        servers = _start_servers(2)
+        proxy = FaultProxy(servers[0].http_address)
+        trace_file = str(tmp_path / "trace.jsonl")
+        with httpclient.InferenceServerClient(servers[1].http_address) as c:
+            c.update_trace_settings(settings={
+                "trace_level": ["TIMESTAMPS"],
+                "trace_rate": "1",
+                "trace_count": "-1",
+                "trace_file": trace_file,
+            })
+        tracer = ClientTracer(trace_file=trace_file)
+        client = ReplicatedClient(
+            [proxy.address, servers[1].http_address],
+            transport="http",
+            policy="round-robin",
+            probe_interval_s=None,
+            tracer=tracer,
+            retry_policy=_fast_policy(jitter=False),
+        )
+        try:
+            proxy.refuse_connections(True)
+            inputs, data = _echo_inputs(httpclient)
+            hop_trace = None
+            for _ in range(4):  # round-robin lands on the dead replica soon
+                result = client.infer("echo", inputs)
+                np.testing.assert_array_equal(result.as_numpy("OUT"), data)
+                for t in tracer.traces:
+                    if len(set(t.attempt_endpoints())) > 1:
+                        hop_trace = t
+                if hop_trace is not None:
+                    break
+            assert hop_trace is not None
+            joined = [
+                r for r in read_trace_file(trace_file)
+                if r["trace_id"] == hop_trace.trace_id
+            ]
+            sources = {r["source"] for r in joined}
+            assert sources == {"client", "server"}
+            client_rec = next(r for r in joined if r["source"] == "client")
+            server_rec = next(r for r in joined if r["source"] == "server")
+            assert server_rec["parent_span_id"] == client_rec["span_id"]
+            endpoints = [
+                t.get("endpoint")
+                for t in client_rec["timestamps"]
+                if t["name"] == "CLIENT_ATTEMPT_START"
+            ]
+            assert endpoints[0] == proxy.address  # the failed first attempt
+            assert endpoints[-1] == servers[1].http_address  # the landing
+        finally:
+            client.close()
+            proxy.close()
+            for s in servers:
+                s.stop()

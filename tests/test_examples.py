@@ -33,6 +33,7 @@ GRPC_EXAMPLES = [
     "simple_grpc_keepalive_client.py",
     "simple_grpc_custom_args_client.py",
     "simple_grpc_custom_repeat.py",
+    "simple_grpc_replicated_client.py",
     "ensemble_client.py",
     "ensemble_image_client.py",
     "reuse_infer_objects_client.py",
@@ -51,6 +52,7 @@ HTTP_EXAMPLES = [
     "simple_http_health_metadata.py",
     "simple_http_model_control.py",
     "simple_http_sequence_sync_infer_client.py",
+    "simple_http_replicated_client.py",
     "simple_http_shm_client.py",
     "simple_http_shm_string_client.py",
     "simple_http_tpushm_client.py",

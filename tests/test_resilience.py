@@ -199,6 +199,95 @@ class TestPolicyUnits:
         assert d.attempt_timeout() == 0.0
 
 
+class TestDeadlineAttemptTimeout:
+    """Direct coverage of the per-attempt timeout derivation — previously
+    only exercised indirectly through client e2e retries."""
+
+    def test_cap_below_remaining_wins(self):
+        d = Deadline(10.0)
+        assert d.attempt_timeout(cap=0.5) == 0.5
+
+    def test_remaining_wins_when_cap_above_budget(self):
+        d = Deadline(0.2)
+        t = d.attempt_timeout(cap=5.0)
+        assert 0 < t <= 0.2
+
+    def test_no_cap_returns_remaining(self):
+        d = Deadline(0.5)
+        t = d.attempt_timeout()
+        assert 0 < t <= 0.5
+
+    def test_expired_budget_clamps_to_zero(self):
+        d = Deadline(0.01)
+        time.sleep(0.02)
+        # an expired budget must never produce a negative transport
+        # timeout (urllib3/aiohttp/grpc all reject those)
+        assert d.attempt_timeout() == 0.0
+        assert d.attempt_timeout(cap=3.0) == 0.0
+        assert d.attempt_timeout(cap=0.0) == 0.0
+
+    def test_zero_or_negative_budget_rejected_at_construction(self):
+        for bad in (0, -1, -0.5, None):
+            with pytest.raises(ValueError):
+                Deadline(bad)
+
+
+class TestHalfOpenSingleProbeRace:
+    """The half-open gate under real thread contention: exactly one of N
+    simultaneous callers may probe a cooled-down open circuit."""
+
+    def _race(self, breaker, n=8):
+        barrier = threading.Barrier(n)
+        outcomes = []
+        lock = threading.Lock()
+
+        def contender():
+            barrier.wait()
+            try:
+                breaker.before_attempt()
+            except CircuitOpenError:
+                with lock:
+                    outcomes.append("rejected")
+            else:
+                with lock:
+                    outcomes.append("admitted")
+
+        threads = [threading.Thread(target=contender) for _ in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        assert not any(t.is_alive() for t in threads)
+        return outcomes
+
+    def test_exactly_one_contender_probes(self):
+        breaker = CircuitBreaker(failure_threshold=1, reset_timeout_s=0.05)
+        breaker.record_failure()
+        assert breaker.state == CircuitBreaker.OPEN
+        time.sleep(0.06)
+        outcomes = self._race(breaker)
+        assert outcomes.count("admitted") == 1
+        assert outcomes.count("rejected") == len(outcomes) - 1
+        assert breaker.state == CircuitBreaker.HALF_OPEN
+
+    def test_failed_probe_reopens_and_regates_next_herd(self):
+        breaker = CircuitBreaker(failure_threshold=1, reset_timeout_s=0.05)
+        breaker.record_failure()
+        time.sleep(0.06)
+        assert self._race(breaker).count("admitted") == 1
+        breaker.record_failure()  # the probe failed: straight back to open
+        assert breaker.state == CircuitBreaker.OPEN
+        with pytest.raises(CircuitOpenError):
+            breaker.before_attempt()  # cooldown restarted
+        time.sleep(0.06)
+        assert self._race(breaker).count("admitted") == 1  # one new probe
+        breaker.record_success()
+        assert breaker.state == CircuitBreaker.CLOSED
+        # closed circuit admits everyone again
+        outcomes = self._race(breaker)
+        assert outcomes.count("admitted") == len(outcomes)
+
+
 # -- scenario 1+2: delay and error-then-succeed over HTTP -------------------
 
 
