@@ -17,7 +17,7 @@ CXXFLAGS ?= -O2 -fPIC -Wall -std=c++17
 NATIVE_OUT := client_tpu/utils/shared_memory
 TPUSHM_OUT := client_tpu/utils/tpu_shared_memory
 
-.PHONY: all protos native cpp clean test asan java java-bindings lint check
+.PHONY: all protos native cpp clean test asan java java-bindings lint check soak
 
 lint:
 	python -m client_tpu.analysis client_tpu tests
@@ -28,6 +28,18 @@ check: lint
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
 	    --continue-on-collection-errors -p no:cacheprovider \
 	    -p no:xdist -p no:randomly
+
+# Churn soak: the slow tier tier-1 excludes — repeats the replica-churn
+# chaos acceptance (discovery add/retire, stream-pinned kill, resolver
+# flap) SOAK_N times; churn bugs are timing bugs, repetition finds them.
+SOAK_N ?= 3
+soak:
+	@for i in $$(seq 1 $(SOAK_N)); do \
+	  echo "== soak round $$i/$(SOAK_N) =="; \
+	  JAX_PLATFORMS=cpu python -m pytest tests/test_discovery.py \
+	      tests/test_balance.py -q -m slow -p no:cacheprovider \
+	      -p no:xdist -p no:randomly || exit 1; \
+	done
 
 all: protos native cpp
 

@@ -47,6 +47,14 @@ _DEADLINE_NAME_RE = re.compile(r"(?i)(deadline|expires?|expiry|_until$|^until$)"
 _LABEL_OPEN_RE = re.compile(r'[A-Za-z_][A-Za-z0-9_]*="$')
 # sanctioned escape helpers for label values (serve/metrics.escape_label)
 _LABEL_ESCAPERS = {"escape_label", "_escape_label"}
+# in-place collection mutators (list/dict/set/deque) that race readers just
+# like an assignment does — the discovery-membership shape (SHARED-MUT).
+# Deliberately excludes names that are atomic/thread-safe on their common
+# receivers (queue put/get, Event set/clear) to keep the gate quiet.
+_COLLECTION_MUTATORS = {
+    "append", "appendleft", "extend", "extendleft", "insert", "remove",
+    "clear", "discard", "popitem", "setdefault",
+}
 
 
 def _expr_text(node):
@@ -770,10 +778,12 @@ class SharedMutRule(Rule):
 
     For every class that spawns ``threading.Thread(target=self.<m>)``,
     the attributes that thread closure touches are shared state: any
-    assignment to them from OTHER methods must happen under a lock
-    (lexically inside ``with *lock/cv/cond:`` or in a ``*_locked``
-    method, this repo's caller-holds-the-lock convention), or in
-    ``__init__`` before the thread can exist.
+    assignment to them — or in-place collection mutation
+    (``self._endpoints.append(...)``, the live-discovery membership
+    shape) — from OTHER methods must happen under a lock (lexically
+    inside ``with *lock/cv/cond:`` or in a ``*_locked`` method, this
+    repo's caller-holds-the-lock convention), or in ``__init__`` before
+    the thread can exist.
     """
 
     id = "SHARED-MUT"
@@ -854,33 +864,53 @@ class SharedMutRule(Rule):
                     for sub in ast.walk(node):
                         locked_nodes.add(id(sub))
             for node in ast.walk(fn):
-                if not isinstance(node, (ast.Assign, ast.AugAssign)):
-                    continue
                 if id(node) in locked_nodes:
                     continue
-                targets = (
-                    node.targets
-                    if isinstance(node, ast.Assign)
-                    else [node.target]
-                )
-                flat = []
-                for t in targets:
-                    flat.extend(
-                        t.elts if isinstance(t, (ast.Tuple, ast.List))
-                        else [t]
+                if isinstance(node, (ast.Assign, ast.AugAssign)):
+                    targets = (
+                        node.targets
+                        if isinstance(node, ast.Assign)
+                        else [node.target]
                     )
-                for t in flat:
-                    if (
-                        isinstance(t, ast.Attribute)
-                        and isinstance(t.value, ast.Name)
-                        and t.value.id == "self"
-                        and t.attr in shared
-                    ):
-                        findings.append(self.finding(
-                            path, lines, node,
-                            f"self.{t.attr} is touched by the "
-                            f"{'/'.join(sorted(closure))} thread closure "
-                            f"but written here ({name}) without the "
-                            "lock",
-                        ))
+                    flat = []
+                    for t in targets:
+                        flat.extend(
+                            t.elts if isinstance(t, (ast.Tuple, ast.List))
+                            else [t]
+                        )
+                    for t in flat:
+                        if self._is_shared_attr(t, shared):
+                            findings.append(self.finding(
+                                path, lines, node,
+                                f"self.{t.attr} is touched by the "
+                                f"{'/'.join(sorted(closure))} thread "
+                                f"closure but written here ({name}) "
+                                "without the lock",
+                            ))
+                elif (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _COLLECTION_MUTATORS
+                    and self._is_shared_attr(node.func.value, shared)
+                ):
+                    # in-place mutation races the reader exactly like an
+                    # assignment: a prober iterating self._endpoints while
+                    # update_endpoints appends/removes sees a torn list
+                    findings.append(self.finding(
+                        path, lines, node,
+                        f"self.{node.func.value.attr}."
+                        f"{node.func.attr}() mutates state the "
+                        f"{'/'.join(sorted(closure))} thread closure "
+                        f"reads, here ({name}) without the lock",
+                    ))
         return findings
+
+    @staticmethod
+    def _is_shared_attr(node, shared):
+        """Whether *node* is ``self.<attr>`` for a thread-shared attr."""
+        return (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+            and node.attr in shared
+        )

@@ -1,50 +1,93 @@
-"""Client-side replica set: health/circuit-aware load balancing.
+"""Client-side replica set: self-healing, health/circuit-aware balancing.
 
 Turns N independent KServe-v2 endpoints into one logical service for all
 four clients (sync/aio × HTTP/gRPC):
 
 - :class:`EndpointPool` — endpoint registry with per-endpoint circuit
-  breaker, health state machine (fed by background readiness probes and
-  per-request outcomes), routing weight, and live inflight count.
+  breaker, health state machine (fed by jittered background readiness
+  probes and per-request outcomes), live membership
+  (``update_endpoints``: probation for new replicas, graceful retire for
+  removed ones, a safety valve for the last healthy endpoint), routing
+  weight, and live inflight count.
+- Discovery (:mod:`client_tpu.balance.discovery`) — pluggable
+  :class:`Resolver` sources (static list, config-file watcher,
+  DNS-style callable) polled by a :class:`DiscoveryLoop` that feeds the
+  pool; resolver errors keep last-known-good membership.
 - Policies (:mod:`client_tpu.balance.policy`) — round-robin,
-  least-inflight, power-of-two-choices, weighted — behind one
-  ``pick(candidates, request_ctx)`` interface.
+  least-inflight, power-of-two-choices, weighted, and sticky (sequence-
+  affine, with the :class:`SequenceRestartError` restart contract) —
+  behind one ``pick(candidates, request_ctx)`` interface.
 - :class:`ReplicatedClient` / :class:`AsyncReplicatedClient` — the
   existing client API over a pool: every request (and every retry
   attempt, which excludes the failed endpoint) routes to a different
-  healthy replica, respecting drain and open circuits.
+  healthy replica, respecting drain, probation/retire, and open circuits.
+- :class:`ResilientStream` / ``resilient_stream_infer`` — replica-aware
+  streaming reconnect: a mid-stream replica death hops the stream to a
+  fresh replica, replaying only unacknowledged requests and deduping
+  duplicate responses by request id.
 
 Built on the resilience layer (`client_tpu.resilience`:
 ``call_with_failover``, ``CircuitBreakerRegistry``) and observable
 through the metrics (`serve.metrics.BalancerMetricsObserver`) and tracing
 (endpoint-stamped CLIENT_ATTEMPT spans) surfaces.  See README
-"Replication & load balancing".
+"Replication & load balancing" and "Self-healing & discovery".
 """
 
+from client_tpu.balance.discovery import (
+    CallableResolver,
+    ConfigFileResolver,
+    DiscoveryLoop,
+    Resolver,
+    StaticResolver,
+    make_resolver,
+)
 from client_tpu.balance.policy import (
     LeastInflight,
     Policy,
     PowerOfTwoChoices,
     RoundRobin,
+    SequenceRestartError,
+    Sticky,
     Weighted,
     make_policy,
 )
-from client_tpu.balance.pool import Endpoint, EndpointPool, Lease
+from client_tpu.balance.pool import (
+    PHASE_ACTIVE,
+    PHASE_PROBATION,
+    PHASE_RETIRING,
+    Endpoint,
+    EndpointPool,
+    Lease,
+)
 from client_tpu.balance.replicated import (
     AsyncReplicatedClient,
     ReplicatedClient,
 )
+from client_tpu.balance.stream import ResilientStream, aio_resilient_stream
 
 __all__ = [
     "Endpoint",
     "EndpointPool",
     "Lease",
+    "PHASE_ACTIVE",
+    "PHASE_PROBATION",
+    "PHASE_RETIRING",
     "Policy",
     "RoundRobin",
     "LeastInflight",
     "PowerOfTwoChoices",
     "Weighted",
+    "Sticky",
+    "SequenceRestartError",
     "make_policy",
+    "Resolver",
+    "StaticResolver",
+    "CallableResolver",
+    "ConfigFileResolver",
+    "make_resolver",
+    "DiscoveryLoop",
     "ReplicatedClient",
     "AsyncReplicatedClient",
+    "ResilientStream",
+    "aio_resilient_stream",
 ]

@@ -1,0 +1,210 @@
+"""Live endpoint discovery: resolvers feeding EndpointPool.update_endpoints.
+
+A *resolver* answers "what replicas exist right now?" — the source of
+truth a fleet actually has (a config file an operator edits, a DNS-style
+lookup, a service-registry callable).  The :class:`DiscoveryLoop` polls
+one and applies the answer to a live pool:
+
+- resolved membership goes through
+  :meth:`~client_tpu.balance.pool.EndpointPool.update_endpoints` (new
+  endpoints enter probation, removed ones retire gracefully, the last
+  healthy endpoint is never evicted);
+- a resolver ERROR keeps the last-known-good membership — a registry
+  outage must not look like a fleet-wide scale-down (the loop records the
+  error and keeps serving on what it last saw).
+
+Resolvers return an iterable of endpoint specs in the pool's vocabulary:
+url strings or ``(url, weight)`` pairs.
+
+This module is stdlib-only and thread-safe where it needs to be; the
+loop's poller is a daemon thread, and :meth:`DiscoveryLoop.refresh_now`
+gives tests and CLIs a synchronous poke.
+"""
+
+import json
+import threading
+
+__all__ = [
+    "Resolver",
+    "StaticResolver",
+    "CallableResolver",
+    "ConfigFileResolver",
+    "make_resolver",
+    "DiscoveryLoop",
+]
+
+
+class Resolver:
+    """Interface: :meth:`resolve` returns the current endpoint specs
+    (url strings or ``(url, weight)`` pairs).  Raise on failure — the
+    discovery loop treats an exception as "keep last-known-good", never
+    as an empty fleet."""
+
+    def resolve(self):
+        raise NotImplementedError
+
+
+class StaticResolver(Resolver):
+    """A fixed list (the no-discovery degenerate case, useful to unify
+    code paths and tests)."""
+
+    def __init__(self, endpoints):
+        self._endpoints = [
+            tuple(e) if isinstance(e, (tuple, list)) else str(e)
+            for e in endpoints
+        ]
+
+    def resolve(self):
+        return list(self._endpoints)
+
+
+class CallableResolver(Resolver):
+    """Wrap any ``fn() -> endpoint specs`` (a DNS lookup, a service
+    registry client, a test harness mutating membership)."""
+
+    def __init__(self, fn):
+        self._fn = fn
+
+    def resolve(self):
+        return self._fn()
+
+
+class ConfigFileResolver(Resolver):
+    """Membership from a config file an operator (or orchestrator) edits.
+
+    Two formats, sniffed per read:
+
+    - JSON: a list of url strings or ``[url, weight]`` pairs, or an
+      object ``{"endpoints": [...]}``;
+    - plain text: one endpoint per line, ``url`` or ``url weight``,
+      ``#`` comments and blank lines ignored.
+
+    Reads the file on every :meth:`resolve` (discovery intervals are
+    seconds; an mtime cache would only save a stat).  A missing or
+    unparseable file raises — the loop keeps last-known-good.
+    """
+
+    def __init__(self, path):
+        self.path = str(path)
+
+    def resolve(self):
+        with open(self.path, "r", encoding="utf-8") as f:
+            text = f.read()
+        stripped = text.lstrip()
+        if stripped.startswith(("[", "{")):
+            data = json.loads(stripped)
+            if isinstance(data, dict):
+                data = data["endpoints"]
+            return [
+                (str(e[0]), float(e[1]))
+                if isinstance(e, (list, tuple)) else str(e)
+                for e in data
+            ]
+        specs = []
+        for line in text.splitlines():
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            if len(parts) == 1:
+                specs.append(parts[0])
+            else:
+                specs.append((parts[0], float(parts[1])))
+        return specs
+
+
+def make_resolver(spec):
+    """Resolver from a Resolver, a callable, a path string, or a list."""
+    if isinstance(spec, Resolver):
+        return spec
+    if callable(spec):
+        return CallableResolver(spec)
+    if isinstance(spec, str):
+        return ConfigFileResolver(spec)
+    return StaticResolver(spec)
+
+
+class DiscoveryLoop:
+    """Poll a resolver and keep a pool's membership current.
+
+    Parameters
+    ----------
+    pool : the live :class:`~client_tpu.balance.pool.EndpointPool`.
+    resolver : anything :func:`make_resolver` accepts.
+    interval_s : polling period (the poller thread is a daemon).
+    on_update : optional ``fn(summary)`` called after each APPLIED update
+        (the dict ``update_endpoints`` returns) — logging/test hook.
+
+    Error containment: a resolver exception (or a membership the pool
+    rejects, e.g. an empty list) leaves the pool on its last-known-good
+    membership; the loop counts it (:attr:`errors`, :attr:`last_error`)
+    and keeps polling.
+    """
+
+    def __init__(self, pool, resolver, interval_s=30.0, on_update=None):
+        self.pool = pool
+        self.resolver = make_resolver(resolver)
+        self.interval_s = float(interval_s)
+        self.on_update = on_update
+        self._lock = threading.Lock()
+        self._thread = None
+        self._stop = threading.Event()
+        self.updates = 0
+        self.errors = 0
+        self.last_error = None
+
+    def refresh_now(self):
+        """One synchronous resolve+apply.  Returns the update summary, or
+        None when the resolver (or the pool) rejected this round — the
+        pool keeps its last-known-good membership either way."""
+        try:
+            specs = list(self.resolver.resolve())
+            summary = self.pool.update_endpoints(specs)
+        except Exception as exc:  # noqa: BLE001 - containment is the point
+            with self._lock:
+                self.errors += 1
+                self.last_error = exc
+            return None
+        with self._lock:
+            self.updates += 1
+        if self.on_update is not None:
+            try:
+                self.on_update(summary)
+            except Exception:
+                pass
+        return summary
+
+    def start(self):
+        with self._lock:
+            if self._thread is not None:
+                return self
+            stop = threading.Event()
+            self._stop = stop
+            thread = threading.Thread(
+                target=self._run, args=(stop,),
+                name="endpoint-discovery", daemon=True,
+            )
+            self._thread = thread
+        thread.start()
+        return self
+
+    def _run(self, stop):
+        while not stop.is_set():
+            self.refresh_now()
+            if stop.wait(self.interval_s):
+                return
+
+    def close(self):
+        with self._lock:
+            thread = self._thread
+            self._thread = None
+            stop = self._stop
+        stop.set()
+        if thread is not None:
+            thread.join(timeout=5)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -13,12 +13,19 @@ One interface — ``pick(candidates, request_ctx)`` over the pool's eligible
   herd-to-the-minimum behavior when many clients share stale load views.
 - **weighted**: stationary weighted-random split, for canaries and
   capacity-skewed fleets.
+- **sticky**: sequence-affine routing — every request of one sequence id
+  lands on one replica (the server's ``SequenceContext`` state lives on
+  exactly one replica); when that replica dies mid-sequence the policy
+  remaps the sequence and surfaces :class:`SequenceRestartError` so the
+  caller restarts the sequence instead of silently splitting its state
+  across replicas.
 
 Policies are invoked with the pool lock held: they may keep unguarded
-internal state (the round-robin cursor), and they must never block or
-call back into the pool.
+internal state (the round-robin cursor, the sticky sequence map), and
+they must never block or call back into the pool.
 """
 
+import collections
 import random
 
 from client_tpu.utils import InferenceServerException
@@ -29,8 +36,39 @@ __all__ = [
     "LeastInflight",
     "PowerOfTwoChoices",
     "Weighted",
+    "Sticky",
+    "SequenceRestartError",
     "make_policy",
 ]
+
+
+class SequenceRestartError(InferenceServerException):
+    """The replica holding this sequence's state is gone; the sequence was
+    remapped to a fresh replica.
+
+    Raised by the sticky policy instead of silently routing a mid-sequence
+    request at a replica that never saw the sequence (which would fork its
+    state).  The condition is *restartable*: the new mapping is already
+    installed, so re-sending the sequence from its start
+    (``sequence_start=True``) lands it whole on the new replica.  The
+    status is 409 (conflict) — deliberately NOT in the retry layer's
+    retryable set, because replaying only the failed request (what a retry
+    would do) is exactly the state split this error exists to prevent.
+    """
+
+    def __init__(self, sequence_id, dead_endpoint, new_endpoint):
+        super().__init__(
+            msg=(
+                f"sequence {sequence_id!r} was pinned to "
+                f"{dead_endpoint!r}, which is no longer routable; remapped "
+                f"to {new_endpoint!r} — restart the sequence "
+                "(sequence_start=True) to rebuild its state there"
+            ),
+            status="409",
+        )
+        self.sequence_id = sequence_id
+        self.dead_endpoint = dead_endpoint
+        self.new_endpoint = new_endpoint
 
 
 class Policy:
@@ -115,17 +153,79 @@ class Weighted(Policy):
         return candidates[-1]
 
 
+class Sticky(Policy):
+    """Sequence-affine routing over ``request_ctx['sequence_id']``.
+
+    Requests without a sequence id fall through to *fallback* (so one
+    pool serves mixed stateless + sequence traffic).  A sequence's first
+    request (or any ``sequence_start``) maps it to a fallback-picked
+    replica; later requests return the mapped replica as long as it is
+    still a candidate.  When it is not — dead, drained, retired, or
+    excluded after a failed attempt — the policy remaps the sequence to a
+    fresh replica and raises :class:`SequenceRestartError` (see its
+    docstring for the restart contract).  ``sequence_end`` drops the
+    mapping; an LRU bound (*max_sequences*) keeps abandoned sequences
+    from pinning the map forever.
+    """
+
+    name = "sticky"
+
+    def __init__(self, fallback="round-robin", max_sequences=100000):
+        self._fallback = make_policy(fallback)
+        self._map = collections.OrderedDict()  # sequence_id -> endpoint url
+        self._max_sequences = int(max_sequences)
+
+    def sequences(self):
+        """{sequence_id: url} snapshot (test/introspection hook)."""
+        return dict(self._map)
+
+    def _remember(self, seq_id, url):
+        self._map[seq_id] = url
+        self._map.move_to_end(seq_id)
+        while len(self._map) > self._max_sequences:
+            self._map.popitem(last=False)
+
+    def pick(self, candidates, request_ctx=None):
+        ctx = request_ctx or {}
+        seq_id = ctx.get("sequence_id") or 0
+        if not seq_id:
+            return self._fallback.pick(candidates, request_ctx)
+        url = self._map.get(seq_id)
+        if url is not None:
+            # honor the mapping whenever the pinned replica is routable —
+            # including on sequence_start, so a restart after
+            # SequenceRestartError lands on the remap the error installed
+            for endpoint in candidates:
+                if endpoint.url == url:
+                    if ctx.get("sequence_end"):
+                        self._map.pop(seq_id, None)
+                    else:
+                        self._map.move_to_end(seq_id)
+                    return endpoint
+        replacement = self._fallback.pick(candidates, request_ctx)
+        if ctx.get("sequence_end"):  # one-shot / final step: nothing to pin
+            self._map.pop(seq_id, None)
+        else:
+            self._remember(seq_id, replacement.url)
+        if url is not None and not ctx.get("sequence_start"):
+            # the pinned replica is gone mid-sequence: the remap is
+            # installed, but the caller must rebuild the state there
+            raise SequenceRestartError(seq_id, url, replacement.url)
+        return replacement
+
+
 _POLICIES = {
     RoundRobin.name: RoundRobin,
     LeastInflight.name: LeastInflight,
     PowerOfTwoChoices.name: PowerOfTwoChoices,
     Weighted.name: Weighted,
+    Sticky.name: Sticky,
 }
 
 
 def make_policy(spec):
     """Policy instance from a name ('round-robin', 'least-inflight',
-    'power-of-two', 'weighted') or an already-built Policy."""
+    'power-of-two', 'weighted', 'sticky') or an already-built Policy."""
     if isinstance(spec, Policy):
         return spec
     cls = _POLICIES.get(str(spec))

@@ -3,22 +3,36 @@
 The pool owns, per endpoint: a :class:`~client_tpu.resilience.CircuitBreaker`
 (from a shared :class:`~client_tpu.resilience.CircuitBreakerRegistry`), a
 health state (READY / NOT_READY / UNREACHABLE — the ``server_state()``
-client verb's vocabulary), a routing weight, and a live inflight count.
+client verb's vocabulary), a membership *phase* (ACTIVE / PROBATION /
+RETIRING), a routing weight, and a live inflight count.
 
 Health is fed from two directions:
 
 - **background readiness probes** (:meth:`EndpointPool.start_probes`): a
-  daemon thread polls each endpoint's readiness on an interval.  Probes are
-  what notice *drain* — a draining server still answers, with not-ready —
-  and what bring a recovered endpoint back without burning a request on it.
+  daemon thread polls each endpoint's readiness with per-endpoint full
+  jitter (a recovering fleet must not take synchronized probe bursts).
+  Probes are what notice *drain* — a draining server still answers, with
+  not-ready — and what bring a recovered endpoint back without burning a
+  request on it.
 - **per-request outcomes**: a successful response marks its endpoint READY
   immediately; a connection-level failure marks it UNREACHABLE (only while
   probing is active — without a prober nothing would ever un-mark it, so
   the circuit breaker alone gates the endpoint then).
 
-Routing (:meth:`EndpointPool.lease`) filters to READY endpoints whose
-breaker admits an attempt (open circuits are skipped until their half-open
-probe), asks the policy to pick, and returns a *lease* whose
+Membership is *live* (:meth:`EndpointPool.update_endpoints`, the discovery
+entry point — see balance/discovery.py):
+
+- **added** endpoints enter PROBATION while a prober is armed and only take
+  traffic once a readiness probe observes READY (without a prober they are
+  admitted optimistically, like constructor endpoints);
+- **removed** endpoints are gracefully RETIRED: no new leases, in-flight
+  leases (including pinned streams) finish, then the endpoint is evicted;
+- a **safety valve** never retires the last healthy endpoint — a flapping
+  resolver cannot evict the only replica still serving.
+
+Routing (:meth:`EndpointPool.lease`) filters to ACTIVE+READY endpoints
+whose breaker admits an attempt (open circuits are skipped until their
+half-open probe), asks the policy to pick, and returns a *lease* whose
 ``success()``/``failure()`` hooks feed the outcome back into inflight,
 breaker, and health state — the contract
 :func:`client_tpu.resilience.call_with_failover` drives.
@@ -27,7 +41,9 @@ All endpoint state is guarded by one pool lock; policies run under it (and
 must not block — see policy.py).
 """
 
+import random
 import threading
+import time
 
 from client_tpu.balance.policy import make_policy
 from client_tpu.resilience import (
@@ -44,7 +60,23 @@ from client_tpu.utils import (
     SERVER_UNREACHABLE,
 )
 
-__all__ = ["Endpoint", "EndpointPool", "Lease"]
+__all__ = [
+    "Endpoint",
+    "EndpointPool",
+    "Lease",
+    "PHASE_ACTIVE",
+    "PHASE_PROBATION",
+    "PHASE_RETIRING",
+]
+
+# Membership lifecycle phases (orthogonal to the READY/NOT_READY/
+# UNREACHABLE health state: phase is what the operator/resolver wants,
+# state is what probes/outcomes observe).
+PHASE_ACTIVE = "active"
+PHASE_PROBATION = "probation"
+PHASE_RETIRING = "retiring"
+
+_VALID_STATES = (SERVER_READY, SERVER_NOT_READY, SERVER_UNREACHABLE)
 
 
 class Endpoint:
@@ -60,6 +92,7 @@ class Endpoint:
         # or an outcome says otherwise (pessimistic start would blackhole
         # a pool constructed before its servers finish binding).
         self.state = SERVER_READY
+        self.phase = PHASE_ACTIVE
         self.inflight = 0
         self.last_error = None
         # State-change delivery ordering: transitions are stamped under the
@@ -72,7 +105,8 @@ class Endpoint:
     def __repr__(self):
         return (
             f"Endpoint({self.url!r}, state={self.state}, "
-            f"inflight={self.inflight}, circuit={self.breaker.state})"
+            f"phase={self.phase}, inflight={self.inflight}, "
+            f"circuit={self.breaker.state})"
         )
 
 
@@ -134,7 +168,10 @@ class EndpointPool:
         ``failure_threshold``/``reset_timeout_s`` when absent.
     observer : optional hook object; any subset of ``on_route(url)``,
         ``on_failover(url)`` (a retryable failure rotated the request off
-        this endpoint), and ``on_endpoint_state(url, state)`` is called —
+        this endpoint), ``on_endpoint_state(url, state)``,
+        ``on_endpoint_phase(url, phase)``, ``on_membership(op, url)`` (op
+        in add/retire/unretire/promote/retain/evict), and
+        ``on_pool_size(active, probation, retiring)`` is called —
         ``client_tpu.serve.metrics.BalancerMetricsObserver`` feeds these
         into per-endpoint /metrics series.
     """
@@ -152,14 +189,7 @@ class EndpointPool:
         self._lock = threading.Lock()
         self._endpoints = []
         for spec in endpoints:
-            if isinstance(spec, Endpoint):
-                endpoint = spec
-            elif isinstance(spec, (tuple, list)):
-                url, weight = spec
-                endpoint = Endpoint(url, weight, breakers.get(str(url)))
-            else:
-                endpoint = Endpoint(spec, 1.0, breakers.get(str(spec)))
-            self._endpoints.append(endpoint)
+            self._endpoints.append(self._build_endpoint(spec))
         # construction errors are programming errors, not the transient
         # retryable NoHealthyEndpointError routing raises
         if not self._endpoints:
@@ -178,34 +208,63 @@ class EndpointPool:
         self._stop = threading.Event()
         self._notify_lock = threading.Lock()
 
+    def _build_endpoint(self, spec):
+        if isinstance(spec, Endpoint):
+            return spec
+        if isinstance(spec, (tuple, list)):
+            url, weight = spec
+            return Endpoint(url, weight, self.breakers.get(str(url)))
+        return Endpoint(spec, 1.0, self.breakers.get(str(spec)))
+
     # -- introspection -------------------------------------------------------
 
     def __len__(self):
-        return len(self._endpoints)
+        with self._lock:
+            return len(self._endpoints)
 
     def urls(self):
-        return [e.url for e in self._endpoints]
+        with self._lock:
+            return [e.url for e in self._endpoints]
 
     def endpoints(self):
-        return list(self._endpoints)
+        with self._lock:
+            return list(self._endpoints)
 
     def states(self):
         with self._lock:
             return {e.url: e.state for e in self._endpoints}
 
+    def phases(self):
+        """{url: ACTIVE/PROBATION/RETIRING} membership view."""
+        with self._lock:
+            return {e.url: e.phase for e in self._endpoints}
+
     def snapshot(self):
-        """Per-endpoint routing view: state, inflight, circuit, weight."""
+        """Per-endpoint routing view: state, phase, inflight, circuit,
+        weight."""
         with self._lock:
             return [
                 {
                     "url": e.url,
                     "state": e.state,
+                    "phase": e.phase,
                     "inflight": e.inflight,
                     "weight": e.weight,
                     "circuit": e.breaker.state,
                 }
                 for e in self._endpoints
             ]
+
+    def _sizes_locked(self):
+        active = probation = retiring = 0
+        for e in self._endpoints:
+            if e.phase == PHASE_ACTIVE:
+                active += 1
+            elif e.phase == PHASE_PROBATION:
+                probation += 1
+            else:
+                retiring += 1
+        return active, probation, retiring
 
     # -- health state machine ------------------------------------------------
 
@@ -222,19 +281,43 @@ class EndpointPool:
             endpoint._state_delivered = seq
             _notify(self.observer, "on_endpoint_state", endpoint.url, state)
 
+    def _deliver_events(self, events):
+        """Deliver a batch of membership/phase events in order (outside the
+        pool lock — observers may look back at the pool)."""
+        if not events:
+            return
+        with self._notify_lock:
+            for method, args in events:
+                _notify(self.observer, method, *args)
+
     def set_state(self, url, state):
-        """Record a health observation for *url* (probe or admin)."""
-        if state not in (SERVER_READY, SERVER_NOT_READY, SERVER_UNREACHABLE):
+        """Record a health observation for *url* (probe or admin).  A
+        READY observation on a PROBATION endpoint promotes it to ACTIVE —
+        the readiness gate new discovery members pass before taking
+        traffic."""
+        if state not in _VALID_STATES:
             raise ValueError(f"unknown endpoint state {state!r}")
         transition = None
+        events = []
         with self._lock:
             for endpoint in self._endpoints:
-                if endpoint.url == url and endpoint.state != state:
+                if endpoint.url != url:
+                    continue
+                if endpoint.state != state:
                     endpoint.state = state
                     endpoint._state_seq += 1
                     transition = (endpoint, state, endpoint._state_seq)
+                if (
+                    state == SERVER_READY
+                    and endpoint.phase == PHASE_PROBATION
+                ):
+                    endpoint.phase = PHASE_ACTIVE
+                    events.append(("on_membership", ("promote", url)))
+                    events.append(("on_endpoint_phase", (url, PHASE_ACTIVE)))
+                    events.append(("on_pool_size", self._sizes_locked()))
         if transition is not None:
             self._deliver_state(*transition)
+        self._deliver_events(events)
 
     def set_weight(self, url, weight):
         with self._lock:
@@ -242,18 +325,151 @@ class EndpointPool:
                 if endpoint.url == url:
                     endpoint.weight = float(weight)
 
+    # -- live membership (the discovery entry point) -------------------------
+
+    def update_endpoints(self, specs):
+        """Apply a new membership list (urls, ``(url, weight)`` pairs, or
+        Endpoint objects) — the :mod:`client_tpu.balance.discovery` feed.
+
+        - New endpoints enter PROBATION while a prober is armed (promoted
+          by their first READY probe; see :meth:`set_state`), ACTIVE
+          otherwise.
+        - Endpoints absent from *specs* are RETIRED: excluded from routing
+          immediately, evicted once their in-flight leases (and pinned
+          streams) finish.
+        - A RETIRING endpoint named again is un-retired in place.
+        - Safety valve: if the update would leave no healthy (ACTIVE +
+          READY) member, the last healthy endpoint slated for removal is
+          retained instead of retired — a flapping resolver can never
+          evict the only replica still serving.
+
+        Raises ValueError on an empty or duplicate-bearing list (config
+        mistakes, not transient routing conditions).  Returns a summary
+        dict: {"added", "retired", "unretired", "retained", "evicted"}.
+        """
+        incoming = []
+        for spec in specs:
+            if isinstance(spec, Endpoint):
+                incoming.append((spec.url, spec.weight))
+            elif isinstance(spec, (tuple, list)):
+                url, weight = spec
+                incoming.append((str(url), float(weight)))
+            else:
+                incoming.append((str(spec), None))
+        if not incoming:
+            raise ValueError(
+                "refusing to apply empty endpoint membership "
+                "(a flapping resolver must not drain the pool)"
+            )
+        urls = [u for u, _ in incoming]
+        if len(set(urls)) != len(urls):
+            raise ValueError(f"duplicate endpoint in membership: {urls}")
+
+        events = []
+        summary = {
+            "added": [], "retired": [], "unretired": [], "retained": [],
+            "evicted": [],
+        }
+        with self._lock:
+            current = {e.url: e for e in self._endpoints}
+            wanted = set(urls)
+            for url, weight in incoming:
+                endpoint = current.get(url)
+                if endpoint is None:
+                    endpoint = Endpoint(
+                        url,
+                        1.0 if weight is None else weight,
+                        self.breakers.get(url),
+                    )
+                    if self._probe is not None:
+                        # unproven: takes traffic only after a READY probe
+                        endpoint.phase = PHASE_PROBATION
+                        endpoint.state = SERVER_NOT_READY
+                    self._endpoints.append(endpoint)
+                    summary["added"].append(url)
+                    events.append(("on_membership", ("add", url)))
+                    events.append(
+                        ("on_endpoint_phase", (url, endpoint.phase))
+                    )
+                else:
+                    if weight is not None:
+                        endpoint.weight = weight
+                    if endpoint.phase == PHASE_RETIRING:
+                        # resolver flapped it back before eviction
+                        endpoint.phase = PHASE_ACTIVE
+                        summary["unretired"].append(url)
+                        events.append(("on_membership", ("unretire", url)))
+                        events.append(
+                            ("on_endpoint_phase", (url, PHASE_ACTIVE))
+                        )
+
+            removals = [
+                e for e in self._endpoints
+                if e.url not in wanted and e.phase != PHASE_RETIRING
+            ]
+            # safety valve: never retire the last healthy endpoint
+            survivors_healthy = any(
+                e.url in wanted
+                and e.phase == PHASE_ACTIVE
+                and e.state == SERVER_READY
+                for e in self._endpoints
+            )
+            if not survivors_healthy:
+                keep = next(
+                    (
+                        e for e in removals
+                        if e.phase == PHASE_ACTIVE
+                        and e.state == SERVER_READY
+                    ),
+                    None,
+                )
+                if keep is not None:
+                    removals = [e for e in removals if e is not keep]
+                    summary["retained"].append(keep.url)
+                    events.append(("on_membership", ("retain", keep.url)))
+            for endpoint in removals:
+                endpoint.phase = PHASE_RETIRING
+                summary["retired"].append(endpoint.url)
+                events.append(("on_membership", ("retire", endpoint.url)))
+                events.append(
+                    ("on_endpoint_phase", (endpoint.url, PHASE_RETIRING))
+                )
+            summary["evicted"] = self._evict_idle_locked(events)
+            events.append(("on_pool_size", self._sizes_locked()))
+        self._deliver_events(events)
+        return summary
+
+    def _evict_idle_locked(self, events):
+        """Drop RETIRING endpoints with no in-flight work (caller holds
+        the pool lock and delivers *events* after releasing it)."""
+        evicted = []
+        keep = []
+        for endpoint in self._endpoints:
+            if endpoint.phase == PHASE_RETIRING and endpoint.inflight <= 0:
+                evicted.append(endpoint.url)
+                events.append(("on_membership", ("evict", endpoint.url)))
+            else:
+                keep.append(endpoint)
+        if evicted:
+            self._endpoints[:] = keep
+        return evicted
+
     # -- probes --------------------------------------------------------------
 
-    def start_probes(self, probe, interval_s=2.0):
+    def start_probes(self, probe, interval_s=2.0, rng=None):
         """Start the background readiness prober.
 
         ``probe(url)`` must return one of the three state constants (the
         clients' ``server_state()`` verb is exactly this shape) and should
         bound its own transport timeout — a probe that can block forever
         wedges the whole pool's (serial) prober.  Exceptions count as
-        UNREACHABLE.  Returns True when this call armed the prober, False
-        when one was already running; :meth:`close` stops it (and the pool
-        can be re-armed afterwards)."""
+        UNREACHABLE.  Each endpoint is probed on its own full-jittered
+        schedule (first probe at ``uniform(0, interval)``, then every
+        ``uniform(interval/2, interval)``) so a fleet of replicas never
+        takes a synchronized probe burst.  Returns True when this call
+        armed the prober, False when one was already running;
+        :meth:`close` stops it (and the pool can be re-armed afterwards).
+        """
         with self._lock:
             if self._prober is not None:
                 return False
@@ -266,28 +482,64 @@ class EndpointPool:
             self._probe = probe
             self._probe_interval_s = float(interval_s)
             prober = threading.Thread(
-                target=self._probe_loop, args=(probe, stop, float(interval_s)),
+                target=self._probe_loop,
+                args=(probe, stop, float(interval_s),
+                      rng if rng is not None else random.Random()),
                 name="endpoint-pool-probe", daemon=True,
             )
             self._prober = prober
         prober.start()
         return True
 
-    def _probe_loop(self, probe, stop, interval_s):
+    def _probe_schedule(self, url, next_due, now, interval_s, rng,
+                        first_sight):
+        """Jittered next-probe time for *url* (full jitter on first sight
+        spreads a whole fleet's probes inside one interval; steady-state
+        periods stay jittered so endpoints never re-align)."""
+        if first_sight:
+            next_due[url] = now + rng.uniform(0.0, interval_s)
+        else:
+            next_due[url] = now + rng.uniform(interval_s / 2.0, interval_s)
+
+    def _probe_loop(self, probe, stop, interval_s, rng):
+        next_due = {}
         while not stop.is_set():
-            for endpoint in self._endpoints:
+            with self._lock:
+                members = [
+                    e.url for e in self._endpoints
+                    if e.phase != PHASE_RETIRING
+                ]
+            now = time.monotonic()
+            for url in members:
                 if stop.is_set():
                     return
+                due = next_due.get(url)
+                if due is None:
+                    self._probe_schedule(
+                        url, next_due, now, interval_s, rng, True
+                    )
+                    continue
+                if due > now:
+                    continue
                 try:
-                    state = probe(endpoint.url)
+                    state = probe(url)
                 except Exception:
                     state = SERVER_UNREACHABLE
-                if state not in (
-                    SERVER_READY, SERVER_NOT_READY, SERVER_UNREACHABLE
-                ):
+                if state not in _VALID_STATES:
                     state = SERVER_UNREACHABLE  # a broken probe is no health
-                self.set_state(endpoint.url, state)
-            if stop.wait(interval_s):
+                self.set_state(url, state)
+                self._probe_schedule(
+                    url, next_due, time.monotonic(), interval_s, rng, False
+                )
+            # forget departed endpoints so the schedule map cannot grow
+            live = set(members)
+            for url in list(next_due):
+                if url not in live:
+                    del next_due[url]
+            now = time.monotonic()
+            delays = [max(due - now, 0.0) for due in next_due.values()]
+            sleep_s = min(delays) if delays else interval_s
+            if stop.wait(min(max(sleep_s, 0.001), interval_s)):
                 return
 
     def close(self):
@@ -312,14 +564,22 @@ class EndpointPool:
     # -- routing -------------------------------------------------------------
 
     def _routable_locked(self):
-        """Endpoints whose health admits new work (breaker gating happens
-        per-pick, where half-open single-probe semantics live)."""
-        return [e for e in self._endpoints if e.state == SERVER_READY]
+        """Endpoints whose health AND membership admit new work (breaker
+        gating happens per-pick, where half-open single-probe semantics
+        live).  PROBATION members are unproven, RETIRING members are on
+        their way out — neither takes new leases."""
+        return [
+            e for e in self._endpoints
+            if e.state == SERVER_READY and e.phase == PHASE_ACTIVE
+        ]
 
     def lease(self, excluded=(), request_ctx=None):
         """Route one attempt: returns a :class:`Lease` on a healthy,
         breaker-admitted endpoint, preferring ones not in *excluded*
-        (the failover loop's already-tried set).  Raises
+        (the failover loop's already-tried set).  ``request_ctx`` is an
+        optional dict of request attributes (model_name, sequence_id,
+        sequence_start/end) content-aware policies key on — the sticky
+        sequence policy routes with it.  Raises
         :class:`NoHealthyEndpointError` when nothing is routable.
 
         Breaker gating runs OUTSIDE the pool lock: ``before_attempt()``
@@ -372,7 +632,7 @@ class EndpointPool:
     def pick(self, request_ctx=None):
         """Policy pick WITHOUT lease accounting — for external assignment
         (e.g. binding perf workers to replicas).  Skips endpoints that are
-        unhealthy or behind a currently-open circuit; raises
+        unhealthy, non-ACTIVE, or behind a currently-open circuit; raises
         :class:`NoHealthyEndpointError` when none qualify."""
         with self._lock:
             candidates = [
@@ -387,18 +647,37 @@ class EndpointPool:
 
     def _describe_locked(self):
         return ", ".join(
-            f"{e.url}={e.state}/{e.breaker.state}" for e in self._endpoints
+            f"{e.url}={e.state}/{e.phase}/{e.breaker.state}"
+            for e in self._endpoints
         )
 
     # -- outcome accounting (Lease callbacks) --------------------------------
 
     def _release(self, endpoint):
         """Outcome-free inflight release (Lease.release)."""
+        events = []
         with self._lock:
             endpoint.inflight = max(endpoint.inflight - 1, 0)
+            self._maybe_evict_locked(endpoint, events)
+        self._deliver_events(events)
+
+    def _maybe_evict_locked(self, endpoint, events):
+        """Evict a drained RETIRING endpoint the moment its last in-flight
+        lease releases (caller holds the pool lock)."""
+        if (
+            endpoint.phase == PHASE_RETIRING
+            and endpoint.inflight <= 0
+            and any(e is endpoint for e in self._endpoints)
+        ):
+            self._endpoints[:] = [
+                e for e in self._endpoints if e is not endpoint
+            ]
+            events.append(("on_membership", ("evict", endpoint.url)))
+            events.append(("on_pool_size", self._sizes_locked()))
 
     def _complete(self, endpoint, ok, exc=None, retryable=True):
         transition = None
+        events = []
         with self._lock:
             endpoint.inflight = max(endpoint.inflight - 1, 0)
             if ok:
@@ -425,6 +704,7 @@ class EndpointPool:
                     transition = (
                         endpoint, SERVER_UNREACHABLE, endpoint._state_seq
                     )
+            self._maybe_evict_locked(endpoint, events)
         # Breaker accounting outside the pool lock (the breaker has its
         # own).  A non-retryable application error means the endpoint
         # answered — evidence of health, never a circuit strike.
@@ -436,3 +716,4 @@ class EndpointPool:
             _notify(self.observer, "on_failover", endpoint.url)
         if transition is not None:
             self._deliver_state(*transition)
+        self._deliver_events(events)

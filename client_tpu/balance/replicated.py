@@ -16,6 +16,14 @@ place of one URL and route every request through it:
   requests finish;
 - open circuits are skipped until their half-open probe admits one
   attempt;
+- with a ``resolver`` (see :mod:`client_tpu.balance.discovery`), pool
+  membership tracks the live fleet: added replicas enter probation and
+  take traffic once probed ready, removed ones retire gracefully;
+- sequence workloads ride the ``sticky`` policy: the ``sequence_id`` /
+  ``sequence_start`` / ``sequence_end`` kwargs flow to the policy as the
+  request context, so every request of a sequence lands on one replica
+  (and a dead replica surfaces
+  :class:`~client_tpu.balance.policy.SequenceRestartError`);
 - with a ``tracer``, the whole request is one client span: every attempt
   records its endpoint (the failover hop is visible as consecutive
   CLIENT_ATTEMPT_START events with different endpoints) and the W3C
@@ -23,20 +31,27 @@ place of one URL and route every request through it:
   so client and server spans join under one trace id.
 
 Streams are pinned: ``start_stream``/``stream_infer`` lease one healthy
-endpoint for the stream's lifetime (streams are never replayed — failing
-over mid-stream would re-send every queued request).
+endpoint for the stream's lifetime.  The *resilient* variants
+(:meth:`ReplicatedClient.resilient_stream`,
+:meth:`AsyncReplicatedClient.resilient_stream_infer`) survive mid-stream
+replica death by reconnecting to a fresh replica and replaying only the
+unacknowledged requests — see :mod:`client_tpu.balance.stream`.
 """
 
 import asyncio
+import threading
 
 from client_tpu import resilience as _resilience
 from client_tpu import tracing as _tracing
+from client_tpu.balance.discovery import DiscoveryLoop, make_resolver
 from client_tpu.balance.pool import EndpointPool
+from client_tpu.balance.stream import ResilientStream, aio_resilient_stream
 from client_tpu.utils import SERVER_READY, raise_error
 
 __all__ = ["ReplicatedClient", "AsyncReplicatedClient"]
 
 _DEFAULT_PROBE_INTERVAL_S = 2.0
+_DEFAULT_DISCOVERY_INTERVAL_S = 30.0
 # Background probes must be bounded: one black-holed endpoint would
 # otherwise wedge the pool's serial prober thread forever.
 _PROBE_TIMEOUT_S = 5.0
@@ -81,6 +96,16 @@ def _attempt_timeout_kwargs(transport, kwargs, timeout_s):
     return kwargs
 
 
+def _request_ctx(model_name, kwargs):
+    """The routing context content-aware policies (sticky) key on."""
+    return {
+        "model_name": model_name,
+        "sequence_id": kwargs.get("sequence_id", 0),
+        "sequence_start": bool(kwargs.get("sequence_start", False)),
+        "sequence_end": bool(kwargs.get("sequence_end", False)),
+    }
+
+
 def _probe_fn(transport, client_for):
     """A bounded ``probe(url)`` callable for EndpointPool.start_probes."""
     if transport == "grpc":
@@ -102,6 +127,7 @@ class ReplicatedClient:
     transport : 'http' or 'grpc' — which client speaks to each replica.
     policy : balancing policy for a URL-built pool (ignored when an
         EndpointPool is passed; configure the pool directly then).
+        ``"sticky"`` routes sequence workloads (see the module docstring).
     retry_policy : RetryPolicy governing attempts/backoff/deadline across
         the failover loop.  Default: one attempt per replica plus one
         (every replica gets a shot, then one wrapped retry).  The policy's
@@ -109,7 +135,13 @@ class ReplicatedClient:
         owned by the pool.
     tracer : optional ClientTracer; see the module docstring.
     probe_interval_s : readiness-probe period (None disables probing —
-        drain then goes unnoticed until requests fail).
+        drain then goes unnoticed until requests fail, and discovery
+        additions skip probation).
+    resolver : optional endpoint-discovery source — anything
+        :func:`client_tpu.balance.discovery.make_resolver` accepts
+        (a Resolver, a callable, a config-file path, or a static list).
+        A DiscoveryLoop polling it every *discovery_interval_s* keeps the
+        pool's membership live; resolver errors keep last-known-good.
     client_factory : ``factory(url, **client_kwargs) -> client`` override.
     client_kwargs : passed to every per-endpoint client constructor.
     """
@@ -117,29 +149,38 @@ class ReplicatedClient:
     def __init__(self, pool, transport="http", policy="round-robin",
                  retry_policy=None, tracer=None,
                  probe_interval_s=_DEFAULT_PROBE_INTERVAL_S,
+                 resolver=None,
+                 discovery_interval_s=_DEFAULT_DISCOVERY_INTERVAL_S,
                  client_factory=None, **client_kwargs):
         self._pool, self._owns_pool = _as_pool(pool, policy)
         self._transport = transport
         self._factory = client_factory or _default_factory(transport, False)
-        self._clients = {
-            url: self._factory(url, **client_kwargs)
-            for url in self._pool.urls()
-        }
+        self._client_kwargs = client_kwargs
+        # Per-endpoint clients are created lazily: with live discovery the
+        # membership outgrows whatever existed at construction.
+        self._clients = {}
+        self._clients_lock = threading.Lock()
         self._retry_policy = retry_policy or _resilience.RetryPolicy(
             max_attempts=len(self._pool) + 1
         )
         self._tracer = tracer
         self._stream_lease = None
+        self._discovery = None
         # Whether close() must stop the pool's prober: always for a pool
         # we built; for a caller-provided pool only when WE armed probes
         # on it (they run through our clients, which close() closes).
         self._stop_pool = self._owns_pool
         if probe_interval_s:
             armed = self._pool.start_probes(
-                _probe_fn(transport, self._clients.__getitem__),
+                _probe_fn(transport, self.client_for),
                 interval_s=probe_interval_s,
             )
             self._stop_pool = self._stop_pool or armed
+        if resolver is not None:
+            self._discovery = DiscoveryLoop(
+                self._pool, make_resolver(resolver),
+                interval_s=discovery_interval_s,
+            ).start()
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -147,14 +188,24 @@ class ReplicatedClient:
     def pool(self):
         return self._pool
 
+    @property
+    def discovery(self):
+        """The DiscoveryLoop when a resolver was given (None otherwise)."""
+        return self._discovery
+
     def close(self):
+        if self._discovery is not None:
+            self._discovery.close()
         if self._stream_lease is not None:
             self.stop_stream()
         if self._stop_pool:
             # stops the prober; a shared pool stays usable (its owner can
             # re-arm probes with start_probes)
             self._pool.close()
-        for client in self._clients.values():
+        with self._clients_lock:
+            clients = list(self._clients.values())
+            self._clients.clear()
+        for client in clients:
             try:
                 client.close()
             except Exception:
@@ -168,8 +219,8 @@ class ReplicatedClient:
 
     # -- routing core --------------------------------------------------------
 
-    def _route(self, excluded):
-        return self._pool.lease(excluded)
+    def _route(self, excluded, request_ctx=None):
+        return self._pool.lease(excluded, request_ctx)
 
     def _routed(self, verb, *args, **kwargs):
         """One management/metadata call, routed with failover.  On gRPC
@@ -181,7 +232,7 @@ class ReplicatedClient:
             call_kwargs = dict(kwargs)
             if self._transport == "grpc":
                 _attempt_timeout_kwargs("grpc", call_kwargs, timeout_s)
-            return getattr(self._clients[lease.url], verb)(
+            return getattr(self.client_for(lease.url), verb)(
                 *args, **call_kwargs
             )
 
@@ -194,11 +245,17 @@ class ReplicatedClient:
     def infer(self, model_name, inputs, **kwargs):
         """One inference, routed across the replica set with failover.
 
-        Accepts the underlying transport client's ``infer`` kwargs."""
+        Accepts the underlying transport client's ``infer`` kwargs.  The
+        sequence kwargs double as the routing context for the sticky
+        policy (see the module docstring)."""
         with _tracing.client_span(self._tracer, model_name) as trace:
             headers = dict(kwargs.pop("headers", None) or {})
             if trace is not None:
                 headers["traceparent"] = trace.traceparent()
+            ctx = _request_ctx(model_name, kwargs)
+
+            def route(excluded):
+                return self._route(excluded, ctx)
 
             def attempt(lease, timeout_s):
                 call_kwargs = dict(kwargs)
@@ -207,12 +264,12 @@ class ReplicatedClient:
                 _attempt_timeout_kwargs(self._transport, call_kwargs,
                                         timeout_s)
                 with _tracing.attempt_span(trace, endpoint=lease.url):
-                    return self._clients[lease.url].infer(
+                    return self.client_for(lease.url).infer(
                         model_name, inputs, **call_kwargs
                     )
 
             return _resilience.call_with_failover(
-                attempt, self._retry_policy, self._route
+                attempt, self._retry_policy, route
             )
 
     # -- health --------------------------------------------------------------
@@ -221,8 +278,8 @@ class ReplicatedClient:
 
     def is_server_live(self, **kwargs):
         return any(
-            self._safe(client.is_server_live, **kwargs)
-            for client in self._clients.values()
+            self._safe(self.client_for(url).is_server_live, **kwargs)
+            for url in self._pool.urls()
         )
 
     def is_server_ready(self, **kwargs):
@@ -233,8 +290,9 @@ class ReplicatedClient:
 
     def is_model_ready(self, model_name, **kwargs):
         return any(
-            self._safe(client.is_model_ready, model_name, **kwargs)
-            for client in self._clients.values()
+            self._safe(self.client_for(url).is_model_ready, model_name,
+                       **kwargs)
+            for url in self._pool.urls()
         )
 
     def server_states(self, **kwargs):
@@ -247,8 +305,8 @@ class ReplicatedClient:
             )
             kwargs = {key: _PROBE_TIMEOUT_S}
         return {
-            url: client.server_state(**kwargs)
-            for url, client in self._clients.items()
+            url: self.client_for(url).server_state(**kwargs)
+            for url in self._pool.urls()
         }
 
     def states(self):
@@ -287,8 +345,14 @@ class ReplicatedClient:
         return self._routed(verb, *args, **kwargs)
 
     def client_for(self, url):
-        """The underlying per-endpoint client (single-replica verbs)."""
-        return self._clients[url]
+        """The underlying per-endpoint client (created on first use —
+        discovery can add endpoints long after construction)."""
+        with self._clients_lock:
+            client = self._clients.get(url)
+            if client is None:
+                client = self._factory(url, **self._client_kwargs)
+                self._clients[url] = client
+            return client
 
     # -- streaming (gRPC): pinned to one healthy replica ---------------------
 
@@ -299,16 +363,34 @@ class ReplicatedClient:
             raise_error("cannot start another stream with one already active")
         lease = self._pool.lease()
         try:
-            self._clients[lease.url].start_stream(callback, **kwargs)
-        except Exception as exc:
-            lease.failure(exc, self._retry_policy.retryable(exc))
+            self.client_for(lease.url).start_stream(callback, **kwargs)
+        except BaseException as exc:
+            # the lease must never leak, whatever start_stream raised
+            # (an Exception feeds the health/breaker machinery; anything
+            # else releases outcome-free)
+            if isinstance(exc, Exception):
+                lease.failure(exc, self._retry_policy.retryable(exc))
+            else:
+                lease.release()
             raise
         self._stream_lease = lease
+
+    def resilient_stream(self, callback, max_unacked=256, **kwargs):
+        """A self-healing stream over the replica set: reconnects to a
+        fresh healthy replica on connection-level stream death, replaying
+        unacknowledged requests (see balance/stream.py).  Independent of
+        the pinned ``start_stream`` slot; close the returned
+        :class:`~client_tpu.balance.stream.ResilientStream` when done."""
+        if self._transport != "grpc":
+            raise_error("streaming requires the grpc transport")
+        return ResilientStream(
+            self, callback, max_unacked=max_unacked, **kwargs
+        )
 
     def async_stream_infer(self, *args, **kwargs):
         if self._stream_lease is None:
             raise_error("stream not available, call start_stream() first")
-        self._clients[self._stream_lease.url].async_stream_infer(
+        self.client_for(self._stream_lease.url).async_stream_infer(
             *args, **kwargs
         )
 
@@ -318,12 +400,53 @@ class ReplicatedClient:
             return
         self._stream_lease = None
         try:
-            self._clients[lease.url].stop_stream(cancel_requests)
+            self.client_for(lease.url).stop_stream(cancel_requests)
         finally:
             # outcome-free: a stream may end BECAUSE the endpoint died, so
             # releasing must not assert health (success would flip a
             # drained/unreachable endpoint back to READY)
             lease.release()
+
+
+class _PinnedStream:
+    """The aio pinned response stream, with a leak-proof lease.
+
+    A bare ``async def`` generator with ``finally: lease.release()`` only
+    releases once the body RUNS — a generator that is created, never
+    iterated, and then ``aclose()``d (or abandoned) never enters its body
+    and leaks the inflight slot forever.  This wrapper releases on
+    exhaustion, on terminal error, and on ``aclose()`` regardless of
+    whether iteration ever started."""
+
+    def __init__(self, stream, lease):
+        self._stream = stream
+        self._lease = lease
+        self._released = False
+
+    def _release(self):
+        if not self._released:
+            self._released = True
+            # outcome-free (see ReplicatedClient.stop_stream): the stream
+            # may have ended because the endpoint died
+            self._lease.release()
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self):
+        try:
+            return await self._stream.__anext__()
+        except BaseException:
+            # StopAsyncIteration (exhausted), a stream error, or a
+            # cancellation: the pin is over either way
+            self._release()
+            raise
+
+    async def aclose(self):
+        self._release()
+        aclose = getattr(self._stream, "aclose", None)
+        if aclose is not None:
+            await aclose()
 
 
 class AsyncReplicatedClient:
@@ -333,7 +456,9 @@ class AsyncReplicatedClient:
     clients are created lazily inside the running event loop, and health
     probing is on-demand (`await refresh_states()`) rather than a
     background thread — outcome-driven state still routes around dead
-    replicas between refreshes.
+    replicas between refreshes.  Live membership comes from calling
+    ``pool.update_endpoints()`` (or sharing a pool that a sync client's
+    resolver keeps current): this client never spawns threads itself.
     """
 
     def __init__(self, pool, transport="http", policy="round-robin",
@@ -377,8 +502,8 @@ class AsyncReplicatedClient:
 
     # -- routing core --------------------------------------------------------
 
-    def _route(self, excluded):
-        return self._pool.lease(excluded)
+    def _route(self, excluded, request_ctx=None):
+        return self._pool.lease(excluded, request_ctx)
 
     async def _routed(self, verb, *args, **kwargs):
         # same per-attempt timeout handling as the sync client's _routed
@@ -401,6 +526,10 @@ class AsyncReplicatedClient:
             headers = dict(kwargs.pop("headers", None) or {})
             if trace is not None:
                 headers["traceparent"] = trace.traceparent()
+            ctx = _request_ctx(model_name, kwargs)
+
+            def route(excluded):
+                return self._route(excluded, ctx)
 
             async def attempt(lease, timeout_s):
                 call_kwargs = dict(kwargs)
@@ -414,7 +543,7 @@ class AsyncReplicatedClient:
                     )
 
             return await _resilience.acall_with_failover(
-                attempt, self._retry_policy, self._route
+                attempt, self._retry_policy, route
             )
 
     # -- health --------------------------------------------------------------
@@ -497,9 +626,10 @@ class AsyncReplicatedClient:
 
     def stream_infer(self, inputs_iterator, **kwargs):
         """Bidirectional stream over ONE leased healthy replica; the lease
-        is released when the response stream finishes (or when the caller
-        ``aclose()``s the returned generator — iterate or close it, an
-        abandoned un-iterated generator holds its inflight slot)."""
+        is released when the response stream finishes or the caller
+        ``aclose()``s the returned stream — including an un-iterated one
+        (a bare generator's ``finally`` never runs for a body that never
+        started, which used to leak the inflight slot)."""
         if self._transport != "grpc":
             raise_error("streaming requires the grpc transport")
         lease = self._pool.lease()
@@ -507,17 +637,22 @@ class AsyncReplicatedClient:
             stream = self._client_for(lease.url).stream_infer(
                 inputs_iterator, **kwargs
             )
-        except Exception as exc:
-            lease.failure(exc, self._retry_policy.retryable(exc))
-            raise
-
-        async def _pinned():
-            try:
-                async for item in stream:
-                    yield item
-            finally:
-                # outcome-free (see ReplicatedClient.stop_stream): the
-                # stream may have ended because the endpoint died
+        except BaseException as exc:
+            if isinstance(exc, Exception):
+                lease.failure(exc, self._retry_policy.retryable(exc))
+            else:
                 lease.release()
+            raise
+        return _PinnedStream(stream, lease)
 
-        return _pinned()
+    def resilient_stream_infer(self, inputs_iterator, max_unacked=256,
+                               **kwargs):
+        """Self-healing twin of :meth:`stream_infer`: reconnects to a
+        fresh healthy replica on connection-level stream death, replays
+        unacknowledged requests, and dedupes duplicate responses by
+        request id (see balance/stream.py)."""
+        if self._transport != "grpc":
+            raise_error("streaming requires the grpc transport")
+        return aio_resilient_stream(
+            self, inputs_iterator, max_unacked=max_unacked, **kwargs
+        )

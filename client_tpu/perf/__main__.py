@@ -113,6 +113,14 @@ def build_parser():
     p.add_argument("--sequence-length-variation", type=float, default=0.0)
     p.add_argument("--start-sequence-id", type=int, default=1)
     p.add_argument("--sequence-id-range", type=int, default=2**32 - 1)
+    p.add_argument("--churn-soak", type=float, default=None,
+                   metavar="SECONDS",
+                   help="with a --url replica list: soak the replica pool "
+                        "under membership churn — every SECONDS a rotating "
+                        "replica is retired from the pool through the "
+                        "discovery layer and re-added one tick later "
+                        "(retire/evict/re-add paths exercised under load; "
+                        "the last healthy endpoint is never dropped)")
     p.add_argument("-f", "--filename", default=None, help="CSV output path")
     p.add_argument("--collect-metrics", action="store_true",
                    help="scrape the server /metrics during measurement")
@@ -362,6 +370,40 @@ def main(argv=None):
 
         replica_pool = EndpointPool(urls, policy="round-robin")
         args.url = urls[0]  # control plane: metadata/statistics/trace
+
+    # Churn-soak: drive discovery updates into the live pool while the
+    # load runs — membership rotates through the resolver machinery, so
+    # probation/retire/evict are exercised exactly as production would.
+    churn_loop = None
+    if args.churn_soak is not None:
+        if replica_pool is None:
+            sys.exit("error: --churn-soak needs a --url replica list "
+                     "(membership churn over a single endpoint would "
+                     "violate the last-healthy safety valve every tick)")
+        from client_tpu.balance.discovery import (
+            CallableResolver,
+            DiscoveryLoop,
+        )
+
+        churn_tick = {"n": 0}
+
+        def churn_membership():
+            # tick k retires replica k % (n+1); the full-fleet round
+            # (k == n) re-admits everyone, so each replica cycles through
+            # retire -> evict -> re-add -> probation -> active
+            i = churn_tick["n"] % (len(urls) + 1)
+            churn_tick["n"] += 1
+            if i == len(urls):
+                return list(urls)
+            return [u for j, u in enumerate(urls) if j != i]
+
+        churn_loop = DiscoveryLoop(
+            replica_pool, CallableResolver(churn_membership),
+            interval_s=args.churn_soak,
+        ).start()
+        if args.verbose:
+            print(f"churn soak: rotating {len(urls)} replicas every "
+                  f"{args.churn_soak:g}s", file=sys.stderr)
 
     ssl_options = None
     if args.protocol == "grpc" and args.ssl_grpc_use_ssl:
@@ -650,6 +692,10 @@ def main(argv=None):
         print(f"error: {e}", file=sys.stderr)
         return 2
     finally:
+        if churn_loop is not None:
+            churn_loop.close()
+        if replica_pool is not None:
+            replica_pool.close()
         try:
             control.close()
         except Exception:

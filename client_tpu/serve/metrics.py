@@ -42,6 +42,9 @@ CIRCUIT_STATE_VALUES = {"closed": 0, "half-open": 1, "open": 2}
 # Endpoint health state -> gauge value (client_tpu.utils server states).
 ENDPOINT_STATE_VALUES = {"READY": 0, "NOT_READY": 1, "UNREACHABLE": 2}
 
+# Endpoint membership phase -> gauge value (client_tpu.balance.pool).
+ENDPOINT_PHASE_VALUES = {"active": 0, "probation": 1, "retiring": 2}
+
 
 def format_labels(labels):
     """{'model': 'm'} -> '{model="m"}' with every value escaped."""
@@ -118,6 +121,17 @@ class Registry:
             if fam is None:
                 return None
             return fam["samples"].get(format_labels(labels))
+
+    def remove(self, name, labels=None):
+        """Drop one labeled sample (gauges for departed label values —
+        e.g. an evicted endpoint's phase/state — must not sit on /metrics
+        at their last value forever, nor accumulate without bound under
+        membership churn)."""
+        key = format_labels(labels)
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is not None:
+                fam["samples"].pop(key, None)
 
     def render_into(self, lines):
         with self._lock:
@@ -201,8 +215,14 @@ class BalancerMetricsObserver:
     Series (all per-endpoint): ``ctpu_client_routed_total`` (requests the
     balancer sent to each replica — the convergence proof when replicas
     die), ``ctpu_client_failovers_total`` (attempts that failed retryably
-    on a replica and rotated off it), and ``ctpu_client_endpoint_state``
-    (the pool's READY/NOT_READY/UNREACHABLE health view).
+    on a replica and rotated off it), ``ctpu_client_endpoint_state``
+    (the pool's READY/NOT_READY/UNREACHABLE health view),
+    ``ctpu_client_endpoint_phase`` (membership lifecycle:
+    active/probation/retiring), ``ctpu_client_membership_changes_total``
+    (discovery add/retire/unretire/promote/retain/evict events),
+    ``ctpu_client_pool_endpoints`` (pool size per phase), and the
+    streaming-reconnect pair ``ctpu_client_stream_reconnects_total`` /
+    ``ctpu_client_stream_replayed_requests_total``.
     """
 
     def __init__(self, registry=None):
@@ -227,6 +247,57 @@ class BalancerMetricsObserver:
             ENDPOINT_STATE_VALUES.get(state, -1),
             help_="Pool health view per endpoint "
                   "(0=ready, 1=not-ready/draining, 2=unreachable)",
+        )
+
+    # membership / discovery hooks -------------------------------------------
+
+    def on_endpoint_phase(self, endpoint, phase):
+        self.registry.set(
+            "ctpu_client_endpoint_phase", {"endpoint": endpoint},
+            ENDPOINT_PHASE_VALUES.get(phase, -1),
+            help_="Pool membership phase per endpoint "
+                  "(0=active, 1=probation, 2=retiring)",
+        )
+
+    def on_membership(self, op, endpoint):
+        self.registry.inc(
+            "ctpu_client_membership_changes_total",
+            {"op": op, "endpoint": endpoint},
+            help_="Discovery-driven membership events "
+                  "(add/retire/unretire/promote/retain/evict)",
+        )
+        if op == "evict":
+            # the endpoint is gone: its per-endpoint gauges must not park
+            # at their last value (counters stay — they are history)
+            labels = {"endpoint": endpoint}
+            self.registry.remove("ctpu_client_endpoint_phase", labels)
+            self.registry.remove("ctpu_client_endpoint_state", labels)
+
+    def on_pool_size(self, active, probation, retiring):
+        for phase, count in (
+            ("active", active), ("probation", probation),
+            ("retiring", retiring),
+        ):
+            self.registry.set(
+                "ctpu_client_pool_endpoints", {"phase": phase}, count,
+                help_="Replica-set pool size per membership phase",
+            )
+
+    # streaming-reconnect hooks ----------------------------------------------
+
+    def on_stream_reconnect(self, endpoint):
+        self.registry.inc(
+            "ctpu_client_stream_reconnects_total", {"endpoint": endpoint},
+            help_="Streams that died connection-level on this replica and "
+                  "reconnected to a fresh one",
+        )
+
+    def on_stream_replayed(self, endpoint, count):
+        self.registry.inc(
+            "ctpu_client_stream_replayed_requests_total",
+            {"endpoint": endpoint}, value=count,
+            help_="Unacknowledged stream requests replayed onto this "
+                  "replica after a reconnect",
         )
 
 

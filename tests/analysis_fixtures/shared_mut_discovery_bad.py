@@ -1,0 +1,32 @@
+"""SHARED-MUT violation, discovery-shaped: live membership mutated IN
+PLACE outside the pool lock while the prober thread iterates it — the
+prober can see a torn list (endpoint half-added, or skip one during a
+remove) and probe/route against membership that never existed."""
+
+import threading
+
+
+class EndpointPool:
+    def __init__(self, urls):
+        self._lock = threading.Lock()
+        self._endpoints = list(urls)
+        self._prober = threading.Thread(target=self._probe_loop, daemon=True)
+
+    def _probe_loop(self):
+        while True:
+            with self._lock:
+                members = list(self._endpoints)
+            for url in members:
+                self._probe(url)
+
+    def _probe(self, url):
+        pass
+
+    def update_endpoints(self, urls):
+        # races the prober's snapshot copy: in-place mutation, no lock
+        for url in urls:
+            if url not in self._endpoints:
+                self._endpoints.append(url)
+        for url in list(self._endpoints):
+            if url not in urls:
+                self._endpoints.remove(url)

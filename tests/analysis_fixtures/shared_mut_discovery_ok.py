@@ -1,0 +1,31 @@
+"""SHARED-MUT clean twin of the discovery fixture: every in-place
+membership mutation the prober thread can observe happens under the pool
+lock (the shape client_tpu/balance/pool.py update_endpoints ships)."""
+
+import threading
+
+
+class EndpointPool:
+    def __init__(self, urls):
+        self._lock = threading.Lock()
+        self._endpoints = list(urls)
+        self._prober = threading.Thread(target=self._probe_loop, daemon=True)
+
+    def _probe_loop(self):
+        while True:
+            with self._lock:
+                members = list(self._endpoints)
+            for url in members:
+                self._probe(url)
+
+    def _probe(self, url):
+        pass
+
+    def update_endpoints(self, urls):
+        with self._lock:
+            for url in urls:
+                if url not in self._endpoints:
+                    self._endpoints.append(url)
+            for url in list(self._endpoints):
+                if url not in urls:
+                    self._endpoints.remove(url)

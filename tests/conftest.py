@@ -24,6 +24,14 @@ jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+def pytest_configure(config):
+    # tier-1 runs `-m 'not slow'`; the slow tier (make soak) repeats the
+    # churn chaos scenario to shake out timing bugs
+    config.addinivalue_line(
+        "markers", "slow: soak/repetition tests excluded from tier-1"
+    )
+
+
 # Native libraries are build artifacts (gitignored): build them on demand so a
 # fresh checkout runs the full suite instead of failing the shm-backed tests.
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

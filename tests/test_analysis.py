@@ -130,6 +130,22 @@ def test_shared_mut_pool_clean():
     assert _scan("shared_mut_pool_ok.py") == []
 
 
+def test_shared_mut_discovery_hits():
+    """Discovery-motivated shape: pool membership mutated IN PLACE
+    (append/remove) outside the pool lock while the prober thread
+    iterates it — the rule's in-place-mutator extension."""
+    findings = _scan("shared_mut_discovery_bad.py")
+    assert _rules_hit(findings) == ["SHARED-MUT"]
+    assert len(findings) == 2
+    messages = " ".join(f.message for f in findings)
+    assert "append" in messages and "remove" in messages
+    assert "_endpoints" in messages
+
+
+def test_shared_mut_discovery_clean():
+    assert _scan("shared_mut_discovery_ok.py") == []
+
+
 def test_time_wall_hits():
     findings = _scan("time_wall_bad.py")
     assert _rules_hit(findings) == ["TIME-WALL"]

@@ -673,6 +673,127 @@ class TestTimeoutsAndOwnership:
         assert any(state == "half-open" for state, _ in seen)
 
 
+# -- stream-lease lifecycle (satellite audit) --------------------------------
+
+
+class _FailingStreamClient:
+    """Per-endpoint stub whose start_stream always raises."""
+
+    def __init__(self, url, **kwargs):
+        self.url = url
+
+    def start_stream(self, callback, **kwargs):
+        raise InferenceServerException("stream refused", status="400")
+
+    def stop_stream(self, cancel_requests=False):
+        pass
+
+    def close(self):
+        pass
+
+
+class TestStreamLeaseLifecycle:
+    def test_sync_start_stream_failure_releases_lease(self):
+        client = ReplicatedClient(
+            ["a", "b"], transport="grpc", probe_interval_s=None,
+            client_factory=_FailingStreamClient,
+        )
+        try:
+            with pytest.raises(InferenceServerException, match="refused"):
+                client.start_stream(lambda result, error: None)
+            # the lease did not leak: no inflight slot held, no pinned
+            # stream recorded, and the stream can be attempted again
+            assert all(s["inflight"] == 0 for s in client.pool.snapshot())
+            assert client._stream_lease is None
+            with pytest.raises(InferenceServerException, match="refused"):
+                client.start_stream(lambda result, error: None)
+            assert all(s["inflight"] == 0 for s in client.pool.snapshot())
+        finally:
+            client.close()
+
+    def test_aio_abandoned_generator_releases_lease_on_aclose(self):
+        """The aclose() regression: an aio stream that is created but
+        never iterated must still release its lease when closed —
+        a bare generator's ``finally`` never runs for a body that never
+        started."""
+        import asyncio
+
+        class _StubAioStream:
+            def __init__(self, url, **kwargs):
+                self.url = url
+
+            def stream_infer(self, inputs_iterator, **kwargs):
+                async def gen():
+                    yield None, None
+
+                return gen()
+
+            async def close(self):
+                pass
+
+        async def flow():
+            client = AsyncReplicatedClient(
+                ["a", "b"], transport="grpc",
+                client_factory=_StubAioStream,
+            )
+            try:
+                stream = client.stream_infer(iter(()))
+                assert any(
+                    s["inflight"] == 1 for s in client.pool.snapshot()
+                )
+                await stream.aclose()  # never iterated
+                assert all(
+                    s["inflight"] == 0 for s in client.pool.snapshot()
+                )
+                # partially consumed then closed: released exactly once
+                stream = client.stream_infer(iter(()))
+                await stream.__anext__()
+                await stream.aclose()
+                assert all(
+                    s["inflight"] == 0 for s in client.pool.snapshot()
+                )
+            finally:
+                await client.close()
+
+        loop = asyncio.new_event_loop()
+        try:
+            loop.run_until_complete(flow())
+        finally:
+            loop.close()
+
+    def test_aio_stream_infer_failure_releases_lease(self):
+        import asyncio
+
+        class _RaisingAio:
+            def __init__(self, url, **kwargs):
+                pass
+
+            def stream_infer(self, inputs_iterator, **kwargs):
+                raise InferenceServerException("no stream", status="400")
+
+            async def close(self):
+                pass
+
+        async def flow():
+            client = AsyncReplicatedClient(
+                ["a"], transport="grpc", client_factory=_RaisingAio
+            )
+            try:
+                with pytest.raises(InferenceServerException, match="no"):
+                    client.stream_infer(iter(()))
+                assert all(
+                    s["inflight"] == 0 for s in client.pool.snapshot()
+                )
+            finally:
+                await client.close()
+
+        loop = asyncio.new_event_loop()
+        try:
+            loop.run_until_complete(flow())
+        finally:
+            loop.close()
+
+
 # -- drain vs death distinction (satellite) ----------------------------------
 
 

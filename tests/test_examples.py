@@ -34,6 +34,7 @@ GRPC_EXAMPLES = [
     "simple_grpc_custom_args_client.py",
     "simple_grpc_custom_repeat.py",
     "simple_grpc_replicated_client.py",
+    "simple_grpc_discovery_client.py",
     "ensemble_client.py",
     "ensemble_image_client.py",
     "reuse_infer_objects_client.py",
