@@ -29,16 +29,18 @@ check: lint
 	    --continue-on-collection-errors -p no:cacheprovider \
 	    -p no:xdist -p no:randomly
 
-# Churn soak: the slow tier tier-1 excludes — repeats the replica-churn
-# chaos acceptance (discovery add/retire, stream-pinned kill, resolver
-# flap) SOAK_N times; churn bugs are timing bugs, repetition finds them.
+# Churn + isolation soak: the slow tier tier-1 excludes — repeats the
+# replica-churn chaos acceptance (discovery add/retire, stream-pinned
+# kill, resolver flap) and the multi-tenant noisy-neighbor/hot-key
+# scenario SOAK_N times; churn and isolation bugs are timing bugs,
+# repetition finds them.
 SOAK_N ?= 3
 soak:
 	@for i in $$(seq 1 $(SOAK_N)); do \
 	  echo "== soak round $$i/$(SOAK_N) =="; \
 	  JAX_PLATFORMS=cpu python -m pytest tests/test_discovery.py \
-	      tests/test_balance.py -q -m slow -p no:cacheprovider \
-	      -p no:xdist -p no:randomly || exit 1; \
+	      tests/test_balance.py tests/test_frontdoor.py -q -m slow \
+	      -p no:cacheprovider -p no:xdist -p no:randomly || exit 1; \
 	done
 
 all: protos native cpp

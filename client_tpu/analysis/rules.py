@@ -772,6 +772,90 @@ class MetricLabelRule(Rule):
 
 
 @register
+class RespParamOverwriteRule(Rule):
+    """RESP-PARAM-OVERWRITE — dict-literal assignment stamps a marker over
+    shared response parameters.
+
+    ``response["parameters"] = {"some_flag": True}`` REPLACES whatever
+    response-level parameters the model or an earlier render step set —
+    the silent-vanish bug the decoupled stream's ``triton_final_response``
+    stamp shipped (ADVICE round 5: model-set params disappeared once
+    grpc_server started forwarding response parameters).  The sanctioned
+    shape merges instead::
+
+        response.setdefault("parameters", {})["some_flag"] = True
+
+    Heuristic: flags assignments of a dict LITERAL carrying at least one
+    boolean-constant value (the marker-stamp shape) to a ``["parameters"]``
+    subscript, unless the subscripted object is a dict literal freshly
+    built in the same function (constructing a new response is not an
+    overwrite — there is nothing to lose yet).
+    """
+
+    id = "RESP-PARAM-OVERWRITE"
+    rationale = (
+        "assigning a marker dict to [\"parameters\"] replaces model-set "
+        "response parameters (merge via setdefault instead)"
+    )
+
+    @staticmethod
+    def _fresh_dict_names(fn):
+        """Local names assigned a dict/list literal in this function —
+        responses under construction, not shared responses."""
+        fresh = set()
+        for node in _walk_no_functions(fn):
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, (ast.Dict, ast.List, ast.DictComp)
+            ):
+                fresh.update(
+                    t.id for t in node.targets if isinstance(t, ast.Name)
+                )
+        return fresh
+
+    @staticmethod
+    def _base_name(node):
+        """Innermost Name a subscript chain hangs off (rendered[0] ->
+        'rendered'); None for call results etc."""
+        while isinstance(node, ast.Subscript):
+            node = node.value
+        return node.id if isinstance(node, ast.Name) else None
+
+    def check(self, tree, lines, path):
+        findings = []
+        for fn in list(_functions(tree)) + [tree]:
+            fresh = self._fresh_dict_names(fn)
+            for node in _walk_no_functions(fn):
+                if not isinstance(node, ast.Assign):
+                    continue
+                for target in node.targets:
+                    if not (
+                        isinstance(target, ast.Subscript)
+                        and isinstance(target.slice, ast.Constant)
+                        and target.slice.value == "parameters"
+                    ):
+                        continue
+                    if not (
+                        isinstance(node.value, ast.Dict)
+                        and any(
+                            isinstance(v, ast.Constant)
+                            and isinstance(v.value, bool)
+                            for v in node.value.values
+                        )
+                    ):
+                        continue  # not the marker-stamp shape
+                    base = self._base_name(target.value)
+                    if base is not None and base in fresh:
+                        continue  # freshly built response: nothing to lose
+                    findings.append(self.finding(
+                        path, lines, node,
+                        'marker dict assigned to ["parameters"] replaces '
+                        "any response parameters the model set — merge "
+                        'with .setdefault("parameters", {})[key] = value',
+                    ))
+        return findings
+
+
+@register
 class SharedMutRule(Rule):
     """SHARED-MUT — unlocked mutation of state shared with a spawned
     thread.

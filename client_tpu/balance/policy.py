@@ -141,6 +141,9 @@ class Weighted(Policy):
         self._rng = rng or random.Random()
 
     def pick(self, candidates, request_ctx=None):
+        # the probation slow-start ramp is applied by the pool's candidate
+        # thinning BEFORE any policy runs (one mechanism for every policy);
+        # scaling weights here too would compound the penalty to ~f^2
         weights = [max(float(e.weight), 0.0) for e in candidates]
         total = sum(weights)
         if total <= 0:  # all zero-weight: fall back to uniform

@@ -95,6 +95,12 @@ class Endpoint:
         self.phase = PHASE_ACTIVE
         self.inflight = 0
         self.last_error = None
+        # Probation ramp-up (slow start): stamped at promote time when the
+        # pool has a rampup window; ramp_fraction() climbs floor -> 1 over
+        # [ramp_started, ramp_started + ramp_span].
+        self.ramp_started = None
+        self.ramp_span = 0.0
+        self.ramp_floor = 0.1
         # State-change delivery ordering: transitions are stamped under the
         # pool lock and delivered outside it with stale ones dropped, so a
         # preempted thread can never park the endpoint-state gauge on an
@@ -108,6 +114,22 @@ class Endpoint:
             f"phase={self.phase}, inflight={self.inflight}, "
             f"circuit={self.breaker.state})"
         )
+
+    def ramp_fraction(self, now=None):
+        """Slow-start traffic share in [ramp_floor, 1]: 1.0 when not
+        ramping, else the elapsed fraction of the ramp window (floored so
+        a freshly promoted replica gets SOME probe traffic — zero share
+        would never exercise it).  Consumed ONLY by the pool's candidate
+        thinning — the single ramp mechanism, applied before any policy
+        runs, so weight-aware policies don't compound the penalty."""
+        if self.ramp_started is None or self.ramp_span <= 0:
+            return 1.0
+        now = time.monotonic() if now is None else now
+        frac = (now - self.ramp_started) / self.ramp_span
+        if frac >= 1.0:
+            self.ramp_started = None  # ramp complete: back to O(1) checks
+            return 1.0
+        return max(frac, self.ramp_floor)
 
 
 class Lease:
@@ -177,7 +199,19 @@ class EndpointPool:
     """
 
     def __init__(self, endpoints, policy="round-robin", breakers=None,
-                 failure_threshold=5, reset_timeout_s=30.0, observer=None):
+                 failure_threshold=5, reset_timeout_s=30.0, observer=None,
+                 rampup_s=0.0, rampup_floor=0.1, rng=None):
+        # Probation ramp-up (slow start): a PROBATION endpoint promoted to
+        # ACTIVE takes traffic gradually over `rampup_s` seconds instead of
+        # instantly absorbing a full 1/N share — a replica whose caches,
+        # JIT executables, and connection pools are cold serves its first
+        # requests slowest, and handing it full traffic at promote time
+        # spikes tail latency exactly when the fleet just recovered.
+        # 0.0 (default) disables; `rampup_floor` is the minimum share so a
+        # ramping replica still sees some traffic from t=0.
+        self.rampup_s = float(rampup_s)
+        self.rampup_floor = float(rampup_floor)
+        self._ramp_rng = rng if rng is not None else random.Random()
         if breakers is None:
             breakers = CircuitBreakerRegistry(
                 failure_threshold=failure_threshold,
@@ -312,6 +346,12 @@ class EndpointPool:
                     and endpoint.phase == PHASE_PROBATION
                 ):
                     endpoint.phase = PHASE_ACTIVE
+                    if self.rampup_s > 0:
+                        # slow start: the promoted replica's share climbs
+                        # from rampup_floor to full over the window
+                        endpoint.ramp_started = time.monotonic()
+                        endpoint.ramp_span = self.rampup_s
+                        endpoint.ramp_floor = self.rampup_floor
                     events.append(("on_membership", ("promote", url)))
                     events.append(("on_endpoint_phase", (url, PHASE_ACTIVE)))
                     events.append(("on_pool_size", self._sizes_locked()))
@@ -573,6 +613,28 @@ class EndpointPool:
             if e.state == SERVER_READY and e.phase == PHASE_ACTIVE
         ]
 
+    def _thin_ramping_locked(self, candidates, request_ctx=None):
+        """Probabilistically skip ramping (slow-start) endpoints so EVERY
+        policy — not just weight-aware ones — honors the ramp: a replica at
+        ramp fraction f stays in the candidate set with probability f.
+        Never empties the set (a pool of only-ramping replicas still
+        serves).  Sequence-bearing requests are exempt: the sticky policy
+        treats a missing pinned replica as DEAD and forces a sequence
+        restart (SequenceRestartError) — thinning a healthy ramping
+        replica out from under its pinned sequences would fabricate
+        restarts for the whole ramp window."""
+        if self.rampup_s <= 0:
+            return candidates
+        if request_ctx and request_ctx.get("sequence_id"):
+            return candidates
+        now = time.monotonic()
+        kept = [
+            e for e in candidates
+            if (f := e.ramp_fraction(now)) >= 1.0
+            or self._ramp_rng.random() < f
+        ]
+        return kept or candidates
+
     def lease(self, excluded=(), request_ctx=None):
         """Route one attempt: returns a :class:`Lease` on a healthy,
         breaker-admitted endpoint, preferring ones not in *excluded*
@@ -592,6 +654,7 @@ class EndpointPool:
                 raise NoHealthyEndpointError(
                     f"no endpoint is routable: {self._describe_locked()}"
                 )
+            routable = self._thin_ramping_locked(routable, request_ctx)
             fresh = [e for e in routable if e.url not in excluded]
             candidates = fresh or routable  # wrap once every replica tried
             last_candidate = len(fresh) <= 1
@@ -643,6 +706,7 @@ class EndpointPool:
                 raise NoHealthyEndpointError(
                     f"no endpoint is routable: {self._describe_locked()}"
                 )
+            candidates = self._thin_ramping_locked(candidates, request_ctx)
             return self._policy.pick(candidates, request_ctx)
 
     def _describe_locked(self):

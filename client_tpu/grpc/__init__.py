@@ -67,11 +67,22 @@ class KeepAliveOptions:
 
 
 def raise_error_grpc(rpc_error):
-    raise InferenceServerException(
+    exc = InferenceServerException(
         msg=rpc_error.details(),
         status=str(rpc_error.code().name),
         debug_details=rpc_error,
-    ) from None
+    )
+    # server backoff hint (the gRPC spelling of HTTP Retry-After): QoS and
+    # overload sheds attach it as trailing metadata; the retry policy's
+    # delay_for() honors exc.retry_after_s
+    try:
+        for key, value in rpc_error.trailing_metadata() or ():
+            if key == "retry-after":
+                exc.retry_after_s = float(value)
+                break
+    except Exception:
+        pass  # a malformed hint must never mask the real error
+    raise exc from None
 
 
 def build_trace_setting_request(model_name, settings):

@@ -27,6 +27,33 @@ from client_tpu.perf.model_parser import ModelParser
 from client_tpu.utils import InferenceServerException
 
 
+def _parse_tenants(spec):
+    """'gold:3,bronze:1' -> ['gold','gold','gold','bronze']: the slot
+    assignment list worker i indexes with i % len (a bare name counts as
+    weight 1).  Interleaving is by expansion order, which is fine — slots
+    are homogeneous."""
+    if not spec:
+        return []
+    slots = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, weight = part.partition(":")
+        try:
+            count = int(weight) if weight else 1
+        except ValueError:
+            raise SystemExit(
+                f"error: bad --tenants entry {part!r} (want name[:weight])"
+            ) from None
+        if count < 1:
+            raise SystemExit(
+                f"error: --tenants weight must be >= 1 in {part!r}"
+            )
+        slots.extend([name] * count)
+    return slots
+
+
 def _parse_range(text, cast):
     """start[:end[:step]] (reference concurrency-range format)."""
     parts = text.split(":")
@@ -107,6 +134,18 @@ def build_parser():
     p.add_argument("--shape", action="append", default=[],
                    help="NAME:d1,d2,... override for dynamic dims")
     p.add_argument("--string-length", type=int, default=16)
+    p.add_argument("--tenants", default=None,
+                   help="tenant mix for the worker slots: "
+                        "'gold:3,bronze:1' assigns slots to tenants "
+                        "proportionally to the weights (a bare name means "
+                        "weight 1); requests carry x-tenant-id and the "
+                        "summary adds a per-tenant latency split — the "
+                        "noisy-neighbor isolation readout against a QoS-"
+                        "enabled server")
+    p.add_argument("--hermetic-cache-entries", type=int, default=0,
+                   help="with --hermetic: enable the in-process engine's "
+                        "response cache (N LRU entries) + coalescing, so "
+                        "cache-hit rates show in the summary")
     p.add_argument("--sequence", action="store_true",
                    help="stateful sequence workload")
     p.add_argument("--sequence-length", type=int, default=20)
@@ -339,7 +378,16 @@ def main(argv=None):
         from client_tpu.serve import InferenceEngine
         from client_tpu.serve.models import model_sets
 
-        engine = InferenceEngine(model_sets(args.hermetic_models))  # no sockets
+        cache = None
+        if args.hermetic_cache_entries > 0:
+            from client_tpu.serve.frontdoor import ResponseCache
+
+            cache = ResponseCache(max_entries=args.hermetic_cache_entries)
+        engine = InferenceEngine(  # no sockets
+            model_sets(args.hermetic_models),
+            response_cache=cache,
+            coalescing=args.hermetic_cache_entries > 0,
+        )
         kind = BackendKind.INPROCESS
     else:
         kind = (
@@ -500,6 +548,10 @@ def main(argv=None):
                 num_streams=loader.num_streams,
             )
 
+        tenant_slots = _parse_tenants(args.tenants)
+        if tenant_slots and (args.async_mode or args.native_loadgen):
+            sys.exit("error: --tenants drives the thread-per-slot python "
+                     "load engine (not --async / --native-loadgen)")
         common = dict(
             backend_factory=backend_factory,
             data_loader=loader,
@@ -508,6 +560,7 @@ def main(argv=None):
             model_version=args.model_version,
             sequence_manager=sequences,
             max_threads=args.max_threads,
+            tenants=tenant_slots,
         )
         latency_limit_us = args.latency_threshold * 1e3 or None
 

@@ -59,7 +59,7 @@ class ClientBackend:
 
     def infer(self, model_name, inputs, outputs=None, request_id="",
               sequence_id=0, sequence_start=False, sequence_end=False,
-              model_version="", priority=0, timeout_us=None):
+              model_version="", priority=0, timeout_us=None, headers=None):
         """Blocking infer; returns the client's InferResult-like object."""
         raise NotImplementedError
 
@@ -146,7 +146,7 @@ class _GrpcBackend(ClientBackend):
 
     def infer(self, model_name, inputs, outputs=None, request_id="",
               sequence_id=0, sequence_start=False, sequence_end=False,
-              model_version="", priority=0, timeout_us=None):
+              model_version="", priority=0, timeout_us=None, headers=None):
         return self._client.infer(
             model_name,
             inputs,
@@ -158,6 +158,7 @@ class _GrpcBackend(ClientBackend):
             sequence_end=sequence_end,
             priority=priority,
             client_timeout=(timeout_us / 1e6) if timeout_us else None,
+            headers=headers,
         )
 
     def statistics(self, model_name="", model_version=""):
@@ -248,7 +249,7 @@ class _HttpBackend(_GrpcBackend):
 
     def infer(self, model_name, inputs, outputs=None, request_id="",
               sequence_id=0, sequence_start=False, sequence_end=False,
-              model_version="", priority=0, timeout_us=None):
+              model_version="", priority=0, timeout_us=None, headers=None):
         return self._client.infer(
             model_name,
             inputs,
@@ -260,6 +261,7 @@ class _HttpBackend(_GrpcBackend):
             sequence_end=sequence_end,
             priority=priority,
             timeout=int(timeout_us) if timeout_us else None,
+            headers=headers,
         )
 
     def statistics(self, model_name="", model_version=""):
@@ -326,7 +328,7 @@ class _InprocessBackend(ClientBackend):
 
     def infer(self, model_name, inputs, outputs=None, request_id="",
               sequence_id=0, sequence_start=False, sequence_end=False,
-              model_version="", priority=0, timeout_us=None):
+              model_version="", priority=0, timeout_us=None, headers=None):
         request = {"id": request_id, "inputs": []}
         if sequence_id:
             request["parameters"] = {
@@ -354,7 +356,10 @@ class _InprocessBackend(ClientBackend):
                 {"name": o.name(), "parameters": dict(o.parameters())}
                 for o in outputs
             ]
-        result = self._engine.execute(model_name, model_version, request, binary)
+        tenant = (headers or {}).get("x-tenant-id", "")
+        result = self._engine.execute(
+            model_name, model_version, request, binary, tenant=tenant
+        )
         if not isinstance(result, tuple):  # decoupled stream (generator/list)
             return [_EngineResult(r, b) for r, b in result]
         response, blobs = result
@@ -474,7 +479,7 @@ class _TorchServeBackend(ClientBackend):
 
     def infer(self, model_name, inputs, outputs=None, request_id="",
               sequence_id=0, sequence_start=False, sequence_end=False,
-              model_version="", priority=0, timeout_us=None):
+              model_version="", priority=0, timeout_us=None, headers=None):
         if not inputs:
             raise InferenceServerException("torchserve infer needs one input")
         body = bytes(inputs[0].raw_data() or b"")
@@ -672,7 +677,7 @@ class _TfServeGrpcBackend(ClientBackend):
 
     def infer(self, model_name, inputs, outputs=None, request_id="",
               sequence_id=0, sequence_start=False, sequence_end=False,
-              model_version="", priority=0, timeout_us=None):
+              model_version="", priority=0, timeout_us=None, headers=None):
         import grpc
 
         if not inputs:
@@ -748,7 +753,7 @@ class _TfServeBackend(_TorchServeBackend):
 
     def infer(self, model_name, inputs, outputs=None, request_id="",
               sequence_id=0, sequence_start=False, sequence_end=False,
-              model_version="", priority=0, timeout_us=None):
+              model_version="", priority=0, timeout_us=None, headers=None):
         if not inputs:
             raise InferenceServerException("tfserve infer needs one input")
         from client_tpu.utils import from_wire_bytes
@@ -826,7 +831,7 @@ class MockClientBackend(ClientBackend):
 
     def infer(self, model_name, inputs, outputs=None, request_id="",
               sequence_id=0, sequence_start=False, sequence_end=False,
-              model_version="", priority=0, timeout_us=None):
+              model_version="", priority=0, timeout_us=None, headers=None):
         self.stats.record(sequence_id)
         if self.latency_s:
             time.sleep(self.latency_s)

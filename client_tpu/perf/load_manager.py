@@ -19,10 +19,10 @@ from client_tpu.utils import InferenceServerException
 
 class RequestRecord:
     __slots__ = ("start_ns", "end_ns", "ok", "sequence_id", "delayed",
-                 "endpoint")
+                 "endpoint", "tenant")
 
     def __init__(self, start_ns, end_ns, ok, sequence_id=0, delayed=False,
-                 endpoint=""):
+                 endpoint="", tenant=""):
         self.start_ns = start_ns
         self.end_ns = end_ns
         self.ok = ok
@@ -31,6 +31,9 @@ class RequestRecord:
         # replica this request was sent to (multi-replica runs report a
         # per-endpoint throughput/latency split)
         self.endpoint = endpoint
+        # tenant identity this request was sent AS (--tenants mixes report
+        # a per-tenant latency split — the noisy-neighbor isolation proof)
+        self.tenant = tenant
 
 
 class ThreadStat:
@@ -51,7 +54,8 @@ class InferContext:
     """One request slot: prepared data rotation + send (infer_context.h:43)."""
 
     def __init__(self, ctx_id, backend, data_manager, loader, model_name,
-                 model_version, sequence_manager=None, thread_stat=None):
+                 model_version, sequence_manager=None, thread_stat=None,
+                 tenant=""):
         self.ctx_id = ctx_id
         self.backend = backend
         self.data_manager = data_manager
@@ -60,6 +64,7 @@ class InferContext:
         self.model_version = model_version
         self.sequences = sequence_manager
         self.stat = thread_stat or ThreadStat()
+        self.tenant = tenant  # sent as the x-tenant-id header when set
         self._rot = 0  # (stream, step) rotation for stateless workloads
 
     def send(self, delayed=False):
@@ -85,6 +90,7 @@ class InferContext:
             )
             self._rot += 1
         data = self.data_manager.get_infer_data(stream_id, step_id)
+        headers = {"x-tenant-id": self.tenant} if self.tenant else None
         start = time.monotonic_ns()
         ok = True
         try:
@@ -96,6 +102,7 @@ class InferContext:
                 sequence_start=seq_start,
                 sequence_end=seq_end,
                 model_version=self.model_version,
+                headers=headers,
             )
             if getattr(self.data_manager, "completion_sync", False):
                 self.data_manager.sync_outputs()
@@ -108,6 +115,7 @@ class InferContext:
                 RequestRecord(
                     start, end, ok, seq_id, delayed,
                     endpoint=self.backend.endpoint,
+                    tenant=self.tenant,
                 )
             )
 
@@ -150,7 +158,8 @@ class LoadManager:
     """Base: owns backend(s), data pipeline, worker threads, stat swap."""
 
     def __init__(self, backend_factory, data_loader, data_manager, model_name,
-                 model_version="", sequence_manager=None, max_threads=16):
+                 model_version="", sequence_manager=None, max_threads=16,
+                 tenants=None):
         self._backend_factory = backend_factory  # () -> ClientBackend
         self.loader = data_loader
         self.data_manager = data_manager
@@ -158,6 +167,9 @@ class LoadManager:
         self.model_version = model_version
         self.sequences = sequence_manager
         self.max_threads = max_threads
+        # Tenant mix: worker slot i sends as tenants[i % len(tenants)]
+        # (--tenants "gold:3,bronze:1" expands to a weighted slot list)
+        self.tenants = list(tenants or [])
         self._threads = []  # (thread, ThreadStat, stop_event)
         self._backends = []
         self._residual = []  # records harvested from stopped workers
@@ -206,9 +218,12 @@ class LoadManager:
         stat = ThreadStat()
         backend = self._backend_factory()
         self._backends.append(backend)
+        tenant = (
+            self.tenants[ctx_id % len(self.tenants)] if self.tenants else ""
+        )
         ctx = InferContext(
             ctx_id, backend, self.data_manager, self.loader, self.model_name,
-            self.model_version, self.sequences, stat,
+            self.model_version, self.sequences, stat, tenant=tenant,
         )
 
         def run(ctx=ctx, stop=stop, stat=stat):

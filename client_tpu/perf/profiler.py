@@ -38,6 +38,9 @@ class PerfStatus:
         # multi-replica runs: endpoint -> {count, throughput, avg_us,
         # p99_us, errors} (empty for single-endpoint runs)
         self.per_endpoint = {}
+        # --tenants mixes: tenant -> same split (empty below two tenants);
+        # the per-tenant p99 is the noisy-neighbor isolation readout
+        self.per_tenant = {}
         self.client_window_s = 0.0
         # Fraction of worker-slot wall time NOT spent inside a request —
         # harness bookkeeping + data rotation (reference "perf_analyzer
@@ -53,10 +56,11 @@ class PerfStatus:
 class Measurement:
     __slots__ = ("throughput", "latency_avg_ns", "latencies_ns", "errors",
                  "delayed", "window_s", "send_rate", "busy_ns",
-                 "per_endpoint")
+                 "per_endpoint", "per_tenant")
 
     def __init__(self, throughput, latency_avg_ns, latencies_ns, errors,
-                 delayed, window_s, send_rate, busy_ns=0, per_endpoint=None):
+                 delayed, window_s, send_rate, busy_ns=0, per_endpoint=None,
+                 per_tenant=None):
         self.throughput = throughput
         self.latency_avg_ns = latency_avg_ns
         self.latencies_ns = latencies_ns
@@ -68,6 +72,7 @@ class Measurement:
         # endpoint -> {"latencies_ns": ndarray, "errors": int} for this
         # window (only populated when records carry endpoint identities)
         self.per_endpoint = per_endpoint or {}
+        self.per_tenant = per_tenant or {}
 
 
 class InferenceProfiler:
@@ -166,23 +171,8 @@ class InferenceProfiler:
             for r in records
             if r.end_ns <= window_end
         )
-        per_endpoint = {}
-        if any(r.endpoint for r in records):
-            for r in valid:
-                entry = per_endpoint.setdefault(
-                    r.endpoint, {"latencies_ns": [], "errors": 0}
-                )
-                entry["latencies_ns"].append(r.end_ns - r.start_ns)
-            for r in records:
-                if not r.ok:
-                    entry = per_endpoint.setdefault(
-                        r.endpoint, {"latencies_ns": [], "errors": 0}
-                    )
-                    entry["errors"] += 1
-            for entry in per_endpoint.values():
-                entry["latencies_ns"] = np.asarray(
-                    entry["latencies_ns"], np.int64
-                )
+        per_endpoint = self._group_window(records, valid, "endpoint")
+        per_tenant = self._group_window(records, valid, "tenant")
         return Measurement(
             throughput=len(valid) / window_s if window_s > 0 else 0.0,
             latency_avg_ns=float(lat.mean()) if lat.size else 0.0,
@@ -193,7 +183,33 @@ class InferenceProfiler:
             send_rate=sent / window_s if window_s > 0 else 0.0,
             busy_ns=int(busy),
             per_endpoint=per_endpoint,
+            per_tenant=per_tenant,
         )
+
+    @staticmethod
+    def _group_window(records, valid, attr):
+        """One window's {group: latencies/errors} split keyed on a record
+        attribute — the shared shape behind the per-endpoint (replica) and
+        per-tenant (QoS) summaries."""
+        groups = {}
+        if not any(getattr(r, attr) for r in records):
+            return groups
+        for r in valid:
+            entry = groups.setdefault(
+                getattr(r, attr), {"latencies_ns": [], "errors": 0}
+            )
+            entry["latencies_ns"].append(r.end_ns - r.start_ns)
+        for r in records:
+            if not r.ok:
+                entry = groups.setdefault(
+                    getattr(r, attr), {"latencies_ns": [], "errors": 0}
+                )
+                entry["errors"] += 1
+        for entry in groups.values():
+            entry["latencies_ns"] = np.asarray(
+                entry["latencies_ns"], np.int64
+            )
+        return groups
 
     # -- stability loop ------------------------------------------------------
 
@@ -278,7 +294,8 @@ class InferenceProfiler:
             status.overhead_pct = round(
                 max(0.0, 100.0 * (1.0 - busy / total_slot_ns)), 2
             )
-        status.per_endpoint = self._per_endpoint_summary(window)
+        status.per_endpoint = self._group_summary(window, "per_endpoint")
+        status.per_tenant = self._group_summary(window, "per_tenant")
         if self.metrics is not None:
             status.tpu_metrics = self.metrics.summarize(
                 self.metrics.swap_snapshots()
@@ -286,29 +303,30 @@ class InferenceProfiler:
         return status
 
     @staticmethod
-    def _per_endpoint_summary(window):
-        """Aggregate the windows' per-endpoint groups into the summary's
-        throughput/latency split (only meaningful past one endpoint)."""
-        endpoints = sorted({e for m in window for e in m.per_endpoint})
-        if len(endpoints) < 2:
+    def _group_summary(window, attr):
+        """Aggregate the windows' grouped measurements (``per_endpoint`` or
+        ``per_tenant``) into the summary's throughput/latency split (only
+        meaningful past one group)."""
+        groups = sorted({g for m in window for g in getattr(m, attr)})
+        if len(groups) < 2:
             return {}
         total_s = sum(m.window_s for m in window)
         out = {}
-        for endpoint in endpoints:
+        for group in groups:
             lat = [
-                m.per_endpoint[endpoint]["latencies_ns"]
+                getattr(m, attr)[group]["latencies_ns"]
                 for m in window
-                if endpoint in m.per_endpoint
+                if group in getattr(m, attr)
             ]
             lat = (
                 np.concatenate([a for a in lat if a.size] or
                                [np.array([], np.int64)])
             )
             errors = sum(
-                m.per_endpoint.get(endpoint, {}).get("errors", 0)
+                getattr(m, attr).get(group, {}).get("errors", 0)
                 for m in window
             )
-            out[endpoint] = {
+            out[group] = {
                 "count": int(lat.size),
                 "throughput": lat.size / total_s if total_s > 0 else 0.0,
                 "avg_us": float(lat.mean()) / 1e3 if lat.size else 0.0,
@@ -498,7 +516,7 @@ def _flatten_stats(stats):
     for ms in model_stats:
         agg = ms.get("inference_stats", {})
         for phase in ("success", "queue", "compute_input", "compute_infer",
-                      "compute_output"):
+                      "compute_output", "cache_hit", "cache_miss"):
             entry = agg.get(phase, {})
             out[f"{phase}_count"] = out.get(f"{phase}_count", 0) + int(
                 entry.get("count", 0)

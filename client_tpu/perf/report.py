@@ -39,6 +39,14 @@ def print_summary(results, percentile=None):
                 f"avg {ep['avg_us']:.0f} usec, "
                 f"p99 {ep['p99_us']:.0f} usec{failed}"
             )
+        for tenant, tp in sorted(s.per_tenant.items()):
+            failed = f", {tp['errors']} failed" if tp["errors"] else ""
+            print(
+                f"    tenant {tenant or '(default)'}: {tp['count']} ok, "
+                f"{tp['throughput']:.1f} infer/sec, "
+                f"avg {tp['avg_us']:.0f} usec, "
+                f"p99 {tp['p99_us']:.0f} usec{failed}"
+            )
         for gauge, agg in sorted(s.tpu_metrics.items()):
             print(
                 f"    {gauge}: avg {agg['avg']:.0f}, max {agg['max']:.0f}"
@@ -54,6 +62,16 @@ def print_summary(results, percentile=None):
                 ns = srv.get(f"{phase}_ns", 0)
                 parts.append(f"{phase} {ns / cnt / 1e3:.0f}")
             print(f"  Server: avg usec/request: {', '.join(parts)}")
+            hits = srv.get("cache_hit_count", 0)
+            if hits or srv.get("cache_miss_count", 0):
+                served = hits + srv.get("cache_miss_count", 0)
+                pct = 100.0 * hits / served if served else 0.0
+                print(
+                    f"    response cache: {hits} hits / {served} lookups "
+                    f"({pct:.1f}%), avg hit "
+                    f"{srv.get('cache_hit_ns', 0) / max(hits, 1) / 1e3:.0f} "
+                    "usec"
+                )
         for name, counters in sorted(s.ensemble_stats.items()):
             cnt = max(counters.get("success_count", 0), 1)
             infer_us = counters.get("compute_infer_ns", 0) / cnt / 1e3
@@ -84,7 +102,7 @@ def write_csv(path, results, verbose=False):
     if verbose:
         fields += [
             "Server Queue", "Server Compute Input", "Server Compute Infer",
-            "Server Compute Output",
+            "Server Compute Output", "Server Cache Hits",
         ]
     gauges = sorted({g for s in results for g in s.tpu_metrics})
     for gauge in gauges:
@@ -115,6 +133,7 @@ def write_csv(path, results, verbose=False):
                     f"{srv.get('compute_input_ns', 0) / cnt / 1e3:.0f}",
                     f"{srv.get('compute_infer_ns', 0) / cnt / 1e3:.0f}",
                     f"{srv.get('compute_output_ns', 0) / cnt / 1e3:.0f}",
+                    str(srv.get("cache_hit_count", 0)),
                 ]
             for gauge in gauges:
                 agg = s.tpu_metrics.get(gauge)

@@ -31,11 +31,20 @@ class Server:
         verbose=False,
         with_default_models=True,
         max_inflight=None,
+        response_cache=None,
+        coalescing=False,
+        qos=None,
     ):
         all_models = list(models or [])
         if with_default_models:
             all_models.extend(default_models())
-        self.engine = InferenceEngine(all_models, max_inflight=max_inflight)
+        self.engine = InferenceEngine(
+            all_models,
+            max_inflight=max_inflight,
+            response_cache=response_cache,
+            coalescing=coalescing,
+            qos=qos,
+        )
         self._http = None
         self._grpc = None
         self._http_port = http_port

@@ -21,6 +21,32 @@ def main():
         default="builtin",
         help="comma-separated model sets: builtin,jax,language (default: builtin)",
     )
+    parser.add_argument(
+        "--response-cache-entries", type=int, default=0,
+        help="enable the content-addressed response cache with this many "
+             "LRU entries (0 = off)",
+    )
+    parser.add_argument(
+        "--response-cache-ttl", type=float, default=None,
+        help="response-cache entry TTL in seconds (default: no expiry)",
+    )
+    parser.add_argument(
+        "--coalescing", action="store_true",
+        help="collapse identical concurrent requests into one dispatch",
+    )
+    parser.add_argument(
+        "--tenant-inflight", type=int, default=None,
+        help="per-tenant concurrent-request cap (429 + Retry-After beyond)",
+    )
+    parser.add_argument(
+        "--tenant-rate", type=float, default=None,
+        help="per-tenant request-rate quota in req/s (429 + Retry-After "
+             "beyond)",
+    )
+    parser.add_argument(
+        "--max-inflight", type=int, default=None,
+        help="global concurrent-request cap (retryable 503 beyond)",
+    )
     args = parser.parse_args()
 
     from client_tpu.serve.models import model_sets
@@ -30,6 +56,23 @@ def main():
 
     from client_tpu.serve import Server
 
+    cache = None
+    if args.response_cache_entries > 0:
+        from client_tpu.serve.frontdoor import ResponseCache
+
+        cache = ResponseCache(
+            max_entries=args.response_cache_entries,
+            ttl_s=args.response_cache_ttl,
+        )
+    qos = None
+    if args.tenant_inflight is not None or args.tenant_rate is not None:
+        from client_tpu.serve.frontdoor import TenantQoS
+
+        qos = TenantQoS(
+            default_max_inflight=args.tenant_inflight,
+            default_rate_per_s=args.tenant_rate,
+        )
+
     server = Server(
         models=extra,
         http_port=args.http_port,
@@ -37,6 +80,10 @@ def main():
         host=args.host,
         verbose=args.verbose,
         with_default_models="builtin" in args.models.split(","),
+        max_inflight=args.max_inflight,
+        response_cache=cache,
+        coalescing=args.coalescing,
+        qos=qos,
     ).start()
     print(f"client_tpu.serve: HTTP on {server.http_address}", flush=True)
     if server.grpc_address:

@@ -130,9 +130,10 @@ def _device_split(arr, offset, rows):
 
 class _Pending:
     __slots__ = ("inputs", "rows", "signature", "event", "result", "error",
-                 "t_enq", "trace")
+                 "t_enq", "trace", "tenant", "weight", "vfinish")
 
-    def __init__(self, inputs, rows, signature, trace=None):
+    def __init__(self, inputs, rows, signature, trace=None, tenant="",
+                 weight=1.0):
         self.inputs = inputs
         self.rows = rows
         self.signature = signature
@@ -141,6 +142,103 @@ class _Pending:
         self.error = None
         self.t_enq = time.monotonic_ns()
         self.trace = trace  # optional RequestTrace (queue/compute events)
+        self.tenant = tenant  # fair-queue lane (see _FairQueue)
+        self.weight = max(float(weight), 1e-3)
+        self.vfinish = 0.0  # virtual finish time, stamped at push
+
+
+class _FairQueue:
+    """Weighted-fair queue over per-tenant FIFO lanes.
+
+    The batcher's old single FIFO serves a flooding tenant's backlog ahead
+    of everyone who arrived later — arrival order IS the schedule.  Here
+    each request is stamped a *virtual finish time* on push
+    (``max(vclock, lane_last_finish) + rows / weight``, the classic
+    start-time fair queueing recurrence) and :meth:`pop` always takes the
+    earliest stamp across lane heads: a tenant's burst deepens only its
+    own lane, and service converges to the weight ratio regardless of
+    arrival order.  Within one lane order stays FIFO.
+
+    Not internally locked — the batcher's ``_cond`` guards every call.
+    """
+
+    __slots__ = ("_lanes", "_last_vfinish", "_vclock", "_len")
+
+    def __init__(self):
+        self._lanes = {}  # tenant -> deque of _Pending
+        self._last_vfinish = {}  # tenant -> last stamped vfinish
+        self._vclock = 0.0
+        self._len = 0
+
+    def __len__(self):
+        return self._len
+
+    def push(self, pending):
+        lane = self._lanes.get(pending.tenant)
+        if lane is None:
+            lane = deque()
+            self._lanes[pending.tenant] = lane
+        start = max(
+            self._vclock, self._last_vfinish.get(pending.tenant, 0.0)
+        )
+        pending.vfinish = start + max(pending.rows, 1) / pending.weight
+        self._last_vfinish[pending.tenant] = pending.vfinish
+        lane.append(pending)
+        self._len += 1
+
+    def pop(self):
+        """Remove and return the entry with the earliest virtual finish
+        time (caller guarantees non-empty)."""
+        best_tenant, best = None, None
+        for tenant, lane in self._lanes.items():
+            head = lane[0]
+            if best is None or head.vfinish < best.vfinish:
+                best_tenant, best = tenant, head
+        self._remove(best_tenant, 0)
+        self._vclock = max(self._vclock, best.vfinish)
+        return best
+
+    def take_first(self, pred):
+        """Remove and return the fair-order-first entry matching *pred*
+        (the batch fold-in scan), or None.  Per lane only the earliest
+        match is a candidate — lane order stays FIFO."""
+        best_tenant, best_i, best = None, None, None
+        for tenant, lane in self._lanes.items():
+            for i, pending in enumerate(lane):
+                if pred(pending):
+                    if best is None or pending.vfinish < best.vfinish:
+                        best_tenant, best_i, best = tenant, i, pending
+                    break
+        if best is None:
+            return None
+        self._remove(best_tenant, best_i)
+        return best
+
+    def _remove(self, tenant, index):
+        lane = self._lanes[tenant]
+        del lane[index]
+        self._len -= 1
+        if not lane:
+            del self._lanes[tenant]
+        if not self._lanes:
+            # busy period over: forget per-tenant stamps so the map cannot
+            # grow without bound across tenant churn (vclock memory only
+            # matters while requests are queued)
+            self._last_vfinish.clear()
+            self._vclock = 0.0
+
+    def depths(self):
+        """{tenant: queued count} (/metrics per-tenant queue gauge)."""
+        return {tenant: len(lane) for tenant, lane in self._lanes.items()}
+
+    def drain(self):
+        """Remove and return every queued entry (shutdown/failure paths)."""
+        out = [p for lane in self._lanes.values() for p in lane]
+        self._lanes.clear()
+        self._last_vfinish.clear()
+        self._vclock = 0.0
+        self._len = 0
+        return out
 
 
 class ModelBatcher:
@@ -204,7 +302,10 @@ class ModelBatcher:
         self._host_closed = False
         self._inflight = 0  # dispatched, completion pending (under _cond)
         self._cond = threading.Condition()
-        self._queue = deque()
+        # Weighted-fair queue across tenant lanes (one lane per tenant;
+        # submit() stamps tenant + weight) — replaces the single FIFO so a
+        # flooding tenant's backlog cannot schedule ahead of everyone else.
+        self._queue = _FairQueue()
         # Requests popped off the queue but not yet completed/failed (gathered
         # group + the in-flight pipelined batch).  Tracked so the _loop
         # BaseException handler can fail them too — otherwise a KeyboardInterrupt
@@ -304,10 +405,16 @@ class ModelBatcher:
         with self._cond:
             return len(self._queue)
 
-    def submit(self, inputs, trace=None):
+    def queue_depths_by_tenant(self):
+        """{tenant: queued count} (/metrics per-tenant queue gauge)."""
+        with self._cond:
+            return self._queue.depths()
+
+    def submit(self, inputs, trace=None, tenant="", weight=1.0):
         """Block until the batched execution finishes; return this request's
         slice of the outputs — host numpy arrays for wire groups, live device
-        slices for device (TPU-shm) groups."""
+        slices for device (TPU-shm) groups.  ``tenant``/``weight`` select
+        and weight the fair-queue lane this request waits in."""
         rows = _leading_rows(inputs)
         # Device-resident requests batch with the jnp path (concat + split on
         # device, no transfers) and must never mix with host groups — the
@@ -323,7 +430,8 @@ class ModelBatcher:
             # of cold XLA compile on the request path) — groups stay
             # row-uniform so every composition is a warmed executable
             signature += (rows,)
-        pending = _Pending(inputs, rows, signature, trace)
+        pending = _Pending(inputs, rows, signature, trace, tenant=tenant,
+                           weight=weight)
         with self._cond:
             if self._closed:
                 raise InferenceServerException(
@@ -352,7 +460,7 @@ class ModelBatcher:
                     "retry after backoff",
                     status="503",
                 )
-            self._queue.append(pending)
+            self._queue.push(pending)
             self._cond.notify()
         pending.event.wait()
         if pending.error is not None:
@@ -385,11 +493,10 @@ class ModelBatcher:
         self._observer.close(timeout=max(deadline - time.monotonic(), 0.0))
         # Fail anything still queued.  Drained under the lock so a batcher
         # thread that outlived the join timeout (e.g. blocked in a cold
-        # compile) cannot race the deque; items it already popped are its to
+        # compile) cannot race the queue; items it already popped are its to
         # complete, items still queued are ours to fail.
         with self._cond:
-            leftovers = list(self._queue)
-            self._queue.clear()
+            leftovers = self._queue.drain()
         for p in leftovers:
             p.error = InferenceServerException("server shutdown", status="500")
             p.event.set()
@@ -402,10 +509,9 @@ class ModelBatcher:
         except BaseException:  # noqa: BLE001 - a dead batcher must not strand waiters
             with self._cond:
                 self._closed = True
-                leftovers = list(self._queue) + [
+                leftovers = self._queue.drain() + [
                     p for p in self._active if not p.event.is_set()
                 ]
-                self._queue.clear()
                 self._active.clear()
             err = InferenceServerException(
                 f"model '{self.model.name}' batcher thread died", status="500"
@@ -501,20 +607,22 @@ class ModelBatcher:
                 self._finish_one(self._sem)
 
     def _drain_compatible_locked(self, group, first, rows, max_arity):
-        """Fold queued signature-compatible requests into *group* (no wait).
-        Caller holds self._cond.  Returns the updated row count."""
+        """Fold queued signature-compatible requests into *group* (no wait),
+        taken in fair-queue order so the fold-in cannot become a side door
+        around the weighted-fair schedule.  Caller holds self._cond.
+        Returns the updated row count."""
         while rows < self.max_batch and len(group) < max_arity:
-            taken = False
-            for i, p in enumerate(self._queue):
-                if p.signature == first.signature and rows + p.rows <= self.max_batch:
-                    del self._queue[i]
-                    self._active.add(p)
-                    group.append(p)
-                    rows += p.rows
-                    taken = True
-                    break
-            if not taken:
+            taken = self._queue.take_first(
+                lambda p, rows=rows: (
+                    p.signature == first.signature
+                    and rows + p.rows <= self.max_batch
+                )
+            )
+            if taken is None:
                 break
+            self._active.add(taken)
+            group.append(taken)
+            rows += taken.rows
         return rows
 
     def _max_arity(self, first):
@@ -540,7 +648,7 @@ class ModelBatcher:
                 if self._closed:
                     return None
                 self._cond.wait()
-            first = self._queue.popleft()
+            first = self._queue.pop()
             self._active.add(first)
             group = [first]
             max_arity = self._max_arity(first)

@@ -14,7 +14,7 @@ from google.protobuf import json_format
 from client_tpu._grpc_service import METHODS, SERVICE
 from client_tpu._proto import inference_pb2 as pb
 from client_tpu._proto import model_config_pb2 as mc
-from client_tpu.serve import model_runtime
+from client_tpu.serve import frontdoor, model_runtime
 from client_tpu.utils import InferenceServerException, to_wire_bytes
 from client_tpu._infer_types import _np_from_json_data
 
@@ -35,7 +35,20 @@ def _abort(context, exc):
     if isinstance(exc, InferenceServerException) and exc.status():
         code = _STATUS_MAP.get(exc.status(), grpc.StatusCode.UNKNOWN)
     msg = exc.message() if isinstance(exc, InferenceServerException) else str(exc)
+    # QoS/overload sheds carry a backoff hint in trailing metadata (the
+    # gRPC spelling of the HTTP Retry-After header)
+    hint = getattr(exc, "retry_after_s", None)
+    if hint:
+        context.set_trailing_metadata((("retry-after", f"{float(hint):.3f}"),))
     context.abort(code, msg)
+
+
+def _tenant_of(context):
+    """Tenant identity from the request metadata (serve/frontdoor.py)."""
+    for key, value in context.invocation_metadata() or ():
+        if key == frontdoor.TENANT_HEADER:
+            return value
+    return ""
 
 
 def _param_value(param):
@@ -395,7 +408,7 @@ class _Handlers:
             req, binary = _request_to_dict(request)
             result = self.engine.execute(
                 request.model_name, request.model_version, req, binary,
-                trace=trace,
+                trace=trace, tenant=_tenant_of(context),
             )
             if not isinstance(result, tuple):  # list/generator = decoupled
                 if hasattr(result, "close"):
@@ -421,6 +434,7 @@ class _Handlers:
                 self.engine.tracer.complete(trace)
 
     def ModelStreamInfer(self, request_iterator, context):
+        tenant = _tenant_of(context)  # one identity per stream connection
         for request in request_iterator:
             trace = self._sample_trace(request, context)
             if trace is not None:
@@ -429,7 +443,7 @@ class _Handlers:
                 req, binary = _request_to_dict(request)
                 result = self.engine.execute(
                     request.model_name, request.model_version, req, binary,
-                    trace=trace,
+                    trace=trace, tenant=tenant,
                 )
                 # a decoupled result streams lazily (generator): each
                 # response reaches the wire as the model produces it

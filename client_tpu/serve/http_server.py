@@ -9,13 +9,14 @@ no cudart anywhere in this framework).
 
 import base64
 import json
+import math
 import re
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import unquote
 
 from client_tpu import _codec
-from client_tpu.serve import model_runtime
+from client_tpu.serve import frontdoor, model_runtime
 from client_tpu.utils import InferenceServerException
 
 _MODEL_URI = re.compile(
@@ -80,8 +81,17 @@ class _Handler(BaseHTTPRequestHandler):
         if status in (429, 503):
             # overload/drain shedding is retryable: tell well-behaved
             # clients when to come back (client retry policies cap this
-            # hint at their own max backoff)
-            headers = {"Retry-After": "1"}
+            # hint at their own max backoff).  QoS quota rejections carry
+            # a computed hint (when the token bucket refills); others
+            # default to 1s.  RFC 9110 Retry-After is integer
+            # delta-seconds — a fractional value would be rejected by
+            # spec-strict third-party parsers, silencing the hint exactly
+            # when it matters.
+            hint = getattr(exc, "retry_after_s", None)
+            headers = {
+                "Retry-After": str(max(1, math.ceil(float(hint))))
+                if hint else "1"
+            }
         self._send(status, json.dumps({"error": msg}).encode("utf-8"), headers)
 
     # -- request routing -----------------------------------------------------
@@ -285,11 +295,14 @@ class _Handler(BaseHTTPRequestHandler):
             self.headers.get("traceparent"), model_name=model,
             model_version=version, protocol="http",
         )
+        # tenant identity for QoS/fair-queueing (serve/frontdoor.py);
+        # header lookup is case-insensitive per the email-message API
+        tenant = self.headers.get(frontdoor.TENANT_HEADER) or ""
         if trace is not None:
             trace.event("REQUEST_START")
         try:
             result = self.engine.execute(
-                model, version, request, binary, trace=trace
+                model, version, request, binary, trace=trace, tenant=tenant
             )
             if not isinstance(result, tuple):  # decoupled (generator/list)
                 # consuming it releases its admission slot
@@ -334,7 +347,17 @@ class HttpFrontend:
         handler = type(
             "BoundHandler", (_Handler,), {"engine": engine, "verbose": verbose}
         )
-        self._httpd = ThreadingHTTPServer((host, port), handler)
+        # socketserver's default listen backlog is 5: a connection burst
+        # (many tenants arriving at once) overflows the accept queue and
+        # the spilled clients pay a full TCP SYN-retransmit timeout (~1s)
+        # before connecting — a 50x tail-latency cliff invisible in any
+        # server-side metric.  A multi-tenant front door needs a real
+        # backlog; admission control above decides who gets served.
+        server_cls = type(
+            "FrontDoorHTTPServer", (ThreadingHTTPServer,),
+            {"request_queue_size": 128},
+        )
+        self._httpd = server_cls((host, port), handler)
         self._httpd.daemon_threads = True
         self._thread = None
 

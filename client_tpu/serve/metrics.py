@@ -485,6 +485,17 @@ def render_metrics(engine):
     )
     for name, depth in sorted(engine.queue_depths().items()):
         buf.add("ctpu_queue_depth", {"model": name}, depth)
+    tenant_depths = getattr(engine, "tenant_queue_depths", None)
+    if tenant_depths is not None:
+        buf.declare(
+            "ctpu_tenant_queue_depth", "gauge",
+            "Requests waiting per tenant fair-queue lane",
+        )
+        for (model, tenant), depth in sorted(tenant_depths().items()):
+            buf.add(
+                "ctpu_tenant_queue_depth",
+                {"model": model, "tenant": tenant}, depth,
+            )
     buf.declare(
         "ctpu_inflight_requests", "gauge", "Requests currently executing"
     )

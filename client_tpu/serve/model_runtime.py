@@ -230,6 +230,12 @@ class ModelStats:
         self.compute_input_ns = 0
         self.compute_output_ns = 0
         self.queue_ns = 0
+        # response-cache accounting (the reference surfaces cache_hit /
+        # cache_miss durations through the statistics extension)
+        self.cache_hit_count = 0
+        self.cache_hit_ns = 0
+        self.cache_miss_count = 0
+        self.cache_miss_ns = 0
         # distributions behind the /metrics histograms: per-request
         # end-to-end duration (success AND failure), per-request batcher
         # queue time, and execution batch size
@@ -280,6 +286,23 @@ class ModelStats:
             self.success_ns += total_ns
             self.request_us.observe(total_ns / 1000)
 
+    def record_cache_hit(self, total_ns):
+        """One request answered from the response cache: a request success
+        with zero inferences executed (inference_count untouched)."""
+        with self.lock:
+            self.success_count += 1
+            self.success_ns += total_ns
+            self.request_us.observe(total_ns / 1000)
+            self.cache_hit_count += 1
+            self.cache_hit_ns += total_ns
+
+    def record_cache_miss(self, lookup_ns):
+        """One cacheable request that had to execute (the lookup cost is
+        what the reference's cache_miss duration measures)."""
+        with self.lock:
+            self.cache_miss_count += 1
+            self.cache_miss_ns += lookup_ns
+
     def histograms(self):
         """Snapshots of (request_us, queue_us, batch_rows) for /metrics."""
         with self.lock:
@@ -313,8 +336,14 @@ class ModelStats:
                         "count": self.success_count,
                         "ns": self.compute_output_ns,
                     },
-                    "cache_hit": {"count": 0, "ns": 0},
-                    "cache_miss": {"count": 0, "ns": 0},
+                    "cache_hit": {
+                        "count": self.cache_hit_count,
+                        "ns": self.cache_hit_ns,
+                    },
+                    "cache_miss": {
+                        "count": self.cache_miss_count,
+                        "ns": self.cache_miss_ns,
+                    },
                 },
             }
 
@@ -685,6 +714,9 @@ class InferenceEngine:
         strict_model_config=True,
         max_sequence_idle_s=60.0,
         max_inflight=None,
+        response_cache=None,
+        coalescing=False,
+        qos=None,
     ):
         self._lock = threading.Lock()
         self._models = {}
@@ -711,6 +743,20 @@ class InferenceEngine:
         # drain counters for /metrics
         self.tracer = Tracer(self.trace_settings)
         self.metrics = Registry()
+        # Multi-tenant front door (serve/frontdoor.py): response cache,
+        # in-flight coalescing, per-tenant QoS.  All opt-in; their metrics
+        # land in this engine's registry unless already bound elsewhere.
+        self.response_cache = response_cache
+        if response_cache is not None and response_cache.registry is None:
+            response_cache.registry = self.metrics
+        self.qos = qos
+        if qos is not None and qos.registry is None:
+            qos.registry = self.metrics
+        self._coalescer = None
+        if coalescing:
+            from client_tpu.serve.frontdoor import Coalescer
+
+            self._coalescer = Coalescer(registry=self.metrics)
         self.log_settings = {
             "log_file": "",
             "log_info": True,
@@ -733,8 +779,18 @@ class InferenceEngine:
             stale = self._batchers.pop(model.name, None)
         if stale is not None:
             stale.close()
+        self._invalidate_cache()
         if model.dynamic_batching and model.warmup:
             self._batcher_for(model).warmup(model.inputs)
+
+    def _invalidate_cache(self):
+        """Repository mutations (add/load/unload) drop the whole response
+        cache: the digest keys on request CONTENT, so a model swapped with
+        new weights or a config/file override would keep answering from its
+        pre-mutation cache forever (repository changes are rare; a full
+        clear is cheap and always correct)."""
+        if self.response_cache is not None:
+            self.response_cache.clear()
 
     def get_model(self, name, version=""):
         with self._lock:
@@ -775,6 +831,7 @@ class InferenceEngine:
             model.config_override = config_override
             model.file_overrides = files or {}
             self._ready[name] = True
+        self._invalidate_cache()
 
     def unload_model(self, name):
         with self._lock:
@@ -786,6 +843,7 @@ class InferenceEngine:
             batcher = self._batchers.pop(name, None)
         if batcher is not None:
             batcher.close()
+        self._invalidate_cache()
 
     def repository_index(self, ready_only=False):
         with self._lock:
@@ -846,6 +904,17 @@ class InferenceEngine:
         with self._lock:
             batchers = dict(self._batchers)
         return {name: b.queue_depth() for name, b in batchers.items()}
+
+    def tenant_queue_depths(self):
+        """{(model, tenant): queued count} across batcher fair-queue lanes
+        (the per-tenant /metrics queue gauge)."""
+        with self._lock:
+            batchers = dict(self._batchers)
+        out = {}
+        for name, batcher in batchers.items():
+            for tenant, depth in batcher.queue_depths_by_tenant().items():
+                out[(name, tenant)] = depth
+        return out
 
     def inflight_count(self):
         with self._flight_cv:
@@ -920,36 +989,216 @@ class InferenceEngine:
     # execution ------------------------------------------------------------
 
     def execute(self, model_name, model_version, request, binary_section,
-                trace=None):
-        """Run one inference request through admission control.
+                trace=None, tenant=""):
+        """Run one inference request through the front door + admission.
 
         *request* is the JSON-form header dict; *binary_section* the raw bytes
         after the header. Returns (response_dict, binary_blobs) — for decoupled
         models, a list of such tuples.  *trace* is an optional RequestTrace
         the frontend sampled; the engine (and the dynamic batcher) record the
-        queue/compute timeline onto it.
+        queue/compute timeline onto it.  *tenant* is the caller identity from
+        the ``x-tenant-id`` header/metadata key (empty = default tenant).
+
+        Order of the front door: response-cache lookup → in-flight
+        coalescing → per-tenant QoS admission (429 with Retry-After) →
+        global admission (503) → execution.  Cache hits and coalesced
+        followers never consume an execution slot OR a tenant quota slot —
+        that is the point: serving a hot key from the cache costs the
+        server almost nothing, so shedding it would be self-defeating
+        (they still count in the per-tenant request series).
         """
+        t0 = time.monotonic_ns()
         if trace is not None:
+            trace.tenant = tenant
             trace.event("QUEUE_START")
+        key = self._front_key(model_name, model_version, request,
+                              binary_section)
+        if key is not None:
+            return self._front_door(
+                key, model_name, model_version, request, binary_section,
+                trace, tenant, t0,
+            )
+        qos_release = self.qos.admit(tenant) if self.qos is not None else None
+        try:
+            result = self._execute_slot(
+                model_name, model_version, request, binary_section,
+                trace, tenant, extra_release=qos_release,
+            )
+            if isinstance(result, _InflightStream):
+                qos_release = None  # the stream owns the QoS slot now
+            return result
+        finally:
+            if qos_release is not None:
+                qos_release()
+
+    def _front_key(self, model_name, model_version, request, binary_section):
+        """Cache/coalesce digest for this request, or None when the front
+        door does not apply (no cache or coalescer configured; decoupled or
+        stateful model; sequence/shared-memory request; unknown model —
+        the normal path raises the proper error)."""
+        if self.response_cache is None and self._coalescer is None:
+            return None
+        with self._lock:
+            model = self._models.get(model_name)
+            if model is None or not self._ready.get(model_name):
+                return None
+        if model.decoupled or model.stateful:
+            return None
+        from client_tpu.serve.frontdoor import request_digest
+
+        return request_digest(model_name, model_version, request,
+                              binary_section)
+
+    def _front_door(self, key, model_name, model_version, request,
+                    binary_section, trace, tenant, t0):
+        """Serve one cacheable unary request: cache hit, coalesced follower,
+        or (leader / uncoalesced) QoS-admitted execution + cache fill."""
+        stats = self._stats[model_name]
+        lookup_ns = 0
+        if self.response_cache is not None:
+            lookup0 = time.monotonic_ns()
+            cached = self.response_cache.get(key)
+            lookup_ns = time.monotonic_ns() - lookup0
+            if cached is not None:
+                if trace is not None:
+                    trace.event("CACHE_HIT")
+                if self.qos is not None:
+                    self.qos.note(tenant)
+                response, blobs = cached
+                stats.record_cache_hit(time.monotonic_ns() - t0)
+                return _stamp_id(response, request), blobs
+        if self._coalescer is None:
+            result = self._front_dispatch(
+                model_name, model_version, request, binary_section, trace,
+                tenant,
+            )
+            if not isinstance(result, tuple):
+                # the model was hot-swapped to a decoupled/stateful shape
+                # between the front-key check and execution: a stream is
+                # not cacheable — hand it straight to the caller
+                return result
+            # a miss is a request that EXECUTED after missing: coalesced
+            # followers and shed requests never dispatched, so counting
+            # them would report a near-0% hit rate during the exact storms
+            # the cache absorbs
+            if self.response_cache is not None:
+                stats.record_cache_miss(lookup_ns)
+            self._cache_fill(key, (_strip_id(result[0]), result[1]))
+            return result
+        while True:
+            is_leader, flight = self._coalescer.join(key)
+            if not is_leader:
+                # identical request already dispatching: wait for its
+                # result (the leader ALWAYS completes the flight — so
+                # this wait is bounded by the leader's execution)
+                flight.event.wait()
+                if flight.retry:
+                    # the leader was shed by ITS OWN tenant's admission:
+                    # that 429 is tenant identity, not request content —
+                    # re-contend so a compliant tenant's request becomes
+                    # the next leader under its own quota
+                    continue
+                if trace is not None:
+                    trace.event("COALESCED")
+                if self.qos is not None:
+                    self.qos.note(tenant)
+                if flight.error is not None:
+                    stats.record(False, time.monotonic_ns() - t0, 0, 0, 0)
+                    raise flight.error
+                response, blobs = flight.result
+                stats.record_request_success(time.monotonic_ns() - t0)
+                return _stamp_id(response, request), blobs
+            try:
+                result = self._front_dispatch(
+                    model_name, model_version, request, binary_section,
+                    trace, tenant,
+                )
+            except InferenceServerException as e:
+                if e.status() == "429":
+                    # tenant-scoped QoS rejection: only THIS request's
+                    # tenant exceeded its caps — followers re-contend
+                    self._coalescer.retry_followers(key, flight)
+                    raise
+                # content-scoped errors fan out to every follower: a
+                # byte-identical request would have failed identically,
+                # and N retries of it is the herd coalescing prevents
+                self._coalescer.fail(key, flight, e)
+                raise
+            except BaseException as e:
+                self._coalescer.fail(key, flight, e)
+                raise
+            if not isinstance(result, tuple):
+                # hot-swap TOCTOU (see the uncoalesced branch): nothing
+                # shareable was produced — followers re-contend and
+                # re-evaluate cacheability against the swapped model
+                self._coalescer.retry_followers(key, flight)
+                return result
+            # publish/cache the id-less rendering: followers and later
+            # hits stamp their own request id — under a guard, because a
+            # flight left incomplete here would strand every follower on
+            # an untimed wait
+            try:
+                if self.response_cache is not None:
+                    stats.record_cache_miss(lookup_ns)  # leader executed
+                shared = (_strip_id(result[0]), result[1])
+            except BaseException as e:  # pragma: no cover - defensive
+                self._coalescer.fail(key, flight, e)
+                raise
+            self._coalescer.publish(key, flight, shared)
+            self._cache_fill(key, shared)
+            return result
+
+    def _front_dispatch(self, model_name, model_version, request,
+                        binary_section, trace, tenant):
+        """One front-door request that missed every fast path: per-tenant
+        QoS admission (429) then a real execution slot.  Always unary —
+        the front door never applies to decoupled models."""
+        qos_release = self.qos.admit(tenant) if self.qos is not None else None
+        try:
+            return self._execute_slot(
+                model_name, model_version, request, binary_section, trace,
+                tenant,
+            )
+        finally:
+            if qos_release is not None:
+                qos_release()
+
+    def _cache_fill(self, key, shared):
+        """Store one id-less ``(response, blobs)`` rendering."""
+        if self.response_cache is not None:
+            self.response_cache.put(key, shared[0], shared[1])
+
+    def _execute_slot(self, model_name, model_version, request,
+                      binary_section, trace, tenant, extra_release=None):
+        """The pre-front-door execution path: global admission + execution.
+        ``extra_release`` (the QoS slot) transfers to the returned stream
+        for decoupled results."""
         self._admit()
         streamed = False
         try:
             result = self._execute_admitted(
-                model_name, model_version, request, binary_section, trace
+                model_name, model_version, request, binary_section, trace,
+                tenant,
             )
             if not isinstance(result, (tuple, list)):  # decoupled generator
                 streamed = True
-                # the stream stays counted as in-flight until the consumer
-                # exhausts, closes, or drops it — drain must not cut a
-                # stream mid-generation
-                return _InflightStream(result, self._release)
+
+                # the stream stays counted as in-flight (engine slot AND
+                # tenant slot) until the consumer exhausts, closes, or
+                # drops it — drain must not cut a stream mid-generation
+                def release(engine=self, extra=extra_release):
+                    engine._release()
+                    if extra is not None:
+                        extra()
+
+                return _InflightStream(result, release)
             return result
         finally:
             if not streamed:
                 self._release()
 
     def _execute_admitted(self, model_name, model_version, request,
-                          binary_section, trace=None):
+                          binary_section, trace=None, tenant=""):
         model = self.get_model(model_name, model_version)
         stats = self._stats[model_name]
         t0 = time.monotonic_ns()
@@ -990,7 +1239,12 @@ class InferenceEngine:
                 # per-request success is recorded here, and any failure
                 # (batched execution or rendering) falls through to the
                 # except clauses below so it is counted exactly once.
-                result = self._batcher_for(model).submit(inputs, trace=trace)
+                weight = (
+                    self.qos.weight(tenant) if self.qos is not None else 1.0
+                )
+                result = self._batcher_for(model).submit(
+                    inputs, trace=trace, tenant=tenant, weight=weight
+                )
                 rendered = self._render_response(
                     model, model_version, request, result
                 )
@@ -1398,6 +1652,23 @@ def _batchable_request(model, inputs, params, context, request):
     from client_tpu.serve.dynamic_batcher import batchable_request
 
     return batchable_request(model, inputs, params, context, request)
+
+
+def _strip_id(response):
+    """The id-less rendering shared via cache/coalescing (the request id is
+    caller identity, not content; every reader stamps its own)."""
+    if "id" in response:
+        return {k: v for k, v in response.items() if k != "id"}
+    return response
+
+
+def _stamp_id(response, request):
+    """A shallow per-caller copy of a shared response with this request's
+    id (nested structures stay shared — readers only serialize them)."""
+    out = dict(response)
+    if request.get("id"):
+        out["id"] = request["id"]
+    return out
 
 
 def _np_dtype_to_wire(arr):

@@ -110,6 +110,9 @@ class RequestTrace(_SpanBase):
         self.model_version = model_version
         self.protocol = protocol
         self.seq = seq
+        # tenant identity (x-tenant-id header/metadata), stamped by the
+        # engine so per-tenant latency can be split straight from traces
+        self.tenant = ""
 
     def traceparent(self):
         return format_traceparent(self.trace_id, self.span_id)
@@ -126,6 +129,8 @@ class RequestTrace(_SpanBase):
             "model_version": self.model_version,
             "timestamps": list(self.timestamps),
         }
+        if self.tenant:
+            record["tenant"] = self.tenant
         if self.error:
             record["error"] = self.error
         return record

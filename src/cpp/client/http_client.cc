@@ -223,10 +223,22 @@ InferenceServerHttpClient::Request(
                         std::chrono::microseconds(timeout_us);
   const auto set_socket_timeout = [&]() -> bool {
     if (tls_enabled_) {
-      // the transport owns its socket; the whole-exchange budget is only
-      // enforced between ops (see header note on TLS timeout granularity)
-      return timeout_us == 0 ||
-             std::chrono::steady_clock::now() < deadline;
+      // TLS path: the remaining budget reaches the transport's socket via
+      // SetIoTimeout, so a peer that accepts then stalls times the read
+      // out (errno EAGAIN, same as the plain-TCP SO_RCVTIMEO path) instead
+      // of hanging Infer() forever.  Factory transports without deadline
+      // support no-op and keep the old between-ops granularity.
+      if (timeout_us == 0) {
+        transport_->SetIoTimeout(0);
+        return true;
+      }
+      const auto remaining =
+          std::chrono::duration_cast<std::chrono::microseconds>(
+              deadline - std::chrono::steady_clock::now())
+              .count();
+      if (remaining <= 0) return false;  // budget exhausted
+      transport_->SetIoTimeout(remaining);
+      return true;
     }
     struct timeval tv;
     if (timeout_us == 0) {

@@ -35,9 +35,12 @@ struct HttpResponse {
 // errors helpfully when neither exists.  Sync requests run over the TLS
 // transport; the epoll-reactor async path is fd-based, so AsyncInfer on a
 // TLS client returns a descriptive error (use Infer, or terminate TLS in a
-// local proxy for async workloads).  client_timeout_us granularity on TLS
-// connections is per-connect (the transport owns its socket options), not
-// per-read as on plain TCP.
+// local proxy for async workloads).  client_timeout_us is enforced per
+// socket op on TLS connections too: the remaining budget reaches the
+// transport through ByteTransport::SetIoTimeout (SO_RCVTIMEO on the
+// built-in transports), so a peer that accepts then stalls times out
+// instead of hanging Infer().  Factory-registered transports that leave
+// SetIoTimeout a no-op degrade to between-ops granularity.
 struct HttpSslOptions {
   bool verify_peer = true;
   bool verify_host = true;

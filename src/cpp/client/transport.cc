@@ -93,6 +93,17 @@ class TcpTransport : public ByteTransport {
     }
   }
 
+  void SetIoTimeout(int64_t timeout_us) override
+  {
+    if (fd_ < 0) return;
+    struct timeval tv;
+    tv.tv_sec = static_cast<time_t>(timeout_us / 1000000);
+    tv.tv_usec = static_cast<suseconds_t>(timeout_us % 1000000);
+    // zero timeval = wait forever (the SO_RCVTIMEO contract)
+    setsockopt(fd_, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    setsockopt(fd_, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+  }
+
   void Shutdown() override
   {
     if (fd_ >= 0) shutdown(fd_, SHUT_RDWR);
@@ -203,6 +214,14 @@ class OpenSslTransport : public ByteTransport {
   {
     const int n = SSL_write(ssl_, buf, static_cast<int>(len));
     return n > 0 ? n : -1;
+  }
+
+  void SetIoTimeout(int64_t timeout_us) override
+  {
+    // the deadline lives on the underlying socket: a timed-out SSL_read
+    // fails with SSL_ERROR_SYSCALL and errno EAGAIN intact, which Read
+    // returns as -1 — exactly the plain-TCP timeout shape
+    tcp_.SetIoTimeout(timeout_us);
   }
 
   void Shutdown() override { tcp_.Shutdown(); }

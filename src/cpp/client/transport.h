@@ -43,6 +43,13 @@ class ByteTransport {
   virtual ssize_t Read(void* buf, size_t len) = 0;
   // Blocking write of up to len bytes; -1 on error.
   virtual ssize_t Write(const void* buf, size_t len) = 0;
+  // Bound every subsequent Read/Write to timeout_us (0 = wait forever).
+  // A timed-out op returns -1 with errno EAGAIN/EWOULDBLOCK, like a plain
+  // socket under SO_RCVTIMEO — this is how client_timeout_us reaches TLS
+  // connections (a peer that accepts then stalls must not hang Infer()
+  // forever).  Default no-op: a factory-registered transport that cannot
+  // enforce deadlines degrades to the old between-ops granularity.
+  virtual void SetIoTimeout(int64_t timeout_us) { (void)timeout_us; }
   // Wake any blocked Read/Write (both directions); idempotent.
   virtual void Shutdown() = 0;
   virtual void Close() = 0;

@@ -4,16 +4,23 @@
 // error (sync AND async paths), a generous timeout must succeed, and the
 // client must remain fully usable afterwards.
 //   client_timeout_test <http_host:port> <grpc_host:port>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
 #include <chrono>
 #include <condition_variable>
 #include <cstring>
 #include <iostream>
 #include <memory>
 #include <mutex>
+#include <thread>
 #include <vector>
 
 #include "grpc_client.h"
 #include "http_client.h"
+#include "transport.h"
 
 namespace tc = ctpu;
 
@@ -114,6 +121,61 @@ TestGrpcAsyncTimeout(tc::InferenceServerGrpcClient* client)
   CHECK(SyncInfer(client, kAmpleUs).IsOk());
 }
 
+// TLS-path stall: a peer that ACCEPTS the connection and then never sends
+// a byte must surface client_timeout_us as a prompt error.  Pre-fix this
+// hung forever — the whole-exchange budget was only checked BETWEEN ops on
+// TLS connections, and transport_->Read had no socket deadline
+// (ByteTransport::SetIoTimeout is what closes that hole).  The factory
+// transport is plain TCP (same seam the TlsTransportSeam tests use), so
+// the test runs on toolchains without OpenSSL.
+static void
+TestTlsStallTimeout()
+{
+  int lfd = ::socket(AF_INET, SOCK_STREAM, 0);
+  CHECK(lfd >= 0);
+  struct sockaddr_in addr;
+  std::memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = 0;
+  CHECK(::bind(lfd, reinterpret_cast<struct sockaddr*>(&addr),
+               sizeof(addr)) == 0);
+  CHECK(::listen(lfd, 1) == 0);
+  socklen_t alen = sizeof(addr);
+  CHECK(::getsockname(
+            lfd, reinterpret_cast<struct sockaddr*>(&addr), &alen) == 0);
+  const int port = ntohs(addr.sin_port);
+
+  std::atomic<bool> stop{false};
+  std::thread acceptor([lfd, &stop]() {
+    // accept and HOLD the connection open without ever writing a byte
+    int cfd = ::accept(lfd, nullptr, nullptr);
+    while (!stop.load()) {
+      std::this_thread::sleep_for(std::chrono::milliseconds(10));
+    }
+    if (cfd >= 0) ::close(cfd);
+  });
+
+  tc::SetTlsTransportFactory(
+      [](const tc::TlsConfig&) { return tc::MakeTcpTransport(); });
+  std::unique_ptr<tc::InferenceServerHttpClient> client;
+  tc::HttpSslOptions ssl_options;
+  tc::Error err = tc::InferenceServerHttpClient::Create(
+      &client, "localhost:" + std::to_string(port), ssl_options, false);
+  CHECK(err.IsOk());
+  const auto t0 = std::chrono::steady_clock::now();
+  err = SyncInfer(client.get(), 200 * 1000);  // 200ms budget
+  const auto elapsed = std::chrono::duration_cast<std::chrono::milliseconds>(
+      std::chrono::steady_clock::now() - t0);
+  CHECK(!err.IsOk());
+  CHECK(elapsed.count() < 5000);  // pre-fix: blocked in Read forever
+
+  tc::SetTlsTransportFactory(nullptr);
+  stop.store(true);
+  acceptor.join();
+  ::close(lfd);
+}
+
 int
 main(int argc, char** argv)
 {
@@ -136,6 +198,7 @@ main(int argc, char** argv)
   TestSyncTimeout(http_client.get());
   TestSyncTimeout(grpc_client.get());
   TestGrpcAsyncTimeout(grpc_client.get());
+  TestTlsStallTimeout();
 
   std::cout << g_checks << " checks, " << g_failures << " failures"
             << std::endl;

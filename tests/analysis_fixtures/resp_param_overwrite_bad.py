@@ -1,0 +1,19 @@
+"""RESP-PARAM-OVERWRITE violations: stamping a decoupled-completion marker
+with a dict-literal ASSIGNMENT replaces whatever response-level parameters
+the model (or the render step) already set — they vanish silently (the
+ADVICE round-5 _stream_execute finding).  Both the subscript-chain shape
+(rendered[0]) and the bare-name shape on a response that was NOT built in
+this function must hit."""
+
+
+def stream_markers(render):
+    rendered = render()
+    # rendered came from a call: its parameters may carry model-set keys
+    rendered[0]["parameters"] = {"triton_final_response": False}
+    return rendered
+
+
+def stamp_final(response):
+    # response is a caller's object; assignment clobbers its parameters
+    response["parameters"] = {"final": True, "count": 3}
+    return response

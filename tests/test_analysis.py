@@ -29,8 +29,9 @@ def test_registry_has_all_rules():
     assert set(REGISTRY) >= {
         "NPY-TRUTH", "ASYNC-BLOCK", "LOCK-DISPATCH", "QUEUE-SENTINEL",
         "CV-WAIT-LOOP", "SHARED-MUT", "TIME-WALL", "METRIC-LABEL",
+        "RESP-PARAM-OVERWRITE",
     }
-    assert len(REGISTRY) >= 8
+    assert len(REGISTRY) >= 9
     for rule in REGISTRY.values():
         assert rule.rationale  # every rule documents its motivating bug
 
@@ -144,6 +145,18 @@ def test_shared_mut_discovery_hits():
 
 def test_shared_mut_discovery_clean():
     assert _scan("shared_mut_discovery_ok.py") == []
+
+
+def test_resp_param_overwrite_hits():
+    findings = _scan("resp_param_overwrite_bad.py")
+    assert _rules_hit(findings) == ["RESP-PARAM-OVERWRITE"]
+    # the subscript-chain stamp (rendered[0]) and the bare-name stamp on
+    # a caller-owned response
+    assert len(findings) == 2
+
+
+def test_resp_param_overwrite_clean():
+    assert _scan("resp_param_overwrite_ok.py") == []
 
 
 def test_time_wall_hits():

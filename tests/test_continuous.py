@@ -183,6 +183,41 @@ def test_cancel_during_prefill_closes_stream(params):
         sched.close()
 
 
+def test_cancel_twice_during_prefill_is_idempotent(params):
+    """Double-cancel racing the unlocked prefill dispatch (round-5 audit):
+    the first cancel marks the handle _CANCELLED, the second must be a
+    no-op — and the lane still comes back free once _admit observes the
+    marker and closes the stream."""
+    sched = ContinuousLmScheduler(params, CFG, max_slots=1)
+    gate = _GatedPrefill(sched._prefill)
+    sched._prefill = gate
+    try:
+        q1, h1 = sched.submit([1, 2, 3], 8)
+        assert gate.entered.wait(timeout=60)
+        sched.cancel(h1)  # entry popped, not yet placed: marks _CANCELLED
+        sched.cancel(h1)  # second cancel sees the marker: no-op, no crash
+        gate.release.set()
+        assert _collect(q1) == []
+        sched.cancel(h1)  # post-close cancel of the marked handle: no-op
+        q2, _ = sched.submit([4, 5], 3)
+        assert _collect(q2) == _serial(params, [4, 5], 3)
+    finally:
+        gate.release.set()
+        sched.close()
+
+
+def test_submit_after_close_returns_closed_stream(params):
+    """submit() on a closed scheduler must hand back an already-closed
+    queue (reader gets CLOSE immediately) instead of queueing work no
+    scheduler thread will ever admit."""
+    sched = ContinuousLmScheduler(params, CFG, max_slots=1)
+    sched.close()
+    q, handle = sched.submit([1, 2, 3], 4)
+    assert handle is None
+    assert q.get(timeout=10) is ContinuousLmScheduler.CLOSE
+    sched.cancel(handle)  # cancel of a rejected submit: no-op
+
+
 def test_failing_prefill_does_not_strand_reader(params):
     """If the admission dispatch itself dies (device OOM / XLA failure on
     a cold compile), the popped entry's reader must still get CLOSE — it

@@ -368,6 +368,126 @@ class TestMembership:
         ) is None
 
 
+# -- probation ramp-up / slow start (satellite) ------------------------------
+
+
+class TestProbationRampup:
+    def test_ramp_fraction_math(self):
+        e = Endpoint("a")
+        assert e.ramp_fraction() == 1.0  # never promoted: full share
+        e.ramp_started, e.ramp_span, e.ramp_floor = 100.0, 10.0, 0.1
+        assert e.ramp_fraction(now=100.0) == pytest.approx(0.1)  # floored
+        assert e.ramp_fraction(now=105.0) == pytest.approx(0.5)
+        assert e.ramp_fraction(now=107.0) == pytest.approx(0.7)
+        assert e.ramp_fraction(now=110.5) == 1.0
+        assert e.ramp_started is None  # completed ramp clears itself
+
+    def test_weighted_policy_ramp_not_double_applied(self):
+        """The ramp lives in the pool's candidate thinning ONLY: a ramping
+        replica at fraction f must get ~f of its fair share under the
+        weighted policy, not ~f^2 (thinning AND weight-scaling would
+        compound)."""
+        pool = EndpointPool(
+            ["a", "b"], policy="weighted", rampup_s=600.0,
+            rng=random.Random(5),
+        )
+        try:
+            b = next(e for e in pool.endpoints() if e.url == "b")
+            b.ramp_started = time.monotonic()  # fraction pinned at floor
+            b.ramp_span, b.ramp_floor = 600.0, 0.2
+            policy = pool._policy
+            policy._rng = random.Random(11)
+            counts = {"a": 0, "b": 0}
+            n = 1000
+            for _ in range(n):
+                lease = pool.lease()
+                counts[lease.url] += 1
+                lease.success()
+            share = counts["b"] / n
+            # expected: survives thinning w.p. 0.2, then equal-weight pick
+            # among {a,b} -> ~0.1; the f^2 bug would give ~0.02
+            assert 0.05 < share < 0.18, counts
+        finally:
+            pool.close()
+
+    def test_promoted_replica_slow_starts_then_ramps_to_full(self):
+        states = {"a": SERVER_READY, "b": SERVER_NOT_READY}
+        pool = EndpointPool(
+            ["a"], rampup_s=60.0, rng=random.Random(7)
+        )
+        pool.start_probes(lambda url: states[url], interval_s=0.02)
+        try:
+            pool.update_endpoints(["a", "b"])
+            states["b"] = SERVER_READY
+            assert _wait_for(lambda: pool.phases()["b"] == PHASE_ACTIVE)
+            b = next(e for e in pool.endpoints() if e.url == "b")
+            assert b.ramp_started is not None  # promote stamped the ramp
+
+            def share(n=400):
+                counts = {"a": 0, "b": 0}
+                for _ in range(n):
+                    lease = pool.lease()
+                    counts[lease.url] += 1
+                    lease.success()
+                return counts["b"] / n
+
+            # freshly promoted: thinning holds b well under its fair 50%
+            assert share() < 0.25
+            # mid-window: share grows but stays below fair
+            b.ramp_started = time.monotonic() - 24.0  # 40% through
+            assert 0.05 < share() < 0.45
+            # past the window: full fair share again (round-robin ~50%)
+            b.ramp_started = time.monotonic() - 120.0
+            assert share() > 0.4
+            assert b.ramp_started is None  # ramp state self-cleared
+        finally:
+            pool.close()
+
+    def test_thinning_exempts_sticky_sequences(self):
+        """A ramping replica must never be thinned out from under the
+        sequences pinned to it: the sticky policy reads a missing pinned
+        candidate as replica death and forces a SequenceRestartError —
+        a fabricated restart on a perfectly healthy replica."""
+        pool = EndpointPool(
+            ["a", "b"], policy="sticky", rampup_s=600.0,
+            rng=random.Random(3),
+        )
+        try:
+            b = next(e for e in pool.endpoints() if e.url == "b")
+            # force b deep into a ramp window (fraction at the floor)
+            b.ramp_started = time.monotonic()
+            b.ramp_span, b.ramp_floor = 600.0, 0.1
+            ctx = {"sequence_id": 42}
+            pinned = pool.lease(request_ctx=ctx)
+            pinned_url = pinned.url
+            pinned.success()
+            for _ in range(100):
+                lease = pool.lease(request_ctx=ctx)  # must never raise
+                assert lease.url == pinned_url
+                lease.success()
+        finally:
+            pool.close()
+
+    def test_rampup_disabled_promotes_at_full_share(self):
+        states = {"a": SERVER_READY, "b": SERVER_NOT_READY}
+        pool = EndpointPool(["a"])  # rampup_s=0: no slow start
+        pool.start_probes(lambda url: states[url], interval_s=0.02)
+        try:
+            pool.update_endpoints(["a", "b"])
+            states["b"] = SERVER_READY
+            assert _wait_for(lambda: pool.phases()["b"] == PHASE_ACTIVE)
+            b = next(e for e in pool.endpoints() if e.url == "b")
+            assert b.ramp_started is None
+            counts = {"a": 0, "b": 0}
+            for _ in range(100):
+                lease = pool.lease()
+                counts[lease.url] += 1
+                lease.success()
+            assert counts["b"] > 30  # instant full rotation share
+        finally:
+            pool.close()
+
+
 # -- probe jitter (satellite) ------------------------------------------------
 
 

