@@ -1,14 +1,23 @@
 # Build orchestration for client_tpu: proto codegen + native libraries.
 #
 # Quality gates:
-#   make lint   tpu-lint static analysis (client_tpu/analysis): concurrency
-#               & numpy-semantics rules grown from this repo's shipped bugs.
-#               Runs over client_tpu/ AND tests/; exits non-zero on any
-#               finding not grandfathered in analysis/baseline.json.
-#               Suppress in place with `# tpulint: disable=RULE` + rationale.
-#   make test   ASAN native tests + the python suite.
-#   make check  the PR gate, reproduced locally: make lint + the tier-1
-#               pytest command (ROADMAP.md "Tier-1 verify").
+#   make lint        tpu-lint static analysis (client_tpu/analysis):
+#                    per-file concurrency & numpy-semantics rules PLUS the
+#                    whole-program pass (call-graph lock summaries:
+#                    LOCK-INV, BLOCK-UNDER-LOCK, CALLBACK-UNDER-LOCK).
+#                    Runs over client_tpu/ AND tests/; exits non-zero on
+#                    any finding not grandfathered in analysis/baseline.json.
+#                    Incremental (mtime+rules-hash cache); `--no-cache` to
+#                    force cold.  Suppressions require a reason:
+#                    `# tpulint: disable=RULE -- why`.
+#   make lint-strict lint, plus examples/ in the scanned program.
+#   make test        ASAN native tests + the python suite.
+#   make check       the PR gate, reproduced locally: make lint + the
+#                    tier-1 pytest command (ROADMAP.md "Tier-1 verify").
+#   make soak        slow-tier chaos repetition, run under the DYNAMIC
+#                    lock-order witness (TPULINT_LOCK_WITNESS=1): every
+#                    lock built under client_tpu/ records the real
+#                    acquisition DAG; a cycle fails the round.
 
 PROTO_DIR := proto
 PB_OUT := client_tpu/_proto
@@ -17,10 +26,14 @@ CXXFLAGS ?= -O2 -fPIC -Wall -std=c++17
 NATIVE_OUT := client_tpu/utils/shared_memory
 TPUSHM_OUT := client_tpu/utils/tpu_shared_memory
 
-.PHONY: all protos native cpp clean test asan java java-bindings lint check soak
+.PHONY: all protos native cpp clean test asan java java-bindings lint \
+        lint-strict check soak
 
 lint:
 	python -m client_tpu.analysis client_tpu tests
+
+lint-strict:
+	python -m client_tpu.analysis client_tpu tests examples
 
 # One command = the PR gate: static analysis, then the tier-1 suite with
 # the exact flags ROADMAP.md's "Tier-1 verify" runs.
@@ -37,8 +50,9 @@ check: lint
 SOAK_N ?= 3
 soak:
 	@for i in $$(seq 1 $(SOAK_N)); do \
-	  echo "== soak round $$i/$(SOAK_N) =="; \
-	  JAX_PLATFORMS=cpu python -m pytest tests/test_discovery.py \
+	  echo "== soak round $$i/$(SOAK_N) (lock-order witness armed) =="; \
+	  JAX_PLATFORMS=cpu TPULINT_LOCK_WITNESS=1 \
+	      python -m pytest tests/test_discovery.py \
 	      tests/test_balance.py tests/test_frontdoor.py -q -m slow \
 	      -p no:cacheprovider -p no:xdist -p no:randomly || exit 1; \
 	done

@@ -3,9 +3,17 @@
 Encodes this repo's recurring bug shapes as enforced rules — numpy
 truthiness in control flow, blocking calls in async bodies, device
 dispatch under scheduler locks, streaming queues abandoned without their
-close sentinel, loop-less ``Condition.wait``, and unlocked writes to
-thread-shared state.  Run ``python -m client_tpu.analysis [paths]``
-(exits non-zero on findings) or ``make lint``.
+close sentinel, loop-less ``Condition.wait``, unlocked writes to
+thread-shared state — plus three whole-program rules over a project-wide
+call graph with per-function lock summaries: lock-order inversion
+(LOCK-INV), blocking work reached under a lock through any call depth
+(BLOCK-UNDER-LOCK), and observer callbacks invoked while a private lock
+is held (CALLBACK-UNDER-LOCK).  A dynamic lock-order witness
+(``client_tpu.analysis.witness``) records the real acquisition DAG under
+test and keeps the static pass honest.
+
+Run ``python -m client_tpu.analysis [paths]`` (exits non-zero on
+findings) or ``make lint``.
 
 Pure stdlib on purpose: the gate must run anywhere the repo checks out,
 with or without jax present.
@@ -13,11 +21,26 @@ with or without jax present.
 
 from client_tpu.analysis.core import (  # noqa: F401
     Finding,
+    PROGRAM_REGISTRY,
+    ProgramRule,
     REGISTRY,
     Rule,
+    all_rules,
     scan_paths,
     scan_source,
 )
 from client_tpu.analysis import rules as _rules  # noqa: F401  (registers)
+from client_tpu.analysis import (  # noqa: F401  (registers)
+    concurrency as _concurrency,
+)
 
-__all__ = ["Finding", "REGISTRY", "Rule", "scan_paths", "scan_source"]
+__all__ = [
+    "Finding",
+    "PROGRAM_REGISTRY",
+    "ProgramRule",
+    "REGISTRY",
+    "Rule",
+    "all_rules",
+    "scan_paths",
+    "scan_source",
+]

@@ -1,15 +1,22 @@
 """CLI: ``python -m client_tpu.analysis [paths...]``.
 
 Exit codes: 0 clean (after baseline filtering), 1 findings, 2 analyzer
-usage/internal error.  ``make lint`` runs this over ``client_tpu tests``.
+usage/internal error.  ``make lint`` runs this over ``client_tpu tests``;
+``make lint-strict`` adds ``examples``.
 """
 
 import argparse
 import os
 import sys
 
-from client_tpu.analysis import REGISTRY, scan_paths
+from client_tpu.analysis import (
+    PROGRAM_REGISTRY,
+    REGISTRY,
+    all_rules,
+    scan_paths,
+)
 from client_tpu.analysis import baseline as baseline_mod
+from client_tpu.analysis import cache as cache_mod
 from client_tpu.analysis import report
 
 
@@ -18,7 +25,8 @@ def main(argv=None):
         prog="python -m client_tpu.analysis",
         description=(
             "tpu-lint: concurrency & array-semantics rules grown from "
-            "this repo's shipped bugs"
+            "this repo's shipped bugs (per-file AST rules + whole-program "
+            "call-graph/lock-order analysis)"
         ),
     )
     parser.add_argument(
@@ -26,7 +34,12 @@ def main(argv=None):
         help="files or directories to scan (default: client_tpu tests)",
     )
     parser.add_argument(
-        "--json", action="store_true", help="machine-readable output"
+        "--format", choices=("text", "json"), default="text",
+        help="report format (json is the machine-readable CI surface)",
+    )
+    parser.add_argument(
+        "--json", action="store_true",
+        help="alias for --format json",
     )
     parser.add_argument(
         "--baseline", default=baseline_mod.DEFAULT_BASELINE,
@@ -48,16 +61,42 @@ def main(argv=None):
         "--list-rules", action="store_true",
         help="print the rule catalog and exit",
     )
+    parser.add_argument(
+        "--explain", metavar="RULE", default="",
+        help="print one rule's full rationale and exit",
+    )
+    parser.add_argument(
+        "--no-cache", action="store_true",
+        help="ignore and do not write the incremental analysis cache",
+    )
+    parser.add_argument(
+        "--cache-file", default=cache_mod.DEFAULT_CACHE,
+        help="incremental cache location (default: alongside the analyzer)",
+    )
     args = parser.parse_args(argv)
 
     if args.list_rules:
-        print(report.render_rules(REGISTRY))
+        print(report.render_rules(all_rules()))
         return 0
 
-    rules = REGISTRY
+    if args.explain:
+        text = report.render_explain(all_rules(), args.explain)
+        if text is None:
+            print(
+                f"tpu-lint: unknown rule {args.explain!r} "
+                "(see --list-rules)",
+                file=sys.stderr,
+            )
+            return 2
+        print(text)
+        return 0
+
+    rules = None  # None = full default rule set (cache-eligible)
+    program_rules = None
     if args.rules:
         wanted = {r.strip().upper() for r in args.rules.split(",")}
-        unknown = wanted - set(REGISTRY)
+        known = all_rules()
+        unknown = wanted - set(known)
         if unknown:
             print(
                 f"tpu-lint: unknown rule(s): {', '.join(sorted(unknown))}",
@@ -65,6 +104,9 @@ def main(argv=None):
             )
             return 2
         rules = {k: v for k, v in REGISTRY.items() if k in wanted}
+        program_rules = {
+            k: v for k, v in PROGRAM_REGISTRY.items() if k in wanted
+        }
 
     missing = [p for p in args.paths if not os.path.exists(p)]
     if missing:
@@ -75,7 +117,13 @@ def main(argv=None):
         )
         return 2
 
-    findings = scan_paths(args.paths, rules=rules)
+    analysis_cache = (
+        None if args.no_cache else cache_mod.AnalysisCache(args.cache_file)
+    )
+    findings = scan_paths(
+        args.paths, rules=rules, cache=analysis_cache,
+        program_rules=program_rules,
+    )
 
     if args.write_baseline:
         if args.rules or args.paths != parser.get_default("paths"):
@@ -99,10 +147,10 @@ def main(argv=None):
     )
     new, old = baseline_mod.filter_findings(findings, baseline)
 
-    if args.json:
-        print(report.render_json(new, old, rules))
+    if args.json or args.format == "json":
+        print(report.render_json(new, old, all_rules()))
     else:
-        print(report.render_text(new, old, rules))
+        print(report.render_text(new, old, all_rules()))
     return 1 if new else 0
 
 

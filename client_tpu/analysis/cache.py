@@ -1,0 +1,117 @@
+"""Incremental-analysis cache for tpu-lint.
+
+Parsing + summarizing every file dominates a `make lint` run; almost no
+file changes between runs.  The cache persists, per file, the post-
+suppression per-file findings, the serialized
+:class:`~client_tpu.analysis.callgraph.ModuleSummary` (program rules
+re-run every time — they are cheap graph walks over the summaries), and
+the suppression map, keyed on ``(path, mtime, size)`` and guarded by a
+**rules hash** over the analyzer's own sources: editing any rule
+invalidates everything (a stale cache must never green-light a finding a
+new rule would catch).
+
+The cache file lives next to the analyzer (gitignored).  Corruption,
+version skew, or a rules-hash mismatch silently degrade to a full scan —
+the cache is an accelerator, never a correctness dependency.
+``--no-cache`` on the CLI is the escape hatch.
+"""
+
+import hashlib
+import json
+import os
+
+_VERSION = 1
+DEFAULT_CACHE = os.path.join(os.path.dirname(__file__), ".cache.json")
+
+
+def rules_hash():
+    """Content hash over every analyzer source file (rule edits, driver
+    edits, and callgraph changes all invalidate the cache)."""
+    here = os.path.dirname(__file__)
+    h = hashlib.sha256()
+    for name in sorted(os.listdir(here)):
+        if not name.endswith(".py"):
+            continue
+        h.update(name.encode("utf-8"))
+        with open(os.path.join(here, name), "rb") as fh:
+            h.update(fh.read())
+        h.update(b"\x00")
+    return h.hexdigest()
+
+
+class AnalysisCache:
+    """mtime-keyed per-file result cache (see module docstring)."""
+
+    def __init__(self, path=DEFAULT_CACHE):
+        self.path = path
+        self._rules_hash = rules_hash()
+        self._entries = {}
+        self._dirty = False
+        self.hits = 0
+        self.misses = 0
+        self._load()
+
+    def _load(self):
+        try:
+            with open(self.path, "r", encoding="utf-8") as fh:
+                data = json.load(fh)
+        except (OSError, ValueError):
+            return
+        if (
+            data.get("version") != _VERSION
+            or data.get("rules_hash") != self._rules_hash
+        ):
+            return  # analyzer changed: every cached result is suspect
+        entries = data.get("files")
+        if isinstance(entries, dict):
+            self._entries = entries
+
+    def stat_key(self, path):
+        """Freshness key for *path* (None when unstattable).  Callers
+        storing results MUST capture this BEFORE reading the file: a save
+        landing between the read and the store must make the entry look
+        stale (re-scan), never fresh (silently serving findings for
+        content nobody analyzed)."""
+        try:
+            st = os.stat(path)
+        except OSError:
+            return None
+        return [int(st.st_mtime_ns), int(st.st_size)]
+
+    def get(self, path):
+        """Cached analysis for *path* if its stat key still matches."""
+        entry = self._entries.get(path)
+        key = self.stat_key(path)
+        if entry is None or key is None or entry.get("stat") != key:
+            self.misses += 1
+            return None
+        self.hits += 1
+        return entry["data"]
+
+    def put(self, path, data, key):
+        """Store *data* under the stat *key* captured before the read."""
+        if key is None:
+            return
+        self._entries[path] = {"stat": key, "data": data}
+        self._dirty = True
+
+    def save(self):
+        if not self._dirty:
+            return
+        payload = {
+            "version": _VERSION,
+            "rules_hash": self._rules_hash,
+            "files": self._entries,
+        }
+        tmp = self.path + ".tmp"
+        try:
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump(payload, fh)
+            os.replace(tmp, self.path)
+        except OSError:
+            # a read-only checkout still lints; it just lints cold
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+        self._dirty = False

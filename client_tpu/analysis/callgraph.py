@@ -1,0 +1,817 @@
+"""Whole-program concurrency model: call graph + per-function lock summaries.
+
+The lexical rules in ``rules.py`` see one function at a time; every
+cross-function concurrency hazard this repo shipped (prefill dispatched
+under the scheduler ``_cv`` three frames below the ``with``, observer
+callbacks fired under a delivery lock) was invisible to them.  This module
+builds the interprocedural substrate the ``concurrency.py`` rules run on:
+
+- a **module summary** per file: top-level functions, classes (methods,
+  base names, lock-kind attributes, jit-bound attributes), import aliases;
+- a **function summary** per function/method/nested def: the lock
+  *acquisitions* it performs (each with the locks already held at that
+  point), the *blocking operations* it performs (device dispatch, sleeps,
+  timeout-less waits/joins/queue gets, sockets/subprocess), the *dynamic
+  callback invocations* it makes (observer/callback-shaped attribute
+  calls, calls through parameters or ``getattr`` results), and its
+  outgoing *call edges* — each event stamped with the lock set lexically
+  held where it happens;
+- a **program** index that resolves call references class/module-aware:
+  ``self.method()`` through the class and its resolvable bases, bare and
+  dotted names through module scope and import aliases, constructor calls
+  to ``__init__``, plus a unique-method fallback (``obj.take_first()``
+  resolves when exactly one class in the program defines an
+  arity-compatible ``take_first``), and callback registration points
+  (``threading.Thread(target=...)``, lambda bodies) as *deferred*
+  references that never inherit the registering frame's held locks.
+
+Lock identity is ``Class.attr`` for ``self.<attr>`` locks, ``module.name``
+for module-level locks, and ``module::func.name`` for function-locals —
+stable across files so the lock-order graph composes program-wide.  A
+``*_locked`` method (this repo's caller-holds-the-lock convention) is
+summarized as *requiring* a lock on entry; rules model that as a pseudo
+lock (``<caller-held:Class>``) held across its body.
+
+Held-lock tracking is lexical: ``with lock:`` bodies extend the held set;
+a bare ``.acquire()`` records the acquisition event (it feeds the
+lock-order graph) but does not extend the held set for the statements
+after it — the approximation the rules document.
+
+Everything here is serializable plain data (see ``to_dict``/``from_dict``)
+so the incremental cache can persist summaries keyed on file mtime and
+skip re-parsing unchanged files entirely.
+"""
+
+import ast
+import os
+import re
+
+from client_tpu.analysis.rules import (
+    _CVLIKE_RE,
+    _DISPATCH_FULL,
+    _DISPATCH_HINTS,
+    _LOCKISH_RE,
+    _expr_text,
+    _jit_bound_names,
+    _last_segment,
+)
+
+# Lock-object constructors, by dotted callee text -> kind.
+_LOCK_CTORS = {
+    "threading.Lock": "lock",
+    "threading.RLock": "rlock",
+    "threading.Condition": "condition",
+    "Lock": "lock",
+    "RLock": "rlock",
+    "Condition": "condition",
+}
+_SEM_CTORS = {"threading.Semaphore", "threading.BoundedSemaphore",
+              "Semaphore", "BoundedSemaphore"}
+
+# Blocking callees by full dotted text.
+_BLOCKING_FULL = {
+    "time.sleep": "time.sleep()",
+    "os.system": "os.system()",
+    "subprocess.run": "subprocess.run()",
+    "subprocess.call": "subprocess.call()",
+    "subprocess.check_output": "subprocess.check_output()",
+    "subprocess.check_call": "subprocess.check_call()",
+    "socket.create_connection": "socket.create_connection()",
+    "urllib.request.urlopen": "urlopen()",
+}
+_BLOCKING_PREFIXES = ("requests.",)
+
+# Attribute calls on receivers whose LAST segment matches these are
+# callback invocations (user/observer code this module does not control).
+_CALLBACKISH_RECV_RE = re.compile(
+    r"(?i)(^|_)(observer|callback|listener|hook|sink)s?$"
+)
+_CALLBACKISH_ATTR_RE = re.compile(r"(?i)^(on_[a-z0-9_]+|callback|_callback)$")
+# Parameter names whose calls count as foreign-code callbacks.  Narrow on
+# purpose: a `pred`/`key` predicate parameter is an internal control knob,
+# not user code — flagging it under a lock would drown the gate.
+_CALLBACKISH_PARAM_RE = re.compile(
+    r"(?i)(^|_)(callback|cb|observer|listener|hook|sink|handler|notify"
+    r"|on_[a-z0-9_]+)s?$"
+)
+_EVENTISH_RE = re.compile(r"(?i)(^|_)(event|ev|done|ready|stop|closed)s?$")
+_QUEUEISH_RE = re.compile(r"(?i)(^|_)(q|queue|backlog|inbox|outbox)s?$")
+_THREADISH_RE = re.compile(r"(?i)(^|_)(thread|prober|worker|pump)s?$")
+
+
+def module_name_for(path):
+    """Dotted module name for *path*.
+
+    Cross-module resolution joins :class:`ModuleSummary.module` against
+    the names ``import`` statements use, so the identity must come out
+    the same however the scan root was spelled — an absolute CI path
+    (``/ci/checkout/client_tpu/...``) and a relative dev path must name
+    the same module.  For files inside a package we therefore walk up
+    through ``__init__.py`` markers and name the module relative to the
+    package root; files outside any package keep the path-derived (but
+    still unique) fallback."""
+    norm = os.path.normpath(path)
+    base = os.path.basename(norm)
+    if base.endswith(".py"):
+        base = base[:-3]
+    directory = os.path.dirname(os.path.abspath(norm))
+    if os.path.isfile(os.path.join(directory, "__init__.py")):
+        parts = [base]
+        d = directory
+        while os.path.isfile(os.path.join(d, "__init__.py")):
+            d, tail = os.path.split(d)
+            if not tail:
+                break
+            parts.insert(0, tail)
+        if parts[-1] == "__init__":
+            parts = parts[:-1]
+        return ".".join(parts)
+    if norm.endswith(".py"):
+        norm = norm[:-3]
+    parts = [
+        p for p in norm.replace(os.sep, "/").split("/") if p not in ("", ".")
+    ]
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts) or norm
+
+
+class FunctionSummary:
+    """One function's concurrency-relevant behavior, as plain data."""
+
+    __slots__ = ("qualname", "name", "cls", "line", "requires_lock",
+                 "params_min", "params_max", "acquisitions", "calls",
+                 "blocking", "callbacks",
+                 # scanner scratch (never serialized)
+                 "_param_names", "_getattr_locals")
+
+    def __init__(self, qualname, name, cls, line, requires_lock,
+                 params_min, params_max):
+        self.qualname = qualname
+        self.name = name
+        self.cls = cls  # enclosing class name or None
+        self.line = line
+        self.requires_lock = requires_lock  # the *_locked convention
+        self.params_min = params_min
+        self.params_max = params_max  # None = *args/**kwargs
+        # [{"lock", "line", "col", "held": [...]}]
+        self.acquisitions = []
+        # [{"ref": (kind, value), "line", "col", "held": [...],
+        #   "nargs", "deferred": bool}]
+        self.calls = []
+        # [{"desc", "kind", "line", "col", "held": [...], "waits_on"}]
+        self.blocking = []
+        # [{"desc", "line", "col", "held": [...]}]
+        self.callbacks = []
+
+    def to_dict(self):
+        return {
+            "qualname": self.qualname, "name": self.name, "cls": self.cls,
+            "line": self.line, "requires_lock": self.requires_lock,
+            "params_min": self.params_min, "params_max": self.params_max,
+            "acquisitions": self.acquisitions,
+            "calls": [dict(c, ref=list(c["ref"])) for c in self.calls],
+            "blocking": self.blocking, "callbacks": self.callbacks,
+        }
+
+    @classmethod
+    def from_dict(cls, d):
+        fn = cls(d["qualname"], d["name"], d["cls"], d["line"],
+                 d["requires_lock"], d["params_min"], d["params_max"])
+        fn.acquisitions = d["acquisitions"]
+        fn.calls = [dict(c, ref=tuple(c["ref"])) for c in d["calls"]]
+        fn.blocking = d["blocking"]
+        fn.callbacks = d["callbacks"]
+        return fn
+
+
+class ModuleSummary:
+    """One file's classes/functions/imports, as plain data."""
+
+    __slots__ = ("path", "module", "imports", "classes", "functions",
+                 "toplevel", "module_locks", "jit_names")
+
+    def __init__(self, path, module):
+        self.path = path
+        self.module = module
+        self.imports = {}       # alias -> "module" or "module:attr"
+        self.classes = {}       # name -> {"bases": [...], "methods": [...],
+        #                                  "lock_attrs": {attr: kind},
+        #                                  "sem_attrs": [...],
+        #                                  "jit_attrs": [...]}
+        self.functions = {}     # qualname -> FunctionSummary
+        self.toplevel = []      # top-level function names
+        self.module_locks = {}  # module-level lock name -> kind
+        self.jit_names = []     # module/self-level names bound from jax.jit
+
+    def to_dict(self):
+        return {
+            "path": self.path, "module": self.module,
+            "imports": self.imports, "classes": self.classes,
+            "functions": {q: f.to_dict() for q, f in self.functions.items()},
+            "toplevel": self.toplevel, "module_locks": self.module_locks,
+            "jit_names": self.jit_names,
+        }
+
+    @classmethod
+    def from_dict(cls, d):
+        ms = cls(d["path"], d["module"])
+        ms.imports = d["imports"]
+        ms.classes = d["classes"]
+        ms.functions = {
+            q: FunctionSummary.from_dict(f)
+            for q, f in d["functions"].items()
+        }
+        ms.toplevel = d["toplevel"]
+        ms.module_locks = d["module_locks"]
+        ms.jit_names = d["jit_names"]
+        return ms
+
+
+# -- summary construction ----------------------------------------------------
+
+
+def _ctor_kind(call):
+    text = _expr_text(call.func) or ""
+    if text in _LOCK_CTORS:
+        return _LOCK_CTORS[text]
+    if text in _SEM_CTORS:
+        return "semaphore"
+    return None
+
+
+def _collect_imports(tree, module):
+    imports = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.asname:
+                    imports[alias.asname] = alias.name
+                else:
+                    root = alias.name.split(".", 1)[0]
+                    imports[root] = root
+        elif isinstance(node, ast.ImportFrom):
+            base = node.module or ""
+            if node.level:
+                parts = module.split(".")
+                parts = parts[: len(parts) - node.level]
+                base = ".".join(
+                    parts + ([node.module] if node.module else [])
+                )
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                name = alias.asname or alias.name
+                imports[name] = f"{base}:{alias.name}"
+    return imports
+
+
+def _direct_nested(fn_node):
+    """Immediate nested function defs (not crossing deeper functions)."""
+    out = []
+    stack = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.append(node)
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+    return sorted(out, key=lambda n: n.lineno)
+
+
+class _FunctionScanner:
+    """Walk one function body with a lexical held-lock stack."""
+
+    def __init__(self, modsum, cls_name, fn_summary, local_locks):
+        self.mod = modsum
+        self.cls = cls_name
+        self.fn = fn_summary
+        self.local_locks = local_locks  # local name -> kind
+
+    # -- lock identity -------------------------------------------------------
+
+    def lock_id(self, text):
+        """Stable program-wide identity for a lock expression, or None."""
+        if not text:
+            return None
+        if text.startswith("self."):
+            rest = text[len("self."):]
+            owner = self.cls or self.mod.module
+            return f"{owner}.{rest}"
+        if "." not in text:
+            if text in self.local_locks:
+                return f"{self.mod.module}::{self.fn.qualname}.{text}"
+            if text in self.mod.module_locks:
+                return f"{self.mod.module}.{text}"
+            return f"{self.mod.module}::{self.fn.qualname}.{text}"
+        return f"{self.mod.module}:{text}"
+
+    def _is_lockish(self, text):
+        if not text:
+            return False
+        last = _last_segment(text)
+        if _LOCKISH_RE.search(last):
+            return True
+        if text.startswith("self.") and self.cls:
+            attrs = self.mod.classes.get(self.cls, {}).get("lock_attrs", {})
+            return text[len("self."):] in attrs
+        return text in self.local_locks or text in self.mod.module_locks
+
+    # -- classification ------------------------------------------------------
+
+    def _is_jit_bound(self, text):
+        if text in self.mod.jit_names:
+            return True
+        if text.startswith("self.") and self.cls:
+            jit_attrs = self.mod.classes.get(self.cls, {}).get("jit_attrs", [])
+            return text[len("self."):] in jit_attrs
+        return False
+
+    @staticmethod
+    def _call_timeout(call, pos_index):
+        """True when the call carries a timeout (kw or positional slot)."""
+        if any(kw.arg == "timeout" for kw in call.keywords):
+            return True
+        return len(call.args) > pos_index
+
+    def _classify_blocking(self, call, text):
+        """(desc, kind, waits_on) for a blocking call, else None."""
+        if text in _BLOCKING_FULL:
+            return _BLOCKING_FULL[text], "host", None
+        if text and text.startswith(_BLOCKING_PREFIXES):
+            return f"{text}()", "host", None
+        if self._is_jit_bound(text):
+            return f"jit-compiled {text}()", "dispatch", None
+        if text in _DISPATCH_FULL:
+            return f"{text}()", "dispatch", None
+        if text and _last_segment(text) in _DISPATCH_HINTS:
+            return f"device-dispatch {text}()", "dispatch", None
+        if not isinstance(call.func, ast.Attribute):
+            return None
+        attr = call.func.attr
+        recv = _expr_text(call.func.value)
+        if recv is None:
+            return None
+        last = _last_segment(recv)
+        if attr in ("wait", "wait_for"):
+            pos = 0 if attr == "wait" else 1
+            if self._call_timeout(call, pos):
+                return None  # bounded wait
+            if _CVLIKE_RE.search(last) or self._is_lockish(recv):
+                return (f"{recv}.{attr}()", "cv-wait", self.lock_id(recv))
+            if _EVENTISH_RE.search(last):
+                return f"{recv}.{attr}()", "event-wait", None
+            return None
+        if attr == "get" and _QUEUEISH_RE.search(last):
+            kwargs = {kw.arg for kw in call.keywords}
+            if "timeout" in kwargs or len(call.args) >= 2:
+                return None
+            for a in call.args[:1]:
+                if isinstance(a, ast.Constant) and a.value is False:
+                    return None  # non-blocking get
+            for kw in call.keywords:
+                if kw.arg == "block" and isinstance(
+                    kw.value, ast.Constant
+                ) and kw.value.value is False:
+                    return None
+            return f"{recv}.get()", "queue-get", None
+        if attr == "join" and not call.args and not any(
+            kw.arg == "timeout" for kw in call.keywords
+        ):
+            if _THREADISH_RE.search(last) or last in ("t", "th"):
+                return f"{recv}.join()", "thread-join", None
+        if attr == "acquire":
+            if self._is_semaphore(recv) and not self._call_timeout(call, 1):
+                return f"{recv}.acquire()", "semaphore", None
+        return None
+
+    def _is_semaphore(self, text):
+        if text.startswith("self.") and self.cls:
+            sems = self.mod.classes.get(self.cls, {}).get("sem_attrs", [])
+            return text[len("self."):] in sems
+        return False
+
+    def _classify_callback(self, call, text):
+        """Description for a dynamic callback invocation, else None."""
+        func = call.func
+        if isinstance(func, ast.Name):
+            # calls through callback-named parameters or getattr()-derived
+            # locals are dynamic: the callee is caller-supplied code
+            if func.id in self.fn._param_names and (
+                _CALLBACKISH_PARAM_RE.search(func.id)
+            ):
+                return f"parameter callback {func.id}()"
+            if func.id in self.fn._getattr_locals:
+                return f"dynamic callable {func.id}()"
+            return None
+        if not isinstance(func, ast.Attribute):
+            return None
+        recv = _expr_text(func.value)
+        if recv is None:
+            return None
+        if _CALLBACKISH_RECV_RE.search(_last_segment(recv)):
+            return f"{recv}.{func.attr}()"
+        if _CALLBACKISH_ATTR_RE.search(func.attr):
+            return f"{recv}.{func.attr}()"
+        return None
+
+    def _call_ref(self, call):
+        """Resolvable reference for a call site, or None."""
+        func = call.func
+        if isinstance(func, ast.Name):
+            return ("name", func.id)
+        if not isinstance(func, ast.Attribute):
+            return None
+        text = _expr_text(func)
+        if text is None:
+            return None
+        if text.startswith("self.") and text.count(".") == 1:
+            return ("self", func.attr)
+        base = text.split(".", 1)[0]
+        if base in self.mod.imports or base in self.mod.classes:
+            return ("dotted", text)
+        return ("method", func.attr)
+
+    # -- the walk ------------------------------------------------------------
+
+    def scan(self, fn_node):
+        args = fn_node.args
+        names = [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
+        if names and names[0] in ("self", "cls"):
+            names = names[1:]
+        self.fn._param_names = set(names)
+        self.fn._getattr_locals = {
+            t.id
+            for node in ast.walk(fn_node)
+            if isinstance(node, ast.Assign)
+            and isinstance(node.value, ast.Call)
+            and _expr_text(node.value.func) == "getattr"
+            for t in node.targets
+            if isinstance(t, ast.Name)
+        }
+        for stmt in fn_node.body:
+            self._walk(stmt, ())
+        self.fn._param_names = self.fn._getattr_locals = None
+
+    def _walk(self, node, held):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # nested defs are summarized separately
+        if isinstance(node, ast.Lambda):
+            # deferred body: runs later, not under the current locks
+            self._walk(node.body, ())
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            inner = list(held)
+            for item in node.items:
+                ctx = item.context_expr
+                self._walk(ctx, tuple(inner))
+                expr = ctx.func if isinstance(ctx, ast.Call) else ctx
+                text = _expr_text(expr)
+                if text and self._is_lockish(text):
+                    lock = self.lock_id(text)
+                    self.fn.acquisitions.append({
+                        "lock": lock, "line": node.lineno,
+                        "col": node.col_offset, "held": list(inner),
+                    })
+                    if lock not in inner:
+                        inner.append(lock)
+            for stmt in node.body:
+                self._walk(stmt, tuple(inner))
+            return
+        if isinstance(node, ast.Call):
+            self._handle_call(node, held)
+            for child in ast.iter_child_nodes(node):
+                self._walk(child, held)
+            return
+        for child in ast.iter_child_nodes(node):
+            self._walk(child, held)
+
+    def _handle_call(self, call, held):
+        text = _expr_text(call.func) or ""
+        site = {"line": call.lineno, "col": call.col_offset,
+                "held": list(held)}
+        # callback registration points: the registered callable runs later,
+        # on another thread or frame — a deferred edge with no held locks
+        if text.endswith("Thread"):
+            for kw in call.keywords:
+                if kw.arg == "target":
+                    ref = self._ref_for_value(kw.value)
+                    if ref is not None:
+                        self.fn.calls.append({
+                            "ref": ref, "line": call.lineno,
+                            "col": call.col_offset, "held": [],
+                            "nargs": -1, "deferred": True,
+                        })
+            return
+        # explicit lock-method acquisition outside a with-statement
+        if (
+            isinstance(call.func, ast.Attribute)
+            and call.func.attr == "acquire"
+        ):
+            recv = _expr_text(call.func.value)
+            if recv and self._is_lockish(recv):
+                self.fn.acquisitions.append({
+                    "lock": self.lock_id(recv), "line": call.lineno,
+                    "col": call.col_offset, "held": list(held),
+                })
+                return
+        blocking = self._classify_blocking(call, text)
+        if blocking is not None:
+            desc, kind, waits_on = blocking
+            self.fn.blocking.append(dict(
+                site, desc=desc, kind=kind, waits_on=waits_on,
+            ))
+            return
+        callback = self._classify_callback(call, text)
+        if callback is not None:
+            self.fn.callbacks.append(dict(site, desc=callback))
+            return
+        ref = self._call_ref(call)
+        if ref is not None:
+            nargs = len(call.args) + len(call.keywords)
+            self.fn.calls.append(dict(
+                site, ref=ref, nargs=nargs, deferred=False,
+            ))
+
+    def _ref_for_value(self, value):
+        text = _expr_text(value)
+        if not text:
+            return None
+        if text.startswith("self.") and text.count(".") == 1:
+            return ("self", text[len("self."):])
+        if "." not in text:
+            return ("name", text)
+        return ("dotted", text)
+
+
+def summarize_module(tree, path):
+    """Build the ModuleSummary for one parsed file."""
+    mod = ModuleSummary(path, module_name_for(path))
+    mod.imports = _collect_imports(tree, mod.module)
+    mod.jit_names = sorted(_jit_bound_names(tree))
+
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            kind = _ctor_kind(node.value)
+            if kind and kind != "semaphore":
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        mod.module_locks[t.id] = kind
+
+    # class inventory first: lock/sem/jit attrs inform the scanners
+    def collect_class(cls):
+        info = {"bases": [], "methods": [], "lock_attrs": {},
+                "sem_attrs": [], "jit_attrs": []}
+        for base in cls.bases:
+            text = _expr_text(base)
+            if text:
+                info["bases"].append(text)
+        for sub in ast.walk(cls):
+            if isinstance(sub, ast.Assign) and isinstance(
+                sub.value, ast.Call
+            ):
+                kind = _ctor_kind(sub.value)
+                ttexts = [_expr_text(t) for t in sub.targets]
+                for tt in ttexts:
+                    if tt and tt.startswith("self."):
+                        attr = tt[len("self."):]
+                        if kind == "semaphore":
+                            info["sem_attrs"].append(attr)
+                        elif kind:
+                            info["lock_attrs"][attr] = kind
+                ftext = _expr_text(sub.value.func) or ""
+                if ftext in ("jax.jit", "jit", "jax.pmap", "pmap"):
+                    for tt in ttexts:
+                        if tt and tt.startswith("self."):
+                            info["jit_attrs"].append(tt[len("self."):])
+        for item in cls.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                info["methods"].append(item.name)
+        mod.classes[cls.name] = info
+
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef):
+            collect_class(node)
+
+    def summarize_function(fn_node, cls_name, prefix, is_method):
+        qual = f"{prefix}{fn_node.name}"
+        args = fn_node.args
+        pos = args.posonlyargs + args.args
+        names = [a.arg for a in pos]
+        skip_self = (
+            1 if (is_method and names and names[0] in ("self", "cls"))
+            else 0
+        )
+        n_pos = len(pos) - skip_self
+        n_defaults = len(args.defaults)
+        params_min = max(n_pos - n_defaults, 0)
+        params_max = None if (args.vararg or args.kwarg) else (
+            n_pos + len(args.kwonlyargs)
+        )
+        summary = FunctionSummary(
+            qual, fn_node.name, cls_name, fn_node.lineno,
+            fn_node.name.endswith("_locked"), params_min, params_max,
+        )
+        local_locks = {}
+        for sub in ast.walk(fn_node):
+            if isinstance(sub, ast.Assign) and isinstance(
+                sub.value, ast.Call
+            ):
+                kind = _ctor_kind(sub.value)
+                if kind and kind != "semaphore":
+                    for t in sub.targets:
+                        if isinstance(t, ast.Name):
+                            local_locks[t.id] = kind
+        _FunctionScanner(mod, cls_name, summary, local_locks).scan(fn_node)
+        mod.functions[qual] = summary
+        for child in _direct_nested(fn_node):
+            # nested defs: own summary, class context inherited
+            summarize_function(child, cls_name, f"{qual}.", False)
+
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            mod.toplevel.append(node.name)
+            summarize_function(node, None, "", False)
+        elif isinstance(node, ast.ClassDef):
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    summarize_function(
+                        item, node.name, f"{node.name}.", True
+                    )
+    return mod
+
+
+# -- program assembly --------------------------------------------------------
+
+
+class Program:
+    """Resolved whole-program view over a set of ModuleSummaries."""
+
+    def __init__(self, modules):
+        self.modules = list(modules)
+        self.by_module = {m.module: m for m in self.modules}
+        # (module, qualname) -> (ModuleSummary, FunctionSummary)
+        self.functions = {}
+        # method name -> [(ModuleSummary, FunctionSummary)]
+        self.methods_by_name = {}
+        for m in self.modules:
+            for qual, fn in m.functions.items():
+                self.functions[(m.module, qual)] = (m, fn)
+                if fn.cls is not None:
+                    self.methods_by_name.setdefault(fn.name, []).append(
+                        (m, fn)
+                    )
+        self._resolve_cache = {}
+
+    def iter_functions(self):
+        for m in self.modules:
+            for fn in m.functions.values():
+                yield m, fn
+
+    # -- call resolution -----------------------------------------------------
+
+    def _lookup_method(self, modsum, cls_name, method, _depth=0):
+        """Find *method* on a class or its resolvable bases."""
+        if _depth > 8 or modsum is None:
+            return None
+        info = modsum.classes.get(cls_name)
+        if info is None:
+            return None
+        if method in info["methods"]:
+            return self.functions.get(
+                (modsum.module, f"{cls_name}.{method}")
+            )
+        for base in info["bases"]:
+            base_mod, base_cls = self._resolve_class(modsum, base)
+            if base_cls is not None:
+                hit = self._lookup_method(
+                    base_mod, base_cls, method, _depth + 1
+                )
+                if hit is not None:
+                    return hit
+        return None
+
+    def _resolve_class(self, modsum, name):
+        """(ModuleSummary, class name) for a class reference, if local or
+        imported from an analyzed module."""
+        if name in modsum.classes:
+            return modsum, name
+        target = modsum.imports.get(name.split(".", 1)[0])
+        if target is None:
+            return None, None
+        if ":" in target:
+            tmod, attr = target.split(":", 1)
+            other = self.by_module.get(tmod)
+            if other is not None and attr in other.classes:
+                return other, attr
+        else:
+            other = self.by_module.get(target)
+            if other is not None and "." in name:
+                cls = name.split(".", 1)[1]
+                if cls in other.classes:
+                    return other, cls
+        return None, None
+
+    def _arity_ok(self, fn, nargs):
+        if nargs < 0:
+            return True
+        if nargs < fn.params_min:
+            return False
+        return fn.params_max is None or nargs <= fn.params_max
+
+    def resolve(self, modsum, caller, ref, nargs=-1):
+        """Resolve a call reference to (ModuleSummary, FunctionSummary) or
+        (None, None)."""
+        key = (modsum.module, caller.qualname if caller else "", ref, nargs)
+        if key in self._resolve_cache:
+            return self._resolve_cache[key]
+        result = self._resolve_uncached(modsum, caller, ref, nargs)
+        self._resolve_cache[key] = result
+        return result
+
+    def _resolve_uncached(self, modsum, caller, ref, nargs):
+        kind, value = ref
+        if kind == "self":
+            cls = caller.cls if caller else None
+            if cls:
+                hit = self._lookup_method(modsum, cls, value)
+                if hit is not None:
+                    return hit
+            return None, None
+        if kind == "name":
+            if value in modsum.toplevel:
+                return self.functions.get(
+                    (modsum.module, value), (None, None)
+                )
+            if value in modsum.classes:
+                return self._ctor(modsum, value)
+            target = modsum.imports.get(value)
+            if target is not None:
+                return self._resolve_import_target(target)
+            return None, None
+        if kind == "dotted":
+            base, rest = value.split(".", 1)
+            if base in modsum.classes:
+                # ClassName.method(...) — an unbound-call idiom
+                hit = self._lookup_method(modsum, base, rest)
+                return hit if hit is not None else (None, None)
+            target = modsum.imports.get(base)
+            if target is None:
+                return None, None
+            if ":" in target:
+                tmod, attr = target.split(":", 1)
+                other = self.by_module.get(tmod)
+                if other is None:
+                    return None, None
+                return self._attr_in_module(other, f"{attr}.{rest}")
+            other = self.by_module.get(target)
+            if other is None:
+                return None, None
+            return self._attr_in_module(other, rest)
+        if kind == "method":
+            candidates = self.methods_by_name.get(value, ())
+            live = [
+                (m, f) for m, f in candidates if self._arity_ok(f, nargs)
+            ]
+            if len(live) == 1:
+                return live[0]
+            return None, None
+        return None, None
+
+    def _ctor(self, modsum, cls_name):
+        hit = self._lookup_method(modsum, cls_name, "__init__")
+        return hit if hit is not None else (None, None)
+
+    def _attr_in_module(self, modsum, attr):
+        if "." in attr:
+            cls, method = attr.split(".", 1)
+            if cls in modsum.classes:
+                hit = self._lookup_method(modsum, cls, method)
+                return hit if hit is not None else (None, None)
+            return None, None
+        if attr in modsum.toplevel:
+            return self.functions.get((modsum.module, attr), (None, None))
+        if attr in modsum.classes:
+            return self._ctor(modsum, attr)
+        return None, None
+
+    def _resolve_import_target(self, target):
+        if ":" in target:
+            tmod, attr = target.split(":", 1)
+            other = self.by_module.get(tmod)
+            if other is None:
+                return None, None
+            return self._attr_in_module(other, attr)
+        return None, None
+
+    # -- convenience ---------------------------------------------------------
+
+    def pseudo_required_lock(self, fn):
+        """The pseudo lock id modeling the *_locked caller-holds-the-lock
+        convention (never fed into the lock-order graph)."""
+        owner = fn.cls or "<module>"
+        return f"<caller-held:{owner}>"
+
+
+def build_program(summaries):
+    return Program(summaries)

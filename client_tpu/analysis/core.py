@@ -1,24 +1,38 @@
-"""tpu-lint core: findings, the rule registry, suppressions, file driver.
+"""tpu-lint core: findings, the rule registries, suppressions, file driver.
 
-Pure stdlib (``ast`` + ``tokenize``-free regex comments) so the analyzer
-runs in any environment the repo does — no jax, no numpy, no third-party
-lint framework.  Each rule encodes an invariant this codebase has actually
-shipped a bug against; see ``rules.py`` for the catalog and README
-"Static analysis (tpu-lint)" for the rationale per rule.
+Pure stdlib (``ast`` + regex comments) so the analyzer runs in any
+environment the repo does — no jax, no numpy, no third-party lint
+framework.  Two rule families share one driver:
+
+- **per-file rules** (``rules.py``): one function/file at a time;
+- **program rules** (``concurrency.py``): run over the whole-program call
+  graph + lock summaries built by ``callgraph.py`` — interprocedural
+  hazards (lock-order inversion, blocking/callbacks reached under a lock
+  through any call depth) that no single-file pass can see.
+
+Each rule encodes an invariant this codebase has actually shipped a bug
+against; see the rule catalogs and README "Static analysis" for the
+rationale per rule.
+
+Suppressions require a reason: ``# tpulint: disable=RULE -- why``.  A
+bare ``# tpulint: disable`` (or one without the ``-- why`` tail) is
+itself a finding (BARE-SUPPRESS) — a waiver nobody can audit is debt,
+not a decision.
 """
 
 import ast
 import dataclasses
+import io
 import os
 import re
+import tokenize
 
-# ``# tpulint: disable=RULE-A,RULE-B`` or a bare ``# tpulint: disable``
-# (all rules).  On a code line it suppresses that line; on a comment-only
-# line it suppresses the line below (so a rationale can sit above the
-# statement it excuses).
-_SUPPRESS_RE = re.compile(
-    r"#\s*tpulint:\s*disable(?:=(?P<rules>[A-Za-z0-9_,\- ]+))?"
-)
+# ``# tpulint: disable=RULE-A,RULE-B -- reason`` or ``# tpulint: disable
+# -- reason`` (all rules).  On a code line it suppresses that line; on a
+# comment-only line it suppresses the line below (so a rationale can sit
+# above the statement it excuses).  The ``-- reason`` tail is mandatory:
+# reason-less suppressions become BARE-SUPPRESS findings.
+_SUPPRESS_RE = re.compile(r"#\s*tpulint:\s*disable(?P<tail>.*)")
 _ALL = "*"
 
 
@@ -46,8 +60,8 @@ class Finding:
 
 
 class Rule:
-    """Base class: subclasses set ``id``/``rationale`` and implement
-    ``check(tree, lines, path) -> iterable[Finding]``."""
+    """Per-file rule base: subclasses set ``id``/``rationale`` and
+    implement ``check(tree, lines, path) -> iterable[Finding]``."""
 
     id = ""
     rationale = ""
@@ -64,56 +78,136 @@ class Rule:
         raise NotImplementedError
 
 
+class ProgramRule:
+    """Whole-program rule base: subclasses implement
+    ``check_program(program) -> iterable[Finding]`` over a
+    :class:`client_tpu.analysis.callgraph.Program`.  Snippets are filled
+    in and suppressions applied by the driver."""
+
+    id = ""
+    rationale = ""
+
+    def check_program(self, program):  # pragma: no cover - interface
+        raise NotImplementedError
+
+
 REGISTRY = {}
+PROGRAM_REGISTRY = {}
 
 
 def register(cls):
-    """Class decorator adding a rule to the global registry."""
+    """Class decorator adding a per-file rule to the global registry."""
     REGISTRY[cls.id] = cls()
     return cls
 
 
-def parse_suppressions(lines):
-    """Map line number -> set of suppressed rule ids ('*' = all)."""
-    out = {}
-    for i, text in enumerate(lines, start=1):
-        m = _SUPPRESS_RE.search(text)
-        if not m:
-            continue
-        rules = m.group("rules")
-        ids = (
-            {_ALL}
-            if not rules
-            else {r.strip().upper() for r in rules.split(",") if r.strip()}
-        )
-        target = i
-        if text.lstrip().startswith("#"):
-            target = i + 1  # comment-only line covers the next line
-        out.setdefault(target, set()).update(ids)
-        out.setdefault(i, set()).update(ids)
+def register_program(cls):
+    """Class decorator adding a whole-program rule to the registry."""
+    PROGRAM_REGISTRY[cls.id] = cls()
+    return cls
+
+
+def all_rules():
+    """{id: rule} over both families (catalog/--explain/--rules)."""
+    merged = dict(REGISTRY)
+    merged.update(PROGRAM_REGISTRY)
+    return merged
+
+
+def _comment_tokens(lines):
+    """(line, column, text) for every real COMMENT token — tokenizing
+    (rather than regexing lines) keeps docstrings and string literals
+    that merely *mention* the suppression syntax from acting as (or being
+    flagged as) suppressions."""
+    source = "\n".join(lines) + "\n"
+    out = []
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                out.append((tok.start[0], tok.start[1], tok.string))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        # unparsable tail: fall back to line-level matching so a file the
+        # AST pass already rejects still reports its suppressions sanely
+        for i, text in enumerate(lines, start=1):
+            idx = text.find("#")
+            if idx >= 0:
+                out.append((i, idx, text[idx:]))
     return out
 
 
-def scan_source(source, path, rules=None):
-    """Run every (or the given) rule over one file's source text."""
+def parse_suppressions(lines):
+    """Parse suppression comments.
+
+    Returns ``(by_line, bare)`` where *by_line* maps line number -> set of
+    suppressed rule ids ('*' = all) and *bare* lists ``(line, ids)`` for
+    suppressions missing the mandatory ``-- reason`` tail.
+    """
+    out = {}
+    bare = []
+    for i, col, comment in _comment_tokens(lines):
+        m = _SUPPRESS_RE.search(comment)
+        if not m:
+            continue
+        tail = m.group("tail") or ""
+        spec, sep, reason = tail.partition("--")
+        spec = spec.strip()
+        if spec.startswith("="):
+            ids = {
+                r.strip().upper()
+                for r in spec[1:].split(",")
+                if r.strip()
+            }
+        else:
+            ids = {_ALL}
+        if not sep or not reason.strip():
+            bare.append((i, ids))
+        target = i
+        if not lines[i - 1][:col].strip():
+            target = i + 1  # comment-only line covers the next line
+        out.setdefault(target, set()).update(ids)
+        out.setdefault(i, set()).update(ids)
+    return out, bare
+
+
+def _suppressed(finding, by_line):
+    if finding.rule == "BARE-SUPPRESS":
+        # a waiver cannot waive the rule about waivers
+        return False
+    ids = by_line.get(finding.line, ())
+    return _ALL in ids or finding.rule.upper() in ids
+
+
+def scan_source(source, path, rules=None, tree=None, parsed_suppressions=None):
+    """Run every (or the given) per-file rule over one file's source.
+
+    *tree* / *parsed_suppressions* accept precomputed results so a driver
+    that also needs them (``_analyze_file`` builds the callgraph summary
+    from the same tree) parses and tokenizes each file exactly once.
+    """
     active = list((rules if rules is not None else REGISTRY).values())
     lines = source.splitlines()
-    try:
-        tree = ast.parse(source, filename=path)
-    except SyntaxError as e:
-        return [
-            Finding(
-                "PARSE-ERROR", path, e.lineno or 1, e.offset or 0,
-                f"could not parse: {e.msg}", "",
-            )
-        ]
-    suppressed = parse_suppressions(lines)
+    if tree is None:
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError as e:
+            return [
+                Finding(
+                    "PARSE-ERROR", path, e.lineno or 1, e.offset or 0,
+                    f"could not parse: {e.msg}", "",
+                )
+            ]
+    if parsed_suppressions is None:
+        parsed_suppressions = parse_suppressions(lines)
+    suppressed, bare = parsed_suppressions
     findings = []
     reported = set()  # one finding per (rule, line): passes can overlap
     for rule in active:
-        for f in rule.check(tree, lines, path):
-            ids = suppressed.get(f.line, ())
-            if _ALL in ids or f.rule.upper() in ids:
+        if hasattr(rule, "check_parsed"):
+            found = rule.check_parsed(bare, lines, path)
+        else:
+            found = rule.check(tree, lines, path)
+        for f in found:
+            if _suppressed(f, suppressed):
                 continue
             if (f.rule, f.line) in reported:
                 continue
@@ -157,16 +251,169 @@ def iter_python_files(paths, exclude_parts=("analysis_fixtures",)):
                 yield norm
 
 
-def scan_paths(paths, rules=None, exclude_parts=("analysis_fixtures",)):
+def _analyze_file(source, path, rules):
+    """(findings, summary, suppression-map) for one file.
+
+    *summary* is None on parse errors (the PARSE-ERROR finding carries
+    the news; program rules skip the file).  The file is parsed and
+    tokenized exactly once, shared between the per-file rules and the
+    callgraph summary.
+    """
+    from client_tpu.analysis import callgraph
+
+    lines = source.splitlines()
+    by_line, bare = parse_suppressions(lines)
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError:
+        return scan_source(source, path, rules), None, by_line
+    findings = scan_source(
+        source, path, rules, tree=tree, parsed_suppressions=(by_line, bare)
+    )
+    summary = callgraph.summarize_module(tree, path)
+    return findings, summary, by_line
+
+
+def scan_paths(paths, rules=None, exclude_parts=("analysis_fixtures",),
+               cache=None, program_rules=None):
+    """Scan files and the program they form.
+
+    ``rules``/``program_rules``: None = all registered; pass a dict to
+    filter (an empty dict disables that family).  ``cache`` is an
+    optional :class:`client_tpu.analysis.cache.AnalysisCache` reused
+    across runs — only consulted for full-default-rule scans (a filtered
+    scan must not poison or be poisoned by cached full results).
+    """
+    from client_tpu.analysis import callgraph
+
+    use_cache = cache is not None and rules is None
     findings = []
+    summaries = []
+    suppress_by_path = {}
+    snippet_lines = {}  # program-finding snippets come from the source
     for path in iter_python_files(paths, exclude_parts):
-        try:
-            with open(path, "r", encoding="utf-8") as fh:
-                source = fh.read()
-        except OSError as e:
-            findings.append(
-                Finding("READ-ERROR", path, 1, 0, f"unreadable: {e}", "")
+        entry = cache.get(path) if use_cache else None
+        if entry is not None:
+            file_findings = [Finding(**f) for f in entry["findings"]]
+            summary = (
+                callgraph.ModuleSummary.from_dict(entry["summary"])
+                if entry["summary"] is not None
+                else None
             )
-            continue
-        findings.extend(scan_source(source, path, rules))
+            by_line = {
+                int(k): set(v) for k, v in entry["suppress"].items()
+            }
+        else:
+            # stat BEFORE reading: a save landing mid-analysis must leave
+            # the entry stale (re-scan next run), never fresh-looking
+            stat_key = cache.stat_key(path) if use_cache else None
+            try:
+                with open(path, "r", encoding="utf-8") as fh:
+                    source = fh.read()
+            except OSError as e:
+                findings.append(
+                    Finding("READ-ERROR", path, 1, 0, f"unreadable: {e}", "")
+                )
+                continue
+            file_findings, summary, by_line = _analyze_file(
+                source, path, rules
+            )
+            # keep THIS run's lines for program-finding snippets: a save
+            # landing mid-run must not produce a snippet (the baseline's
+            # drift-stable key) from content nobody analyzed
+            snippet_lines[path] = source.splitlines()
+            if use_cache:
+                cache.put(path, {
+                    "findings": [f.to_dict() for f in file_findings],
+                    "summary": (
+                        summary.to_dict() if summary is not None else None
+                    ),
+                    "suppress": {
+                        str(k): sorted(v) for k, v in by_line.items()
+                    },
+                }, stat_key)
+        findings.extend(file_findings)
+        if summary is not None:
+            summaries.append(summary)
+            suppress_by_path[path] = by_line
+
+    active_program = (
+        PROGRAM_REGISTRY if program_rules is None else program_rules
+    )
+    if active_program and summaries:
+        program = callgraph.build_program(summaries)
+        reported = set()
+        program_findings = []
+        for rule in active_program.values():
+            for f in rule.check_program(program):
+                by_line = suppress_by_path.get(f.path, {})
+                if _suppressed(f, by_line):
+                    continue
+                # message is part of the key: two DISTINCT cycles can
+                # anchor on the same witness line (a call made under two
+                # held locks); only true duplicates may collapse
+                key = (f.rule, f.path, f.line, f.message)
+                if key in reported:
+                    continue
+                reported.add(key)
+                if f.path not in snippet_lines:
+                    # cache-hit file: its source was not read this run
+                    try:
+                        with open(f.path, "r", encoding="utf-8") as fh:
+                            snippet_lines[f.path] = fh.read().splitlines()
+                    except OSError:
+                        snippet_lines[f.path] = []
+                lines = snippet_lines[f.path]
+                snippet = (
+                    lines[f.line - 1].strip()
+                    if 1 <= f.line <= len(lines)
+                    else ""
+                )
+                program_findings.append(
+                    dataclasses.replace(f, snippet=snippet)
+                )
+        findings.extend(program_findings)
+
+    if use_cache:
+        cache.save()
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings
+
+
+@register
+class BareSuppressRule(Rule):
+    """BARE-SUPPRESS — suppression comments without a ``-- reason``.
+
+    Every waiver is a decision someone later has to re-audit; a bare
+    ``# tpulint: disable=RULE`` records the decision without the
+    reasoning, so the next reader cannot tell a load-bearing exemption
+    from a drive-by silence.  The reason rides in the comment itself:
+    ``# tpulint: disable=RULE -- why this is safe``.  BARE-SUPPRESS
+    findings cannot themselves be suppressed.
+    """
+
+    id = "BARE-SUPPRESS"
+    rationale = (
+        "a suppression without a reason cannot be audited — write "
+        "`# tpulint: disable=RULE -- why`"
+    )
+
+    def check(self, tree, lines, path):
+        _by_line, bare = parse_suppressions(lines)
+        return self.check_parsed(bare, lines, path)
+
+    def check_parsed(self, bare, lines, path):
+        """The driver hands over its already-parsed suppressions so the
+        file is tokenized once, not once per consumer."""
+        findings = []
+        for line, ids in bare:
+            what = (
+                "all rules" if _ALL in ids else ", ".join(sorted(ids))
+            )
+            snippet = lines[line - 1].strip() if line <= len(lines) else ""
+            findings.append(Finding(
+                self.id, path, line, 0,
+                f"suppression of {what} carries no reason — append "
+                "`-- <why this is safe>`", snippet,
+            ))
+        return findings

@@ -43,9 +43,23 @@ def render_json(new, grandfathered, rules):
 def render_rules(rules):
     lines = ["tpu-lint rule catalog:"]
     for rule_id in sorted(rules):
-        lines.append(f"  {rule_id:15s} {rules[rule_id].rationale}")
+        lines.append(f"  {rule_id:20s} {rules[rule_id].rationale}")
     lines.append(
-        "suppress in place with `# tpulint: disable=RULE` (same line or "
-        "a comment line above)"
+        "suppress in place with `# tpulint: disable=RULE -- why` (same "
+        "line or a comment line above); reason-less suppressions are "
+        "BARE-SUPPRESS findings"
     )
+    return "\n".join(lines)
+
+
+def render_explain(rules, rule_id):
+    """Full rationale for one rule (class docstring + one-liner), or
+    None when the id is unknown."""
+    rule = rules.get(rule_id.strip().upper())
+    if rule is None:
+        return None
+    doc = (type(rule).__doc__ or "").strip("\n")
+    lines = [f"{rule.id}: {rule.rationale}", ""]
+    if doc:
+        lines.append(doc)
     return "\n".join(lines)

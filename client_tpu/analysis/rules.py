@@ -27,6 +27,26 @@ _DISPATCH_HINTS = {"prefill", "decode_step", "block_until_ready"}
 _DISPATCH_FULL = {
     "jax.device_put", "jax.device_get", "jax.block_until_ready",
 }
+# constructors whose assignment targets become jit-compiled callables
+_JIT_CTORS = ("jax.jit", "jit", "jax.pmap", "pmap")
+
+
+def _jit_bound_names(tree):
+    """Names (bare or ``self.x``) assigned from jax.jit/pmap anywhere in
+    *tree*.  Shared by the lexical LOCK-DISPATCH rule and the callgraph
+    summaries so both rule families agree on what a dispatch is."""
+    bound = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            text = _expr_text(node.value.func) or ""
+            if text in _JIT_CTORS:
+                for t in node.targets:
+                    tt = _expr_text(t)
+                    if tt:
+                        bound.add(tt)
+    return bound
+
+
 # blocking callees never allowed in an async def body
 _ASYNC_BLOCKING_FULL = {
     "time.sleep",
@@ -458,22 +478,6 @@ class LockDispatchRule(Rule):
         "for a full XLA compile (continuous.py _admit_locked)"
     )
 
-    def _jit_bound(self, tree):
-        bound = set()
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Assign):
-                continue
-            value = node.value
-            if not isinstance(value, ast.Call):
-                continue
-            func_text = _expr_text(value.func) or ""
-            if func_text in ("jax.jit", "jit", "jax.pmap", "pmap"):
-                for t in node.targets:
-                    text = _expr_text(t)
-                    if text:
-                        bound.add(text)
-        return bound
-
     def _is_dispatch(self, call, jit_bound):
         text = _expr_text(call.func)
         if not text:
@@ -487,7 +491,7 @@ class LockDispatchRule(Rule):
         return None
 
     def check(self, tree, lines, path):
-        jit_bound = self._jit_bound(tree)
+        jit_bound = _jit_bound_names(tree)
         findings = []
         regions = []
         for node in ast.walk(tree):

@@ -1,0 +1,372 @@
+"""Dynamic lock-order witness: TSan-shaped evidence for the static pass.
+
+The static LOCK-INV rule reasons over *names*; this module watches the
+*objects*.  Opt-in wrappers around the repo's lock/condition objects
+record the actual acquisition DAG a test run exercises — every edge
+``A -> B`` where some thread acquired B while holding A, stamped with
+the acquiring source sites — and report any cycle.  Static analysis and
+the witness keep each other honest: a cycle only one of them sees is
+either an unexercised static path (add a test) or a dynamic aliasing
+pattern the summaries cannot name (add a rule).
+
+Usage (tests)::
+
+    w = LockWitness()
+    with w.installed():           # patches threading.Lock/RLock/Condition
+        run_concurrent_scenario() # locks built inside client_tpu/ record
+    w.assert_acyclic()            # raises LockOrderViolation on a cycle
+
+The ``installed()`` patch only wraps locks *constructed from files under
+the configured prefixes* (default ``client_tpu``): stdlib internals
+(queue, threading.Event, logging) keep raw primitives, so overhead and
+noise stay scoped to the code under test.  Lock identity is the
+construction site (``client_tpu/balance/pool.py:223``) — all instances
+born at one line share a name, which matches how the static pass (and a
+human) reasons about lock order.
+
+Pytest integration: ``--lock-witness`` (or ``TPULINT_LOCK_WITNESS=1``,
+the ``make soak`` hookup) arms a per-test witness via the fixture in
+``tests/conftest.py`` and fails any test whose acquisition graph closed
+a cycle.
+"""
+
+import contextlib
+import os
+import sys
+import threading
+
+__all__ = [
+    "LockOrderViolation",
+    "LockWitness",
+    "WitnessLock",
+    "WitnessCondition",
+]
+
+_HERE = os.path.abspath(os.path.dirname(__file__))
+
+
+class LockOrderViolation(AssertionError):
+    """A cycle exists in the observed lock-acquisition graph."""
+
+
+def _call_site(prefixes):
+    """The IMMEDIATE caller frame (first one outside this module) as
+    ``relpath:lineno`` when it lives under one of *prefixes*; None
+    otherwise.  Deliberately no deeper walk: a lock allocated by stdlib
+    internals on behalf of client code (``Condition()``'s private RLock,
+    ``queue.Queue``'s mutex) must stay a raw primitive — wrapping the
+    RLock inside a Condition breaks its non-reentrant ``_is_owned``
+    fallback probe."""
+    frame = sys._getframe(2)
+    while frame is not None:
+        filename = frame.f_code.co_filename
+        absfile = os.path.abspath(filename)
+        if absfile.startswith(_HERE):
+            frame = frame.f_back
+            continue
+        norm = absfile.replace(os.sep, "/")
+        for prefix in prefixes:
+            # a prefix names a PACKAGE, not a substring: a checkout
+            # directory itself called client_tpu must not pull the whole
+            # tree (tests included) into scope, so the matched component
+            # has to be a real package root (it carries __init__.py)
+            idx = 0
+            needle = "/" + prefix + "/"
+            while True:
+                idx = norm.find(needle, idx)
+                if idx < 0:
+                    break
+                if _is_package_dir(norm[: idx + 1 + len(prefix)]):
+                    rel = norm[idx + 1:]
+                    if not rel.startswith("client_tpu/analysis/"):
+                        return f"{rel}:{frame.f_lineno}"
+                idx += 1
+        return None
+    return None
+
+
+_PKG_DIR_CACHE = {}
+
+
+def _is_package_dir(d):
+    hit = _PKG_DIR_CACHE.get(d)
+    if hit is None:
+        hit = os.path.isfile(os.path.join(d, "__init__.py"))
+        _PKG_DIR_CACHE[d] = hit
+    return hit
+
+
+class LockWitness:
+    """Collects the acquisition DAG; detects cycles as edges close them."""
+
+    def __init__(self, prefixes=("client_tpu",)):
+        self.prefixes = tuple(prefixes)
+        self._mu = threading.Lock()
+        # (held_name, acquired_name) -> {"held_site", "site", "count"}
+        self._edges = {}
+        self._tls = threading.local()
+        self.violations = []  # [(cycle list, description)]
+        self.acquisitions = 0
+
+    # -- held-stack bookkeeping (per thread) --------------------------------
+
+    def _stack(self):
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        return stack
+
+    def note_acquire(self, name, site):
+        stack = self._stack()
+        with self._mu:
+            self.acquisitions += 1
+            for held_name, held_site in stack:
+                if held_name == name:
+                    continue  # re-entrant acquire: not an ordering edge
+                edge = (held_name, name)
+                entry = self._edges.get(edge)
+                if entry is None:
+                    self._edges[edge] = {
+                        "held_site": held_site, "site": site, "count": 1,
+                    }
+                    # the new edge held->name closes a cycle iff a path
+                    # name ~> held already existed
+                    path = self._path_locked(name, held_name)
+                    if path is not None:
+                        cycle = [held_name] + path
+                        self.violations.append(
+                            (cycle, self._describe_locked(cycle))
+                        )
+                else:
+                    entry["count"] += 1
+        stack.append((name, site))
+
+    def note_release(self, name):
+        stack = self._stack()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i][0] == name:
+                del stack[i]
+                return
+
+    # -- graph queries -------------------------------------------------------
+
+    def _path_locked(self, src, dst):
+        """A node path src..dst over current edges, else None."""
+        adjacent = {}
+        for a, b in self._edges:
+            adjacent.setdefault(a, []).append(b)
+        stack = [(src, [src])]
+        seen = {src}
+        while stack:
+            node, path = stack.pop()
+            if node == dst:
+                return path
+            for nxt in adjacent.get(node, ()):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append((nxt, path + [nxt]))
+        return None
+
+    def _describe_locked(self, cycle):
+        parts = []
+        for i, a in enumerate(cycle):
+            b = cycle[(i + 1) % len(cycle)]
+            entry = self._edges.get((a, b))
+            if entry is not None:
+                parts.append(
+                    f"{a} (held at {entry['held_site']}) -> "
+                    f"{b} (acquired at {entry['site']})"
+                )
+        return " ; ".join(parts)
+
+    def edges(self):
+        """{(held, acquired): count} snapshot of the observed DAG."""
+        with self._mu:
+            return {e: d["count"] for e, d in self._edges.items()}
+
+    def cycles(self):
+        """Cycles recorded while the witness was armed."""
+        with self._mu:
+            return list(self.violations)
+
+    def assert_acyclic(self):
+        """Raise :class:`LockOrderViolation` if any acquisition cycle was
+        observed; returns the edge count otherwise (so callers can assert
+        the witness actually saw traffic)."""
+        with self._mu:
+            violations = list(self.violations)
+            n_edges = len(self._edges)
+        if violations:
+            lines = [
+                f"lock-order cycle: {' -> '.join(c + [c[0]])} ({how})"
+                for c, how in violations
+            ]
+            raise LockOrderViolation(
+                f"{len(violations)} lock-order cycle(s) observed:\n"
+                + "\n".join(lines)
+            )
+        return n_edges
+
+    # -- wrapping ------------------------------------------------------------
+
+    def wrap_lock(self, lock, name):
+        return WitnessLock(lock, name, self)
+
+    def wrap_condition(self, cond, name):
+        return WitnessCondition(cond, name, self)
+
+    @contextlib.contextmanager
+    def installed(self):
+        """Patch ``threading.Lock/RLock/Condition`` so objects constructed
+        from files under the witness prefixes are wrapped (everything else
+        gets the raw primitive)."""
+        real_lock = threading.Lock
+        real_rlock = threading.RLock
+        real_cond = threading.Condition
+        witness = self
+
+        def make_lock():
+            site = _call_site(witness.prefixes)
+            inner = real_lock()
+            return (
+                WitnessLock(inner, site, witness)
+                if site is not None
+                else inner
+            )
+
+        def make_rlock():
+            site = _call_site(witness.prefixes)
+            inner = real_rlock()
+            return (
+                WitnessLock(inner, site, witness)
+                if site is not None
+                else inner
+            )
+
+        def make_condition(lock=None):
+            site = _call_site(witness.prefixes)
+            if isinstance(lock, WitnessLock):
+                # share the existing wrapper's identity; the condition
+                # acquires through it
+                inner = real_cond(lock._inner)
+                return WitnessCondition(inner, lock._name, witness)
+            inner = real_cond(lock)
+            return (
+                WitnessCondition(inner, site, witness)
+                if site is not None
+                else inner
+            )
+
+        threading.Lock = make_lock
+        threading.RLock = make_rlock
+        threading.Condition = make_condition
+        try:
+            yield self
+        finally:
+            threading.Lock = real_lock
+            threading.RLock = real_rlock
+            threading.Condition = real_cond
+
+
+class WitnessLock:
+    """Recording proxy over a Lock/RLock."""
+
+    def __init__(self, inner, name, witness):
+        self._inner = inner
+        self._name = name
+        self._w = witness
+
+    def acquire(self, blocking=True, timeout=-1):
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            self._w.note_acquire(self._name, _call_site(self._w.prefixes))
+        return ok
+
+    def release(self):
+        self._w.note_release(self._name)
+        self._inner.release()
+
+    def locked(self):
+        return self._inner.locked()
+
+    def _is_owned(self):
+        """Condition-compatibility: delegate RLock ownership, and answer
+        the non-reentrant probe without re-recording (a wrapped lock
+        handed to ``threading.Condition`` must keep its semantics)."""
+        inner_owned = getattr(self._inner, "_is_owned", None)
+        if inner_owned is not None:
+            return inner_owned()
+        if self._inner.acquire(False):
+            self._inner.release()
+            return False
+        return True
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return f"WitnessLock({self._name!r})"
+
+
+class WitnessCondition:
+    """Recording proxy over a Condition.
+
+    ``wait``/``wait_for`` release the underlying lock for their duration:
+    the witness pops the name while blocked and re-records the
+    reacquisition (which IS an ordering event — waking up under other
+    held locks is how wait-based inversions happen)."""
+
+    def __init__(self, inner, name, witness):
+        self._inner = inner
+        self._name = name
+        self._w = witness
+
+    def acquire(self, *args, **kwargs):
+        ok = self._inner.acquire(*args, **kwargs)
+        if ok:
+            self._w.note_acquire(self._name, _call_site(self._w.prefixes))
+        return ok
+
+    def release(self):
+        self._w.note_release(self._name)
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def wait(self, timeout=None):
+        self._w.note_release(self._name)
+        try:
+            return self._inner.wait(timeout)
+        finally:
+            self._w.note_acquire(
+                self._name, _call_site(self._w.prefixes)
+            )
+
+    def wait_for(self, predicate, timeout=None):
+        self._w.note_release(self._name)
+        try:
+            return self._inner.wait_for(predicate, timeout)
+        finally:
+            self._w.note_acquire(
+                self._name, _call_site(self._w.prefixes)
+            )
+
+    def notify(self, n=1):
+        self._inner.notify(n)
+
+    def notify_all(self):
+        self._inner.notify_all()
+
+    def __repr__(self):
+        return f"WitnessCondition({self._name!r})"

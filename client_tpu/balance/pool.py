@@ -52,6 +52,7 @@ from client_tpu.resilience import (
     CircuitOpenError,
     NoHealthyEndpointError,
     _notify,
+    _SerialDeliverer,
     is_connection_level,
 )
 from client_tpu.utils import (
@@ -240,7 +241,11 @@ class EndpointPool:
         self._probe_interval_s = 0.0
         self._prober = None
         self._stop = threading.Event()
-        self._notify_lock = threading.Lock()
+        # Observer delivery: ordered, stale-dropping, and — crucially —
+        # with NO pool lock held during the callback (an observer that
+        # looks back at the pool, or whose delivery triggers another
+        # transition, must never deadlock on a private delivery lock).
+        self._deliverer = _SerialDeliverer()
 
     def _build_endpoint(self, spec):
         if isinstance(spec, Endpoint):
@@ -306,23 +311,36 @@ class EndpointPool:
         """Deliver one stamped state transition, dropping it if a newer one
         was already delivered (out-of-order delivery would wedge the
         endpoint-state gauge on a stale value forever, since changes only
-        notify on transitions)."""
+        notify on transitions).  The staleness check runs in delivery
+        order inside the deliverer; the observer call runs lock-free."""
         if seq is None:
             return
-        with self._notify_lock:
+
+        def accept():
             if seq <= endpoint._state_delivered:
-                return
+                return False
             endpoint._state_delivered = seq
-            _notify(self.observer, "on_endpoint_state", endpoint.url, state)
+            return True
+
+        self._deliverer.post(
+            lambda: _notify(
+                self.observer, "on_endpoint_state", endpoint.url, state
+            ),
+            accept,
+        )
 
     def _deliver_events(self, events):
-        """Deliver a batch of membership/phase events in order (outside the
-        pool lock — observers may look back at the pool)."""
+        """Deliver a batch of membership/phase events in order, contiguous
+        per batch, with no lock held during the callbacks (observers may
+        look back at the pool)."""
         if not events:
             return
-        with self._notify_lock:
+
+        def deliver():
             for method, args in events:
                 _notify(self.observer, method, *args)
+
+        self._deliverer.post(deliver)
 
     def set_state(self, url, state):
         """Record a health observation for *url* (probe or admin).  A

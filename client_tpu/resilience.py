@@ -41,6 +41,7 @@ All deadlines are computed from ``time.monotonic()`` — wall-clock
 """
 
 import asyncio
+import collections
 import random
 import threading
 import time
@@ -189,6 +190,58 @@ def _notify(observer, method, *args):
         pass
 
 
+class _SerialDeliverer:
+    """Ordered observer delivery with NO lock held during the callback.
+
+    The old scheme serialized deliveries by holding a ``_notify_lock``
+    across the observer call — which handed third-party code a private,
+    non-reentrant lock: an observer that triggered another transition
+    (or looked back at an object that does) deadlocked on it
+    (CALLBACK-UNDER-LOCK).  This replaces it with a FIFO queue + single
+    drainer: posters enqueue under a tiny mutex; whichever thread finds
+    no drainer active becomes one and delivers queued items with the
+    mutex RELEASED, so total order is preserved (one drainer at a time,
+    FIFO queue) while observers run lock-free.
+
+    ``post(deliver, accept=None)``: *accept* (optional) runs under the
+    mutex at dequeue time and may veto the delivery — the stale-transition
+    drop (a preempted thread's older state change must not be delivered
+    after a newer one) keeps its exact semantics, because the accept check
+    happens in delivery order, not post order.
+    """
+
+    __slots__ = ("_mu", "_queue", "_draining")
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._queue = collections.deque()
+        self._draining = False
+
+    def post(self, deliver, accept=None):
+        with self._mu:
+            self._queue.append((deliver, accept))
+            if self._draining:
+                return  # the active drainer will deliver this, in order
+            self._draining = True
+        try:
+            while True:
+                with self._mu:
+                    if not self._queue:
+                        self._draining = False
+                        return
+                    deliver, accept = self._queue.popleft()
+                    ok = accept is None or accept()
+                if ok:
+                    deliver()
+        except BaseException:
+            # a raising deliver/accept must not latch _draining forever
+            # (every later post would enqueue into a queue nobody drains);
+            # items already queued wait for the next post to drain them
+            with self._mu:
+                self._draining = False
+            raise  # observer code: no lock of ours is held
+
+
 class CircuitBreaker:
     """Per-endpoint circuit breaker: closed → open → half-open.
 
@@ -217,12 +270,14 @@ class CircuitBreaker:
         self._opened_at = 0.0
         self._probing = False  # a half-open probe is in flight
         # Transition delivery: stamped under _lock, delivered outside it
-        # under _notify_lock, stale deliveries dropped — so a preempted
-        # thread can never report an older transition after a newer one
-        # (which would wedge a state gauge at the wrong value).
+        # through the serial deliverer, stale deliveries dropped — so a
+        # preempted thread can never report an older transition after a
+        # newer one (which would wedge a state gauge at the wrong value),
+        # and the observer runs with NO breaker lock held (an observer
+        # that reads .state or trips another transition must not deadlock).
         self._transition_seq = 0
         self._delivered_seq = 0
-        self._notify_lock = threading.Lock()
+        self._deliverer = _SerialDeliverer()
 
     @property
     def state(self):
@@ -240,14 +295,22 @@ class CircuitBreaker:
         """Deliver one stamped transition, dropping it if a newer one was
         already delivered (a preempted thread must not overwrite a fresher
         observer state — e.g. park a circuit-state gauge at 'open' after
-        the breaker already closed again)."""
+        the breaker already closed again).  The accept check runs in
+        delivery order inside the deliverer's mutex; the observer call
+        itself runs outside every lock."""
         if seq is None:
             return
-        with self._notify_lock:
+
+        def accept():
             if seq <= self._delivered_seq:
-                return
+                return False
             self._delivered_seq = seq
-            _notify(self.observer, "on_state_change", old, new)
+            return True
+
+        self._deliverer.post(
+            lambda: _notify(self.observer, "on_state_change", old, new),
+            accept,
+        )
 
     def before_attempt(self):
         """Gate one attempt; raises CircuitOpenError without touching the

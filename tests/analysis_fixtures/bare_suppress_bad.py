@@ -1,0 +1,16 @@
+"""Reason-less suppressions: the waiver still silences its rule (the
+suppression machinery is unchanged) but becomes a BARE-SUPPRESS finding
+itself — a waiver nobody can audit is debt, not a decision.  Both the
+targeted and the blanket form, same-line and comment-line-above."""
+
+import time
+
+
+class Poller:
+    def tick(self):
+        deadline = time.time() + 5  # tpulint: disable=TIME-WALL
+        return deadline
+
+    async def nap(self):
+        # tpulint: disable
+        time.sleep(0.1)

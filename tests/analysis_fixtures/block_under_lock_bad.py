@@ -1,0 +1,58 @@
+"""PRE-FIX prefill-under-_cv, the INTERPROCEDURAL shape (ADVICE round
+5's incident one refactor later): the jit prefill dispatch no longer
+sits lexically inside the ``with self._cv:`` body — it is two calls
+below it — so the lexical LOCK-DISPATCH rule cannot fire.  Only the
+call-graph pass sees that ``_loop`` carries the scheduler lock into
+``_admit_one -> _do_prefill`` where the compile-on-novel-shape dispatch
+runs.  Also covers direct host-blocking (``time.sleep``) under a lock,
+same-function and through a call.
+"""
+
+import functools
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+
+from some_model import prefill  # noqa: F401 (fixture only)
+
+
+class Scheduler:
+    def __init__(self, params, cfg):
+        self.params = params
+        self._cv = threading.Condition()
+        self._pending = []
+        self._prefill = jax.jit(functools.partial(prefill, cfg=cfg))
+
+    def _loop(self):
+        while True:
+            with self._cv:
+                # BAD: this call chain reaches the jit dispatch while _cv
+                # is held — a novel-length prompt compiles for seconds
+                # with every submit()/cancel() blocked behind it
+                self._admit_one()
+
+    def _admit_one(self):
+        entry = self._pending.pop(0)
+        return self._do_prefill(entry)
+
+    def _do_prefill(self, entry):
+        logits, _cache = self._prefill(
+            self.params, jnp.asarray(entry[0]), cache={}
+        )
+        return logits
+
+    def drain(self):
+        with self._cv:
+            # BAD: host sleep directly inside the critical section
+            time.sleep(0.01)
+
+    def flush(self):
+        with self._cv:
+            # BAD: the sleep is one call away — same stall, invisible to
+            # any per-function rule
+            self._settle()
+
+    def _settle(self):
+        time.sleep(0.05)

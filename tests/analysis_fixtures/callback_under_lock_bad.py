@@ -1,0 +1,41 @@
+"""PRE-FIX observer delivery (the balance/pool.py + resilience.py shape
+this PR fixed): ``_notify`` — a dynamic getattr-derived callback — runs
+while the private ``_notify_lock`` is held, and a metrics observer is
+invoked directly under the pool lock.  An observer that looks back at
+the pool (snapshot/states) or triggers another transition re-enters a
+non-reentrant private lock and deadlocks; one that blocks parks every
+state transition behind third-party code."""
+
+import threading
+
+
+def _notify(observer, method, *args):
+    if observer is None:
+        return
+    fn = getattr(observer, method, None)
+    if fn is None:
+        return
+    try:
+        fn(*args)
+    except Exception:
+        pass
+
+
+class Pool:
+    def __init__(self, observer):
+        self.observer = observer
+        self._lock = threading.Lock()
+        self._notify_lock = threading.Lock()
+        self._states = {}
+
+    def _deliver_events(self, events):
+        # BAD: the callback chain runs under the private delivery lock
+        with self._notify_lock:
+            for method, args in events:
+                _notify(self.observer, method, *args)
+
+    def set_state(self, url, state):
+        with self._lock:
+            self._states[url] = state
+            # BAD: observer invoked directly under the pool lock
+            self.observer.on_endpoint_state(url, state)

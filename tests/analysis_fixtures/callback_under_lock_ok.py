@@ -1,0 +1,37 @@
+"""Post-fix twin of callback_under_lock_bad.py: state mutates under the
+lock, the observer snapshot is delivered after it is released (the
+``_SerialDeliverer`` discipline resilience.py/pool.py now use)."""
+
+import threading
+
+
+def _notify(observer, method, *args):
+    if observer is None:
+        return
+    fn = getattr(observer, method, None)
+    if fn is None:
+        return
+    try:
+        fn(*args)
+    except Exception:
+        pass
+
+
+class Pool:
+    def __init__(self, observer):
+        self.observer = observer
+        self._lock = threading.Lock()
+        self._states = {}
+
+    def _deliver_events(self, events):
+        # no lock held: observers run free to look back at the pool
+        for method, args in events:
+            _notify(self.observer, method, *args)
+
+    def set_state(self, url, state):
+        events = []
+        with self._lock:
+            if self._states.get(url) != state:
+                self._states[url] = state
+                events.append(("on_endpoint_state", (url, state)))
+        self._deliver_events(events)

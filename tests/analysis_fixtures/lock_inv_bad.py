@@ -1,0 +1,29 @@
+"""Lock-order inversion: ``credit`` takes audit-then-write, ``debit``
+takes write-then-(via a helper)-audit.  Each function is individually
+fine — the deadlock only exists in the composition, with one edge hidden
+behind a call, which is why no per-function rule can ever see it.  Two
+threads, one in each method, each holding one lock and waiting for the
+other: classic ABBA."""
+
+import threading
+
+
+class Ledger:
+    def __init__(self):
+        self._audit_lock = threading.Lock()
+        self._write_lock = threading.Lock()
+        self.entries = []
+
+    def credit(self, amount):
+        with self._audit_lock:
+            with self._write_lock:  # edge: audit -> write
+                self.entries.append(amount)
+
+    def debit(self, amount):
+        with self._write_lock:
+            self.entries.append(-amount)
+            self._audit()  # edge: write -> audit, one call down
+
+    def _audit(self):
+        with self._audit_lock:
+            return sum(self.entries)

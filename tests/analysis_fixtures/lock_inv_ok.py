@@ -1,0 +1,24 @@
+"""Consistent global lock order (audit before write on every path):
+same structure as lock_inv_bad.py, no cycle, no finding."""
+
+import threading
+
+
+class Ledger:
+    def __init__(self):
+        self._audit_lock = threading.Lock()
+        self._write_lock = threading.Lock()
+        self.entries = []
+
+    def credit(self, amount):
+        with self._audit_lock:
+            with self._write_lock:  # audit -> write
+                self.entries.append(amount)
+
+    def debit(self, amount):
+        with self._audit_lock:  # same order: audit first, then write
+            self._write(-amount)
+
+    def _write(self, amount):
+        with self._write_lock:
+            self.entries.append(amount)

@@ -1,5 +1,7 @@
 """Suppression samples: real violations waived in place with
-`# tpulint: disable=RULE` — same-line and comment-line-above forms."""
+`# tpulint: disable=RULE -- reason` — same-line and comment-line-above
+forms.  Every waiver carries its reason; reason-less waivers are
+BARE-SUPPRESS findings (see bare_suppress_bad.py)."""
 
 import threading
 import time
@@ -13,12 +15,12 @@ class Scheduler:
 
     def has_tokens(self, prompt_tokens):
         arr = np.asarray(prompt_tokens, np.int32)
-        if arr:  # tpulint: disable=NPY-TRUTH
+        if arr:  # tpulint: disable=NPY-TRUTH -- scalar array by contract
             return True
-        # single-waiter cv with a latched predicate; loop not needed here
-        # tpulint: disable=CV-WAIT-LOOP
+        # tpulint: disable=CV-WAIT-LOOP -- single waiter, latched predicate
         self._cv.wait()
         return False
 
     async def blanket_waiver(self):
-        time.sleep(0.1)  # tpulint: disable
+        # tpulint: disable -- fixture exercising the all-rules waiver form
+        time.sleep(0.1)

@@ -32,6 +32,41 @@ def pytest_configure(config):
     )
 
 
+def pytest_addoption(parser):
+    parser.addoption(
+        "--lock-witness", action="store_true", default=False,
+        help=(
+            "wrap every lock/condition constructed under client_tpu/ in "
+            "the dynamic lock-order witness and fail any test whose "
+            "acquisition graph closes a cycle (TPULINT_LOCK_WITNESS=1 "
+            "does the same — the make-soak hookup)"
+        ),
+    )
+
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _lock_order_witness(request):
+    """Opt-in dynamic lock-order witness (see client_tpu.analysis.witness):
+    records the acquisition DAG the test actually exercises and fails on a
+    cycle — the runtime complement of the static LOCK-INV rule."""
+    env = os.environ.get("TPULINT_LOCK_WITNESS", "").strip().lower()
+    enabled = request.config.getoption("--lock-witness") or env not in (
+        "", "0", "false", "no", "off"
+    )
+    if not enabled:
+        yield None
+        return
+    from client_tpu.analysis.witness import LockWitness
+
+    witness = LockWitness()
+    with witness.installed():
+        yield witness
+    witness.assert_acyclic()
+
+
 # Native libraries are build artifacts (gitignored): build them on demand so a
 # fresh checkout runs the full suite instead of failing the shm-backed tests.
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

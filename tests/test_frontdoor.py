@@ -787,3 +787,28 @@ def test_noisy_neighbor_soak():
         n_per_tenant=80, flood_threads=8, storm_n=16, delay_s=0.015
     )
     assert summary["sheds"] > 0
+
+
+def test_chaos_lock_order_witness():
+    """The dynamic lock-order witness (client_tpu.analysis.witness) armed
+    over the noisy-neighbor chaos scenario: every lock/condition the front
+    door, batcher, engine, pool, and clients construct records the REAL
+    acquisition DAG this run exercises.  The acceptance is a non-trivial,
+    acyclic graph — the runtime complement of the static LOCK-INV rule
+    (a cycle only the witness sees is a dynamic aliasing pattern the
+    summaries cannot name; one only the static pass sees is an
+    unexercised path)."""
+    from client_tpu.analysis.witness import LockWitness
+
+    witness = LockWitness()
+    with witness.installed():
+        summary = _chaos_with_p99_retry(
+            n_per_tenant=30, flood_threads=4, storm_n=8, delay_s=0.015
+        )
+    assert summary["sheds"] > 0
+    edges = witness.assert_acyclic()
+    # the scenario nests acquisitions (batcher cond -> metrics registry,
+    # QoS lock -> registry, cache lock -> registry): an edgeless graph
+    # means the witness was not actually armed
+    assert edges > 0
+    assert witness.acquisitions > 0

@@ -288,6 +288,46 @@ class TestHalfOpenSingleProbeRace:
         assert outcomes.count("admitted") == len(outcomes)
 
 
+class TestSerialDeliverer:
+    """The lock-free observer-delivery queue behind pool/breaker
+    notifications: ordered, re-entrant, and never latched by a raising
+    callback."""
+
+    def test_raising_delivery_does_not_latch_the_drainer(self):
+        from client_tpu.resilience import _SerialDeliverer
+
+        d = _SerialDeliverer()
+        delivered = []
+        with pytest.raises(RuntimeError):
+            d.post(lambda: (_ for _ in ()).throw(RuntimeError("boom")))
+        # the deliverer must have unlatched: later posts still deliver
+        d.post(lambda: delivered.append("after"))
+        assert delivered == ["after"]
+
+    def test_reentrant_post_delivers_in_order(self):
+        from client_tpu.resilience import _SerialDeliverer
+
+        d = _SerialDeliverer()
+        delivered = []
+
+        def first():
+            delivered.append("first")
+            d.post(lambda: delivered.append("nested"))  # from inside
+
+        d.post(first)
+        d.post(lambda: delivered.append("second"))
+        assert delivered == ["first", "nested", "second"]
+
+    def test_accept_vetoes_stale_delivery(self):
+        from client_tpu.resilience import _SerialDeliverer
+
+        d = _SerialDeliverer()
+        delivered = []
+        d.post(lambda: delivered.append("kept"), accept=lambda: True)
+        d.post(lambda: delivered.append("dropped"), accept=lambda: False)
+        assert delivered == ["kept"]
+
+
 # -- scenario 1+2: delay and error-then-succeed over HTTP -------------------
 
 
