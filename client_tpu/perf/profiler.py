@@ -410,8 +410,10 @@ class InferenceProfiler:
         while r <= end:
             self.manager.change_request_rate(r)
             before = self._server_stats()
+            before_ens = self._ensemble_stats()
             status = self.profile_level("request_rate", r)
             status.server_stats = self._server_stats_delta(before)
+            status.ensemble_stats = self._ensemble_stats_delta(before_ens)
             results.append(status)
             if latency_limit_us and status.latency_us(
                 self.percentile

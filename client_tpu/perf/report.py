@@ -73,11 +73,19 @@ def print_summary(results, percentile=None):
                     "usec"
                 )
         for name, counters in sorted(s.ensemble_stats.items()):
+            # full per-stage phase split (mirrors the reference's ensemble
+            # recursion in ReportWriter): each composing model's queue and
+            # compute breakdown under its own name, so a pipeline's slow
+            # stage is visible straight from the sweep output
             cnt = max(counters.get("success_count", 0), 1)
-            infer_us = counters.get("compute_infer_ns", 0) / cnt / 1e3
+            parts = []
+            for phase in ("queue", "compute_input", "compute_infer",
+                          "compute_output"):
+                ns = counters.get(f"{phase}_ns", 0)
+                parts.append(f"{phase} {ns / cnt / 1e3:.0f}")
             print(
                 f"  Composing model {name}: {counters.get('success_count', 0)}"
-                f" exec, avg compute {infer_us:.0f} usec"
+                f" exec, avg usec/request: {', '.join(parts)}"
             )
         print()
     if results:
@@ -104,6 +112,11 @@ def write_csv(path, results, verbose=False):
             "Server Queue", "Server Compute Input", "Server Compute Infer",
             "Server Compute Output", "Server Cache Hits",
         ]
+    # ensemble targets: one queue/compute column pair per composing model
+    # (the reference appends per-composing columns the same way)
+    composing = sorted({n for s in results for n in s.ensemble_stats})
+    for name in composing:
+        fields += [f"Ensemble {name} Queue", f"Ensemble {name} Compute"]
     gauges = sorted({g for s in results for g in s.tpu_metrics})
     for gauge in gauges:
         fields += [f"{gauge} (avg)", f"{gauge} (max)"]
@@ -134,6 +147,16 @@ def write_csv(path, results, verbose=False):
                     f"{srv.get('compute_infer_ns', 0) / cnt / 1e3:.0f}",
                     f"{srv.get('compute_output_ns', 0) / cnt / 1e3:.0f}",
                     str(srv.get("cache_hit_count", 0)),
+                ]
+            for name in composing:
+                counters = s.ensemble_stats.get(name)
+                if not counters:
+                    row += ["", ""]
+                    continue
+                cnt = max(counters.get("success_count", 0), 1)
+                row += [
+                    f"{counters.get('queue_ns', 0) / cnt / 1e3:.0f}",
+                    f"{counters.get('compute_infer_ns', 0) / cnt / 1e3:.0f}",
                 ]
             for gauge in gauges:
                 agg = s.tpu_metrics.get(gauge)

@@ -195,7 +195,7 @@ def ensemble_model():
 
 
 def default_models():
-    return [
+    models = [
         simple_model(),
         simple_string_model(),
         identity_model(),
@@ -208,3 +208,15 @@ def default_models():
         classification_model(),
         ensemble_model(),
     ]
+    # vision pipeline (preprocess -> resnet backbone -> postprocess DAG):
+    # the hermetic tiny variant — jax-backed composing models whose
+    # intermediates stay device-resident between steps (serve/pipeline.py).
+    # Parameters initialize on first forward, so building the set stays
+    # cheap; a jax-less install keeps the numpy-only builtin set instead
+    # of failing at startup (the module above imports jax).
+    try:
+        from client_tpu.serve.models.vision import vision_pipeline_models
+    except ImportError:
+        return models
+    models.extend(vision_pipeline_models())
+    return models

@@ -149,6 +149,8 @@ class Model:
         self.file_overrides = {}
         # optional resource-release hook, called by InferenceEngine.close()
         self.closer = None
+        # validated ensemble DAG (serve/pipeline.py), built at add/load time
+        self._dag = None
 
     def metadata(self):
         return {
@@ -723,6 +725,7 @@ class InferenceEngine:
         self._ready = {}
         self._stats = {}
         self._batchers = {}
+        self._pipeline = None  # lazy ensemble DAG scheduler
         # Admission control: cap on concurrently executing requests (None =
         # unbounded).  Work beyond the cap is rejected retryably (503).
         self.max_inflight = max_inflight
@@ -771,7 +774,46 @@ class InferenceEngine:
     # repository -----------------------------------------------------------
 
     def add_model(self, model, ready=True):
+        from client_tpu.serve.pipeline import build_dag
+
+        # Validation and installation are ONE critical section: a DAG
+        # validated against a repository snapshot that can mutate before
+        # the install would let a concurrent add/load leave a READY
+        # ensemble whose DAG describes a since-replaced composing model.
+        # build_dag is pure spec walking — nothing blocks under the lock.
         with self._lock:
+            known = dict(self._models)
+            known[model.name] = model
+            if model.ensemble_steps:
+                # Ensembles validate at ADD time (cycles, unknown composing
+                # models, unmapped/dangling tensors, dtype/shape
+                # mismatches, sequence/decoupled composing models) -> 400
+                # here, never a surprise at infer time.  Composing models
+                # must already be in the repository.
+                model._dag = build_dag(model, known.get)
+            # A swap must not leave a loaded ensemble silently broken:
+            # every ready ensemble composing over this name revalidates
+            # against the replacement.  A compatible swap refreshes the
+            # dependent's DAG; an incompatible one marks the dependent NOT
+            # READY (infer gets the engine's clean 400, and reloading it
+            # surfaces the real mismatch via load_model's revalidation) —
+            # never wrong-typed bytes on the wire.  Direct dependents
+            # only: an ensemble's own declared specs don't change unless
+            # it is itself re-added.
+            for n, dep in self._models.items():
+                if (
+                    n == model.name or not dep.ensemble_steps
+                    or not self._ready.get(n)
+                    or all(
+                        s.get("model_name") != model.name
+                        for s in dep.ensemble_steps
+                    )
+                ):
+                    continue
+                try:
+                    dep._dag = build_dag(dep, known.get)
+                except InferenceServerException:
+                    self._ready[n] = False
             self._models[model.name] = model
             self._ready[model.name] = ready
             self._stats.setdefault(model.name, ModelStats())
@@ -782,6 +824,15 @@ class InferenceEngine:
         self._invalidate_cache()
         if model.dynamic_batching and model.warmup:
             self._batcher_for(model).warmup(model.inputs)
+
+    def _model_lookup(self, extra=None):
+        """Name -> Model resolver over the current repository snapshot (the
+        model being added rides along so self-reference is detectable)."""
+        with self._lock:
+            known = dict(self._models)
+        if extra is not None:
+            known[extra.name] = extra
+        return known.get
 
     def _invalidate_cache(self):
         """Repository mutations (add/load/unload) drop the whole response
@@ -817,6 +868,8 @@ class InferenceEngine:
             )
 
     def load_model(self, name, config_override=None, files=None):
+        from client_tpu.serve.pipeline import build_dag
+
         with self._lock:
             if name not in self._models:
                 raise InferenceServerException(
@@ -828,6 +881,12 @@ class InferenceEngine:
                     status="400",
                 )
             model = self._models[name]
+            if model.ensemble_steps:
+                # revalidate against the CURRENT repository (composing
+                # models may have been swapped since add): a broken
+                # ensemble fails the load with a 400 and is not marked
+                # ready.  Atomic with the ready flip — see add_model.
+                model._dag = build_dag(model, dict(self._models).get)
             model.config_override = config_override
             model.file_overrides = files or {}
             self._ready[name] = True
@@ -1218,7 +1277,16 @@ class InferenceEngine:
                     trace.event("QUEUE_END", w_in0)
                     trace.event("COMPUTE_START", w_in0)
                     trace.event("COMPUTE_INPUT_END", w_in1)
-                result = self._run_ensemble(model, inputs)
+                # DAG scheduler (serve/pipeline.py): concurrent independent
+                # steps, per-step spans/stats, device-resident intermediates.
+                # Request params (minus ensemble-reserved keys) thread
+                # through to every composing model.  work_ns — the summed
+                # per-step durations — is recorded as the ensemble's
+                # compute_infer so composing stats reconcile with ensemble
+                # totals in the statistics extension.
+                result, work_ns = self._pipeline_runner().run(
+                    model, inputs, params, trace=trace, tenant=tenant
+                )
                 t_inf1 = time.monotonic_ns()
                 if trace is not None:
                     trace.event("COMPUTE_OUTPUT_START")
@@ -1229,7 +1297,7 @@ class InferenceEngine:
                 if trace is not None:
                     trace.event("COMPUTE_END")
                 stats.record(
-                    True, t1 - t0, t_inf1 - t_in1, t_in1 - t_in0, t1 - t_inf1,
+                    True, t1 - t0, work_ns, t_in1 - t_in0, t1 - t_inf1,
                     batch=_batch_of(model, request),
                 )
                 return rendered
@@ -1368,58 +1436,16 @@ class InferenceEngine:
             if not recorded:  # abandoned mid-stream (GeneratorExit/GC)
                 stats.record(False, time.monotonic_ns() - t0, 0, 0, 0)
 
-    def _run_ensemble(self, model, inputs):
-        """Chain composing models per ensemble_scheduling (the reference's
-        ensemble scheduler): a tensor pool flows ensemble inputs through each
-        step's input_map/output_map.  Each composing model's statistics are
-        recorded under its own name, so clients (and the perf profiler's
-        ensemble recursion) see per-composing-model queue/compute durations.
-        """
-        pool = dict(inputs)
-        for step in model.ensemble_steps:
-            sub = self.get_model(step["model_name"], "")
-            try:
-                sub_inputs = {
-                    ci: pool[et] for ci, et in step["input_map"].items()
-                }
-            except KeyError as e:
-                raise InferenceServerException(
-                    f"ensemble '{model.name}' step '{sub.name}': tensor "
-                    f"{e} not produced by any earlier step", status="400",
-                )
-            sub_stats = self._stats[sub.name]
-            st0 = time.monotonic_ns()
-            try:
-                if sub.ensemble_steps:  # nested ensemble: recurse
-                    out = self._run_ensemble(sub, sub_inputs)
-                else:
-                    with self.busy:
-                        out = sub.fn(sub_inputs, {}, None)
-            except InferenceServerException:
-                sub_stats.record(False, time.monotonic_ns() - st0, 0, 0, 0)
-                raise
-            except Exception as e:
-                sub_stats.record(False, time.monotonic_ns() - st0, 0, 0, 0)
-                raise InferenceServerException(
-                    f"ensemble '{model.name}' step '{sub.name}' failed: {e}",
-                    status="500", debug_details=e,
-                ) from e
-            st1 = time.monotonic_ns()
-            sub_stats.record(True, st1 - st0, st1 - st0, 0, 0)
-            for co, et in step["output_map"].items():
-                if co not in out:
-                    raise InferenceServerException(
-                        f"ensemble '{model.name}' step '{sub.name}' produced "
-                        f"no output '{co}'", status="500",
-                    )
-                pool[et] = out[co]
-        missing = [t.name for t in model.outputs if t.name not in pool]
-        if missing:
-            raise InferenceServerException(
-                f"ensemble '{model.name}' produced no tensor(s) {missing}",
-                status="500",
-            )
-        return {t.name: pool[t.name] for t in model.outputs}
+    def _pipeline_runner(self):
+        """The engine's ensemble DAG scheduler (one per engine, stateless
+        across requests — see serve/pipeline.PipelineRunner)."""
+        runner = self._pipeline
+        if runner is None:
+            from client_tpu.serve.pipeline import PipelineRunner
+
+            runner = PipelineRunner(self)
+            self._pipeline = runner
+        return runner
 
     def _batcher_for(self, model):
         with self._lock:

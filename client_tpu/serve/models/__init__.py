@@ -1,9 +1,11 @@
 """JAX/TPU model zoo for the in-process server (flagship models).
 
-``model_sets("builtin,jax,resnet,language")`` is the single set-name resolver
-used by the serve and perf CLIs; ``jax_models()`` is the small-CNN vision set
-used by bench.py, ``resnet_models()`` the resnet50 of BASELINE config 3, and
-``language_models()`` the tokenizer→streaming-LM stack of BASELINE config 5.
+``model_sets("builtin,jax,resnet,language,pipeline")`` is the single set-name
+resolver used by the serve and perf CLIs; ``jax_models()`` is the small-CNN
+vision set used by bench.py, ``resnet_models()`` the resnet50 of BASELINE
+config 3, ``language_models()`` the tokenizer→streaming-LM stack of BASELINE
+config 5, and ``pipeline_models()`` the full-size vision ensemble DAG
+(preprocess → resnet50 backbone → classification postprocess).
 """
 
 from client_tpu.utils import InferenceServerException
@@ -24,8 +26,23 @@ def language_models():
     return _lm()
 
 
+def pipeline_models(warmup=False):
+    """Full-size vision pipeline (224px resnet50 backbone): the ensemble
+    DAG acceptance workload at serving scale."""
+    from client_tpu.serve.models.vision import (
+        _RESNET50_STAGES,
+        vision_pipeline_models,
+    )
+
+    return vision_pipeline_models(
+        image_size=224, stages=_RESNET50_STAGES, num_classes=1000,
+        max_batch_size=64, warmup=warmup,
+    )
+
+
 def model_sets(names):
-    """Resolve a comma-separated set list (builtin,jax,resnet,language)."""
+    """Resolve a comma-separated set list
+    (builtin,jax,resnet,language,pipeline)."""
     from client_tpu.serve.builtins import default_models
 
     loaders = {
@@ -33,6 +50,7 @@ def model_sets(names):
         "jax": jax_models,
         "resnet": resnet_models,
         "language": language_models,
+        "pipeline": pipeline_models,
     }
     models = []
     for name in names.split(","):

@@ -186,12 +186,16 @@ def _bottleneck(block, x, stride):
     return jax.nn.relu(h + skip)
 
 
-def _resnet_forward(params, x, stage_strides=None):
+def _resnet_features(params, x, stage_strides=None):
+    """Backbone half: image -> pooled feature vector (the head applies in
+    _resnet_head).  Split out so the vision *pipeline* can serve the
+    backbone and the classification head as separate composing models with
+    the feature tensor staying device-resident between them."""
     # strides are structural (static under jit tracing), not pytree leaves —
     # conv window_strides must be concrete.  Custom-`stages` params need a
     # matching stage_strides; the default follows _RESNET50_STAGES.
     strides = stage_strides or tuple(s for _, _, s in _RESNET50_STAGES)
-    # x: [N, 3, H, W] float32 -> scores [N, num_classes] float32
+    # x: [N, 3, H, W] float32 -> features [N, C] bfloat16
     h = x.astype(jnp.bfloat16)
     h = jax.nn.relu(_conv(h, params["stem"], stride=2) * params["stem_scale"])
     h = jax.lax.reduce_window(
@@ -203,8 +207,21 @@ def _resnet_forward(params, x, stage_strides=None):
     for si, blocks in enumerate(params["stages"]):
         for bi, block in enumerate(blocks):
             h = _bottleneck(block, h, strides[si] if bi == 0 else 1)
-    h = jnp.mean(h, axis=(2, 3))
-    return (h @ params["head_w"] + params["head_b"]).astype(jnp.float32)
+    return jnp.mean(h, axis=(2, 3))
+
+
+def _resnet_head(params, h):
+    """Classification head over pooled features -> float32 scores."""
+    return (
+        h.astype(jnp.bfloat16) @ params["head_w"] + params["head_b"]
+    ).astype(jnp.float32)
+
+
+def _resnet_forward(params, x, stage_strides=None):
+    # x: [N, 3, H, W] float32 -> scores [N, num_classes] float32
+    return _resnet_head(
+        params, _resnet_features(params, x, stage_strides=stage_strides)
+    )
 
 
 def resnet50_flops_per_image(image_size=224, in_ch=3,
@@ -249,6 +266,165 @@ class ResNet50Classifier:
     def __call__(self, inputs, params, ctx):
         x = jnp.asarray(inputs["INPUT0"])
         return {"OUTPUT0": self._forward(self.params, x)}
+
+
+# ---------------------------------------------------------------------------
+# Vision pipeline (ensemble acceptance workload, serve/pipeline.py):
+# preprocess -> resnet backbone -> classification postprocess, all jax-backed
+# so every intermediate tensor stays in device HBM between steps — the DAG
+# scheduler hands the jax.Array straight to the next composing model with
+# zero host round-trips (asserted via ctpu_ensemble_host_hops_total).
+# ---------------------------------------------------------------------------
+
+# Tiny stage geometry for the hermetic default-model variant: ~0.4M params,
+# compiles in well under a second on CPU.  Full-size callers pass
+# stages=_RESNET50_STAGES.
+_TINY_STAGES = ((16, 1, 1), (32, 1, 2))
+
+_IMAGENET_MEAN = (0.485, 0.456, 0.406)
+_IMAGENET_STD = (0.229, 0.224, 0.225)
+
+
+def _preprocess_forward(x):
+    """uint8 NHWC image batch -> normalized float32 NCHW pixels."""
+    x = x.astype(jnp.float32) / 255.0
+    x = jnp.transpose(x, (0, 3, 1, 2))
+    mean = jnp.asarray(_IMAGENET_MEAN, jnp.float32).reshape(1, 3, 1, 1)
+    std = jnp.asarray(_IMAGENET_STD, jnp.float32).reshape(1, 3, 1, 1)
+    return (x - mean) / std
+
+
+class _VisionPipelineRunners:
+    """Shared lazy state behind the pipeline's composing models: one resnet
+    parameter tree (backbone stages + classification head) initialized on
+    first use so constructing the default model set stays cheap."""
+
+    def __init__(self, image_size, stages, num_classes, seed=0):
+        self.image_size = image_size
+        self.stages = tuple(stages)
+        self.num_classes = num_classes
+        self.seed = seed
+        self.feature_dim = self.stages[-1][0] * 4
+        self._params = None  # init is idempotent; racing first calls agree
+        self._pre = jax.jit(_preprocess_forward)
+        strides = tuple(s for _, _, s in self.stages)
+        self._features = jax.jit(
+            functools.partial(_resnet_features, stage_strides=strides)
+        )
+        self._head = jax.jit(_resnet_head)
+
+    def _ensure(self):
+        params = self._params
+        if params is None:
+            params = _init_resnet_params(
+                jax.random.PRNGKey(self.seed),
+                num_classes=self.num_classes,
+                stages=self.stages,
+            )
+            self._params = params
+        return params
+
+    def preprocess(self, inputs, params, ctx):
+        return {"PIXELS": self._pre(jnp.asarray(inputs["IMAGE"]))}
+
+    def backbone(self, inputs, params, ctx):
+        # jnp.asarray is a no-op for the device-resident PIXELS handoff;
+        # the float32 cast honors the FEATURES spec and stays on device
+        return {
+            "FEATURES": self._features(
+                self._ensure(), jnp.asarray(inputs["PIXELS"])
+            ).astype(jnp.float32)
+        }
+
+    def postprocess(self, inputs, params, ctx):
+        scores = self._head(self._ensure(), jnp.asarray(inputs["FEATURES"]))
+        return {"SCORES": jax.nn.softmax(scores, axis=-1)}
+
+
+def vision_pipeline_models(
+    image_size=32,
+    stages=_TINY_STAGES,
+    num_classes=16,
+    max_batch_size=32,
+    warmup=False,
+    prefix="vision",
+):
+    """The vision-pipeline model family: three jax-backed composing models
+    plus the ensemble wiring them into a DAG.
+
+    - ``{prefix}_preprocess``: UINT8 NHWC image -> normalized FP32 NCHW
+      (direct dispatch: trivially cheap, and its jitted output is already a
+      device array, which puts the backbone step on the batcher's device
+      path).
+    - ``{prefix}_backbone``: resnet features, dynamic batching + fused
+      device groups — concurrent pipeline requests fuse into real MXU
+      batches mid-DAG.
+    - ``{prefix}_postprocess``: classification head + softmax, labels
+      attached for the classification extension.
+    - ``{prefix}_pipeline``: the ensemble (IMAGE -> SCORES).
+
+    Defaults are the hermetic tiny variant served by the builtin model set;
+    bench passes ``image_size=224, stages=_RESNET50_STAGES,
+    num_classes=1000`` for the full resnet50-backed pipeline.
+    """
+    runners = _VisionPipelineRunners(image_size, stages, num_classes)
+    labels = [f"class_{i}" for i in range(num_classes)]
+    feat = runners.feature_dim
+    preprocess = Model(
+        f"{prefix}_preprocess",
+        inputs=[TensorSpec("IMAGE", "UINT8", [-1, image_size, image_size, 3])],
+        outputs=[TensorSpec("PIXELS", "FP32", [-1, 3, image_size, image_size])],
+        fn=runners.preprocess,
+        platform="jax",
+        backend="jax",
+        max_batch_size=max_batch_size,
+    )
+    backbone = Model(
+        f"{prefix}_backbone",
+        inputs=[TensorSpec("PIXELS", "FP32", [-1, 3, image_size, image_size])],
+        outputs=[TensorSpec("FEATURES", "FP32", [-1, feat])],
+        fn=runners.backbone,
+        platform="jax",
+        backend="jax",
+        max_batch_size=max_batch_size,
+        dynamic_batching=True,
+        batch_device_inputs=True,
+        warmup=warmup,
+    )
+    postprocess = Model(
+        f"{prefix}_postprocess",
+        inputs=[TensorSpec("FEATURES", "FP32", [-1, feat])],
+        outputs=[TensorSpec("SCORES", "FP32", [-1, num_classes], labels=labels)],
+        fn=runners.postprocess,
+        platform="jax",
+        backend="jax",
+        max_batch_size=max_batch_size,
+    )
+    pipeline = Model(
+        f"{prefix}_pipeline",
+        inputs=[TensorSpec("IMAGE", "UINT8", [-1, image_size, image_size, 3])],
+        outputs=[TensorSpec("SCORES", "FP32", [-1, num_classes], labels=labels)],
+        fn=None,
+        platform="ensemble",
+        ensemble_steps=[
+            {
+                "model_name": f"{prefix}_preprocess",
+                "input_map": {"IMAGE": "IMAGE"},
+                "output_map": {"PIXELS": "pixels"},
+            },
+            {
+                "model_name": f"{prefix}_backbone",
+                "input_map": {"PIXELS": "pixels"},
+                "output_map": {"FEATURES": "features"},
+            },
+            {
+                "model_name": f"{prefix}_postprocess",
+                "input_map": {"FEATURES": "features"},
+                "output_map": {"SCORES": "SCORES"},
+            },
+        ],
+    )
+    return [preprocess, backbone, postprocess, pipeline]
 
 
 def resnet50_model(

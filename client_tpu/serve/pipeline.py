@@ -1,0 +1,719 @@
+"""Ensemble DAG scheduler: server-side model pipelines with device-resident
+intermediates.
+
+The reference treats ensembles as a first-class scheduler kind (ModelParser
+NONE/DYNAMIC/SEQUENCE/ENSEMBLE/ENSEMBLE_SEQUENCE, per-composing-model stats
+in InferenceProfiler/ReportWriter — SURVEY §2.3-2.5).  This module is that
+scheduler for the in-process engine, replacing the old strictly-sequential
+``_run_ensemble`` chain:
+
+- **Parse + validate at load time** (:func:`build_dag`): ``ensemble_scheduling``
+  steps become an explicit dependency DAG over ensemble tensors.  Cycles,
+  unknown composing models, unmapped composing inputs, dangling tensors,
+  producer/consumer dtype (and comparable-rank shape) mismatches, and
+  composing models we cannot honor (sequence-stateful, decoupled) are all
+  rejected with a 400 when the ensemble is *added or loaded* — not at the
+  first unlucky infer.
+
+- **Concurrent ready steps** (:class:`PipelineRunner`): independent branches
+  run in parallel (the builtin ``simple_ensemble``'s two identity branches
+  used to run serially); pure chains keep the zero-thread sequential path.
+
+- **The normal scheduling path per step**: a step is dispatched exactly like
+  a direct request to the composing model — through the model's dynamic
+  batcher when the request is batchable (so ensemble steps from concurrent
+  requests fuse into real device batches and wait in the per-tenant fair
+  queue), directly otherwise — and records real per-composing-model
+  statistics plus QUEUE_*/COMPUTE_* events on a per-step child span tagged
+  with the step and ensemble names.
+
+- **Device-resident intermediates**: when producer and consumer steps are
+  both jax-backed, the ``jax.Array`` is handed off without a host
+  round-trip — the place where the measured tpushm-vs-sysshm advantage
+  compounds across a pipeline.  Host materialization happens only for
+  python-platform consumers (counted in ``ctpu_ensemble_host_hops_total``)
+  and at the DAG boundary when the response is rendered.
+
+- **Failure semantics**: a failing step cancels every not-yet-started step,
+  the error names the failing step, and the composing model's failure plus
+  the ensemble-level failure are each recorded exactly once.  A composing
+  model unloaded mid-flight surfaces as the engine's clean 400, never a
+  hang.  Nested ensembles recurse through this same scheduler.
+"""
+
+import queue
+import threading
+import time
+
+import numpy as np
+
+from client_tpu.serve.tracing import RequestTrace
+from client_tpu.tracing import gen_span_id
+from client_tpu.utils import InferenceServerException
+
+__all__ = [
+    "ENSEMBLE_RESERVED_PARAMS",
+    "EnsembleDag",
+    "PipelineRunner",
+    "build_dag",
+]
+
+# Request parameters that configure the *ensemble* request itself and must
+# not leak into composing-model executions: sequence identity binds to the
+# ensemble (composing sequence models are rejected at load), rendering hints
+# apply only to the ensemble's own response, and decoupled-completion
+# markers have no meaning mid-DAG.  Everything else (model-defined params
+# like temperature/seed) threads through to every step.
+ENSEMBLE_RESERVED_PARAMS = frozenset(
+    {
+        "sequence_id",
+        "sequence_start",
+        "sequence_end",
+        "binary_data_output",
+        "triton_enable_empty_final_response",
+        "priority",
+        "timeout",
+    }
+)
+
+
+def step_params(params):
+    """Request parameters forwarded to composing models (reserved keys
+    stripped) — the fix for ensemble steps silently running with ``{}``."""
+    return {
+        k: v for k, v in (params or {}).items()
+        if k not in ENSEMBLE_RESERVED_PARAMS
+    }
+
+
+def is_jax_model(model):
+    """Whether a composing model consumes device arrays natively (its fn is
+    jax-backed), so an upstream ``jax.Array`` hands off with zero host I/O."""
+    platform = getattr(model, "platform", "") or ""
+    backend = getattr(model, "backend", "") or ""
+    return platform.startswith("jax") or backend.startswith("jax")
+
+
+def _is_device_array(arr):
+    from client_tpu.serve.dynamic_batcher import _is_device_array as _impl
+
+    return _impl(arr)
+
+
+class _Step:
+    """One parsed ensemble step and its resolved dependencies."""
+
+    __slots__ = ("index", "model_name", "input_map", "output_map", "deps",
+                 "consumers")
+
+    def __init__(self, index, model_name, input_map, output_map):
+        self.index = index
+        self.model_name = model_name
+        self.input_map = dict(input_map)    # composing input <- ensemble tensor
+        self.output_map = dict(output_map)  # composing output -> ensemble tensor
+        self.deps = set()        # step indices whose outputs this step reads
+        self.consumers = set()   # step indices reading this step's outputs
+
+    @property
+    def label(self):
+        return f"step_{self.index}:{self.model_name}"
+
+
+class EnsembleDag:
+    """Validated dependency DAG for one ensemble model."""
+
+    __slots__ = ("model_name", "steps", "is_chain", "order", "produced")
+
+    def __init__(self, model_name, steps, is_chain, order, produced):
+        self.model_name = model_name
+        self.steps = steps
+        self.is_chain = is_chain
+        self.order = order        # step indices in topological order
+        self.produced = produced  # ensemble tensors produced by steps
+
+
+def _reject(ensemble_name, message):
+    raise InferenceServerException(
+        f"ensemble '{ensemble_name}': {message}", status="400"
+    )
+
+
+def _spec_maps(model):
+    inputs = {t.name: t for t in model.inputs}
+    outputs = {t.name: t for t in model.outputs}
+    return inputs, outputs
+
+
+def _shapes_conflict(src_dims, dst_dims):
+    """True when two equal-rank specs pin conflicting fixed dims.  Specs of
+    different rank are not comparable here — models like the builtin
+    ``identity`` declare ``[-1]`` meaning "any shape"."""
+    if len(src_dims) != len(dst_dims):
+        return False
+    return any(
+        s >= 0 and d >= 0 and s != d for s, d in zip(src_dims, dst_dims)
+    )
+
+
+def build_dag(model, lookup):
+    """Parse + validate *model*'s ensemble_scheduling into an EnsembleDag.
+
+    *lookup* maps a model name to its Model (or None).  Raises a 400
+    InferenceServerException on any structural problem so the ensemble is
+    rejected at add/load time, never at infer time.
+    """
+    name = model.name
+    if not model.ensemble_steps:
+        _reject(name, "ensemble_scheduling has no steps")
+    ens_inputs, ens_outputs = _spec_maps(model)
+
+    steps = []
+    producer = {}        # ensemble tensor -> producing step index
+    produced_spec = {}   # ensemble tensor -> composing output TensorSpec
+    for i, raw in enumerate(model.ensemble_steps):
+        sub_name = raw.get("model_name")
+        if not sub_name:
+            _reject(name, f"step {i} has no model_name")
+        step = _Step(i, sub_name, raw.get("input_map") or {},
+                     raw.get("output_map") or {})
+        if sub_name == name:
+            _reject(name, f"step {i} refers to the ensemble itself")
+        sub = lookup(sub_name)
+        if sub is None:
+            _reject(
+                name,
+                f"step {i} names unknown composing model '{sub_name}'",
+            )
+        if getattr(sub, "stateful", False):
+            _reject(
+                name,
+                f"step {i}: composing model '{sub_name}' uses sequence "
+                "batching; ENSEMBLE over sequence models is not supported "
+                "(sequence state binds to the composing model, not the "
+                "ensemble request)",
+            )
+        if getattr(sub, "decoupled", False):
+            _reject(
+                name,
+                f"step {i}: composing model '{sub_name}' is decoupled; "
+                "a mid-DAG response stream cannot be honored",
+            )
+        sub_inputs, sub_outputs = _spec_maps(sub)
+        for ci in step.input_map:
+            if ci not in sub_inputs:
+                _reject(
+                    name,
+                    f"step {i} input_map names '{ci}', which is not an "
+                    f"input of composing model '{sub_name}'",
+                )
+        missing = [
+            t.name for t in sub.inputs
+            if t.name not in step.input_map and not t.optional
+        ]
+        if missing:
+            _reject(
+                name,
+                f"step {i} leaves composing model '{sub_name}' inputs "
+                f"{missing} unmapped",
+            )
+        for co, et in step.output_map.items():
+            if co not in sub_outputs:
+                _reject(
+                    name,
+                    f"step {i} output_map names '{co}', which is not an "
+                    f"output of composing model '{sub_name}'",
+                )
+            if et in producer:
+                _reject(
+                    name,
+                    f"tensor '{et}' is produced by both step "
+                    f"{producer[et]} and step {i}",
+                )
+            if et in ens_inputs:
+                _reject(
+                    name,
+                    f"step {i} produces tensor '{et}', which shadows an "
+                    "ensemble input",
+                )
+            producer[et] = i
+            produced_spec[et] = sub_outputs[co]
+        steps.append(step)
+
+    # Resolve each step input to its source (ensemble input or producing
+    # step) and check dtype/shape agreement producer -> consumer.
+    for step in steps:
+        sub = lookup(step.model_name)
+        sub_inputs, _ = _spec_maps(sub)
+        for ci, et in step.input_map.items():
+            dst = sub_inputs[ci]
+            if et in ens_inputs:
+                src = ens_inputs[et]
+            elif et in producer:
+                if producer[et] == step.index:
+                    _reject(
+                        name,
+                        f"step {step.index} reads its own output tensor "
+                        f"'{et}' (self-cycle)",
+                    )
+                step.deps.add(producer[et])
+                src = produced_spec[et]
+            else:
+                _reject(
+                    name,
+                    f"step {step.index} reads tensor '{et}', which is "
+                    "neither an ensemble input nor produced by any step "
+                    "(dangling tensor)",
+                )
+            if src.datatype != dst.datatype:
+                _reject(
+                    name,
+                    f"step {step.index} input '{ci}' expects "
+                    f"{dst.datatype} but tensor '{et}' carries "
+                    f"{src.datatype}",
+                )
+            if _shapes_conflict(src.dims, dst.dims):
+                _reject(
+                    name,
+                    f"step {step.index} input '{ci}' dims {dst.dims} "
+                    f"conflict with tensor '{et}' dims {src.dims}",
+                )
+    for step in steps:
+        for d in step.deps:
+            steps[d].consumers.add(step.index)
+
+    # Every ensemble output must be produced (or be a pass-through input),
+    # with matching dtype.
+    for out_name, spec in ens_outputs.items():
+        if out_name in ens_inputs:
+            src = ens_inputs[out_name]
+        elif out_name in producer:
+            src = produced_spec[out_name]
+        else:
+            _reject(
+                name,
+                f"output tensor '{out_name}' is not produced by any step",
+            )
+        if src.datatype != spec.datatype:
+            _reject(
+                name,
+                f"output tensor '{out_name}' is declared {spec.datatype} "
+                f"but its producer carries {src.datatype}",
+            )
+        if _shapes_conflict(src.dims, spec.dims):
+            _reject(
+                name,
+                f"output tensor '{out_name}' dims {spec.dims} conflict "
+                f"with its producer's dims {src.dims}",
+            )
+
+    # Kahn topological check: leftover steps form a cycle.  The same walk
+    # detects whether the DAG is a pure chain (at most one step ready at
+    # any point) — chains skip the threaded scheduler entirely.
+    indegree = {s.index: len(s.deps) for s in steps}
+    ready = sorted(i for i, d in indegree.items() if d == 0)
+    scheduled = []
+    is_chain = True
+    while ready:
+        if len(ready) > 1:
+            is_chain = False
+        i = ready.pop(0)
+        scheduled.append(i)
+        for c in sorted(steps[i].consumers):
+            indegree[c] -= 1
+            if indegree[c] == 0:
+                ready.append(c)
+    if len(scheduled) != len(steps):
+        stuck = sorted(set(indegree) - set(scheduled))
+        _reject(
+            name,
+            "ensemble_scheduling steps "
+            f"{[steps[i].label for i in stuck]} form a dependency cycle",
+        )
+    return EnsembleDag(name, steps, is_chain, scheduled, frozenset(producer))
+
+
+class _StepOutcome:
+    __slots__ = ("index", "outputs", "error", "work_ns")
+
+    def __init__(self, index, outputs=None, error=None, work_ns=0):
+        self.index = index
+        self.outputs = outputs
+        self.error = error
+        self.work_ns = work_ns
+
+
+class PipelineRunner:
+    """Executes validated ensemble DAGs against an InferenceEngine.
+
+    One runner per engine; all state is per-call, so concurrent requests
+    share it freely.  Steps ride each composing model's normal scheduling
+    path (dynamic batcher or direct dispatch) — this class only sequences
+    them and moves tensors between steps.
+    """
+
+    def __init__(self, engine):
+        self._engine = engine
+
+    # -- public entry --------------------------------------------------------
+
+    def run(self, model, inputs, params, trace=None, tenant=""):
+        """Execute *model*'s DAG over *inputs*; returns
+        ``(outputs, work_ns)`` where *outputs* maps the ensemble's declared
+        output tensors and *work_ns* is the summed per-step duration — the
+        exact quantity recorded as the ensemble's ``compute_infer`` so
+        per-composing-model statistics reconcile against ensemble totals.
+        """
+        dag = getattr(model, "_dag", None)
+        if dag is None:
+            # engine-level callers always validate at add/load; a model
+            # handed in by other means validates here, same 400 contract
+            dag = build_dag(model, self._engine._model_lookup())
+            model._dag = dag
+        metrics = self._engine.metrics
+        metrics.inc(
+            "ctpu_ensemble_requests_total", {"model": model.name},
+            help_="Requests executed by the ensemble DAG scheduler",
+        )
+        forwarded = step_params(params)
+        pool = dict(inputs)
+        if dag.is_chain:
+            work_ns = self._run_chain(model, dag, pool, forwarded, trace,
+                                      tenant)
+        else:
+            work_ns = self._run_parallel(model, dag, pool, forwarded, trace,
+                                         tenant)
+        missing = [t.name for t in model.outputs if t.name not in pool]
+        if missing:
+            raise InferenceServerException(
+                f"ensemble '{model.name}' produced no tensor(s) {missing}",
+                status="500",
+            )
+        return {t.name: pool[t.name] for t in model.outputs}, work_ns
+
+    # -- schedulers ----------------------------------------------------------
+
+    def _run_chain(self, model, dag, pool, forwarded, trace, tenant):
+        """Sequential path for pure chains: no threads, no queue."""
+        work_ns = 0
+        for position, index in enumerate(dag.order):
+            outcome = self._run_step(model, dag, dag.steps[index], pool,
+                                     forwarded, trace, tenant)
+            work_ns += outcome.work_ns
+            if outcome.error is not None:
+                self._note_cancelled(model, len(dag.steps) - position - 1)
+                raise outcome.error
+            pool.update(outcome.outputs)
+        return work_ns
+
+    def _run_parallel(self, model, dag, pool, forwarded, trace, tenant):
+        """Event-driven scheduler: every ready step dispatches immediately
+        on its own worker thread; completions release their consumers.  On
+        a step failure nothing new dispatches (the cancellation contract) —
+        already-running steps are drained so no worker outlives the call.
+        """
+        done = queue.Queue()
+        pool_lock = threading.Lock()
+        indegree = {s.index: len(s.deps) for s in dag.steps}
+        ready = [dag.steps[i] for i, d in sorted(indegree.items()) if d == 0]
+        inflight = 0
+        executed = 0
+        failures = 0
+        failure = None
+        work_ns = 0
+
+        def worker(step):
+            with pool_lock:
+                snapshot = dict(pool)
+            try:
+                outcome = self._run_step(model, dag, step, snapshot,
+                                         forwarded, trace, tenant)
+            except BaseException as e:  # noqa: BLE001 - thread boundary:
+                # the worker must always post exactly one outcome or the
+                # coordinator hangs on done.get()
+                outcome = _StepOutcome(
+                    step.index, error=self._step_error(model, step, e)
+                )
+            done.put(outcome)
+
+        while ready or inflight:
+            if len(ready) == 1 and not inflight:
+                # a lone ready step with nothing to overlap runs directly
+                # on the calling thread — chain-shaped stretches of a wide
+                # DAG spawn no threads, and without the worker's
+                # thread-boundary net KeyboardInterrupt/SystemExit
+                # propagate exactly like the chain path (no snapshot
+                # either: nothing in flight can mutate the pool)
+                done.put(self._run_step(model, dag, ready.pop(), pool,
+                                        forwarded, trace, tenant))
+                inflight += 1
+            else:
+                # thread-per-ready-step, deliberately not a shared bounded
+                # pool: steps block on the batcher (and nested ensembles
+                # dispatch steps of their own), so a finite pool could
+                # deadlock parent steps waiting on children with no slot.
+                # Per-wave thread churn (~100us/step) is noise next to
+                # batcher queue+dispatch time.
+                for step in ready:
+                    t = threading.Thread(
+                        target=worker, args=(step,), daemon=True,
+                        name=f"ensemble-{model.name}-{step.label}",
+                    )
+                    try:
+                        t.start()
+                    except RuntimeError:
+                        # thread limit hit: degrade to inline execution
+                        worker(step)
+                    inflight += 1
+                ready = []
+            # bounded: every dispatched worker always posts exactly one
+            # outcome, success or failure
+            outcome = done.get()
+            inflight -= 1
+            work_ns += outcome.work_ns
+            if outcome.error is not None:
+                failures += 1
+                if failure is None:
+                    failure = outcome.error
+                continue  # drain remaining in-flight steps, dispatch nothing
+            executed += 1
+            with pool_lock:
+                pool.update(outcome.outputs)
+            if failure is None:
+                for c in sorted(dag.steps[outcome.index].consumers):
+                    indegree[c] -= 1
+                    if indegree[c] == 0:
+                        ready.append(dag.steps[c])
+        if failure is not None:
+            # dispatched steps all posted (executed + failures); the rest
+            # were never dispatched
+            self._note_cancelled(
+                model, len(dag.steps) - executed - failures
+            )
+            raise failure
+        return work_ns
+
+    def _note_cancelled(self, model, count):
+        if count > 0:
+            self._engine.metrics.inc(
+                "ctpu_ensemble_cancelled_steps_total",
+                {"model": model.name}, value=count,
+                help_="DAG steps never dispatched because an earlier step "
+                      "failed",
+            )
+
+    # -- one step ------------------------------------------------------------
+
+    def _run_step(self, ens, dag, step, pool, forwarded, trace, tenant):
+        """Execute one step; failures come back in the outcome so the
+        schedulers control cancellation uniformly.  Only ``Exception`` is
+        converted — KeyboardInterrupt/SystemExit propagate (the parallel
+        scheduler's worker adds its own thread-boundary net)."""
+        engine = self._engine
+        metrics = engine.metrics
+        t0 = time.monotonic_ns()
+        step_trace = self._step_span(trace, ens, step)
+        try:
+            # repository lookup per dispatch: a composing model unloaded
+            # mid-flight fails THIS step with the engine's clean 400
+            sub = engine.get_model(step.model_name, "")
+            sub_inputs, hops, handoffs = self._map_inputs(
+                step, sub, pool, dag.produced
+            )
+            t_in1 = time.monotonic_ns()
+            if hops:
+                metrics.inc(
+                    "ctpu_ensemble_host_hops_total", {"model": ens.name},
+                    value=hops,
+                    help_="Device intermediates materialized to host for a "
+                          "non-jax consumer step",
+                )
+            if handoffs:
+                metrics.inc(
+                    "ctpu_ensemble_device_handoffs_total",
+                    {"model": ens.name}, value=handoffs,
+                    help_="Device intermediates handed to a jax-backed "
+                          "consumer step with zero host I/O",
+                )
+            out, total_ns = self._dispatch(
+                ens, step, sub, sub_inputs, forwarded, step_trace, tenant,
+                t0, t_in1,
+            )
+            outputs = {}
+            for co, et in step.output_map.items():
+                if co not in out:
+                    raise InferenceServerException(
+                        f"composing model '{sub.name}' produced no output "
+                        f"'{co}'", status="500",
+                    )
+                outputs[et] = out[co]
+            metrics.inc(
+                "ctpu_ensemble_steps_total",
+                {"model": ens.name, "composing_model": step.model_name},
+                help_="Ensemble DAG steps executed",
+            )
+            if step_trace is not None:
+                engine.tracer.complete(step_trace)
+            return _StepOutcome(step.index, outputs=outputs,
+                                work_ns=total_ns)
+        except Exception as e:
+            metrics.inc(
+                "ctpu_ensemble_step_failures_total",
+                {"model": ens.name, "composing_model": step.model_name},
+                help_="Ensemble DAG steps that failed",
+            )
+            err = self._step_error(ens, step, e)
+            if step_trace is not None:
+                step_trace.error = err.message()
+                engine.tracer.complete(step_trace)
+            return _StepOutcome(
+                step.index, error=err, work_ns=time.monotonic_ns() - t0
+            )
+
+    def _dispatch(self, ens, step, sub, sub_inputs, forwarded, step_trace,
+                  tenant, t0, t_in1):
+        """Route one step through the composing model's normal scheduling
+        path and record its statistics under the composing model's name.
+        Returns ``(result_arrays, total_ns)``; *total_ns* is exactly what
+        lands in the composing model's success duration, so summed step
+        durations reconcile with the ensemble's compute_infer total."""
+        engine = self._engine
+        sub_stats = engine._stats[sub.name]
+        if sub.ensemble_steps:  # nested ensemble: recurse, record its stats
+            try:
+                out, work_ns = self.run(
+                    sub, sub_inputs, forwarded, trace=step_trace,
+                    tenant=tenant,
+                )
+            except BaseException:
+                sub_stats.record(False, time.monotonic_ns() - t0, 0, 0, 0)
+                raise
+            total_ns = time.monotonic_ns() - t0
+            sub_stats.record(
+                True, total_ns, work_ns, t_in1 - t0, 0,
+                batch=_rows_of(sub, sub_inputs),
+            )
+            return out, total_ns
+        try:
+            if self._batchable(sub, sub_inputs, forwarded):
+                weight = (
+                    engine.qos.weight(tenant)
+                    if engine.qos is not None else 1.0
+                )
+                # the batcher stamps QUEUE_END/COMPUTE_* on the step span at
+                # dispatch/completion and records execution-level stats
+                # (queue/compute split) under the composing model's name
+                out = engine._batcher_for(sub).submit(
+                    sub_inputs, trace=step_trace, tenant=tenant,
+                    weight=weight,
+                )
+                total_ns = time.monotonic_ns() - t0
+                sub_stats.record_request_success(total_ns)
+                return out, total_ns
+            if step_trace is not None:
+                w_now = time.time_ns()
+                step_trace.event("QUEUE_END", w_now)
+                step_trace.event("COMPUTE_START", w_now)
+                step_trace.event("COMPUTE_INPUT_END")
+            with engine.busy:
+                out = sub.fn(sub_inputs, forwarded, None)
+            t_inf1 = time.monotonic_ns()
+            if step_trace is not None:
+                step_trace.event("COMPUTE_END")
+            t_end = time.monotonic_ns()
+            total_ns = t_end - t0
+            # real phase split (the old chain stuffed the whole step into
+            # infer_ns): input = tensor mapping/residency conversion,
+            # infer = the model call, output = the output-map fanout
+            sub_stats.record(
+                True, total_ns, t_inf1 - t_in1, t_in1 - t0, t_end - t_inf1,
+                batch=_rows_of(sub, sub_inputs),
+            )
+            return out, total_ns
+        except BaseException:
+            sub_stats.record(False, time.monotonic_ns() - t0, 0, 0, 0)
+            raise
+
+    @staticmethod
+    def _batchable(sub, sub_inputs, forwarded):
+        from client_tpu.serve.dynamic_batcher import batchable_request
+
+        return batchable_request(sub, sub_inputs, forwarded, None, {})
+
+    def _map_inputs(self, step, sub, pool, produced):
+        """Composing-model inputs from the tensor pool, honoring residency:
+        jax-backed consumers take device arrays as-is (zero host I/O);
+        python consumers get host arrays.  Only *intermediates* — tensors
+        produced by an upstream step — count toward the handoff/hop
+        metrics; an ensemble boundary input arriving as a device array
+        (tpushm) is not a hop the pipeline saved or spent."""
+        jax_backed = is_jax_model(sub)
+        sub_inputs = {}
+        hops = 0
+        handoffs = 0
+        for ci, et in step.input_map.items():
+            try:
+                arr = pool[et]
+            except KeyError:
+                raise InferenceServerException(
+                    f"tensor '{et}' not available for step "
+                    f"'{step.model_name}'", status="500",
+                ) from None
+            if _is_device_array(arr):
+                if jax_backed:
+                    handoffs += et in produced
+                else:
+                    arr = np.asarray(arr)  # host materialization
+                    hops += et in produced
+            sub_inputs[ci] = arr
+        return sub_inputs, hops, handoffs
+
+    @staticmethod
+    def _step_span(trace, ens, step):
+        """A child span for one step under the request's trace (None when
+        the request was not sampled).  Tagged with the step label and the
+        owning ensemble so per-branch timelines read straight off the
+        trace file."""
+        if trace is None:
+            return None
+        span = RequestTrace(
+            trace.trace_id,
+            gen_span_id(),
+            parent_span_id=trace.span_id,
+            model_name=step.model_name,
+            model_version="",
+            protocol=getattr(trace, "protocol", ""),
+            seq=getattr(trace, "seq", 0),
+            step=step.label,
+            ensemble=ens.name,
+        )
+        span.tenant = getattr(trace, "tenant", "")
+        span.event("QUEUE_START")
+        return span
+
+    @staticmethod
+    def _step_error(ens, step, exc):
+        if isinstance(exc, InferenceServerException):
+            message = exc.message() or str(exc)
+            if message.startswith(f"ensemble '{ens.name}' step"):
+                return exc  # already named by a nested level
+            return InferenceServerException(
+                f"ensemble '{ens.name}' step {step.index} "
+                f"('{step.model_name}') failed: {message}",
+                status=exc.status() or "500",
+                debug_details=exc.debug_details(),
+            )
+        return InferenceServerException(
+            f"ensemble '{ens.name}' step {step.index} "
+            f"('{step.model_name}') failed: {exc}",
+            status="500", debug_details=exc,
+        )
+
+
+def _rows_of(model, inputs):
+    if getattr(model, "max_batch_size", 0) <= 0:
+        return 1
+    for arr in inputs.values():
+        shape = getattr(arr, "shape", None)
+        if shape:
+            return int(shape[0])
+    return 1

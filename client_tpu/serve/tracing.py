@@ -104,7 +104,8 @@ class RequestTrace(_SpanBase):
     trace id when the request carried a traceparent)."""
 
     def __init__(self, trace_id, span_id, parent_span_id=None,
-                 model_name="", model_version="", protocol="", seq=0):
+                 model_name="", model_version="", protocol="", seq=0,
+                 step="", ensemble=""):
         super().__init__(trace_id, span_id, model_name)
         self.parent_span_id = parent_span_id
         self.model_version = model_version
@@ -113,6 +114,11 @@ class RequestTrace(_SpanBase):
         # tenant identity (x-tenant-id header/metadata), stamped by the
         # engine so per-tenant latency can be split straight from traces
         self.tenant = ""
+        # ensemble step tags (serve/pipeline.py): one child span per DAG
+        # step, tagged with the step label and the owning ensemble so
+        # branch overlap reads straight off the exported timeline
+        self.step = step
+        self.ensemble = ensemble
 
     def traceparent(self):
         return format_traceparent(self.trace_id, self.span_id)
@@ -131,6 +137,11 @@ class RequestTrace(_SpanBase):
         }
         if self.tenant:
             record["tenant"] = self.tenant
+        if self.step:
+            record["step"] = self.step
+            record["composing_model"] = self.model_name
+        if self.ensemble:
+            record["ensemble"] = self.ensemble
         if self.error:
             record["error"] = self.error
         return record

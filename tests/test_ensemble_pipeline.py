@@ -1,0 +1,736 @@
+"""Ensemble DAG scheduler (serve/pipeline.py) acceptance suite.
+
+Covers the ISSUE-8 contract:
+
+- load-time validation: cycles, unknown composing models, unmapped and
+  dangling tensors, dtype/shape mismatches, duplicate producers,
+  sequence-stateful and decoupled composing models — all 400 at
+  ``add_model``/``load_model``, never at infer time,
+- parallel-branch concurrency proven BOTH by wall clock and by
+  overlapping per-step trace spans,
+- nested ensembles recursing through the same scheduler,
+- mid-DAG step failure: the rest of the DAG is cancelled, the error
+  names the failing step, per-step and ensemble-level failures each
+  record exactly once,
+- request-params threading to composing models (ensemble-reserved keys
+  stripped),
+- device-resident intermediates: a jax-backed consumer receives the
+  producer's ``jax.Array`` (no ``np.asarray`` host hop), a python
+  consumer gets a host array and the hop is counted,
+- per-composing-model stats reconciling exactly against the ensemble's
+  own ``compute_infer`` total,
+- chaos: a composing model unloaded mid-flight surfaces as a clean 4xx
+  with no hang.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from client_tpu.serve.model_runtime import InferenceEngine, Model, TensorSpec
+from client_tpu.serve.pipeline import (
+    ENSEMBLE_RESERVED_PARAMS,
+    build_dag,
+    step_params,
+)
+from client_tpu.tracing import read_trace_file
+from client_tpu.utils import InferenceServerException
+
+
+def _identity(name, dtype="INT32", sleep_s=0.0, record=None, fail=False,
+              on_call=None, **model_kwargs):
+    """A configurable one-in/one-out python model for DAG shapes."""
+
+    def fn(inputs, params, ctx):
+        if record is not None:
+            record.append((name, dict(params or {}), time.monotonic()))
+        if on_call is not None:
+            on_call()
+        if sleep_s:
+            time.sleep(sleep_s)
+        if fail:
+            raise InferenceServerException(f"{name} exploded", status="500")
+        return {"OUT": inputs["IN"]}
+
+    return Model(
+        name,
+        inputs=[TensorSpec("IN", dtype, [-1])],
+        outputs=[TensorSpec("OUT", dtype, [-1])],
+        fn=fn,
+        **model_kwargs,
+    )
+
+
+def _ensemble(name, steps, in_dtype="INT32", out_names=("OUT",),
+              out_dtype=None, in_names=("IN",)):
+    return Model(
+        name,
+        inputs=[TensorSpec(n, in_dtype, [-1]) for n in in_names],
+        outputs=[TensorSpec(n, out_dtype or in_dtype, [-1])
+                 for n in out_names],
+        fn=None,
+        platform="ensemble",
+        ensemble_steps=steps,
+    )
+
+
+def _step(model, inp, out):
+    return {"model_name": model, "input_map": inp, "output_map": out}
+
+
+def _infer(engine, name, arrays, params=None):
+    request = {
+        "id": "t",
+        "inputs": [
+            {"name": n, "shape": list(a.shape), "datatype": dt,
+             "data": a.flatten().tolist()}
+            for n, dt, a in arrays
+        ],
+    }
+    if params:
+        request["parameters"] = dict(params)
+    response, _ = engine.execute(name, "", request, b"")
+    return {o["name"]: np.array(o["data"]).reshape(o["shape"])
+            for o in response["outputs"]}
+
+
+def _inference_stats(engine, name):
+    return engine.statistics(name)[0]["inference_stats"]
+
+
+# -- load-time validation ----------------------------------------------------
+
+
+class TestValidation:
+    def _reject(self, models, ensemble, match):
+        engine = InferenceEngine(models)
+        try:
+            with pytest.raises(InferenceServerException, match=match) as ei:
+                engine.add_model(ensemble)
+            assert ei.value.status() == "400"
+        finally:
+            engine.close()
+
+    def test_unknown_composing_model_rejected_at_add(self):
+        self._reject(
+            [_identity("a")],
+            _ensemble("e", [_step("ghost", {"IN": "IN"}, {"OUT": "OUT"})]),
+            match="unknown composing model 'ghost'",
+        )
+
+    def test_cycle_rejected_at_add(self):
+        steps = [
+            _step("a", {"IN": "t2"}, {"OUT": "t1"}),
+            _step("b", {"IN": "t1"}, {"OUT": "t2"}),
+        ]
+        # t1/t2 feed each other; OUT passes through neither -> make OUT
+        # produced so only the cycle trips
+        steps.append(_step("a", {"IN": "IN"}, {"OUT": "OUT"}))
+        self._reject(
+            [_identity("a"), _identity("b")],
+            _ensemble("e", steps),
+            match="dependency cycle",
+        )
+
+    def test_dangling_tensor_rejected_at_add(self):
+        self._reject(
+            [_identity("a")],
+            _ensemble("e", [_step("a", {"IN": "nowhere"}, {"OUT": "OUT"})]),
+            match="dangling tensor",
+        )
+
+    def test_unmapped_composing_input_rejected_at_add(self):
+        self._reject(
+            [_identity("a")],
+            _ensemble("e", [_step("a", {}, {"OUT": "OUT"})]),
+            match="unmapped",
+        )
+
+    def test_dtype_mismatch_rejected_at_add(self):
+        self._reject(
+            [_identity("a", dtype="INT32"), _identity("b", dtype="FP32")],
+            _ensemble("e", [
+                _step("a", {"IN": "IN"}, {"OUT": "mid"}),
+                _step("b", {"IN": "mid"}, {"OUT": "OUT"}),
+            ], out_dtype="FP32"),
+            match="expects FP32 but tensor 'mid' carries INT32",
+        )
+
+    def test_shape_conflict_rejected_at_add(self):
+        wide = Model(
+            "wide",
+            inputs=[TensorSpec("IN", "INT32", [-1, 8])],
+            outputs=[TensorSpec("OUT", "INT32", [-1, 8])],
+            fn=lambda i, p, c: {"OUT": i["IN"]},
+        )
+        narrow = Model(
+            "narrow",
+            inputs=[TensorSpec("IN", "INT32", [-1, 4])],
+            outputs=[TensorSpec("OUT", "INT32", [-1, 4])],
+            fn=lambda i, p, c: {"OUT": i["IN"]},
+        )
+        ens = Model(
+            "e",
+            inputs=[TensorSpec("IN", "INT32", [-1, 8])],
+            outputs=[TensorSpec("OUT", "INT32", [-1, 4])],
+            fn=None,
+            platform="ensemble",
+            ensemble_steps=[
+                _step("wide", {"IN": "IN"}, {"OUT": "mid"}),
+                _step("narrow", {"IN": "mid"}, {"OUT": "OUT"}),
+            ],
+        )
+        self._reject([wide, narrow], ens, match="conflict with tensor 'mid'")
+
+    def test_duplicate_producer_rejected_at_add(self):
+        self._reject(
+            [_identity("a")],
+            _ensemble("e", [
+                _step("a", {"IN": "IN"}, {"OUT": "OUT"}),
+                _step("a", {"IN": "IN"}, {"OUT": "OUT"}),
+            ]),
+            match="produced by both step 0 and step 1",
+        )
+
+    def test_unproduced_output_rejected_at_add(self):
+        self._reject(
+            [_identity("a")],
+            _ensemble("e", [_step("a", {"IN": "IN"}, {"OUT": "mid"})]),
+            match="output tensor 'OUT' is not produced",
+        )
+
+    def test_self_reference_rejected_at_add(self):
+        self._reject(
+            [_identity("a")],
+            _ensemble("e", [_step("e", {"IN": "IN"}, {"OUT": "OUT"})]),
+            match="refers to the ensemble itself",
+        )
+
+    def test_self_cycle_rejected_at_add(self):
+        # a step reading its own output is a one-step cycle Kahn never
+        # sees (the dep edge would be skipped) — it must still be a 400
+        # at add, not an infer-time 500 "tensor not available"
+        self._reject(
+            [_identity("a")],
+            _ensemble("e", [_step("a", {"IN": "t"}, {"OUT": "t"}),
+                            _step("a", {"IN": "IN"}, {"OUT": "OUT"})]),
+            match="reads its own output tensor 't'",
+        )
+
+    def test_sequence_composing_model_rejected_at_add(self):
+        self._reject(
+            [_identity("seq", stateful=True)],
+            _ensemble("e", [_step("seq", {"IN": "IN"}, {"OUT": "OUT"})]),
+            match="sequence",
+        )
+
+    def test_decoupled_composing_model_rejected_at_add(self):
+        self._reject(
+            [_identity("dec", decoupled=True)],
+            _ensemble("e", [_step("dec", {"IN": "IN"}, {"OUT": "OUT"})]),
+            match="decoupled",
+        )
+
+    def test_load_revalidates_against_current_repository(self):
+        """A composing model swapped for an incompatible one after add must
+        fail the ensemble's *load* with a 400, not the next infer."""
+        engine = InferenceEngine([_identity("a", dtype="INT32")])
+        try:
+            engine.add_model(_ensemble(
+                "e", [_step("a", {"IN": "IN"}, {"OUT": "OUT"})]
+            ))
+            engine.add_model(_identity("a", dtype="FP32"))  # swap in place
+            with pytest.raises(InferenceServerException) as ei:
+                engine.load_model("e")
+            assert ei.value.status() == "400"
+            assert "expects FP32 but tensor 'IN' carries INT32" in str(
+                ei.value
+            )
+        finally:
+            engine.close()
+
+    def test_incompatible_swap_unloads_dependent_ensemble(self):
+        """add_model of an incompatible composing-model replacement must
+        not leave the loaded ensemble serving stale-typed responses: the
+        dependent goes NOT READY (clean 400 at infer), and reloading it
+        names the real mismatch."""
+        engine = InferenceEngine([_identity("a", dtype="INT32")])
+        try:
+            engine.add_model(_ensemble(
+                "e", [_step("a", {"IN": "IN"}, {"OUT": "OUT"})]
+            ))
+            assert engine.model_ready("e")
+            engine.add_model(_identity("a", dtype="FP32"))  # breaking swap
+            assert not engine.model_ready("e")
+            with pytest.raises(InferenceServerException) as ei:
+                _infer(engine, "e",
+                       [("IN", "INT32", np.arange(4, dtype=np.int32))])
+            assert ei.value.status() == "400"
+            with pytest.raises(InferenceServerException,
+                               match="expects FP32"):
+                engine.load_model("e")
+        finally:
+            engine.close()
+
+    def test_compatible_swap_keeps_dependent_ensemble_ready(self):
+        engine = InferenceEngine([_identity("a", dtype="INT32")])
+        try:
+            engine.add_model(_ensemble(
+                "e", [_step("a", {"IN": "IN"}, {"OUT": "OUT"})]
+            ))
+            engine.add_model(_identity("a", dtype="INT32"))  # same specs
+            assert engine.model_ready("e")
+            x = np.arange(4, dtype=np.int32)
+            out = _infer(engine, "e", [("IN", "INT32", x)])
+            np.testing.assert_array_equal(out["OUT"], x)
+        finally:
+            engine.close()
+
+    def test_valid_dag_computes_deps(self):
+        a = _identity("a")
+        b = _identity("b")
+        ens = _ensemble("e", [
+            _step("a", {"IN": "IN"}, {"OUT": "mid"}),
+            _step("b", {"IN": "mid"}, {"OUT": "OUT"}),
+        ])
+        dag = build_dag(ens, {"a": a, "b": b}.get)
+        assert dag.is_chain
+        assert dag.steps[1].deps == {0}
+        assert dag.steps[0].consumers == {1}
+
+    def test_parallel_branches_not_a_chain(self):
+        a = _identity("a")
+        ens = _ensemble("e", [
+            _step("a", {"IN": "IN"}, {"OUT": "OUT"}),
+            _step("a", {"IN": "IN"}, {"OUT": "OUT1"}),
+        ], out_names=("OUT", "OUT1"))
+        dag = build_dag(ens, {"a": a}.get)
+        assert not dag.is_chain
+
+
+# -- execution ---------------------------------------------------------------
+
+
+class TestExecution:
+    def test_builtin_simple_ensemble_results(self):
+        from client_tpu.serve.builtins import (
+            ensemble_model,
+            identity_model,
+            simple_model,
+        )
+
+        engine = InferenceEngine(
+            [simple_model(), identity_model("identity_int32", "INT32"),
+             ensemble_model()]
+        )
+        try:
+            i0 = np.arange(16, dtype=np.int32).reshape(1, 16)
+            i1 = np.full((1, 16), 4, dtype=np.int32)
+            out = _infer(engine, "simple_ensemble", [
+                ("INPUT0", "INT32", i0), ("INPUT1", "INT32", i1),
+            ])
+            np.testing.assert_array_equal(out["OUTPUT0"], i0 + i1)
+            np.testing.assert_array_equal(out["OUTPUT1"], i0 - i1)
+        finally:
+            engine.close()
+
+    def test_parallel_branches_overlap(self, tmp_path):
+        """Two independent 0.15 s branches: wall clock shows overlap AND
+        the per-step trace spans overlap in time (the acceptance proof)."""
+        trace_file = str(tmp_path / "trace.jsonl")
+        engine = InferenceEngine([
+            _identity("slow_a", sleep_s=0.15),
+            _identity("slow_b", sleep_s=0.15),
+        ])
+        try:
+            engine.add_model(_ensemble("fork", [
+                _step("slow_a", {"IN": "IN"}, {"OUT": "OUT"}),
+                _step("slow_b", {"IN": "IN"}, {"OUT": "OUT1"}),
+            ], out_names=("OUT", "OUT1")))
+            engine.update_trace_settings({
+                "trace_level": ["TIMESTAMPS"], "trace_rate": "1",
+                "trace_count": "-1", "trace_file": trace_file,
+            })
+            trace = engine.tracer.sample(None, model_name="fork",
+                                         protocol="test")
+            trace.event("REQUEST_START")
+            x = np.arange(8, dtype=np.int32)
+            t0 = time.monotonic()
+            request = {"id": "t", "inputs": [
+                {"name": "IN", "shape": [8], "datatype": "INT32",
+                 "data": x.tolist()}]}
+            engine.execute("fork", "", request, b"", trace=trace)
+            wall = time.monotonic() - t0
+            engine.tracer.complete(trace)
+            # serial would be >= 0.30s; overlapped is ~0.15s
+            assert wall < 0.26, f"branches ran serially ({wall:.3f}s)"
+
+            spans = {
+                r["step"]: {t["name"]: t["ns"] for t in r["timestamps"]}
+                for r in read_trace_file(trace_file) if r.get("step")
+            }
+            assert set(spans) == {"step_0:slow_a", "step_1:slow_b"}
+            a, b = spans["step_0:slow_a"], spans["step_1:slow_b"]
+            overlap_start = max(a["COMPUTE_START"], b["COMPUTE_START"])
+            overlap_end = min(a["COMPUTE_END"], b["COMPUTE_END"])
+            assert overlap_end > overlap_start, "step spans do not overlap"
+            ensembles = {
+                r["ensemble"] for r in read_trace_file(trace_file)
+                if r.get("step")
+            }
+            assert ensembles == {"fork"}
+        finally:
+            engine.close()
+
+    def test_nested_ensemble_recurses(self):
+        engine = InferenceEngine([_identity("leaf")])
+        try:
+            engine.add_model(_ensemble(
+                "inner", [_step("leaf", {"IN": "IN"}, {"OUT": "OUT"})]
+            ))
+            engine.add_model(_ensemble(
+                "outer", [
+                    _step("inner", {"IN": "IN"}, {"OUT": "mid"}),
+                    _step("leaf", {"IN": "mid"}, {"OUT": "OUT"}),
+                ]
+            ))
+            x = np.arange(6, dtype=np.int32)
+            out = _infer(engine, "outer", [("IN", "INT32", x)])
+            np.testing.assert_array_equal(out["OUT"], x)
+            # the nested ensemble and the leaf both recorded real stats:
+            # leaf ran twice (once under inner, once directly)
+            assert _inference_stats(engine, "inner")["success"]["count"] == 1
+            assert _inference_stats(engine, "leaf")["success"]["count"] == 2
+        finally:
+            engine.close()
+
+    def test_request_params_thread_to_composing_models(self):
+        seen = []
+        engine = InferenceEngine([_identity("a", record=seen)])
+        try:
+            engine.add_model(_ensemble(
+                "e", [_step("a", {"IN": "IN"}, {"OUT": "OUT"})]
+            ))
+            x = np.arange(4, dtype=np.int32)
+            _infer(engine, "e", [("IN", "INT32", x)],
+                   params={"temperature": 0.5, "timeout": 10,
+                           "priority": 3})
+            (_, params, _), = seen
+            assert params.get("temperature") == 0.5
+            # ensemble-reserved keys never reach composing models
+            assert not (set(params) & ENSEMBLE_RESERVED_PARAMS)
+        finally:
+            engine.close()
+
+    def test_step_params_strips_only_reserved_keys(self):
+        params = {"sequence_id": 9, "timeout": 1, "seed": 7}
+        assert step_params(params) == {"seed": 7}
+        assert step_params(None) == {}
+
+    def test_mid_dag_failure_cancels_rest_and_names_step(self):
+        ran = []
+        engine = InferenceEngine([
+            _identity("ok", record=ran),
+            _identity("boom", fail=True),
+            _identity("never", record=ran),
+        ])
+        try:
+            engine.add_model(_ensemble("chain", [
+                _step("ok", {"IN": "IN"}, {"OUT": "t1"}),
+                _step("boom", {"IN": "t1"}, {"OUT": "t2"}),
+                _step("never", {"IN": "t2"}, {"OUT": "OUT"}),
+            ]))
+            x = np.arange(4, dtype=np.int32)
+            with pytest.raises(InferenceServerException) as ei:
+                _infer(engine, "chain", [("IN", "INT32", x)])
+            msg = str(ei.value)
+            assert "step 1" in msg and "'boom'" in msg
+            assert ei.value.status() == "500"
+            assert [n for n, _, _ in ran] == ["ok"], "step after failure ran"
+            # cancellation is visible in metrics and per-model stats
+            assert engine.metrics.get(
+                "ctpu_ensemble_cancelled_steps_total", {"model": "chain"}
+            ) == 1
+            assert _inference_stats(engine, "never")["success"]["count"] == 0
+            # the composing failure AND the ensemble-level failure each
+            # recorded exactly once (the old double-raise skew)
+            assert _inference_stats(engine, "boom")["fail"]["count"] == 1
+            assert _inference_stats(engine, "chain")["fail"]["count"] == 1
+        finally:
+            engine.close()
+
+    def test_parallel_branch_failure_does_not_hang(self):
+        engine = InferenceEngine([
+            _identity("slow", sleep_s=0.2),
+            _identity("boom", fail=True),
+        ])
+        try:
+            engine.add_model(_ensemble("fork", [
+                _step("slow", {"IN": "IN"}, {"OUT": "OUT"}),
+                _step("boom", {"IN": "IN"}, {"OUT": "OUT1"}),
+            ], out_names=("OUT", "OUT1")))
+            x = np.arange(4, dtype=np.int32)
+            t0 = time.monotonic()
+            with pytest.raises(InferenceServerException, match="'boom'"):
+                _infer(engine, "fork", [("IN", "INT32", x)])
+            # in-flight branch drained, nothing hangs
+            assert time.monotonic() - t0 < 2.0
+        finally:
+            engine.close()
+
+    def test_missing_composing_output_is_500_naming_step(self):
+        broken = Model(
+            "half",
+            inputs=[TensorSpec("IN", "INT32", [-1])],
+            outputs=[TensorSpec("OUT", "INT32", [-1])],
+            fn=lambda i, p, c: {},  # declares OUT, produces nothing
+        )
+        engine = InferenceEngine([broken])
+        try:
+            engine.add_model(_ensemble(
+                "e", [_step("half", {"IN": "IN"}, {"OUT": "OUT"})]
+            ))
+            with pytest.raises(InferenceServerException) as ei:
+                _infer(engine, "e", [("IN", "INT32",
+                                      np.arange(2, dtype=np.int32))])
+            assert ei.value.status() == "500"
+            assert "produced no output 'OUT'" in str(ei.value)
+        finally:
+            engine.close()
+
+    def test_composing_model_unloaded_mid_flight_clean_4xx(self):
+        """Chaos case: the second step's model is unloaded while the first
+        step runs — the request fails promptly with the engine's 400."""
+        engine = InferenceEngine([_identity("b")])
+        gate = threading.Event()
+
+        def unload_b():
+            engine.unload_model("b")
+            gate.set()
+
+        engine.add_model(_identity("a", on_call=unload_b))
+        try:
+            engine.add_model(_ensemble("chain", [
+                _step("a", {"IN": "IN"}, {"OUT": "mid"}),
+                _step("b", {"IN": "mid"}, {"OUT": "OUT"}),
+            ]))
+            t0 = time.monotonic()
+            with pytest.raises(InferenceServerException) as ei:
+                _infer(engine, "chain", [("IN", "INT32",
+                                          np.arange(2, dtype=np.int32))])
+            assert gate.is_set()
+            assert time.monotonic() - t0 < 2.0, "unload mid-flight hung"
+            assert ei.value.status() == "400"
+            assert "step 1" in str(ei.value) and "'b'" in str(ei.value)
+        finally:
+            engine.close()
+
+
+# -- statistics / metrics reconciliation -------------------------------------
+
+
+class TestStatsReconcile:
+    def test_composing_durations_sum_to_ensemble_compute_infer(self):
+        engine = InferenceEngine([_identity("a"), _identity("b")])
+        try:
+            engine.add_model(_ensemble("e", [
+                _step("a", {"IN": "IN"}, {"OUT": "mid"}),
+                _step("b", {"IN": "mid"}, {"OUT": "OUT"}),
+            ]))
+            x = np.arange(8, dtype=np.int32)
+            for _ in range(3):
+                _infer(engine, "e", [("IN", "INT32", x)])
+            ens = _inference_stats(engine, "e")
+            total = sum(
+                _inference_stats(engine, n)["success"]["ns"]
+                for n in ("a", "b")
+            )
+            assert ens["success"]["count"] == 3
+            assert ens["compute_infer"]["ns"] == total
+        finally:
+            engine.close()
+
+    def test_step_stats_have_real_phase_split(self):
+        """The old chain stuffed the whole step into infer_ns with zero
+        input/output split; the scheduler records a real one."""
+        engine = InferenceEngine([_identity("a")])
+        try:
+            engine.add_model(_ensemble(
+                "e", [_step("a", {"IN": "IN"}, {"OUT": "OUT"})]
+            ))
+            _infer(engine, "e", [("IN", "INT32",
+                                  np.arange(64, dtype=np.int32))])
+            sub = _inference_stats(engine, "a")
+            assert sub["compute_input"]["ns"] > 0
+            assert sub["compute_infer"]["ns"] > 0
+            assert sub["success"]["ns"] >= (
+                sub["compute_input"]["ns"] + sub["compute_infer"]["ns"]
+            )
+        finally:
+            engine.close()
+
+    def test_ensemble_metric_series(self):
+        engine = InferenceEngine([_identity("a"), _identity("b")])
+        try:
+            engine.add_model(_ensemble("e", [
+                _step("a", {"IN": "IN"}, {"OUT": "mid"}),
+                _step("b", {"IN": "mid"}, {"OUT": "OUT"}),
+            ]))
+            x = np.arange(4, dtype=np.int32)
+            _infer(engine, "e", [("IN", "INT32", x)])
+            m = engine.metrics
+            assert m.get("ctpu_ensemble_requests_total",
+                         {"model": "e"}) == 1
+            assert m.get("ctpu_ensemble_steps_total",
+                         {"model": "e", "composing_model": "a"}) == 1
+            assert m.get("ctpu_ensemble_steps_total",
+                         {"model": "e", "composing_model": "b"}) == 1
+        finally:
+            engine.close()
+
+    def test_batched_composing_model_records_queue_stats(self):
+        """A dynamic-batching composing model rides its batcher from the
+        pipeline: executions land under its own name with queue counts."""
+        engine = InferenceEngine([
+            _identity("batched", max_batch_size=8, dynamic_batching=True),
+        ])
+        try:
+            engine.add_model(Model(
+                "e",
+                inputs=[TensorSpec("IN", "INT32", [-1, 4])],
+                outputs=[TensorSpec("OUT", "INT32", [-1, 4])],
+                fn=None,
+                platform="ensemble",
+                ensemble_steps=[_step("batched", {"IN": "IN"},
+                                      {"OUT": "OUT"})],
+            ))
+            x = np.arange(4, dtype=np.int32).reshape(1, 4)
+            _infer(engine, "e", [("IN", "INT32", x)])
+            sub = _inference_stats(engine, "batched")
+            assert sub["success"]["count"] == 1
+            assert sub["queue"]["count"] >= 1
+        finally:
+            engine.close()
+
+
+# -- device residency (jax) --------------------------------------------------
+
+
+class TestDeviceResidency:
+    def test_jax_consumer_receives_device_array(self):
+        """Between two jax-backed steps the intermediate is handed off as a
+        jax.Array — no np.asarray host hop (asserted inside the consumer)."""
+        import jax
+        import jax.numpy as jnp
+
+        received = []
+
+        def producer_fn(inputs, params, ctx):
+            return {"OUT": jnp.asarray(np.asarray(inputs["IN"])) * 2}
+
+        def consumer_fn(inputs, params, ctx):
+            received.append(type(inputs["IN"]))
+            assert isinstance(inputs["IN"], jax.Array), (
+                "device intermediate was materialized to host"
+            )
+            return {"OUT": inputs["IN"] + 1}
+
+        producer = Model(
+            "producer",
+            inputs=[TensorSpec("IN", "FP32", [-1])],
+            outputs=[TensorSpec("OUT", "FP32", [-1])],
+            fn=producer_fn, platform="jax", backend="jax",
+        )
+        consumer = Model(
+            "consumer",
+            inputs=[TensorSpec("IN", "FP32", [-1])],
+            outputs=[TensorSpec("OUT", "FP32", [-1])],
+            fn=consumer_fn, platform="jax", backend="jax",
+        )
+        engine = InferenceEngine([producer, consumer])
+        try:
+            engine.add_model(_ensemble("e", [
+                _step("producer", {"IN": "IN"}, {"OUT": "mid"}),
+                _step("consumer", {"IN": "mid"}, {"OUT": "OUT"}),
+            ], in_dtype="FP32"))
+            x = np.arange(4, dtype=np.float32)
+            out = _infer(engine, "e", [("IN", "FP32", x)])
+            np.testing.assert_allclose(out["OUT"], x * 2 + 1)
+            assert received, "consumer never ran"
+            assert engine.metrics.get(
+                "ctpu_ensemble_device_handoffs_total", {"model": "e"}
+            ) == 1
+            assert not engine.metrics.get(
+                "ctpu_ensemble_host_hops_total", {"model": "e"}
+            )
+        finally:
+            engine.close()
+
+    def test_python_consumer_gets_host_array_and_hop_is_counted(self):
+        import jax.numpy as jnp
+
+        def producer_fn(inputs, params, ctx):
+            return {"OUT": jnp.asarray(np.asarray(inputs["IN"]))}
+
+        def consumer_fn(inputs, params, ctx):
+            assert isinstance(inputs["IN"], np.ndarray)
+            return {"OUT": inputs["IN"]}
+
+        producer = Model(
+            "producer",
+            inputs=[TensorSpec("IN", "FP32", [-1])],
+            outputs=[TensorSpec("OUT", "FP32", [-1])],
+            fn=producer_fn, platform="jax", backend="jax",
+        )
+        consumer = Model(
+            "pyconsumer",
+            inputs=[TensorSpec("IN", "FP32", [-1])],
+            outputs=[TensorSpec("OUT", "FP32", [-1])],
+            fn=consumer_fn,  # python platform: host arrays expected
+        )
+        engine = InferenceEngine([producer, consumer])
+        try:
+            engine.add_model(_ensemble("e", [
+                _step("producer", {"IN": "IN"}, {"OUT": "mid"}),
+                _step("pyconsumer", {"IN": "mid"}, {"OUT": "OUT"}),
+            ], in_dtype="FP32"))
+            _infer(engine, "e", [("IN", "FP32",
+                                  np.arange(4, dtype=np.float32))])
+            assert engine.metrics.get(
+                "ctpu_ensemble_host_hops_total", {"model": "e"}
+            ) == 1
+        finally:
+            engine.close()
+
+    def test_vision_pipeline_zero_host_hops(self):
+        """The builtin tiny vision pipeline: preprocess -> backbone ->
+        postprocess with every intermediate device-resident."""
+        from client_tpu.serve.models.vision import vision_pipeline_models
+
+        engine = InferenceEngine(vision_pipeline_models())
+        try:
+            img = np.random.default_rng(0).integers(
+                0, 255, (2, 32, 32, 3), dtype=np.uint8
+            )
+            out = _infer(engine, "vision_pipeline",
+                         [("IMAGE", "UINT8", img)])
+            scores = out["SCORES"]
+            assert scores.shape == (2, 16)
+            np.testing.assert_allclose(scores.sum(axis=1), 1.0, atol=1e-5)
+            m = engine.metrics
+            assert not m.get("ctpu_ensemble_host_hops_total",
+                             {"model": "vision_pipeline"})
+            assert m.get("ctpu_ensemble_device_handoffs_total",
+                         {"model": "vision_pipeline"}) == 2
+            # per-composing stats reconcile against the ensemble total
+            ens = _inference_stats(engine, "vision_pipeline")
+            total = sum(
+                _inference_stats(engine, n)["success"]["ns"]
+                for n in ("vision_preprocess", "vision_backbone",
+                          "vision_postprocess")
+            )
+            assert ens["compute_infer"]["ns"] == total
+        finally:
+            engine.close()
