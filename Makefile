@@ -44,8 +44,9 @@ check: lint
 
 # Churn + isolation soak: the slow tier tier-1 excludes — repeats the
 # replica-churn chaos acceptance (discovery add/retire, stream-pinned
-# kill, resolver flap) and the multi-tenant noisy-neighbor/hot-key
-# scenario SOAK_N times; churn and isolation bugs are timing bugs,
+# kill, resolver flap), the multi-tenant noisy-neighbor/hot-key
+# scenario, and the continuous-batching LM 128-stream submit/cancel
+# churn SOAK_N times; churn and isolation bugs are timing bugs,
 # repetition finds them.
 SOAK_N ?= 3
 soak:
@@ -53,7 +54,8 @@ soak:
 	  echo "== soak round $$i/$(SOAK_N) (lock-order witness armed) =="; \
 	  JAX_PLATFORMS=cpu TPULINT_LOCK_WITNESS=1 \
 	      python -m pytest tests/test_discovery.py \
-	      tests/test_balance.py tests/test_frontdoor.py -q -m slow \
+	      tests/test_balance.py tests/test_frontdoor.py \
+	      tests/test_lm.py -q -m slow \
 	      -p no:cacheprovider -p no:xdist -p no:randomly || exit 1; \
 	done
 

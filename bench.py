@@ -1105,6 +1105,32 @@ def main():
     }
     if lm:
         result["lm_token_floor_rtt_ms"] = link["link_rtt_ms"]
+    # LM MFU headline (the decode analog of mfu_pct/resnet50_mfu_pct):
+    # model FLOPs per generated token (transformer.lm_flops_per_token, the
+    # PaLM 2N convention + the live-context attention term) against the
+    # chip's dense peak — batch-1 (lm_*, the latency configuration) and
+    # full-lane continuous batching (lm_batched_*, the throughput
+    # configuration the serve/lm engine exists for).  Low absolute values
+    # are the honest statement for a byte-vocab model on a tunneled chip;
+    # the round-over-round DELTA is the decode-throughput signal.
+    from client_tpu.serve.models.language import DEFAULT_LM_CONFIG
+    from client_tpu.serve.models.transformer import lm_flops_per_token
+
+    if lm.get("lm_tokens_per_sec"):
+        # batch-1 stream: ~41-token prompt, 64 max_tokens -> mid-stream
+        # context ~73
+        flops_b1 = lm_flops_per_token(DEFAULT_LM_CONFIG, context=73)
+        result["lm_mfu_pct"] = _mfu_pct(
+            lm["lm_tokens_per_sec"], flops_b1, peak_tflops
+        )
+        result["lm_flops_per_token"] = flops_b1
+    if lm_batched.get("lm_batched_tokens_per_sec"):
+        # full-lane native run: 8-token prompt, 32 max_tokens -> ~24
+        flops_lane = lm_flops_per_token(DEFAULT_LM_CONFIG, context=24)
+        result["lm_batched_mfu_pct"] = _mfu_pct(
+            lm_batched["lm_batched_tokens_per_sec"], flops_lane,
+            peak_tflops,
+        )
     print(json.dumps(result))
     return 0 if tpu["n"] and not tpu["errors"] else 1
 

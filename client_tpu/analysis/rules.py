@@ -1002,3 +1002,138 @@ class SharedMutRule(Rule):
             and node.value.id == "self"
             and node.attr in shared
         )
+
+
+# callees that fix an array's dispatch shape (bucketing/padding helpers)
+_SHAPE_SANITIZER_RE = re.compile(r"(?i)(pad|bucket|chunk)")
+
+
+@register
+class JitUnboundedShapeRule(Rule):
+    """JIT-UNBOUNDED-SHAPE — jitted callable invoked with a
+    request-shaped array and no bucketing/padding on the path.
+
+    ``jax.jit`` keys executables on input SHAPE: a jitted prefill fed
+    ``np.asarray(prompt_tokens).reshape(1, -1)`` compiles a fresh XLA
+    program for EVERY distinct prompt length (seconds each on a real
+    chip), unbounded by anything but client behavior — the serving-path
+    recompile storm serve/lm's geometric bucket set exists to fix (the
+    pre-fix ``_admit`` prefill in serve/models/continuous.py).  Within a
+    function, a local whose value came through a ragged ``reshape``
+    (any ``-1`` dimension — the shape is data-dependent) must pass
+    through a shape sanitizer (a ``pad*``/``bucket*``/``chunk*`` call)
+    before reaching a jit-bound callable's argument list.
+    """
+
+    id = "JIT-UNBOUNDED-SHAPE"
+    rationale = (
+        "a jitted callable fed a request-shaped array compiles one "
+        "executable per distinct length — bucket/pad the shape first "
+        "(continuous.py per-prompt-length prefill recompiles)"
+    )
+
+    @staticmethod
+    def _is_ragged_reshape(node):
+        """A ``<expr>.reshape(...)`` call with a -1 dimension (including
+        ``reshape((-1,))`` tuple forms): the result's shape follows the
+        DATA, not the code."""
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "reshape"):
+            return False
+        args = []
+        for a in node.args:
+            args.extend(a.elts if isinstance(a, (ast.Tuple, ast.List))
+                        else [a])
+        return any(
+            isinstance(a, ast.Constant) and a.value == -1 for a in args
+        ) or any(
+            isinstance(a, ast.UnaryOp) and isinstance(a.op, ast.USub)
+            and isinstance(a.operand, ast.Constant)
+            and a.operand.value == 1
+            for a in args
+        )
+
+    @classmethod
+    def _is_sanitizer(cls, node):
+        if not isinstance(node, ast.Call):
+            return False
+        text = _expr_text(node.func)
+        return bool(
+            text and _SHAPE_SANITIZER_RE.search(_last_segment(text))
+        )
+
+    def _tainted_names(self, func):
+        """Locals whose LAST shaping assignment in *func* is a ragged
+        reshape (a later sanitizer assignment clears the taint).
+        _walk_no_functions yields statements in reverse source order, so
+        assignments are re-sorted by position before last-wins folding."""
+        tainted = {}
+        assigns = sorted(
+            (n for n in _walk_no_functions(func)
+             if isinstance(n, ast.Assign)),
+            key=lambda n: (n.lineno, n.col_offset),
+        )
+        for node in assigns:
+            targets = [
+                _expr_text(t) for t in node.targets
+                if _expr_text(t) is not None
+            ]
+            if not targets:
+                continue
+            value = node.value
+            if self._is_sanitizer(value):
+                for t in targets:
+                    tainted[t] = False
+            elif any(
+                self._is_ragged_reshape(sub) for sub in ast.walk(value)
+            ):
+                for t in targets:
+                    tainted[t] = True
+        return {name for name, on in tainted.items() if on}
+
+    def _names_outside_sanitizers(self, node):
+        """Name/attr texts in *node*, skipping sanitizer-call subtrees
+        (``jitfn(pad_prompt(prompt, w))`` is the FIXED shape)."""
+        if self._is_sanitizer(node):
+            return
+        text = _expr_text(node)
+        if text is not None:
+            yield text
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            return
+        for child in ast.iter_child_nodes(node):
+            yield from self._names_outside_sanitizers(child)
+
+    def check(self, tree, lines, path):
+        jit_bound = _jit_bound_names(tree)
+        if not jit_bound:
+            return []
+        findings = []
+        for func in _functions(tree):
+            tainted = self._tainted_names(func)
+            if not tainted:
+                continue
+            for node in _walk_no_functions(func):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = _expr_text(node.func)
+                if callee not in jit_bound:
+                    continue
+                hit = sorted(
+                    name
+                    for arg in list(node.args)
+                    + [kw.value for kw in node.keywords]
+                    for name in self._names_outside_sanitizers(arg)
+                    if name in tainted
+                )
+                if hit:
+                    findings.append(self.finding(
+                        path, lines, node,
+                        f"jit-compiled {callee}() takes "
+                        f"{'/'.join(dict.fromkeys(hit))}, whose shape "
+                        "follows request data (ragged reshape): one XLA "
+                        "compile per distinct length — pad/bucket the "
+                        "shape first",
+                    ))
+        return findings

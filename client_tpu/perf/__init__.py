@@ -29,7 +29,7 @@ from client_tpu.perf.load_manager import (
 )
 from client_tpu.perf.model_parser import ModelParser, SchedulerType
 from client_tpu.perf.profiler import InferenceProfiler, PerfStatus
-from client_tpu.perf.report import print_summary, write_csv
+from client_tpu.perf.report import print_summary, write_csv, write_json
 from client_tpu.perf.sequence_manager import SequenceManager
 
 __all__ = [
@@ -50,4 +50,5 @@ __all__ = [
     "create_infer_data_manager",
     "print_summary",
     "write_csv",
+    "write_json",
 ]

@@ -22,6 +22,7 @@ from client_tpu.perf import (
     create_infer_data_manager,
     print_summary,
     write_csv,
+    write_json,
 )
 from client_tpu.perf.model_parser import ModelParser
 from client_tpu.utils import InferenceServerException
@@ -161,6 +162,10 @@ def build_parser():
                         "(retire/evict/re-add paths exercised under load; "
                         "the last healthy endpoint is never dropped)")
     p.add_argument("-f", "--filename", default=None, help="CSV output path")
+    p.add_argument("--json-export", default=None,
+                   help="per-sweep-point JSON report path (the full "
+                        "record CSV columns cannot hold: all percentiles, "
+                        "per-endpoint/tenant splits, server stats deltas)")
     p.add_argument("--collect-metrics", action="store_true",
                    help="scrape the server /metrics during measurement")
     p.add_argument("--metrics-url", default=None,
@@ -579,6 +584,7 @@ def main(argv=None):
             # than silently measuring something else
             unsupported = [
                 ("-f/--filename", args.filename),
+                ("--json-export", args.json_export),
                 ("--latency-threshold", args.latency_threshold),
                 ("--binary-search", args.binary_search),
                 ("--collect-metrics", args.collect_metrics),
@@ -689,15 +695,46 @@ def main(argv=None):
             measurement_request_count=args.measurement_request_count,
         )
 
+        json_extra = {}
         try:
             if args.request_intervals:
                 manager.start()
                 results = [profiler.profile_level("custom_intervals", 0)]
             elif args.request_rate_range:
                 start, end, step = _parse_range(args.request_rate_range, float)
-                results = profiler.profile_request_rate_range(
-                    start, end, step, latency_limit_us
-                )
+                if args.binary_search and latency_limit_us:
+                    # SLO-seeking capacity search: max sustainable QPS
+                    # under the latency limit (open-loop arrivals)
+                    results, best = profiler.profile_request_rate_binary(
+                        start, end, latency_limit_us,
+                        resolution=step if len(
+                            args.request_rate_range.split(":")) > 2 else None,
+                    )
+                    # the search's verdict rides the JSON export: without
+                    # it a consumer would have to re-derive pass/fail
+                    # from the raw sweep points
+                    json_extra["slo_search"] = {
+                        "latency_limit_us": latency_limit_us,
+                        "percentile": args.percentile,
+                        "best_request_rate": (
+                            None if best is None else best.level_value
+                        ),
+                        "best_throughput_infer_per_sec": (
+                            None if best is None else best.throughput
+                        ),
+                    }
+                    if best is not None:
+                        print(
+                            f"Max sustainable rate under SLO: "
+                            f"{best.level_value} req/s "
+                            f"({best.throughput:.1f} infer/sec)"
+                        )
+                    else:
+                        print("SLO violated at every probed rate")
+                else:
+                    results = profiler.profile_request_rate_range(
+                        start, end, step, latency_limit_us
+                    )
             else:
                 start, end, step = _parse_range(
                     args.concurrency_range or "1", int
@@ -740,6 +777,9 @@ def main(argv=None):
         if args.filename:
             write_csv(args.filename, results, verbose=args.verbose)
             print(f"wrote {args.filename}")
+        if args.json_export:
+            write_json(args.json_export, results, extra=json_extra)
+            print(f"wrote {args.json_export}")
         return 0 if results and all(r.error_count == 0 for r in results) else 1
     except InferenceServerException as e:
         print(f"error: {e}", file=sys.stderr)

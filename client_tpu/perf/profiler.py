@@ -440,6 +440,53 @@ class InferenceProfiler:
                 hi = mid - 1
         return results, best
 
+    def profile_request_rate_binary(self, start, end, latency_limit_us,
+                                    resolution=None):
+        """SLO-seeking search over REQUEST RATE: the max sustainable
+        open-loop req/s whose stabilized latency (``percentile`` when
+        set, else the average) stays under ``latency_limit_us``.
+
+        Concurrency search answers "how many outstanding requests fit";
+        this answers the capacity-planning question — "what arrival rate
+        can I advertise under my p99 SLO" — on the open-loop schedule
+        whose queueing collapse closed-loop concurrency sweeps hide.
+        Bisects [start, end] to ``resolution`` req/s (default: 1/16 of
+        the span); returns (all measured levels, best passing level or
+        None when even ``start`` violates the SLO).
+        """
+        lo, hi = float(start), float(end)
+        if resolution is None or resolution <= 0:
+            resolution = max((hi - lo) / 16.0, 1e-3)
+
+        def measure(rate):
+            self.manager.change_request_rate(rate)
+            before = self._server_stats()
+            before_ens = self._ensemble_stats()
+            status = self.profile_level("request_rate", round(rate, 3))
+            status.server_stats = self._server_stats_delta(before)
+            status.ensemble_stats = self._ensemble_stats_delta(before_ens)
+            return status
+
+        results = []
+        # probe start explicitly: bisection midpoints never reach lo, so
+        # without this a capacity at/just above `start` would be reported
+        # as "no passing rate" instead of `start` itself
+        status = measure(lo)
+        results.append(status)
+        if status.latency_us(self.percentile) > latency_limit_us:
+            return results, None
+        best = status
+        while hi - lo >= resolution:
+            mid = (lo + hi) / 2.0
+            status = measure(mid)
+            results.append(status)
+            if status.latency_us(self.percentile) <= latency_limit_us:
+                best = status
+                lo = mid
+            else:
+                hi = mid
+        return results, best
+
     # -- server-side stats ---------------------------------------------------
 
     def _server_stats(self):

@@ -1,12 +1,17 @@
-"""Result reporting: stdout summary + CSV export.
+"""Result reporting: stdout summary + CSV and JSON export.
 
 Parity with the reference ReportWriter (reference
 src/c++/perf_analyzer/report_writer.cc:39-246): a per-level stdout block in
 the perf_analyzer format and a CSV with one row per load level (verbose adds
-send rate, delayed/error counts and server-side breakdown columns).
+send rate, delayed/error counts and server-side breakdown columns).  The
+JSON export carries the FULL per-sweep-point record (every latency
+percentile, per-endpoint/per-tenant splits, server + ensemble stats
+deltas, tpu_metrics aggregates) — the machine-readable companion the flat
+CSV column set cannot hold.
 """
 
 import csv
+import json
 
 
 def print_summary(results, percentile=None):
@@ -163,3 +168,39 @@ def write_csv(path, results, verbose=False):
                 row += ([f"{agg['avg']:.1f}", f"{agg['max']:.1f}"]
                         if agg else ["", ""])
             w.writerow(row)
+
+
+def status_record(s):
+    """One sweep point as a JSON-ready dict (every field PerfStatus
+    carries; percentile keys stringified for stable JSON)."""
+    return {
+        "level_label": s.level_label,
+        "level_value": s.level_value,
+        "throughput_infer_per_sec": s.throughput,
+        "send_rate_req_per_sec": s.send_rate,
+        "latency_avg_us": s.latency_avg_us,
+        "percentiles_us": {
+            str(p): v for p, v in sorted(s.percentiles_us.items())
+        },
+        "completed_requests": s.completed_requests,
+        "error_count": s.error_count,
+        "delayed_count": s.delayed_count,
+        "stable": bool(s.stable),
+        "client_window_s": s.client_window_s,
+        "overhead_pct": s.overhead_pct,
+        "per_endpoint": s.per_endpoint,
+        "per_tenant": s.per_tenant,
+        "tpu_metrics": s.tpu_metrics,
+        "server_stats": s.server_stats,
+        "ensemble_stats": s.ensemble_stats,
+    }
+
+
+def write_json(path, results, extra=None):
+    """Per-sweep-point JSON export: ``{"results": [record, ...]}`` plus
+    any ``extra`` top-level keys (e.g. the SLO search's best level)."""
+    doc = dict(extra or {})
+    doc["results"] = [status_record(s) for s in results]
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")

@@ -382,11 +382,12 @@ class TenantQoS:
 
     def __init__(self, default_weight=1.0, default_max_inflight=None,
                  default_rate_per_s=None, default_burst=None,
-                 tenants=None, registry=None):
+                 default_lane_share=0.75, tenants=None, registry=None):
         self.default_weight = float(default_weight)
         self.default_max_inflight = default_max_inflight
         self.default_rate_per_s = default_rate_per_s
         self.default_burst = default_burst
+        self.default_lane_share = default_lane_share
         self.tenants = dict(tenants or {})
         self.registry = registry
         self._lock = threading.Lock()
@@ -402,6 +403,17 @@ class TenantQoS:
         zero/negative config cannot starve the tenant forever)."""
         w = float(self._cfg(tenant, "weight", self.default_weight))
         return max(w, 1e-3)
+
+    def lane_share(self, tenant):
+        """Max fraction of the continuous-batching DECODE LANES *tenant*
+        may hold while another tenant is waiting (per-tenant ``lane_share``
+        config key; None = uncapped).  Decoupled token streams bypass the
+        request-level front door — one tenant's long generations would
+        otherwise occupy every decode lane for minutes — so the LM engine
+        enforces this at lane-admission time (work-conserving: the quota
+        binds only while someone else is queued)."""
+        share = self._cfg(tenant, "lane_share", self.default_lane_share)
+        return None if share is None else float(share)
 
     def _state_locked(self, tenant):
         state = self._states.get(tenant)

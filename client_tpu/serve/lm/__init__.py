@@ -1,0 +1,44 @@
+"""Continuous-batching LM serving subsystem.
+
+The production-grade successor of the fixed-lane prototype that used to
+live inside ``serve/models/continuous.py`` (which now re-exports this
+package's engine under its old names).  Four pillars:
+
+- **prompt-length bucketing** (:mod:`.policy`) — prompts pad to a small
+  geometric set of prefill widths so the compiled prefill-executable
+  count is bounded by ``len(buckets)`` instead of growing with every
+  novel prompt length;
+- **chunked prefill** (:class:`.engine.LmEngine`) — prefill dispatches in
+  fixed-width chunks interleaved 1:1 with decode ticks, so one novel
+  long prompt can no longer freeze every active token stream for the
+  length of its prefill (or its XLA compile);
+- **paged KV cache** (:mod:`.kv`) — a block-table KV pool with
+  fixed-size blocks and static shapes; HBM is pooled across lanes and
+  requests reserve only the blocks their own ``prompt + max_tokens``
+  needs, instead of every lane pinning ``max_seq`` rows forever;
+- **lane autoscaling + per-tenant lane quotas** — the engine steps
+  between a small precompiled set of decode lane counts on sustained
+  queue depth, and admission is tenant-aware so one tenant cannot occupy
+  every decode lane while another waits.
+
+Per-lane sampling (temperature / top-k via per-lane RNG keys inside the
+jitted tick) removes the old "greedy only" limitation.
+"""
+
+from client_tpu.serve.lm.engine import LmEngine
+from client_tpu.serve.lm.kv import KvBlockPool
+from client_tpu.serve.lm.policy import (
+    LaneAutoscaler,
+    bucket_for,
+    geometric_buckets,
+    pad_prompt,
+)
+
+__all__ = [
+    "LmEngine",
+    "KvBlockPool",
+    "LaneAutoscaler",
+    "bucket_for",
+    "geometric_buckets",
+    "pad_prompt",
+]

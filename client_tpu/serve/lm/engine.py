@@ -1,0 +1,810 @@
+"""Continuous-batching LM engine: bucketed chunked prefill over a paged
+KV pool, lane autoscaling, per-lane sampling, tenant-aware admission.
+
+Scheduling model (one scheduler thread, every device dispatch outside
+the condition lock — the LOCK-DISPATCH/BLOCK-UNDER-LOCK invariant the
+lint gate enforces):
+
+Each scheduler pass runs AT MOST one prefill chunk and then one decode
+tick.  That 1:1 interleave is the head-of-line fix: a novel max-length
+prompt used to run its whole prefill (and, for a novel length, a full
+XLA compile) between decode ticks, stalling every active token stream;
+now the stall per pass is bounded by one fixed-width chunk whose shape
+comes from a small geometric bucket set (``policy.chunk_plan``), so the
+compile set is bounded too.
+
+Static shapes everywhere (TPU-first):
+
+- decode ticks run at one of a few precompiled lane counts
+  (``lane_counts``), stepped by :class:`policy.LaneAutoscaler` on
+  sustained queue depth — one executable per count, ever;
+- the KV cache is a paged block pool (:class:`kv.KvBlockPool`): per-lane
+  block tables gather the logical cache inside the jitted programs, and
+  the new token's K/V scatters to ``(table[pos // bs], pos % bs)``.
+  Idle lanes and write-masked pad positions scatter to the reserved
+  trash block, which the length mask guarantees is never read;
+- sampling happens inside the jitted tick with per-lane RNG keys,
+  temperatures and top-k — greedy lanes (temperature 0) take the
+  on-device argmax, so mixed greedy/sampled batches share one program.
+
+Safety of block recycling: device dispatches from the scheduler thread
+execute in dispatch order on one stream, so a stale in-flight tick's
+scatter into a freed block always lands before the block's next owner
+writes (and every position the next owner ever *reads* is one its own
+later dispatches wrote).
+"""
+
+import functools
+import queue
+import threading
+import time
+from collections import OrderedDict, deque
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from client_tpu.serve.lm.kv import KvBlockPool
+from client_tpu.serve.lm.policy import (
+    LaneAutoscaler,
+    chunk_plan,
+    geometric_buckets,
+    pad_prompt,
+)
+from client_tpu.serve.models.transformer import (
+    _ffn_block,
+    _mm,
+    _rms_norm,
+    _rope,
+    paged_attention,
+)
+
+# sentinel object closing a stream's token queue
+_CLOSE = object()
+
+# placed-marker for a handle cancelled while its prefill job was in
+# flight (chunks dispatch outside _cv); the job step sees it, frees the
+# reservation and closes the queue
+_CANCELLED = object()
+
+# static cap for the per-lane top-k filter (per-lane k is dynamic below it)
+_TOPK_CAP = 64
+
+_LANE_HELP = {
+    "ctpu_lm_lanes": "Configured decode lane count (autoscaled)",
+    "ctpu_lm_active_lanes": "Decode lanes currently streaming",
+}
+
+
+def _select_token(logits, key, temperature, top_k):
+    """One lane's token choice on device: argmax when temperature == 0,
+    else temperature softmax sampling over the top-k filtered logits
+    (top_k <= 0 = unfiltered)."""
+    greedy = jnp.argmax(logits)
+    kmax = min(_TOPK_CAP, logits.shape[-1])
+    vals = lax.top_k(logits, kmax)[0]
+    thresh = vals[jnp.clip(top_k - 1, 0, kmax - 1)]
+    keep = (top_k <= 0) | (logits >= thresh)
+    filtered = jnp.where(keep, logits, -jnp.inf)
+    sampled = jax.random.categorical(
+        key, filtered / jnp.maximum(temperature, 1e-6)
+    )
+    return jnp.where(temperature > 0.0, sampled, greedy).astype(jnp.int32)
+
+
+def _decode_tick(params, tokens_full, pool_k, pool_v, tables, lens,
+                 temps, topks, keys_full, *, cfg, n, block_size):
+    """One batched decode step over the first ``n`` lanes (n is static:
+    one executable per configured lane count)."""
+    pool_k = list(pool_k)
+    pool_v = list(pool_v)
+    tok = tokens_full[:n]
+    x = jnp.take(params["embed"], tok, axis=0)[:, None, :]  # [n,1,D]
+    pos = lens  # [n]
+    hd = cfg.head_dim
+    lane = jnp.arange(n)
+    blk_col = pos // block_size
+    slot = pos % block_size
+    for i, layer in enumerate(params["layers"]):
+        h = _rms_norm(x, layer["ln_attn"])
+        q = _mm(h, layer["attn"]["wq"]).reshape(n, 1, cfg.n_heads, hd)
+        k = _mm(h, layer["attn"]["wk"]).reshape(n, 1, cfg.n_kv_heads, hd)
+        v = _mm(h, layer["attn"]["wv"]).reshape(n, 1, cfg.n_kv_heads, hd)
+        q = _rope(q, pos[:, None], cfg.rope_theta)
+        k = _rope(k, pos[:, None], cfg.rope_theta)
+        blk = tables[lane, blk_col]  # [n] physical block per lane
+        pool_k[i] = pool_k[i].at[blk, slot].set(k[:, 0])
+        pool_v[i] = pool_v[i].at[blk, slot].set(v[:, 0])
+        attn = paged_attention(
+            q, pool_k[i], pool_v[i], tables, pos[:, None], cfg, block_size
+        )
+        out = _mm(
+            attn.reshape(n, 1, cfg.n_heads * hd), layer["attn"]["wo"]
+        )
+        x = x + out.astype(x.dtype)
+        x, _ = _ffn_block(layer, x, cfg)
+    x = _rms_norm(x, params["ln_f"])
+    logits = _mm(x[:, 0], params["lm_head"]).astype(jnp.float32)  # [n,V]
+    pairs = jax.vmap(functools.partial(jax.random.split, num=2))(
+        keys_full[:n]
+    )
+    nxt = jax.vmap(_select_token)(logits, pairs[:, 0], temps, topks)
+    tokens_out = tokens_full.at[:n].set(nxt)
+    keys_out = keys_full.at[:n].set(pairs[:, 1])
+    return tokens_out, pool_k, pool_v, keys_out
+
+
+def _prefill_chunk(params, chunk, pool_k, pool_v, table, start,
+                   prompt_len, key, temperature, top_k, *, cfg,
+                   block_size):
+    """One prefill chunk ([1, C] tokens at logical positions
+    start..start+C-1) written straight into the paged pool.
+
+    Positions >= prompt_len (bucket padding) write to the trash block
+    and are never attended (the length mask), so padding is inert.  The
+    returned token is the sampled/greedy first generation token — only
+    the FINAL chunk's return is meaningful (its chunk contains position
+    prompt_len - 1)."""
+    pool_k = list(pool_k)
+    pool_v = list(pool_v)
+    c = chunk.shape[1]
+    x = jnp.take(params["embed"], chunk, axis=0)  # [1,C,D]
+    pos = start + jnp.arange(c)  # [C] logical positions
+    writable = pos < prompt_len
+    hd = cfg.head_dim
+    blk = jnp.where(
+        writable, table[pos // block_size], KvBlockPool.TRASH
+    )
+    slot = pos % block_size
+    for i, layer in enumerate(params["layers"]):
+        h = _rms_norm(x, layer["ln_attn"])
+        q = _mm(h, layer["attn"]["wq"]).reshape(1, c, cfg.n_heads, hd)
+        k = _mm(h, layer["attn"]["wk"]).reshape(1, c, cfg.n_kv_heads, hd)
+        v = _mm(h, layer["attn"]["wv"]).reshape(1, c, cfg.n_kv_heads, hd)
+        q = _rope(q, pos[None, :], cfg.rope_theta)
+        k = _rope(k, pos[None, :], cfg.rope_theta)
+        pool_k[i] = pool_k[i].at[blk, slot].set(k[0])
+        pool_v[i] = pool_v[i].at[blk, slot].set(v[0])
+        attn = paged_attention(
+            q, pool_k[i], pool_v[i], table[None], pos[None], cfg,
+            block_size,
+        )
+        out = _mm(
+            attn.reshape(1, c, cfg.n_heads * hd), layer["attn"]["wo"]
+        )
+        x = x + out.astype(x.dtype)
+        x, _ = _ffn_block(layer, x, cfg)
+    x = _rms_norm(x, params["ln_f"])
+    last = jnp.clip(prompt_len - 1 - start, 0, c - 1)
+    xsel = jnp.take(x, last[None], axis=1)  # [1,1,D]
+    logits = _mm(xsel[:, 0], params["lm_head"]).astype(jnp.float32)[0]
+    k_sample, k_carry = jax.random.split(key)
+    tok = _select_token(logits, k_sample, temperature, top_k)
+    return tok, pool_k, pool_v, k_carry
+
+
+def _adopt(tokens, keys, slot, tok, key):
+    """Install an admitted request's first token + RNG carry into lane
+    ``slot`` (traced index: one executable regardless of slot)."""
+    return tokens.at[slot].set(tok), keys.at[slot].set(key)
+
+
+class _Lane:
+    __slots__ = ("gen", "active", "queue", "remaining", "produced",
+                 "length", "limit", "tenant", "temperature", "top_k",
+                 "table", "blocks")
+
+    def __init__(self, table_width):
+        self.gen = 0        # bumped on every (re)assignment and cancel
+        self.active = False
+        self.queue = None
+        self.remaining = 0
+        self.produced = 0
+        self.length = 0     # logical sequence length (next write position)
+        self.limit = 0      # prompt_len + max_tokens: last writable pos + 1
+        self.tenant = ""
+        self.temperature = 0.0
+        self.top_k = 0
+        self.table = np.zeros((table_width,), np.int32)  # trash-filled
+        self.blocks = None  # reservation owned while active
+
+
+class _Handle:
+    """Opaque submit() handle; ``placed`` is None (pending / mid-prefill),
+    _CANCELLED, or (slot, gen) once streaming."""
+
+    __slots__ = ("prompt", "prompt_len", "max_tokens", "queue", "tenant",
+                 "temperature", "top_k", "seed", "placed")
+
+    def __init__(self, prompt, max_tokens, q, tenant, temperature, top_k,
+                 seed):
+        self.prompt = prompt
+        self.prompt_len = int(prompt.shape[1])
+        self.max_tokens = int(max_tokens)
+        self.queue = q
+        self.tenant = tenant
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.seed = int(seed)
+        self.placed = None
+
+
+class _PrefillJob:
+    __slots__ = ("handle", "slot", "blocks", "table", "plan", "chunk_idx",
+                 "key", "token")
+
+    def __init__(self, handle, slot, blocks, table, plan, key):
+        self.handle = handle
+        self.slot = slot
+        self.blocks = blocks
+        self.table = table
+        self.plan = plan
+        self.chunk_idx = 0
+        self.key = key
+        self.token = None
+
+
+class LmEngine:
+    """Continuous-batching decode engine (submit/cancel/close surface
+    compatible with the old ContinuousLmScheduler).
+
+    ``submit(prompt_tokens, max_tokens, temperature=0, top_k=0, seed=0,
+    tenant="")`` returns ``(queue, handle)``; the queue yields int token
+    ids and finally :data:`CLOSE`.  ``cancel(handle)`` releases a stream
+    early.  Device state (KV pool, lane arrays, scheduler thread)
+    allocates lazily on the first submit so an idle engine pins no HBM.
+    """
+
+    CLOSE = _CLOSE
+
+    def __init__(self, params, cfg, max_slots=8, lane_counts=None,
+                 block_size=16, pool_tokens=None, prefill_chunk=None,
+                 min_bucket=16, readback_depth=8, eos_id=None,
+                 check_prompt=None, registry=None, tracer=None,
+                 tenant_lane_share=0.75, scale_up_after=3,
+                 scale_down_after=50, tick_log_len=8192):
+        self.params = params
+        self.cfg = cfg
+        self.max_slots = int(max_slots)
+        if lane_counts is None:
+            lane_counts = sorted({
+                max(1, self.max_slots // 4),
+                max(1, self.max_slots // 2),
+                self.max_slots,
+            })
+        self.lane_counts = tuple(sorted(set(int(c) for c in lane_counts)))
+        if self.lane_counts[-1] != self.max_slots:
+            raise ValueError("largest lane count must equal max_slots")
+        self.depth = max(int(readback_depth), 0)
+        self.eos_id = eos_id
+        self.check_prompt = check_prompt  # optional prompt validator
+        self.registry = registry
+        self.tracer = tracer
+        self.tenant_lane_share = tenant_lane_share
+        self.block_size = int(block_size)
+        chunk = int(prefill_chunk or min(64, cfg.max_seq))
+        self.buckets = geometric_buckets(
+            min(min_bucket, chunk), min(chunk, cfg.max_seq)
+        )
+        self._table_width = -(-cfg.max_seq // self.block_size)
+        self._pool_tokens = int(pool_tokens or self.max_slots * cfg.max_seq)
+
+        self._cv = threading.Condition()
+        self._closed = False
+        self._lanes = [
+            _Lane(self._table_width) for _ in range(self.max_slots)
+        ]
+        self._pending = OrderedDict()  # tenant -> deque[_Handle]
+        self._rr = 0                   # round-robin cursor over tenants
+        self._job = None
+        self._scaler = LaneAutoscaler(
+            self.lane_counts, up_after=scale_up_after,
+            down_after=scale_down_after,
+        )
+        self._tick_log = deque(maxlen=int(tick_log_len))
+        self._inflight = deque()
+        self._thread = None  # started lazily on the first submit
+
+        # device state allocates lazily with the thread
+        self.kv = None
+        self._tokens = None
+        self._keys = None
+        # donate the KV pool buffers (args 2/3 of both programs): the
+        # functional .at[].set update would otherwise materialize a full
+        # copy of every per-layer block pool on EACH dispatch — ~2x the
+        # dominant HBM allocation and a whole-pool copy per token.  The
+        # caller reassigns self.kv.pools from the outputs immediately, so
+        # the donated inputs are never touched again.  CPU (the test
+        # platform) has no donation support; jit would just warn.
+        self._donate = (
+            (2, 3) if jax.default_backend() != "cpu" else ()
+        )
+        self._prefill = jax.jit(
+            functools.partial(
+                _prefill_chunk, cfg=cfg, block_size=self.block_size
+            ),
+            donate_argnums=self._donate,
+        )
+        self._adopt = jax.jit(_adopt)
+        self._tick_jits = {}
+
+    # -- executable accounting (the bounded-compile proofs) ---------------
+
+    def prefill_executables(self):
+        """Compiled prefill-chunk executable count (<= len(self.buckets)
+        by construction — chunk widths come from the bucket set)."""
+        size = getattr(self._prefill, "_cache_size", None)
+        return size() if callable(size) else None
+
+    def decode_executables(self):
+        """Compiled decode-tick executable count (<= len(lane_counts))."""
+        total = 0
+        for fn in self._tick_jits.values():
+            size = getattr(fn, "_cache_size", None)
+            total += size() if callable(size) else 1
+        return total
+
+    def tick_trace(self):
+        """Recent per-tick records ({kind, t0, t1, lanes, n_lanes}) —
+        the fairness/jitter evidence tests and ops read."""
+        with self._cv:
+            return list(self._tick_log)
+
+    def set_registry(self, registry):
+        """Late-bind the serving metrics registry (add_model wiring)."""
+        with self._cv:
+            self.registry = registry
+            kv = self.kv
+        if kv is not None:
+            kv.set_registry(registry)
+
+    # -- request side ------------------------------------------------------
+
+    def submit(self, prompt_tokens, max_tokens, temperature=0.0, top_k=0,
+               seed=0, tenant=""):
+        """Returns (token_queue, handle); the queue ends with CLOSE."""
+        prompt = np.asarray(prompt_tokens, np.int32).reshape(1, -1)
+        max_tokens = min(int(max_tokens),
+                         self.cfg.max_seq - prompt.shape[1])
+        q = queue.Queue()
+        if max_tokens <= 0:
+            q.put(_CLOSE)
+            return q, None
+        handle = _Handle(prompt, max_tokens, q, str(tenant or ""),
+                         temperature, top_k, seed)
+        with self._cv:
+            if self._closed:
+                q.put(_CLOSE)
+                return q, None
+            self._ensure_thread_locked()
+            self._pending.setdefault(handle.tenant, deque()).append(handle)
+            self._cv.notify_all()
+        return q, handle
+
+    def cancel(self, handle):
+        """Release a stream early (consumer went away)."""
+        if handle is None:
+            return
+        with self._cv:
+            lane_q = self._pending.get(handle.tenant)
+            if lane_q is not None:
+                for i, entry in enumerate(lane_q):
+                    if entry is handle:
+                        entry.queue.put(_CLOSE)
+                        del lane_q[i]
+                        if not lane_q:
+                            del self._pending[handle.tenant]
+                        return
+            placed = handle.placed
+            if placed is None:
+                # popped from pending but not yet streaming: the prefill
+                # job is mid-dispatch outside _cv.  Mark the handle; the
+                # job step aborts and closes the queue.
+                handle.placed = _CANCELLED
+                return
+            if placed is _CANCELLED:
+                return
+            slot_idx, gen = placed
+            lane = self._lanes[slot_idx]
+            if lane.active and lane.gen == gen:
+                self._retire_lane_locked(lane)
+
+    def close(self):
+        with self._cv:
+            self._closed = True
+            self._release_all_locked()
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+
+    # -- locked helpers ----------------------------------------------------
+
+    def _ensure_thread_locked(self):
+        if self._thread is not None:
+            return
+        self.kv = KvBlockPool(
+            self.cfg,
+            n_blocks=max(
+                self._pool_tokens // self.block_size, self._table_width
+            ),
+            block_size=self.block_size,
+            registry=self.registry,
+        )
+        self._tokens = jnp.zeros((self.max_slots,), jnp.int32)
+        self._keys = jnp.zeros((self.max_slots, 2), jnp.uint32)
+        self._thread = threading.Thread(
+            target=self._loop, name="lm-engine", daemon=True
+        )
+        self._thread.start()
+
+    def _retire_lane_locked(self, lane):
+        """Close a lane's stream and return its KV reservation."""
+        lane.active = False
+        lane.gen += 1  # in-flight ticks for this lane drop on drain
+        lane.queue.put(_CLOSE)
+        lane.table[:] = KvBlockPool.TRASH
+        lane.length = 0
+        blocks, lane.blocks = lane.blocks, None
+        if blocks:
+            self.kv.release(blocks)
+
+    def _release_all_locked(self):
+        """Close every pending/active/in-prefill stream (caller holds
+        _cv)."""
+        for lane_q in self._pending.values():
+            for entry in lane_q:
+                entry.queue.put(_CLOSE)
+        self._pending.clear()
+        for lane in self._lanes:
+            if lane.active:
+                self._retire_lane_locked(lane)
+        job, self._job = self._job, None
+        if job is not None:
+            self._abort_job_locked(job)
+
+    def _abort_job_locked(self, job):
+        blocks, job.blocks = job.blocks, None
+        if blocks:
+            self.kv.release(blocks)
+        job.handle.queue.put(_CLOSE)
+
+    def _tenant_lanes_locked(self, tenant):
+        held = sum(
+            1 for lane in self._lanes if lane.active and lane.tenant == tenant
+        )
+        if self._job is not None and self._job.handle.tenant == tenant:
+            held += 1
+        return held
+
+    def _tenant_quota_locked(self, tenant, n_lanes, others_pending):
+        """Max lanes *tenant* may hold right now.  Work-conserving: the
+        quota binds only while another tenant is waiting."""
+        if not others_pending:
+            return n_lanes
+        share = self.tenant_lane_share
+        if callable(share):
+            share = share(tenant)
+        if share is None:
+            share = 1.0
+        return max(1, min(n_lanes, int(np.ceil(float(share) * n_lanes))))
+
+    def _pick_pending_locked(self, n_lanes):
+        """Round-robin-fair pop of the next admissible pending handle
+        (tenants at their lane quota are skipped while others wait)."""
+        tenants = [t for t, dq in self._pending.items() if dq]
+        if not tenants:
+            return None
+        order = tenants[self._rr % len(tenants):] + \
+            tenants[:self._rr % len(tenants)]
+        for tenant in order:
+            others = any(t != tenant and dq for t, dq in
+                         self._pending.items() if dq)
+            quota = self._tenant_quota_locked(tenant, n_lanes, others)
+            if self._tenant_lanes_locked(tenant) >= quota:
+                continue
+            self._rr += 1
+            lane_q = self._pending[tenant]
+            handle = lane_q.popleft()
+            if not lane_q:
+                # a drained tenant's entry is evicted: client-minted
+                # tenant ids must not grow the map (or the per-pass
+                # scan) without bound
+                del self._pending[tenant]
+            return handle
+        return None
+
+    def _max_active_locked(self):
+        top = -1
+        for i, lane in enumerate(self._lanes):
+            if lane.active:
+                top = i
+        if self._job is not None:
+            top = max(top, self._job.slot)
+        return top
+
+    def _has_pending_locked(self):
+        return any(dq for dq in self._pending.values())
+
+    def _lane_gauges_locked(self, active_count=None):
+        if self.registry is None:
+            return
+        self.registry.set("ctpu_lm_lanes", None, self._scaler.n_lanes,
+                          help_=_LANE_HELP["ctpu_lm_lanes"])
+        if active_count is None:
+            active_count = sum(1 for lane in self._lanes if lane.active)
+        self.registry.set("ctpu_lm_active_lanes", None, active_count,
+                          help_=_LANE_HELP["ctpu_lm_active_lanes"])
+
+    # -- scheduler loop ----------------------------------------------------
+
+    def _admit(self):
+        """Move one pending request into a prefill job (bookkeeping under
+        _cv; every chunk dispatch happens later, outside the lock)."""
+        with self._cv:
+            if self._closed or self._job is not None:
+                return
+            n_lanes = self._scaler.n_lanes
+            slot = next(
+                (i for i in range(n_lanes) if not self._lanes[i].active),
+                None,
+            )
+            if slot is None:
+                # every lane busy: ANY pending work is starvation —
+                # sustained starvation steps the lane count up.  (Checked
+                # before the quota-aware pick: a tenant at its lane quota
+                # with zero free lanes must still register pressure.)
+                if self._has_pending_locked():
+                    if self._scaler.note_starved():
+                        self._lane_gauges_locked()
+                else:
+                    self._scaler.note_ok(False, self._max_active_locked())
+                return
+            handle = self._pick_pending_locked(n_lanes)
+            if handle is None:
+                # nothing admissible: idle, or every pending tenant is at
+                # its quota while a lane sits free (note_ok with pending
+                # True so the free lane cannot drive a scale-down under a
+                # quota-capped backlog)
+                self._scaler.note_ok(
+                    self._has_pending_locked(), self._max_active_locked()
+                )
+                self._lane_gauges_locked()
+                return
+            needed = self.kv.blocks_for(
+                handle.prompt_len + handle.max_tokens
+            )
+            blocks = self.kv.alloc(needed)
+            if blocks is None:
+                # pool exhausted: admission backpressure until a
+                # completion frees blocks (the pick may have evicted the
+                # tenant's drained entry — recreate it)
+                self._pending.setdefault(
+                    handle.tenant, deque()
+                ).appendleft(handle)
+                self._rr -= 1
+                return
+            table = np.full(
+                (self._table_width,), KvBlockPool.TRASH, np.int32
+            )
+            table[:len(blocks)] = blocks
+            # key=None: PRNGKey is itself a (jitted) device dispatch and
+            # must not run under _cv — the first _prefill_step builds it
+            self._job = _PrefillJob(
+                handle, slot, blocks, table,
+                chunk_plan(handle.prompt_len, self.buckets), None,
+            )
+            self._scaler.note_ok(False, self._max_active_locked())
+
+    def _prefill_step(self):
+        """Dispatch ONE chunk of the current prefill job (outside _cv);
+        the final chunk activates the lane."""
+        with self._cv:
+            # re-read under the lock: a concurrent close() may have
+            # aborted and cleared the job since the caller's check
+            job = self._job
+            if job is None:
+                return
+            if self._closed or job.handle.placed is _CANCELLED:
+                self._abort_job_locked(job)
+                self._job = None
+                return
+        handle = job.handle
+        if job.key is None:  # deferred out of _admit: dispatch-free lock
+            job.key = jax.random.PRNGKey(handle.seed)
+        start, width = job.plan[job.chunk_idx]
+        chunk = pad_prompt(
+            handle.prompt[:, start:start + width], width,
+            pad_id=0,
+        )
+        t0 = time.monotonic()
+        tok, pool_k, pool_v, job.key = self._prefill(
+            self.params, jnp.asarray(chunk), self.kv.pools["k"],
+            self.kv.pools["v"], jnp.asarray(job.table),
+            jnp.int32(start), jnp.int32(handle.prompt_len), job.key,
+            jnp.float32(handle.temperature), jnp.int32(handle.top_k),
+        )
+        self.kv.pools["k"] = pool_k
+        self.kv.pools["v"] = pool_v
+        job.chunk_idx += 1
+        self._log_tick("prefill_chunk", t0, (job.slot,))
+        if self.registry is not None:
+            self.registry.inc(
+                "ctpu_lm_prefill_chunks_total",
+                help_="Prefill chunks dispatched between decode ticks",
+            )
+        if job.chunk_idx < len(job.plan):
+            return
+        with self._cv:
+            self._job = None
+            if self._closed or handle.placed is _CANCELLED:
+                self._abort_job_locked(job)
+                return
+            lane = self._lanes[job.slot]
+            lane.gen += 1
+            lane.active = True
+            lane.queue = handle.queue
+            lane.remaining = handle.max_tokens
+            lane.produced = 0
+            lane.length = handle.prompt_len
+            lane.limit = handle.prompt_len + handle.max_tokens
+            lane.tenant = handle.tenant
+            lane.temperature = handle.temperature
+            lane.top_k = handle.top_k
+            lane.table[:] = job.table
+            lane.blocks, job.blocks = job.blocks, None
+            handle.placed = (job.slot, lane.gen)
+            snapshot = ((job.slot, lane.gen),)
+            self._lane_gauges_locked()
+        # install the first token + RNG carry into the lane arrays and
+        # stream the token through the readback pipeline (single-lane
+        # entry, exactly like a full tick's vector)
+        self._tokens, self._keys = self._adopt(
+            self._tokens, self._keys, jnp.int32(job.slot), tok, job.key
+        )
+        if hasattr(tok, "copy_to_host_async"):
+            tok.copy_to_host_async()
+        self._inflight.append((tok, snapshot))
+
+    def _tick_for(self, n):
+        fn = self._tick_jits.get(n)
+        if fn is None:
+            fn = jax.jit(
+                functools.partial(
+                    _decode_tick, cfg=self.cfg, n=n,
+                    block_size=self.block_size,
+                ),
+                donate_argnums=self._donate,
+            )
+            self._tick_jits[n] = fn
+        return fn
+
+    def _decode_pass(self):
+        """One batched decode tick over the active lanes (dispatch
+        outside _cv).  Returns True if a tick ran."""
+        with self._cv:
+            if self._closed:
+                return False
+            n = self._scaler.n_lanes
+            # a lane drops out of the tick batch once it has dispatched
+            # its full token budget (readback may still be in flight) —
+            # dispatch-ahead must never write past the lane's block
+            # reservation
+            active = [
+                (i, self._lanes[i].gen)
+                for i in range(n)
+                if self._lanes[i].active
+                and self._lanes[i].length < self._lanes[i].limit
+            ]
+            if not active:
+                return False
+            # lanes outside the batch (idle, or at-budget awaiting drain)
+            # get a trash table + position 0: their scatter lands in the
+            # trash block and their garbage token is never delivered
+            included = {i for i, _ in active}
+            trash_row = np.zeros((self._table_width,), np.int32)
+            tables = np.stack([
+                self._lanes[i].table if i in included else trash_row
+                for i in range(n)
+            ])
+            lens = np.array(
+                [self._lanes[i].length if i in included else 0
+                 for i in range(n)], np.int32,
+            )
+            temps = np.array(
+                [self._lanes[i].temperature for i in range(n)], np.float32
+            )
+            topks = np.array(
+                [self._lanes[i].top_k for i in range(n)], np.int32
+            )
+            for i, _ in active:
+                self._lanes[i].length += 1  # this tick writes position len
+            self._lane_gauges_locked(active_count=len(active))
+        t0 = time.monotonic()
+        fn = self._tick_for(n)
+        self._tokens, pool_k, pool_v, self._keys = fn(
+            self.params, self._tokens, self.kv.pools["k"],
+            self.kv.pools["v"], jnp.asarray(tables), jnp.asarray(lens),
+            jnp.asarray(temps), jnp.asarray(topks), self._keys,
+        )
+        self.kv.pools["k"] = pool_k
+        self.kv.pools["v"] = pool_v
+        if hasattr(self._tokens, "copy_to_host_async"):
+            self._tokens.copy_to_host_async()
+        self._inflight.append((self._tokens, tuple(active)))
+        self._log_tick("decode", t0, tuple(i for i, _ in active))
+        return True
+
+    def _log_tick(self, kind, t0, slots):
+        t1 = time.monotonic()
+        with self._cv:
+            self._tick_log.append({
+                "kind": kind, "t0": t0, "t1": t1, "lanes": slots,
+                "n_lanes": self._scaler.n_lanes,
+            })
+        tracer = self.tracer
+        if tracer is not None:
+            tracer.tick_span(kind, t0, t1)
+
+    def _drain_one(self):
+        tokens_dev, snapshot = self._inflight.popleft()
+        vals = np.asarray(tokens_dev).reshape(-1)
+        with self._cv:
+            for slot_idx, gen in snapshot:
+                lane = self._lanes[slot_idx]
+                if not lane.active or lane.gen != gen:
+                    continue  # cancelled/finished lane: stale tick token
+                # full ticks carry one token PER LANE (index by slot);
+                # single-lane prefill entries carry exactly one value
+                token = (
+                    int(vals[slot_idx]) if vals.size > 1 else int(vals[0])
+                )
+                lane.queue.put(token)
+                lane.produced += 1
+                if self.registry is not None:
+                    self.registry.inc(
+                        "ctpu_lm_tokens_total",
+                        help_="Tokens streamed by the LM engine",
+                    )
+                done = (
+                    lane.produced >= lane.remaining
+                    or (self.eos_id is not None and token == self.eos_id)
+                )
+                if done:
+                    self._retire_lane_locked(lane)
+
+    def _loop(self):
+        try:
+            self._loop_inner()
+        except Exception:
+            # a dying scheduler must never strand consumers on q.get()
+            with self._cv:
+                self._release_all_locked()
+                self._closed = True
+            raise
+
+    def _loop_inner(self):
+        while True:
+            self._admit()  # takes/releases _cv itself; no dispatch inside
+            worked = False
+            if self._job is not None:
+                self._prefill_step()  # ONE chunk, outside _cv
+                worked = True
+            ticked = self._decode_pass()  # ONE decode tick, outside _cv
+            worked = worked or ticked
+            with self._cv:
+                if self._closed:
+                    break
+            while len(self._inflight) > (self.depth if ticked else 0):
+                self._drain_one()
+            if not worked and not self._inflight:
+                with self._cv:
+                    if self._closed:
+                        break
+                    if (not self._has_pending_locked()
+                            and self._job is None
+                            and not any(l.active for l in self._lanes)):
+                        self._cv.wait(timeout=0.05)
+        # shutdown: drop the in-flight tail (queues already closed)
+        self._inflight.clear()

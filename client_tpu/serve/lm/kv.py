@@ -1,0 +1,108 @@
+"""Paged KV cache: a block-table pool shared by every decode lane.
+
+The fixed-lane prototype allocated per layer ``[lanes, max_seq, kv, hd]``
+— every lane pinned max-seq-len rows of HBM whether it held a 5-token
+request, a 500-token one, or nothing.  The pool here is per layer
+``[n_blocks, block_size, kv, hd]`` with a host-side free list: a request
+reserves exactly ``ceil((prompt_len + max_tokens) / block_size)`` blocks
+at admission and frees them at completion/cancel, so HBM capacity is a
+function of *aggregate live tokens*, not ``lanes * max_seq``.
+
+Static shapes throughout (TPU-first): the device arrays never change
+shape; splice/free are index bookkeeping on the host plus
+scatter/gather through per-lane block tables inside the jitted programs.
+Block 0 is reserved as the *trash block*: idle lanes and write-masked
+pad positions scatter there, so the jitted tick needs no per-lane
+branch.  Nothing ever reads it (the length mask excludes every position
+a table maps to trash).
+"""
+
+import threading
+
+import jax.numpy as jnp
+
+_KV_HELP = {
+    "ctpu_lm_kv_blocks_used": "Paged-KV blocks currently reserved",
+    "ctpu_lm_kv_blocks_free": "Paged-KV blocks free in the pool",
+}
+
+
+class KvBlockPool:
+    """Device block pool + host free-list accounting.
+
+    ``n_blocks`` counts usable blocks; one extra trash block (index 0) is
+    allocated on top, so the device arrays hold ``n_blocks + 1`` blocks.
+    """
+
+    TRASH = 0
+
+    def __init__(self, cfg, n_blocks, block_size, registry=None):
+        if block_size <= 0 or n_blocks <= 0:
+            raise ValueError("block_size and n_blocks must be positive")
+        self.cfg = cfg
+        self.block_size = int(block_size)
+        self.n_blocks = int(n_blocks)
+        self.registry = registry
+        shape = (self.n_blocks + 1, self.block_size,
+                 cfg.n_kv_heads, cfg.head_dim)
+        self.pools = {
+            "k": [jnp.zeros(shape, cfg.jdtype) for _ in range(cfg.n_layers)],
+            "v": [jnp.zeros(shape, cfg.jdtype) for _ in range(cfg.n_layers)],
+        }
+        self._lock = threading.Lock()
+        self._free = list(range(1, self.n_blocks + 1))
+
+    def blocks_for(self, n_tokens):
+        """Blocks a sequence of ``n_tokens`` total (prompt + generation
+        budget) reserves."""
+        return -(-int(n_tokens) // self.block_size)
+
+    def alloc(self, n):
+        """Reserve ``n`` blocks; returns the block index list or None
+        when the pool cannot satisfy the reservation (admission
+        backpressure — the caller retries once completions free blocks)."""
+        n = int(n)
+        with self._lock:
+            if n > len(self._free):
+                return None
+            taken = self._free[:n]
+            del self._free[:n]
+            self._gauges_locked()
+            return taken
+
+    def release(self, blocks):
+        """Return a reservation to the pool (idempotent callers pass each
+        list exactly once; double-free is a bug we guard with a set check
+        in debug runs, not in the hot path)."""
+        if not blocks:
+            return
+        with self._lock:
+            self._free.extend(blocks)
+            self._gauges_locked()
+
+    @property
+    def free_blocks(self):
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def used_blocks(self):
+        with self._lock:
+            return self.n_blocks - len(self._free)
+
+    def _gauges_locked(self):
+        if self.registry is None:
+            return
+        free = len(self._free)
+        self.registry.set("ctpu_lm_kv_blocks_used", None,
+                          self.n_blocks - free,
+                          help_=_KV_HELP["ctpu_lm_kv_blocks_used"])
+        self.registry.set("ctpu_lm_kv_blocks_free", None, free,
+                          help_=_KV_HELP["ctpu_lm_kv_blocks_free"])
+
+    def set_registry(self, registry):
+        """Late-bind a metrics registry (the engine learns its server's
+        registry at add_model time) and publish the current gauges."""
+        with self._lock:
+            self.registry = registry
+            self._gauges_locked()

@@ -16,7 +16,12 @@ observability surface:
   to their retry policy / circuit breaker — client-side retry counters and
   per-endpoint circuit state,
 - per-TPU-device HBM usage via ``device.memory_stats()`` where the PJRT
-  runtime exposes it.
+  runtime exposes it,
+- the continuous-batching LM engine's series (serve/lm, bound into this
+  registry at add_model time): ``ctpu_lm_kv_blocks_{used,free}`` (paged
+  KV pool occupancy), ``ctpu_lm_lanes`` / ``ctpu_lm_active_lanes``
+  (autoscaled decode lane count vs lanes streaming),
+  ``ctpu_lm_tokens_total`` and ``ctpu_lm_prefill_chunks_total``.
 
 Every label value passes through :func:`escape_label`: the exposition format
 reserves ``\\``, ``"`` and newline inside quoted label values, and a model

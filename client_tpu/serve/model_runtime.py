@@ -149,6 +149,11 @@ class Model:
         self.file_overrides = {}
         # optional resource-release hook, called by InferenceEngine.close()
         self.closer = None
+        # optional late-bind hook, called by InferenceEngine.add_model with
+        # the engine: model-owned subsystems (e.g. the continuous-batching
+        # LM engine) pick up the server's metrics registry, tracer, and
+        # per-tenant QoS here instead of constructing their own
+        self.binder = None
         # validated ensemble DAG (serve/pipeline.py), built at add/load time
         self._dag = None
 
@@ -822,6 +827,10 @@ class InferenceEngine:
         if stale is not None:
             stale.close()
         self._invalidate_cache()
+        # outside the repository lock: binders may take their own locks
+        # (registry/QoS) and must never nest under self._lock
+        if model.binder is not None:
+            model.binder(self)
         if model.dynamic_batching and model.warmup:
             self._batcher_for(model).warmup(model.inputs)
 
@@ -1330,7 +1339,7 @@ class InferenceEngine:
                 # driven decode steps over a tunneled chip = seconds).
                 return self._decoupled_stream(
                     model, model_version, request, inputs, params, context,
-                    stats, t0, t_in0, t_in1, trace,
+                    stats, t0, t_in0, t_in1, trace, tenant,
                 )
             # Direct path: the busy span opens at dispatch and is closed by
             # the observer at device completion (async results) or right
@@ -1370,7 +1379,7 @@ class InferenceEngine:
 
     def _decoupled_stream(self, model, model_version, request, inputs,
                           params, context, stats, t0, t_in0, t_in1,
-                          trace=None):
+                          trace=None, tenant=""):
         """Generator of (response_dict, blobs) for a decoupled model.
 
         Exactly one statistics entry per request: success at exhaustion,
@@ -1385,6 +1394,15 @@ class InferenceEngine:
         # extra EMPTY response marked triton_final_response=true so the
         # client can detect completion without model-specific EOS logic.
         want_final = bool(params.get("triton_enable_empty_final_response"))
+        # Decoupled models bypass the front door, so the tenant identity
+        # (x-tenant-id) reaches them through the RESERVED __tenant__
+        # parameter on a COPY of the request params — stamped by the
+        # engine, never trusted from the client (a spoofed value would
+        # let one tenant bill its decode lanes to another).
+        params = dict(params)
+        params.pop("__tenant__", None)
+        if tenant:
+            params["__tenant__"] = tenant
         try:
             gen = model.fn(inputs, params, context)
             while True:

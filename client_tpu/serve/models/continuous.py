@@ -1,360 +1,69 @@
-"""Slot-based continuous batching for decoupled LM token streaming.
+"""Continuous batching for decoupled LM token streaming — compat surface.
 
-The Orca/vLLM idea in its static-shape TPU form: a fixed batch of
-``max_slots`` decode lanes runs ONE jitted ``decode_step`` per tick across
-every active stream.  ``transformer.decode_step`` is already per-row
-batched with heterogeneous positions (``cache["len"]`` is ``[B]``; rope,
-the KV scatter, and the attention mask are all per-row), so concurrent
-streams share each matmul instead of serializing whole decode programs —
-aggregate tokens/sec scales with active lanes, where per-request decode
-(one ``generate()`` per stream) stays flat.
+The fixed-lane prototype that lived here grew into the
+``client_tpu.serve.lm`` subsystem (paged KV cache, bucketed + chunked
+prefill interleaved with decode, lane autoscaling, per-lane sampling,
+tenant-aware lane admission).  This module keeps the original names and
+submit/cancel/stream surface so existing callers and tests are
+untouched:
 
-TPU-first constraints honored:
-- Static shapes everywhere: the lane count is fixed at construction; idle
-  lanes compute masked garbage that nobody reads (no dynamic batch growth,
-  no recompiles).  Admission splices a prefilled request's KV rows into the
-  batched cache with ``dynamic_update_slice`` at a *traced* slot index —
-  one executable regardless of slot.
-- Async dispatch: the scheduler thread dispatches decode ticks ahead of
-  readback; per-tick token vectors drain through a ``copy_to_host_async``
-  pipeline exactly like ``transformer.generate`` (depth ``readback_depth``),
-  so a high-RTT link bounds throughput at ~depth ticks/RTT, not 1/RTT.
-- Greedy selection stays on device (argmax inside the jitted tick).
+- :class:`ContinuousLmScheduler` IS :class:`client_tpu.serve.lm.LmEngine`
+  (``submit(prompt, max_tokens) -> (queue, handle)``, ``cancel``,
+  ``close``, the ``CLOSE`` sentinel);
+- :class:`BatchedLmRunner` is the ``stream()`` provider
+  lm_streaming_batched_model plugs into — now with per-request
+  temperature / top-k / seed (per-lane RNG inside the jitted tick
+  removed the old "greedy only" 400) and a ``tenant`` identity that
+  feeds per-tenant decode-lane quotas.
 
-Reference analog: none — the reference is a client; its Llama config
-(BASELINE config 5) points at a server whose continuous batching lives in
-the backend.  Here the TPU-native server owns it.
+See ``client_tpu/serve/lm/`` for the engine internals and README
+"LLM serving / continuous batching" for the design.
 """
-
-import functools
-import queue
-import threading
 
 import numpy as np
 
-import jax
-import jax.numpy as jnp
-from jax import lax
+from client_tpu.serve.lm.engine import _CLOSE, _TOPK_CAP, LmEngine
+from client_tpu.utils import InferenceServerException
 
-from client_tpu.serve.models import transformer as tfm
-
-# sentinel object closing a stream's token queue
-_CLOSE = object()
-
-# placed-marker for a handle cancelled while its prefill dispatch was in
-# flight (admission runs outside _cv); _admit sees it and closes the queue
-_CANCELLED = object()
-
-
-class _Slot:
-    __slots__ = ("gen", "active", "queue", "remaining", "produced")
-
-    def __init__(self):
-        self.gen = 0        # bumped on every (re)assignment and cancel
-        self.active = False
-        self.queue = None   # per-request token queue
-        self.remaining = 0  # tokens still to produce
-        self.produced = 0
-
-
-class ContinuousLmScheduler:
-    """Continuous-batching decode scheduler over a fixed lane count.
-
-    ``submit(prompt_tokens, max_tokens)`` returns a ``queue.Queue`` that
-    yields int token ids and finally the ``CLOSE`` sentinel; ``cancel``
-    releases a lane early (abandoned client streams).  Greedy decoding
-    only — the batched tick selects argmax on device; per-request
-    temperature would need per-lane RNG lanes (future work).
-    """
-
-    CLOSE = _CLOSE
-
-    def __init__(self, params, cfg, max_slots=4, readback_depth=8,
-                 eos_id=None, check_prompt=None):
-        self.params = params
-        self.cfg = cfg
-        self.max_slots = int(max_slots)
-        self.depth = max(int(readback_depth), 0)
-        self.eos_id = eos_id
-        self.check_prompt = check_prompt  # optional prompt validator
-        self._slots = [_Slot() for _ in range(self.max_slots)]
-        self._pending = []  # (prompt np.int32[1,T], max_tokens, q)
-        self._cv = threading.Condition()
-        self._closed = False
-
-        # device state allocates lazily with the thread: a Server that
-        # never routes a request here must not pin HBM for the lane cache
-        self._cache = None
-        self._tokens = None
-        self._prefill = jax.jit(functools.partial(tfm.prefill, cfg=cfg))
-
-        n_layers = cfg.n_layers
-
-        def adopt(cache, single, tokens, slot, first_token):
-            """Splice a prefilled batch-1 cache into lane ``slot`` and set
-            its next input token — slot is a traced index, one executable."""
-            out = {
-                "k": [
-                    lax.dynamic_update_slice(
-                        cache["k"][i], single["k"][i], (slot, 0, 0, 0)
-                    )
-                    for i in range(n_layers)
-                ],
-                "v": [
-                    lax.dynamic_update_slice(
-                        cache["v"][i], single["v"][i], (slot, 0, 0, 0)
-                    )
-                    for i in range(n_layers)
-                ],
-                "len": cache["len"].at[slot].set(single["len"][0]),
-            }
-            return out, tokens.at[slot].set(first_token)
-
-        self._adopt = jax.jit(adopt)
-
-        def tick(params, tokens, cache):
-            logits, cache = tfm.decode_step(params, tokens, cfg=cfg,
-                                            cache=cache)
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
-
-        self._tick = jax.jit(tick)
-        self._thread = None  # started lazily on the first submit
-
-    def _ensure_thread_locked(self):
-        if self._thread is None:
-            self._cache = tfm.init_cache(self.cfg, self.max_slots)
-            self._tokens = jnp.zeros((self.max_slots,), jnp.int32)
-            self._thread = threading.Thread(
-                target=self._loop, name="lm-continuous-batcher", daemon=True
-            )
-            self._thread.start()
-
-    # -- request side ------------------------------------------------------
-
-    def submit(self, prompt_tokens, max_tokens):
-        """Returns (token_queue, handle); the queue ends with CLOSE."""
-        prompt = np.asarray(prompt_tokens, np.int32).reshape(1, -1)
-        # clamp like generate(): slot i's token goes to prompt_len + i
-        max_tokens = min(int(max_tokens),
-                         self.cfg.max_seq - prompt.shape[1])
-        q = queue.Queue()
-        if max_tokens <= 0:
-            q.put(_CLOSE)
-            return q, None
-        entry = [prompt, max_tokens, q, None]  # [3] = (slot, gen) once admitted
-        with self._cv:
-            if self._closed:
-                q.put(_CLOSE)
-                return q, None
-            self._ensure_thread_locked()
-            self._pending.append(entry)
-            self._cv.notify_all()
-        return q, entry
-
-    def cancel(self, handle):
-        """Release a stream early (consumer went away)."""
-        if handle is None:
-            return
-        with self._cv:
-            # identity scan: entries hold numpy prompts, so `in`/`remove`
-            # (which compare element-wise) would raise on array equality
-            for i, entry in enumerate(self._pending):
-                if entry is handle:
-                    entry[2].put(_CLOSE)  # a reader must not hang on get()
-                    del self._pending[i]
-                    return
-            placed = handle[3]
-            if placed is None:
-                # popped from _pending but not yet admitted: the prefill
-                # dispatch is running outside _cv right now.  Mark the
-                # handle; _admit closes the queue once the dispatch returns.
-                handle[3] = _CANCELLED
-                return
-            if placed is _CANCELLED:
-                return
-            slot_idx, gen = placed
-            slot = self._slots[slot_idx]
-            if slot.active and slot.gen == gen:
-                slot.active = False
-                slot.gen += 1  # in-flight ticks for this lane drop on drain
-                slot.queue.put(_CLOSE)  # a reader must not hang on get()
-
-    def _release_all_locked(self):
-        """Close every pending and active stream queue (caller holds _cv)."""
-        for entry in self._pending:
-            entry[2].put(_CLOSE)
-        self._pending.clear()
-        for slot in self._slots:
-            if slot.active:
-                slot.active = False
-                slot.gen += 1
-                slot.queue.put(_CLOSE)
-
-    def close(self):
-        with self._cv:
-            self._closed = True
-            self._release_all_locked()
-            self._cv.notify_all()
-        if self._thread is not None:
-            self._thread.join(timeout=30)
-
-    # -- scheduler loop ----------------------------------------------------
-
-    def _admit(self):
-        """Move pending requests into free lanes (prefill + splice).
-
-        The prefill dispatch runs OUTSIDE _cv: jax.jit compiles a fresh
-        prefill executable per distinct prompt length, so a novel-length
-        prompt would otherwise hold the lock for a full XLA compile
-        (seconds) and head-of-line-block every submit()/cancel() caller.
-        Only the pending-pop and slot bookkeeping need the lock — the
-        device state (_cache/_tokens) is scheduler-thread-private.  Lanes
-        admit one at a time; the scheduler is the only admitter, so a
-        reserved slot_idx cannot be stolen while the lock is dropped.
-        """
-        while True:
-            with self._cv:
-                if self._closed or not self._pending:
-                    return
-                slot_idx = next(
-                    (i for i, s in enumerate(self._slots) if not s.active),
-                    None,
-                )
-                if slot_idx is None:
-                    return
-                entry = self._pending.pop(0)
-                prompt, max_tokens, q = entry[0], entry[1], entry[2]
-            try:
-                single = tfm.init_cache(self.cfg, 1)
-                logits, single = self._prefill(
-                    self.params, jnp.asarray(prompt), cache=single
-                )
-                first = jnp.argmax(logits, axis=-1).astype(jnp.int32)[0]
-                self._cache, self._tokens = self._adopt(
-                    self._cache, single, self._tokens, slot_idx, first
-                )
-            except BaseException:
-                # the entry is in neither _pending nor a slot here, so the
-                # crash handler's _release_all_locked cannot see it — close
-                # its stream before the exception kills the scheduler
-                q.put(_CLOSE)
-                raise
-            with self._cv:
-                if self._closed or entry[3] is _CANCELLED:
-                    # consumer went away (or shutdown) during the dispatch:
-                    # close the stream and leave the lane free — the spliced
-                    # cache rows are inert, like any idle lane's garbage
-                    q.put(_CLOSE)
-                    continue
-                slot = self._slots[slot_idx]
-                slot.gen += 1
-                slot.active = True
-                slot.queue = q
-                slot.remaining = max_tokens
-                slot.produced = 0
-                entry[3] = (slot_idx, slot.gen)
-                # the prefill's own first token streams through the readback
-                # pipeline like every tick token (single-lane entry)
-                if hasattr(first, "copy_to_host_async"):
-                    first.copy_to_host_async()
-                self._inflight.append((first, ((slot_idx, slot.gen),)))
-
-    def _drain_one(self):
-        tokens_dev, snapshot = self._inflight.popleft()
-        vals = np.asarray(tokens_dev).reshape(-1)
-        with self._cv:
-            for slot_idx, gen in snapshot:
-                slot = self._slots[slot_idx]
-                if not slot.active or slot.gen != gen:
-                    continue  # cancelled/finished lane: stale tick token
-                # full ticks carry one token PER LANE (index by slot);
-                # single-lane prefill entries carry exactly one value
-                token = int(vals[slot_idx]) if vals.size > 1 else int(vals[0])
-                slot.queue.put(token)
-                slot.produced += 1
-                done = (
-                    slot.produced >= slot.remaining
-                    or (self.eos_id is not None and token == self.eos_id)
-                )
-                if done:
-                    slot.queue.put(_CLOSE)
-                    slot.active = False
-                    slot.gen += 1
-
-    def _loop(self):
-        try:
-            self._loop_inner()
-        except Exception:
-            # a dying scheduler must never strand consumers on q.get()
-            with self._cv:
-                self._release_all_locked()
-                self._closed = True
-            raise
-
-    def _loop_inner(self):
-        from collections import deque
-
-        self._inflight = deque()
-        while True:
-            self._admit()  # takes/releases _cv itself; prefill outside it
-            with self._cv:
-                if self._closed:
-                    break
-                active = [
-                    (i, s.gen) for i, s in enumerate(self._slots) if s.active
-                ]
-                if not active and not self._pending:
-                    if self._inflight:
-                        pass  # fall through to drain the tail
-                    else:
-                        self._cv.wait(timeout=0.1)
-                        continue
-            if active:
-                self._tokens, self._cache = self._tick(
-                    self.params, self._tokens, self._cache
-                )
-                if hasattr(self._tokens, "copy_to_host_async"):
-                    self._tokens.copy_to_host_async()
-                # full-batch snapshot: entry i maps to vals[slot_idx]
-                self._inflight.append(
-                    (self._tokens,
-                     tuple((slot_idx, gen) for slot_idx, gen in active))
-                )
-            while len(self._inflight) > (self.depth if active else 0):
-                self._drain_one()
-        # shutdown: drop the in-flight tail (queues already closed)
-        self._inflight.clear()
+# the engine, under its historical serving-path name
+ContinuousLmScheduler = LmEngine
 
 
 class BatchedLmRunner:
-    """Drop-in ``stream()`` provider backed by ContinuousLmScheduler —
-    signature-compatible with language._LmRunner.stream so the batched
-    model reuses lm_streaming_model verbatim.  Greedy-only: the batched
-    tick argmaxes on device, so a sampled request is rejected with a clear
-    400 instead of silently decoding greedily."""
+    """Drop-in ``stream()`` provider backed by the continuous-batching
+    engine — signature-compatible with language._LmRunner.stream so the
+    batched model reuses lm_streaming_model verbatim.  Per-request
+    sampling (temperature / top_k / seed) runs inside the jitted tick
+    with per-lane RNG keys; temperature 0 lanes take the on-device
+    argmax, so mixed greedy/sampled batches share one executable."""
 
     def __init__(self, params, cfg, max_slots=4, eos_id=None,
-                 check_prompt=None):
+                 check_prompt=None, **engine_kwargs):
         self.cfg = cfg
-        self.scheduler = ContinuousLmScheduler(
+        self.scheduler = LmEngine(
             params, cfg, max_slots=max_slots, eos_id=eos_id,
-            check_prompt=check_prompt,
+            check_prompt=check_prompt, **engine_kwargs,
         )
 
-    def stream(self, tokens, max_tokens, temperature=0.0, seed=0):
-        if temperature and float(temperature) > 0.0:
-            from client_tpu.utils import InferenceServerException
-
+    def stream(self, tokens, max_tokens, temperature=0.0, seed=0,
+               top_k=0, tenant=""):
+        if int(top_k) > _TOPK_CAP:
+            # the jitted tick's per-lane filter has a static width: a
+            # silently-truncated k would sample a different distribution
+            # than the client asked for
             raise InferenceServerException(
-                "the continuous-batching LM decodes greedily (batched "
-                "on-device argmax); use lm_streaming for sampled "
-                "generation", status="400",
+                f"top_k {int(top_k)} exceeds the engine's static cap of "
+                f"{_TOPK_CAP}; use top_k <= {_TOPK_CAP} or 0 (unfiltered)",
+                status="400",
             )
         if self.scheduler.check_prompt is not None:
             self.scheduler.check_prompt(
                 int(np.asarray(tokens).reshape(-1).shape[0])
             )
-        q, handle = self.scheduler.submit(tokens, max_tokens)
+        q, handle = self.scheduler.submit(
+            tokens, max_tokens, temperature=temperature, top_k=top_k,
+            seed=seed, tenant=tenant,
+        )
         try:
             while True:
                 tok = q.get()

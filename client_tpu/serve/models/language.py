@@ -26,6 +26,19 @@ _BOS = 256
 _EOS = 257
 _VOCAB = 258
 
+# The hermetic serving configuration (swap for a full-size model on real
+# deployments).  Module-level so harnesses (bench.py's lm_mfu_pct) can
+# compute tfm.lm_flops_per_token without instantiating a runner's params.
+DEFAULT_LM_CONFIG = tfm.TransformerConfig(
+    vocab_size=_VOCAB,
+    d_model=256,
+    n_layers=4,
+    n_heads=8,
+    n_kv_heads=4,
+    d_ff=768,
+    max_seq=512,
+)
+
 
 def encode_text(text):
     """Byte-level tokenize: BOS + utf-8 bytes."""
@@ -87,15 +100,7 @@ class _LmRunner:
     """Owns the transformer params + jitted decode programs."""
 
     def __init__(self, cfg=None, seed=0, quantize=False, params=None):
-        self.cfg = cfg or tfm.TransformerConfig(
-            vocab_size=_VOCAB,
-            d_model=256,
-            n_layers=4,
-            n_heads=8,
-            n_kv_heads=4,
-            d_ff=768,
-            max_seq=512,
-        )
+        self.cfg = cfg or DEFAULT_LM_CONFIG
         if params is None:
             params = tfm.init_params(jax.random.PRNGKey(seed), self.cfg)
         self.params = params
@@ -117,8 +122,15 @@ class _LmRunner:
         if n_prompt_tokens == 0:
             raise InferenceServerException("empty prompt", status="400")
 
-    def stream(self, tokens, max_tokens, temperature=0.0, seed=0):
+    def stream(self, tokens, max_tokens, temperature=0.0, seed=0,
+               top_k=0, tenant=""):
         self.check_prompt(int(np.asarray(tokens).reshape(-1).shape[0]))
+        if top_k and int(top_k) > 0:
+            raise InferenceServerException(
+                "top_k sampling needs the continuous-batching engine "
+                "(lm_streaming_batched); this model samples the full "
+                "distribution", status="400",
+            )
         key = jax.random.PRNGKey(seed) if temperature > 0 else None
         for tok in tfm.generate(
             self.params, self.cfg, tokens, max_tokens,
@@ -143,7 +155,14 @@ def lm_streaming_model(name="lm_streaming", runner=None):
         max_tokens = int(np.asarray(inputs["MAX_TOKENS"]).flatten()[0])
         temperature = float(params.get("temperature", 0.0) or 0.0)
         seed = int(params.get("seed", 0) or 0)
-        for tok in runner.stream(tokens, max_tokens, temperature, seed):
+        # top_k rides as a request parameter; __tenant__ is the RESERVED
+        # caller identity the engine stamps from x-tenant-id (decoupled
+        # models bypass the front door, so lane quotas are enforced at
+        # decode-lane admission inside the LM engine instead)
+        top_k = int(params.get("top_k", 0) or 0)
+        tenant = str(params.get("__tenant__", "") or "")
+        for tok in runner.stream(tokens, max_tokens, temperature, seed,
+                                 top_k=top_k, tenant=tenant):
             piece = decode_tokens([tok]).encode("utf-8")
             yield {
                 "TOKEN": np.array([tok], dtype=np.int32),
@@ -166,23 +185,38 @@ def lm_streaming_model(name="lm_streaming", runner=None):
 
 
 def lm_streaming_batched_model(name="lm_streaming_batched", runner=None,
-                               max_slots=8):
+                               max_slots=8, **engine_kwargs):
     """Decoupled LM with CONTINUOUS BATCHING: concurrent streams share one
-    batched decode tick per token step (models/continuous.py), so aggregate
-    tokens/sec scales with active streams instead of serializing whole
-    per-request decode programs.  Greedy decoding (the scheduler's batched
-    argmax); same request/response surface as lm_streaming — the model IS
-    lm_streaming_model with the batched runner behind it."""
+    batched decode tick per token step (serve/lm: paged KV cache, bucketed
+    + chunked prefill, lane autoscaling), so aggregate tokens/sec scales
+    with active streams instead of serializing whole per-request decode
+    programs.  Per-request ``temperature``/``top_k``/``seed`` sample
+    inside the jitted tick via per-lane RNG keys; same request/response
+    surface as lm_streaming — the model IS lm_streaming_model with the
+    batched runner behind it."""
     from client_tpu.serve.models.continuous import BatchedLmRunner
 
     base = runner or _LmRunner()
     batched = BatchedLmRunner(
         base.params, base.cfg, max_slots=max_slots, eos_id=_EOS,
-        check_prompt=base.check_prompt,
+        check_prompt=base.check_prompt, **engine_kwargs,
     )
     model = lm_streaming_model(name=name, runner=batched)
-    # the scheduler's thread + lane KV cache release with the engine
+    # the scheduler's thread + paged KV pool release with the engine
     model.closer = batched.scheduler.close
+
+    def bind(engine):
+        """Late-bind the owning InferenceEngine's observability + QoS
+        (add_model calls this): lane/KV gauges land in the server's
+        /metrics registry, per-tick spans ride its tracer, and tenant
+        decode-lane quotas come from the front door's TenantQoS."""
+        sched = batched.scheduler
+        sched.set_registry(engine.metrics)
+        sched.tracer = engine.tracer
+        if engine.qos is not None:
+            sched.tenant_lane_share = engine.qos.lane_share
+
+    model.binder = bind
     return model
 
 

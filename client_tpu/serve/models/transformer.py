@@ -354,6 +354,65 @@ def decode_step(params, token, cfg, cache):
     return logits, cache
 
 
+def paged_attention(q, pool_k, pool_v, tables, pos, cfg, block_size):
+    """Attention of ``q`` ([B,T,H,hd], already roped) against a PAGED KV
+    cache: ``pool_k``/``pool_v`` are one layer's block pools
+    ([n_blocks+1, block_size, n_kv, hd], serve/lm/kv.KvBlockPool layout)
+    and ``tables`` ([B, table_width] int32) maps each lane's logical
+    block index to its physical pool block.  Length-masked at ``pos``
+    ([B,T] logical query positions; keys at logical position j attend
+    iff j <= pos), so trash-mapped rows are never read.
+
+    This is the serving cache layout of serve/lm: the contiguous
+    ``init_cache`` [B, max_seq, ...] layout pins max_seq rows per lane
+    forever; the paged layout pools HBM across lanes and a lane holds
+    only ceil((prompt+budget)/block_size) blocks.
+    """
+    b = q.shape[0]
+    hd = cfg.head_dim
+    n_rep = cfg.n_heads // cfg.n_kv_heads
+    s_len = tables.shape[-1] * block_size
+    kk = pool_k[tables].reshape(b, s_len, cfg.n_kv_heads, hd)
+    vv = pool_v[tables].reshape(b, s_len, cfg.n_kv_heads, hd)
+    kk = _repeat_kv(kk, n_rep)
+    vv = _repeat_kv(vv, n_rep)
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, kk, preferred_element_type=jnp.float32
+    ) * (hd ** -0.5)
+    valid = jnp.arange(s_len)[None, None, :] <= pos[:, :, None]  # [B,T,S]
+    s = jnp.where(valid[:, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(vv.dtype), vv)
+
+
+def lm_flops_per_token(cfg, context=0):
+    """Model FLOPs one generated token costs (the MFU denominator for
+    `tokens/sec` headlines, the LM analog of vision.cnn_flops_per_image).
+
+    Counts 2 FLOPs per weight element in every matmul a token traverses
+    (the PaLM 2N convention): attention projections, FFN (top_k experts
+    for MoE configs — the routed math, not the dense formulation's
+    all-experts execution), and the lm_head.  ``context`` > 0 adds the
+    attention score/combine term (4 * n_heads * head_dim * context per
+    layer), which depends on live sequence length; pass a typical
+    context (e.g. prompt_len + max_tokens/2) for decode-phase MFU.
+    """
+    hd = cfg.head_dim
+    attn_w = (
+        cfg.d_model * cfg.n_heads * hd          # wq
+        + 2 * cfg.d_model * cfg.n_kv_heads * hd  # wk, wv
+        + cfg.n_heads * hd * cfg.d_model         # wo
+    )
+    ffn_active = 3 * cfg.d_model * cfg.d_ff
+    if cfg.n_experts > 0:
+        ffn_active *= cfg.top_k
+        ffn_active += cfg.d_model * cfg.n_experts  # router
+    per_layer = 2 * (attn_w + ffn_active)
+    per_layer += 4 * cfg.n_heads * hd * int(context)  # scores + combine
+    head = 2 * cfg.d_model * cfg.vocab_size
+    return cfg.n_layers * per_layer + head
+
+
 def _next_token_nll(logits, targets):
     """Mean next-token cross-entropy: logits [B,T,V] f32, targets [B,T]."""
     logp = jax.nn.log_softmax(logits, axis=-1)

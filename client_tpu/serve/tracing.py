@@ -26,6 +26,7 @@ trace).
 
 import collections
 import threading
+import time
 
 from client_tpu.tracing import (
     append_trace_record,
@@ -162,6 +163,10 @@ class Tracer:
         self._seq = 0
         self._pending_flush = []
         self.completed = collections.deque(maxlen=max_traces)
+        # scheduler tick spans live apart from request traces: they fire
+        # hundreds of times a second and must not evict request spans
+        self._tick_seen = 0
+        self.tick_completed = collections.deque(maxlen=max_traces)
 
     def enabled(self):
         levels = self._settings.get("trace_level") or ["OFF"]
@@ -213,19 +218,57 @@ class Tracer:
         """Record a finished trace; export per log_frequency."""
         if trace is None:
             return
+        self._complete_into(trace, self.completed)
+
+    def _complete_into(self, trace, store):
+        """Shared completion tail for request and tick spans: append to
+        *store* and batch-flush to the trace file per log_frequency."""
         trace_file = self._settings.get("trace_file") or ""
         log_frequency = max(
             self._int_setting(self._settings, "log_frequency", 0), 0
         )
         to_write = []
         with self._lock:
-            self.completed.append(trace)
+            store.append(trace)
             if trace_file:
                 self._pending_flush.append(trace.to_json())
                 if len(self._pending_flush) >= max(log_frequency, 1):
                     to_write = self._pending_flush
                     self._pending_flush = []
         self._write(trace_file, to_write)
+
+    def tick_span(self, kind, t0, t1):
+        """One continuous-batching scheduler tick as a completed COMPUTE
+        span under the synthetic model name ``__lm_<kind>__`` (kinds:
+        ``decode``, ``prefill_chunk``).  ``t0``/``t1`` are monotonic
+        seconds; the span is stamped onto the wall clock ending now, so
+        tick spans interleave with request spans in the exported trace
+        file — the per-tick jitter/fairness evidence the LM engine's
+        head-of-line and starvation proofs read.
+
+        Ticks subsample on ``trace_rate`` with their OWN counter and land
+        in ``tick_completed``: decode ticks fire hundreds of times per
+        second, so sharing the request path's ``trace_count`` budget or
+        its bounded ``completed`` deque would exhaust the budget (and
+        evict every real request trace) within seconds."""
+        if not self.enabled():
+            return
+        rate = max(self._int_setting(self._settings, "trace_rate", 1), 1)
+        with self._lock:
+            seen = self._tick_seen
+            self._tick_seen += 1
+            if seen % rate:
+                return
+            self._seq += 1
+            seq = self._seq
+        span = RequestTrace(
+            gen_trace_id(), gen_span_id(),
+            model_name=f"__lm_{kind}__", seq=seq,
+        )
+        now = time.time_ns()
+        span.event("COMPUTE_START", now - int((t1 - t0) * 1e9))
+        span.event("COMPUTE_END", now)
+        self._complete_into(span, self.tick_completed)
 
     def flush(self):
         """Force any buffered records to the trace file (engine close)."""

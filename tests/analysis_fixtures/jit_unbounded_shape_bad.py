@@ -1,0 +1,36 @@
+"""JIT-UNBOUNDED-SHAPE fixture: the pre-fix per-prompt-length prefill
+recompile shape (serve/models/continuous.py before serve/lm) — a jitted
+callable fed an array whose leading shape derives from request data,
+with no bucketing/padding on the path.  One distinct prompt length =
+one fresh XLA executable, unbounded by anything but client behavior."""
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def prefill(params, tokens, cache=None):
+    return tokens, cache
+
+
+class Scheduler:
+    def __init__(self, params, cfg):
+        self.params = params
+        self._prefill = jax.jit(functools.partial(prefill, cfg=cfg))
+
+    def admit(self, prompt_tokens):
+        # ragged reshape: the resulting [1, T] shape follows the request
+        prompt = np.asarray(prompt_tokens, np.int32).reshape(1, -1)
+        logits, _ = self._prefill(self.params, jnp.asarray(prompt))
+        return logits
+
+    def admit_unsanitized_rebind(self, prompt_tokens):
+        # last assignment wins the other way: a ragged reshape AFTER a
+        # sanitizer re-taints the name before the jitted dispatch
+        prompt = pad_prompt(np.asarray(prompt_tokens, np.int32), 64)
+        prompt = np.asarray(prompt_tokens, np.int32).reshape(1, -1)
+        logits, _ = self._prefill(self.params, jnp.asarray(prompt))
+        return logits

@@ -1,0 +1,59 @@
+"""JIT-UNBOUNDED-SHAPE clean fixture: the fixed shape — the ragged
+request array passes through a pad/bucket sanitizer before any jitted
+dispatch, so the compiled-executable set is bounded by the bucket set
+(serve/lm/policy.pad_prompt + geometric buckets)."""
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+BUCKETS = (16, 32, 64)
+
+
+def prefill(params, tokens, cache=None):
+    return tokens, cache
+
+
+def pad_prompt(prompt, width, pad_id=0):
+    out = np.full((1, width), pad_id, np.int32)
+    out[0, : prompt.shape[1]] = prompt[0]
+    return out
+
+
+def bucket_for(n):
+    for width in BUCKETS:
+        if n <= width:
+            return width
+    return BUCKETS[-1]
+
+
+class Scheduler:
+    def __init__(self, params, cfg):
+        self.params = params
+        self._prefill = jax.jit(functools.partial(prefill, cfg=cfg))
+
+    def admit(self, prompt_tokens):
+        prompt = np.asarray(prompt_tokens, np.int32).reshape(1, -1)
+        # the sanitizer fixes the dispatch shape to a bucket member
+        chunk = pad_prompt(prompt, bucket_for(prompt.shape[1]))
+        logits, _ = self._prefill(self.params, jnp.asarray(chunk))
+        return logits
+
+    def admit_inline(self, prompt_tokens):
+        prompt = np.asarray(prompt_tokens, np.int32).reshape(1, -1)
+        # inline sanitizer call inside the argument list is also fixed
+        logits, _ = self._prefill(
+            self.params, jnp.asarray(pad_prompt(prompt, 64))
+        )
+        return logits
+
+    def admit_rebind(self, prompt_tokens):
+        # sanitize-in-place: the LAST assignment to the name is the
+        # sanitizer, which clears the earlier ragged-reshape taint
+        prompt = np.asarray(prompt_tokens, np.int32).reshape(1, -1)
+        prompt = pad_prompt(prompt, bucket_for(prompt.shape[1]))
+        logits, _ = self._prefill(self.params, jnp.asarray(prompt))
+        return logits

@@ -44,7 +44,7 @@ def test_registry_has_all_rules():
     assert set(REGISTRY) >= {
         "NPY-TRUTH", "ASYNC-BLOCK", "LOCK-DISPATCH", "QUEUE-SENTINEL",
         "CV-WAIT-LOOP", "SHARED-MUT", "TIME-WALL", "METRIC-LABEL",
-        "RESP-PARAM-OVERWRITE", "BARE-SUPPRESS",
+        "RESP-PARAM-OVERWRITE", "BARE-SUPPRESS", "JIT-UNBOUNDED-SHAPE",
     }
     assert set(PROGRAM_REGISTRY) >= {
         "LOCK-INV", "BLOCK-UNDER-LOCK", "CALLBACK-UNDER-LOCK",
@@ -175,6 +175,23 @@ def test_resp_param_overwrite_hits():
 
 def test_resp_param_overwrite_clean():
     assert _scan("resp_param_overwrite_ok.py") == []
+
+
+def test_jit_unbounded_shape_hits():
+    """The per-prompt-length prefill recompile shape (pre-serve/lm
+    continuous.py): a jitted callable fed a ragged-reshaped request
+    array with no pad/bucket sanitizer on the path."""
+    findings = _scan("jit_unbounded_shape_bad.py")
+    assert _rules_hit(findings) == ["JIT-UNBOUNDED-SHAPE"]
+    assert len(findings) == 2  # plain ragged + sanitize-then-re-taint
+    assert "pad/bucket" in findings[0].message
+
+
+def test_jit_unbounded_shape_clean():
+    """pad_prompt on the assignment path, inline in the argument list,
+    AND rebinding the name to the sanitizer after a ragged reshape
+    (last assignment wins) all fix the dispatch shape — no finding."""
+    assert _scan("jit_unbounded_shape_ok.py") == []
 
 
 def test_time_wall_hits():

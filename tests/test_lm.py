@@ -1,0 +1,577 @@
+"""Continuous-batching LM engine (client_tpu/serve/lm): the four-pillar
+acceptance — bounded prefill compiles (bucketing), chunked prefill
+interleaved with decode (head-of-line fix), paged KV accounting, lane
+autoscaling + tenant lane quotas — plus per-lane sampling determinism
+and the >=128-stream churn soak (slow tier, `make soak`)."""
+
+import queue
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from client_tpu.serve.lm import KvBlockPool, LmEngine
+from client_tpu.serve.lm.policy import (
+    LaneAutoscaler,
+    bucket_for,
+    chunk_plan,
+    geometric_buckets,
+    pad_prompt,
+)
+from client_tpu.serve.metrics import Registry
+from client_tpu.serve.models import transformer as tfm
+
+CLOSE = LmEngine.CLOSE
+
+CFG = tfm.TransformerConfig(
+    vocab_size=128,
+    d_model=64,
+    n_layers=2,
+    n_heads=4,
+    n_kv_heads=2,
+    d_ff=128,
+    max_seq=96,
+    dtype="float32",
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return tfm.init_params(jax.random.PRNGKey(0), CFG)
+
+
+def _serial(params, prompt, n):
+    return list(tfm.generate(params, CFG, prompt, n, readback_depth=0))
+
+
+def _collect(q, timeout=120):
+    out = []
+    while True:
+        tok = q.get(timeout=timeout)
+        if tok is CLOSE:
+            return out
+        out.append(tok)
+
+
+# -- policy units ----------------------------------------------------------
+
+def test_geometric_buckets_and_lookup():
+    assert geometric_buckets(16, 64) == (16, 32, 64)
+    assert geometric_buckets(16, 48) == (16, 32, 48)
+    assert geometric_buckets(64, 64) == (64,)
+    assert bucket_for(1, (16, 32)) == 16
+    assert bucket_for(17, (16, 32)) == 32
+    assert bucket_for(999, (16, 32)) == 32  # multi-chunk prompts
+
+
+def test_chunk_plan_widths_are_bucket_members():
+    buckets = geometric_buckets(4, 16)
+    for n in range(1, 60):
+        plan = chunk_plan(n, buckets)
+        assert all(width in buckets for _, width in plan), (n, plan)
+        covered = sum(width for _, width in plan)
+        assert covered >= n
+        # starts tile the prompt contiguously
+        assert [s for s, _ in plan] == [
+            i * buckets[-1] for i in range(len(plan))
+        ] or len(plan) == 1
+
+
+def test_pad_prompt_rejects_overflow():
+    with pytest.raises(ValueError):
+        pad_prompt(np.zeros((1, 8), np.int32), 4)
+
+
+def test_lane_autoscaler_hysteresis():
+    sc = LaneAutoscaler((2, 4, 8), up_after=2, down_after=3)
+    assert sc.n_lanes == 2
+    assert not sc.note_starved()
+    assert sc.note_starved()  # 2 consecutive -> step up
+    assert sc.n_lanes == 4
+    # ok passes with active work below the lower count start the idle run
+    for _ in range(2):
+        assert not sc.note_ok(False, 0)
+    assert sc.note_ok(False, 0)  # 3rd idle pass -> step down
+    assert sc.n_lanes == 2
+    # pending work resets the idle run
+    sc2 = LaneAutoscaler((2, 4), up_after=1, down_after=2)
+    sc2.note_starved()
+    assert sc2.n_lanes == 4
+    sc2.note_ok(False, -1)
+    sc2.note_ok(True, -1)  # pending: reset
+    sc2.note_ok(False, -1)
+    assert sc2.n_lanes == 4
+
+
+# -- paged KV pool ---------------------------------------------------------
+
+def test_kv_pool_alloc_release_and_gauges():
+    reg = Registry()
+    pool = KvBlockPool(CFG, n_blocks=8, block_size=16, registry=reg)
+    assert pool.blocks_for(1) == 1
+    assert pool.blocks_for(16) == 1
+    assert pool.blocks_for(17) == 2
+    a = pool.alloc(3)
+    assert len(a) == 3 and KvBlockPool.TRASH not in a
+    assert pool.used_blocks == 3 and pool.free_blocks == 5
+    assert reg.get("ctpu_lm_kv_blocks_used") == 3
+    assert reg.get("ctpu_lm_kv_blocks_free") == 5
+    assert pool.alloc(6) is None  # over-ask: backpressure, not partial
+    pool.release(a)
+    assert pool.free_blocks == 8
+    assert reg.get("ctpu_lm_kv_blocks_used") == 0
+
+
+# -- engine: correctness through the paged/chunked path --------------------
+
+def test_streams_match_serial_including_multi_chunk_prefill(params):
+    eng = LmEngine(params, CFG, max_slots=4, lane_counts=(4,),
+                   block_size=8, prefill_chunk=16, min_bucket=4)
+    try:
+        prompts = [[1, 2, 3], [7, 9], list(range(1, 41)), [11, 3, 2, 8]]
+        lengths = [6, 9, 5, 7]
+        qs = [eng.submit(p, n)[0] for p, n in zip(prompts, lengths)]
+        got = [_collect(q) for q in qs]
+        for p, n, toks in zip(prompts, lengths, got):
+            assert toks == _serial(params, p, n), (p, n)
+    finally:
+        eng.close()
+
+
+def test_bounded_prefill_compile_over_distinct_lengths(params):
+    """THE bounded-compile proof: many distinct prompt lengths compile at
+    most len(buckets) prefill executables (jax jit cache-size counter);
+    the unbucketed prototype compiled one per distinct length."""
+    eng = LmEngine(params, CFG, max_slots=2, lane_counts=(2,),
+                   block_size=8, prefill_chunk=16, min_bucket=4)
+    try:
+        lengths = list(range(1, 15)) + [20, 27, 40]  # 17 distinct lengths
+        for n in lengths:
+            q, _ = eng.submit(list(range(1, n + 1)), 2)
+            _collect(q)
+        compiled = eng.prefill_executables()
+        assert compiled is not None
+        assert compiled <= len(eng.buckets), (compiled, eng.buckets)
+        assert eng.decode_executables() <= len(eng.lane_counts)
+    finally:
+        eng.close()
+
+
+def test_chunked_prefill_interleaves_with_decode(params):
+    """THE head-of-line proof: with active token streams, admitting a
+    novel multi-chunk prompt keeps decode ticking BETWEEN its prefill
+    chunks (trace-timestamp assertion) — the prototype ran the whole
+    prefill (plus its XLA compile) as one stall."""
+    eng = LmEngine(params, CFG, max_slots=4, lane_counts=(4,),
+                   block_size=8, prefill_chunk=16, min_bucket=4)
+    try:
+        s1, _ = eng.submit([1, 2, 3], 60)
+        s2, _ = eng.submit([9, 4], 60)
+        # both streams demonstrably live before the long prompt arrives
+        assert s1.get(timeout=60) is not CLOSE
+        assert s2.get(timeout=60) is not CLOSE
+        t_submit = time.monotonic()
+        long_q, _ = eng.submit(list(range(1, 49)), 4)  # 48 tok = 3 chunks
+        assert _collect(long_q) == _serial(params, list(range(1, 49)), 4)
+        _collect(s1)
+        _collect(s2)
+        trace = eng.tick_trace()
+        chunks = [r for r in trace
+                  if r["kind"] == "prefill_chunk" and r["t0"] >= t_submit]
+        assert len(chunks) == 3, chunks  # 48 tokens / 16-wide chunks
+        decodes = [r for r in trace if r["kind"] == "decode"]
+        # structural interleave: >=1 decode tick between consecutive chunks
+        for a, b in zip(chunks, chunks[1:]):
+            between = [r for r in decodes if a["t1"] <= r["t0"] <= b["t0"]]
+            assert between, (a, b)
+        # numeric jitter bound: during the prefill window, decode
+        # tick-to-tick gaps stay within one chunk budget (chunk + tick +
+        # scheduling slack), never the whole-prefill stall
+        window = [r for r in decodes
+                  if chunks[0]["t0"] <= r["t0"] <= chunks[-1]["t1"]]
+        budget = (
+            max(r["t1"] - r["t0"] for r in chunks)
+            + max(r["t1"] - r["t0"] for r in decodes)
+            + 0.5
+        )
+        for a, b in zip(window, window[1:]):
+            assert b["t0"] - a["t0"] <= budget, (a, b, budget)
+    finally:
+        eng.close()
+
+
+def test_lane_autoscaling_up_on_queue_depth_then_down(params):
+    eng = LmEngine(params, CFG, max_slots=4, lane_counts=(1, 2, 4),
+                   block_size=8, prefill_chunk=16, min_bucket=4,
+                   scale_up_after=2, scale_down_after=3)
+    try:
+        qs = [eng.submit([i + 1, i + 2], 25)[0] for i in range(4)]
+        got = [_collect(q) for q in qs]
+        for i, toks in enumerate(got):
+            assert toks == _serial(params, [i + 1, i + 2], 25)
+        # sustained queue depth stepped the lane count up to the max
+        assert max(r["n_lanes"] for r in eng.tick_trace()) == 4
+        # drained + idle: hysteresis steps back down (idle passes tick at
+        # the scheduler's wait timeout)
+        deadline = time.monotonic() + 10
+        while eng._scaler.n_lanes != 1 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert eng._scaler.n_lanes == 1
+    finally:
+        eng.close()
+
+
+def test_kv_pool_exhaustion_backpressures_admission(params):
+    """A request that cannot reserve its blocks queues until a completion
+    frees them — admission backpressure, not an error and not a partial
+    reservation."""
+    reg = Registry()
+    # pool sized to hold exactly ONE 40-token reservation (3 blocks of 16
+    # + the engine floors n_blocks at table_width=6)
+    eng = LmEngine(params, CFG, max_slots=2, lane_counts=(2,),
+                   block_size=16, pool_tokens=96, prefill_chunk=16,
+                   min_bucket=4, registry=reg)
+    try:
+        q1, _ = eng.submit([1, 2, 3, 4], 60)  # 64 tok -> 4 blocks of 6
+        assert q1.get(timeout=60) is not CLOSE
+        used_during = reg.get("ctpu_lm_kv_blocks_used")
+        assert used_during == 4
+        q2, _ = eng.submit([5, 6], 40)  # needs 3 blocks; only 2 free
+        got2 = _collect(q2)  # completes AFTER q1 frees its reservation
+        assert got2 == _serial(params, [5, 6], 40)
+        _collect(q1)
+        assert reg.get("ctpu_lm_kv_blocks_used") == 0  # all freed
+    finally:
+        eng.close()
+
+
+def test_tenant_lane_quota_admission_policy(params):
+    """The quota decision itself, driven deterministically against a
+    frozen lane state (the scheduler thread starts lazily, so the locked
+    helpers can be exercised race-free): while tenant B waits, tenant A
+    at ceil(share * lanes) held lanes is SKIPPED and B's handle is
+    picked even though A is first in round-robin order; once B's queue
+    drains the quota lifts (work-conserving)."""
+    from collections import deque
+
+    from client_tpu.serve.lm.engine import _Handle
+
+    eng = LmEngine(params, CFG, max_slots=4, lane_counts=(4,),
+                   block_size=8, prefill_chunk=16, min_bucket=4,
+                   tenant_lane_share=0.5)
+
+    def handle(tenant):
+        return _Handle(np.zeros((1, 2), np.int32), 4, queue.Queue(),
+                       tenant, 0.0, 0, 0)
+
+    ha, hb = handle("a"), handle("b")
+    with eng._cv:
+        for i in range(2):  # a already holds ceil(0.5 * 4) = 2 lanes
+            eng._lanes[i].active = True
+            eng._lanes[i].tenant = "a"
+        eng._pending["a"] = deque([ha])
+        eng._pending["b"] = deque([hb])
+        assert eng._tenant_quota_locked("a", 4, others_pending=True) == 2
+        assert eng._tenant_quota_locked("a", 4, others_pending=False) == 4
+        picked = eng._pick_pending_locked(4)
+        assert picked is hb  # a over quota while b waits
+        # b's backlog drained: a's quota lifts and its handle is admissible
+        assert eng._pick_pending_locked(4) is ha
+        for i in range(2):
+            eng._lanes[i].active = False
+
+
+def test_tenant_lane_quota_bounds_flood_integration(params):
+    """A tenant flooding the engine with long streams cannot starve a
+    late-arriving tenant: B's short stream completes before A's flood
+    drains (A is quota-capped to 1 of 2 lanes whenever B waits)."""
+    eng = LmEngine(params, CFG, max_slots=2, lane_counts=(2,),
+                   block_size=8, prefill_chunk=16, min_bucket=4,
+                   tenant_lane_share=0.5)
+    try:
+        flood = [eng.submit([i + 1, i + 2], 40, tenant="a")[0]
+                 for i in range(4)]
+        qb, _ = eng.submit([9, 9], 5, tenant="b")
+        done = {}
+
+        def drain(name, q):
+            _collect(q)
+            done[name] = time.monotonic()
+
+        threads = [
+            threading.Thread(target=drain, args=(f"a{i}", q), daemon=True)
+            for i, q in enumerate(flood)
+        ] + [threading.Thread(target=drain, args=("b", qb), daemon=True)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+            assert not t.is_alive()
+        assert done["b"] < max(v for k, v in done.items() if k != "b")
+    finally:
+        eng.close()
+
+
+def test_uncontended_tenant_uses_all_lanes(params):
+    """The quota binds only while another tenant waits: a lone tenant's
+    two streams run on both lanes concurrently (work-conserving)."""
+    eng = LmEngine(params, CFG, max_slots=2, lane_counts=(2,),
+                   block_size=8, prefill_chunk=16, min_bucket=4,
+                   tenant_lane_share=0.5)
+    try:
+        q1, _ = eng.submit([1, 2], 20, tenant="a")
+        q2, _ = eng.submit([3, 4], 20, tenant="a")
+        assert _collect(q1) == _serial(params, [1, 2], 20)
+        assert _collect(q2) == _serial(params, [3, 4], 20)
+        # both lanes streamed at once at some point
+        assert any(
+            len(r["lanes"]) == 2 for r in eng.tick_trace()
+            if r["kind"] == "decode"
+        )
+    finally:
+        eng.close()
+
+
+def test_pending_map_evicts_drained_tenants(params):
+    """Tenant ids are client-minted (x-tenant-id): a drained tenant's
+    _pending entry must be evicted, or a rotating-id flood grows the map
+    (and every scheduler pass's scan) without bound."""
+    eng = LmEngine(params, CFG, max_slots=2, lane_counts=(2,),
+                   block_size=8, prefill_chunk=16, min_bucket=4)
+    try:
+        qs = [eng.submit([i + 1, 2], 3, tenant=f"t{i}")[0]
+              for i in range(6)]
+        for q in qs:
+            _collect(q)
+        # cancel-from-pending also evicts: both lanes held first, so the
+        # cancelled handle is still queued when cancel() lands
+        busy1, _ = eng.submit([5, 6], 30, tenant="busy")
+        busy2, _ = eng.submit([6, 7], 30, tenant="busy")
+        assert busy1.get(timeout=60) is not CLOSE
+        assert busy2.get(timeout=60) is not CLOSE
+        q7, h7 = eng.submit([1, 2], 3, tenant="t-cancel")
+        eng.cancel(h7)
+        assert _collect(q7) == []
+        _collect(busy1)
+        _collect(busy2)
+        with eng._cv:
+            assert not eng._pending, dict(eng._pending)
+    finally:
+        eng.close()
+
+
+# -- per-lane sampling -----------------------------------------------------
+
+def test_sampling_seed_deterministic_and_varied(params):
+    eng = LmEngine(params, CFG, max_slots=2, lane_counts=(2,),
+                   block_size=8, prefill_chunk=16, min_bucket=4)
+    try:
+        kw = dict(temperature=0.8, top_k=8)
+        s1 = _collect(eng.submit([1, 2, 3], 10, seed=42, **kw)[0])
+        s2 = _collect(eng.submit([1, 2, 3], 10, seed=42, **kw)[0])
+        s3 = _collect(eng.submit([1, 2, 3], 10, seed=7, **kw)[0])
+        greedy = _collect(eng.submit([1, 2, 3], 10)[0])
+        assert s1 == s2  # same seed, same lane-RNG path
+        assert s1 != s3 or s1 != greedy  # sampling actually samples
+        assert greedy == _serial(params, [1, 2, 3], 10)
+    finally:
+        eng.close()
+
+
+def test_mixed_greedy_and_sampled_lanes_share_one_tick(params):
+    """A greedy lane must decode EXACTLY the serial stream while a
+    sampled lane shares its batched tick (temperature 0 takes the
+    on-device argmax; the executable count does not grow)."""
+    eng = LmEngine(params, CFG, max_slots=2, lane_counts=(2,),
+                   block_size=8, prefill_chunk=16, min_bucket=4)
+    try:
+        qg, _ = eng.submit([1, 2, 3], 15)
+        qs, _ = eng.submit([4, 5], 15, temperature=1.2, top_k=4, seed=9)
+        got_g = _collect(qg)
+        got_s = _collect(qs)
+        assert got_g == _serial(params, [1, 2, 3], 15)
+        assert len(got_s) == 15
+        assert eng.decode_executables() <= len(eng.lane_counts)
+    finally:
+        eng.close()
+
+
+def test_top_k_above_static_cap_rejected(params):
+    """The jitted tick's per-lane top-k filter has a static width: a k
+    above it must 400, not silently sample a narrower distribution than
+    the client asked for."""
+    from client_tpu.serve.lm.engine import _TOPK_CAP
+    from client_tpu.serve.models.continuous import BatchedLmRunner
+    from client_tpu.utils import InferenceServerException
+
+    runner = BatchedLmRunner(params, CFG, max_slots=1, lane_counts=(1,),
+                             block_size=8, prefill_chunk=16, min_bucket=4)
+    try:
+        with pytest.raises(InferenceServerException) as exc:
+            next(runner.stream([1, 2], 4, temperature=1.0,
+                               top_k=_TOPK_CAP + 1))
+        assert exc.value.status() == "400"
+        # at the cap is fine
+        assert len(list(
+            runner.stream([1, 2], 4, temperature=1.0, top_k=_TOPK_CAP)
+        )) == 4
+    finally:
+        runner.scheduler.close()
+
+
+def test_top_k_restricts_support(params):
+    """top_k=1 IS greedy (the filtered distribution has one atom), at
+    any temperature — the tightest sampling-correctness check that needs
+    no distribution test."""
+    eng = LmEngine(params, CFG, max_slots=1, lane_counts=(1,),
+                   block_size=8, prefill_chunk=16, min_bucket=4)
+    try:
+        got = _collect(
+            eng.submit([1, 2, 3], 12, temperature=5.0, top_k=1, seed=3)[0]
+        )
+        assert got == _serial(params, [1, 2, 3], 12)
+    finally:
+        eng.close()
+
+
+# -- engine metrics / spans ------------------------------------------------
+
+def test_engine_metrics_and_tick_spans(params):
+    from client_tpu.serve.tracing import Tracer
+
+    reg = Registry()
+    settings = {"trace_level": ["TIMESTAMPS"], "trace_rate": "1",
+                "trace_count": "1", "trace_file": "", "log_frequency": "0"}
+    tracer = Tracer(settings)
+    eng = LmEngine(params, CFG, max_slots=2, lane_counts=(2,),
+                   block_size=8, prefill_chunk=16, min_bucket=4,
+                   registry=reg, tracer=tracer)
+    try:
+        _collect(eng.submit([1, 2, 3], 6)[0])
+        assert reg.get("ctpu_lm_tokens_total") == 6
+        assert reg.get("ctpu_lm_prefill_chunks_total") >= 1
+        assert reg.get("ctpu_lm_lanes") == 2
+        kinds = {t.model_name for t in tracer.tick_completed}
+        assert "__lm_decode__" in kinds
+        assert "__lm_prefill_chunk__" in kinds
+        for t in tracer.tick_completed:
+            names = [e["name"] for e in t.timestamps]
+            assert names == ["COMPUTE_START", "COMPUTE_END"]
+        # tick spans never touch the request-trace budget or deque: a
+        # decode loop must not starve/evict real request traces
+        assert not any(
+            t.model_name.startswith("__lm_") for t in tracer.completed
+        )
+        assert tracer.sample(model_name="req") is not None
+    finally:
+        eng.close()
+
+
+# -- soak: >=128 concurrent streams under churn (slow tier) ----------------
+
+@pytest.mark.slow
+def test_soak_128_streams_submit_cancel_churn(params):
+    """The production acceptance: 128 concurrent streams on ONE engine
+    through submit/cancel churn — zero client-visible errors (every
+    stream terminates; survivors decode EXACTLY their serial greedy
+    stream), no stream starved (bounded inter-token gap while the engine
+    ran), compiled executables bounded by the bucket/lane-count sets,
+    every KV block freed.  Runs under the lock-order witness in
+    `make soak`."""
+    n_streams = 128
+    max_tokens = 6
+    eng = LmEngine(params, CFG, max_slots=8, lane_counts=(2, 4, 8),
+                   block_size=8, prefill_chunk=16, min_bucket=4,
+                   scale_up_after=2, registry=Registry())
+    lengths = (2, 3, 5)
+    prompts = [
+        [((i * 7 + j) % 120) + 1 for j in range(lengths[i % 3])]
+        for i in range(n_streams)
+    ]
+    expected = {}
+    for p in prompts:
+        expected.setdefault(tuple(p), _serial(params, p, max_tokens))
+    results = [None] * n_streams
+    gaps = [0.0] * n_streams
+
+    def run(i):
+        q, handle = eng.submit(prompts[i], max_tokens)
+        toks = []
+        cancel_after = 2 if i % 9 == 0 else None
+        last = None
+        try:
+            while True:
+                tok = q.get(timeout=300)
+                now = time.monotonic()
+                if tok is CLOSE:
+                    break
+                if last is not None:
+                    gaps[i] = max(gaps[i], now - last)
+                last = now
+                toks.append(tok)
+                if cancel_after is not None and len(toks) >= cancel_after:
+                    eng.cancel(handle)
+                    cancel_after = None  # queue still drains to CLOSE
+            results[i] = ("cancelled" if i % 9 == 0 else "done", toks)
+        except Exception as e:  # pragma: no cover - failure path
+            results[i] = ("error", repr(e))
+
+    try:
+        threads = [
+            threading.Thread(target=run, args=(i,), daemon=True)
+            for i in range(n_streams)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+            assert not t.is_alive(), "stream reader wedged"
+
+        errors = [r for r in results if r is None or r[0] == "error"]
+        assert not errors, errors[:5]
+        for i, (status, toks) in enumerate(results):
+            want = expected[tuple(prompts[i])]
+            if status == "done":
+                assert toks == want, (i, toks, want)
+            else:  # cancelled mid-flight: clean prefix, then CLOSE
+                assert toks == want[: len(toks)], (i, toks, want)
+        # no starvation: while streaming, no stream waited unboundedly
+        # between its own tokens (generous CI bound; the unbounded-stall
+        # failure mode is minutes, not seconds)
+        assert max(gaps) < 30.0, max(gaps)
+        # bounded executable sets survived the churn
+        assert eng.prefill_executables() <= len(eng.buckets)
+        assert eng.decode_executables() <= len(eng.lane_counts)
+        # autoscaling engaged under 128-deep queues
+        assert max(r["n_lanes"] for r in eng.tick_trace()) == 8
+        # chunked-prefill interleave held under churn: between any two
+        # consecutive prefill chunks with active lanes, decode ticked
+        trace = eng.tick_trace()
+        decodes = [r for r in trace if r["kind"] == "decode"]
+        assert len(decodes) >= max_tokens  # batched, not serialized
+        # every reservation returned
+        assert eng.kv.used_blocks == 0
+    finally:
+        eng.close()
+
+
+def test_close_releases_everything(params):
+    eng = LmEngine(params, CFG, max_slots=2, lane_counts=(2,),
+                   block_size=8, prefill_chunk=16, min_bucket=4)
+    q1, _ = eng.submit([1, 2], 50)
+    assert q1.get(timeout=60) is not CLOSE
+    q2, _ = eng.submit([3, 4], 50)
+    q3, _ = eng.submit([5, 6], 50)  # pending (no free lane)
+    eng.close()
+    for q in (q1, q2, q3):
+        while True:
+            if q.get(timeout=10) is CLOSE:
+                break
+    assert eng.kv.used_blocks == 0
+    # post-close submit is a closed stream, not queued work
+    q4, h4 = eng.submit([1], 4)
+    assert h4 is None
+    assert q4.get(timeout=10) is CLOSE

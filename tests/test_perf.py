@@ -336,6 +336,35 @@ class TestProfiler:
         status = prof.profile_level("concurrency", 1)
         assert status.error_count == 9  # 3 per window
 
+    def test_request_rate_binary_probes_start(self):
+        """Bisection midpoints never reach lo, so `start` gets its own
+        explicit probe: a capacity at/just above start must report start
+        as the best passing rate, not 'SLO violated everywhere'."""
+
+        class _RateMgr(_FakeManager):
+            def __init__(self):
+                super().__init__([])
+                self.rate = None
+
+            def change_request_rate(self, r):
+                self.rate = r
+
+            def swap_timestamps(self):
+                now = time.monotonic_ns()
+                lat = 1_000_000 if self.rate <= 60 else 50_000_000
+                self._sent += 20
+                return [RequestRecord(now - lat, now, True)
+                        for _ in range(20)]
+
+        prof = InferenceProfiler(_RateMgr(), measurement_window_s=0.02)
+        results, best = prof.profile_request_rate_binary(50, 400, 10_000)
+        assert best is not None
+        assert best.level_value == 50
+        # and an SLO no rate meets still reports None (start probed+failed)
+        prof2 = InferenceProfiler(_RateMgr(), measurement_window_s=0.02)
+        _, none_best = prof2.profile_request_rate_binary(50, 400, 1)
+        assert none_best is None
+
     def test_percentiles_monotone(self):
         lats = [(int(n), True) for n in np.linspace(1e6, 9e6, 50)]
         prof = self._profiler([lats] * 10)
@@ -481,6 +510,74 @@ class TestEndToEndInprocess:
         out = capsys.readouterr().out
         assert "Request Rate: 100" in out
         assert rc == 0
+
+    def test_request_rate_binary_search_finds_slo_rate(self, capsys):
+        """--binary-search + --request-rate-range + -l: SLO-seeking
+        bisection over REQUEST RATE (the capacity-planning search;
+        profile_concurrency_binary only answers the closed-loop
+        question) — converges to a passing rate under a generous SLO."""
+        from client_tpu.perf.__main__ import main
+
+        rc = main([
+            "-m", "simple", "--hermetic",
+            "--request-rate-range", "50:400",
+            "--binary-search",
+            "-l", "500",  # msec; hermetic latencies are ~0.2 ms
+            "--measurement-interval", "100",
+            "--max-trials", "3",
+            "-s", "90",
+        ])
+        out = capsys.readouterr().out
+        assert "Max sustainable rate under SLO" in out
+        assert rc == 0
+
+    def test_request_rate_binary_search_slo_unmeetable(self, capsys):
+        """An SLO below any achievable latency reports no passing rate
+        (best=None) instead of fabricating one."""
+        from client_tpu.perf.__main__ import main
+
+        rc = main([
+            "-m", "simple", "--hermetic",
+            "--request-rate-range", "50:200",
+            "--binary-search",
+            "-l", "0.000001",
+            "--measurement-interval", "100",
+            "--max-trials", "3",
+            "-s", "90",
+        ])
+        out = capsys.readouterr().out
+        assert "SLO violated at every probed rate" in out
+        assert rc == 0
+
+    def test_json_export_per_sweep_point(self, tmp_path, capsys):
+        """--json-export writes one full record per sweep point (all
+        percentiles + server stats deltas — the fields the flat CSV
+        cannot hold) alongside the CSV."""
+        import json
+
+        from client_tpu.perf.__main__ import main
+
+        json_path = tmp_path / "report.json"
+        csv_path = tmp_path / "report.csv"
+        rc = main([
+            "-m", "simple", "--hermetic",
+            "--concurrency-range", "1:2",
+            "--measurement-interval", "100",
+            "--max-trials", "3",
+            "-s", "90",
+            "-f", str(csv_path),
+            "--json-export", str(json_path),
+        ])
+        assert rc == 0
+        doc = json.loads(json_path.read_text())
+        assert len(doc["results"]) == 2
+        for rec in doc["results"]:
+            assert rec["level_label"] == "concurrency"
+            assert rec["throughput_infer_per_sec"] > 0
+            assert set(rec["percentiles_us"]) == {"50", "90", "95", "99"}
+            assert "server_stats" in rec and "per_tenant" in rec
+        # CSV rode along untouched
+        assert csv_path.read_text().startswith("Level,Inferences/Second")
 
 
 class TestValidation:
