@@ -661,6 +661,109 @@ def _run_lm_inproc(n_streams=8, max_tokens=32):
     }
 
 
+def _run_lm_prefix(prompts=24, prompt_len=64, share=0.8, max_tokens=4,
+                   shared_pool=2):
+    """KV prefix-cache + preemption headline, in-process on the engine.
+
+    Shared-prefix workload (``share`` of every prompt drawn from
+    ``shared_pool`` shared prefixes) vs the same prompts on a cold
+    (cache-disabled) engine: ``lm_prefix_hit_pct`` is the block-adoption
+    rate and ``lm_prefill_tokens_saved_pct`` the measured prefill-compute
+    drop — the win production prompt reuse (system prompts, few-shot
+    templates, chat history) buys.  ``lm_preempt_resume_ms`` times the
+    swap path: a low-priority stream preempted for a high-priority
+    admission under a deliberately exhausted pool, swap-out to host →
+    swap-in, stream byte-exact throughout."""
+    import threading
+
+    from client_tpu.serve.lm import LmEngine
+    from client_tpu.serve.metrics import Registry
+    from client_tpu.serve.models.language import _EOS, _LmRunner
+
+    # float weights, like the served lm_streaming_batched model (the
+    # int8 kernel's off-TPU interpret mode would swamp the measurement)
+    base = _LmRunner()
+    params, cfg = base.params, base.cfg
+    rng = np.random.default_rng(7)
+    prefixes = [rng.integers(1, 256, int(round(share * prompt_len)))
+                for _ in range(shared_pool)]
+    prompt_set = []
+    for i in range(prompts):
+        row = rng.integers(1, 256, prompt_len)
+        row[: len(prefixes[0])] = prefixes[i % shared_pool]
+        prompt_set.append(row.astype(np.int32))
+
+    def run(prefix_on):
+        reg = Registry()
+        eng = LmEngine(params, cfg, max_slots=4, eos_id=_EOS,
+                       prefix_cache=prefix_on, registry=reg)
+        try:
+            warm_q, _ = eng.submit(prompt_set[0], 2)
+            while warm_q.get(timeout=600) is not LmEngine.CLOSE:
+                pass
+            t0 = time.perf_counter()
+            qs = [eng.submit(p, max_tokens)[0] for p in prompt_set]
+            for q in qs:
+                while q.get(timeout=600) is not LmEngine.CLOSE:
+                    pass
+            elapsed = time.perf_counter() - t0
+            computed = int(reg.get("ctpu_lm_prefill_tokens_total") or 0)
+            stats = eng.prefix_stats()
+        finally:
+            eng.close()
+        return computed, elapsed, stats
+
+    cold_tokens, cold_s, _ = run(False)
+    warm_tokens, warm_s, stats = run(True)
+    looked = stats.get("hits", 0) + stats.get("misses", 0)
+    result = {
+        "lm_prefix_hit_pct": round(
+            100.0 * stats.get("hits", 0) / looked, 1
+        ) if looked else 0.0,
+        "lm_prefill_tokens_saved_pct": round(
+            100.0 * (cold_tokens - warm_tokens) / cold_tokens, 1
+        ) if cold_tokens else 0.0,
+        "lm_prefix_share": share,
+        "lm_prefix_prompts": prompts,
+        "lm_prefix_cold_s": round(cold_s, 3),
+        "lm_prefix_warm_s": round(warm_s, 3),
+    }
+
+    # preemption: pool sized so the high-priority admission cannot fit
+    # beside the low-priority stream — 9 blocks of 64 (the pool floors
+    # n_blocks at table_width = ceil(max_seq/block_size), so the big
+    # block size is what makes a genuinely small pool possible); each
+    # stream reserves 5.  Resume latency = swap-out -> reactivation.
+    eng = LmEngine(params, cfg, max_slots=2, lane_counts=(2,),
+                   block_size=64, pool_tokens=576,
+                   eos_id=None, prefix_cache=True,
+                   tenant_priority={"gold": 10.0}, registry=Registry())
+    try:
+        q_lo, _ = eng.submit([5] * 8, 260, tenant="free")
+        assert q_lo.get(timeout=600) is not LmEngine.CLOSE
+        q_hi, _ = eng.submit([7] * 8, 260, tenant="gold")
+
+        def drain(q):
+            while q.get(timeout=600) is not LmEngine.CLOSE:
+                pass
+
+        t_lo = threading.Thread(target=drain, args=(q_lo,), daemon=True)
+        t_hi = threading.Thread(target=drain, args=(q_hi,), daemon=True)
+        t_lo.start()
+        t_hi.start()
+        t_lo.join(timeout=600)
+        t_hi.join(timeout=600)
+        ps = eng.preempt_stats()
+        if ps["resume_ms"]:
+            result["lm_preempt_resume_ms"] = round(
+                float(np.median(ps["resume_ms"])), 1
+            )
+            result["lm_preemptions"] = ps["preemptions"]
+    finally:
+        eng.close()
+    return result
+
+
 def _lm_prompt(i):
     # zero-padded so EVERY prompt (and the warmup) encodes to the same
     # token shape — the LM forward is shape-keyed jit
@@ -875,6 +978,7 @@ def main():
     finally:
         server.stop()
     lm_inproc = attempt("lm_inproc", _run_lm_inproc) or {}
+    lm_prefix = attempt("lm_prefix", _run_lm_prefix) or {}
 
     # Headline instrument: the native C++ worker when built (GIL-free async
     # contexts — measures the SERVER, not the client); the python-harness
@@ -1101,6 +1205,7 @@ def main():
         **lm_native,
         **lm_batched,
         **lm_inproc,
+        **lm_prefix,
         **link,
     }
     if lm:

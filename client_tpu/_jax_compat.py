@@ -1,0 +1,38 @@
+"""Shims over jax API renames, shared by every kernel/mesh module.
+
+The repo is written against the current jax surface; older releases in
+some images spell two things differently:
+
+- ``pltpu.CompilerParams`` was ``pltpu.TPUCompilerParams`` (same kwargs
+  for everything we pass — ``dimension_semantics``);
+- ``jax.shard_map`` lived at ``jax.experimental.shard_map.shard_map``
+  with the replication checker spelled ``check_rep`` instead of
+  ``check_vma``.
+
+One home for both so the next rename is a one-file fix instead of a
+hunt across every pallas kernel.
+"""
+
+import jax
+from jax.experimental.pallas import tpu as _pltpu
+
+# Pallas TPU compiler-params class under whichever name this jax has.
+CompilerParams = getattr(
+    _pltpu, "CompilerParams", getattr(_pltpu, "TPUCompilerParams", None)
+)
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma=True):
+    """``jax.shard_map`` across the rename.  On the legacy path the
+    ``check_rep`` checker is SKIPPED: it predates the varying-axis (vma)
+    semantics this code is written against and rejects valid programs
+    (e.g. a causal ring's ``lax.cond`` under grad — jax's own error text
+    suggests ``check_rep=False``); it is static validation only, never
+    part of the compiled program."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+    return _legacy_shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_rep=False)

@@ -1137,3 +1137,104 @@ class JitUnboundedShapeRule(Rule):
                         "shape first",
                     ))
         return findings
+
+
+_REFCOUNT_NAME_RE = re.compile(
+    r"(^|_)(refs?|ref_?counts?)$", re.IGNORECASE
+)
+
+
+@register
+class RefcountPairRule(Rule):
+    """REFCOUNT-PAIR — a class increments a refcount attribute with no
+    decrement anywhere in the class.
+
+    The paged KV pool's block sharing (serve/lm/kv.py) lives and dies by
+    refcount discipline: ``retain`` adds a reference, ``release`` drops
+    one, and a reference that is incremented but never decremented is a
+    LEAKED SHARED BLOCK — never freed, never readable, silently shrinking
+    the pool until admission backpressure bricks the engine.  The leak is
+    invisible in tests that don't drain to zero, which is exactly how it
+    ships.
+
+    Heuristic: within one class, an increment of an attribute or mapping
+    whose name looks refcount-ish (``refs``, ``_refs``, ``refcount``,
+    ``*_refcount``, ``ref_count``) — ``+= 1``-style AugAssign or an
+    ``x = <ref> + n`` rebind — must be paired with a decrement of the
+    SAME name somewhere in the class (``-=`` or a ``<ref> - n``
+    expression on every holder's exit path; the class-level pairing is
+    the static floor we can check).  A class that only ever increments
+    gets one finding per incrementing method.
+    """
+
+    id = "REFCOUNT-PAIR"
+    rationale = (
+        "a refcount incremented with no paired decrement is a leaked "
+        "shared block: the pool shrinks until admission bricks "
+        "(serve/lm/kv.py retain/release discipline)"
+    )
+
+    @staticmethod
+    def _ref_name(node):
+        """The refcount-ish name a target/operand refers to, or None.
+        Accepts ``self._refs`` (Attribute), ``self._refs[b]`` (Subscript
+        over an Attribute/Name) and bare ``refs`` names."""
+        if isinstance(node, ast.Subscript):
+            node = node.value
+        if isinstance(node, ast.Attribute):
+            name = node.attr
+        elif isinstance(node, ast.Name):
+            name = node.id
+        else:
+            return None
+        return name if _REFCOUNT_NAME_RE.search(name) else None
+
+    @classmethod
+    def _deltas(cls, fn):
+        """(increments, decrements) ref-name sets in one function."""
+        incs, decs = {}, set()
+        for node in _walk_no_functions(fn):
+            if isinstance(node, ast.AugAssign):
+                name = cls._ref_name(node.target)
+                if name is None:
+                    continue
+                if isinstance(node.op, ast.Add):
+                    incs.setdefault(name, node)
+                elif isinstance(node.op, ast.Sub):
+                    decs.add(name)
+            elif isinstance(node, ast.BinOp):
+                # x = self._refs[b] + 1 / left = self._refs[b] - 1 forms
+                name = cls._ref_name(node.left)
+                if name is None:
+                    continue
+                if isinstance(node.op, ast.Add):
+                    incs.setdefault(name, node)
+                elif isinstance(node.op, ast.Sub):
+                    decs.add(name)
+        return incs, decs
+
+    def check(self, tree, lines, path):
+        findings = []
+        for cls_node in ast.walk(tree):
+            if not isinstance(cls_node, ast.ClassDef):
+                continue
+            incs = {}   # ref name -> [(method, witness node), ...]
+            decs = set()
+            for fn in _functions(cls_node):
+                fn_incs, fn_decs = self._deltas(fn)
+                for name, node in fn_incs.items():
+                    incs.setdefault(name, []).append((fn.name, node))
+                decs.update(fn_decs)
+            for name, sites in sorted(incs.items()):
+                if name in decs:
+                    continue
+                for method, node in sites:
+                    findings.append(self.finding(
+                        path, lines, node,
+                        f"{method}() increments {name} but class "
+                        f"{cls_node.name} never decrements it — a "
+                        "leaked reference is a block the pool can "
+                        "neither free nor read; pair every retain "
+                        "with a release on each holder's exit path",
+                    ))
+        return findings

@@ -29,6 +29,8 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from client_tpu._jax_compat import CompilerParams as _CompilerParams
+
 
 def quantize_int8(w):
     """Per-output-channel symmetric int8 quantization of a [K, N] weight.
@@ -113,7 +115,7 @@ def int8_matmul(x, qw, block_m=128, block_n=128, block_k=512,
         ],
         out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
         scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,

@@ -135,6 +135,20 @@ def build_parser():
     p.add_argument("--shape", action="append", default=[],
                    help="NAME:d1,d2,... override for dynamic dims")
     p.add_argument("--string-length", type=int, default=16)
+    p.add_argument("--prefix-share", type=float, default=None,
+                   help="LM workload knob: generate prompts whose leading "
+                        "FRAC of tokens comes from a small shared prefix "
+                        "pool (see --prefix-pool), so the KV prefix "
+                        "cache's prefill savings are measurable; with "
+                        "--hermetic the summary/CSV/JSON gain per-sweep "
+                        "prefix_hit_pct + prefill_tokens_saved_pct from "
+                        "the engine's counters")
+    p.add_argument("--prefix-pool", type=int, default=4,
+                   help="number of distinct shared prefixes --prefix-share "
+                        "draws from (smaller pool = hotter prefixes)")
+    p.add_argument("--prefix-prompts", type=int, default=16,
+                   help="distinct prompts generated for --prefix-share "
+                        "(workers rotate over them)")
     p.add_argument("--tenants", default=None,
                    help="tenant mix for the worker slots: "
                         "'gold:3,bronze:1' assigns slots to tenants "
@@ -523,7 +537,18 @@ def main(argv=None):
             inputs_meta, batch_size=args.batch_size,
             shape_overrides=shape_overrides,
         )
-        if args.input_data in (None, "random"):
+        if args.prefix_share is not None:
+            if args.input_data not in (None, "random"):
+                sys.exit("error: --prefix-share generates its own prompt "
+                         "workload; drop --input-data")
+            if args.native_loadgen:
+                sys.exit("error: --prefix-share rotates a prompt set; the "
+                         "native engine repeats one fixed request")
+            loader.generate_prefix_share(
+                args.prefix_share, num_prompts=args.prefix_prompts,
+                shared_pool=args.prefix_pool,
+            )
+        elif args.input_data in (None, "random"):
             loader.generate_data(string_length=args.string_length)
         elif args.input_data == "zero":
             loader.generate_data(zero_data=True,
@@ -694,6 +719,26 @@ def main(argv=None):
             measurement_mode=args.measurement_mode,
             measurement_request_count=args.measurement_request_count,
         )
+        if args.prefix_share is not None and engine is not None:
+            # hermetic runs read the LM engine's prefix counters straight
+            # from the in-process registry; socket runs have no per-level
+            # counter deltas to offer (scrape aggregates only)
+            registry = engine.metrics
+
+            def _prefix_probe():
+                def count(name):
+                    return int(registry.get(name) or 0)
+
+                return {
+                    "hits": count("ctpu_lm_prefix_hits_total"),
+                    "misses": count("ctpu_lm_prefix_misses_total"),
+                    "prefill_tokens": count("ctpu_lm_prefill_tokens_total"),
+                    "saved_tokens": count(
+                        "ctpu_lm_prefill_tokens_saved_total"
+                    ),
+                }
+
+            profiler.prefix_probe = _prefix_probe
 
         json_extra = {}
         try:

@@ -107,6 +107,78 @@ class DataLoader:
         lo, hi = max(info.min, -1024), min(info.max, 1024)
         return self._rng.integers(lo, hi + 1, shape).astype(np_dtype)
 
+    def generate_prefix_share(self, share, num_prompts=16, shared_pool=4,
+                              scalar_int_value=16, vocab=256):
+        """LM workload with a controlled prompt-prefix share (the
+        ``--prefix-share`` knob): ``num_prompts`` streams whose token
+        input starts with one of ``shared_pool`` shared prefixes covering
+        ``share`` of the prompt, the tail unique per stream — so a
+        KV prefix cache's prefill savings are measurable from the CLI
+        (share 0.8 ≈ 80% of prefill compute adoptable once warm).
+
+        The prompt rides the first multi-element INT tensor (``TOKENS``
+        by name when present); values stay in ``[1, vocab)`` so byte-
+        vocab LMs accept them.  Single-element INT inputs (``MAX_TOKENS``
+        and friends) get ``scalar_int_value`` — a random budget could be
+        negative, which would make every stream empty.  Other inputs
+        generate as usual.
+        """
+        share = float(share)
+        if not 0.0 <= share <= 1.0:
+            raise InferenceServerException(
+                f"--prefix-share must be in [0, 1], got {share}"
+            )
+        token_meta = None
+        for meta in self._inputs:
+            shape = _resolve_shape(
+                meta["shape"], self._batch, self._shapes, meta["name"]
+            )
+            if not meta["datatype"].startswith(("INT", "UINT")):
+                continue
+            if meta["name"] == "TOKENS":
+                token_meta = meta
+                break
+            if token_meta is None and int(np.prod(shape)) > 1:
+                token_meta = meta
+        if token_meta is None:
+            raise InferenceServerException(
+                "--prefix-share needs an integer token input (e.g. the "
+                "LM models' TOKENS); this model has none"
+            )
+        token_shape = _resolve_shape(
+            token_meta["shape"], self._batch, self._shapes,
+            token_meta["name"],
+        )
+        prompt_len = int(np.prod(token_shape))
+        prefix_len = int(round(share * prompt_len))
+        prefixes = [
+            self._rng.integers(1, vocab, prefix_len).astype(np.int32)
+            for _ in range(max(int(shared_pool), 1))
+        ]
+        self.streams = []
+        for i in range(int(num_prompts)):
+            step = {}
+            for meta in self._inputs:
+                name = meta["name"]
+                shape = _resolve_shape(
+                    meta["shape"], self._batch, self._shapes, name
+                )
+                if meta is token_meta:
+                    row = self._rng.integers(
+                        1, vocab, prompt_len
+                    ).astype(np.int32)
+                    row[:prefix_len] = prefixes[i % len(prefixes)]
+                    arr = row.reshape(token_shape)
+                elif (meta["datatype"].startswith(("INT", "UINT"))
+                        and int(np.prod(shape)) == 1):
+                    arr = np.full(shape, int(scalar_int_value),
+                                  triton_to_np_dtype(meta["datatype"]))
+                else:
+                    arr = self._gen_tensor(meta["datatype"], shape, False, 16)
+                step[name] = TensorData(arr)
+            self.streams.append([step])
+        self.expected_outputs = [[{}] for _ in self.streams]
+
     # -- directory of raw files ----------------------------------------------
 
     def read_data_from_dir(self, data_dir):

@@ -46,6 +46,10 @@ class PerfStatus:
         # harness bookkeeping + data rotation (reference "perf_analyzer
         # overhead", inference_profiler.h:430-533).
         self.overhead_pct = 0.0
+        # --prefix-share sweeps: this level's KV prefix-cache outcome
+        # ({"prefix_hit_pct", "prefill_tokens_saved_pct", raw deltas};
+        # empty when no prefix probe is wired)
+        self.lm_prefix = {}
 
     def latency_us(self, percentile=None):
         if percentile is None:
@@ -107,6 +111,11 @@ class InferenceProfiler:
             )
         self.measurement_mode = measurement_mode
         self.request_count = int(measurement_request_count)
+        # optional zero-arg callable returning the LM engine's prefix-
+        # cache counters ({hits, misses, prefill_tokens, saved_tokens});
+        # wired by the CLI for --prefix-share runs so every sweep level
+        # reports its hit rate and prefill savings as a counter DELTA
+        self.prefix_probe = None
 
     # -- one window ----------------------------------------------------------
 
@@ -235,6 +244,34 @@ class InferenceProfiler:
 
     def profile_level(self, label, value):
         """Run windows at the current manager configuration until stable."""
+        before_prefix = (
+            self.prefix_probe() if self.prefix_probe is not None else None
+        )
+        status = self._profile_level_windows(label, value)
+        if before_prefix is not None:
+            status.lm_prefix = self._prefix_delta(before_prefix)
+        return status
+
+    def _prefix_delta(self, before):
+        after = self.prefix_probe()
+        delta = {k: after.get(k, 0) - before.get(k, 0) for k in after}
+        looked = delta.get("hits", 0) + delta.get("misses", 0)
+        prefilled = (
+            delta.get("prefill_tokens", 0) + delta.get("saved_tokens", 0)
+        )
+        return {
+            "prefix_hit_pct": (
+                round(100.0 * delta.get("hits", 0) / looked, 2)
+                if looked else 0.0
+            ),
+            "prefill_tokens_saved_pct": (
+                round(100.0 * delta.get("saved_tokens", 0) / prefilled, 2)
+                if prefilled else 0.0
+            ),
+            **delta,
+        }
+
+    def _profile_level_windows(self, label, value):
         if self.metrics is not None:
             self.metrics.swap_snapshots()  # drop pre-level scrapes
         window = []

@@ -56,6 +56,13 @@ def print_summary(results, percentile=None):
             print(
                 f"    {gauge}: avg {agg['avg']:.0f}, max {agg['max']:.0f}"
             )
+        if s.lm_prefix:
+            print(
+                f"    prefix cache: {s.lm_prefix['prefix_hit_pct']:.1f}% "
+                "block hit rate, "
+                f"{s.lm_prefix['prefill_tokens_saved_pct']:.1f}% prefill "
+                "tokens saved"
+            )
         if s.overhead_pct:
             print(f"    harness overhead: {s.overhead_pct:.1f}% of slot time")
         if s.server_stats:
@@ -117,6 +124,10 @@ def write_csv(path, results, verbose=False):
             "Server Queue", "Server Compute Input", "Server Compute Infer",
             "Server Compute Output", "Server Cache Hits",
         ]
+    # --prefix-share sweeps: the per-level KV prefix-cache outcome
+    has_prefix = any(s.lm_prefix for s in results)
+    if has_prefix:
+        fields += ["Prefix Hit %", "Prefill Tokens Saved %"]
     # ensemble targets: one queue/compute column pair per composing model
     # (the reference appends per-composing columns the same way)
     composing = sorted({n for s in results for n in s.ensemble_stats})
@@ -153,6 +164,12 @@ def write_csv(path, results, verbose=False):
                     f"{srv.get('compute_output_ns', 0) / cnt / 1e3:.0f}",
                     str(srv.get("cache_hit_count", 0)),
                 ]
+            if has_prefix:
+                row += (
+                    [f"{s.lm_prefix['prefix_hit_pct']:.2f}",
+                     f"{s.lm_prefix['prefill_tokens_saved_pct']:.2f}"]
+                    if s.lm_prefix else ["", ""]
+                )
             for name in composing:
                 counters = s.ensemble_stats.get(name)
                 if not counters:
@@ -193,6 +210,7 @@ def status_record(s):
         "tpu_metrics": s.tpu_metrics,
         "server_stats": s.server_stats,
         "ensemble_stats": s.ensemble_stats,
+        "lm_prefix": s.lm_prefix,
     }
 
 

@@ -134,7 +134,9 @@ class ResponseCache:
         self.ttl_s = ttl_s
         self.registry = registry
         self._lock = threading.Lock()
-        self._entries = OrderedDict()  # key -> (value, nbytes, stored_at)
+        # key -> (value, nbytes, stored_at, ttl_s) — ttl_s None means
+        # the cache-wide default applies
+        self._entries = OrderedDict()
         self._bytes = 0
         self.hits = 0
         self.misses = 0
@@ -174,15 +176,16 @@ class ResponseCache:
         now = time.monotonic()
         with self._lock:
             entry = self._entries.get(key)
-            if entry is not None and (
-                self.ttl_s is not None and now - entry[2] > self.ttl_s
-            ):
-                self._entries.pop(key)
-                self._bytes -= entry[1]
-                self.evictions += 1
-                self._gauges_locked()
-                entry = None
-                self._inc("ctpu_cache_evictions_total", {"reason": "ttl"})
+            if entry is not None:
+                ttl = entry[3] if entry[3] is not None else self.ttl_s
+                if ttl is not None and now - entry[2] > ttl:
+                    self._entries.pop(key)
+                    self._bytes -= entry[1]
+                    self.evictions += 1
+                    self._gauges_locked()
+                    entry = None
+                    self._inc("ctpu_cache_evictions_total",
+                              {"reason": "ttl"})
             if entry is None:
                 self.misses += 1
                 self._inc("ctpu_cache_misses_total")
@@ -192,9 +195,14 @@ class ResponseCache:
             self._inc("ctpu_cache_hits_total")
             return entry[0]
 
-    def put(self, key, response_json, blobs):
+    def put(self, key, response_json, blobs, ttl_s=None):
         """Insert one rendered response (no-op for values that alone exceed
-        the byte bound — caching them would evict the whole working set)."""
+        the byte bound — caching them would evict the whole working set).
+
+        ``ttl_s`` overrides the cache-wide TTL for THIS entry — the
+        per-model ``response_cache`` config block's freshness hint (a
+        weather model's answers go stale in seconds, an embedding
+        model's never do)."""
         nbytes = _response_nbytes(response_json, blobs)
         if nbytes > self.max_bytes:
             return
@@ -203,13 +211,15 @@ class ResponseCache:
             old = self._entries.pop(key, None)
             if old is not None:
                 self._bytes -= old[1]
-            self._entries[key] = (value, nbytes, time.monotonic())
+            self._entries[key] = (value, nbytes, time.monotonic(), ttl_s)
             self._bytes += nbytes
             while (
                 len(self._entries) > self.max_entries
                 or self._bytes > self.max_bytes
             ):
-                _, (_, evicted_bytes, _) = self._entries.popitem(last=False)
+                _, (_, evicted_bytes, _, _) = self._entries.popitem(
+                    last=False
+                )
                 self._bytes -= evicted_bytes
                 self.evictions += 1
                 self._inc("ctpu_cache_evictions_total", {"reason": "lru"})
@@ -382,12 +392,14 @@ class TenantQoS:
 
     def __init__(self, default_weight=1.0, default_max_inflight=None,
                  default_rate_per_s=None, default_burst=None,
-                 default_lane_share=0.75, tenants=None, registry=None):
+                 default_lane_share=0.75, default_priority=0.0,
+                 tenants=None, registry=None):
         self.default_weight = float(default_weight)
         self.default_max_inflight = default_max_inflight
         self.default_rate_per_s = default_rate_per_s
         self.default_burst = default_burst
         self.default_lane_share = default_lane_share
+        self.default_priority = float(default_priority)
         self.tenants = dict(tenants or {})
         self.registry = registry
         self._lock = threading.Lock()
@@ -414,6 +426,16 @@ class TenantQoS:
         binds only while someone else is queued)."""
         share = self._cfg(tenant, "lane_share", self.default_lane_share)
         return None if share is None else float(share)
+
+    def priority(self, tenant):
+        """Preemption priority class of *tenant* (per-tenant ``priority``
+        config key; higher outranks lower, default 0).  Weights shape how
+        much service a tenant gets; priority decides who keeps their KV
+        blocks when the LM engine's pool runs dry — a STRICTLY
+        higher-priority waiter may swap out a lower-priority lane (the
+        engine's preemption controller consumes this via the
+        ``tenant_priority`` hook wired in add_model)."""
+        return float(self._cfg(tenant, "priority", self.default_priority))
 
     def _state_locked(self, tenant):
         state = self._states.get(tenant)

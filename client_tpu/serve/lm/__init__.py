@@ -19,7 +19,17 @@ package's engine under its old names).  Four pillars:
 - **lane autoscaling + per-tenant lane quotas** — the engine steps
   between a small precompiled set of decode lane counts on sustained
   queue depth, and admission is tenant-aware so one tenant cannot occupy
-  every decode lane while another waits.
+  every decode lane while another waits;
+- **prefix cache** (:mod:`.prefix`) — a radix trie over token-block
+  chains adopts cached full prompt-prefix blocks BY REFERENCE at
+  admission (per-block refcounts in :mod:`.kv`), so chunked prefill
+  starts at the first non-cached block; retiring requests hand their
+  prompt blocks to the cache (LRU, evicted only under pool pressure);
+- **preemption / swap** (:class:`.engine.LmEngine`) — under pool
+  exhaustion with a strictly higher-priority tenant waiting, the
+  lowest-priority lane swaps its KV to a bounded host-side store (or
+  drops it for recompute), its stream pausing — not erroring — until
+  blocks free up, byte-exact with an unpreempted run on the swap path.
 
 Per-lane sampling (temperature / top-k via per-lane RNG keys inside the
 jitted tick) removes the old "greedy only" limitation.
@@ -33,11 +43,13 @@ from client_tpu.serve.lm.policy import (
     geometric_buckets,
     pad_prompt,
 )
+from client_tpu.serve.lm.prefix import PrefixCache
 
 __all__ = [
     "LmEngine",
     "KvBlockPool",
     "LaneAutoscaler",
+    "PrefixCache",
     "bucket_for",
     "geometric_buckets",
     "pad_prompt",

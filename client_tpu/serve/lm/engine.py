@@ -1,5 +1,6 @@
 """Continuous-batching LM engine: bucketed chunked prefill over a paged
-KV pool, lane autoscaling, per-lane sampling, tenant-aware admission.
+KV pool, prefix-cache block sharing, priority preemption with host-side
+swap, lane autoscaling, per-lane sampling, tenant-aware admission.
 
 Scheduling model (one scheduler thread, every device dispatch outside
 the condition lock — the LOCK-DISPATCH/BLOCK-UNDER-LOCK invariant the
@@ -27,11 +28,30 @@ Static shapes everywhere (TPU-first):
   temperatures and top-k — greedy lanes (temperature 0) take the
   on-device argmax, so mixed greedy/sampled batches share one program.
 
+Prefix cache (serve/lm/prefix.py): admission walks the prompt's full
+token blocks through a radix trie and ADOPTS every cached match by
+reference (per-block refcounts in kv.py), so chunked prefill starts at
+the first miss; retiring requests hand their full prompt blocks to the
+cache instead of freeing them, and the cache yields blocks back (LRU,
+leaves first) only under pool pressure.
+
+Preemption: when the pool is exhausted and a strictly higher-priority
+tenant (TenantQoS priority classes via the ``tenant_priority`` hook) is
+waiting, the lowest-priority active lane is swapped out — its written
+KV blocks copied to a bounded host-side store (or, past the swap
+budget, dropped for recompute), its stream PAUSED (no CLOSE, no error)
+— and swapped back in once blocks free up, byte-exact with an
+unpreempted run on the swap path.
+
 Safety of block recycling: device dispatches from the scheduler thread
 execute in dispatch order on one stream, so a stale in-flight tick's
 scatter into a freed block always lands before the block's next owner
 writes (and every position the next owner ever *reads* is one its own
-later dispatches wrote).
+later dispatches wrote).  Cached blocks extend the argument: no program
+ever WRITES a cached block — decode writes at ``pos >= prompt_len`` and
+prefill writes at ``pos >= adopted_start``, both past the full-prompt-
+block region the cache holds — so adopting one is a pure read of
+content whose producing dispatch already ordered before the adopter's.
 """
 
 import functools
@@ -53,6 +73,8 @@ from client_tpu.serve.lm.policy import (
     geometric_buckets,
     pad_prompt,
 )
+from client_tpu.serve.lm.prefix import PrefixCache
+from client_tpu.serve.metrics import LM_PREFIX_HELP
 from client_tpu.serve.models.transformer import (
     _ffn_block,
     _mm,
@@ -194,7 +216,7 @@ def _adopt(tokens, keys, slot, tok, key):
 class _Lane:
     __slots__ = ("gen", "active", "queue", "remaining", "produced",
                  "length", "limit", "tenant", "temperature", "top_k",
-                 "table", "blocks")
+                 "table", "blocks", "prompt", "tokens", "handle")
 
     def __init__(self, table_width):
         self.gen = 0        # bumped on every (re)assignment and cancel
@@ -209,6 +231,9 @@ class _Lane:
         self.top_k = 0
         self.table = np.zeros((table_width,), np.int32)  # trash-filled
         self.blocks = None  # reservation owned while active
+        self.prompt = None  # [1, T] prompt row (prefix-cache insertion)
+        self.tokens = []    # delivered generation tokens (recompute replay)
+        self.handle = None  # the submit() handle streaming on this lane
 
 
 class _Handle:
@@ -233,7 +258,7 @@ class _Handle:
 
 class _PrefillJob:
     __slots__ = ("handle", "slot", "blocks", "table", "plan", "chunk_idx",
-                 "key", "token")
+                 "key", "token", "resume")
 
     def __init__(self, handle, slot, blocks, table, plan, key):
         self.handle = handle
@@ -244,6 +269,48 @@ class _PrefillJob:
         self.chunk_idx = 0
         self.key = key
         self.token = None
+        # _Swapped being resumed via the recompute path (None for normal
+        # admissions): activation restores its produced/remaining state
+        # and the saved token/RNG carry instead of the chunk's sample
+        self.resume = None
+
+
+class _Swapped:
+    """One preempted stream parked off-device.
+
+    ``host_k``/``host_v`` hold the lane's written blocks per layer when
+    the swap fit the host budget; None means the recompute path (replay
+    prompt + delivered tokens through chunked prefill on resume).  The
+    stream's queue is PAUSED — no CLOSE, no error — until resume or
+    cancel."""
+
+    __slots__ = ("handle", "queue", "tenant", "prompt", "prompt_len",
+                 "produced", "remaining", "length", "limit", "temperature",
+                 "top_k", "tokens", "token", "key", "host_k", "host_v",
+                 "n_blocks", "written_blocks", "cancelled", "t_swap")
+
+    def __init__(self, lane, n_blocks, written_blocks, token, key,
+                 host_k, host_v):
+        self.handle = lane.handle
+        self.queue = lane.queue
+        self.tenant = lane.tenant
+        self.prompt = lane.prompt
+        self.prompt_len = int(lane.prompt.shape[1])
+        self.produced = lane.produced
+        self.remaining = lane.remaining
+        self.length = lane.length
+        self.limit = lane.limit
+        self.temperature = lane.temperature
+        self.top_k = lane.top_k
+        self.tokens = list(lane.tokens)
+        self.token = token          # input token for the next decode tick
+        self.key = key              # RNG carry at the preemption point
+        self.host_k = host_k
+        self.host_v = host_v
+        self.n_blocks = int(n_blocks)
+        self.written_blocks = int(written_blocks)
+        self.cancelled = False
+        self.t_swap = time.monotonic()
 
 
 class LmEngine:
@@ -264,7 +331,9 @@ class LmEngine:
                  min_bucket=16, readback_depth=8, eos_id=None,
                  check_prompt=None, registry=None, tracer=None,
                  tenant_lane_share=0.75, scale_up_after=3,
-                 scale_down_after=50, tick_log_len=8192):
+                 scale_down_after=50, tick_log_len=8192,
+                 prefix_cache=True, min_prefix_blocks=1,
+                 tenant_priority=None, swap_block_limit=None):
         self.params = params
         self.cfg = cfg
         self.max_slots = int(max_slots)
@@ -307,8 +376,23 @@ class LmEngine:
         self._inflight = deque()
         self._thread = None  # started lazily on the first submit
 
+        # prefix cache + preemption state
+        self._prefix_enabled = bool(prefix_cache)
+        self.min_prefix_blocks = int(min_prefix_blocks)
+        # tenant -> priority (callable or mapping; None/absent = 0.0) —
+        # preemption triggers only for a STRICTLY higher-priority waiter
+        self.tenant_priority = tenant_priority
+        # host-side swap store budget in blocks (None = one pool's worth)
+        self.swap_block_limit = swap_block_limit
+        self._swapped = []          # paused _Swapped streams, FIFO
+        self._swapped_blocks = 0    # blocks parked in the host store
+        self._preempt = None        # (slot, gen) chosen by _admit
+        self._preemptions = 0
+        self._resume_ms = []        # swap-out -> reactivation latencies
+
         # device state allocates lazily with the thread
         self.kv = None
+        self.prefix = None
         self._tokens = None
         self._keys = None
         # donate the KV pool buffers (args 2/3 of both programs): the
@@ -352,10 +436,30 @@ class LmEngine:
         with self._cv:
             return list(self._tick_log)
 
+    def prefix_stats(self):
+        """Prefix-cache counters ({} when the cache is disabled or the
+        engine never started)."""
+        with self._cv:
+            return {} if self.prefix is None else self.prefix.stats()
+
+    def preempt_stats(self):
+        """Preemption/swap counters: preemptions, completed resumes with
+        their swap-out -> reactivation latencies, streams still parked."""
+        with self._cv:
+            return {
+                "preemptions": self._preemptions,
+                "resumes": len(self._resume_ms),
+                "resume_ms": list(self._resume_ms),
+                "swapped_streams": len(self._swapped),
+                "swapped_blocks": self._swapped_blocks,
+            }
+
     def set_registry(self, registry):
         """Late-bind the serving metrics registry (add_model wiring)."""
         with self._cv:
             self.registry = registry
+            if self.prefix is not None:
+                self.prefix.registry = registry
             kv = self.kv
         if kv is not None:
             kv.set_registry(registry)
@@ -406,6 +510,21 @@ class LmEngine:
                 return
             if placed is _CANCELLED:
                 return
+            if isinstance(placed, _Swapped):
+                # preempted and parked: its blocks were already released
+                # at swap-out, so cancel just closes the paused stream
+                # and drops the host copies (a resume job in flight for
+                # it sees .cancelled and aborts)
+                if not placed.cancelled:
+                    placed.cancelled = True
+                    placed.queue.put(_CLOSE)
+                    if placed in self._swapped:
+                        self._swapped.remove(placed)
+                        if placed.host_k is not None:
+                            self._swapped_blocks -= placed.written_blocks
+                            self._swap_gauge_locked()
+                    placed.host_k = placed.host_v = None
+                return
             slot_idx, gen = placed
             lane = self._lanes[slot_idx]
             if lane.active and lane.gen == gen:
@@ -432,6 +551,13 @@ class LmEngine:
             block_size=self.block_size,
             registry=self.registry,
         )
+        if self._prefix_enabled:
+            self.prefix = PrefixCache(
+                self.kv, registry=self.registry,
+                min_prefix_blocks=self.min_prefix_blocks,
+            )
+        if self.swap_block_limit is None:
+            self.swap_block_limit = self.kv.n_blocks
         self._tokens = jnp.zeros((self.max_slots,), jnp.int32)
         self._keys = jnp.zeros((self.max_slots, 2), jnp.uint32)
         self._thread = threading.Thread(
@@ -439,20 +565,40 @@ class LmEngine:
         )
         self._thread.start()
 
-    def _retire_lane_locked(self, lane):
-        """Close a lane's stream and return its KV reservation."""
+    def _retire_lane_locked(self, lane, close_queue=True):
+        """Release a lane and return its KV reservation (full prompt
+        blocks go to the prefix cache; the rest free).  ``close_queue``
+        False is the preemption path: the stream pauses, it does not
+        end."""
         lane.active = False
         lane.gen += 1  # in-flight ticks for this lane drop on drain
-        lane.queue.put(_CLOSE)
+        if close_queue:
+            lane.queue.put(_CLOSE)
         lane.table[:] = KvBlockPool.TRASH
-        lane.length = 0
+        written, lane.length = lane.length, 0
+        prompt, lane.prompt = lane.prompt, None
+        lane.tokens = []
+        lane.handle = None
         blocks, lane.blocks = lane.blocks, None
         if blocks:
+            self._release_blocks_locked(prompt, written, blocks)
+
+    def _release_blocks_locked(self, prompt, written_tokens, blocks):
+        """Return one reservation: fully written FULL prompt blocks are
+        offered to the prefix cache (the holder's reference transfers or
+        drops — see PrefixCache.give_back), everything else frees."""
+        if self.prefix is None or prompt is None:
             self.kv.release(blocks)
+            return
+        prompt_row = prompt[0]
+        cacheable = (
+            min(int(written_tokens), prompt_row.shape[0]) // self.block_size
+        )
+        self.prefix.give_back(prompt_row, cacheable, blocks)
 
     def _release_all_locked(self):
-        """Close every pending/active/in-prefill stream (caller holds
-        _cv)."""
+        """Close every pending/active/in-prefill/swapped stream and drop
+        the warm cache (caller holds _cv)."""
         for lane_q in self._pending.values():
             for entry in lane_q:
                 entry.queue.put(_CLOSE)
@@ -463,12 +609,35 @@ class LmEngine:
         job, self._job = self._job, None
         if job is not None:
             self._abort_job_locked(job)
+        swapped, self._swapped = self._swapped, []
+        for entry in swapped:
+            if not entry.cancelled:
+                entry.cancelled = True
+                entry.queue.put(_CLOSE)
+        self._swapped_blocks = 0
+        self._preempt = None
+        if self.prefix is not None:
+            # AFTER every give_back above: the pool must end fully free
+            self.prefix.clear()
 
     def _abort_job_locked(self, job):
         blocks, job.blocks = job.blocks, None
         if blocks:
-            self.kv.release(blocks)
-        job.handle.queue.put(_CLOSE)
+            # chunks already dispatched cover positions up to the next
+            # chunk's start; those full prompt blocks are valid cache
+            # content even though the request died mid-prefill
+            written = (
+                job.plan[job.chunk_idx][0]
+                if job.chunk_idx < len(job.plan)
+                else job.handle.prompt_len
+            )
+            self._release_blocks_locked(job.handle.prompt, written, blocks)
+        if job.resume is not None:
+            if not job.resume.cancelled:
+                job.resume.cancelled = True
+                job.resume.queue.put(_CLOSE)
+        else:
+            job.handle.queue.put(_CLOSE)
 
     def _tenant_lanes_locked(self, tenant):
         held = sum(
@@ -491,13 +660,20 @@ class LmEngine:
         return max(1, min(n_lanes, int(np.ceil(float(share) * n_lanes))))
 
     def _pick_pending_locked(self, n_lanes):
-        """Round-robin-fair pop of the next admissible pending handle
-        (tenants at their lane quota are skipped while others wait)."""
+        """Pop the next admissible pending handle: strict priority-class
+        order first (a gold request queued behind a backpressured bronze
+        one must be picked — and preempt — FIRST, or pool exhaustion
+        re-picks the bronze head forever and preemption never fires),
+        round-robin-fair within a class (the only order when no
+        priorities are configured); tenants at their lane quota are
+        skipped while others wait."""
         tenants = [t for t, dq in self._pending.items() if dq]
         if not tenants:
             return None
-        order = tenants[self._rr % len(tenants):] + \
+        rotated = tenants[self._rr % len(tenants):] + \
             tenants[:self._rr % len(tenants)]
+        # stable sort: equal classes keep their rotated (rr) order
+        order = sorted(rotated, key=lambda t: -self._priority_of(t))
         for tenant in order:
             others = any(t != tenant and dq for t, dq in
                          self._pending.items() if dq)
@@ -524,8 +700,66 @@ class LmEngine:
             top = max(top, self._job.slot)
         return top
 
-    def _has_pending_locked(self):
+    def _queued_locked(self):
         return any(dq for dq in self._pending.values())
+
+    def _has_pending_locked(self):
+        # swapped streams count as pending pressure: they need a lane and
+        # blocks to resume, so the autoscaler must not scale down past them
+        return self._queued_locked() or bool(self._swapped)
+
+    def _priority_of(self, tenant):
+        """Priority class of *tenant* (higher preempts lower; default 0)."""
+        source = self.tenant_priority
+        if source is None:
+            return 0.0
+        value = source(tenant) if callable(source) else source.get(tenant)
+        return 0.0 if value is None else float(value)
+
+    def _pick_preempt_victim_locked(self, tenant):
+        """Lowest-priority active lane STRICTLY below *tenant*'s class
+        (ties broken toward the shortest sequence — least KV to swap);
+        None when nothing qualifies."""
+        want = self._priority_of(tenant)
+        victim = None
+        victim_key = None
+        for i, lane in enumerate(self._lanes):
+            if not lane.active:
+                continue
+            pri = self._priority_of(lane.tenant)
+            if pri >= want:
+                continue
+            key = (pri, lane.length)
+            if victim_key is None or key < victim_key:
+                victim, victim_key = i, key
+        return victim
+
+    def _restore_lane_locked(self, lane, entry, slot):
+        """Install a parked _Swapped stream's saved counters/identity on
+        a lane and stamp the resume latency.  The caller owns gen/active
+        and the table/blocks install — those differ between the swap-in
+        and recompute-replay paths."""
+        lane.queue = entry.queue
+        lane.remaining = entry.remaining
+        lane.produced = entry.produced
+        lane.length = entry.length
+        lane.limit = entry.limit
+        lane.tenant = entry.tenant
+        lane.temperature = entry.temperature
+        lane.top_k = entry.top_k
+        lane.prompt = entry.prompt
+        lane.tokens = list(entry.tokens)
+        lane.handle = entry.handle
+        if entry.handle is not None:
+            entry.handle.placed = (slot, lane.gen)
+        self._resume_ms.append((time.monotonic() - entry.t_swap) * 1e3)
+
+    def _swap_gauge_locked(self):
+        if self.registry is not None:
+            self.registry.set(
+                "ctpu_lm_swapped_blocks", None, self._swapped_blocks,
+                help_=LM_PREFIX_HELP["ctpu_lm_swapped_blocks"],
+            )
 
     def _lane_gauges_locked(self, active_count=None):
         if self.registry is None:
@@ -539,11 +773,28 @@ class LmEngine:
 
     # -- scheduler loop ----------------------------------------------------
 
+    def _reserve_locked(self, needed, matched_blocks):
+        """Allocate ``needed - len(matched)`` fresh blocks, evicting warm
+        cache blocks under pressure.  Matched blocks must already be
+        adopted (refcount >= 2) so eviction can never steal them.
+        Returns the fresh list or None."""
+        short = needed - len(matched_blocks)
+        fresh = self.kv.alloc(short)
+        if fresh is None and self.prefix is not None:
+            missing = short - self.kv.free_blocks
+            if self.prefix.evict(missing) >= missing:
+                fresh = self.kv.alloc(short)
+        return fresh
+
     def _admit(self):
         """Move one pending request into a prefill job (bookkeeping under
-        _cv; every chunk dispatch happens later, outside the lock)."""
+        _cv; every chunk dispatch happens later, outside the lock).
+        Prefix-cache adoption happens here: matched prompt blocks are
+        retained by reference and the chunk plan starts at the first
+        miss."""
         with self._cv:
-            if self._closed or self._job is not None:
+            if (self._closed or self._job is not None
+                    or self._preempt is not None):
                 return
             n_lanes = self._scaler.n_lanes
             slot = next(
@@ -575,27 +826,65 @@ class LmEngine:
             needed = self.kv.blocks_for(
                 handle.prompt_len + handle.max_tokens
             )
-            blocks = self.kv.alloc(needed)
-            if blocks is None:
-                # pool exhausted: admission backpressure until a
-                # completion frees blocks (the pick may have evicted the
-                # tenant's drained entry — recreate it)
+            matched_blocks, matched_nodes = [], []
+            shareable = (handle.prompt_len - 1) // self.block_size
+            if self.prefix is not None and shareable:
+                # cap at (prompt_len - 1): the final prompt position must
+                # always prefill — its logits seed the first new token
+                matched_blocks, matched_nodes = self.prefix.match(
+                    handle.prompt[0], shareable
+                )
+                # adopt BEFORE the allocation attempt: refcount 2 pins the
+                # matched chain against the eviction pass below
+                self.prefix.adopt(matched_nodes)
+            fresh = self._reserve_locked(needed, matched_blocks)
+            if fresh is None:
+                # pool exhausted even after cache eviction: drop the
+                # adoption, then either preempt a strictly lower-priority
+                # lane for a higher-priority waiter or backpressure until
+                # completions free blocks.  (The pick may have evicted the
+                # tenant's drained entry — recreate it.)
+                if matched_blocks:
+                    self.kv.release(matched_blocks)
+                victim = self._pick_preempt_victim_locked(handle.tenant)
+                if victim is not None:
+                    self._preempt = (victim, self._lanes[victim].gen)
                 self._pending.setdefault(
                     handle.tenant, deque()
                 ).appendleft(handle)
                 self._rr -= 1
                 return
+            blocks = matched_blocks + fresh
             table = np.full(
                 (self._table_width,), KvBlockPool.TRASH, np.int32
             )
             table[:len(blocks)] = blocks
+            start = len(matched_blocks) * self.block_size
+            if self.prefix is not None and shareable:
+                self.prefix.note_lookup(
+                    len(matched_blocks), shareable - len(matched_blocks)
+                )
+            if self.registry is not None and start:
+                self.registry.inc(
+                    "ctpu_lm_prefill_tokens_saved_total", None, value=start,
+                    help_=LM_PREFIX_HELP["ctpu_lm_prefill_tokens_saved_total"],
+                )
             # key=None: PRNGKey is itself a (jitted) device dispatch and
             # must not run under _cv — the first _prefill_step builds it
             self._job = _PrefillJob(
                 handle, slot, blocks, table,
-                chunk_plan(handle.prompt_len, self.buckets), None,
+                chunk_plan(handle.prompt_len, self.buckets, start=start),
+                None,
             )
             self._scaler.note_ok(False, self._max_active_locked())
+
+    def _job_cancelled_locked(self, job):
+        """True when the stream this job serves went away: a normal
+        admission's handle was cancelled, or a recompute-resume's
+        swapped stream was."""
+        if job.resume is not None:
+            return job.resume.cancelled
+        return job.handle.placed is _CANCELLED
 
     def _prefill_step(self):
         """Dispatch ONE chunk of the current prefill job (outside _cv);
@@ -606,7 +895,7 @@ class LmEngine:
             job = self._job
             if job is None:
                 return
-            if self._closed or job.handle.placed is _CANCELLED:
+            if self._closed or self._job_cancelled_locked(job):
                 self._abort_job_locked(job)
                 self._job = None
                 return
@@ -634,29 +923,65 @@ class LmEngine:
                 "ctpu_lm_prefill_chunks_total",
                 help_="Prefill chunks dispatched between decode ticks",
             )
+            # real (non-pad) prompt tokens this chunk computed — the
+            # denominator side of the prefix-cache savings accounting
+            self.registry.inc(
+                "ctpu_lm_prefill_tokens_total", None,
+                value=min(start + width, handle.prompt_len) - start,
+                help_=LM_PREFIX_HELP["ctpu_lm_prefill_tokens_total"],
+            )
         if job.chunk_idx < len(job.plan):
             return
         with self._cv:
             self._job = None
-            if self._closed or handle.placed is _CANCELLED:
+            if self._closed or self._job_cancelled_locked(job):
                 self._abort_job_locked(job)
                 return
             lane = self._lanes[job.slot]
+            resume = job.resume
             lane.gen += 1
             lane.active = True
-            lane.queue = handle.queue
-            lane.remaining = handle.max_tokens
-            lane.produced = 0
-            lane.length = handle.prompt_len
-            lane.limit = handle.prompt_len + handle.max_tokens
-            lane.tenant = handle.tenant
-            lane.temperature = handle.temperature
-            lane.top_k = handle.top_k
             lane.table[:] = job.table
             lane.blocks, job.blocks = job.blocks, None
-            handle.placed = (job.slot, lane.gen)
+            if resume is None:
+                lane.queue = handle.queue
+                lane.remaining = handle.max_tokens
+                lane.produced = 0
+                lane.length = handle.prompt_len
+                lane.limit = handle.prompt_len + handle.max_tokens
+                lane.tenant = handle.tenant
+                lane.temperature = handle.temperature
+                lane.top_k = handle.top_k
+                lane.prompt = handle.prompt
+                lane.tokens = []
+                lane.handle = handle
+                handle.placed = (job.slot, lane.gen)
+                if self.prefix is not None:
+                    # the prompt's full blocks are fully written as of
+                    # this chunk: publish them so a same-prefix burst
+                    # shares from the first finished prefill
+                    self.prefix.publish(
+                        handle.prompt[0],
+                        handle.prompt_len // self.block_size,
+                        lane.blocks,
+                    )
+            else:
+                # recompute-resume: the replayed prefill rebuilt the KV
+                # for prompt + delivered tokens; streaming continues from
+                # the SAVED counters, token and RNG carry — the chunk's
+                # sampled token is discarded (that position's token was
+                # already delivered before preemption)
+                self._restore_lane_locked(lane, resume, job.slot)
             snapshot = ((job.slot, lane.gen),)
             self._lane_gauges_locked()
+        if resume is not None:
+            # install the saved next-tick input token + RNG carry; nothing
+            # streams (everything up to `produced` was already delivered)
+            self._tokens, self._keys = self._adopt(
+                self._tokens, self._keys, jnp.int32(job.slot),
+                jnp.int32(resume.token), jnp.asarray(resume.key),
+            )
+            return
         # install the first token + RNG carry into the lane arrays and
         # stream the token through the readback pipeline (single-lane
         # entry, exactly like a full tick's vector)
@@ -762,6 +1087,7 @@ class LmEngine:
                 )
                 lane.queue.put(token)
                 lane.produced += 1
+                lane.tokens.append(token)  # recompute-replay history
                 if self.registry is not None:
                     self.registry.inc(
                         "ctpu_lm_tokens_total",
@@ -773,6 +1099,203 @@ class LmEngine:
                 )
                 if done:
                     self._retire_lane_locked(lane)
+
+    # -- preemption / swap -------------------------------------------------
+
+    def _preempt_step(self):
+        """Swap the victim _admit chose out to the host store (or drop
+        its KV for recompute when the store is full).  Scheduler thread;
+        every device copy runs OUTSIDE _cv."""
+        # deliver every dispatched token first so the swap record's
+        # counters (produced/length) and the lane arrays' token/RNG carry
+        # describe one consistent preemption point
+        while self._inflight:
+            self._drain_one()
+        with self._cv:
+            decision, self._preempt = self._preempt, None
+            if decision is None or self._closed:
+                return
+            slot, gen = decision
+            lane = self._lanes[slot]
+            if not lane.active or lane.gen != gen:
+                return  # completed or cancelled since the decision
+            written_blocks = -(-lane.length // self.block_size)
+            blocks = [int(b) for b in lane.table[:written_blocks]]
+            n_blocks = len(lane.blocks)
+            use_swap = (
+                self._swapped_blocks + written_blocks
+                <= self.swap_block_limit
+            )
+        # device -> host gather outside the lock: scheduler-thread
+        # dispatch order guarantees every write to these blocks was
+        # issued before this read, and nobody re-allocates them until
+        # the release below
+        host_k = host_v = None
+        if use_swap:
+            idx = jnp.asarray(np.asarray(blocks, np.int32))
+            host_k = [np.asarray(p[idx]) for p in self.kv.pools["k"]]
+            host_v = [np.asarray(p[idx]) for p in self.kv.pools["v"]]
+        token = int(np.asarray(self._tokens)[slot])
+        key = np.asarray(self._keys)[slot].copy()
+        with self._cv:
+            lane = self._lanes[slot]
+            if self._closed or not lane.active or lane.gen != gen:
+                return  # raced with cancel/close: drop the copies
+            entry = _Swapped(
+                lane, n_blocks, written_blocks, token, key, host_k, host_v
+            )
+            self._swapped.append(entry)
+            if entry.handle is not None:
+                entry.handle.placed = entry
+            if use_swap:
+                self._swapped_blocks += written_blocks
+            self._preemptions += 1
+            if self.registry is not None:
+                self.registry.inc(
+                    "ctpu_lm_preemptions_total", None,
+                    help_=LM_PREFIX_HELP["ctpu_lm_preemptions_total"],
+                )
+            self._swap_gauge_locked()
+            # pause, don't end: the stream's queue stays open
+            self._retire_lane_locked(lane, close_queue=False)
+
+    def _resume_step(self):
+        """Swap one parked stream back in when a free lane + blocks
+        exist and no queued request outranks it (otherwise the blocks a
+        preemption just freed would thrash straight back to the stream
+        it preempted)."""
+        plan = None
+        with self._cv:
+            if (self._closed or not self._swapped or self._job is not None
+                    or self._preempt is not None):
+                return
+            n_lanes = self._scaler.n_lanes
+            slot = next(
+                (i for i in range(n_lanes) if not self._lanes[i].active),
+                None,
+            )
+            if slot is None:
+                return
+            queued_pri = None
+            for tenant, dq in self._pending.items():
+                if dq:
+                    pri = self._priority_of(tenant)
+                    queued_pri = (
+                        pri if queued_pri is None else max(queued_pri, pri)
+                    )
+            order = sorted(
+                range(len(self._swapped)),
+                key=lambda i: (
+                    -self._priority_of(self._swapped[i].tenant), i,
+                ),
+            )
+            for i in order:
+                entry = self._swapped[i]
+                if entry.cancelled:
+                    continue  # cancel() removes eagerly; belt and braces
+                if (queued_pri is not None
+                        and self._priority_of(entry.tenant) < queued_pri):
+                    continue
+                if entry.host_k is not None:
+                    row = entry.prompt[0]
+                    cap = min(entry.prompt_len // self.block_size,
+                              entry.written_blocks)
+                else:
+                    # recompute: the replay chain is prompt + delivered
+                    # tokens, so cached generated-token blocks match too
+                    row = np.concatenate([
+                        entry.prompt[0],
+                        np.asarray(entry.tokens[:entry.produced - 1],
+                                   np.int32),
+                    ])
+                    cap = (entry.length - 1) // self.block_size
+                matched_blocks, matched_nodes = [], []
+                if self.prefix is not None and cap:
+                    matched_blocks, matched_nodes = self.prefix.match(
+                        row, cap
+                    )
+                    self.prefix.adopt(matched_nodes)
+                fresh = self._reserve_locked(entry.n_blocks, matched_blocks)
+                if fresh is None:
+                    if matched_blocks:
+                        self.kv.release(matched_blocks)
+                    continue
+                self._swapped.pop(i)
+                blocks = matched_blocks + fresh
+                table = np.full(
+                    (self._table_width,), KvBlockPool.TRASH, np.int32
+                )
+                table[:len(blocks)] = blocks
+                if entry.host_k is None:
+                    pseudo = row[None, :].astype(np.int32)
+                    handle = _Handle(
+                        pseudo, entry.limit - entry.length, entry.queue,
+                        entry.tenant, entry.temperature, entry.top_k, 0,
+                    )
+                    job = _PrefillJob(
+                        handle, slot, blocks, table,
+                        chunk_plan(
+                            entry.length, self.buckets,
+                            start=len(matched_blocks) * self.block_size,
+                        ),
+                        None,
+                    )
+                    job.resume = entry
+                    self._job = job  # _prefill_step replays from here
+                    return
+                plan = (entry, slot, blocks, len(matched_blocks), table,
+                        entry.host_k, entry.host_v)
+                break
+        if plan is None:
+            return
+        entry, slot, blocks, n_matched, table, host_k, host_v = plan
+        # restore the written, non-adopted blocks from the host store —
+        # un-jitted .at[].set (one pool copy per layer): resume is a rare
+        # pressure event, correctness beats the copy here
+        dst = np.asarray(blocks[n_matched:entry.written_blocks], np.int32)
+        if dst.size:
+            idx = jnp.asarray(dst)
+            sel = slice(n_matched, entry.written_blocks)
+            for layer in range(len(host_k)):
+                self.kv.pools["k"][layer] = (
+                    self.kv.pools["k"][layer].at[idx]
+                    .set(jnp.asarray(host_k[layer][sel]))
+                )
+                self.kv.pools["v"][layer] = (
+                    self.kv.pools["v"][layer].at[idx]
+                    .set(jnp.asarray(host_v[layer][sel]))
+                )
+        with self._cv:
+            if self._closed or entry.cancelled:
+                # the stream died while restoring: unwind the reservation
+                # (cancel/close already closed the queue).  host_k is the
+                # plan-local reference — cancel may have nulled the entry's.
+                if self._closed:
+                    # _release_all_locked already zeroed _swapped_blocks
+                    # (and cleared the cache), so no gauge decrement here
+                    self.kv.release(blocks)
+                else:
+                    self._release_blocks_locked(
+                        entry.prompt, entry.length, blocks
+                    )
+                    self._swapped_blocks -= entry.written_blocks
+                    self._swap_gauge_locked()
+                return
+            lane = self._lanes[slot]
+            lane.gen += 1
+            lane.active = True
+            lane.table[:] = table
+            lane.blocks = blocks
+            self._restore_lane_locked(lane, entry, slot)
+            self._swapped_blocks -= entry.written_blocks
+            self._swap_gauge_locked()
+            self._lane_gauges_locked()
+        # install the saved next-tick input token + RNG carry (scheduler
+        # thread: the next decode pass dispatches strictly after this)
+        self._tokens, self._keys = self._adopt(
+            self._tokens, self._keys, jnp.int32(slot),
+            jnp.int32(entry.token), jnp.asarray(entry.key),
+        )
 
     def _loop(self):
         try:
@@ -786,6 +1309,10 @@ class LmEngine:
 
     def _loop_inner(self):
         while True:
+            if self._preempt is not None:
+                self._preempt_step()  # device copies outside _cv
+            if self._swapped:
+                self._resume_step()
             self._admit()  # takes/releases _cv itself; no dispatch inside
             worked = False
             if self._job is not None:
@@ -802,7 +1329,10 @@ class LmEngine:
                 with self._cv:
                     if self._closed:
                         break
-                    if (not self._has_pending_locked()
+                    # swapped streams deliberately DON'T block the wait:
+                    # an unresumable one (blocks pinned) retries on the
+                    # 50ms tick instead of busy-spinning the loop
+                    if (not self._queued_locked()
                             and self._job is None
                             and not any(l.active for l in self._lanes)):
                         self._cv.wait(timeout=0.05)

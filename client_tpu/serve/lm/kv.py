@@ -1,4 +1,6 @@
-"""Paged KV cache: a block-table pool shared by every decode lane.
+"""Paged KV cache: a refcounted block-table pool shared by every decode
+lane (and, since the prefix cache, by every REQUEST that shares a prompt
+prefix).
 
 The fixed-lane prototype allocated per layer ``[lanes, max_seq, kv, hd]``
 — every lane pinned max-seq-len rows of HBM whether it held a 5-token
@@ -7,6 +9,15 @@ request, a 500-token one, or nothing.  The pool here is per layer
 reserves exactly ``ceil((prompt_len + max_tokens) / block_size)`` blocks
 at admission and frees them at completion/cancel, so HBM capacity is a
 function of *aggregate live tokens*, not ``lanes * max_seq``.
+
+Blocks carry a REFERENCE COUNT: ``alloc`` hands out blocks at refcount 1,
+``retain`` adds a reference (a second request adopting a shared prompt-
+prefix block, or the prefix cache keeping a retired request's blocks
+warm), and ``release`` decrements — a block returns to the free list only
+when its last reference drops.  That is what makes block-granular KV
+sharing safe: an 80%-shared prompt adopts its prefix blocks by reference
+instead of recomputing them, and nobody can free a block out from under
+another holder.
 
 Static shapes throughout (TPU-first): the device arrays never change
 shape; splice/free are index bookkeeping on the host plus
@@ -22,13 +33,13 @@ import threading
 import jax.numpy as jnp
 
 _KV_HELP = {
-    "ctpu_lm_kv_blocks_used": "Paged-KV blocks currently reserved",
+    "ctpu_lm_kv_blocks_used": "Paged-KV blocks currently referenced",
     "ctpu_lm_kv_blocks_free": "Paged-KV blocks free in the pool",
 }
 
 
 class KvBlockPool:
-    """Device block pool + host free-list accounting.
+    """Device block pool + host free-list/refcount accounting.
 
     ``n_blocks`` counts usable blocks; one extra trash block (index 0) is
     allocated on top, so the device arrays hold ``n_blocks + 1`` blocks.
@@ -51,6 +62,7 @@ class KvBlockPool:
         }
         self._lock = threading.Lock()
         self._free = list(range(1, self.n_blocks + 1))
+        self._refs = {}  # block -> live reference count (absent = free)
 
     def blocks_for(self, n_tokens):
         """Blocks a sequence of ``n_tokens`` total (prompt + generation
@@ -58,27 +70,55 @@ class KvBlockPool:
         return -(-int(n_tokens) // self.block_size)
 
     def alloc(self, n):
-        """Reserve ``n`` blocks; returns the block index list or None
-        when the pool cannot satisfy the reservation (admission
-        backpressure — the caller retries once completions free blocks)."""
+        """Reserve ``n`` blocks at refcount 1; returns the block index
+        list or None when the pool cannot satisfy the reservation
+        (admission backpressure — the caller evicts cache blocks,
+        preempts a lane, or retries once completions free blocks)."""
         n = int(n)
         with self._lock:
             if n > len(self._free):
                 return None
             taken = self._free[:n]
             del self._free[:n]
+            for block in taken:
+                self._refs[block] = 1
             self._gauges_locked()
             return taken
 
+    def retain(self, blocks):
+        """Add one reference to each block (prefix-cache adoption; the
+        block must already be live — retaining a freed block is a bug)."""
+        with self._lock:
+            for block in blocks:
+                self._refs[block] += 1
+
     def release(self, blocks):
-        """Return a reservation to the pool (idempotent callers pass each
-        list exactly once; double-free is a bug we guard with a set check
-        in debug runs, not in the hot path)."""
+        """Drop one reference from each block; blocks whose last
+        reference drops return to the free list.  Every ``alloc``/
+        ``retain`` must be paired with exactly one release — the
+        REFCOUNT-PAIR lint rule guards the shape (a leaked reference
+        bricks the pool: the block is never free and never readable)."""
         if not blocks:
             return
         with self._lock:
-            self._free.extend(blocks)
+            for block in blocks:
+                left = self._refs[block] - 1
+                if left > 0:
+                    self._refs[block] = left
+                else:
+                    del self._refs[block]
+                    self._free.append(block)
             self._gauges_locked()
+
+    def ref_count(self, block):
+        """Live reference count of one block (0 = free)."""
+        with self._lock:
+            return self._refs.get(block, 0)
+
+    def ref_counts(self):
+        """{block: refcount} snapshot of every live block (leak audits)."""
+        with self._lock:
+            return dict(self._refs)
 
     @property
     def free_blocks(self):

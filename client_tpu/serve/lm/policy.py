@@ -53,18 +53,26 @@ def pad_prompt(prompt, width, pad_id=0):
     return out
 
 
-def chunk_plan(prompt_len, buckets):
+def chunk_plan(prompt_len, buckets, start=0):
     """The per-chunk (start, width) dispatch plan for one prompt.
 
-    Prompts <= the largest bucket run as ONE chunk at ``bucket_for``
-    width; longer prompts run max-bucket-wide chunks back to back (the
-    final chunk pads).  Every width in the plan is a member of
-    ``buckets`` — that is the bounded-compile invariant tests assert.
+    ``start`` > 0 skips positions already in the KV cache (prefix-cache
+    adoption: the matched blocks' tokens need no recompute, so the plan
+    covers only ``[start, prompt_len)``).  Remainders <= the largest
+    bucket run as ONE chunk at ``bucket_for`` width; longer remainders
+    run max-bucket-wide chunks back to back (the final chunk pads).
+    Every width in the plan is a member of ``buckets`` — that is the
+    bounded-compile invariant tests assert: adoption changes WHERE
+    prefill starts, never which shapes compile.
     """
+    start = int(start)
+    if not 0 <= start < prompt_len:
+        raise ValueError(f"chunk start {start} outside [0, {prompt_len})")
     chunk = buckets[-1]
-    if prompt_len <= chunk:
-        return [(0, bucket_for(prompt_len, buckets))]
-    return [(start, chunk) for start in range(0, prompt_len, chunk)]
+    remaining = prompt_len - start
+    if remaining <= chunk:
+        return [(start, bucket_for(remaining, buckets))]
+    return [(s, chunk) for s in range(start, prompt_len, chunk)]
 
 
 class LaneAutoscaler:

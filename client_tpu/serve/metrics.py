@@ -21,7 +21,15 @@ observability surface:
   registry at add_model time): ``ctpu_lm_kv_blocks_{used,free}`` (paged
   KV pool occupancy), ``ctpu_lm_lanes`` / ``ctpu_lm_active_lanes``
   (autoscaled decode lane count vs lanes streaming),
-  ``ctpu_lm_tokens_total`` and ``ctpu_lm_prefill_chunks_total``.
+  ``ctpu_lm_tokens_total`` and ``ctpu_lm_prefill_chunks_total``, plus
+  the KV **prefix cache** and **preemption** series (:data:`LM_PREFIX_HELP`
+  below): ``ctpu_lm_prefix_{hits,misses,evictions}_total`` (blocks
+  adopted / shareable-but-cold / evicted under pool pressure),
+  ``ctpu_lm_prefix_cached_blocks``, the prefill-compute accounting pair
+  ``ctpu_lm_prefill_tokens_total`` / ``ctpu_lm_prefill_tokens_saved_total``
+  (the perf/bench ``prefix_hit_pct`` numerators), and
+  ``ctpu_lm_preemptions_total`` / ``ctpu_lm_swapped_blocks`` (lanes
+  swapped to the host store under priority pressure).
 
 Every label value passes through :func:`escape_label`: the exposition format
 reserves ``\\``, ``"`` and newline inside quoted label values, and a model
@@ -49,6 +57,28 @@ ENDPOINT_STATE_VALUES = {"READY": 0, "NOT_READY": 1, "UNREACHABLE": 2}
 
 # Endpoint membership phase -> gauge value (client_tpu.balance.pool).
 ENDPOINT_PHASE_VALUES = {"active": 0, "probation": 1, "retiring": 2}
+
+# LM prefix-cache + preemption series (written by serve/lm/prefix.py and
+# serve/lm/engine.py into whichever registry the engine is bound to; the
+# help text lives here so the catalog has one source of truth).
+LM_PREFIX_HELP = {
+    "ctpu_lm_prefix_hits_total":
+        "Prompt-prefix KV blocks adopted by reference from the cache",
+    "ctpu_lm_prefix_misses_total":
+        "Shareable full prompt blocks that had no cached match",
+    "ctpu_lm_prefix_evictions_total":
+        "Cached prefix blocks evicted under pool pressure",
+    "ctpu_lm_prefix_cached_blocks":
+        "KV blocks currently held warm by the prefix cache",
+    "ctpu_lm_prefill_tokens_total":
+        "Prompt tokens actually computed by prefill chunks",
+    "ctpu_lm_prefill_tokens_saved_total":
+        "Prompt tokens skipped via prefix-cache adoption",
+    "ctpu_lm_preemptions_total":
+        "Decode lanes preempted (KV swapped out) under priority pressure",
+    "ctpu_lm_swapped_blocks":
+        "KV blocks currently parked in the host-side swap store",
+}
 
 
 def format_labels(labels):

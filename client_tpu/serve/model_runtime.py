@@ -112,6 +112,7 @@ class Model:
         max_queue_depth=None,
         ensemble_steps=None,
         flops_per_item=None,
+        response_cache=None,
     ):
         self.name = name
         self.inputs = list(inputs)
@@ -145,6 +146,12 @@ class Model:
         # achieved TFLOP/s and MFU (reference perf_analyzer reports only
         # protocol rates; compute accounting is a TPU-charter addition).
         self.flops_per_item = flops_per_item
+        # Per-model cache hints (the reference's `response_cache` config
+        # block): {"cacheable"/"enable": bool, "ttl_s": float, and for LM
+        # models a "prefix_cache" sub-block with the KV prefix-cache
+        # knobs}.  None = default behavior (cacheable whenever the server
+        # runs a ResponseCache, no per-model TTL).
+        self.response_cache = dict(response_cache or {}) or None
         self.config_override = None  # set by repository load with config param
         self.file_overrides = {}
         # optional resource-release hook, called by InferenceEngine.close()
@@ -214,7 +221,27 @@ class Model:
                     for s in self.ensemble_steps
                 ]
             }
+        if self.response_cache is not None:
+            cacheable, ttl_s = self.cache_hints()
+            block = {"enable": cacheable}
+            if ttl_s is not None:
+                block["ttl_s"] = ttl_s
+            if self.response_cache.get("prefix_cache") is not None:
+                block["prefix_cache"] = dict(
+                    self.response_cache["prefix_cache"]
+                )
+            cfg["response_cache"] = block
         return cfg
+
+    def cache_hints(self):
+        """(cacheable, ttl_s) from the model's ``response_cache`` block:
+        the per-model front-door policy the engine consults before the
+        all-models response cache (absent block = cacheable, no TTL
+        override).  ``cacheable`` and ``enable`` are accepted synonyms —
+        the reference config block spells it ``enable``."""
+        rc = self.response_cache or {}
+        cacheable = rc.get("cacheable", rc.get("enable", True))
+        return bool(cacheable), rc.get("ttl_s")
 
 
 def _cfg_type(datatype):
@@ -1079,12 +1106,13 @@ class InferenceEngine:
         if trace is not None:
             trace.tenant = tenant
             trace.event("QUEUE_START")
-        key = self._front_key(model_name, model_version, request,
-                              binary_section)
-        if key is not None:
+        front = self._front_key(model_name, model_version, request,
+                                binary_section)
+        if front is not None:
+            key, cacheable, ttl_s = front
             return self._front_door(
                 key, model_name, model_version, request, binary_section,
-                trace, tenant, t0,
+                trace, tenant, t0, cacheable, ttl_s,
             )
         qos_release = self.qos.admit(tenant) if self.qos is not None else None
         try:
@@ -1100,10 +1128,16 @@ class InferenceEngine:
                 qos_release()
 
     def _front_key(self, model_name, model_version, request, binary_section):
-        """Cache/coalesce digest for this request, or None when the front
-        door does not apply (no cache or coalescer configured; decoupled or
-        stateful model; sequence/shared-memory request; unknown model —
-        the normal path raises the proper error)."""
+        """``(digest, cacheable, ttl_s)`` for this request, or None when
+        the front door does not apply (no cache or coalescer configured;
+        decoupled or stateful model; sequence/shared-memory request;
+        unknown model — the normal path raises the proper error).
+
+        ``cacheable``/``ttl_s`` come from the model's per-model
+        ``response_cache`` config block: a model that opts out of caching
+        still coalesces (a hot key is a hot key), and a model with a
+        freshness bound caches with its own TTL instead of the cache-wide
+        default."""
         if self.response_cache is None and self._coalescer is None:
             return None
         with self._lock:
@@ -1112,18 +1146,26 @@ class InferenceEngine:
                 return None
         if model.decoupled or model.stateful:
             return None
+        cacheable, ttl_s = model.cache_hints()
+        if not cacheable and self._coalescer is None:
+            return None  # nothing left for the front door to do
         from client_tpu.serve.frontdoor import request_digest
 
-        return request_digest(model_name, model_version, request,
-                              binary_section)
+        key = request_digest(model_name, model_version, request,
+                             binary_section)
+        if key is None:
+            return None
+        return key, cacheable, ttl_s
 
     def _front_door(self, key, model_name, model_version, request,
-                    binary_section, trace, tenant, t0):
+                    binary_section, trace, tenant, t0, cacheable=True,
+                    ttl_s=None):
         """Serve one cacheable unary request: cache hit, coalesced follower,
         or (leader / uncoalesced) QoS-admitted execution + cache fill."""
         stats = self._stats[model_name]
+        use_cache = self.response_cache is not None and cacheable
         lookup_ns = 0
-        if self.response_cache is not None:
+        if use_cache:
             lookup0 = time.monotonic_ns()
             cached = self.response_cache.get(key)
             lookup_ns = time.monotonic_ns() - lookup0
@@ -1149,9 +1191,10 @@ class InferenceEngine:
             # followers and shed requests never dispatched, so counting
             # them would report a near-0% hit rate during the exact storms
             # the cache absorbs
-            if self.response_cache is not None:
+            if use_cache:
                 stats.record_cache_miss(lookup_ns)
-            self._cache_fill(key, (_strip_id(result[0]), result[1]))
+                self._cache_fill(key, (_strip_id(result[0]), result[1]),
+                                 ttl_s)
             return result
         while True:
             is_leader, flight = self._coalescer.join(key)
@@ -1206,14 +1249,15 @@ class InferenceEngine:
             # flight left incomplete here would strand every follower on
             # an untimed wait
             try:
-                if self.response_cache is not None:
+                if use_cache:
                     stats.record_cache_miss(lookup_ns)  # leader executed
                 shared = (_strip_id(result[0]), result[1])
             except BaseException as e:  # pragma: no cover - defensive
                 self._coalescer.fail(key, flight, e)
                 raise
             self._coalescer.publish(key, flight, shared)
-            self._cache_fill(key, shared)
+            if use_cache:
+                self._cache_fill(key, shared, ttl_s)
             return result
 
     def _front_dispatch(self, model_name, model_version, request,
@@ -1231,10 +1275,11 @@ class InferenceEngine:
             if qos_release is not None:
                 qos_release()
 
-    def _cache_fill(self, key, shared):
-        """Store one id-less ``(response, blobs)`` rendering."""
+    def _cache_fill(self, key, shared, ttl_s=None):
+        """Store one id-less ``(response, blobs)`` rendering, under the
+        model's own TTL when its config block sets one."""
         if self.response_cache is not None:
-            self.response_cache.put(key, shared[0], shared[1])
+            self.response_cache.put(key, shared[0], shared[1], ttl_s=ttl_s)
 
     def _execute_slot(self, model_name, model_version, request,
                       binary_section, trace, tenant, extra_release=None):

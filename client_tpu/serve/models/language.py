@@ -185,36 +185,54 @@ def lm_streaming_model(name="lm_streaming", runner=None):
 
 
 def lm_streaming_batched_model(name="lm_streaming_batched", runner=None,
-                               max_slots=8, **engine_kwargs):
+                               max_slots=8, response_cache=None,
+                               **engine_kwargs):
     """Decoupled LM with CONTINUOUS BATCHING: concurrent streams share one
     batched decode tick per token step (serve/lm: paged KV cache, bucketed
-    + chunked prefill, lane autoscaling), so aggregate tokens/sec scales
-    with active streams instead of serializing whole per-request decode
-    programs.  Per-request ``temperature``/``top_k``/``seed`` sample
-    inside the jitted tick via per-lane RNG keys; same request/response
-    surface as lm_streaming — the model IS lm_streaming_model with the
-    batched runner behind it."""
+    + chunked prefill, KV prefix caching, lane autoscaling), so aggregate
+    tokens/sec scales with active streams instead of serializing whole
+    per-request decode programs.  Per-request ``temperature``/``top_k``/
+    ``seed`` sample inside the jitted tick via per-lane RNG keys; same
+    request/response surface as lm_streaming — the model IS
+    lm_streaming_model with the batched runner behind it.
+
+    ``response_cache`` is the per-model cache-hint config block; its
+    ``prefix_cache`` sub-block carries the KV prefix-cache knobs this
+    model's engine honors: ``{"prefix_cache": {"enable": bool,
+    "min_prefix_blocks": int}}`` (the response-cache half is moot here —
+    decoupled models never hit the unary response cache — but the block
+    rides the model config so operators read one policy surface)."""
     from client_tpu.serve.models.continuous import BatchedLmRunner
 
+    prefix_knobs = dict((response_cache or {}).get("prefix_cache") or {})
+    if "enable" in prefix_knobs:
+        engine_kwargs.setdefault("prefix_cache",
+                                 bool(prefix_knobs["enable"]))
+    if "min_prefix_blocks" in prefix_knobs:
+        engine_kwargs.setdefault("min_prefix_blocks",
+                                 int(prefix_knobs["min_prefix_blocks"]))
     base = runner or _LmRunner()
     batched = BatchedLmRunner(
         base.params, base.cfg, max_slots=max_slots, eos_id=_EOS,
         check_prompt=base.check_prompt, **engine_kwargs,
     )
     model = lm_streaming_model(name=name, runner=batched)
+    model.response_cache = dict(response_cache or {}) or None
     # the scheduler's thread + paged KV pool release with the engine
     model.closer = batched.scheduler.close
 
     def bind(engine):
         """Late-bind the owning InferenceEngine's observability + QoS
-        (add_model calls this): lane/KV gauges land in the server's
-        /metrics registry, per-tick spans ride its tracer, and tenant
-        decode-lane quotas come from the front door's TenantQoS."""
+        (add_model calls this): lane/KV/prefix gauges land in the
+        server's /metrics registry, per-tick spans ride its tracer, and
+        tenant decode-lane quotas + preemption priority classes come
+        from the front door's TenantQoS."""
         sched = batched.scheduler
         sched.set_registry(engine.metrics)
         sched.tracer = engine.tracer
         if engine.qos is not None:
             sched.tenant_lane_share = engine.qos.lane_share
+            sched.tenant_priority = engine.qos.priority
 
     model.binder = bind
     return model
@@ -268,6 +286,10 @@ def language_models(shared_runner=True):
         detokenizer_model(),
         lm_streaming_model(runner=runner),
         lm_streaming_model(name="lm_streaming_int8", runner=int8_runner),
-        lm_streaming_batched_model(runner=int8_runner),
+        # the batched model serves the float weights: the continuous-
+        # batching engine's win is lane sharing, and the int8 kernel's
+        # off-TPU interpret mode is too slow to measure it (int8 serving
+        # stays available as lm_streaming_int8)
+        lm_streaming_batched_model(runner=runner),
         text_ensemble_model(runner=runner),
     ]

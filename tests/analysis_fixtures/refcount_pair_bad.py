@@ -1,0 +1,48 @@
+"""REFCOUNT-PAIR fixture — the leaked-shared-block shape.
+
+A block pool whose ``retain`` adds references that no method of the
+class ever drops: every adoption permanently shrinks the pool (the
+block is never freed and, once its owner retires, never read again).
+This is the bug-class the prefix cache's refcounted sharing must never
+reintroduce; the clean twin pairs the increment with ``release``.
+"""
+
+import threading
+
+
+class LeakyBlockPool:
+    def __init__(self, n_blocks):
+        self._lock = threading.Lock()
+        self._free = list(range(1, n_blocks + 1))
+        self._refs = {}
+
+    def alloc(self, n):
+        with self._lock:
+            if n > len(self._free):
+                return None
+            taken = self._free[:n]
+            del self._free[:n]
+            for block in taken:
+                self._refs[block] = 1
+            return taken
+
+    def retain(self, blocks):
+        # BAD: adds a reference no exit path of this class ever drops
+        with self._lock:
+            for block in blocks:
+                self._refs[block] += 1
+
+    def free_count(self):
+        with self._lock:
+            return len(self._free)
+
+
+class LeakyCounter:
+    """Same shape on a scalar attribute (``*_refcount`` spelling)."""
+
+    def __init__(self):
+        self.block_refcount = 0
+
+    def acquire(self):
+        # BAD: incremented, never decremented anywhere in the class
+        self.block_refcount = self.block_refcount + 1

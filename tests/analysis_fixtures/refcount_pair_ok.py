@@ -1,0 +1,52 @@
+"""REFCOUNT-PAIR clean twin — every increment has a paired decrement.
+
+The serve/lm/kv.py shape: ``retain`` increments, ``release`` decrements
+and frees at zero; plain counters that are not refcount-ish (request
+tallies, follower counts) increment freely without tripping the rule.
+"""
+
+import threading
+
+
+class RefcountedBlockPool:
+    def __init__(self, n_blocks):
+        self._lock = threading.Lock()
+        self._free = list(range(1, n_blocks + 1))
+        self._refs = {}
+
+    def alloc(self, n):
+        with self._lock:
+            if n > len(self._free):
+                return None
+            taken = self._free[:n]
+            del self._free[:n]
+            for block in taken:
+                self._refs[block] = 1
+            return taken
+
+    def retain(self, blocks):
+        with self._lock:
+            for block in blocks:
+                self._refs[block] += 1
+
+    def release(self, blocks):
+        with self._lock:
+            for block in blocks:
+                left = self._refs[block] - 1
+                if left > 0:
+                    self._refs[block] = left
+                else:
+                    del self._refs[block]
+                    self._free.append(block)
+
+
+class PlainTally:
+    """Non-refcount counters increment without a paired decrement."""
+
+    def __init__(self):
+        self.requests = 0
+        self.followers = 0
+
+    def note(self):
+        self.requests += 1
+        self.followers += 1

@@ -45,11 +45,12 @@ def test_registry_has_all_rules():
         "NPY-TRUTH", "ASYNC-BLOCK", "LOCK-DISPATCH", "QUEUE-SENTINEL",
         "CV-WAIT-LOOP", "SHARED-MUT", "TIME-WALL", "METRIC-LABEL",
         "RESP-PARAM-OVERWRITE", "BARE-SUPPRESS", "JIT-UNBOUNDED-SHAPE",
+        "REFCOUNT-PAIR",
     }
     assert set(PROGRAM_REGISTRY) >= {
         "LOCK-INV", "BLOCK-UNDER-LOCK", "CALLBACK-UNDER-LOCK",
     }
-    assert len(all_rules()) >= 13
+    assert len(all_rules()) >= 14
     for rule in all_rules().values():
         assert rule.rationale  # every rule documents its motivating bug
 
@@ -192,6 +193,24 @@ def test_jit_unbounded_shape_clean():
     AND rebinding the name to the sanitizer after a ragged reshape
     (last assignment wins) all fix the dispatch shape — no finding."""
     assert _scan("jit_unbounded_shape_ok.py") == []
+
+
+def test_refcount_pair_hits():
+    """The leaked-shared-block shape (serve/lm/kv.py discipline): a class
+    that increments a refs/refcount attribute with no decrement anywhere
+    — on a mapping (+=) and on a scalar (x = x + 1 rebind)."""
+    findings = _scan("refcount_pair_bad.py")
+    assert _rules_hit(findings) == ["REFCOUNT-PAIR"]
+    assert len(findings) == 2
+    messages = " ".join(f.message for f in findings)
+    assert "retain()" in messages and "acquire()" in messages
+    assert "leaked reference" in findings[0].message
+
+
+def test_refcount_pair_clean():
+    """retain paired with release (the kv.py shape: AugAssign up, BinOp
+    subtraction down) and non-refcount counters both stay silent."""
+    assert _scan("refcount_pair_ok.py") == []
 
 
 def test_time_wall_hits():
@@ -836,6 +855,7 @@ def test_cli_fails_on_each_seeded_bad_fixture():
         ("block_under_lock_bad.py", "BLOCK-UNDER-LOCK"),
         ("callback_under_lock_bad.py", "CALLBACK-UNDER-LOCK"),
         ("bare_suppress_bad.py", "BARE-SUPPRESS"),
+        ("refcount_pair_bad.py", "REFCOUNT-PAIR"),
     ):
         proc = _cli(
             f"tests/analysis_fixtures/{name}", "--no-baseline", "--no-cache"

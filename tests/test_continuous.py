@@ -267,7 +267,9 @@ def test_batched_runner_stream(params):
 
 def test_grpc_batched_model_concurrent(params):
     """lm_streaming_batched over real gRPC: concurrent streams produce the
-    same tokens as the serial lm_streaming_int8 model (same weights)."""
+    same tokens as the serial lm_streaming model (same float weights —
+    the batched model serves the shared float runner; int8 lives on as
+    lm_streaming_int8)."""
     import client_tpu.grpc as grpcclient
     from client_tpu.serve import Server
     from client_tpu.serve.models import language_models
@@ -302,7 +304,7 @@ def test_grpc_batched_model_concurrent(params):
             return toks
 
         prompts = [[1, 2, 3], [9, 9], [4, 5, 6, 7]]
-        expected = [run_stream("lm_streaming_int8", p, 5) for p in prompts]
+        expected = [run_stream("lm_streaming", p, 5) for p in prompts]
 
         got = [None] * len(prompts)
         threads = [

@@ -126,6 +126,16 @@ class TestResponseCache:
         assert cache.get("k") is None  # expired at read time
         assert cache.stats()["evictions"] == 1
 
+    def test_per_entry_ttl_overrides_default(self):
+        """The per-model ttl_s hint: an entry carrying its own TTL
+        expires on that clock while default-TTL neighbors live on."""
+        cache = ResponseCache(max_entries=4, ttl_s=30.0)
+        cache.put("fresh", {"outputs": []}, [b"v"], ttl_s=0.05)
+        cache.put("stable", {"outputs": []}, [b"v"])
+        time.sleep(0.08)
+        assert cache.get("fresh") is None  # model's own bound expired it
+        assert cache.get("stable") is not None  # cache-wide 30s still good
+
     def test_metrics_series(self):
         registry = Registry()
         cache = ResponseCache(max_entries=1, registry=registry)
@@ -329,6 +339,168 @@ def test_leader_qos_shed_does_not_poison_other_tenants():
         engine.close()
 
 
+# -- per-model cache hints (response_cache config block) ---------------------
+
+
+def _hint_model(name, calls, response_cache=None):
+    from client_tpu.serve.model_runtime import Model, TensorSpec
+
+    def fn(inputs, params, ctx):
+        calls.append(name)
+        return {"OUT": inputs["IN"] * 2.0}
+
+    return Model(
+        name,
+        inputs=[TensorSpec("IN", "FP32", [-1, 4])],
+        outputs=[TensorSpec("OUT", "FP32", [-1, 4])],
+        fn=fn,
+        response_cache=response_cache,
+    )
+
+
+def _hint_req(value=1.0):
+    req, raw = _req(value)
+    return dict(req), raw
+
+
+def test_model_opt_out_skips_cache_but_default_models_cache():
+    """The all-models-alike behavior is gone: a model whose config block
+    says cacheable=False executes every identical request, while its
+    default-config neighbor answers repeats from the cache."""
+    calls = []
+    engine = InferenceEngine(
+        models=[
+            _hint_model("uncached", calls,
+                        response_cache={"cacheable": False}),
+            _hint_model("cached", calls),
+        ],
+        response_cache=ResponseCache(max_entries=16),
+    )
+    try:
+        req, raw = _hint_req()
+        for _ in range(3):
+            engine.execute("uncached", "", dict(req), raw)
+        assert calls.count("uncached") == 3  # opted out: always executes
+        for _ in range(3):
+            engine.execute("cached", "", dict(req), raw)
+        assert calls.count("cached") == 1  # repeats served from cache
+        # the opt-out renders in the model's config for clients to read
+        cfg = engine.get_model("uncached").config()
+        assert cfg["response_cache"] == {"enable": False}
+    finally:
+        engine.close()
+
+
+def test_model_ttl_hint_expires_its_own_entries():
+    calls = []
+    engine = InferenceEngine(
+        models=[_hint_model("fast_stale", calls,
+                            response_cache={"cacheable": True,
+                                            "ttl_s": 0.05})],
+        response_cache=ResponseCache(max_entries=16),  # no default TTL
+    )
+    try:
+        req, raw = _hint_req()
+        engine.execute("fast_stale", "", dict(req), raw)
+        engine.execute("fast_stale", "", dict(req), raw)
+        assert calls.count("fast_stale") == 1  # within the model's TTL
+        time.sleep(0.08)
+        engine.execute("fast_stale", "", dict(req), raw)
+        assert calls.count("fast_stale") == 2  # model's TTL expired it
+    finally:
+        engine.close()
+
+
+def test_uncacheable_model_still_coalesces():
+    """Opting out of the response cache must not opt out of coalescing:
+    N identical CONCURRENT requests to an uncacheable model still
+    collapse to one dispatch."""
+    calls = []
+    release = threading.Event()
+
+    from client_tpu.serve.model_runtime import Model, TensorSpec
+
+    def fn(inputs, params, ctx):
+        calls.append(1)
+        release.wait(timeout=30)
+        return {"OUT": inputs["IN"] * 2.0}
+
+    model = Model(
+        "slow_uncached",
+        inputs=[TensorSpec("IN", "FP32", [-1, 4])],
+        outputs=[TensorSpec("OUT", "FP32", [-1, 4])],
+        fn=fn,
+        response_cache={"cacheable": False},
+    )
+    engine = InferenceEngine(
+        models=[model],
+        response_cache=ResponseCache(max_entries=16),
+        coalescing=True,
+    )
+    try:
+        req, raw = _hint_req()
+        results, errors = [], []
+
+        def call():
+            try:
+                results.append(
+                    engine.execute("slow_uncached", "", dict(req), raw)
+                )
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=call) for _ in range(4)]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 30
+        while not calls and time.monotonic() < deadline:
+            time.sleep(0.005)
+        time.sleep(0.05)  # let the followers pile onto the flight
+        release.set()
+        for t in threads:
+            t.join(timeout=30)
+            assert not t.is_alive()
+        assert not errors, errors
+        assert len(results) == 4
+        assert len(calls) == 1  # one dispatch for the whole herd
+        # and a SUBSEQUENT identical request re-executes: nothing cached
+        engine.execute("slow_uncached", "", dict(req), raw)
+        assert len(calls) == 2
+    finally:
+        release.set()
+        engine.close()
+
+
+def test_lm_prefix_knobs_ride_the_model_config():
+    """The same config block carries the LM prefix-cache knobs: an
+    lm_streaming_batched model built with prefix_cache disabled runs its
+    engine cache-less, and the block renders in config()."""
+    from client_tpu.serve.models import transformer as tfm
+    from client_tpu.serve.models.language import (
+        _LmRunner,
+        lm_streaming_batched_model,
+    )
+
+    cfg = tfm.TransformerConfig(
+        vocab_size=258, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq=64, dtype="float32",
+    )
+    model = lm_streaming_batched_model(
+        name="lm_hinted", runner=_LmRunner(cfg=cfg),
+        response_cache={"prefix_cache": {"enable": False,
+                                         "min_prefix_blocks": 2}},
+    )
+    try:
+        sched = model.closer.__self__  # the engine behind close()
+        assert sched._prefix_enabled is False
+        assert sched.min_prefix_blocks == 2
+        assert model.config()["response_cache"]["prefix_cache"] == {
+            "enable": False, "min_prefix_blocks": 2,
+        }
+    finally:
+        model.closer()
+
+
 # -- tenant QoS --------------------------------------------------------------
 
 
@@ -368,6 +540,15 @@ class TestTenantQoS:
         assert qos.weight("gold") == 8.0
         assert qos.weight("anyone") == 1.0
         assert qos.weight("zero") > 0  # floored: never full starvation
+
+    def test_priority_classes(self):
+        """Preemption priority: per-tenant `priority` key, default 0 —
+        the LM engine's swap controller only acts on STRICT inequality,
+        so unconfigured fleets never preempt."""
+        qos = TenantQoS(tenants={"gold": {"priority": 10}})
+        assert qos.priority("gold") == 10.0
+        assert qos.priority("anyone") == 0.0
+        assert TenantQoS(default_priority=2.5).priority("x") == 2.5
 
     def test_note_counts_without_caps(self):
         registry = Registry()

@@ -1,8 +1,10 @@
 """Continuous-batching LM engine (client_tpu/serve/lm): the four-pillar
 acceptance — bounded prefill compiles (bucketing), chunked prefill
 interleaved with decode (head-of-line fix), paged KV accounting, lane
-autoscaling + tenant lane quotas — plus per-lane sampling determinism
-and the >=128-stream churn soak (slow tier, `make soak`)."""
+autoscaling + tenant lane quotas — plus per-lane sampling determinism,
+the prefix-cache/preemption subsystem (refcounted block sharing,
+LRU eviction under pressure, priority swap with byte-exact resume) and
+the >=128-stream churn soak (slow tier, `make soak`)."""
 
 import queue
 import threading
@@ -13,7 +15,7 @@ import pytest
 
 import jax
 
-from client_tpu.serve.lm import KvBlockPool, LmEngine
+from client_tpu.serve.lm import KvBlockPool, LmEngine, PrefixCache
 from client_tpu.serve.lm.policy import (
     LaneAutoscaler,
     bucket_for,
@@ -437,6 +439,328 @@ def test_top_k_restricts_support(params):
         eng.close()
 
 
+# -- prefix cache: refcounted block sharing --------------------------------
+
+def test_kv_pool_refcounts_share_and_release():
+    pool = KvBlockPool(CFG, n_blocks=8, block_size=16)
+    blocks = pool.alloc(2)
+    assert [pool.ref_count(b) for b in blocks] == [1, 1]
+    pool.retain(blocks)  # a second holder adopts both
+    assert [pool.ref_count(b) for b in blocks] == [2, 2]
+    pool.release(blocks)  # first holder exits: blocks stay live
+    assert pool.free_blocks == 6
+    assert [pool.ref_count(b) for b in blocks] == [1, 1]
+    pool.release(blocks)  # last holder exits: blocks free
+    assert pool.free_blocks == 8
+    assert pool.ref_counts() == {}
+
+
+def test_prefix_cache_match_adopt_give_back_evict():
+    pool = KvBlockPool(CFG, n_blocks=8, block_size=4)
+    cache = PrefixCache(pool)
+    prompt = np.arange(1, 13, dtype=np.int32)  # 3 full blocks of 4
+    blocks = pool.alloc(3)
+    # retirement inserts the chain: the holder's references TRANSFER
+    cache.give_back(prompt, 3, blocks)
+    assert cache.cached_blocks == 3
+    assert pool.used_blocks == 3  # cache keeps them live
+    # a matching prompt adopts the chain by reference
+    matched, nodes = cache.match(prompt, 3)
+    assert matched == blocks
+    cache.adopt(nodes)
+    assert [pool.ref_count(b) for b in blocks] == [2, 2, 2]
+    # pinned blocks are NOT evictable; nothing can be freed
+    assert cache.evict(3) == 0
+    pool.release(matched)  # adopter retires (its prefix re-inserts as hits)
+    # a diverging prompt matches only the shared lead
+    other = prompt.copy()
+    other[4:] = 99
+    matched2, nodes2 = cache.match(other, 3)
+    assert matched2 == blocks[:1]
+    # now unpinned: eviction frees leaves first, LRU order
+    assert cache.evict(2) == 2
+    assert cache.cached_blocks == 1
+    assert pool.used_blocks == 1
+    cache.clear()
+    assert pool.used_blocks == 0
+
+
+def test_prefix_cache_min_blocks_hint():
+    pool = KvBlockPool(CFG, n_blocks=8, block_size=4)
+    cache = PrefixCache(pool, min_prefix_blocks=2)
+    prompt = np.arange(1, 9, dtype=np.int32)  # 2 full blocks
+    cache.give_back(prompt, 1, pool.alloc(2))  # only 1 block cached
+    matched, nodes = cache.match(prompt, 2)
+    assert matched == [] and nodes == []  # below the hint: not worth it
+    cache.clear()
+
+
+def test_prefix_adoption_shares_blocks_and_skips_prefill(params):
+    """The prefill-savings acceptance at engine level: prompts sharing a
+    long prefix decode byte-exact vs serial while the second+ admissions
+    adopt the prefix blocks (hits counted, prefill compute reduced, the
+    shared blocks' refcounts prove by-reference sharing)."""
+    reg = Registry()
+    eng = LmEngine(params, CFG, max_slots=2, lane_counts=(2,),
+                   block_size=8, prefill_chunk=16, min_bucket=4,
+                   registry=reg)
+    shared = list(range(1, 25))  # 3 full blocks of 8
+    prompts = [shared + [30 + i] for i in range(3)]
+    try:
+        cold = _collect(eng.submit(prompts[0], 5)[0])
+        assert cold == _serial(params, prompts[0], 5)
+        computed_cold = reg.get("ctpu_lm_prefill_tokens_total")
+        for p in prompts[1:]:
+            assert _collect(eng.submit(p, 5)[0]) == _serial(params, p, 5)
+        stats = eng.prefix_stats()
+        assert stats["hits"] == 6  # 3 blocks adopted by each warm prompt
+        assert stats["cached_blocks"] >= 3
+        # each warm prompt prefilled only its 1-token tail (padded to the
+        # 4-wide min bucket): way below the 25-token cold prefill
+        computed_warm = (
+            reg.get("ctpu_lm_prefill_tokens_total") - computed_cold
+        )
+        assert computed_warm == 2  # 1 real token each, pad excluded
+        assert reg.get("ctpu_lm_prefill_tokens_saved_total") == 48
+    finally:
+        eng.close()
+    assert eng.kv.used_blocks == 0, eng.kv.ref_counts()
+
+
+def test_prefix_cache_disabled_knob(params):
+    eng = LmEngine(params, CFG, max_slots=2, lane_counts=(2,),
+                   block_size=8, prefill_chunk=16, min_bucket=4,
+                   prefix_cache=False)
+    shared = list(range(1, 25))
+    try:
+        assert _collect(eng.submit(shared + [30], 4)[0]) == \
+            _serial(params, shared + [30], 4)
+        assert eng.prefix is None
+        assert eng.prefix_stats() == {}
+    finally:
+        eng.close()
+    assert eng.kv.used_blocks == 0
+
+
+def test_prefix_eviction_under_pool_pressure(params):
+    """Warm cache blocks yield to admissions: a pool too small to hold
+    the cache AND a new reservation evicts LRU cached blocks instead of
+    backpressuring the request forever."""
+    reg = Registry()
+    # 6 blocks of 16 = 96 tokens: one 40-token stream reserves 3 blocks
+    eng = LmEngine(params, CFG, max_slots=2, lane_counts=(2,),
+                   block_size=16, pool_tokens=96, prefill_chunk=16,
+                   min_bucket=4, registry=reg)
+    try:
+        p1 = list(range(1, 33))  # 2 full blocks cached at retirement
+        assert _collect(eng.submit(p1, 8, seed=1)[0]) == \
+            _serial(params, p1, 8)
+        assert eng.prefix_stats()["cached_blocks"] == 2
+        # a disjoint request needing 5 blocks with only 4 non-cache free:
+        # eviction makes room, admission never wedges
+        p2 = [90] * 40
+        assert _collect(eng.submit(p2, 40)[0]) == _serial(params, p2, 40)
+        assert eng.prefix_stats()["evictions"] >= 1
+        assert reg.get("ctpu_lm_prefix_evictions_total") >= 1
+    finally:
+        eng.close()
+    assert eng.kv.used_blocks == 0, eng.kv.ref_counts()
+
+
+def test_prefix_cancel_mid_prefill_keeps_refcounts_balanced(params):
+    """Cancels racing multi-chunk prefill of shared prompts must leave
+    the ledger balanced: whatever was written may enter the cache, but
+    after close every reference is gone (the REFCOUNT-PAIR bug-class,
+    exercised dynamically)."""
+    eng = LmEngine(params, CFG, max_slots=2, lane_counts=(2,),
+                   block_size=8, prefill_chunk=16, min_bucket=4)
+    shared = list(range(1, 41))  # 40 tokens = 3 prefill chunks
+    try:
+        for i in range(6):
+            q, handle = eng.submit(shared + [60 + i], 4)
+            if i % 2 == 0:
+                eng.cancel(handle)  # often lands mid-prefill
+                got = _collect(q)
+                want = _serial(params, shared + [60 + i], 4)
+                assert got == want[: len(got)]
+            else:
+                assert _collect(q) == _serial(params, shared + [60 + i], 4)
+        # drained: only the cache may hold references, every one exactly 1
+        refs = eng.kv.ref_counts()
+        assert all(v == 1 for v in refs.values()), refs
+        assert len(refs) == eng.prefix_stats()["cached_blocks"]
+    finally:
+        eng.close()
+    assert eng.kv.used_blocks == 0, eng.kv.ref_counts()
+
+
+# -- preemption: priority swap ---------------------------------------------
+
+def _preempt_scenario(params, swap_block_limit):
+    """Pool sized so the high-priority admission cannot fit beside the
+    low-priority stream: the engine must swap the low lane out, serve
+    'hi' first, then resume 'lo' — both byte-exact vs serial greedy."""
+    reg = Registry()
+    eng = LmEngine(params, CFG, max_slots=2, lane_counts=(2,),
+                   block_size=8, pool_tokens=80, prefill_chunk=16,
+                   min_bucket=4, registry=reg,
+                   tenant_priority={"hi": 10.0},
+                   swap_block_limit=swap_block_limit)
+    pa, pb = [1, 2, 3], [9, 4]
+    try:
+        qa, _ = eng.submit(pa, 60, tenant="lo")  # 8 of 10 blocks
+        first = qa.get(timeout=120)
+        assert first is not CLOSE
+        qb, _ = eng.submit(pb, 40, tenant="hi")  # needs 6: must preempt
+        done = {}
+
+        def drain(name, q, acc):
+            while True:
+                tok = q.get(timeout=120)
+                if tok is CLOSE:
+                    break
+                acc.append(tok)
+            done[name] = time.monotonic()
+
+        got_a, got_b = [first], []
+        threads = [
+            threading.Thread(target=drain, args=("a", qa, got_a),
+                             daemon=True),
+            threading.Thread(target=drain, args=("b", qb, got_b),
+                             daemon=True),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+            assert not t.is_alive(), "stream wedged across preemption"
+        assert got_a == _serial(params, pa, 60)  # byte-exact THROUGH swap
+        assert got_b == _serial(params, pb, 40)
+        ps = eng.preempt_stats()
+        assert ps["preemptions"] >= 1, ps
+        assert ps["resumes"] == ps["preemptions"]
+        assert ps["swapped_streams"] == 0
+        assert all(ms > 0 for ms in ps["resume_ms"])
+        assert reg.get("ctpu_lm_preemptions_total") == ps["preemptions"]
+        assert (reg.get("ctpu_lm_swapped_blocks") or 0) == 0
+    finally:
+        eng.close()
+    assert eng.kv.used_blocks == 0, eng.kv.ref_counts()
+
+
+def test_preemption_swap_path_byte_exact(params):
+    _preempt_scenario(params, swap_block_limit=None)
+
+
+def test_preemption_recompute_fallback_byte_exact(params):
+    """swap_block_limit=0 forces the recompute path: the preempted KV is
+    dropped and rebuilt by replaying prompt + delivered tokens through
+    chunked prefill — the stream still resumes and completes exactly."""
+    _preempt_scenario(params, swap_block_limit=0)
+
+
+def test_pick_order_prefers_priority_class_over_rr_head(params):
+    """The admission-order half of the preemption guarantee, driven
+    race-free against a frozen engine (the scheduler thread starts
+    lazily): with the round-robin cursor parked on a low-priority
+    tenant, a higher-class tenant's handle is still picked FIRST — the
+    shape that makes preemption reachable when a gold request queues
+    behind a backpressured bronze head."""
+    from collections import deque
+
+    from client_tpu.serve.lm.engine import _Handle
+
+    eng = LmEngine(params, CFG, max_slots=4, lane_counts=(4,),
+                   block_size=8, prefill_chunk=16, min_bucket=4,
+                   tenant_priority={"hi": 10.0})
+
+    def handle(tenant):
+        return _Handle(np.zeros((1, 2), np.int32), 4, queue.Queue(),
+                       tenant, 0.0, 0, 0)
+
+    h_lo, h_hi = handle("lo"), handle("hi")
+    with eng._cv:
+        eng._pending["lo"] = deque([h_lo])
+        eng._pending["hi"] = deque([h_hi])
+        eng._rr = 0  # cursor on "lo": rotation alone would pick it
+        assert eng._pick_pending_locked(4) is h_hi  # class outranks rr
+        assert eng._pick_pending_locked(4) is h_lo
+
+
+def test_high_priority_preempts_past_backpressured_low_head(params):
+    """A gold request queued BEHIND another tenant's backpressured
+    request must still fire preemption: admission picks priority classes
+    first (round-robin only within a class), so pool exhaustion can't
+    park the cursor on a low-priority head forever."""
+    eng = LmEngine(params, CFG, max_slots=3, lane_counts=(3,),
+                   block_size=8, pool_tokens=80, prefill_chunk=16,
+                   min_bucket=4, tenant_priority={"hi": 10.0})
+    pa = [1, 2, 3]
+    try:
+        # A's reservation spans the WHOLE pool (blocks_for(3+90) = 12):
+        # nothing else admits until A is preempted or fully done, and a
+        # 90-token stream cannot finish before the hi submit lands
+        q_a, _ = eng.submit(pa, 90, tenant="lo")
+        assert q_a.get(timeout=120) is not CLOSE
+        q_b, _ = eng.submit([5, 6], 40, tenant="lo2")  # stuck rr head
+        q_c, _ = eng.submit([9, 4], 40, tenant="hi")
+        got_c = _collect(q_c)
+        assert got_c == _serial(params, [9, 4], 40)
+        assert eng.preempt_stats()["preemptions"] >= 1
+        assert _collect(q_b) == _serial(params, [5, 6], 40)
+        got_a = [_serial(params, pa, 90)[0]] + _collect(q_a)
+        assert got_a == _serial(params, pa, 90)
+    finally:
+        eng.close()
+    assert eng.kv.used_blocks == 0, eng.kv.ref_counts()
+
+
+def test_no_preemption_between_equal_priorities(params):
+    """Priority ties never preempt: with everyone at the default class,
+    pool exhaustion stays plain admission backpressure."""
+    eng = LmEngine(params, CFG, max_slots=2, lane_counts=(2,),
+                   block_size=8, pool_tokens=80, prefill_chunk=16,
+                   min_bucket=4, tenant_priority={})
+    try:
+        qa, _ = eng.submit([1, 2, 3], 60, tenant="x")
+        assert qa.get(timeout=120) is not CLOSE
+        qb, _ = eng.submit([9, 4], 40, tenant="y")
+        assert _collect(qb) == _serial(params, [9, 4], 40)
+        _collect(qa)
+        assert eng.preempt_stats()["preemptions"] == 0
+    finally:
+        eng.close()
+    assert eng.kv.used_blocks == 0
+
+
+def test_cancel_while_swapped_closes_cleanly(params):
+    """A parked (preempted) stream cancelled before resume: its queue
+    closes, nothing leaks, the engine keeps serving."""
+    eng = LmEngine(params, CFG, max_slots=2, lane_counts=(2,),
+                   block_size=8, pool_tokens=80, prefill_chunk=16,
+                   min_bucket=4, tenant_priority={"hi": 10.0})
+    try:
+        qa, ha = eng.submit([1, 2, 3], 60, tenant="lo")
+        assert qa.get(timeout=120) is not CLOSE
+        qb, _ = eng.submit([9, 4], 40, tenant="hi")
+        # wait until the low stream is actually parked
+        deadline = time.monotonic() + 60
+        while (eng.preempt_stats()["swapped_streams"] == 0
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        assert eng.preempt_stats()["swapped_streams"] == 1
+        eng.cancel(ha)
+        # the paused queue ends with CLOSE, never an error
+        while qa.get(timeout=60) is not CLOSE:
+            pass
+        assert _collect(qb) == _serial(params, [9, 4], 40)
+        ps = eng.preempt_stats()
+        assert ps["swapped_streams"] == 0 and ps["resumes"] == 0
+    finally:
+        eng.close()
+    assert eng.kv.used_blocks == 0, eng.kv.ref_counts()
+
+
 # -- engine metrics / spans ------------------------------------------------
 
 def test_engine_metrics_and_tick_spans(params):
@@ -479,16 +803,26 @@ def test_soak_128_streams_submit_cancel_churn(params):
     stream terminates; survivors decode EXACTLY their serial greedy
     stream), no stream starved (bounded inter-token gap while the engine
     ran), compiled executables bounded by the bucket/lane-count sets,
-    every KV block freed.  Runs under the lock-order witness in
-    `make soak`."""
+    every KV block freed.
+
+    A third of the streams carry SHARED-PREFIX prompts long enough for
+    multi-chunk prefill, and some of those are cancelled mid-flight —
+    so prefix-cache adoption, publication and give-back churn against
+    cancels racing prefill (the refcount-leak bug-class, dynamically).
+    At drain every surviving block reference belongs to the cache
+    (exactly one each); close() leaves the pool FULLY free.  Runs under
+    the lock-order witness in `make soak`."""
     n_streams = 128
     max_tokens = 6
     eng = LmEngine(params, CFG, max_slots=8, lane_counts=(2, 4, 8),
                    block_size=8, prefill_chunk=16, min_bucket=4,
                    scale_up_after=2, registry=Registry())
     lengths = (2, 3, 5)
+    shared = [((j * 11) % 120) + 1 for j in range(40)]  # 3 prefill chunks
     prompts = [
-        [((i * 7 + j) % 120) + 1 for j in range(lengths[i % 3])]
+        (shared + [((i * 13) % 120) + 1, ((i * 5) % 120) + 1]
+         if i % 3 == 0
+         else [((i * 7 + j) % 120) + 1 for j in range(lengths[i % 3])])
         for i in range(n_streams)
     ]
     expected = {}
@@ -500,7 +834,13 @@ def test_soak_128_streams_submit_cancel_churn(params):
     def run(i):
         q, handle = eng.submit(prompts[i], max_tokens)
         toks = []
+        # i % 9 == 0 cancels after 2 tokens; shared-prefix streams with
+        # i % 6 == 3 cancel IMMEDIATELY — those often land mid-prefill
+        cancelled = i % 9 == 0 or i % 6 == 3
         cancel_after = 2 if i % 9 == 0 else None
+        if i % 6 == 3:
+            eng.cancel(handle)
+            cancel_after = None
         last = None
         try:
             while True:
@@ -515,7 +855,7 @@ def test_soak_128_streams_submit_cancel_churn(params):
                 if cancel_after is not None and len(toks) >= cancel_after:
                     eng.cancel(handle)
                     cancel_after = None  # queue still drains to CLOSE
-            results[i] = ("cancelled" if i % 9 == 0 else "done", toks)
+            results[i] = ("cancelled" if cancelled else "done", toks)
         except Exception as e:  # pragma: no cover - failure path
             results[i] = ("error", repr(e))
 
@@ -552,10 +892,18 @@ def test_soak_128_streams_submit_cancel_churn(params):
         trace = eng.tick_trace()
         decodes = [r for r in trace if r["kind"] == "decode"]
         assert len(decodes) >= max_tokens  # batched, not serialized
-        # every reservation returned
-        assert eng.kv.used_blocks == 0
+        # every reservation returned: at drain the ONLY live references
+        # are the prefix cache's warm prompt blocks, exactly one each —
+        # any request-held reference here is a leak
+        refs = eng.kv.ref_counts()
+        assert all(v == 1 for v in refs.values()), refs
+        assert len(refs) == eng.prefix_stats()["cached_blocks"]
+        assert eng.prefix_stats()["hits"] > 0  # sharing actually happened
     finally:
         eng.close()
+    # close() drops the cache too: zero references, pool FULLY free
+    assert eng.kv.ref_counts() == {}
+    assert eng.kv.used_blocks == 0
 
 
 def test_close_releases_everything(params):

@@ -116,6 +116,44 @@ class TestDataLoader:
         )
         assert loader.get_expected_outputs(1, 0)["OUTPUT0"].array.size == 4
 
+    def test_prefix_share_generation(self):
+        """--prefix-share workload shape: num_prompts streams whose token
+        input shares its leading FRAC with one of shared_pool prefixes,
+        scalar INT inputs pinned to a sane budget, values in-vocab."""
+        meta = [
+            {"name": "TOKENS", "datatype": "INT32", "shape": [32]},
+            {"name": "MAX_TOKENS", "datatype": "INT32", "shape": [1]},
+        ]
+        loader = DataLoader(meta)
+        loader.generate_prefix_share(0.75, num_prompts=8, shared_pool=2)
+        assert loader.num_streams == 8
+        rows = [loader.get_input_data(i, 0)["TOKENS"].array.reshape(-1)
+                for i in range(8)]
+        prefix_len = int(round(0.75 * 32))
+        for i, row in enumerate(rows):
+            assert row.shape == (32,)
+            assert row.min() >= 1 and row.max() < 256  # byte-vocab safe
+            # same pool slot -> identical prefix
+            np.testing.assert_array_equal(
+                row[:prefix_len], rows[i % 2][:prefix_len]
+            )
+        # the two pools differ, and tails are (overwhelmingly) unique
+        assert not np.array_equal(rows[0][:prefix_len],
+                                  rows[1][:prefix_len])
+        budgets = {int(loader.get_input_data(i, 0)["MAX_TOKENS"]
+                       .array.reshape(-1)[0]) for i in range(8)}
+        assert budgets == {16}  # pinned, never a random negative
+
+    def test_prefix_share_needs_token_input_and_valid_share(self):
+        loader = DataLoader(META)  # FP32 only: nothing to build prompts in
+        with pytest.raises(InferenceServerException):
+            loader.generate_prefix_share(0.5)
+        loader2 = DataLoader(
+            [{"name": "TOKENS", "datatype": "INT32", "shape": [8]}]
+        )
+        with pytest.raises(InferenceServerException):
+            loader2.generate_prefix_share(1.5)
+
     def test_bytes_generation(self):
         loader = DataLoader([{"name": "S", "datatype": "BYTES", "shape": [2]}])
         loader.generate_data(string_length=5)
@@ -408,6 +446,49 @@ class TestEndToEndInprocess:
         lines = csv_path.read_text().strip().splitlines()
         assert len(lines) == 2
         assert lines[0].startswith("Level,Inferences/Second")
+
+    def test_prefix_share_sweep_reports_columns(self, tmp_path, capsys):
+        """--prefix-share drives the rotated shared-prefix workload and
+        lands the per-sweep prefix columns in summary + CSV + JSON (the
+        builtin simple model has no prefix cache, so the numbers are 0 —
+        the LM savings themselves are asserted at engine level in
+        tests/test_lm.py, where CPU-speed models make it cheap)."""
+        import json
+
+        from client_tpu.perf.__main__ import main
+
+        csv_path = tmp_path / "prefix.csv"
+        json_path = tmp_path / "prefix.json"
+        rc = main([
+            "-m", "simple", "--hermetic",
+            "--prefix-share", "0.8", "--prefix-pool", "2",
+            "--prefix-prompts", "6",
+            "--concurrency-range", "2",
+            "--measurement-interval", "100",
+            "--max-trials", "3",
+            "-s", "90",
+            "-f", str(csv_path),
+            "--json-export", str(json_path),
+        ])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "prefix cache:" in out
+        header = csv_path.read_text().splitlines()[0]
+        assert "Prefix Hit %" in header
+        assert "Prefill Tokens Saved %" in header
+        doc = json.loads(json_path.read_text())
+        rec = doc["results"][0]["lm_prefix"]
+        assert set(rec) >= {"prefix_hit_pct", "prefill_tokens_saved_pct"}
+
+    def test_prefix_share_rejects_custom_input_data(self):
+        from client_tpu.perf.__main__ import main
+
+        with pytest.raises(SystemExit):
+            main([
+                "-m", "simple", "--hermetic",
+                "--prefix-share", "0.5", "--input-data", "zero",
+                "--concurrency-range", "1",
+            ])
 
     def test_trace_options_applied_hermetic(self, capsys):
         """--trace-* flags reach the engine's trace-settings control plane."""
