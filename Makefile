@@ -45,9 +45,9 @@ check: lint
 # Churn + isolation soak: the slow tier tier-1 excludes — repeats the
 # replica-churn chaos acceptance (discovery add/retire, stream-pinned
 # kill, resolver flap), the multi-tenant noisy-neighbor/hot-key
-# scenario, and the continuous-batching LM 128-stream submit/cancel
-# churn SOAK_N times; churn and isolation bugs are timing bugs,
-# repetition finds them.
+# scenario, the continuous-batching LM 128-stream submit/cancel churn,
+# and the three-replica fleet kill-mid-stream chaos SOAK_N times; churn
+# and isolation bugs are timing bugs, repetition finds them.
 SOAK_N ?= 3
 soak:
 	@for i in $$(seq 1 $(SOAK_N)); do \
@@ -55,7 +55,7 @@ soak:
 	  JAX_PLATFORMS=cpu TPULINT_LOCK_WITNESS=1 \
 	      python -m pytest tests/test_discovery.py \
 	      tests/test_balance.py tests/test_frontdoor.py \
-	      tests/test_lm.py -q -m slow \
+	      tests/test_lm.py tests/test_fleet.py -q -m slow \
 	      -p no:cacheprovider -p no:xdist -p no:randomly || exit 1; \
 	done
 

@@ -764,6 +764,90 @@ def _run_lm_prefix(prompts=24, prompt_len=64, share=0.8, max_tokens=4,
     return result
 
 
+def _run_fleet_prefix(prompts=12, prompt_len=64, share=0.75, max_tokens=2):
+    """Fleet cache-tier headline: the same shared-prefix workload split
+    across TWO replicas, with and without the cross-replica prefix tier
+    (serve/fleet.py).  ``fleet_lm_prefix_hit_pct`` counts a shareable
+    block served from ANY replica's cache (local trie adoption + blocks
+    installed from a peer's host store); the single-replica figure is
+    the same split workload with no tier — the delta is exactly the
+    prefill compute the fleet recovers that N independent caches lose."""
+    import threading  # noqa: F401  (parity with _run_lm_prefix imports)
+
+    from client_tpu.serve.fleet import FleetTier
+    from client_tpu.serve.lm import LmEngine
+    from client_tpu.serve.metrics import Registry
+    from client_tpu.serve.models.language import _EOS, _LmRunner
+
+    base = _LmRunner()
+    params, cfg = base.params, base.cfg
+    rng = np.random.default_rng(11)
+    prefix = rng.integers(1, 256, int(round(share * prompt_len)))
+    prompt_set = []
+    for _ in range(prompts):
+        row = rng.integers(1, 256, prompt_len)
+        row[: len(prefix)] = prefix
+        prompt_set.append(row.astype(np.int32))
+
+    def run(with_tier):
+        tiers = []
+        if with_tier:
+            tiers = [FleetTier(gossip_interval_s=0).start()
+                     for _ in range(2)]
+            for tier in tiers:
+                tier.set_peers(
+                    [t.address for t in tiers if t is not tier]
+                )
+        engines = [
+            LmEngine(params, cfg, max_slots=4, eos_id=_EOS,
+                     registry=Registry(),
+                     fleet=tiers[i] if with_tier else None)
+            for i in range(2)
+        ]
+        try:
+            # warm replica 0 (compile + publish the shared prefix once);
+            # then the split workload alternates replicas
+            warm_q, _ = engines[0].submit(prompt_set[0], 2)
+            while warm_q.get(timeout=600) is not LmEngine.CLOSE:
+                pass
+            t0 = time.perf_counter()
+            queues = [
+                engines[i % 2].submit(p, max_tokens)[0]
+                for i, p in enumerate(prompt_set)
+            ]
+            for q in queues:
+                while q.get(timeout=600) is not LmEngine.CLOSE:
+                    pass
+            elapsed = time.perf_counter() - t0
+            hits = misses = remote = 0
+            for engine in engines:
+                stats = engine.prefix_stats()
+                hits += stats.get("hits", 0)
+                misses += stats.get("misses", 0)
+                remote += engine.fleet_stats()["remote_blocks"]
+        finally:
+            for engine in engines:
+                engine.close()
+            for tier in tiers:
+                tier.close()
+        looked = hits + misses
+        pct = (
+            100.0 * min(hits + remote, looked) / looked if looked else 0.0
+        )
+        return pct, remote, elapsed
+
+    single_pct, _, single_s = run(False)
+    fleet_pct, remote_blocks, fleet_s = run(True)
+    return {
+        "fleet_lm_prefix_hit_pct": round(fleet_pct, 1),
+        "fleet_lm_prefix_single_replica_hit_pct": round(single_pct, 1),
+        "fleet_lm_prefix_remote_blocks": remote_blocks,
+        "fleet_lm_prefix_single_s": round(single_s, 3),
+        "fleet_lm_prefix_fleet_s": round(fleet_s, 3),
+        "fleet_replicas": 2,
+    }
+
+
 def _lm_prompt(i):
     # zero-padded so EVERY prompt (and the warmup) encodes to the same
     # token shape — the LM forward is shape-keyed jit
@@ -979,6 +1063,7 @@ def main():
         server.stop()
     lm_inproc = attempt("lm_inproc", _run_lm_inproc) or {}
     lm_prefix = attempt("lm_prefix", _run_lm_prefix) or {}
+    fleet_prefix = attempt("fleet_prefix", _run_fleet_prefix) or {}
 
     # Headline instrument: the native C++ worker when built (GIL-free async
     # contexts — measures the SERVER, not the client); the python-harness
@@ -1206,6 +1291,7 @@ def main():
         **lm_batched,
         **lm_inproc,
         **lm_prefix,
+        **fleet_prefix,
         **link,
     }
     if lm:

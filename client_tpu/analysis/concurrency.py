@@ -24,6 +24,16 @@ single-function shape):
   deadlocks; one that blocks extends the critical section unboundedly.
   This is the re-entrancy vector the balance/frontdoor observer plumbing
   is one refactor away from.
+- **PEER-CALL-UNDER-LOCK** — the fleet-tier generalization of
+  BLOCK-UNDER-LOCK: a rendezvous/peer RPC (``fleet``/``peer``/
+  ``rendezvous``-named receivers, or the frame/gather transport
+  primitives) reachable while any engine or pool lock is held.  A peer
+  lookup is bounded by timeouts, but "bounded" is still hundreds of
+  milliseconds — under the LM engine's ``_cv`` that stalls every decode
+  tick behind one slow peer; under the balance pool's lock it stalls
+  every route.  The tier's whole surface (even its host-side methods)
+  must stay out of critical sections: snapshot under the lock, call the
+  tier outside it.
 
 Precision choices (documented FN > noisy FP):
 
@@ -63,6 +73,42 @@ def _is_pseudo(lock):
     return lock.startswith("<caller-held:")
 
 
+# Receiver segments (leading underscores stripped) that mark a call as a
+# peer RPC: anything invoked on a fleet tier / peer set / rendezvous
+# object.  Deliberately receiver-shaped, not op-shaped — the tier's
+# host-side methods ride the same ban (a critical section should not
+# even *touch* the tier's surface; snapshot and call outside).
+_PEER_RECEIVERS = {"fleet", "peer", "peers", "rendezvous", "rdv"}
+
+# Call names that ARE the fleet/peer surface, whatever the receiver:
+# the frame primitives, the rendezvous collective ops, and FleetTier's
+# methods.  Needed because the callgraph collapses ``self.fleet.x(...)``
+# to a receiver-less ("method", "x") ref — the names carry the signal
+# when the receiver text is gone.
+_PEER_CALL_NAMES = {
+    "all_gather", "all_ranks_stable", "peer_call", "_peer_call",
+    "send_frame", "recv_frame", "_send_frame", "_recv_frame",
+    "fetch_summary", "prefix_lookup", "cache_lookup", "gossip_now",
+    "export_prefix", "local_summary",
+}
+
+
+def _peer_desc(ref):
+    """Human-readable description when *ref* is a peer RPC, else None."""
+    kind, value = ref
+    if kind in ("name", "method", "self"):
+        if value in _PEER_CALL_NAMES:
+            return (f"self.{value}()" if kind == "self" else value + "()")
+        return None
+    parts = value.split(".")
+    if parts[-1] in _PEER_CALL_NAMES:
+        return value + "()"
+    for part in parts[:-1]:
+        if part.lstrip("_") in _PEER_RECEIVERS:
+            return value + "()"
+    return None
+
+
 class _Effects:
     """Memoized transitive effects (blocking ops, callback invocations,
     lock acquisitions) per function."""
@@ -72,6 +118,7 @@ class _Effects:
         self._blocking = {}
         self._callbacks = {}
         self._acquires = {}
+        self._peers = {}
 
     # Each entry: (desc, kind, waits_on, chain-tuple)
     def blocking(self, mod, fn):
@@ -92,6 +139,21 @@ class _Effects:
             self._callbacks, mod, fn,
             direct=lambda f: [
                 (c["desc"], (f.qualname,)) for c in f.callbacks
+            ],
+            extend=lambda eff, qual: [
+                (d, (qual,) + chain) for d, chain in eff
+            ],
+        )
+
+    # Each entry: (desc, chain-tuple) — peer RPCs reachable from fn
+    def peer_calls(self, mod, fn):
+        return self._memo(
+            self._peers, mod, fn,
+            direct=lambda f: [
+                (desc, (f.qualname,))
+                for call in f.calls
+                if not call["deferred"]
+                and (desc := _peer_desc(call["ref"])) is not None
             ],
             extend=lambda eff, qual: [
                 (d, (qual,) + chain) for d, chain in eff
@@ -272,6 +334,69 @@ class CallbackUnderLockRule(ProgramRule):
                         f"{_chain_text(chain)} invokes callback {desc} "
                         f"while {', '.join(sorted(held))} is held — "
                         "deliver outside the lock", "",
+                    ))
+                    break  # one finding per call site
+        return findings
+
+
+@register_program
+class PeerCallUnderLockRule(ProgramRule):
+    """PEER-CALL-UNDER-LOCK — a rendezvous/peer RPC reachable (at any
+    call depth) while an engine or pool lock is held.
+
+    The fleet-tier generalization of BLOCK-UNDER-LOCK: peer lookups are
+    timeout-bounded, so the blocking classifier does not see them — but
+    hundreds of milliseconds under the LM engine's ``_cv`` stalls every
+    decode tick, and under the balance pool's lock stalls every route.
+    Detection is receiver-shaped (calls on ``fleet``/``peer``/
+    ``rendezvous``-named objects) plus the transport primitives
+    (``send_frame``/``recv_frame``/``all_gather``/...), so the rule works
+    on fixtures and unresolvable call targets alike.  The whole tier
+    surface is banned under locks — host-side methods included — because
+    the correct shape is always the same: snapshot under the lock, call
+    the tier after releasing it (serve/lm/engine.py's submit/export
+    paths are the reference implementation).
+    """
+
+    id = "PEER-CALL-UNDER-LOCK"
+    rationale = (
+        "a peer/rendezvous RPC under an engine or pool lock stalls every "
+        "waiter behind one slow peer's timeout — snapshot under the "
+        "lock, call the peer outside it"
+    )
+
+    def check_program(self, program):
+        effects = _Effects(program)
+        findings = []
+        for mod, fn in program.iter_functions():
+            for call in fn.calls:
+                if call["deferred"]:
+                    continue
+                held = _effective_held(program, fn, call["held"])
+                if not held:
+                    continue
+                locks = ", ".join(sorted(held))
+                desc = _peer_desc(call["ref"])
+                if desc is not None:
+                    findings.append(Finding(
+                        self.id, mod.path, call["line"], call["col"],
+                        f"peer RPC {desc} invoked while holding {locks} "
+                        f"(in {fn.qualname}) — snapshot under the lock, "
+                        "call the peer outside it", "",
+                    ))
+                    continue
+                cmod, cfn = program.resolve(
+                    mod, fn, call["ref"], call["nargs"]
+                )
+                if cfn is None:
+                    continue
+                for peer_desc, chain in effects.peer_calls(cmod, cfn):
+                    findings.append(Finding(
+                        self.id, mod.path, call["line"], call["col"],
+                        f"call chain {fn.qualname} -> "
+                        f"{_chain_text(chain)} reaches peer RPC "
+                        f"{peer_desc} while {locks} is held — move the "
+                        "peer call outside the critical section", "",
                     ))
                     break  # one finding per call site
         return findings

@@ -11,12 +11,14 @@ four clients (sync/aio × HTTP/gRPC):
   weight, and live inflight count.
 - Discovery (:mod:`client_tpu.balance.discovery`) — pluggable
   :class:`Resolver` sources (static list, config-file watcher,
-  DNS-style callable) polled by a :class:`DiscoveryLoop` that feeds the
-  pool; resolver errors keep last-known-good membership.
+  DNS-style callable, TTL-honoring :class:`SrvResolver`) polled by a
+  :class:`DiscoveryLoop` that feeds the pool; resolver errors keep
+  last-known-good membership.
 - Policies (:mod:`client_tpu.balance.policy`) — round-robin,
-  least-inflight, power-of-two-choices, weighted, and sticky (sequence-
-  affine, with the :class:`SequenceRestartError` restart contract) —
-  behind one ``pick(candidates, request_ctx)`` interface.
+  least-inflight, power-of-two-choices, weighted, sticky (sequence-
+  affine, with the :class:`SequenceRestartError` restart contract), and
+  prefix-aware (cache-affinity over gossiped digest summaries) — behind
+  one ``pick(candidates, request_ctx)`` interface.
 - :class:`ReplicatedClient` / :class:`AsyncReplicatedClient` — the
   existing client API over a pool: every request (and every retry
   attempt, which excludes the failed endpoint) routes to a different
@@ -38,6 +40,7 @@ from client_tpu.balance.discovery import (
     ConfigFileResolver,
     DiscoveryLoop,
     Resolver,
+    SrvResolver,
     StaticResolver,
     make_resolver,
 )
@@ -45,6 +48,7 @@ from client_tpu.balance.policy import (
     LeastInflight,
     Policy,
     PowerOfTwoChoices,
+    PrefixAware,
     RoundRobin,
     SequenceRestartError,
     Sticky,
@@ -78,12 +82,14 @@ __all__ = [
     "PowerOfTwoChoices",
     "Weighted",
     "Sticky",
+    "PrefixAware",
     "SequenceRestartError",
     "make_policy",
     "Resolver",
     "StaticResolver",
     "CallableResolver",
     "ConfigFileResolver",
+    "SrvResolver",
     "make_resolver",
     "DiscoveryLoop",
     "ReplicatedClient",

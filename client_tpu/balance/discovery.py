@@ -23,12 +23,14 @@ gives tests and CLIs a synchronous poke.
 
 import json
 import threading
+import time
 
 __all__ = [
     "Resolver",
     "StaticResolver",
     "CallableResolver",
     "ConfigFileResolver",
+    "SrvResolver",
     "make_resolver",
     "DiscoveryLoop",
 ]
@@ -111,6 +113,82 @@ class ConfigFileResolver(Resolver):
             else:
                 specs.append((parts[0], float(parts[1])))
         return specs
+
+
+class SrvResolver(Resolver):
+    """DNS ``SRV``-style resolution honoring record TTLs.
+
+    ``lookup()`` answers like an SRV query: an iterable of records, each
+    a url string, a ``(url, weight)`` pair, or a ``(url, weight,
+    ttl_s)`` triple (target + weight + per-record TTL).  Two behaviors a
+    plain :class:`CallableResolver` cannot give a fleet:
+
+    - **TTL caching**: :meth:`resolve` serves the cached answer until
+      the SMALLEST record TTL expires (records without one use
+      ``default_ttl_s``; ``min_ttl_s`` floors a zero/garbage TTL so a
+      misconfigured zone cannot turn discovery into a query-per-request
+      hot loop), then re-resolves;
+    - **stale-on-error**: a lookup failure AFTER a successful resolution
+      serves the last-known-good answer and re-arms a retry after
+      ``min_ttl_s`` — a registry outage must not look like a fleet-wide
+      scale-down.  Only an initial failure, with nothing cached yet,
+      raises (the DiscoveryLoop then keeps ITS last-known-good).
+
+    ``resolutions``/``errors``/``last_error`` count live behavior for
+    tests and ops.
+    """
+
+    def __init__(self, lookup, default_ttl_s=30.0, min_ttl_s=1.0,
+                 time_fn=time.monotonic):
+        self._lookup = lookup
+        self.default_ttl_s = float(default_ttl_s)
+        self.min_ttl_s = float(min_ttl_s)
+        self._time = time_fn
+        self._lock = threading.Lock()
+        self._cached = None
+        self._expiry = 0.0
+        self.resolutions = 0
+        self.errors = 0
+        self.last_error = None
+
+    def _parse(self, records):
+        specs = []
+        ttls = []
+        for record in records:
+            if isinstance(record, (tuple, list)):
+                url = str(record[0])
+                weight = float(record[1]) if len(record) > 1 else 1.0
+                if len(record) > 2 and record[2] is not None:
+                    ttls.append(float(record[2]))
+                specs.append((url, weight))
+            else:
+                specs.append(str(record))
+        ttl = min(ttls) if ttls else self.default_ttl_s
+        return specs, max(ttl, self.min_ttl_s)
+
+    def resolve(self):
+        now = self._time()
+        with self._lock:
+            if self._cached is not None and now < self._expiry:
+                return list(self._cached)
+        try:
+            records = list(self._lookup())
+        except Exception as exc:  # noqa: BLE001 - stale-on-error
+            with self._lock:
+                self.errors += 1
+                self.last_error = exc
+                if self._cached is not None:
+                    # serve stale; retry after the floor, not the full
+                    # TTL (the outage should be re-probed promptly)
+                    self._expiry = now + self.min_ttl_s
+                    return list(self._cached)
+            raise
+        specs, ttl = self._parse(records)
+        with self._lock:
+            self._cached = specs
+            self._expiry = now + ttl
+            self.resolutions += 1
+        return list(specs)
 
 
 def make_resolver(spec):

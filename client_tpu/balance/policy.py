@@ -19,6 +19,13 @@ One interface — ``pick(candidates, request_ctx)`` over the pool's eligible
   remaps the sequence and surfaces :class:`SequenceRestartError` so the
   caller restarts the sequence instead of silently splitting its state
   across replicas.
+- **prefix-aware**: cache-affinity routing for the fleet cache tier —
+  route to the replica whose gossiped digest summary holds the request's
+  LONGEST cached prefix (``request_ctx['prefix_digests']``, the
+  cumulative block-chain digests of ``client_tpu.serve.fleet.
+  chain_digests``), multiplying the prefix cache's prefill savings by
+  the fleet hit rate; ties and digest-less requests fall back to
+  least-inflight, so stale gossip degrades to load balancing.
 
 Policies are invoked with the pool lock held: they may keep unguarded
 internal state (the round-robin cursor, the sticky sequence map), and
@@ -37,6 +44,7 @@ __all__ = [
     "PowerOfTwoChoices",
     "Weighted",
     "Sticky",
+    "PrefixAware",
     "SequenceRestartError",
     "make_policy",
 ]
@@ -217,12 +225,70 @@ class Sticky(Policy):
         return replacement
 
 
+class PrefixAware(Policy):
+    """Cache-affinity routing over ``request_ctx['prefix_digests']``.
+
+    The context value is the request's cumulative block-chain digest
+    list (``client_tpu.serve.fleet.chain_digests``: ``digests[i]``
+    identifies the first ``i + 1`` full token blocks).  Each candidate's
+    ``Endpoint.summary`` is the digest set its replica gossiped —
+    piggybacked on the pool's health probes
+    (``EndpointPool.set_summary``).  The pick is the replica holding the
+    request's LONGEST cached prefix: its trie (or fleet store) already
+    has those blocks, so routing there turns per-replica prefill savings
+    into fleet-level savings without any peer fetch at all.
+
+    Degradation is deliberate: requests without digests, candidates
+    without summaries (stale/never-gossiped), and ties all fall through
+    to *fallback* (least-inflight by default) — affinity is a hint, load
+    balance is the floor, and a wrong/stale summary can only cost the
+    peer-fetch the fleet tier would have done anyway.
+    """
+
+    name = "prefix-aware"
+
+    def __init__(self, fallback="least-inflight"):
+        self._fallback = make_policy(fallback)
+
+    @staticmethod
+    def _depth(digests, summary):
+        """Longest cached prefix: the deepest cumulative digest the
+        summary holds (walked longest-first — chain digests compose, so
+        holding digest i without i-1 only happens under store eviction,
+        and then the deeper hit is still the better answer)."""
+        for i in range(len(digests) - 1, -1, -1):
+            if digests[i] in summary:
+                return i + 1
+        return 0
+
+    def pick(self, candidates, request_ctx=None):
+        ctx = request_ctx or {}
+        digests = ctx.get("prefix_digests") or ()
+        if not digests:
+            return self._fallback.pick(candidates, request_ctx)
+        best_depth = 0
+        best = []
+        for endpoint in candidates:
+            summary = getattr(endpoint, "summary", None) or ()
+            depth = self._depth(digests, summary)
+            if depth > best_depth:
+                best_depth, best = depth, [endpoint]
+            elif depth == best_depth and best_depth > 0:
+                best.append(endpoint)
+        if not best:
+            return self._fallback.pick(candidates, request_ctx)
+        if len(best) == 1:
+            return best[0]
+        return self._fallback.pick(best, request_ctx)
+
+
 _POLICIES = {
     RoundRobin.name: RoundRobin,
     LeastInflight.name: LeastInflight,
     PowerOfTwoChoices.name: PowerOfTwoChoices,
     Weighted.name: Weighted,
     Sticky.name: Sticky,
+    PrefixAware.name: PrefixAware,
 }
 
 

@@ -96,6 +96,10 @@ class Endpoint:
         self.phase = PHASE_ACTIVE
         self.inflight = 0
         self.last_error = None
+        # Gossiped digest-prefix summary (what this replica's cache tier
+        # holds), fed by probes via set_summary; the prefix-aware policy
+        # reads it.  Empty = no affinity signal, policies fall back.
+        self.summary = frozenset()
         # Probation ramp-up (slow start): stamped at promote time when the
         # pool has a rampup window; ramp_fraction() climbs floor -> 1 over
         # [ramp_started, ramp_started + ramp_span].
@@ -383,6 +387,23 @@ class EndpointPool:
                 if endpoint.url == url:
                     endpoint.weight = float(weight)
 
+    def set_summary(self, url, digests):
+        """Install *url*'s gossiped cache-summary (an iterable of digest
+        strings — ``fleet.chain_digests`` / response-cache keys).  Probes
+        piggyback this: a ``probe(url)`` returning ``(state, digests)``
+        updates health AND summary in one round trip, so cache-aware
+        routing costs no extra probe traffic."""
+        summary = frozenset(str(d) for d in digests)
+        with self._lock:
+            for endpoint in self._endpoints:
+                if endpoint.url == url:
+                    endpoint.summary = summary
+
+    def summaries(self):
+        """{url: frozenset(digests)} gossip view."""
+        with self._lock:
+            return {e.url: e.summary for e in self._endpoints}
+
     # -- live membership (the discovery entry point) -------------------------
 
     def update_endpoints(self, specs):
@@ -518,9 +539,11 @@ class EndpointPool:
         """Start the background readiness prober.
 
         ``probe(url)`` must return one of the three state constants (the
-        clients' ``server_state()`` verb is exactly this shape) and should
-        bound its own transport timeout — a probe that can block forever
-        wedges the whole pool's (serial) prober.  Exceptions count as
+        clients' ``server_state()`` verb is exactly this shape) — or a
+        ``(state, digests)`` tuple to piggyback the replica's cache-tier
+        summary for prefix-aware routing — and should bound its own
+        transport timeout — a probe that can block forever wedges the
+        whole pool's (serial) prober.  Exceptions count as
         UNREACHABLE.  Each endpoint is probed on its own full-jittered
         schedule (first probe at ``uniform(0, interval)``, then every
         ``uniform(interval/2, interval)``) so a fleet of replicas never
@@ -583,9 +606,24 @@ class EndpointPool:
                     state = probe(url)
                 except Exception:
                     state = SERVER_UNREACHABLE
+                # probes may piggyback the replica's cache-summary gossip:
+                # (state, digests) updates health AND routing affinity in
+                # one round trip (see set_summary).  Any OTHER tuple arity
+                # is a malformed probe result and must degrade like a
+                # broken state — an unpack error here would kill the
+                # prober thread and freeze all health probing forever.
+                summary = None
+                if isinstance(state, tuple):
+                    if len(state) == 2:
+                        state, summary = state
+                    else:
+                        state = SERVER_UNREACHABLE
                 if state not in _VALID_STATES:
                     state = SERVER_UNREACHABLE  # a broken probe is no health
+                    summary = None
                 self.set_state(url, state)
+                if summary is not None:
+                    self.set_summary(url, summary)
                 self._probe_schedule(
                     url, next_due, time.monotonic(), interval_s, rng, False
                 )

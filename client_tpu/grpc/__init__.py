@@ -32,6 +32,7 @@ from client_tpu.utils import (
     SERVER_UNREACHABLE,
     InferenceServerException,
     raise_error,
+    stamp_tenant as _stamp_tenant,
 )
 
 __all__ = [
@@ -242,6 +243,7 @@ class InferenceServerClient:
         channel_args=None,
         retry_policy=None,
         tracer=None,
+        tenant=None,
     ):
         options = _channel_options(keepalive_options, channel_args)
         if creds is not None:
@@ -272,6 +274,9 @@ class InferenceServerClient:
         # Opt-in tracing (client_tpu.tracing.ClientTracer): client spans +
         # traceparent propagation over gRPC metadata.
         self._tracer = tracer
+        # Tenant identity stamped as x-tenant-id metadata on EVERY verb,
+        # unary and streaming (an explicitly passed header wins).
+        self._tenant = None if tenant is None else str(tenant)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -310,6 +315,7 @@ class InferenceServerClient:
             )
 
     def _call_once(self, name, request, headers=None, client_timeout=None, **kwargs):
+        headers = _stamp_tenant(headers, self._tenant)
         if self._verbose:
             print(f"{name}, metadata {headers}\n{request}")
         try:
@@ -657,7 +663,7 @@ class InferenceServerClient:
         try:
             future = self._stubs["ModelInfer"].future(
                 request,
-                metadata=_metadata(headers),
+                metadata=_metadata(_stamp_tenant(headers, self._tenant)),
                 timeout=client_timeout,
                 compression=_grpc_compression(compression_algorithm),
             )
@@ -694,7 +700,7 @@ class InferenceServerClient:
         self._stream = _InferStream(
             callback,
             self._stubs,
-            _metadata(headers),
+            _metadata(_stamp_tenant(headers, self._tenant)),
             stream_timeout,
             _grpc_compression(compression_algorithm),
         )

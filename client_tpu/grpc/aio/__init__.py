@@ -23,6 +23,7 @@ from client_tpu.grpc import (
     _channel_options,
     _grpc_compression,
     _metadata,
+    _stamp_tenant,
     raise_error_grpc,
 )
 from client_tpu.utils import (
@@ -58,6 +59,7 @@ class InferenceServerClient:
         channel_args=None,
         retry_policy=None,
         tracer=None,
+        tenant=None,
     ):
         options = _channel_options(keepalive_options, channel_args)
         if creds is not None:
@@ -87,6 +89,8 @@ class InferenceServerClient:
         # Opt-in tracing (client_tpu.tracing.ClientTracer): client spans +
         # traceparent propagation over gRPC metadata.
         self._tracer = tracer
+        # Tenant identity stamped on every verb (sync-client semantics).
+        self._tenant = None if tenant is None else str(tenant)
 
     async def close(self):
         await self._channel.close()
@@ -122,6 +126,7 @@ class InferenceServerClient:
             )
 
     async def _call_once(self, name, request, headers=None, client_timeout=None, **kw):
+        headers = _stamp_tenant(headers, self._tenant)
         if self._verbose:
             print(f"{name}, metadata {headers}\n{request}")
         try:
@@ -460,7 +465,7 @@ class InferenceServerClient:
             try:
                 stream = self._stubs["ModelStreamInfer"](
                     _requests(),
-                    metadata=_metadata(headers),
+                    metadata=_metadata(_stamp_tenant(headers, self._tenant)),
                     timeout=stream_timeout,
                     compression=_grpc_compression(compression_algorithm),
                 )

@@ -32,6 +32,7 @@ from client_tpu.utils import (
     InferenceServerException,
     from_wire_bytes,
     raise_error,
+    stamp_tenant as _stamp_tenant,
 )
 
 __all__ = [
@@ -159,6 +160,7 @@ class InferenceServerClient:
         insecure=False,
         retry_policy=None,
         tracer=None,
+        tenant=None,
     ):
         if "://" in url:
             scheme, _, rest = url.partition("://")
@@ -191,6 +193,10 @@ class InferenceServerClient:
         # calls, records client spans, and propagates a W3C traceparent so
         # the server's trace joins under the same trace id.
         self._tracer = tracer
+        # Tenant identity: stamped as the x-tenant-id header on EVERY verb
+        # so callers stop hand-threading headers= through each call (an
+        # explicitly passed header still wins).
+        self._tenant = None if tenant is None else str(tenant)
         self._executor = None  # lazily created for async_infer
 
     # -- lifecycle ----------------------------------------------------------
@@ -252,6 +258,7 @@ class InferenceServerClient:
     def _request_once(
         self, method, uri, headers=None, query_params=None, body=None, timeout_s=None
     ):
+        headers = _stamp_tenant(headers, self._tenant)
         url = f"{self._base_url}/{uri}"
         if query_params:
             url += "?" + urlencode(query_params, doseq=True)

@@ -18,6 +18,7 @@ from client_tpu._infer_types import InferInput, InferRequestedOutput  # noqa: F4
 from client_tpu.http import (  # same response/error parsing as sync
     InferResult,
     _get_error_from_response,
+    _stamp_tenant,
 )
 from client_tpu.utils import (
     SERVER_NOT_READY,
@@ -48,6 +49,7 @@ class InferenceServerClient:
         ssl_context=None,
         retry_policy=None,
         tracer=None,
+        tenant=None,
     ):
         if "://" in url:
             scheme, _, rest = url.partition("://")
@@ -70,6 +72,8 @@ class InferenceServerClient:
         # Opt-in tracing (client_tpu.tracing.ClientTracer): client spans +
         # traceparent propagation, same semantics as the sync client.
         self._tracer = tracer
+        # Tenant identity stamped on every verb (sync-client semantics).
+        self._tenant = None if tenant is None else str(tenant)
 
     async def close(self):
         await self._session.close()
@@ -123,6 +127,7 @@ class InferenceServerClient:
     async def _request_once(
         self, method, uri, headers=None, query_params=None, body=b"", timeout_s=None
     ):
+        headers = _stamp_tenant(headers, self._tenant)
         if self._verbose:
             print(f"{method} {self._base_url}/{uri}")
         kwargs = {}

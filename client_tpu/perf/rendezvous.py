@@ -25,7 +25,9 @@ from client_tpu.resilience import backoff_delays
 from client_tpu.utils import InferenceServerException
 
 
-def _send_frame(sock, obj):
+def send_frame(sock, obj):
+    """Write one length-prefixed JSON frame — the transport primitive the
+    rendezvous AND the fleet cache tier (serve/fleet.py) share."""
     payload = json.dumps(obj).encode("utf-8")
     sock.sendall(struct.pack("<I", len(payload)) + payload)
 
@@ -40,9 +42,15 @@ def _recv_exact(sock, n):
     return buf
 
 
-def _recv_frame(sock):
+def recv_frame(sock):
+    """Read one length-prefixed JSON frame (see :func:`send_frame`)."""
     (length,) = struct.unpack("<I", _recv_exact(sock, 4))
     return json.loads(_recv_exact(sock, length).decode("utf-8"))
+
+
+# historical private names (pre-fleet callers)
+_send_frame = send_frame
+_recv_frame = recv_frame
 
 
 class Rendezvous:

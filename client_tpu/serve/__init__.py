@@ -34,6 +34,7 @@ class Server:
         response_cache=None,
         coalescing=False,
         qos=None,
+        fleet=None,
     ):
         all_models = list(models or [])
         if with_default_models:
@@ -44,6 +45,7 @@ class Server:
             response_cache=response_cache,
             coalescing=coalescing,
             qos=qos,
+            fleet=fleet,
         )
         self._http = None
         self._grpc = None

@@ -1,0 +1,646 @@
+"""Cross-replica cache tier: the replica set as ONE cache, not N.
+
+Everything above the balance layer used to be N independent copies of one
+server — a replica death lost its KV prefix trie and response cache, and
+a prompt prefix prefilled on replica A bought replica B nothing.  This
+module makes cached state span the fleet:
+
+- **prefix tier**: each replica keeps a host-side store of the KV blocks
+  its prefix cache published (token-chain keyed, LRU-bounded).  A peer
+  admission whose local trie misses asks the fleet
+  (:meth:`FleetTier.prefix_lookup`) and installs the fetched blocks into
+  its own pool, so a prefix prefilled anywhere saves prefill everywhere —
+  and a parked (preempted) stream exported at planned retire resumes on a
+  surviving replica from the same store;
+- **response-cache tier**: a unary local cache miss consults peers
+  (:meth:`FleetTier.cache_lookup`) before dispatching — a fleet-hot key
+  costs the fleet one execution, not one per replica;
+- **gossip**: a background round piggybacks two compact payloads on the
+  peer transport — per-tenant admission counters (so token-bucket quotas
+  account fleet-wide; see ``TenantQoS.absorb_remote``) and digest-prefix
+  summaries (what the balance layer's prefix-aware routing policy keys
+  on; see :func:`chain_digests` and ``balance/policy.py``).
+
+Transport: the same length-prefixed JSON frames as the perf rendezvous
+(:mod:`client_tpu.perf.rendezvous`), one request/response per connection
+so the peer server stays stateless and a half-dead peer can only wedge
+its own connection.
+
+**The degraded-tier guarantee** — a degraded tier must never be slower
+than no tier: every peer lookup is bounded by ``fan_out`` peers x a
+short per-peer connect/read timeout, each peer sits behind its own
+:class:`~client_tpu.resilience.CircuitBreaker` (a dead peer stops being
+dialed after ``failure_threshold`` strikes and is only re-probed after
+``reset_timeout_s``), and every failure path falls back to local-only.
+With every peer unreachable the steady state is "breaker open, lookup
+returns immediately" — the serve path never blocks on the fleet.
+
+**Locking discipline**: peer RPCs (``cache_lookup`` / ``prefix_lookup``
+/ ``gossip_now`` and anything that reaches :meth:`FleetTier._peer_call`)
+MUST run with no engine or pool lock held — a peer call under the LM
+engine's ``_cv`` or the balance pool's lock would stall every decode
+tick / route behind a slow peer's timeout.  The tpu-lint
+``PEER-CALL-UNDER-LOCK`` rule enforces this shape program-wide; this
+module itself only ever touches its own ``_lock`` for host-side
+bookkeeping and releases it before any socket work.
+"""
+
+import base64
+import hashlib
+import socket
+import threading
+import time
+from collections import OrderedDict
+
+import numpy as np
+
+from client_tpu.perf.rendezvous import recv_frame, send_frame
+from client_tpu.resilience import CircuitBreakerRegistry, CircuitOpenError
+from client_tpu.serve.metrics import FLEET_HELP
+
+__all__ = [
+    "FleetTier",
+    "chain_digests",
+    "fetch_summary",
+]
+
+
+def chain_digests(tokens, block_size, max_blocks=None):
+    """Cumulative digest per FULL token block of *tokens*.
+
+    ``digests[i]`` identifies the first ``(i + 1) * block_size`` tokens —
+    the same chain identity the prefix trie keys on, compressed to 16 hex
+    chars so thousands fit in a gossip frame.  Both sides of prefix-aware
+    routing use this: replicas summarize their stores with it and clients
+    stamp it into ``request_ctx['prefix_digests']``.
+    """
+    row = [int(t) for t in np.asarray(tokens).reshape(-1)]
+    block_size = int(block_size)
+    n = len(row) // block_size
+    if max_blocks is not None:
+        n = min(n, int(max_blocks))
+    digest = hashlib.sha256()
+    out = []
+    for i in range(n):
+        block = row[i * block_size:(i + 1) * block_size]
+        digest.update((",".join(map(str, block)) + ";").encode("ascii"))
+        out.append(digest.hexdigest()[:16])
+    return out
+
+
+def _encode_block(arrays):
+    """One block's per-layer [block_size, kv_heads, head_dim] arrays ->
+    JSON-safe dict (dtype + shape + base64 payload per layer)."""
+    return [
+        {
+            "dtype": str(a.dtype),
+            "shape": list(a.shape),
+            "data": base64.b64encode(np.ascontiguousarray(a).tobytes())
+            .decode("ascii"),
+        }
+        for a in arrays
+    ]
+
+
+def _decode_block(encoded):
+    return [
+        np.frombuffer(
+            base64.b64decode(e["data"]), dtype=np.dtype(e["dtype"])
+        ).reshape(e["shape"])
+        for e in encoded
+    ]
+
+
+class _PrefixStore:
+    """Host-side store of published KV prefix blocks, token-chain keyed.
+
+    One entry per FULL block, keyed by the flattened token prefix up to
+    and including that block (exact tuple keys, like the on-device trie:
+    a match is a guarantee).  Values are per-layer host arrays — no
+    device state, so serving a peer's lookup touches no engine lock and
+    no accelerator.  LRU-bounded by block count.
+    """
+
+    def __init__(self, max_blocks=4096):
+        self.max_blocks = int(max_blocks)
+        self._lock = threading.Lock()
+        # tuple(tokens[: (i+1)*bs]) -> (digest, k_layers, v_layers)
+        self._entries = OrderedDict()
+
+    def put(self, row, n_blocks, block_size, host_k, host_v):
+        """Insert ``n_blocks`` leading full blocks of *row* (host arrays
+        per layer, shaped [>=n_blocks, block_size, kv, hd])."""
+        row = [int(t) for t in np.asarray(row).reshape(-1)]
+        n_blocks = min(int(n_blocks), len(row) // int(block_size))
+        digests = chain_digests(row, block_size, n_blocks)
+        with self._lock:
+            for i in range(n_blocks):
+                key = tuple(row[: (i + 1) * int(block_size)])
+                if key not in self._entries:
+                    self._entries[key] = (
+                        digests[i],
+                        [np.asarray(k[i]) for k in host_k],
+                        [np.asarray(v[i]) for v in host_v],
+                    )
+                self._entries.move_to_end(key)
+            while len(self._entries) > self.max_blocks:
+                self._entries.popitem(last=False)
+
+    def lookup(self, row, block_size, max_blocks):
+        """Longest stored chain for *row*: ``(covered, k_layers,
+        v_layers)`` with per-layer arrays stacked [covered, bs, kv, hd],
+        or None on a total miss."""
+        row = [int(t) for t in np.asarray(row).reshape(-1)]
+        block_size = int(block_size)
+        hits = []
+        with self._lock:
+            for i in range(int(max_blocks)):
+                key = tuple(row[: (i + 1) * block_size])
+                entry = self._entries.get(key)
+                if entry is None:
+                    break
+                self._entries.move_to_end(key)
+                hits.append(entry)
+        if not hits:
+            return None
+        n_layers = len(hits[0][1])
+        k_layers = [
+            np.stack([h[1][layer] for h in hits]) for layer in range(n_layers)
+        ]
+        v_layers = [
+            np.stack([h[2][layer] for h in hits]) for layer in range(n_layers)
+        ]
+        return len(hits), k_layers, v_layers
+
+    def digests(self, limit=512):
+        """Most-recently-used chain digests (the gossip summary)."""
+        with self._lock:
+            keys = list(self._entries)[-int(limit):]
+            return [self._entries[k][0] for k in keys]
+
+    @property
+    def blocks(self):
+        with self._lock:
+            return len(self._entries)
+
+    def clear(self):
+        with self._lock:
+            self._entries.clear()
+
+
+def fetch_summary(addr, timeout_s=0.5):
+    """One replica's routing summary ``{"prefix_digests": [...],
+    "cache_digests": [...]}`` from its fleet peer port — the payload a
+    pool health probe piggybacks (``EndpointPool.set_summary``).  Raises
+    on transport failure (the probe loop treats that as no-summary)."""
+    host, _, port = str(addr).rpartition(":")
+    with socket.create_connection(
+        (host or "127.0.0.1", int(port)), timeout=timeout_s
+    ) as sock:
+        sock.settimeout(timeout_s)
+        send_frame(sock, {"op": "summary"})
+        reply = recv_frame(sock)
+    return {
+        "prefix_digests": list(reply.get("prefix_digests") or ()),
+        "cache_digests": list(reply.get("cache_digests") or ()),
+    }
+
+
+class FleetTier:
+    """One replica's membership in the cross-replica cache tier.
+
+    Owns the peer-facing server (answers ``cache_get`` / ``prefix_get``
+    / ``gossip`` / ``summary`` / ``ping``), the host-side
+    :class:`_PrefixStore`, the per-peer circuit breakers, and the gossip
+    loop.  Attach to a serving engine with :meth:`attach` (wires the
+    response cache + TenantQoS; the LM engine binds itself through the
+    model binder — see ``language.lm_streaming_batched_model``).
+
+    Peer RPC methods must be called with NO engine/pool lock held (the
+    ``PEER-CALL-UNDER-LOCK`` gate); local-store methods
+    (:meth:`export_prefix`, :meth:`local_summary`) are host-side only
+    and safe anywhere outside device-dispatch critical sections.
+    """
+
+    def __init__(self, bind="127.0.0.1:0", peers=(), lookup_timeout_s=0.25,
+                 fan_out=2, gossip_interval_s=2.0, failure_threshold=3,
+                 reset_timeout_s=5.0, max_store_blocks=4096,
+                 summary_limit=512, registry=None):
+        host, _, port = str(bind).rpartition(":")
+        self._bind_host = host or "127.0.0.1"
+        self._bind_port = int(port)
+        self.lookup_timeout_s = float(lookup_timeout_s)
+        self.fan_out = max(int(fan_out), 1)
+        self.gossip_interval_s = float(gossip_interval_s)
+        self.summary_limit = int(summary_limit)
+        self.registry = registry
+        self.store = _PrefixStore(max_store_blocks)
+        self._breakers = CircuitBreakerRegistry(
+            failure_threshold=failure_threshold,
+            reset_timeout_s=reset_timeout_s,
+        )
+        self._lock = threading.Lock()  # peers list + counters only
+        self._peers = [str(p) for p in peers]
+        # addr -> {tenant: n}: admission deltas not yet ACKED by that
+        # peer.  delta_counts() is destructive, so a failed/breaker-open
+        # send must not lose its deltas — they retry next round (a long-
+        # dead peer's map stays bounded by the tenant count; its counts
+        # drain into the peer's bucket, floored at zero, when it revives)
+        self._pending_gossip = {}
+        self._engine = None      # InferenceEngine (response cache + qos)
+        self._server = None
+        self._accept_thread = None
+        self._gossip_thread = None
+        self._stop = threading.Event()
+        self._address = None
+        # host-side counters (mirrored into the registry when bound)
+        self.peer_hits = 0
+        self.peer_misses = 0
+        self.peer_errors = 0
+        self.peer_skips = 0
+        self.gossip_rounds = 0
+        self.served = 0  # peer requests this replica answered
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def attach(self, engine):
+        """Bind to an :class:`~client_tpu.serve.model_runtime.
+        InferenceEngine`: the tier reads its response cache + TenantQoS
+        and the engine routes front-door misses through the tier.
+        (Written under the tier lock: the peer-server and gossip threads
+        may already be running when a server attaches late.)"""
+        with self._lock:
+            self._engine = engine
+            if self.registry is None and getattr(engine, "metrics", None):
+                self.registry = engine.metrics
+        engine.fleet = self
+        return self
+
+    def start(self):
+        if self._server is not None:
+            return self
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind((self._bind_host, self._bind_port))
+        srv.listen(16)
+        srv.settimeout(0.2)
+        self._server = srv
+        with self._lock:  # peers() filters against it from other threads
+            self._address = "%s:%d" % srv.getsockname()[:2]
+        self._stop = threading.Event()
+        self._accept_thread = threading.Thread(
+            target=self._serve_loop, args=(srv, self._stop),
+            name="fleet-peer", daemon=True,
+        )
+        self._accept_thread.start()
+        if self.gossip_interval_s > 0:
+            self._gossip_thread = threading.Thread(
+                target=self._gossip_loop, args=(self._stop,),
+                name="fleet-gossip", daemon=True,
+            )
+            self._gossip_thread.start()
+        return self
+
+    def close(self):
+        self._stop.set()
+        for thread in (self._accept_thread, self._gossip_thread):
+            if thread is not None:
+                thread.join(timeout=5)
+        self._accept_thread = self._gossip_thread = None
+        if self._server is not None:
+            self._server.close()
+            self._server = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.close()
+
+    @property
+    def address(self):
+        return self._address
+
+    def set_peers(self, addrs):
+        """Install the peer set.  Membership lists can be shared
+        verbatim across the fleet: the replica's own address is filtered
+        at USE time (:meth:`peers`), which also covers addresses handed
+        to the constructor or installed before :meth:`start` bound the
+        listen port — a replica gossiping to itself would double-drain
+        its own tenant quotas."""
+        with self._lock:
+            self._peers = [str(a) for a in addrs]
+
+    def peers(self):
+        with self._lock:
+            return [a for a in self._peers if a != self._address]
+
+    # -- peer server side --------------------------------------------------
+
+    def _serve_loop(self, srv, stop):
+        while not stop.is_set():
+            try:
+                conn, _ = srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            # one short-lived thread per connection: a half-dead peer
+            # holding a partial frame wedges only ITS handler, never the
+            # accept loop — healthy peers' lookups keep answering inside
+            # their timeout instead of collecting breaker strikes
+            threading.Thread(
+                target=self._serve_one, args=(conn,),
+                name="fleet-peer-conn", daemon=True,
+            ).start()
+
+    def _serve_one(self, conn):
+        try:
+            conn.settimeout(max(self.lookup_timeout_s * 4, 1.0))
+            request = recv_frame(conn)
+            send_frame(conn, self._handle(request))
+            with self._lock:
+                self.served += 1
+        except Exception:
+            # a garbled/half-dead peer costs exactly one connection
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _handle(self, request):
+        op = request.get("op")
+        if op == "ping":
+            return {"ok": True}
+        if op == "summary":
+            return self.local_summary()
+        if op == "cache_get":
+            return self._handle_cache_get(request.get("key"))
+        if op == "prefix_get":
+            return self._handle_prefix_get(request)
+        if op == "gossip":
+            engine = self._engine
+            qos = getattr(engine, "qos", None) if engine else None
+            if qos is not None:
+                qos.absorb_remote(request.get("tenants") or {})
+            return {"ok": True}
+        return {"error": f"unknown op {op!r}"}
+
+    def _handle_cache_get(self, key):
+        engine = self._engine
+        cache = getattr(engine, "response_cache", None) if engine else None
+        value = cache.peek(key) if cache is not None and key else None
+        if value is None:
+            return {"hit": False}
+        response, blobs = value
+        return {
+            "hit": True,
+            "response": response,
+            "blobs": [
+                base64.b64encode(bytes(b)).decode("ascii") for b in blobs
+            ],
+        }
+
+    def _handle_prefix_get(self, request):
+        start = max(int(request.get("start") or 0), 0)
+        got = self.store.lookup(
+            request.get("tokens") or [],
+            int(request.get("block_size") or 0) or 1,
+            int(request.get("max_blocks") or 0),
+        )
+        if got is None or got[0] <= start:
+            # nothing beyond what the asker already holds locally
+            return {"hit": False}
+        covered, k_layers, v_layers = got
+        return {
+            "hit": True,
+            "covered": covered,
+            "start": start,
+            # only the tail past the asker's local match travels: the
+            # first `start` blocks would be sliced off and discarded,
+            # and base64-inflated KV is the expensive part of the frame
+            "k": _encode_block([k[start:] for k in k_layers]),
+            "v": _encode_block([v[start:] for v in v_layers]),
+        }
+
+    # -- peer client side (NEVER call with an engine/pool lock held) -------
+
+    def _peer_call(self, addr, payload):
+        """One framed request/response against *addr* with bounded
+        connect + read timeouts.  Raises OSError-family on any transport
+        failure — callers feed the per-peer breaker."""
+        host, _, port = addr.rpartition(":")
+        with socket.create_connection(
+            (host or "127.0.0.1", int(port)), timeout=self.lookup_timeout_s
+        ) as sock:
+            sock.settimeout(self.lookup_timeout_s)
+            send_frame(sock, payload)
+            return recv_frame(sock)
+
+    def _candidates(self):
+        """Breaker-admitted peer snapshot (skips counted): at most
+        ``fan_out`` peers per lookup, so a lookup's worst case is
+        ``fan_out * lookup_timeout_s`` even before breakers open."""
+        out = []
+        for addr in self.peers():
+            breaker = self._breakers.get(addr)
+            try:
+                breaker.before_attempt()
+            except CircuitOpenError:
+                with self._lock:
+                    self.peer_skips += 1
+                self._count("ctpu_fleet_peer_skips_total")
+                continue
+            out.append((addr, breaker))
+            if len(out) >= self.fan_out:
+                break
+        return out
+
+    def _ask(self, payload):
+        """Fan the payload out peer-by-peer.  Yields ``(addr, reply)``
+        for each answered peer; ANY peer failure is a breaker strike and
+        a local-only fallback, never a caller-visible error."""
+        for addr, breaker in self._candidates():
+            try:
+                reply = self._peer_call(addr, payload)
+            except Exception:  # noqa: BLE001 - containment is the point
+                breaker.record_failure()
+                with self._lock:
+                    self.peer_errors += 1
+                self._count("ctpu_fleet_peer_errors_total")
+                continue
+            breaker.record_success()
+            yield addr, reply
+
+    def cache_lookup(self, key):
+        """Peer response-cache lookup: ``(response_json, blobs)`` or
+        None.  Bounded fan-out, per-peer timeout, local-only on error."""
+        for _addr, reply in self._ask({"op": "cache_get", "key": key}):
+            if reply.get("hit"):
+                self._note_lookup(True, "cache")
+                blobs = [
+                    base64.b64decode(b) for b in reply.get("blobs") or ()
+                ]
+                return reply["response"], blobs
+        self._note_lookup(False, "cache")
+        return None
+
+    def prefix_lookup(self, tokens, block_size, max_blocks,
+                      start_blocks=0):
+        """Longest peer-cached KV chain for *tokens*: ``(covered,
+        k_layers, v_layers, start)`` or None.  ``start_blocks`` is how
+        many leading blocks the asker already holds locally — only the
+        tail past it travels the wire; the returned per-layer host
+        arrays cover blocks ``[start, covered)``.  Takes the best answer
+        across the fan-out; stops early on full coverage."""
+        tokens = [int(t) for t in np.asarray(tokens).reshape(-1)]
+        start_blocks = max(int(start_blocks), 0)
+        payload = {
+            "op": "prefix_get",
+            "tokens": tokens,
+            "block_size": int(block_size),
+            "max_blocks": int(max_blocks),
+            "start": start_blocks,
+        }
+        best = None
+        for _addr, reply in self._ask(payload):
+            if not reply.get("hit"):
+                continue
+            covered = int(reply.get("covered") or 0)
+            if best is None or covered > best[0]:
+                try:
+                    best = (
+                        covered,
+                        _decode_block(reply["k"]),
+                        _decode_block(reply["v"]),
+                        start_blocks,
+                    )
+                except (KeyError, ValueError):
+                    continue  # malformed peer payload: ignore it
+                if covered >= int(max_blocks):
+                    break
+        self._note_lookup(best is not None, "prefix")
+        return best
+
+    def gossip_now(self):
+        """Push one gossip round to EVERY breaker-admitted peer: the
+        local per-tenant admission deltas (fleet-wide quota accounting).
+        Deltas a peer did not ACK — send failure, open breaker — are
+        retained per-peer and retried next round, so a transient
+        partition delays convergence instead of losing admissions.
+        Returns the number of peers that acked."""
+        engine = self._engine
+        qos = getattr(engine, "qos", None) if engine else None
+        fresh = qos.delta_counts() if qos is not None else {}
+        peers = self.peers()
+        with self._lock:
+            for addr in peers:
+                pending = self._pending_gossip.setdefault(addr, {})
+                for tenant, n in fresh.items():
+                    pending[tenant] = pending.get(tenant, 0) + n
+            for addr in list(self._pending_gossip):
+                if addr not in peers:  # departed peer: drop its backlog
+                    del self._pending_gossip[addr]
+        acked = 0
+        for addr in peers:
+            with self._lock:
+                tenants = dict(self._pending_gossip.get(addr) or {})
+            breaker = self._breakers.get(addr)
+            try:
+                breaker.before_attempt()
+            except CircuitOpenError:
+                continue
+            try:
+                self._peer_call(addr, {"op": "gossip", "tenants": tenants})
+            except Exception:  # noqa: BLE001 - containment is the point
+                breaker.record_failure()
+                continue
+            breaker.record_success()
+            acked += 1
+            with self._lock:
+                pending = self._pending_gossip.get(addr)
+                if pending is not None:
+                    # subtract what was ACKED (concurrent rounds may have
+                    # grown the backlog since the snapshot)
+                    for tenant, n in tenants.items():
+                        left = pending.get(tenant, 0) - n
+                        if left > 0:
+                            pending[tenant] = left
+                        else:
+                            pending.pop(tenant, None)
+        with self._lock:
+            self.gossip_rounds += 1
+        self._count("ctpu_fleet_gossip_rounds_total")
+        return acked
+
+    def _gossip_loop(self, stop):
+        while not stop.wait(self.gossip_interval_s):
+            try:
+                self.gossip_now()
+            except Exception:  # pragma: no cover - defensive
+                pass
+
+    # -- local store (host-side; no peer RPC, no device state) -------------
+
+    def export_prefix(self, row, n_blocks, block_size, host_k, host_v):
+        """Install *n_blocks* leading full blocks of the token row into
+        this replica's host store (the LM engine calls this at prefill
+        completion and at planned retire for parked streams — always
+        OUTSIDE its condition lock; the arrays are already host-side)."""
+        self.store.put(row, n_blocks, block_size, host_k, host_v)
+        self._gauge()
+
+    def local_summary(self):
+        """The gossip/probe summary: most-recent chain digests plus the
+        response cache's digest keys (truncated to the summary limit)."""
+        engine = self._engine
+        cache = getattr(engine, "response_cache", None) if engine else None
+        cache_digests = (
+            cache.keys()[-self.summary_limit:] if cache is not None else []
+        )
+        return {
+            "prefix_digests": self.store.digests(self.summary_limit),
+            "cache_digests": cache_digests,
+        }
+
+    # -- metrics / introspection -------------------------------------------
+
+    def _count(self, name, labels=None, value=1):
+        if self.registry is not None:
+            self.registry.inc(name, labels, value=value,
+                              help_=FLEET_HELP[name])
+
+    def _gauge(self):
+        if self.registry is not None:
+            self.registry.set(
+                "ctpu_fleet_store_blocks", None, self.store.blocks,
+                help_=FLEET_HELP["ctpu_fleet_store_blocks"],
+            )
+
+    def _note_lookup(self, hit, op):
+        with self._lock:
+            if hit:
+                self.peer_hits += 1
+            else:
+                self.peer_misses += 1
+        self._count(
+            "ctpu_fleet_peer_hits_total" if hit
+            else "ctpu_fleet_peer_misses_total",
+            {"op": op},
+        )
+
+    def stats(self):
+        store_blocks = self.store.blocks
+        with self._lock:
+            return {
+                "peer_hits": self.peer_hits,
+                "peer_misses": self.peer_misses,
+                "peer_errors": self.peer_errors,
+                "peer_skips": self.peer_skips,
+                "gossip_rounds": self.gossip_rounds,
+                "served": self.served,
+                "store_blocks": store_blocks,
+                "peers": list(self._peers),
+            }

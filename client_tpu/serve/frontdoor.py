@@ -33,7 +33,7 @@ import threading
 import time
 from collections import OrderedDict
 
-from client_tpu.utils import InferenceServerException
+from client_tpu.utils import TENANT_HEADER, InferenceServerException
 
 __all__ = [
     "TENANT_HEADER",
@@ -42,10 +42,6 @@ __all__ = [
     "TenantQoS",
     "request_digest",
 ]
-
-# The wire key both frontends read tenant identity from (HTTP header name /
-# gRPC metadata key — gRPC metadata keys are lowercase by spec).
-TENANT_HEADER = "x-tenant-id"
 
 
 def request_digest(model_name, model_version, request, binary_section):
@@ -225,6 +221,28 @@ class ResponseCache:
                 self._inc("ctpu_cache_evictions_total", {"reason": "lru"})
             self._gauges_locked()
 
+    def peek(self, key):
+        """Read *key* WITHOUT touching hit/miss counters or LRU order —
+        the fleet peer-serving path: a peer's lookup must not skew this
+        replica's own hit-rate accounting (its miss already counted on
+        the replica that asked) nor keep entries hot that only remote
+        traffic touches.  TTL still applies (a stale entry is stale for
+        peers too), but expiry is left to the owning ``get`` path."""
+        now = time.monotonic()
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                return None
+            ttl = entry[3] if entry[3] is not None else self.ttl_s
+            if ttl is not None and now - entry[2] > ttl:
+                return None
+            return entry[0]
+
+    def keys(self):
+        """Digest-key snapshot (the routing-gossip summary source)."""
+        with self._lock:
+            return list(self._entries)
+
     def clear(self):
         with self._lock:
             self._entries.clear()
@@ -357,13 +375,17 @@ class _TokenBucket:
 
 
 class _TenantState:
-    __slots__ = ("inflight", "bucket", "requests", "shed")
+    __slots__ = ("inflight", "bucket", "requests", "shed", "gossip_delta")
 
     def __init__(self):
         self.inflight = 0
         self.bucket = None
         self.requests = 0
         self.shed = 0
+        # admissions since the last fleet-gossip collection: what peers
+        # drain from THEIR buckets so a flooder spraying N replicas
+        # converges on ~1x its quota fleet-wide, not N x
+        self.gossip_delta = 0
 
 
 class TenantQoS:
@@ -484,6 +506,7 @@ class TenantQoS:
                     retry_after = wait
             if reason is None:
                 state.inflight += 1
+                state.gossip_delta += 1
                 # gauge written under the SAME lock as the count: a
                 # read-then-set outside it lets a preempted thread park
                 # the gauge on a stale value (same delivery-ordering
@@ -537,6 +560,43 @@ class TenantQoS:
                 "ctpu_tenant_inflight", {"tenant": tenant}, inflight,
                 help_="Requests currently executing per tenant",
             )
+
+    # -- fleet-wide accounting ----------------------------------------------
+
+    def delta_counts(self):
+        """{tenant: admissions since the last call} — collected by the
+        fleet gossip loop and pushed to peers, then reset.  Only tenants
+        with activity appear (the payload stays compact)."""
+        with self._lock:
+            out = {}
+            for tenant, state in self._states.items():
+                if state.gossip_delta:
+                    out[tenant] = state.gossip_delta
+                    state.gossip_delta = 0
+            return out
+
+    def absorb_remote(self, counts):
+        """Drain each tenant's local token bucket by the admissions a
+        PEER replica reported (fleet gossip): the rate quota becomes
+        approximately fleet-wide instead of per-process, so a flooder
+        cannot collect N x its quota by spraying N replicas.  Convergence
+        is eventual (one gossip interval of slack); tenants without a
+        bucket, or unknown here, are ignored — remote evidence must never
+        fabricate local state."""
+        with self._lock:
+            for tenant, n in (counts or {}).items():
+                state = self._states.get(tenant)
+                if state is None and tenant in self.tenants:
+                    # operator-configured tenant this replica just hasn't
+                    # served yet: materialize its bucket so the remote
+                    # consumption isn't forgotten (arbitrary gossip names
+                    # stay ignored — a peer must not grow the state map)
+                    state = self._state_locked(tenant)
+                if state is None or state.bucket is None:
+                    continue
+                state.bucket.tokens = max(
+                    state.bucket.tokens - float(n), 0.0
+                )
 
     # -- introspection -------------------------------------------------------
 

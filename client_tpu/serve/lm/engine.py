@@ -74,7 +74,7 @@ from client_tpu.serve.lm.policy import (
     pad_prompt,
 )
 from client_tpu.serve.lm.prefix import PrefixCache
-from client_tpu.serve.metrics import LM_PREFIX_HELP
+from client_tpu.serve.metrics import FLEET_HELP, LM_PREFIX_HELP
 from client_tpu.serve.models.transformer import (
     _ffn_block,
     _mm,
@@ -241,7 +241,7 @@ class _Handle:
     _CANCELLED, or (slot, gen) once streaming."""
 
     __slots__ = ("prompt", "prompt_len", "max_tokens", "queue", "tenant",
-                 "temperature", "top_k", "seed", "placed")
+                 "temperature", "top_k", "seed", "placed", "remote_kv")
 
     def __init__(self, prompt, max_tokens, q, tenant, temperature, top_k,
                  seed):
@@ -254,11 +254,15 @@ class _Handle:
         self.top_k = int(top_k)
         self.seed = int(seed)
         self.placed = None
+        # (covered_blocks, host_k, host_v) fetched from the fleet prefix
+        # tier on the submit caller's thread (never under _cv); _admit
+        # adopts whatever still beats the local trie at admission time
+        self.remote_kv = None
 
 
 class _PrefillJob:
     __slots__ = ("handle", "slot", "blocks", "table", "plan", "chunk_idx",
-                 "key", "token", "resume")
+                 "key", "token", "resume", "remote")
 
     def __init__(self, handle, slot, blocks, table, plan, key):
         self.handle = handle
@@ -273,6 +277,11 @@ class _PrefillJob:
         # admissions): activation restores its produced/remaining state
         # and the saved token/RNG carry instead of the chunk's sample
         self.resume = None
+        # [lo, hi, host_k, host_v]: fleet-fetched KV content destined for
+        # blocks[lo:hi]; installed by the FIRST _prefill_step (outside
+        # _cv), cleared once on device — abort before install must not
+        # cache those blocks as valid content
+        self.remote = None
 
 
 class _Swapped:
@@ -333,7 +342,7 @@ class LmEngine:
                  tenant_lane_share=0.75, scale_up_after=3,
                  scale_down_after=50, tick_log_len=8192,
                  prefix_cache=True, min_prefix_blocks=1,
-                 tenant_priority=None, swap_block_limit=None):
+                 tenant_priority=None, swap_block_limit=None, fleet=None):
         self.params = params
         self.cfg = cfg
         self.max_slots = int(max_slots)
@@ -389,6 +398,13 @@ class LmEngine:
         self._preempt = None        # (slot, gen) chosen by _admit
         self._preemptions = 0
         self._resume_ms = []        # swap-out -> reactivation latencies
+
+        # fleet prefix tier (serve/fleet.py): peer lookups run on the
+        # SUBMIT caller's thread and exports on the scheduler thread,
+        # both strictly outside _cv (the PEER-CALL-UNDER-LOCK gate)
+        self.fleet = fleet
+        self._fleet_lookups = 0     # peer prefix lookups issued
+        self._fleet_blocks = 0      # blocks installed from peers
 
         # device state allocates lazily with the thread
         self.kv = None
@@ -464,6 +480,22 @@ class LmEngine:
         if kv is not None:
             kv.set_registry(registry)
 
+    def set_fleet(self, fleet):
+        """Late-bind the cross-replica prefix tier (add_model wiring):
+        submit consults it on local-trie shortfall, prefill completion
+        exports into it, drain migrates parked streams through it."""
+        with self._cv:
+            self.fleet = fleet
+
+    def fleet_stats(self):
+        """Fleet prefix-tier counters: peer lookups issued at submit and
+        KV blocks installed from peers (zeros when no tier is bound)."""
+        with self._cv:
+            return {
+                "remote_lookups": self._fleet_lookups,
+                "remote_blocks": self._fleet_blocks,
+            }
+
     # -- request side ------------------------------------------------------
 
     def submit(self, prompt_tokens, max_tokens, temperature=0.0, top_k=0,
@@ -478,6 +510,32 @@ class LmEngine:
             return q, None
         handle = _Handle(prompt, max_tokens, q, str(tenant or ""),
                          temperature, top_k, seed)
+        fleet = self.fleet
+        if fleet is not None and self._prefix_enabled:
+            shareable = (handle.prompt_len - 1) // self.block_size
+            if shareable > 0:
+                with self._cv:
+                    if self._closed:
+                        q.put(_CLOSE)
+                        return q, None
+                    self._ensure_thread_locked()
+                    local = len(
+                        self.prefix.match(handle.prompt[0], shareable)[0]
+                    )
+                    if local < shareable:
+                        self._fleet_lookups += 1
+                if local < shareable:
+                    # peer RPC on the CALLER's thread with no engine lock
+                    # held: a slow/dead peer delays only this submit, by
+                    # at most the tier's bounded fan-out x timeout — the
+                    # scheduler keeps ticking throughout.  Only the tail
+                    # past the local match travels (start_blocks).
+                    got = fleet.prefix_lookup(
+                        handle.prompt[0], self.block_size, shareable,
+                        start_blocks=local,
+                    )
+                    if got is not None and got[0] > local:
+                        handle.remote_kv = got
         with self._cv:
             if self._closed:
                 q.put(_CLOSE)
@@ -631,6 +689,12 @@ class LmEngine:
                 if job.chunk_idx < len(job.plan)
                 else job.handle.prompt_len
             )
+            if job.remote is not None:
+                # fleet-fetched blocks were never installed on device:
+                # only the locally adopted prefix below them is real
+                # content — caching the uninstalled range would poison
+                # the trie with garbage KV
+                written = min(written, job.remote[0] * self.block_size)
             self._release_blocks_locked(job.handle.prompt, written, blocks)
         if job.resume is not None:
             if not job.resume.cancelled:
@@ -860,6 +924,40 @@ class LmEngine:
             )
             table[:len(blocks)] = blocks
             start = len(matched_blocks) * self.block_size
+            job_remote = None
+            if handle.remote_kv is not None:
+                # fleet-tier adoption beyond the local trie: blocks
+                # [local..covered) are FRESH pool blocks whose content the
+                # first _prefill_step installs from the peer's host arrays
+                # (outside _cv); the chunk plan starts past them.  The
+                # fetched arrays cover blocks [rstart, covered) — if the
+                # trie shrank below rstart since the submit-time probe
+                # (eviction under pressure), the fetch cannot bridge the
+                # gap and is dropped: prefill is always correct, just
+                # slower.
+                covered = min(int(handle.remote_kv[0]), shareable)
+                rstart = handle.remote_kv[3]
+                if rstart <= len(matched_blocks) < covered:
+                    job_remote = [
+                        len(matched_blocks), covered,
+                        handle.remote_kv[1], handle.remote_kv[2], rstart,
+                    ]
+                    start = covered * self.block_size
+                    self._fleet_blocks += covered - len(matched_blocks)
+                    if self.registry is not None:
+                        self.registry.inc(
+                            "ctpu_fleet_prefix_blocks_total", None,
+                            value=covered - len(matched_blocks),
+                            help_=FLEET_HELP[
+                                "ctpu_fleet_prefix_blocks_total"],
+                        )
+                        self.registry.inc(
+                            "ctpu_fleet_prefix_tokens_saved_total", None,
+                            value=(covered - len(matched_blocks))
+                            * self.block_size,
+                            help_=FLEET_HELP[
+                                "ctpu_fleet_prefix_tokens_saved_total"],
+                        )
             if self.prefix is not None and shareable:
                 self.prefix.note_lookup(
                     len(matched_blocks), shareable - len(matched_blocks)
@@ -876,6 +974,7 @@ class LmEngine:
                 chunk_plan(handle.prompt_len, self.buckets, start=start),
                 None,
             )
+            self._job.remote = job_remote
             self._scaler.note_ok(False, self._max_active_locked())
 
     def _job_cancelled_locked(self, job):
@@ -899,7 +998,34 @@ class LmEngine:
                 self._abort_job_locked(job)
                 self._job = None
                 return
+            # snapshot the remote-install plan under the lock: a close()
+            # racing this step nulls job.blocks in _abort_job_locked, and
+            # the consumed job.remote marks the blocks as real content
+            # for the eventual give_back
+            remote, job.remote = job.remote, None
+            remote_blocks = (
+                list(job.blocks[remote[0]:remote[1]])
+                if remote is not None else None
+            )
         handle = job.handle
+        if remote is not None:
+            # install the fleet-fetched KV content into the reservation's
+            # fresh blocks (scheduler thread, outside _cv — the scatter
+            # orders before this job's chunk dispatches below, so the
+            # chunk's attention reads the peer-computed content).  The
+            # host arrays cover chain blocks [rstart, covered); the
+            # destination is blocks [lo, hi) of the reservation.
+            lo, hi, host_k, host_v, rstart = remote
+            idx = jnp.asarray(np.asarray(remote_blocks, np.int32))
+            for layer in range(len(host_k)):
+                self.kv.pools["k"][layer] = (
+                    self.kv.pools["k"][layer].at[idx]
+                    .set(jnp.asarray(host_k[layer][lo - rstart:hi - rstart]))
+                )
+                self.kv.pools["v"][layer] = (
+                    self.kv.pools["v"][layer].at[idx]
+                    .set(jnp.asarray(host_v[layer][lo - rstart:hi - rstart]))
+                )
         if job.key is None:  # deferred out of _admit: dispatch-free lock
             job.key = jax.random.PRNGKey(handle.seed)
         start, width = job.plan[job.chunk_idx]
@@ -932,6 +1058,7 @@ class LmEngine:
             )
         if job.chunk_idx < len(job.plan):
             return
+        export = None
         with self._cv:
             self._job = None
             if self._closed or self._job_cancelled_locked(job):
@@ -943,6 +1070,14 @@ class LmEngine:
             lane.active = True
             lane.table[:] = job.table
             lane.blocks, job.blocks = job.blocks, None
+            if resume is None and self.fleet is not None:
+                nfull = handle.prompt_len // self.block_size
+                if nfull:
+                    export = (
+                        handle.prompt[0],
+                        [int(b) for b in lane.blocks[:nfull]],
+                        nfull,
+                    )
             if resume is None:
                 lane.queue = handle.queue
                 lane.remaining = handle.max_tokens
@@ -974,6 +1109,8 @@ class LmEngine:
                 self._restore_lane_locked(lane, resume, job.slot)
             snapshot = ((job.slot, lane.gen),)
             self._lane_gauges_locked()
+        if export is not None:
+            self._export_prefix(export)
         if resume is not None:
             # install the saved next-tick input token + RNG carry; nothing
             # streams (everything up to `produced` was already delivered)
@@ -991,6 +1128,72 @@ class LmEngine:
         if hasattr(tok, "copy_to_host_async"):
             tok.copy_to_host_async()
         self._inflight.append((tok, snapshot))
+
+    def _export_prefix(self, export):
+        """Publish freshly prefilled full prompt blocks into the fleet
+        tier's host store (scheduler thread, OUTSIDE _cv: the gather is
+        a device read ordered after this job's chunk writes, and the
+        store insert is host-side only).  One device->host copy per
+        prefill — the price of making the prefix fleet-visible, paid
+        only while a tier is attached."""
+        row, blocks, nfull = export
+        idx = jnp.asarray(np.asarray(blocks, np.int32))
+        host_k = [np.asarray(p[idx]) for p in self.kv.pools["k"]]
+        host_v = [np.asarray(p[idx]) for p in self.kv.pools["v"]]
+        self.fleet.export_prefix(
+            row, nfull, self.block_size, host_k, host_v
+        )
+
+    def drain(self):
+        """Planned retire: migrate what can migrate, then close.
+
+        Active lanes' prompt prefixes were already exported to the fleet
+        tier at prefill completion, so a client replaying
+        prompt + delivered tokens on a surviving replica resumes
+        byte-exact with its prefill largely served from the tier.
+        Parked (preempted) streams are the case with otherwise-stranded
+        state: their host-swapped KV chains — prompt AND generated-token
+        blocks — are exported here, and the swap store drops with the
+        close (audited: no leaked blocks).  Returns the number of parked
+        streams exported."""
+        exports = []
+        with self._cv:
+            fleet = self.fleet if self.prefix is not None else None
+            if fleet is not None:
+                for entry in self._swapped:
+                    if entry.cancelled or entry.host_k is None:
+                        continue
+                    nfull = entry.length // self.block_size
+                    if not nfull:
+                        continue
+                    row = entry.prompt[0]
+                    if entry.produced > 1:
+                        # the written sequence is prompt + every delivered
+                        # token except the last (which is the NEXT tick's
+                        # input): exactly `length` tokens
+                        row = np.concatenate([
+                            row,
+                            np.asarray(
+                                entry.tokens[:entry.produced - 1], np.int32
+                            ),
+                        ])
+                    exports.append(
+                        (row, nfull, entry.host_k, entry.host_v)
+                    )
+        for row, nfull, host_k, host_v in exports:
+            fleet.export_prefix(
+                row, nfull, self.block_size,
+                [a[:nfull] for a in host_k],
+                [a[:nfull] for a in host_v],
+            )
+        if exports and self.registry is not None:
+            self.registry.inc(
+                "ctpu_fleet_sessions_migrated_total", None,
+                value=len(exports),
+                help_=FLEET_HELP["ctpu_fleet_sessions_migrated_total"],
+            )
+        self.close()
+        return len(exports)
 
     def _tick_for(self, n):
         fn = self._tick_jits.get(n)
@@ -1267,12 +1470,18 @@ class LmEngine:
                 )
         with self._cv:
             if self._closed or entry.cancelled:
-                # the stream died while restoring: unwind the reservation
-                # (cancel/close already closed the queue).  host_k is the
-                # plan-local reference — cancel may have nulled the entry's.
+                # the stream died while restoring: unwind the reservation.
+                # host_k is the plan-local reference — cancel may have
+                # nulled the entry's.
                 if self._closed:
                     # _release_all_locked already zeroed _swapped_blocks
-                    # (and cleared the cache), so no gauge decrement here
+                    # (and cleared the cache), so no gauge decrement here.
+                    # The entry was popped from _swapped BEFORE close ran,
+                    # so close's sweep missed its queue: close it here or
+                    # the consumer blocks on q.get() forever.
+                    if not entry.cancelled:
+                        entry.cancelled = True
+                        entry.queue.put(_CLOSE)
                     self.kv.release(blocks)
                 else:
                     self._release_blocks_locked(

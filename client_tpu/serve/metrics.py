@@ -80,6 +80,32 @@ LM_PREFIX_HELP = {
         "KV blocks currently parked in the host-side swap store",
 }
 
+# Fleet cache-tier series (written by serve/fleet.py and the fleet hooks
+# in serve/lm/engine.py + model_runtime into whichever registry the tier
+# is bound to; one help catalog so /metrics, README and tests agree).
+FLEET_HELP = {
+    "ctpu_fleet_peer_hits_total":
+        "Peer lookups answered with content (by op: cache/prefix)",
+    "ctpu_fleet_peer_misses_total":
+        "Peer lookups every reachable peer missed (by op)",
+    "ctpu_fleet_peer_errors_total":
+        "Peer RPCs that failed or timed out (circuit strikes)",
+    "ctpu_fleet_peer_skips_total":
+        "Peer lookups skipped behind an open per-peer circuit",
+    "ctpu_fleet_prefix_blocks_total":
+        "KV prefix blocks installed from a peer replica's cache tier",
+    "ctpu_fleet_prefix_tokens_saved_total":
+        "Prefill tokens skipped via peer-fetched KV prefix blocks",
+    "ctpu_fleet_cache_hits_total":
+        "Unary responses served from a peer replica's response cache",
+    "ctpu_fleet_store_blocks":
+        "KV blocks exported into this replica's host-side fleet store",
+    "ctpu_fleet_gossip_rounds_total":
+        "Fleet gossip rounds pushed (tenant counters + digest summaries)",
+    "ctpu_fleet_sessions_migrated_total":
+        "Parked LM streams exported to the fleet tier at planned retire",
+}
+
 
 def format_labels(labels):
     """{'model': 'm'} -> '{model="m"}' with every value escaped."""

@@ -31,6 +31,7 @@ from client_tpu._infer_types import _np_from_json_data
 from client_tpu.serve._completion import CompletionObserver
 from client_tpu.serve.metrics import (
     BATCH_BUCKETS,
+    FLEET_HELP,
     Histogram,
     Registry,
 )
@@ -751,6 +752,7 @@ class InferenceEngine:
         response_cache=None,
         coalescing=False,
         qos=None,
+        fleet=None,
     ):
         self._lock = threading.Lock()
         self._models = {}
@@ -792,6 +794,13 @@ class InferenceEngine:
             from client_tpu.serve.frontdoor import Coalescer
 
             self._coalescer = Coalescer(registry=self.metrics)
+        # Cross-replica cache tier (serve/fleet.py): a local response-
+        # cache miss consults peer replicas before dispatching, the LM
+        # engine's prefix cache spans the fleet (wired per-model through
+        # Model.binder), and tenant quotas account fleet-wide via gossip.
+        self.fleet = None
+        if fleet is not None:
+            fleet.attach(self)
         self.log_settings = {
             "log_file": "",
             "log_info": True,
@@ -1178,6 +1187,12 @@ class InferenceEngine:
                 stats.record_cache_hit(time.monotonic_ns() - t0)
                 return _stamp_id(response, request), blobs
         if self._coalescer is None:
+            if use_cache:
+                fleet_hit = self._fleet_cached(key, ttl_s)
+                if fleet_hit is not None:
+                    return self._serve_fleet_hit(
+                        fleet_hit, request, trace, tenant, stats, t0
+                    )
             result = self._front_dispatch(
                 model_name, model_version, request, binary_section, trace,
                 tenant,
@@ -1219,15 +1234,30 @@ class InferenceEngine:
                 response, blobs = flight.result
                 stats.record_request_success(time.monotonic_ns() - t0)
                 return _stamp_id(response, request), blobs
+            if use_cache:
+                # LEADER-only fleet lookup (followers coalesce onto it):
+                # a hot key's peer fan-out stays one lookup per flight,
+                # not one per request in the herd
+                fleet_hit = self._fleet_cached(key, ttl_s)
+                if fleet_hit is not None:
+                    self._coalescer.publish(key, flight, fleet_hit)
+                    return self._serve_fleet_hit(
+                        fleet_hit, request, trace, tenant, stats, t0
+                    )
             try:
                 result = self._front_dispatch(
                     model_name, model_version, request, binary_section,
                     trace, tenant,
                 )
             except InferenceServerException as e:
-                if e.status() == "429":
-                    # tenant-scoped QoS rejection: only THIS request's
-                    # tenant exceeded its caps — followers re-contend
+                from client_tpu.resilience import is_connection_level
+
+                if e.status() == "429" or is_connection_level(e):
+                    # tenant-scoped QoS rejection — or a leader that died
+                    # WITH its transport (replica/peer death mid-dispatch):
+                    # neither says anything about the request CONTENT, so
+                    # followers re-contend (the next leader lands on a
+                    # surviving path) instead of inheriting the error
                     self._coalescer.retry_followers(key, flight)
                     raise
                 # content-scoped errors fan out to every follower: a
@@ -1274,6 +1304,37 @@ class InferenceEngine:
         finally:
             if qos_release is not None:
                 qos_release()
+
+    def _fleet_cached(self, key, ttl_s):
+        """Peer-replica response-cache lookup for a local miss: the
+        id-less ``(response, blobs)`` rendering, filled into the local
+        cache, or None.  The peer RPC runs on the request thread with NO
+        engine lock held and is bounded by the tier's fan-out x timeout
+        (breaker-gated: a dead fleet degrades to local-only)."""
+        fleet = self.fleet
+        if fleet is None or self.response_cache is None:
+            return None
+        remote = fleet.cache_lookup(key)
+        if remote is None:
+            return None
+        response, blobs = remote
+        self.response_cache.put(key, response, blobs, ttl_s=ttl_s)
+        self.metrics.inc(
+            "ctpu_fleet_cache_hits_total",
+            help_=FLEET_HELP["ctpu_fleet_cache_hits_total"],
+        )
+        return response, blobs
+
+    def _serve_fleet_hit(self, shared, request, trace, tenant, stats, t0):
+        """Render one fleet cache hit exactly like a local hit: own
+        request id stamped, tenant request counted, no execution slot."""
+        if trace is not None:
+            trace.event("CACHE_HIT")
+        if self.qos is not None:
+            self.qos.note(tenant)
+        response, blobs = shared
+        stats.record_cache_hit(time.monotonic_ns() - t0)
+        return _stamp_id(response, request), blobs
 
     def _cache_fill(self, key, shared, ttl_s=None):
         """Store one id-less ``(response, blobs)`` rendering, under the

@@ -233,6 +233,10 @@ def lm_streaming_batched_model(name="lm_streaming_batched", runner=None,
         if engine.qos is not None:
             sched.tenant_lane_share = engine.qos.lane_share
             sched.tenant_priority = engine.qos.priority
+        if getattr(engine, "fleet", None) is not None:
+            # cross-replica prefix tier: submit-side peer lookups,
+            # prefill-completion exports, parked-stream migration
+            sched.set_fleet(engine.fleet)
 
     model.binder = bind
     return model

@@ -37,7 +37,28 @@ __all__ = [
     "SERVER_READY",
     "SERVER_NOT_READY",
     "SERVER_UNREACHABLE",
+    "TENANT_HEADER",
+    "stamp_tenant",
 ]
+
+# The wire key tenant identity rides on (HTTP header name / gRPC metadata
+# key — gRPC metadata keys are lowercase by spec).  Lives here, not in
+# serve/frontdoor, because BOTH sides speak it: the serving front door
+# reads it and the clients' ``tenant=`` constructor kwarg stamps it.
+TENANT_HEADER = "x-tenant-id"
+
+
+def stamp_tenant(headers, tenant):
+    """Merge a client's tenant identity into *headers* for one request
+    (an explicitly passed x-tenant-id, any case, wins).  Shared by all
+    four clients' ``tenant=`` constructor kwarg."""
+    if tenant is None:
+        return headers
+    if headers and any(k.lower() == TENANT_HEADER for k in headers):
+        return headers
+    merged = dict(headers or {})
+    merged[TENANT_HEADER] = tenant
+    return merged
 
 # Server health states reported by the clients' ``server_state()`` verb.
 # ``is_server_ready()`` keeps its boolean contract; these distinguish the

@@ -1,0 +1,49 @@
+"""Peer RPC under an engine/pool lock — the fleet-tier stall shape.
+
+A peer lookup is timeout-bounded, so no blocking classifier fires; but
+hundreds of milliseconds under the scheduler's condition lock stalls
+every decode tick (and under a pool lock, every route).  Three shapes:
+a direct fleet call inside the ``with``, one reached through a call
+chain (invisible to any lexical rule), and a rendezvous collective
+under a pool lock.
+"""
+
+import threading
+
+from some_fleet import FleetTier  # noqa: F401 (fixture only)
+
+
+class Scheduler:
+    def __init__(self, fleet):
+        self.fleet = fleet
+        self._cv = threading.Condition()
+        self._pending = []
+
+    def submit(self, prompt):
+        with self._cv:
+            # BAD: peer RPC directly inside the critical section — every
+            # submit/cancel/tick waiter stalls behind one slow peer
+            remote = self.fleet.prefix_lookup(prompt, 8, 4)
+            self._pending.append((prompt, remote))
+
+    def admit(self):
+        with self._cv:
+            # BAD: the peer call is one frame below the lock — same
+            # stall, invisible to any per-function rule
+            self._fetch_remote()
+
+    def _fetch_remote(self):
+        return self.fleet.cache_lookup("digest")
+
+
+class Pool:
+    def __init__(self, rendezvous):
+        self.rendezvous = rendezvous
+        self._lock = threading.Lock()
+        self._stable = False
+
+    def converge(self):
+        with self._lock:
+            # BAD: a rendezvous collective under the pool lock — every
+            # route waits on the slowest rank
+            self._stable = all(self.rendezvous.all_gather(True))

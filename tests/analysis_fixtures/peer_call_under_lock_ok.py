@@ -1,0 +1,48 @@
+"""Post-fix twin of peer_call_under_lock_bad.py: the lock covers only
+the host-side bookkeeping; every peer RPC runs with the lock released
+(the serve/lm/engine.py submit/export shape)."""
+
+import threading
+
+from some_fleet import FleetTier  # noqa: F401 (fixture only)
+
+
+class Scheduler:
+    def __init__(self, fleet):
+        self.fleet = fleet
+        self._cv = threading.Condition()
+        self._pending = []
+
+    def submit(self, prompt):
+        with self._cv:
+            closed = not self._pending and False
+        if closed:
+            return
+        # peer RPC on the caller's thread, no lock held: a slow peer
+        # delays only this submit
+        remote = self.fleet.prefix_lookup(prompt, 8, 4)
+        with self._cv:
+            self._pending.append((prompt, remote))
+
+    def admit(self):
+        with self._cv:
+            entry = self._pending.pop(0) if self._pending else None
+        if entry is None:
+            return None
+        return self._fetch_remote()
+
+    def _fetch_remote(self):
+        return self.fleet.cache_lookup("digest")
+
+
+class Pool:
+    def __init__(self, rendezvous):
+        self.rendezvous = rendezvous
+        self._lock = threading.Lock()
+        self._stable = False
+
+    def converge(self):
+        # collective OUTSIDE the lock; only the result install holds it
+        stable = all(self.rendezvous.all_gather(True))
+        with self._lock:
+            self._stable = stable

@@ -49,8 +49,9 @@ def test_registry_has_all_rules():
     }
     assert set(PROGRAM_REGISTRY) >= {
         "LOCK-INV", "BLOCK-UNDER-LOCK", "CALLBACK-UNDER-LOCK",
+        "PEER-CALL-UNDER-LOCK",
     }
-    assert len(all_rules()) >= 14
+    assert len(all_rules()) >= 15
     for rule in all_rules().values():
         assert rule.rationale  # every rule documents its motivating bug
 
@@ -427,6 +428,29 @@ def test_block_under_lock_clean():
     """The post-fix shape (pop under the lock, dispatch outside; cv.wait
     under its own lock) scans clean through every rule family."""
     assert _pscan("block_under_lock_ok.py") == []
+
+
+def test_peer_call_under_lock_hits_fleet_shapes():
+    """The fleet-tier stall: a peer RPC (timeout-bounded, so no blocking
+    classifier fires) reached under an engine/pool lock — direct, one
+    call below the ``with``, and a rendezvous collective under a pool
+    lock.  The blocking rules must stay silent (that is the gap this
+    rule closes)."""
+    findings = _pscan("peer_call_under_lock_bad.py")
+    assert _rules_hit(findings) == ["PEER-CALL-UNDER-LOCK"]
+    assert len(findings) == 3
+    messages = " ".join(f.message for f in findings)
+    assert "prefix_lookup" in messages       # direct, under _cv
+    assert "_fetch_remote" in messages       # through the call chain
+    assert "cache_lookup" in messages
+    assert "all_gather" in messages          # rendezvous collective
+
+
+def test_peer_call_under_lock_clean():
+    """The post-fix shape (snapshot under the lock, peer call outside —
+    the serve/lm/engine.py submit/export structure) scans clean through
+    every rule family."""
+    assert _pscan("peer_call_under_lock_ok.py") == []
 
 
 def test_lock_inv_hits_abba():

@@ -28,6 +28,7 @@ from client_tpu.balance import (
     ReplicatedClient,
     AsyncReplicatedClient,
     SequenceRestartError,
+    SrvResolver,
     StaticResolver,
     Sticky,
     make_policy,
@@ -179,6 +180,121 @@ class TestResolvers:
         )
         r = StaticResolver(["a"])
         assert make_resolver(r) is r
+
+
+class TestSrvResolver:
+    """DNS SRV-style resolution honoring record TTLs (the PR 5
+    carry-over): cached until the smallest TTL expires, re-resolved
+    after, last-known-good on lookup failure."""
+
+    def _clock(self):
+        state = {"now": 100.0}
+
+        def advance(dt):
+            state["now"] += dt
+
+        return (lambda: state["now"]), advance
+
+    def test_ttl_caches_until_expiry_then_re_resolves(self):
+        time_fn, advance = self._clock()
+        calls = []
+
+        def lookup():
+            calls.append(1)
+            return [("h1:8001", 1.0, 5.0), ("h2:8001", 2.0, 9.0)]
+
+        r = SrvResolver(lookup, time_fn=time_fn)
+        assert r.resolve() == [("h1:8001", 1.0), ("h2:8001", 2.0)]
+        # inside the smallest record TTL (5s): served from cache
+        advance(4.9)
+        assert r.resolve() == [("h1:8001", 1.0), ("h2:8001", 2.0)]
+        assert len(calls) == 1
+        # past it: re-resolved
+        advance(0.2)
+        r.resolve()
+        assert len(calls) == 2
+        assert r.resolutions == 2
+
+    def test_records_without_ttl_use_default(self):
+        time_fn, advance = self._clock()
+        calls = []
+
+        def lookup():
+            calls.append(1)
+            return ["h1:8001", ("h2:8001", 2.0)]
+
+        r = SrvResolver(lookup, default_ttl_s=30.0, time_fn=time_fn)
+        assert r.resolve() == ["h1:8001", ("h2:8001", 2.0)]
+        advance(29.0)
+        r.resolve()
+        assert len(calls) == 1
+        advance(2.0)
+        r.resolve()
+        assert len(calls) == 2
+
+    def test_zero_ttl_floored_not_a_hot_loop(self):
+        time_fn, _advance = self._clock()
+        calls = []
+
+        def lookup():
+            calls.append(1)
+            return [("h1:8001", 1.0, 0.0)]  # misconfigured zone
+
+        r = SrvResolver(lookup, min_ttl_s=1.0, time_fn=time_fn)
+        r.resolve()
+        r.resolve()  # same instant: still cached (TTL floored to 1s)
+        assert len(calls) == 1
+
+    def test_lookup_failure_serves_last_known_good(self):
+        time_fn, advance = self._clock()
+        answers = [["h1:8001"], RuntimeError("registry down"), ["h2:8001"]]
+
+        def lookup():
+            answer = answers.pop(0)
+            if isinstance(answer, Exception):
+                raise answer
+            return answer
+
+        r = SrvResolver(lookup, default_ttl_s=5.0, min_ttl_s=1.0,
+                        time_fn=time_fn)
+        assert r.resolve() == ["h1:8001"]
+        advance(6.0)  # TTL expired, lookup now fails
+        assert r.resolve() == ["h1:8001"]  # stale-on-error
+        assert r.errors == 1 and "registry down" in str(r.last_error)
+        # the outage re-probes after the floor, not the full TTL
+        advance(1.1)
+        assert r.resolve() == ["h2:8001"]
+
+    def test_initial_failure_raises(self):
+        def lookup():
+            raise RuntimeError("cold start, registry down")
+
+        r = SrvResolver(lookup)
+        with pytest.raises(RuntimeError):
+            r.resolve()
+        # DiscoveryLoop contains it like any resolver error
+        pool = EndpointPool(["seed:8001"])
+        loop = DiscoveryLoop(pool, r, interval_s=3600)
+        assert loop.refresh_now() is None
+        assert pool.urls() == ["seed:8001"]  # last-known-good membership
+
+    def test_feeds_discovery_loop_on_ttl_churn(self):
+        time_fn, advance = self._clock()
+        membership = [["a:8001", "b:8001"], ["b:8001", "c:8001"]]
+
+        def lookup():
+            return [(u, 1.0, 2.0) for u in membership[0]]
+
+        r = SrvResolver(lookup, time_fn=time_fn)
+        pool = EndpointPool(["a:8001"])
+        loop = DiscoveryLoop(pool, r, interval_s=3600)
+        assert loop.refresh_now() is not None
+        assert sorted(pool.urls()) == ["a:8001", "b:8001"]
+        membership.pop(0)
+        advance(3.0)  # TTL expiry picks up the new records
+        summary = loop.refresh_now()
+        assert summary["added"] == ["c:8001"]
+        assert "a:8001" in summary["retired"]
 
 
 class TestDiscoveryLoop:

@@ -270,6 +270,80 @@ def test_nontuple_leader_result_never_strands_followers():
         engine.close()
 
 
+def test_follower_recontends_when_leader_dies_with_replica():
+    """Replica-failover regression: a coalesced follower whose leader
+    dies WITH its transport (connection-level error — the replica/peer
+    the leader was talking to is gone) must re-contend on a surviving
+    path, not surface the leader's connection error.  A connection
+    error, like a 429, says nothing about the request CONTENT — only a
+    content-scoped failure may fan out to the herd."""
+    def fn(inputs, params, ctx):
+        return {"OUT": inputs["IN"] * 2.0}
+
+    model = Model(
+        "echo",
+        inputs=[TensorSpec("IN", "FP32", [-1, 4])],
+        outputs=[TensorSpec("OUT", "FP32", [-1, 4])],
+        fn=fn,
+    )
+    engine = InferenceEngine(models=[model], coalescing=True)
+    follower_joined = threading.Event()
+    real_dispatch = engine._front_dispatch
+    calls = [0]
+
+    def dying_dispatch(*args, **kwargs):
+        calls[0] += 1
+        if calls[0] == 1:
+            # leader's replica dies mid-dispatch, AFTER the follower is
+            # coalesced behind it
+            assert follower_joined.wait(timeout=30)
+            raise InferenceServerException(
+                "connection reset by replica",
+                debug_details=ConnectionResetError("peer died"),
+            )
+        return real_dispatch(*args, **kwargs)
+
+    engine._front_dispatch = dying_dispatch
+    try:
+        req, raw = _req(11.0)
+        leader_err, follower_result, follower_err = [], [], []
+
+        def leader():
+            try:
+                engine.execute("echo", "", dict(req), raw)
+            except InferenceServerException as e:
+                leader_err.append(e)
+
+        def follower():
+            deadline = time.monotonic() + 30
+            while not engine._coalescer._flights:
+                assert time.monotonic() < deadline
+                time.sleep(0.001)
+            threading.Timer(0.05, follower_joined.set).start()
+            try:
+                follower_result.append(
+                    engine.execute("echo", "", dict(req), raw)
+                )
+            except InferenceServerException as e:
+                follower_err.append(e)
+
+        t1 = threading.Thread(target=leader)
+        t2 = threading.Thread(target=follower)
+        t1.start()
+        t2.start()
+        t1.join(timeout=30)
+        t2.join(timeout=30)
+        assert not t2.is_alive(), "follower stranded on the flight"
+        # the leader surfaces ITS error; the follower re-contended as the
+        # next leader and executed successfully (two dispatches total)
+        assert len(leader_err) == 1
+        assert "connection reset" in str(leader_err[0])
+        assert not follower_err, follower_err
+        assert len(follower_result) == 1 and calls[0] == 2
+    finally:
+        engine.close()
+
+
 def test_leader_qos_shed_does_not_poison_other_tenants():
     """A coalesce leader rejected by ITS OWN tenant's quota (429) must not
     fan that tenant-scoped error out to a compliant tenant's identical

@@ -283,3 +283,60 @@ class TestManagement:
     def test_cuda_shm_rejected(self, client):
         with pytest.raises(InferenceServerException, match="CUDA"):
             client.register_cuda_shared_memory("r", b"\x00" * 8, 0, 64)
+
+
+class TestTenantPropagation:
+    """The tenant= constructor kwarg stamps x-tenant-id metadata on every
+    verb — unary, async futures, and streams."""
+
+    def test_tenant_kwarg_stamps_unary_and_stream(self):
+        from client_tpu.serve.frontdoor import TenantQoS
+
+        qos = TenantQoS()
+        with Server(grpc_port=0, qos=qos) as server:
+            with grpcclient.InferenceServerClient(
+                server.grpc_address, tenant="acme"
+            ) as client:
+                assert client.is_server_ready()
+                inputs, i0, i1 = _simple_inputs()
+                result = client.infer("simple", inputs)
+                np.testing.assert_array_equal(
+                    result.as_numpy("OUTPUT0"), i0 + i1
+                )
+            snapshot = qos.snapshot()
+            assert "acme" in snapshot
+            assert snapshot["acme"]["requests"] >= 1
+
+    def test_explicit_header_wins_over_tenant_kwarg(self):
+        from client_tpu.serve.frontdoor import TenantQoS
+
+        qos = TenantQoS()
+        with Server(grpc_port=0, qos=qos) as server:
+            with grpcclient.InferenceServerClient(
+                server.grpc_address, tenant="acme"
+            ) as client:
+                inputs, _, _ = _simple_inputs()
+                client.infer(
+                    "simple", inputs, headers={"x-tenant-id": "override"}
+                )
+            snapshot = qos.snapshot()
+            assert "override" in snapshot and "acme" not in snapshot
+
+    def test_aio_tenant_kwarg(self):
+        import asyncio
+
+        import client_tpu.grpc.aio as aiogrpc
+        from client_tpu.serve.frontdoor import TenantQoS
+
+        qos = TenantQoS()
+        with Server(grpc_port=0, qos=qos) as server:
+
+            async def run():
+                async with aiogrpc.InferenceServerClient(
+                    server.grpc_address, tenant="aio-acme"
+                ) as client:
+                    inputs, _, _ = _simple_inputs()
+                    await client.infer("simple", inputs)
+
+            asyncio.run(run())
+            assert "aio-acme" in qos.snapshot()

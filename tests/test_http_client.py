@@ -350,3 +350,60 @@ class TestExample:
         )
         assert proc.returncode == 0, proc.stderr
         assert "PASS" in proc.stdout
+
+
+class TestTenantPropagation:
+    """The tenant= constructor kwarg stamps x-tenant-id on every verb so
+    callers stop hand-threading headers= through each call."""
+
+    def test_tenant_kwarg_stamps_every_verb(self):
+        from client_tpu.serve.frontdoor import TenantQoS
+
+        qos = TenantQoS()
+        with Server(qos=qos) as server:
+            with httpclient.InferenceServerClient(
+                server.http_address, tenant="acme"
+            ) as client:
+                assert client.is_server_ready()  # probe verbs stamped too
+                inputs, i0, i1 = _simple_inputs()
+                result = client.infer("simple", inputs)
+                np.testing.assert_array_equal(
+                    result.as_numpy("OUTPUT0"), i0 + i1
+                )
+            snapshot = qos.snapshot()
+            assert "acme" in snapshot
+            assert snapshot["acme"]["requests"] >= 1
+
+    def test_explicit_header_wins_over_tenant_kwarg(self):
+        from client_tpu.serve.frontdoor import TenantQoS
+
+        qos = TenantQoS()
+        with Server(qos=qos) as server:
+            with httpclient.InferenceServerClient(
+                server.http_address, tenant="acme"
+            ) as client:
+                inputs, _, _ = _simple_inputs()
+                client.infer(
+                    "simple", inputs, headers={"X-Tenant-Id": "override"}
+                )
+            snapshot = qos.snapshot()
+            assert "override" in snapshot and "acme" not in snapshot
+
+    def test_aio_tenant_kwarg(self):
+        import asyncio
+
+        import client_tpu.http.aio as aioclient
+        from client_tpu.serve.frontdoor import TenantQoS
+
+        qos = TenantQoS()
+        with Server(qos=qos) as server:
+
+            async def run():
+                async with aioclient.InferenceServerClient(
+                    server.http_address, tenant="aio-acme"
+                ) as client:
+                    inputs, _, _ = _simple_inputs()
+                    await client.infer("simple", inputs)
+
+            asyncio.run(run())
+            assert "aio-acme" in qos.snapshot()

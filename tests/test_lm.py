@@ -761,6 +761,102 @@ def test_cancel_while_swapped_closes_cleanly(params):
     assert eng.kv.used_blocks == 0, eng.kv.ref_counts()
 
 
+# -- planned retire with parked streams (fleet migration) ------------------
+
+def _park_low_stream(params, fleet=None):
+    """Engine with a preempted-and-parked low-priority stream (the PR 10
+    swap path).  The caller drains IMMEDIATELY — the 'hi' stream still
+    holds the pool, so the parked stream cannot resume first — and reads
+    the low stream's delivered-token prefix off its (closed) queue."""
+    eng = LmEngine(params, CFG, max_slots=2, lane_counts=(2,),
+                   block_size=8, pool_tokens=80, prefill_chunk=16,
+                   min_bucket=4, tenant_priority={"hi": 10.0},
+                   registry=Registry(), fleet=fleet)
+    prompt = [1, 2, 3]
+    q_lo, h_lo = eng.submit(prompt, 60, tenant="lo")
+    first = q_lo.get(timeout=120)
+    assert first is not CLOSE
+    q_hi, _ = eng.submit([9, 4], 40, tenant="hi")
+    deadline = time.monotonic() + 60
+    while (eng.preempt_stats()["swapped_streams"] == 0
+           and time.monotonic() < deadline):
+        time.sleep(0.005)
+    assert eng.preempt_stats()["swapped_streams"] == 1
+    return eng, prompt, first, q_lo, q_hi
+
+
+def test_retire_with_parked_stream_never_leaks_swap_blocks(params):
+    """A preempted (swapped-out) LM stream on a retiring engine: drain()
+    closes its paused queue cleanly (no error, no strand) and the swap
+    store + KV pool end fully free — a parked stream must never leak its
+    swap blocks through a planned retire."""
+    eng, prompt, first, q_lo, q_hi = _park_low_stream(params)
+    migrated = eng.drain()  # no fleet tier: nothing to migrate INTO
+    assert migrated == 0
+    # both queues end with CLOSE, never an error sentinel
+    delivered = [first]
+    while True:
+        tok = q_lo.get(timeout=60)
+        if tok is CLOSE:
+            break
+        delivered.append(tok)
+    while q_hi.get(timeout=60) is not CLOSE:
+        pass
+    ps = eng.preempt_stats()
+    assert ps["swapped_streams"] == 0 and ps["swapped_blocks"] == 0
+    assert eng.kv.used_blocks == 0, eng.kv.ref_counts()
+    # delivered tokens are a clean prefix of the serial stream (no
+    # duplicated or reordered positions across the preemption)
+    assert delivered == _serial(params, prompt, 60)[:len(delivered)]
+
+
+def test_parked_stream_migrates_through_fleet_tier(params):
+    """The fleet half of the retire contract: drain() exports the parked
+    stream's host-swapped KV chain (prompt AND generated blocks) into
+    the shared tier, and a surviving replica resumes it byte-exact with
+    the replayed prefill served from peer-fetched blocks."""
+    from client_tpu.serve.fleet import FleetTier
+
+    tier_a = FleetTier(gossip_interval_s=0).start()
+    tier_b = FleetTier(gossip_interval_s=0).start()
+    eng_b = None
+    try:
+        tier_a.set_peers([tier_b.address])
+        tier_b.set_peers([tier_a.address])
+        eng, prompt, first, q_lo, _q_hi = _park_low_stream(
+            params, fleet=tier_a
+        )
+        migrated = eng.drain()
+        assert migrated == 1
+        delivered = [first]
+        while True:
+            tok = q_lo.get(timeout=60)
+            if tok is CLOSE:
+                break
+            delivered.append(tok)
+        assert eng.kv.used_blocks == 0, eng.kv.ref_counts()
+        assert eng.preempt_stats()["swapped_blocks"] == 0
+        # the surviving replica resumes: prompt + delivered tokens as the
+        # new prompt, remaining budget as max_tokens — byte-exact vs the
+        # uninterrupted serial stream, prefill fed from the shared tier
+        eng_b = LmEngine(params, CFG, max_slots=2, lane_counts=(2,),
+                         block_size=8, prefill_chunk=16, min_bucket=4,
+                         registry=Registry(), fleet=tier_b)
+        resume_prompt = prompt + delivered
+        q_r, _ = eng_b.submit(resume_prompt, 60 - len(delivered))
+        rest = _collect(q_r)
+        assert delivered + rest == _serial(params, prompt, 60)
+        fs = eng_b.fleet_stats()
+        assert fs["remote_lookups"] >= 1
+        assert fs["remote_blocks"] >= 1  # prefill fed from the peer store
+    finally:
+        if eng_b is not None:
+            eng_b.close()
+        tier_a.close()
+        tier_b.close()
+    assert eng_b.kv.used_blocks == 0, eng_b.kv.ref_counts()
+
+
 # -- engine metrics / spans ------------------------------------------------
 
 def test_engine_metrics_and_tick_spans(params):
