@@ -14,6 +14,10 @@
 #   make test        ASAN native tests + the python suite.
 #   make check       the PR gate, reproduced locally: make lint + the
 #                    tier-1 pytest command (ROADMAP.md "Tier-1 verify").
+#   make chaos       the fast chaos-matrix subset (tests/test_chaos.py:
+#                    deterministic fault schedules + invariant checkers)
+#                    under the dynamic lock-order witness — the quick
+#                    failure-domain gate.
 #   make soak        slow-tier chaos repetition, run under the DYNAMIC
 #                    lock-order witness (TPULINT_LOCK_WITNESS=1): every
 #                    lock built under client_tpu/ records the real
@@ -27,7 +31,7 @@ NATIVE_OUT := client_tpu/utils/shared_memory
 TPUSHM_OUT := client_tpu/utils/tpu_shared_memory
 
 .PHONY: all protos native cpp clean test asan java java-bindings lint \
-        lint-strict check soak
+        lint-strict check soak chaos
 
 lint:
 	python -m client_tpu.analysis client_tpu tests
@@ -42,12 +46,22 @@ check: lint
 	    --continue-on-collection-errors -p no:cacheprovider \
 	    -p no:xdist -p no:randomly
 
+# Fast chaos-matrix gate: the deterministic fault schedules + invariant
+# checkers (SIGKILL-with-active-sequences, anti-entropy convergence,
+# harness units) under the dynamic lock-order witness.
+chaos:
+	JAX_PLATFORMS=cpu TPULINT_LOCK_WITNESS=1 \
+	    python -m pytest tests/test_chaos.py -q -m 'not slow' \
+	    -p no:cacheprovider -p no:xdist -p no:randomly
+
 # Churn + isolation soak: the slow tier tier-1 excludes — repeats the
 # replica-churn chaos acceptance (discovery add/retire, stream-pinned
 # kill, resolver flap), the multi-tenant noisy-neighbor/hot-key
 # scenario, the continuous-batching LM 128-stream submit/cancel churn,
-# and the three-replica fleet kill-mid-stream chaos SOAK_N times; churn
-# and isolation bugs are timing bugs, repetition finds them.
+# the three-replica fleet kill-mid-stream chaos, and the scaled
+# chaos-matrix scenarios (randomized-timing SIGKILL with durable
+# sequences, anti-entropy convergence) SOAK_N times; churn and
+# isolation bugs are timing bugs, repetition finds them.
 SOAK_N ?= 3
 soak:
 	@for i in $$(seq 1 $(SOAK_N)); do \
@@ -55,7 +69,8 @@ soak:
 	  JAX_PLATFORMS=cpu TPULINT_LOCK_WITNESS=1 \
 	      python -m pytest tests/test_discovery.py \
 	      tests/test_balance.py tests/test_frontdoor.py \
-	      tests/test_lm.py tests/test_fleet.py -q -m slow \
+	      tests/test_lm.py tests/test_fleet.py tests/test_chaos.py \
+	      -q -m slow \
 	      -p no:cacheprovider -p no:xdist -p no:randomly || exit 1; \
 	done
 

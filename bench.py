@@ -848,6 +848,93 @@ def _run_fleet_prefix(prompts=12, prompt_len=64, share=0.75, max_tokens=2):
     }
 
 
+def _run_fleet_seq_failover(n_sequences=8, warm_steps=4):
+    """Fault-domain headline: kill-to-first-resumed-step latency.
+
+    Two in-process replicas with fleet tiers; durable sequences run
+    ``warm_steps`` applied steps on replica A (each step's snapshot
+    replicates to B before the response), then A dies unplanned (tier
+    closed, engine dropped — no drain).  ``fleet_seq_failover_ms`` is
+    the per-sequence latency of the FIRST step served by survivor B —
+    snapshot recovery + idempotent-counter resume included — versus the
+    steady-state step latency as the baseline."""
+    from client_tpu.serve import InferenceEngine
+    from client_tpu.serve.builtins import sequence_model
+    from client_tpu.serve.fleet import FleetTier
+
+    def seq_request(value, sid, step, start=False):
+        return {
+            "inputs": [{
+                "name": "INPUT", "shape": [1], "datatype": "INT32",
+                "data": [int(value)],
+            }],
+            "parameters": {
+                "sequence_id": sid,
+                "sequence_start": bool(start),
+                "sequence_durable": True,
+                "sequence_step": int(step),
+            },
+        }
+
+    tier_a = FleetTier(gossip_interval_s=0).start()
+    tier_b = FleetTier(gossip_interval_s=0).start()
+    for tier, other in ((tier_a, tier_b), (tier_b, tier_a)):
+        tier.set_peers([other.address])
+    eng_a = InferenceEngine(models=[sequence_model()], fleet=tier_a)
+    eng_b = InferenceEngine(models=[sequence_model()], fleet=tier_b)
+    steady_ms = []
+    failover_ms = []
+    try:
+        for sid in range(1, n_sequences + 1):
+            for step in range(1, warm_steps + 1):
+                t0 = time.perf_counter()
+                eng_a.execute(
+                    "simple_sequence", "",
+                    seq_request(step, sid, step, start=(step == 1)), b"",
+                )
+                steady_ms.append((time.perf_counter() - t0) * 1e3)
+        # unplanned death: no drain, no export beyond the per-step
+        # pushes.  t_kill stamps the moment the replica is GONE (the
+        # in-process close()s simulate the kill; their thread-join cost
+        # is harness overhead a real SIGKILL does not pay)
+        tier_a.close()
+        eng_a.close()
+        t_kill = time.perf_counter()
+        t_first = None
+        for sid in range(1, n_sequences + 1):
+            t0 = time.perf_counter()
+            response, _ = eng_b.execute(
+                "simple_sequence", "",
+                seq_request(99, sid, warm_steps + 1), b"",
+            )
+            failover_ms.append((time.perf_counter() - t0) * 1e3)
+            if t_first is None:
+                t_first = time.perf_counter()
+            want = sum(range(1, warm_steps + 1)) + 99
+            got = int(response["outputs"][0]["data"][0])
+            assert got == want, (sid, got, want)  # resumed byte-exact
+        kill_to_first_ms = (t_first - t_kill) * 1e3
+    finally:
+        eng_b.close()
+        tier_b.close()
+        try:
+            eng_a.close()
+            tier_a.close()
+        except Exception:
+            pass
+    steady_ms.sort()
+    return {
+        # headline: kill-to-first-resumed-step (snapshot recovery incl.)
+        "fleet_seq_failover_ms": round(kill_to_first_ms, 3),
+        "fleet_seq_resume_step_ms": round(failover_ms[0], 3),
+        "fleet_seq_resume_mean_ms": round(
+            sum(failover_ms) / len(failover_ms), 3
+        ),
+        "fleet_seq_step_ms": round(steady_ms[len(steady_ms) // 2], 3),
+        "fleet_seq_sequences": n_sequences,
+    }
+
+
 def _lm_prompt(i):
     # zero-padded so EVERY prompt (and the warmup) encodes to the same
     # token shape — the LM forward is shape-keyed jit
@@ -1064,6 +1151,9 @@ def main():
     lm_inproc = attempt("lm_inproc", _run_lm_inproc) or {}
     lm_prefix = attempt("lm_prefix", _run_lm_prefix) or {}
     fleet_prefix = attempt("fleet_prefix", _run_fleet_prefix) or {}
+    fleet_failover = attempt(
+        "fleet_seq_failover", _run_fleet_seq_failover
+    ) or {}
 
     # Headline instrument: the native C++ worker when built (GIL-free async
     # contexts — measures the SERVER, not the client); the python-harness
@@ -1292,6 +1382,7 @@ def main():
         **lm_inproc,
         **lm_prefix,
         **fleet_prefix,
+        **fleet_failover,
         **link,
     }
     if lm:

@@ -1145,6 +1145,142 @@ _REFCOUNT_NAME_RE = re.compile(
 
 
 @register
+class BgThreadCrashRule(Rule):
+    """BG-THREAD-CRASH — a ``threading.Thread`` loop target with no
+    top-level exception guard dies silently and takes its subsystem
+    with it.
+
+    Background service threads (probers, gossip loops, accept loops,
+    schedulers) are registered once and expected to run forever.  Python
+    prints an unhandled thread exception to stderr and simply ends the
+    thread — health probing freezes, membership stops updating, the peer
+    server goes deaf — with zero errors surfaced to anyone.  This is the
+    bug class the endpoint-pool prober fix patched by hand (a malformed
+    probe tuple unpacked in the loop body killed all probing forever);
+    this rule makes the *shape* illegal instead of the one instance.
+
+    Heuristic: resolve each ``threading.Thread(target=X)`` registration
+    to a same-file function (``self.method`` within the class, bare
+    names to the class's or module's functions).  Every ``while`` loop
+    in the target must either sit inside a ``try`` or have a fully
+    guarded body — every top-level statement a ``try``, a trivial
+    control statement (``pass``/``break``/``continue``/``return``), or
+    an ``if`` composed of those (the ``if stop.wait(t): return`` sleep
+    shape).  Bounded ``for`` loops and loop-less targets are exempt: the
+    rule is about loops meant to run forever.
+    """
+
+    id = "BG-THREAD-CRASH"
+    rationale = (
+        "an unguarded exception in a background thread's service loop "
+        "kills the thread silently — probing/gossip/accept stops forever "
+        "with no surfaced error (the endpoint-pool prober-arity incident)"
+    )
+
+    @staticmethod
+    def _thread_target(call):
+        """('self'|'bare', name) for a threading.Thread(target=...)
+        registration, else None."""
+        if _last_segment(_expr_text(call.func) or "") != "Thread":
+            return None
+        for kw in call.keywords:
+            if kw.arg != "target":
+                continue
+            value = kw.value
+            if (
+                isinstance(value, ast.Attribute)
+                and isinstance(value.value, ast.Name)
+                and value.value.id == "self"
+            ):
+                return ("self", value.attr)
+            if isinstance(value, ast.Name):
+                return ("bare", value.id)
+        return None
+
+    @classmethod
+    def _safe_stmt(cls, stmt):
+        if isinstance(stmt, (ast.Try, ast.Pass, ast.Break, ast.Continue,
+                             ast.Return)):
+            return True
+        if isinstance(stmt, ast.If):
+            return all(
+                cls._safe_stmt(s) for s in stmt.body + stmt.orelse
+            )
+        return False
+
+    @classmethod
+    def _unguarded_loops(cls, fn):
+        """``while`` loops in *fn* that are neither under a ``try`` nor
+        fully-guarded-bodied (nested defs not crossed)."""
+        out = []
+
+        def scan(node, guarded):
+            if isinstance(node, ast.Try):
+                for child in node.body:
+                    scan(child, True)
+                for handler in node.handlers:
+                    for child in handler.body:
+                        scan(child, guarded)
+                for child in node.orelse + node.finalbody:
+                    scan(child, guarded)
+                return
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                return
+            if isinstance(node, ast.While) and not guarded:
+                if not all(cls._safe_stmt(s) for s in node.body):
+                    out.append(node)
+            for child in ast.iter_child_nodes(node):
+                scan(child, guarded)
+
+        for stmt in fn.body:
+            scan(stmt, False)
+        return out
+
+    def check(self, tree, lines, path):
+        findings = []
+        module_fns = {
+            n.name: n for n in tree.body if isinstance(n, ast.FunctionDef)
+        }
+        seen = set()
+
+        def visit(node, methods):
+            if isinstance(node, ast.ClassDef):
+                methods = {
+                    f.name: f for f in node.body
+                    if isinstance(f, ast.FunctionDef)
+                }
+            if isinstance(node, ast.Call):
+                target = self._thread_target(node)
+                if target is not None:
+                    kind, name = target
+                    fn = methods.get(name)
+                    if fn is None and kind == "bare":
+                        fn = module_fns.get(name)
+                    if fn is not None:
+                        for loop in self._unguarded_loops(fn):
+                            key = (fn.name, loop.lineno)
+                            if key in seen:
+                                continue
+                            seen.add(key)
+                            findings.append(self.finding(
+                                path, lines, loop,
+                                f"{fn.name}() runs as a thread target "
+                                f"(registered at line {node.lineno}) but "
+                                "this while loop has no top-level "
+                                "exception guard — one escaped exception "
+                                "kills the thread silently and its "
+                                "subsystem with it; wrap the loop (or "
+                                "its whole body) in try/except",
+                            ))
+            for child in ast.iter_child_nodes(node):
+                visit(child, methods)
+
+        visit(tree, {})
+        return findings
+
+
+@register
 class RefcountPairRule(Rule):
     """REFCOUNT-PAIR — a class increments a refcount attribute with no
     decrement anywhere in the class.

@@ -267,8 +267,14 @@ class DiscoveryLoop:
         return self
 
     def _run(self, stop):
+        # refresh_now() contains its own errors, but the loop body still
+        # sits under a guard (the BG-THREAD-CRASH shape): a poller thread
+        # that dies silently freezes fleet membership forever
         while not stop.is_set():
-            self.refresh_now()
+            try:
+                self.refresh_now()
+            except Exception:  # pragma: no cover - defensive
+                pass
             if stop.wait(self.interval_s):
                 return
 

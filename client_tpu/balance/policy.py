@@ -174,9 +174,13 @@ class Sticky(Policy):
     still a candidate.  When it is not — dead, drained, retired, or
     excluded after a failed attempt — the policy remaps the sequence to a
     fresh replica and raises :class:`SequenceRestartError` (see its
-    docstring for the restart contract).  ``sequence_end`` drops the
-    mapping; an LRU bound (*max_sequences*) keeps abandoned sequences
-    from pinning the map forever.
+    docstring for the restart contract) — UNLESS the request context
+    carries ``sequence_durable``: durable sequences replicate their
+    server-side state through the fleet tier's sequence lane, the
+    survivor rebuilds the context from a peer snapshot on first touch,
+    and the remap is silent.  ``sequence_end`` drops the mapping; an LRU
+    bound (*max_sequences*) keeps abandoned sequences from pinning the
+    map forever.
     """
 
     name = "sticky"
@@ -219,6 +223,13 @@ class Sticky(Policy):
         else:
             self._remember(seq_id, replacement.url)
         if url is not None and not ctx.get("sequence_start"):
+            if ctx.get("sequence_durable"):
+                # durable sequences replicate their state through the
+                # fleet tier (SequenceContext snapshots, see serve/fleet
+                # "sequence lane"): the survivor rebuilds the sequence
+                # from a peer snapshot on first touch, so the remap is
+                # SILENT — the client never sees the replica die
+                return replacement
             # the pinned replica is gone mid-sequence: the remap is
             # installed, but the caller must rebuild the state there
             raise SequenceRestartError(seq_id, url, replacement.url)

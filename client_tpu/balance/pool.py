@@ -100,6 +100,11 @@ class Endpoint:
         # holds), fed by probes via set_summary; the prefix-aware policy
         # reads it.  Empty = no affinity signal, policies fall back.
         self.summary = frozenset()
+        # Gossiped autoscaling pressure ({"queue_depth", "prefix_hot",
+        # ...}), fed by probes via set_pressure; surfaced through
+        # pressures() and the observer's on_endpoint_pressure hook so a
+        # discovery source can scale on it.  Empty = never gossiped.
+        self.pressure = {}
         # Probation ramp-up (slow start): stamped at promote time when the
         # pool has a rampup window; ramp_fraction() climbs floor -> 1 over
         # [ramp_started, ramp_started + ramp_span].
@@ -404,6 +409,34 @@ class EndpointPool:
         with self._lock:
             return {e.url: e.summary for e in self._endpoints}
 
+    def set_pressure(self, url, pressure):
+        """Install *url*'s gossiped autoscaling pressure (a mapping of
+        numeric signals — ``FleetTier.local_summary()['pressure']``).
+        Probes piggyback this as the third element of a ``(state,
+        digests, pressure)`` result; the observer's
+        ``on_endpoint_pressure`` hook exports it as the
+        ``ctpu_fleet_pressure_*`` per-endpoint gauges."""
+        pressure = dict(pressure or {})
+        matched = False
+        with self._lock:
+            for endpoint in self._endpoints:
+                if endpoint.url == url:
+                    endpoint.pressure = pressure
+                    matched = True
+        if matched:
+            # unknown urls (an in-flight probe completing after eviction)
+            # must NOT notify: the observer would resurrect the evicted
+            # endpoint's pressure gauges and nothing would ever remove
+            # them again
+            _notify(self.observer, "on_endpoint_pressure", url, pressure)
+
+    def pressures(self):
+        """{url: pressure dict} autoscaling-signal view — what a
+        discovery source polls to scale the fleet on queue depth and
+        prefix-affinity pressure."""
+        with self._lock:
+            return {e.url: dict(e.pressure) for e in self._endpoints}
+
     # -- live membership (the discovery entry point) -------------------------
 
     def update_endpoints(self, specs):
@@ -541,7 +574,9 @@ class EndpointPool:
         ``probe(url)`` must return one of the three state constants (the
         clients' ``server_state()`` verb is exactly this shape) — or a
         ``(state, digests)`` tuple to piggyback the replica's cache-tier
-        summary for prefix-aware routing — and should bound its own
+        summary for prefix-aware routing, or ``(state, digests,
+        pressure)`` to additionally carry its autoscaling pressure
+        signals — and should bound its own
         transport timeout — a probe that can block forever wedges the
         whole pool's (serial) prober.  Exceptions count as
         UNREACHABLE.  Each endpoint is probed on its own full-jittered
@@ -583,60 +618,80 @@ class EndpointPool:
             next_due[url] = now + rng.uniform(interval_s / 2.0, interval_s)
 
     def _probe_loop(self, probe, stop, interval_s, rng):
+        # Whole-pass guard (the BG-THREAD-CRASH shape, generalizing the
+        # probe-arity fix): ANY escaped exception — a broken observer, a
+        # hostile summary payload — would otherwise kill this thread and
+        # freeze all health probing forever, silently.
         next_due = {}
         while not stop.is_set():
-            with self._lock:
-                members = [
-                    e.url for e in self._endpoints
-                    if e.phase != PHASE_RETIRING
-                ]
-            now = time.monotonic()
-            for url in members:
-                if stop.is_set():
+            try:
+                if self._probe_pass(probe, stop, interval_s, rng, next_due):
                     return
-                due = next_due.get(url)
-                if due is None:
-                    self._probe_schedule(
-                        url, next_due, now, interval_s, rng, True
-                    )
-                    continue
-                if due > now:
-                    continue
-                try:
-                    state = probe(url)
-                except Exception:
-                    state = SERVER_UNREACHABLE
-                # probes may piggyback the replica's cache-summary gossip:
-                # (state, digests) updates health AND routing affinity in
-                # one round trip (see set_summary).  Any OTHER tuple arity
-                # is a malformed probe result and must degrade like a
-                # broken state — an unpack error here would kill the
-                # prober thread and freeze all health probing forever.
-                summary = None
-                if isinstance(state, tuple):
-                    if len(state) == 2:
-                        state, summary = state
-                    else:
-                        state = SERVER_UNREACHABLE
-                if state not in _VALID_STATES:
-                    state = SERVER_UNREACHABLE  # a broken probe is no health
-                    summary = None
-                self.set_state(url, state)
-                if summary is not None:
-                    self.set_summary(url, summary)
+            except Exception:
+                if stop.wait(interval_s):
+                    return
+
+    def _probe_pass(self, probe, stop, interval_s, rng, next_due):
+        """One full probe sweep + sleep; True when *stop* fired."""
+        with self._lock:
+            members = [
+                e.url for e in self._endpoints
+                if e.phase != PHASE_RETIRING
+            ]
+        now = time.monotonic()
+        for url in members:
+            if stop.is_set():
+                return True
+            due = next_due.get(url)
+            if due is None:
                 self._probe_schedule(
-                    url, next_due, time.monotonic(), interval_s, rng, False
+                    url, next_due, now, interval_s, rng, True
                 )
-            # forget departed endpoints so the schedule map cannot grow
-            live = set(members)
-            for url in list(next_due):
-                if url not in live:
-                    del next_due[url]
-            now = time.monotonic()
-            delays = [max(due - now, 0.0) for due in next_due.values()]
-            sleep_s = min(delays) if delays else interval_s
-            if stop.wait(min(max(sleep_s, 0.001), interval_s)):
-                return
+                continue
+            if due > now:
+                continue
+            try:
+                state = probe(url)
+            except Exception:
+                state = SERVER_UNREACHABLE
+            # probes may piggyback the replica's cache-tier gossip:
+            # (state, digests) updates health AND routing affinity, and
+            # (state, digests, pressure) additionally carries the
+            # autoscaling signals — all in one round trip (see
+            # set_summary/set_pressure).  Any OTHER tuple arity is a
+            # malformed probe result and must degrade like a broken
+            # state — an unpack error here would kill the prober thread
+            # and freeze all health probing forever.
+            summary = None
+            pressure = None
+            if isinstance(state, tuple):
+                if len(state) == 2:
+                    state, summary = state
+                elif len(state) == 3:
+                    state, summary, pressure = state
+                else:
+                    state = SERVER_UNREACHABLE
+            if state not in _VALID_STATES:
+                state = SERVER_UNREACHABLE  # a broken probe is no health
+                summary = None
+                pressure = None
+            self.set_state(url, state)
+            if summary is not None:
+                self.set_summary(url, summary)
+            if pressure is not None:
+                self.set_pressure(url, pressure)
+            self._probe_schedule(
+                url, next_due, time.monotonic(), interval_s, rng, False
+            )
+        # forget departed endpoints so the schedule map cannot grow
+        live = set(members)
+        for url in list(next_due):
+            if url not in live:
+                del next_due[url]
+        now = time.monotonic()
+        delays = [max(due - now, 0.0) for due in next_due.values()]
+        sleep_s = min(delays) if delays else interval_s
+        return stop.wait(min(max(sleep_s, 0.001), interval_s))
 
     def close(self):
         with self._lock:

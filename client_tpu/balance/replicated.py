@@ -98,12 +98,57 @@ def _attempt_timeout_kwargs(transport, kwargs, timeout_s):
 
 def _request_ctx(model_name, kwargs):
     """The routing context content-aware policies (sticky) key on."""
+    params = kwargs.get("parameters") or {}
     return {
         "model_name": model_name,
         "sequence_id": kwargs.get("sequence_id", 0),
         "sequence_start": bool(kwargs.get("sequence_start", False)),
         "sequence_end": bool(kwargs.get("sequence_end", False)),
+        # durable sequences replicate server-side state through the fleet
+        # tier: the sticky policy remaps them SILENTLY on replica death
+        # instead of raising SequenceRestartError
+        "sequence_durable": bool(params.get("sequence_durable", False)),
     }
+
+
+def _sequence_params(kwargs):
+    """Fold the ``sequence_durable``/``sequence_step`` convenience kwargs
+    into the request ``parameters`` dict (the transport clients pass
+    parameters through verbatim; these two are engine-level sequence
+    semantics, not transport kwargs)."""
+    durable = kwargs.pop("sequence_durable", None)
+    step = kwargs.pop("sequence_step", None)
+    if durable is None and step is None:
+        return kwargs
+    params = dict(kwargs.get("parameters") or {})
+    if durable is not None:
+        params["sequence_durable"] = bool(durable)
+    if step is not None:
+        params["sequence_step"] = int(step)
+    kwargs["parameters"] = params
+    return kwargs
+
+
+def _prefix_digests(model_name, inputs, kwargs, prefix_fn, block_size):
+    """The ``prefix_digests`` routing hint for the prefix-aware policy.
+
+    Priority: an explicit ``prefix_digests=`` kwarg, then a
+    ``prefix_tokens=`` kwarg (token ids the caller already has), then
+    the client's ``prefix_fn(model_name, inputs)`` tokenizer hook.
+    Returns a digest list or None; tokens digest through
+    ``client_tpu.serve.fleet.chain_digests`` (imported lazily — plain
+    transport clients never pay for the serving stack)."""
+    digests = kwargs.pop("prefix_digests", None)
+    tokens = kwargs.pop("prefix_tokens", None)
+    if digests is not None:
+        return list(digests)
+    if tokens is None and prefix_fn is not None:
+        tokens = prefix_fn(model_name, inputs)
+    if tokens is None:
+        return None
+    from client_tpu.serve.fleet import chain_digests
+
+    return chain_digests(tokens, block_size)
 
 
 def _probe_fn(transport, client_for):
@@ -151,11 +196,18 @@ class ReplicatedClient:
                  probe_interval_s=_DEFAULT_PROBE_INTERVAL_S,
                  resolver=None,
                  discovery_interval_s=_DEFAULT_DISCOVERY_INTERVAL_S,
-                 client_factory=None, **client_kwargs):
+                 client_factory=None, prefix_fn=None, prefix_block_size=16,
+                 **client_kwargs):
         self._pool, self._owns_pool = _as_pool(pool, policy)
         self._transport = transport
         self._factory = client_factory or _default_factory(transport, False)
         self._client_kwargs = client_kwargs
+        # tokenizer-aware prefix routing: prefix_fn(model_name, inputs)
+        # returns the request's prompt token ids; infer() digests them
+        # into the prefix_digests routing ctx the prefix-aware policy
+        # keys on (explicit prefix_digests=/prefix_tokens= kwargs win)
+        self._prefix_fn = prefix_fn
+        self._prefix_block_size = int(prefix_block_size)
         # Per-endpoint clients are created lazily: with live discovery the
         # membership outgrows whatever existed at construction.
         self._clients = {}
@@ -245,14 +297,27 @@ class ReplicatedClient:
     def infer(self, model_name, inputs, **kwargs):
         """One inference, routed across the replica set with failover.
 
-        Accepts the underlying transport client's ``infer`` kwargs.  The
-        sequence kwargs double as the routing context for the sticky
-        policy (see the module docstring)."""
+        Accepts the underlying transport client's ``infer`` kwargs plus
+        four replica-set extras: ``sequence_durable=``/``sequence_step=``
+        (folded into the request parameters — durable sequences survive
+        replica death through the fleet tier) and
+        ``prefix_digests=``/``prefix_tokens=`` (the prefix-aware
+        routing hint; a ``prefix_fn`` client hook computes it from the
+        inputs when neither is given).  The sequence kwargs double as
+        the routing context for the sticky policy (see the module
+        docstring)."""
         with _tracing.client_span(self._tracer, model_name) as trace:
             headers = dict(kwargs.pop("headers", None) or {})
             if trace is not None:
                 headers["traceparent"] = trace.traceparent()
+            kwargs = _sequence_params(kwargs)
             ctx = _request_ctx(model_name, kwargs)
+            digests = _prefix_digests(
+                model_name, inputs, kwargs, self._prefix_fn,
+                self._prefix_block_size,
+            )
+            if digests:
+                ctx["prefix_digests"] = digests
 
             def route(excluded):
                 return self._route(excluded, ctx)
@@ -463,11 +528,13 @@ class AsyncReplicatedClient:
 
     def __init__(self, pool, transport="http", policy="round-robin",
                  retry_policy=None, tracer=None, client_factory=None,
-                 **client_kwargs):
+                 prefix_fn=None, prefix_block_size=16, **client_kwargs):
         self._pool, self._owns_pool = _as_pool(pool, policy)
         self._transport = transport
         self._factory = client_factory or _default_factory(transport, True)
         self._client_kwargs = client_kwargs
+        self._prefix_fn = prefix_fn
+        self._prefix_block_size = int(prefix_block_size)
         self._clients = {}
         self._retry_policy = retry_policy or _resilience.RetryPolicy(
             max_attempts=len(self._pool) + 1
@@ -526,7 +593,14 @@ class AsyncReplicatedClient:
             headers = dict(kwargs.pop("headers", None) or {})
             if trace is not None:
                 headers["traceparent"] = trace.traceparent()
+            kwargs = _sequence_params(kwargs)
             ctx = _request_ctx(model_name, kwargs)
+            digests = _prefix_digests(
+                model_name, inputs, kwargs, self._prefix_fn,
+                self._prefix_block_size,
+            )
+            if digests:
+                ctx["prefix_digests"] = digests
 
             def route(excluded):
                 return self._route(excluded, ctx)

@@ -72,16 +72,33 @@ class CompletionObserver:
             pass
 
     def _loop(self):
+        # one guard per pass (the BG-THREAD-CRASH shape): a raising
+        # completion callback must not kill the observer thread — every
+        # later watch would leak its span/semaphore/counter silently
         while True:
-            with self._cv:
-                while not self._backlog and not self._closed:
-                    self._cv.wait()
-                if not self._backlog:
+            try:
+                if not self._drain_once():
                     return
-                batch, self._backlog = self._backlog, []
-            self._settle([arrays for arrays, _ in batch])
-            for _, callback in batch:
+            except Exception:
+                pass
+
+    def _drain_once(self):
+        """Settle and deliver one backlog batch; False once closed and
+        drained.  Each callback is guarded individually so one bad
+        callback cannot skip its batch siblings."""
+        with self._cv:
+            while not self._backlog and not self._closed:
+                self._cv.wait()
+            if not self._backlog:
+                return False
+            batch, self._backlog = self._backlog, []
+        self._settle([arrays for arrays, _ in batch])
+        for _, callback in batch:
+            try:
                 callback()
+            except Exception:  # noqa: BLE001 - siblings must still run
+                pass
+        return True
 
     def close(self, timeout=30):
         with self._cv:

@@ -589,22 +589,36 @@ class ModelBatcher:
             self._host_cv.notify()
 
     def _host_loop(self):
+        # one guard per pass (the BG-THREAD-CRASH shape): an escaped
+        # exception would kill this completion worker silently and
+        # strand every group queued behind it
         while True:
-            with self._host_cv:
-                while not self._host_q and not self._host_closed:
-                    self._host_cv.wait()
-                if not self._host_q:
-                    self._host_cv.notify_all()  # wake the close() waiter
-                    return
-                dispatched = self._host_q.popleft()
-                self._host_outstanding += 1
             try:
-                self._complete_host(*dispatched)
-            finally:
-                with self._host_cv:
-                    self._host_outstanding -= 1
-                    self._host_cv.notify_all()
-                self._finish_one(self._sem)
+                if not self._host_once():
+                    return
+            except Exception:
+                pass
+
+    def _host_once(self):
+        """Complete one dispatched host group; False once closed and
+        drained (the outstanding/semaphore accounting is exception-safe
+        either way)."""
+        with self._host_cv:
+            while not self._host_q and not self._host_closed:
+                self._host_cv.wait()
+            if not self._host_q:
+                self._host_cv.notify_all()  # wake the close() waiter
+                return False
+            dispatched = self._host_q.popleft()
+            self._host_outstanding += 1
+        try:
+            self._complete_host(*dispatched)
+        finally:
+            with self._host_cv:
+                self._host_outstanding -= 1
+                self._host_cv.notify_all()
+            self._finish_one(self._sem)
+        return True
 
     def _drain_compatible_locked(self, group, first, rows, max_arity):
         """Fold queued signature-compatible requests into *group* (no wait),

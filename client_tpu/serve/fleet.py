@@ -47,6 +47,8 @@ bookkeeping and releases it before any socket work.
 
 import base64
 import hashlib
+import json
+import queue
 import socket
 import threading
 import time
@@ -124,8 +126,14 @@ class _PrefixStore:
     def __init__(self, max_blocks=4096):
         self.max_blocks = int(max_blocks)
         self._lock = threading.Lock()
-        # tuple(tokens[: (i+1)*bs]) -> (digest, k_layers, v_layers)
+        # tuple(tokens[: (i+1)*bs]) ->
+        #     [digest, k_layers, v_layers, hits, pushed]
+        # hits counts demand (local re-publishes + peer lookups) — the
+        # anti-entropy loop pushes chains past the hot threshold; pushed
+        # marks chains already replicated (cleared on push failure so a
+        # later hit re-queues them)
         self._entries = OrderedDict()
+        self.block_size = None  # last-seen block size (uniform per engine)
 
     def put(self, row, n_blocks, block_size, host_k, host_v):
         """Insert ``n_blocks`` leading full blocks of *row* (host arrays
@@ -134,19 +142,25 @@ class _PrefixStore:
         n_blocks = min(int(n_blocks), len(row) // int(block_size))
         digests = chain_digests(row, block_size, n_blocks)
         with self._lock:
+            self.block_size = int(block_size)
             for i in range(n_blocks):
                 key = tuple(row[: (i + 1) * int(block_size)])
-                if key not in self._entries:
-                    self._entries[key] = (
+                entry = self._entries.get(key)
+                if entry is None:
+                    self._entries[key] = [
                         digests[i],
                         [np.asarray(k[i]) for k in host_k],
                         [np.asarray(v[i]) for v in host_v],
-                    )
+                        0,
+                        False,
+                    ]
+                else:
+                    entry[3] += 1  # re-published: local demand
                 self._entries.move_to_end(key)
             while len(self._entries) > self.max_blocks:
                 self._entries.popitem(last=False)
 
-    def lookup(self, row, block_size, max_blocks):
+    def lookup(self, row, block_size, max_blocks, count_hits=True):
         """Longest stored chain for *row*: ``(covered, k_layers,
         v_layers)`` with per-layer arrays stacked [covered, bs, kv, hd],
         or None on a total miss."""
@@ -160,6 +174,8 @@ class _PrefixStore:
                 if entry is None:
                     break
                 self._entries.move_to_end(key)
+                if count_hits:
+                    entry[3] += 1
                 hits.append(entry)
         if not hits:
             return None
@@ -178,6 +194,57 @@ class _PrefixStore:
             keys = list(self._entries)[-int(limit):]
             return [self._entries[k][0] for k in keys]
 
+    def hot_count(self, threshold):
+        """Chains at or past the hot-hit threshold (the prefix-affinity
+        pressure signal gossiped on probes)."""
+        with self._lock:
+            return sum(
+                1 for e in self._entries.values() if e[3] >= threshold
+            )
+
+    def take_hot(self, threshold):
+        """Hot, not-yet-replicated chain heads: ``[(row, n_blocks)]``.
+
+        Longest-chain-first with proper prefixes of an already-taken
+        chain skipped (one ``prefix_put`` of the longest chain carries
+        every sub-chain), each marked pushed so it is taken once; a
+        failed push clears the mark via :meth:`unmark_pushed`."""
+        with self._lock:
+            if self.block_size is None:
+                return []
+            hot = sorted(
+                (
+                    key for key, e in self._entries.items()
+                    if e[3] >= threshold and not e[4]
+                ),
+                key=len, reverse=True,
+            )
+            taken = []
+            for key in hot:
+                covered = False
+                for longer, _n in taken:
+                    if tuple(longer[: len(key)]) == key:
+                        covered = True
+                        break
+                self._entries[key][4] = True
+                if not covered:
+                    taken.append((list(key), len(key) // self.block_size))
+            return taken
+
+    def unmark_pushed(self, row):
+        """Clear the replicated mark on the chain AND every sub-chain
+        after a failed push: take_hot marked the covered prefixes pushed
+        too (one prefix_put of the longest chain carries them), so a
+        failed push must re-arm the whole family or an eviction of the
+        head chain would leave still-hot sub-chains skipped forever."""
+        row = [int(t) for t in row]
+        with self._lock:
+            block_size = self.block_size or len(row) or 1
+            for i in range(len(row) // block_size):
+                entry = self._entries.get(tuple(row[: (i + 1) * block_size]))
+                if entry is not None:
+                    entry[4] = False
+
     @property
     def blocks(self):
         with self._lock:
@@ -186,6 +253,72 @@ class _PrefixStore:
     def clear(self):
         with self._lock:
             self._entries.clear()
+
+
+def _seq_version(snapshot):
+    """Snapshot ordering key: ``(epoch, step)`` — the incarnation stamp
+    first, so a restarted sequence id's fresh epoch beats the dead
+    incarnation's higher step count."""
+    return (
+        float(snapshot.get("epoch", 0.0)), int(snapshot.get("step", 0))
+    )
+
+
+class _SequenceStore:
+    """Replicated sequence-state snapshots, versioned by (epoch, step).
+
+    One snapshot per sequence id (``SequenceContext.export()`` shape).
+    ``put`` is monotonic: a snapshot whose ``(epoch, step)`` version
+    does not beat the stored one is STALE and rejected — replication,
+    retries, and gossip races can never move a sequence backwards, and
+    a RESTARTED sequence id (fresh epoch) overwrites the previous
+    incarnation's leftovers.  LRU-bounded; entries idle past ``ttl_s``
+    expire at read time (mirroring the engine's own
+    ``max_sequence_idle_s`` hygiene)."""
+
+    def __init__(self, max_sequences=4096, ttl_s=120.0):
+        self.max_sequences = int(max_sequences)
+        self.ttl_s = float(ttl_s)
+        self._lock = threading.Lock()
+        self._entries = OrderedDict()  # seq_id -> (snapshot, stored_at)
+        self.stale_rejected = 0
+
+    def put(self, snapshot):
+        """Install one snapshot; False when stale (version not newer)."""
+        seq_id = snapshot.get("sequence_id")
+        if seq_id is None:
+            return False
+        with self._lock:
+            old = self._entries.get(seq_id)
+            if old is not None and _seq_version(old[0]) >= _seq_version(
+                snapshot
+            ):
+                self.stale_rejected += 1
+                return False
+            self._entries[seq_id] = (snapshot, time.monotonic())
+            self._entries.move_to_end(seq_id)
+            while len(self._entries) > self.max_sequences:
+                self._entries.popitem(last=False)
+            return True
+
+    def get(self, seq_id):
+        with self._lock:
+            entry = self._entries.get(seq_id)
+            if entry is None:
+                return None
+            if time.monotonic() - entry[1] > self.ttl_s:
+                self._entries.pop(seq_id, None)
+                return None
+            return entry[0]
+
+    def pop(self, seq_id):
+        with self._lock:
+            self._entries.pop(seq_id, None)
+
+    @property
+    def count(self):
+        with self._lock:
+            return len(self._entries)
 
 
 def fetch_summary(addr, timeout_s=0.5):
@@ -203,6 +336,7 @@ def fetch_summary(addr, timeout_s=0.5):
     return {
         "prefix_digests": list(reply.get("prefix_digests") or ()),
         "cache_digests": list(reply.get("cache_digests") or ()),
+        "pressure": dict(reply.get("pressure") or {}),
     }
 
 
@@ -225,7 +359,10 @@ class FleetTier:
     def __init__(self, bind="127.0.0.1:0", peers=(), lookup_timeout_s=0.25,
                  fan_out=2, gossip_interval_s=2.0, failure_threshold=3,
                  reset_timeout_s=5.0, max_store_blocks=4096,
-                 summary_limit=512, registry=None):
+                 summary_limit=512, registry=None, replicate_k=1,
+                 replicate_budget_bytes_s=4 << 20, hot_hits=3,
+                 replicate_interval_s=0.2, max_sequences=4096,
+                 seq_ttl_s=120.0):
         host, _, port = str(bind).rpartition(":")
         self._bind_host = host or "127.0.0.1"
         self._bind_port = int(port)
@@ -235,6 +372,27 @@ class FleetTier:
         self.summary_limit = int(summary_limit)
         self.registry = registry
         self.store = _PrefixStore(max_store_blocks)
+        # replicated sequence-state lane (snapshots peers pushed to us,
+        # plus lookups cached from peers) — the failure-domain half
+        self.seq_store = _SequenceStore(max_sequences, ttl_s=seq_ttl_s)
+        # proactive replication / anti-entropy: hot content pushes to K
+        # peers on a bounded byte/sec budget, strictly OFF the request
+        # path (a dedicated thread drains the queue)
+        self.replicate_k = max(int(replicate_k), 0)
+        self.hot_hits = max(int(hot_hits), 1)
+        self.replicate_interval_s = float(replicate_interval_s)
+        self._repl_rate = float(replicate_budget_bytes_s)
+        self._repl_tokens = self._repl_rate
+        self._repl_stamp = time.monotonic()
+        self._repl_queue = queue.Queue()
+        self._repl_thread = None
+        # response-cache hot tracking: key -> local hit count since the
+        # last push (bounded; a pushed key re-queues only on new demand)
+        self._cache_hot = OrderedDict()
+        self._cache_pushed = set()
+        self.replicated_items = 0
+        self.replicated_bytes = 0
+        self.seq_pushes = 0
         self._breakers = CircuitBreakerRegistry(
             failure_threshold=failure_threshold,
             reset_timeout_s=reset_timeout_s,
@@ -299,14 +457,23 @@ class FleetTier:
                 name="fleet-gossip", daemon=True,
             )
             self._gossip_thread.start()
+        if self.replicate_k > 0:
+            self._repl_thread = threading.Thread(
+                target=self._replicate_loop, args=(self._stop,),
+                name="fleet-replicate", daemon=True,
+            )
+            self._repl_thread.start()
         return self
 
     def close(self):
         self._stop.set()
-        for thread in (self._accept_thread, self._gossip_thread):
+        threads = (self._accept_thread, self._gossip_thread,
+                   self._repl_thread)
+        for thread in threads:
             if thread is not None:
                 thread.join(timeout=5)
         self._accept_thread = self._gossip_thread = None
+        self._repl_thread = None
         if self._server is not None:
             self._server.close()
             self._server = None
@@ -338,21 +505,30 @@ class FleetTier:
     # -- peer server side --------------------------------------------------
 
     def _serve_loop(self, srv, stop):
+        # the whole pass sits under one guard (the BG-THREAD-CRASH shape):
+        # an accept-loop thread that dies silently takes the peer server —
+        # and every survivor's lookups against it — down with it
         while not stop.is_set():
             try:
                 conn, _ = srv.accept()
+                # one short-lived thread per connection: a half-dead peer
+                # holding a partial frame wedges only ITS handler, never
+                # the accept loop — healthy peers' lookups keep answering
+                # inside their timeout instead of collecting breaker
+                # strikes
+                threading.Thread(
+                    target=self._serve_one, args=(conn,),
+                    name="fleet-peer-conn", daemon=True,
+                ).start()
             except socket.timeout:
                 continue
             except OSError:
                 return
-            # one short-lived thread per connection: a half-dead peer
-            # holding a partial frame wedges only ITS handler, never the
-            # accept loop — healthy peers' lookups keep answering inside
-            # their timeout instead of collecting breaker strikes
-            threading.Thread(
-                target=self._serve_one, args=(conn,),
-                name="fleet-peer-conn", daemon=True,
-            ).start()
+            except Exception:  # thread-spawn failure: drop the connection
+                try:
+                    conn.close()
+                except OSError:
+                    pass
 
     def _serve_one(self, conn):
         try:
@@ -380,6 +556,14 @@ class FleetTier:
             return self._handle_cache_get(request.get("key"))
         if op == "prefix_get":
             return self._handle_prefix_get(request)
+        if op == "prefix_put":
+            return self._handle_prefix_put(request)
+        if op == "cache_put":
+            return self._handle_cache_put(request)
+        if op == "seq_put":
+            return self._handle_seq_put(request)
+        if op == "seq_get":
+            return self._handle_seq_get(request.get("sequence_id"))
         if op == "gossip":
             engine = self._engine
             qos = getattr(engine, "qos", None) if engine else None
@@ -425,6 +609,71 @@ class FleetTier:
             "v": _encode_block([v[start:] for v in v_layers]),
         }
 
+    def _handle_prefix_put(self, request):
+        """Anti-entropy receive: install a peer's pushed KV chain into
+        this replica's host store (host-side only; no device state)."""
+        try:
+            self.store.put(
+                request.get("tokens") or [],
+                int(request.get("n_blocks") or 0),
+                int(request.get("block_size") or 0) or 1,
+                _decode_block(request.get("k") or []),
+                _decode_block(request.get("v") or []),
+            )
+        except (KeyError, ValueError):
+            return {"ok": False}
+        self._gauge()
+        return {"ok": True}
+
+    def _handle_cache_put(self, request):
+        """Anti-entropy receive: fill a peer's pushed hot response into
+        the local response cache (plain LRU insert — a remote fill
+        competes for space like any local one)."""
+        engine = self._engine
+        cache = getattr(engine, "response_cache", None) if engine else None
+        key = request.get("key")
+        if cache is None or not key:
+            return {"ok": False}
+        blobs = [base64.b64decode(b) for b in request.get("blobs") or ()]
+        cache.put(key, request.get("response") or {}, blobs)
+        return {"ok": True}
+
+    def _handle_seq_put(self, request):
+        """Sequence-state lane receive: install (or, for an ended
+        sequence, drop) one versioned snapshot.  Stale snapshots — step
+        not beating the stored one — are rejected, never applied."""
+        if request.get("ended"):
+            self.seq_store.pop(request.get("sequence_id"))
+            return {"ok": True, "stored": False}
+        snapshot = request.get("snapshot") or {}
+        stored = self.seq_store.put(snapshot)
+        if not stored:
+            self._count("ctpu_fleet_seq_stale_total")
+        return {"ok": True, "stored": stored}
+
+    def _handle_seq_get(self, seq_id):
+        """Serve one sequence snapshot: the freshest of the replicated
+        store and the attached engine's LIVE sequence (planned handoffs
+        can pull state that was never pushed)."""
+        if seq_id is None:
+            return {"hit": False}
+        snapshot = self.seq_store.get(seq_id)
+        engine = self._engine
+        export = getattr(engine, "export_sequence", None) if engine else None
+        if export is not None:
+            try:
+                live = export(seq_id)
+            except Exception:  # pragma: no cover - defensive
+                live = None
+            if live is not None and (
+                snapshot is None
+                or _seq_version(live) > _seq_version(snapshot)
+            ):
+                snapshot = live
+        if snapshot is None:
+            return {"hit": False}
+        return {"hit": True, "snapshot": snapshot}
+
     # -- peer client side (NEVER call with an engine/pool lock held) -------
 
     def _peer_call(self, addr, payload):
@@ -439,10 +688,12 @@ class FleetTier:
             send_frame(sock, payload)
             return recv_frame(sock)
 
-    def _candidates(self):
+    def _candidates(self, limit=None):
         """Breaker-admitted peer snapshot (skips counted): at most
-        ``fan_out`` peers per lookup, so a lookup's worst case is
-        ``fan_out * lookup_timeout_s`` even before breakers open."""
+        ``limit`` (default ``fan_out``) peers per call, so a lookup's
+        worst case is ``fan_out * lookup_timeout_s`` even before
+        breakers open."""
+        limit = self.fan_out if limit is None else int(limit)
         out = []
         for addr in self.peers():
             breaker = self._breakers.get(addr)
@@ -454,7 +705,7 @@ class FleetTier:
                 self._count("ctpu_fleet_peer_skips_total")
                 continue
             out.append((addr, breaker))
-            if len(out) >= self.fan_out:
+            if len(out) >= limit:
                 break
         return out
 
@@ -582,6 +833,264 @@ class FleetTier:
             except Exception:  # pragma: no cover - defensive
                 pass
 
+    # -- replicated sequence state (the failure-domain lane) ---------------
+
+    def _push(self, payload, nbytes=0, limit=None, stop=None, accept=None,
+              candidates=None):
+        """Push one payload to up to ``limit`` (default ``replicate_k``)
+        breaker-admitted peers; returns the ack count.  ``nbytes`` > 0
+        charges the anti-entropy byte budget FIRST (per peer) — the
+        replication thread's rate bound.  ``accept(reply)``, when given,
+        decides whether a peer's answer counts as an ack (a reachable
+        peer that REJECTED the payload is not one; it is still breaker
+        evidence of health).  ``candidates`` lets a caller that already
+        admitted peers (consuming half-open probe slots) hand them in —
+        an admitted candidate MUST have its outcome recorded, or the
+        breaker's single-probe gate wedges."""
+        if candidates is None:
+            limit = self.replicate_k if limit is None else int(limit)
+            candidates = self._candidates(limit=limit)
+        acked = 0
+        for i, (addr, breaker) in enumerate(candidates):
+            if nbytes and not self._budget_wait(nbytes, stop):
+                # shutting down mid-wait: release the remaining admitted
+                # half-open probe slots so no breaker stays wedged
+                for _addr, pending in candidates[i:]:
+                    pending.record_failure()
+                break
+            try:
+                reply = self._peer_call(addr, payload)
+            except Exception:  # noqa: BLE001 - containment is the point
+                breaker.record_failure()
+                with self._lock:
+                    self.peer_errors += 1
+                self._count("ctpu_fleet_peer_errors_total")
+                continue
+            breaker.record_success()
+            if accept is None or accept(reply):
+                acked += 1
+        return acked
+
+    def publish_sequence(self, snapshot):
+        """Replicate one durable sequence snapshot to ``replicate_k``
+        peers SYNCHRONOUSLY — the engine calls this after applying a
+        durable step and before the response reaches the wire, so an
+        acked step survives this replica's unplanned death.  Bounded by
+        k x lookup timeout with per-peer breakers: an unreachable fleet
+        costs (almost) nothing and degrades to local-only durability.
+        Returns the number of peers that STORED the snapshot — a peer
+        that rejected it as stale is reachable but is no durability."""
+        acked = self._push(
+            {"op": "seq_put", "snapshot": snapshot},
+            accept=lambda reply: bool(reply.get("stored")),
+        )
+        if acked:
+            with self._lock:
+                self.seq_pushes += 1
+            self._count("ctpu_fleet_seq_snapshots_total")
+        return acked
+
+    def forget_sequence(self, seq_id):
+        """A sequence ended cleanly: queue the drop so peers stop holding
+        its snapshot (asynchronous — correctness never depends on it;
+        stale entries also age out of the store)."""
+        self.seq_store.pop(seq_id)
+        if self.replicate_k > 0:
+            # replicate_k=0 runs no replication thread: enqueueing onto
+            # a never-drained queue would grow memory forever
+            self._repl_queue.put(("seq_end", seq_id))
+
+    def sequence_lookup(self, seq_id):
+        """The freshest replicated snapshot for *seq_id*: local store
+        first, then a bounded peer fan-out.  A peer hit is cached
+        locally (stale-rejecting), so a sequence resumes with ONE fleet
+        round trip.  None when nobody holds it."""
+        best = self.seq_store.get(seq_id)
+        if best is not None:
+            return best
+        for _addr, reply in self._ask(
+            {"op": "seq_get", "sequence_id": seq_id}
+        ):
+            if not reply.get("hit"):
+                continue
+            snapshot = reply.get("snapshot") or {}
+            if best is None or _seq_version(snapshot) > _seq_version(best):
+                best = snapshot
+        self._note_lookup(best is not None, "seq")
+        if best is not None:
+            self.seq_store.put(best)
+        return best
+
+    # -- proactive replication / anti-entropy ------------------------------
+
+    def note_cache_hit(self, key):
+        """Host-side hot-entry signal from the front door's LOCAL cache
+        hits (never a peer RPC): entries past ``hot_hits`` queue for the
+        replication thread to push."""
+        if self.replicate_k <= 0:
+            return
+        with self._lock:
+            count = self._cache_hot.get(key, 0) + 1
+            self._cache_hot[key] = count
+            self._cache_hot.move_to_end(key)
+            while len(self._cache_hot) > 4096:
+                self._cache_hot.popitem(last=False)
+            if count < self.hot_hits or key in self._cache_pushed:
+                return
+            self._cache_pushed.add(key)
+            if len(self._cache_pushed) > 8192:
+                self._cache_pushed.clear()  # bounded; worst case re-push
+        self._repl_queue.put(("cache", key))
+
+    def _budget_wait(self, nbytes, stop=None):
+        """Charge *nbytes* against the byte/sec token bucket, sleeping
+        (bounded, stop-aware) while the bucket is in debt.  Debt-based:
+        one oversized item may overdraw, and the loop then waits the
+        debt out — average push rate stays at the budget."""
+        if self._repl_rate <= 0:
+            return True  # unlimited
+        while True:
+            with self._lock:
+                now = time.monotonic()
+                self._repl_tokens = min(
+                    self._repl_rate,
+                    self._repl_tokens
+                    + (now - self._repl_stamp) * self._repl_rate,
+                )
+                self._repl_stamp = now
+                if self._repl_tokens > 0:
+                    self._repl_tokens -= nbytes
+                    return True
+            if stop is None:
+                return True  # synchronous replicate_now: no throttling
+            if stop.wait(0.05):
+                return False
+
+    def _scan_hot(self):
+        """Queue hot, not-yet-replicated prefix chains (store-lock only;
+        the expensive encode is deferred to _replicate_one, which skips
+        it while no peer is admissible)."""
+        for row, n_blocks in self.store.take_hot(self.hot_hits):
+            self._repl_queue.put(("prefix", row, n_blocks))
+
+    def _replicate_one(self, item, stop=None):
+        """Push one queued anti-entropy item to ``replicate_k`` peers.
+        Returns the ack count (0 = nothing pushed; hot marks are cleared
+        so later demand re-queues).  Peers are admitted BEFORE the
+        expensive payload encode: with nobody reachable (no peers, every
+        breaker open) the item is re-armed and dropped without paying
+        the encode — an isolated or fully-degraded replica must not
+        re-encode its hot set every scan interval forever."""
+        kind = item[0]
+        if kind == "seq_end":
+            return self._push({"op": "seq_put", "ended": True,
+                               "sequence_id": item[1]}, nbytes=256,
+                              stop=stop)
+        if kind == "cache":
+            key = item[1]
+            candidates = self._candidates(limit=self.replicate_k)
+            if not candidates:
+                with self._lock:
+                    self._cache_pushed.discard(key)  # re-arm for later
+                return 0
+            engine = self._engine
+            cache = (
+                getattr(engine, "response_cache", None) if engine else None
+            )
+            value = cache.peek(key) if cache is not None else None
+            if value is None:
+                # evicted/expired since it ran hot: the admitted probe
+                # slots must still resolve — ping keeps them honest
+                self._push({"op": "ping"}, candidates=candidates)
+                return 0
+            response, blobs = value
+            encoded = [
+                base64.b64encode(bytes(b)).decode("ascii") for b in blobs
+            ]
+            nbytes = sum(len(b) for b in encoded) + len(
+                json.dumps(response)
+            ) + 256
+            acked = self._push(
+                {"op": "cache_put", "key": key, "response": response,
+                 "blobs": encoded},
+                nbytes=nbytes, stop=stop, candidates=candidates,
+            )
+            if not acked:
+                with self._lock:
+                    self._cache_pushed.discard(key)
+            else:
+                self._note_replicated("cache", nbytes, acked)
+            return acked
+        if kind == "prefix":
+            row, n_blocks = item[1], item[2]
+            candidates = self._candidates(limit=self.replicate_k)
+            if not candidates:
+                self.store.unmark_pushed(row)  # re-arm for later
+                return 0
+            block_size = self.store.block_size or 1
+            got = self.store.lookup(row, block_size, n_blocks,
+                                    count_hits=False)
+            if got is None:
+                self._push({"op": "ping"}, candidates=candidates)
+                return 0  # evicted since the scan
+            covered, k_layers, v_layers = got
+            k_enc = _encode_block(k_layers)
+            v_enc = _encode_block(v_layers)
+            nbytes = sum(
+                len(e["data"]) for e in k_enc + v_enc
+            ) + 4 * len(row) + 256
+            acked = self._push(
+                {"op": "prefix_put", "tokens": list(row),
+                 "n_blocks": covered, "block_size": block_size,
+                 "k": k_enc, "v": v_enc},
+                nbytes=nbytes, stop=stop, candidates=candidates,
+            )
+            if not acked:
+                self.store.unmark_pushed(row)
+            else:
+                self._note_replicated("prefix", nbytes, acked)
+            return acked
+        return 0
+
+    def _note_replicated(self, kind, nbytes, acked):
+        with self._lock:
+            self.replicated_items += 1
+            self.replicated_bytes += nbytes * acked
+        self._count("ctpu_fleet_replicated_items_total", {"kind": kind})
+        self._count("ctpu_fleet_replicated_bytes_total",
+                    value=nbytes * acked)
+
+    def _replicate_loop(self, stop):
+        """The anti-entropy thread: drains the push queue under the byte
+        budget and, when idle, scans the prefix store for chains that ran
+        hot.  Strictly OFF the request path — nothing here is ever
+        awaited by a serving request."""
+        while not stop.is_set():
+            try:
+                try:
+                    item = self._repl_queue.get(
+                        timeout=self.replicate_interval_s
+                    )
+                except queue.Empty:
+                    self._scan_hot()
+                    continue
+                self._replicate_one(item, stop=stop)
+            except Exception:  # a bad item must not kill anti-entropy
+                pass
+
+    def replicate_now(self):
+        """Synchronously drain the anti-entropy queue (tests, benchmarks,
+        pre-shutdown flushes).  Budget-exempt.  Returns items pushed."""
+        self._scan_hot()
+        pushed = 0
+        while True:
+            try:
+                item = self._repl_queue.get_nowait()
+            except queue.Empty:
+                return pushed
+            if self._replicate_one(item):
+                pushed += 1
+
     # -- local store (host-side; no peer RPC, no device state) -------------
 
     def export_prefix(self, row, n_blocks, block_size, host_k, host_v):
@@ -593,8 +1102,9 @@ class FleetTier:
         self._gauge()
 
     def local_summary(self):
-        """The gossip/probe summary: most-recent chain digests plus the
-        response cache's digest keys (truncated to the summary limit)."""
+        """The gossip/probe summary: most-recent chain digests, the
+        response cache's digest keys (truncated to the summary limit),
+        and the replica's autoscaling pressure signals."""
         engine = self._engine
         cache = getattr(engine, "response_cache", None) if engine else None
         cache_digests = (
@@ -603,7 +1113,38 @@ class FleetTier:
         return {
             "prefix_digests": self.store.digests(self.summary_limit),
             "cache_digests": cache_digests,
+            "pressure": self.pressure(),
         }
+
+    def pressure(self):
+        """Autoscaling signal bundle gossiped on probes: queued+inflight
+        work on the attached engine, prefix-affinity pressure (hot
+        chains held), and replicated sequences carried.  Host-side only
+        — safe from the peer-server thread."""
+        engine = self._engine
+        queue_depth = 0
+        if engine is not None:
+            fn = getattr(engine, "pressure", None)
+            if callable(fn):
+                try:
+                    queue_depth = int(fn().get("queue_depth", 0))
+                except Exception:  # pragma: no cover - defensive
+                    queue_depth = 0
+        out = {
+            "queue_depth": queue_depth,
+            "prefix_hot": self.store.hot_count(self.hot_hits),
+            "sequences": self.seq_store.count,
+        }
+        if self.registry is not None:
+            self.registry.set(
+                "ctpu_fleet_pressure_queue_depth", None, queue_depth,
+                help_=FLEET_HELP["ctpu_fleet_pressure_queue_depth"],
+            )
+            self.registry.set(
+                "ctpu_fleet_pressure_prefix", None, out["prefix_hot"],
+                help_=FLEET_HELP["ctpu_fleet_pressure_prefix"],
+            )
+        return out
 
     # -- metrics / introspection -------------------------------------------
 
@@ -633,6 +1174,8 @@ class FleetTier:
 
     def stats(self):
         store_blocks = self.store.blocks
+        sequences = self.seq_store.count
+        stale = self.seq_store.stale_rejected
         with self._lock:
             return {
                 "peer_hits": self.peer_hits,
@@ -642,5 +1185,10 @@ class FleetTier:
                 "gossip_rounds": self.gossip_rounds,
                 "served": self.served,
                 "store_blocks": store_blocks,
+                "sequences": sequences,
+                "seq_pushes": self.seq_pushes,
+                "seq_stale_rejected": stale,
+                "replicated_items": self.replicated_items,
+                "replicated_bytes": self.replicated_bytes,
                 "peers": list(self._peers),
             }

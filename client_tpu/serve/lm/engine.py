@@ -496,6 +496,18 @@ class LmEngine:
                 "remote_blocks": self._fleet_blocks,
             }
 
+    def pressure(self):
+        """Autoscaling signal: queued submissions + parked (swapped)
+        streams + active lanes — the LM half of the per-replica
+        queue-depth gauge the fleet tier gossips on probes."""
+        with self._cv:
+            pending = sum(len(dq) for dq in self._pending.values())
+            active = sum(1 for lane in self._lanes if lane.active)
+            return {
+                "queue_depth": pending + len(self._swapped),
+                "inflight": active,
+            }
+
     # -- request side ------------------------------------------------------
 
     def submit(self, prompt_tokens, max_tokens, temperature=0.0, top_k=0,

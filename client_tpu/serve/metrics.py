@@ -104,6 +104,20 @@ FLEET_HELP = {
         "Fleet gossip rounds pushed (tenant counters + digest summaries)",
     "ctpu_fleet_sessions_migrated_total":
         "Parked LM streams exported to the fleet tier at planned retire",
+    "ctpu_fleet_seq_snapshots_total":
+        "Durable sequence snapshots pushed to peer replicas",
+    "ctpu_fleet_seq_resumes_total":
+        "Sequences resumed from a fleet-replicated snapshot",
+    "ctpu_fleet_seq_stale_total":
+        "Stale sequence snapshots rejected by the replicated store",
+    "ctpu_fleet_replicated_items_total":
+        "Anti-entropy items proactively pushed to peers (by kind)",
+    "ctpu_fleet_replicated_bytes_total":
+        "Anti-entropy payload bytes proactively pushed to peers",
+    "ctpu_fleet_pressure_queue_depth":
+        "Gossiped per-replica queued+inflight work (autoscaling signal)",
+    "ctpu_fleet_pressure_prefix":
+        "Gossiped per-replica prefix-affinity pressure (hot chains held)",
 }
 
 
@@ -333,6 +347,23 @@ class BalancerMetricsObserver:
             labels = {"endpoint": endpoint}
             self.registry.remove("ctpu_client_endpoint_phase", labels)
             self.registry.remove("ctpu_client_endpoint_state", labels)
+            self.registry.remove("ctpu_fleet_pressure_queue_depth", labels)
+            self.registry.remove("ctpu_fleet_pressure_prefix", labels)
+
+    def on_endpoint_pressure(self, endpoint, pressure):
+        """Gossiped autoscaling signals (probe-piggybacked; see
+        ``FleetTier.local_summary`` / ``EndpointPool.set_pressure``)."""
+        labels = {"endpoint": endpoint}
+        self.registry.set(
+            "ctpu_fleet_pressure_queue_depth", labels,
+            float(pressure.get("queue_depth", 0) or 0),
+            help_=FLEET_HELP["ctpu_fleet_pressure_queue_depth"],
+        )
+        self.registry.set(
+            "ctpu_fleet_pressure_prefix", labels,
+            float(pressure.get("prefix_hot", 0) or 0),
+            help_=FLEET_HELP["ctpu_fleet_pressure_prefix"],
+        )
 
     def on_pool_size(self, active, probation, retiring):
         for phase, count in (

@@ -14,6 +14,7 @@ extension, statistics accounting) follow the KServe-v2 spec the reference
 clients target.
 """
 
+import base64
 import json
 import mmap
 import os
@@ -74,13 +75,123 @@ class TensorSpec:
         return {"name": self.name, "datatype": self.datatype, "shape": self.dims}
 
 
-class SequenceContext:
-    """Per-sequence state handed to stateful model functions."""
+def _seq_encode(value):
+    """JSON-safe encoding of one sequence-state value (numpy arrays and
+    scalars become tagged base64/item dicts; containers recurse; anything
+    else must already be JSON-serializable — the fleet tier ships these
+    snapshots as JSON frames)."""
+    if isinstance(value, np.ndarray):
+        return {
+            "__nd__": [
+                str(value.dtype),
+                list(value.shape),
+                base64.b64encode(
+                    np.ascontiguousarray(value).tobytes()
+                ).decode("ascii"),
+            ]
+        }
+    if isinstance(value, (np.integer, np.floating, np.bool_)):
+        return {"__np__": [str(value.dtype), value.item()]}
+    if isinstance(value, bytes):
+        return {"__b__": base64.b64encode(value).decode("ascii")}
+    if isinstance(value, dict):
+        return {k: _seq_encode(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [_seq_encode(v) for v in value]
+    return value
 
-    def __init__(self, sequence_id):
+
+def _seq_decode(value):
+    if isinstance(value, dict):
+        if "__nd__" in value and len(value) == 1:
+            dtype, shape, data = value["__nd__"]
+            return np.frombuffer(
+                base64.b64decode(data), dtype=np.dtype(dtype)
+            ).reshape(shape).copy()
+        if "__np__" in value and len(value) == 1:
+            dtype, item = value["__np__"]
+            return np.dtype(dtype).type(item)
+        if "__b__" in value and len(value) == 1:
+            return base64.b64decode(value["__b__"])
+        return {k: _seq_decode(v) for k, v in value.items()}
+    if isinstance(value, list):
+        return [_seq_decode(v) for v in value]
+    return value
+
+
+class SequenceContext:
+    """Per-sequence state handed to stateful model functions.
+
+    ``step`` is the monotonic applied-step counter: the engine bumps it
+    once per successfully executed request of the sequence, and requests
+    that declare their own ``sequence_step`` parameter are replayed
+    idempotently when the counter already covers them (the retained
+    ``last_response`` rendering answers the duplicate without
+    re-applying).  ``export()``/``restore()`` are the versioned snapshot
+    pair the fleet tier replicates: versions order by ``(epoch, step)``
+    and a snapshot that does not beat the stored version is stale and
+    rejected, so replication can never move a sequence backwards.
+    ``epoch`` stamps the sequence INCARNATION (wall clock at creation;
+    restores keep the original): a client that restarts a sequence id
+    with ``sequence_start`` mints a new, higher epoch, so the fresh
+    incarnation's step-1 snapshot overwrites the dead incarnation's
+    higher-step leftovers on every peer instead of being rejected as
+    stale.
+    """
+
+    def __init__(self, sequence_id, durable=False):
         self.sequence_id = sequence_id
         self.state = {}
+        self.step = 0
+        # incarnation stamp, NOT a deadline: wall time so a restart on
+        # any replica orders after the previous incarnation
+        self.epoch = time.time()
+        self.durable = bool(durable)
+        # (step, id-less response dict, blobs) of the last applied step —
+        # what an idempotent duplicate replay returns
+        self.last_response = None
         self.last_used = time.monotonic()
+
+    def export(self):
+        """Serializable snapshot: JSON-safe through the fleet tier's
+        frame transport (numpy state base64-tagged)."""
+        last = None
+        if self.last_response is not None:
+            step, response, blobs = self.last_response
+            last = {
+                "step": int(step),
+                "response": response,
+                "blobs": [
+                    base64.b64encode(bytes(b)).decode("ascii")
+                    for b in blobs
+                ],
+            }
+        return {
+            "sequence_id": self.sequence_id,
+            "step": int(self.step),
+            "epoch": float(self.epoch),
+            "durable": self.durable,
+            "state": _seq_encode(self.state),
+            "last_response": last,
+        }
+
+    @classmethod
+    def restore(cls, snapshot):
+        """Rebuild a context from an exported snapshot (the survivor-side
+        half of sequence migration)."""
+        ctx = cls(snapshot["sequence_id"],
+                  durable=snapshot.get("durable", False))
+        ctx.step = int(snapshot.get("step", 0))
+        ctx.epoch = float(snapshot.get("epoch", 0.0))
+        ctx.state = _seq_decode(snapshot.get("state") or {})
+        last = snapshot.get("last_response")
+        if last is not None:
+            ctx.last_response = (
+                int(last["step"]),
+                last["response"],
+                [base64.b64decode(b) for b in last.get("blobs") or ()],
+            )
+        return ctx
 
 
 class Model:
@@ -1044,6 +1155,7 @@ class InferenceEngine:
             "ctpu_drain_total",
             help_="Graceful drains initiated",
         )
+        drained = True
         with self._flight_cv:
             self._draining = True
             while self._inflight:
@@ -1051,9 +1163,21 @@ class InferenceEngine:
                 if deadline is not None:
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
-                        return False
+                        drained = False
+                        break
                 self._flight_cv.wait(timeout=remaining)
-        return True
+        # Planned retire: replicate every live sequence into the fleet
+        # tier (timed-out drains included — stranded sequence state is
+        # exactly what the tier exists to carry).  Peer pushes run with
+        # no engine lock held.
+        fleet = self.fleet
+        if fleet is not None:
+            for snapshot in self.export_sequences():
+                try:
+                    fleet.publish_sequence(snapshot)
+                except Exception:  # pragma: no cover - defensive
+                    pass
+        return drained
 
     def _admit(self):
         """One request enters execution, or is shed with a retryable 503."""
@@ -1183,6 +1307,11 @@ class InferenceEngine:
                     trace.event("CACHE_HIT")
                 if self.qos is not None:
                     self.qos.note(tenant)
+                fleet = self.fleet
+                if fleet is not None:
+                    # hot-entry signal for proactive replication: a pure
+                    # host-side counter bump, never a peer RPC
+                    fleet.note_cache_hit(key)
                 response, blobs = cached
                 stats.record_cache_hit(time.monotonic_ns() - t0)
                 return _stamp_id(response, request), blobs
@@ -1385,6 +1514,27 @@ class InferenceEngine:
             inputs = self._gather_inputs(model, request, binary_section)
             params = request.get("parameters", {}) or {}
             context = self._sequence_context(params)
+            if context is not None:
+                if model.decoupled and (
+                    params.get("sequence_durable")
+                    or params.get("sequence_step")
+                ):
+                    # the commit path (step counter, retained rendering,
+                    # snapshot push) only exists on the unary direct
+                    # path: pretending otherwise would silently drop the
+                    # durability the client asked for
+                    raise InferenceServerException(
+                        f"{model.name}: sequence_durable/sequence_step "
+                        "apply to unary stateful models only — decoupled "
+                        "streams do not replicate sequence state",
+                        status="400",
+                    )
+                replayed = self._sequence_replay(context, params, request)
+                if replayed is not None:
+                    # duplicate declared step: answer from the retained
+                    # rendering without re-applying (exactly-once resume)
+                    stats.record_request_success(time.monotonic_ns() - t0)
+                    return replayed
             t_in1 = time.monotonic_ns()
             w_in1 = time.time_ns() if trace is not None else 0
             if model.ensemble_steps:
@@ -1473,6 +1623,10 @@ class InferenceEngine:
                 True, t1 - t0, t_inf1 - t_in1, t_in1 - t_in0, t1 - t_inf1,
                 batch=_batch_of(model, request),
             )
+            if context is not None:
+                # applied-step accounting + durable snapshot replication
+                # (peer push BEFORE the response leaves this method)
+                self._sequence_commit(context, params, rendered)
             return rendered
         except InferenceServerException:
             stats.record(False, time.monotonic_ns() - t0, 0, 0, 0)
@@ -1592,6 +1746,28 @@ class InferenceEngine:
         seq_id = params.get("sequence_id", 0)
         if not seq_id:
             return None
+        durable = bool(params.get("sequence_durable"))
+        ctx = self._sequence_context_local(seq_id, params, durable)
+        if ctx is not None:
+            return ctx
+        # Local miss mid-sequence with a fleet tier attached: the replica
+        # that held this sequence may have died, and its replicated
+        # snapshot lives in the tier.  The peer RPC runs on the REQUEST
+        # thread with no engine lock held (the PEER-CALL-UNDER-LOCK
+        # shape) and is bounded by the tier's fan-out x timeout.
+        snapshot = None
+        fleet = self.fleet
+        if fleet is not None:
+            lookup = getattr(fleet, "sequence_lookup", None)
+            if lookup is not None:
+                snapshot = lookup(seq_id)
+        return self._install_sequence(seq_id, params, durable,
+                                             snapshot)
+
+    def _sequence_context_local(self, seq_id, params, durable):
+        """Fast path under the lock: the context when it exists locally
+        (or must be created fresh), None when a fleet recovery attempt
+        should run first."""
         now = time.monotonic()
         with self._lock:
             # Expire sequences idle past the advertised
@@ -1604,13 +1780,160 @@ class InferenceEngine:
             ]
             for sid in expired:
                 del self._sequences[sid]
-            if params.get("sequence_start") or seq_id not in self._sequences:
-                self._sequences[seq_id] = SequenceContext(seq_id)
+            missing = seq_id not in self._sequences
+            if missing and not params.get("sequence_start") \
+                    and self.fleet is not None:
+                return None  # try the tier before forking fresh state
+            if params.get("sequence_start") or missing:
+                self._sequences[seq_id] = SequenceContext(
+                    seq_id, durable=durable
+                )
             ctx = self._sequences[seq_id]
+            ctx.durable = ctx.durable or durable
             ctx.last_used = now
             if params.get("sequence_end"):
                 self._sequences.pop(seq_id, None)
             return ctx
+
+    def _install_sequence(self, seq_id, params, durable, snapshot):
+        """Install the recovered (or fresh) context after a fleet lookup.
+        A context another thread installed meanwhile wins unless the
+        snapshot is strictly newer — replication must never move a
+        sequence backwards."""
+        with self._lock:
+            ctx = self._sequences.get(seq_id)
+            if snapshot is not None and (
+                ctx is None
+                or (float(snapshot.get("epoch", 0.0)),
+                    int(snapshot.get("step", 0))) > (ctx.epoch, ctx.step)
+            ):
+                ctx = SequenceContext.restore(snapshot)
+                self.metrics.inc(
+                    "ctpu_fleet_seq_resumes_total",
+                    help_=FLEET_HELP["ctpu_fleet_seq_resumes_total"],
+                )
+            elif ctx is None:
+                if durable:
+                    # a DURABLE mid-sequence request whose snapshot is
+                    # nowhere in the fleet must fail LOUDLY: executing
+                    # against a silently forked fresh context would
+                    # return wrong answers with no error — the exact
+                    # state split SequenceRestartError exists to prevent
+                    raise InferenceServerException(
+                        f"durable sequence {seq_id!r} has no local state "
+                        "and no replicated snapshot in the fleet — its "
+                        "replica died before any step was replicated; "
+                        "restart the sequence (sequence_start=True)",
+                        status="409",
+                    )
+                ctx = SequenceContext(seq_id, durable=durable)
+            ctx.durable = ctx.durable or durable
+            ctx.last_used = time.monotonic()
+            self._sequences[seq_id] = ctx
+            if params.get("sequence_end"):
+                self._sequences.pop(seq_id, None)
+            return ctx
+
+    def _sequence_replay(self, context, params, request):
+        """Idempotent duplicate-step short-circuit.
+
+        Requests may declare a monotonic ``sequence_step`` parameter
+        (1-based).  A declared step the context already applied returns
+        the retained rendering re-stamped with this request's id — the
+        retried step after a failover lands exactly once, never twice.
+        A declared step AHEAD of the applied counter means intermediate
+        steps were lost (a non-durable sequence resumed from a stale
+        snapshot): that is the state fork ``SequenceRestartError``
+        exists to prevent, so it is rejected with a restartable 409.
+        Returns None when the step is fresh and must execute."""
+        declared = params.get("sequence_step")
+        if not declared:
+            return None
+        declared = int(declared)
+        with self._lock:
+            step = context.step
+            last = context.last_response
+        if declared == step + 1:
+            return None  # the expected next step: apply it
+        if declared > step:
+            raise InferenceServerException(
+                f"sequence {context.sequence_id}: declared step {declared} "
+                f"skips ahead of the applied counter ({step}) — "
+                "intermediate steps were never applied here; restart the "
+                "sequence (sequence_start=True)",
+                status="409",
+            )
+        if last is not None and last[0] == declared:
+            response, blobs = last[1], last[2]
+            return _stamp_id(response, request), list(blobs)
+        raise InferenceServerException(
+            f"sequence {context.sequence_id}: step {declared} was already "
+            f"applied (counter at {step}) and its response is no longer "
+            "retained",
+            status="409",
+        )
+
+    def _sequence_commit(self, context, params, rendered):
+        """Advance the applied-step counter, retain the rendering for
+        idempotent replay, and — for durable sequences with a fleet tier
+        attached — push the snapshot to peer replicas BEFORE the response
+        reaches the wire: an acked step must survive this replica's
+        unplanned death.  The peer push runs with no engine lock held and
+        is bounded by the tier's fan-out x timeout x per-peer breakers
+        (an unreachable fleet degrades to local-only durability)."""
+        response, blobs = rendered
+        ended = bool(params.get("sequence_end"))
+        with self._lock:
+            context.step += 1
+            context.last_response = (
+                context.step, _strip_id(response), list(blobs),
+            )
+        fleet = self.fleet
+        if fleet is None or not context.durable:
+            return
+        if not ended:
+            # export OUTSIDE the engine lock: encoding multi-MB numpy
+            # state under the repository-wide _lock would stall every
+            # concurrent admission.  Steps of ONE sequence are serial by
+            # contract, so the context is stable while we encode.
+            fleet.publish_sequence(context.export())
+        else:
+            # the sequence is complete: peers can drop their snapshots
+            fleet.forget_sequence(context.sequence_id)
+
+    def export_sequence(self, seq_id):
+        """One live sequence's snapshot (the fleet tier's ``seq_get``
+        handler reads this so a survivor can pull live state during a
+        planned handoff), or None.  The encode runs OUTSIDE the
+        engine-wide lock (see _sequence_commit) — only the context
+        reference is taken under it."""
+        with self._lock:
+            ctx = self._sequences.get(seq_id)
+        return ctx.export() if ctx is not None else None
+
+    def export_sequences(self):
+        """Snapshots of every live sequence (the planned-drain export).
+        Encoding runs outside the lock; by drain time no request is
+        mutating these contexts."""
+        with self._lock:
+            contexts = list(self._sequences.values())
+        return [ctx.export() for ctx in contexts]
+
+    def pressure(self):
+        """Autoscaling signal: queued + in-flight work on this replica.
+        Gossiped on fleet probes (``FleetTier.local_summary``) and
+        surfaced per-endpoint through ``EndpointPool.pressures()``."""
+        with self._flight_cv:
+            inflight = self._inflight
+        with self._lock:
+            batchers = list(self._batchers.values())
+        depth = 0
+        for batcher in batchers:
+            try:
+                depth += batcher.queue_depth()
+            except Exception:  # pragma: no cover - defensive
+                pass
+        return {"queue_depth": depth + inflight, "inflight": inflight}
 
     def _gather_inputs(self, model, request, binary_section):
         """Resolve request inputs to arrays.

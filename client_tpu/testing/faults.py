@@ -89,6 +89,15 @@ class FaultProxy:
             for sock in pair:
                 _hard_close(sock)
 
+    def sigkill(self):
+        """The SIGKILL shape as seen from the network: every live
+        connection dies with an RST and every new one is refused — what
+        a process kill (plus the kernel reaping its sockets) looks like
+        to clients.  The upstream server process itself is untouched;
+        pair with stopping it (without drain) for full fidelity."""
+        self.refuse_connections(True)
+        self.kill_active()
+
     def close(self):
         with self._lock:
             self._closed = True
@@ -102,37 +111,44 @@ class FaultProxy:
     # -- data path ----------------------------------------------------------
 
     def _serve(self):
+        # one guard over the whole accept pass (the BG-THREAD-CRASH
+        # shape): a chaos proxy whose accept thread dies silently turns
+        # every scenario into a refused-connection test
         while True:
             try:
                 conn, _ = self._srv.accept()
             except OSError:  # listener closed
                 return
-            with self._lock:
-                if self._closed:
+            try:
+                with self._lock:
+                    if self._closed:
+                        _hard_close(conn)
+                        return
+                    self.connections += 1
+                    reset = self._refuse
+                    if self._reset_next > 0:
+                        self._reset_next -= 1
+                        reset = True
+                    delay = self._delay_s
+                    # a reset connection must not consume a truncation
+                    # plan: the plan applies to the next connection that
+                    # bridges
+                    budget = (
+                        self._cut_plans.pop(0)
+                        if self._cut_plans and not reset
+                        else None
+                    )
+                if reset:
                     _hard_close(conn)
-                    return
-                self.connections += 1
-                reset = self._refuse
-                if self._reset_next > 0:
-                    self._reset_next -= 1
-                    reset = True
-                delay = self._delay_s
-                # a reset connection must not consume a truncation plan:
-                # the plan applies to the next connection that bridges
-                budget = (
-                    self._cut_plans.pop(0)
-                    if self._cut_plans and not reset
-                    else None
-                )
-            if reset:
+                    continue
+                threading.Thread(
+                    target=self._bridge,
+                    args=(conn, delay, budget),
+                    name="fault-proxy-conn",
+                    daemon=True,
+                ).start()
+            except Exception:
                 _hard_close(conn)
-                continue
-            threading.Thread(
-                target=self._bridge,
-                args=(conn, delay, budget),
-                name="fault-proxy-conn",
-                daemon=True,
-            ).start()
 
     def _bridge(self, conn, delay, budget):
         if delay:

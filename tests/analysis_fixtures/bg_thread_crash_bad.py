@@ -1,0 +1,41 @@
+"""BG-THREAD-CRASH fixtures — the silently-dying background thread.
+
+Freezes the endpoint-pool prober incident shape: a service loop spawned
+as a ``threading.Thread`` target whose body can raise (here: tuple
+unpack of an arbitrary probe result) with no top-level guard.  One
+malformed result ends the thread; probing stops forever; nothing
+surfaces anywhere.
+"""
+
+import threading
+
+
+class Prober:
+    def __init__(self, probe, interval_s=1.0):
+        self._probe = probe
+        self._interval_s = interval_s
+        self._stop = threading.Event()
+        self.states = {}
+
+    def start(self):
+        threading.Thread(target=self._probe_loop, daemon=True).start()
+
+    def _probe_loop(self):
+        while not self._stop.is_set():  # BAD: unpack can raise; loop dies
+            state, summary = self._probe("replica")
+            self.states["replica"] = state
+            self.states["summary"] = summary
+            if self._stop.wait(self._interval_s):
+                return
+
+
+def serve_forever(sock, handle):
+    while True:  # BAD: a bad frame kills the accept loop silently
+        conn, _ = sock.accept()
+        handle(conn)
+
+
+def start_server(sock, handle):
+    thread = threading.Thread(target=serve_forever, args=(sock, handle))
+    thread.start()
+    return thread

@@ -1,0 +1,69 @@
+"""BG-THREAD-CRASH clean fixtures — guarded service loops.
+
+Every shape here must stay finding-free: a loop whose whole body is one
+``try``, a loop nested inside a ``try``, the ``if stop.wait(): return``
+sleep shape beside a ``try``, a bounded ``for`` driver, and a loop-less
+one-shot worker.
+"""
+
+import threading
+
+
+class GuardedProber:
+    def __init__(self, probe, interval_s=1.0):
+        self._probe = probe
+        self._interval_s = interval_s
+        self._stop = threading.Event()
+        self.states = {}
+
+    def start(self):
+        threading.Thread(target=self._probe_loop, daemon=True).start()
+
+    def _probe_loop(self):
+        # OK: the whole body is one try; a broken probe result degrades
+        # instead of killing the thread
+        while not self._stop.is_set():
+            try:
+                state, summary = self._probe("replica")
+                self.states["replica"] = state
+                self.states["summary"] = summary
+            except Exception:
+                pass
+            if self._stop.wait(self._interval_s):
+                return
+
+
+class OuterGuard:
+    def start(self):
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        try:
+            while True:  # OK: the loop itself sits under a try
+                self._tick()
+        except Exception:
+            self._closed = True
+
+    def _tick(self):
+        pass
+
+
+class BoundedDriver:
+    def start(self):
+        threading.Thread(target=self._drive, daemon=True).start()
+
+    def _drive(self):
+        for i in range(100):  # OK: bounded for-driver, not a service loop
+            self._step(i)
+
+    def _step(self, i):
+        pass
+
+
+def one_shot(conn):
+    data = conn.recv(1024)  # OK: no loop at all
+    conn.sendall(data)
+
+
+def spawn(conn):
+    threading.Thread(target=one_shot, args=(conn,), daemon=True).start()

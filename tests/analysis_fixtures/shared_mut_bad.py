@@ -13,11 +13,14 @@ class Batcher:
 
     def _loop(self):
         while True:
-            with self._cv:
-                while not self._backlog:
-                    self._cv.wait()
-                batch, self._backlog = self._backlog, []
-            self._dispatch(batch)
+            try:
+                with self._cv:
+                    while not self._backlog:
+                        self._cv.wait()
+                    batch, self._backlog = self._backlog, []
+                self._dispatch(batch)
+            except Exception:
+                pass
 
     def _dispatch(self, batch):
         pass

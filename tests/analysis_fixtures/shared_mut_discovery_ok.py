@@ -13,10 +13,13 @@ class EndpointPool:
 
     def _probe_loop(self):
         while True:
-            with self._lock:
-                members = list(self._endpoints)
-            for url in members:
-                self._probe(url)
+            try:
+                with self._lock:
+                    members = list(self._endpoints)
+                for url in members:
+                    self._probe(url)
+            except Exception:
+                pass
 
     def _probe(self, url):
         pass

@@ -14,13 +14,16 @@ class Batcher:
 
     def _loop(self):
         while True:
-            with self._cv:
-                while not self._backlog and not self._closed:
-                    self._cv.wait()
-                if self._closed:
-                    return
-                batch, self._backlog = self._backlog, []
-            self._dispatch(batch)
+            try:
+                with self._cv:
+                    while not self._backlog and not self._closed:
+                        self._cv.wait()
+                    if self._closed:
+                        return
+                    batch, self._backlog = self._backlog, []
+                self._dispatch(batch)
+            except Exception:
+                pass
 
     def _dispatch(self, batch):
         pass

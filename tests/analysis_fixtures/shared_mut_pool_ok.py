@@ -14,11 +14,14 @@ class EndpointPool:
 
     def _probe_loop(self):
         while True:
-            with self._lock:
-                if self._draining:
-                    return
-                snapshot = dict(self._states)
-            self._refresh(snapshot)
+            try:
+                with self._lock:
+                    if self._draining:
+                        return
+                    snapshot = dict(self._states)
+                self._refresh(snapshot)
+            except Exception:
+                pass
 
     def _refresh(self, snapshot):
         pass

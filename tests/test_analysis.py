@@ -214,6 +214,25 @@ def test_refcount_pair_clean():
     assert _scan("refcount_pair_ok.py") == []
 
 
+def test_bg_thread_crash_hits():
+    """The silently-dying background thread (the endpoint-pool prober
+    incident shape): a Thread-registered service loop whose body can
+    raise with no top-level guard — method target AND bare-name target."""
+    findings = _scan("bg_thread_crash_bad.py")
+    assert _rules_hit(findings) == ["BG-THREAD-CRASH"]
+    assert len(findings) == 2
+    messages = " ".join(f.message for f in findings)
+    assert "_probe_loop()" in messages and "serve_forever()" in messages
+    assert "kills the thread silently" in findings[0].message
+
+
+def test_bg_thread_crash_clean():
+    """Guarded shapes stay silent: whole-body try, loop under an outer
+    try, the stop.wait sleep shape, bounded for-drivers, loop-less
+    one-shot workers."""
+    assert _scan("bg_thread_crash_ok.py") == []
+
+
 def test_time_wall_hits():
     findings = _scan("time_wall_bad.py")
     assert _rules_hit(findings) == ["TIME-WALL"]
@@ -880,6 +899,7 @@ def test_cli_fails_on_each_seeded_bad_fixture():
         ("callback_under_lock_bad.py", "CALLBACK-UNDER-LOCK"),
         ("bare_suppress_bad.py", "BARE-SUPPRESS"),
         ("refcount_pair_bad.py", "REFCOUNT-PAIR"),
+        ("bg_thread_crash_bad.py", "BG-THREAD-CRASH"),
     ):
         proc = _cli(
             f"tests/analysis_fixtures/{name}", "--no-baseline", "--no-cache"

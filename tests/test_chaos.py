@@ -1,0 +1,712 @@
+"""Chaos-matrix acceptance: fault-domain hardening end to end.
+
+Three layers under test:
+
+1. the harness itself (``client_tpu/testing/chaos.py``): seeded
+   deterministic schedules, the exactly-once step ledger, fault
+   dispatch, driver error/wedge collection;
+2. replicated sequence state at the engine level: durable
+   ``SequenceContext`` snapshots push to peers at each applied step,
+   a survivor resumes them (stale rejected, duplicate steps replayed
+   idempotently, step gaps rejected);
+3. the two fleet acceptances as one-scenario matrix entries:
+   - **SIGKILL with active durable sequences** — three real HTTP servers
+     behind chaos proxies, a sticky ``ReplicatedClient`` driving durable
+     sequences, replica 0 SIGKILLed mid-sequence: every sequence resumes
+     byte-exact on a survivor, zero client-visible errors, no
+     ``(sequence, step)`` applied twice (orphaned applies on the corpse
+     excepted);
+   - **anti-entropy convergence** — hot prefix chains proactively pushed
+     to peers survive replica 0's SIGKILL: the dead replica's chains are
+     retrievable from survivors and save prefill there, byte-exact.
+
+``make soak`` repeats the slow-marked scaled variants.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from client_tpu.balance.replicated import ReplicatedClient
+from client_tpu.serve import InferenceEngine, Model, Server, TensorSpec
+from client_tpu.serve.fleet import FleetTier
+from client_tpu.serve.lm import LmEngine
+from client_tpu.serve.metrics import Registry
+from client_tpu.serve.models import transformer as tfm
+from client_tpu.testing.chaos import (
+    ChaosMatrix,
+    ChaosScenario,
+    FaultSpec,
+    StepLedger,
+    assert_byte_exact,
+    assert_kv_clean,
+    dispatch_fault,
+    run_scenario,
+)
+from client_tpu.testing.faults import FaultProxy
+
+CLOSE = LmEngine.CLOSE
+
+
+def _tier(**kwargs):
+    kwargs.setdefault("gossip_interval_s", 0)
+    return FleetTier(**kwargs).start()
+
+
+def _peer_up(tiers):
+    for tier in tiers:
+        tier.set_peers([t.address for t in tiers if t is not tier])
+
+
+def _seq_model(ledger, replica, name="chaos_sequence"):
+    """Stateful accumulator that records every APPLIED step into the
+    ledger — idempotent replays served from the retained rendering never
+    reach this function, which is exactly what the exactly-once checker
+    verifies."""
+
+    def fn(inputs, params, ctx):
+        value = inputs["INPUT"]
+        if ctx is None:
+            return {"OUTPUT": value}
+        if params.get("sequence_start") or "acc" not in ctx.state:
+            ctx.state["acc"] = np.zeros_like(value)
+        ctx.state["acc"] = ctx.state["acc"] + value
+        ledger.record(ctx.sequence_id, ctx.step + 1, replica)
+        return {"OUTPUT": ctx.state["acc"].copy()}
+
+    return Model(
+        name,
+        inputs=[TensorSpec("INPUT", "INT32", [1])],
+        outputs=[TensorSpec("OUTPUT", "INT32", [1])],
+        fn=fn,
+        stateful=True,
+    )
+
+
+def _seq_request(value, sid, step, start=False, end=False, durable=True):
+    return {
+        "id": f"s{sid}-{step}",
+        "inputs": [{
+            "name": "INPUT", "shape": [1], "datatype": "INT32",
+            "data": [int(value)],
+        }],
+        "parameters": {
+            "sequence_id": sid,
+            "sequence_start": bool(start),
+            "sequence_end": bool(end),
+            "sequence_durable": bool(durable),
+            "sequence_step": int(step),
+        },
+    }
+
+
+def _out_value(response):
+    return int(response["outputs"][0]["data"][0])
+
+
+# -- harness units ----------------------------------------------------------
+
+def test_scenario_schedule_is_seed_deterministic():
+    faults = [
+        FaultSpec("kill_replica", at_s=("uniform", 0.1, 0.9), target=0),
+        FaultSpec("refuse", at_s=0.05, target=1),
+    ]
+    a = ChaosScenario("s", faults, seed=42).schedule()
+    b = ChaosScenario("s", faults, seed=42).schedule()
+    c = ChaosScenario("s", faults, seed=43).schedule()
+    assert [t for t, _ in a] == [t for t, _ in b]  # same seed, same times
+    assert [t for t, _ in a] != [t for t, _ in c]  # different seed differs
+    assert a[0][1].kind == "refuse"  # sorted by time
+    assert 0.1 <= a[1][0] <= 0.9
+    with pytest.raises(ValueError):
+        ChaosScenario(
+            "bad", [FaultSpec("refuse", at_s=("gauss", 0, 1))]
+        ).schedule()
+
+
+def test_step_ledger_exactly_once_semantics():
+    ledger = StepLedger()
+    ledger.record(1, 1, "r0")
+    ledger.record(1, 2, "r0")
+    ledger.record(1, 3, "r0")   # applied on r0 but unacked: r0 dies
+    ledger.record(1, 3, "r1")   # survivor re-applies from the snapshot
+    ledger.record(1, 4, "r1")
+    ledger.assert_exactly_once(orphans={"r0"})  # the resume carve-out
+    with pytest.raises(AssertionError):
+        ledger.assert_exactly_once()  # without the orphan: a duplicate
+    assert ledger.steps_for(1) == [1, 2, 3, 4]
+    # duplicates on one replica always fail, orphaned or not
+    dup = StepLedger()
+    dup.record(7, 1, "r0")
+    dup.record(7, 1, "r0")
+    with pytest.raises(AssertionError):
+        dup.assert_exactly_once(orphans={"r0"})
+    # a re-apply whose predecessor ran on a SURVIVOR always fails
+    forked = StepLedger()
+    forked.record(9, 2, "r1")
+    forked.record(9, 2, "r2")
+    with pytest.raises(AssertionError):
+        forked.assert_exactly_once(orphans={"r0"})
+
+
+def test_run_scenario_collects_errors_and_wedges():
+    gate = threading.Event()
+
+    def ok():
+        gate.wait(timeout=10)
+
+    def boom():
+        raise RuntimeError("driver died")
+
+    scenario = ChaosScenario(
+        "units", [FaultSpec("custom", at_s=0.0, fn=gate.set)]
+    )
+    result = run_scenario(scenario, lambda f: dispatch_fault(f), [ok, boom])
+    assert result.wedged == 0
+    assert len(result.errors) == 1 and result.errors[0][0] == 1
+    with pytest.raises(AssertionError):
+        result.assert_clean()
+    # a driver that outlives the join timeout is reported wedged
+    slow = threading.Event()
+    try:
+        result = run_scenario(
+            ChaosScenario("wedge"), lambda f: None,
+            [lambda: slow.wait(timeout=5)], join_timeout_s=0.1,
+        )
+        assert result.wedged == 1
+    finally:
+        slow.set()
+
+
+def test_dispatch_fault_drives_a_fault_proxy():
+    import socket
+
+    upstream = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    upstream.bind(("127.0.0.1", 0))
+    upstream.listen(4)
+    proxy = FaultProxy("%s:%d" % upstream.getsockname()[:2])
+    try:
+        host, _, port = proxy.address.rpartition(":")
+        dispatch_fault(FaultSpec("refuse", target=0), proxies=[proxy])
+        # the refused connection dies at accept: either the RST raises
+        # or the FIN half of the hard close races it and reads as EOF
+        try:
+            data = socket.create_connection(
+                (host, int(port)), timeout=2
+            ).recv(1)
+            assert data == b"", "refused connection served data"
+        except OSError:
+            pass
+        dispatch_fault(FaultSpec("restore", target=0), proxies=[proxy])
+        sock = socket.create_connection((host, int(port)), timeout=2)
+        sock.close()
+        killed = []
+        dispatch_fault(
+            FaultSpec("kill_replica", target=0), proxies=[proxy],
+            kill=killed.append,
+        )
+        assert killed == [0]  # sigkill + the kill hook both fired
+        with pytest.raises(ValueError):
+            dispatch_fault(FaultSpec("martian"), proxies=[proxy])
+    finally:
+        proxy.close()
+        upstream.close()
+
+
+# -- replicated sequence state at the engine level --------------------------
+
+def test_durable_sequence_resumes_on_survivor_engine():
+    """The tentpole's core path without HTTP in the way: durable steps
+    applied on engine A replicate to B's tier; after A's death B resumes
+    the sequence byte-exact, replays the duplicate step idempotently,
+    and rejects a step gap with a restartable 409."""
+    ledger = StepLedger()
+    tier_a, tier_b = _tier(replicate_k=1), _tier(replicate_k=1)
+    _peer_up([tier_a, tier_b])
+    eng_a = InferenceEngine(
+        models=[_seq_model(ledger, "rA")], fleet=tier_a
+    )
+    eng_b = InferenceEngine(
+        models=[_seq_model(ledger, "rB")], fleet=tier_b
+    )
+    try:
+        sid, total = 31, 0
+        for step, value in enumerate((3, 1, 4), start=1):
+            total += value
+            response, _ = eng_a.execute(
+                "chaos_sequence", "",
+                _seq_request(value, sid, step, start=(step == 1)), b"",
+            )
+            assert _out_value(response) == total
+        # each applied step pushed a snapshot before responding
+        assert tier_a.stats()["seq_pushes"] == 3
+        snap = tier_b.seq_store.get(sid)
+        assert snap is not None and snap["step"] == 3
+        # A dies unplanned (no drain): B sees step 4 for a sequence it
+        # never met, recovers the snapshot from its tier, and continues
+        tier_a.close()
+        response, _ = eng_b.execute(
+            "chaos_sequence", "", _seq_request(5, sid, 4), b"",
+        )
+        assert _out_value(response) == total + 5
+        assert eng_b.metrics.get("ctpu_fleet_seq_resumes_total") == 1
+        # the duplicate declared step replays from the retained
+        # rendering — same bytes, NO second apply in the ledger
+        replay, _ = eng_b.execute(
+            "chaos_sequence", "", _seq_request(5, sid, 4), b"",
+        )
+        assert _out_value(replay) == total + 5
+        ledger.assert_exactly_once()
+        assert ledger.steps_for(sid) == [1, 2, 3, 4]
+        # a declared step AHEAD of the counter is the lost-steps fork:
+        # restartable 409, never a silent wrong-state apply
+        from client_tpu.utils import InferenceServerException
+
+        with pytest.raises(InferenceServerException) as exc:
+            eng_b.execute(
+                "chaos_sequence", "", _seq_request(9, sid, 7), b"",
+            )
+        assert exc.value.status() == "409"
+    finally:
+        eng_a.close()
+        eng_b.close()
+        tier_a.close()
+        tier_b.close()
+
+
+def test_sequence_snapshots_reject_stale_and_fork_failed_lookup():
+    """Staleness + miss behavior: an older snapshot never overwrites a
+    newer one, and with no tier hit a mid-sequence miss falls back to a
+    fresh context (today's non-durable semantics, preserved)."""
+    ledger = StepLedger()
+    tier = _tier()
+    engine = InferenceEngine(models=[_seq_model(ledger, "r")], fleet=tier)
+    try:
+        engine.execute(
+            "chaos_sequence", "", _seq_request(2, 5, 1, start=True), b"",
+        )
+        engine.execute("chaos_sequence", "", _seq_request(3, 5, 2), b"")
+        newer = engine.export_sequence(5)
+        assert newer["step"] == 2
+        older = dict(newer)
+        older["step"] = 1
+        assert tier.seq_store.put(newer) is True
+        assert tier.seq_store.put(older) is False  # stale rejected
+        assert tier.seq_store.get(5)["step"] == 2
+        assert tier.stats()["seq_stale_rejected"] == 1
+        # unknown sequence, tier miss: fresh context (state forks only
+        # when there is genuinely nothing to recover)
+        response, _ = engine.execute(
+            "chaos_sequence", "",
+            _seq_request(7, 404, 1, durable=False), b"",
+        )
+        assert _out_value(response) == 7
+    finally:
+        engine.close()
+        tier.close()
+
+
+def test_restarted_sequence_epoch_beats_stale_incarnation():
+    """A restarted sequence id is a NEW incarnation: its fresh epoch
+    must overwrite the dead incarnation's higher-step snapshots on
+    peers — and a reachable peer that REJECTS a snapshot as stale must
+    not count as a durability ack."""
+    ledger = StepLedger()
+    tier_a, tier_b = _tier(replicate_k=1), _tier(replicate_k=1)
+    _peer_up([tier_a, tier_b])
+    eng_a = InferenceEngine(models=[_seq_model(ledger, "rA")],
+                            fleet=tier_a)
+    eng_b = InferenceEngine(models=[_seq_model(ledger, "rB")],
+                            fleet=tier_b)
+    try:
+        sid = 77
+        for step in range(1, 4):
+            eng_a.execute(
+                "chaos_sequence", "",
+                _seq_request(step, sid, step, start=(step == 1)), b"",
+            )
+        old = tier_b.seq_store.get(sid)
+        assert old is not None and old["step"] == 3
+        # the client restarts the id (the 409 contract) on replica B:
+        # fresh incarnation, step 1 — its snapshot must REPLACE the old
+        # incarnation's step-3 leftovers wherever they live
+        response, _ = eng_b.execute(
+            "chaos_sequence", "", _seq_request(9, sid, 1, start=True), b"",
+        )
+        assert _out_value(response) == 9
+        fresh = eng_b.export_sequence(sid)
+        assert fresh["epoch"] > old["epoch"]
+        assert tier_b.seq_store.put(dict(fresh)) is True  # overwrites
+        stored = tier_b.seq_store.get(sid)
+        assert stored["step"] == 1 and stored["epoch"] == fresh["epoch"]
+        # the OLD incarnation arriving late (gossip race) is now stale
+        assert tier_b.seq_store.put(dict(old)) is False
+        # a peer that rejects as stale is NOT a durability ack
+        assert tier_a.publish_sequence(dict(old)) == 0
+        # and a resume restores the NEW incarnation, not the corpse's
+        restored = tier_b.seq_store.get(sid)
+        assert restored["epoch"] == fresh["epoch"]
+    finally:
+        eng_a.close()
+        eng_b.close()
+        tier_a.close()
+        tier_b.close()
+
+
+def test_drain_exports_sequences_to_the_tier():
+    """Planned retire: every live sequence's snapshot lands on a peer
+    even when it was never marked durable — drain is free durability."""
+    ledger = StepLedger()
+    tier_a, tier_b = _tier(), _tier()
+    _peer_up([tier_a, tier_b])
+    engine = InferenceEngine(models=[_seq_model(ledger, "rA")],
+                             fleet=tier_a)
+    try:
+        engine.execute(
+            "chaos_sequence", "",
+            _seq_request(4, 11, 1, start=True, durable=False), b"",
+        )
+        assert tier_b.seq_store.get(11) is None  # not durable: no push yet
+        assert engine.drain(timeout_s=5) is True
+        snap = tier_b.seq_store.get(11)
+        assert snap is not None and snap["step"] == 1
+    finally:
+        engine.close()
+        tier_a.close()
+        tier_b.close()
+
+
+# -- acceptance 1: three-replica SIGKILL with active durable sequences ------
+
+class _SeqChaosFixture:
+    """Three HTTP servers behind chaos proxies, one sticky replicated
+    client, N durable sequences as drivers.  ``check`` asserts the
+    scenario's cross-cutting invariants."""
+
+    MODEL = "chaos_sequence"
+
+    def __init__(self, scenario):
+        self.scenario = scenario
+        self.ledger = StepLedger()
+        self.sessions = int(scenario.params.get("sessions", 6))
+        self.steps = int(scenario.params.get("steps", 8))
+        self.think_s = float(scenario.params.get("think_s", 0.04))
+        rng = scenario.rng()
+        self.values = [
+            [rng.randrange(1, 9) for _ in range(self.steps)]
+            for _ in range(self.sessions)
+        ]
+        self.delivered = [[] for _ in range(self.sessions)]
+        self.tiers = [
+            _tier(replicate_k=1, fan_out=2, lookup_timeout_s=0.5)
+            for _ in range(3)
+        ]
+        _peer_up(self.tiers)
+        self.servers = []
+        self.proxies = []
+        for i, tier in enumerate(self.tiers):
+            server = Server(
+                models=[_seq_model(self.ledger, f"r{i}")],
+                with_default_models=False, fleet=tier,
+            ).start()
+            self.servers.append(server)
+            self.proxies.append(FaultProxy(server.http_address))
+        self.client = ReplicatedClient(
+            [proxy.address for proxy in self.proxies],
+            transport="http", policy="sticky", probe_interval_s=0.5,
+        )
+
+    def apply_fault(self, fault):
+        dispatch_fault(fault, proxies=self.proxies, kill=self._kill)
+
+    def _kill(self, target):
+        # SIGKILL semantics: connections RST, listener refused (the
+        # proxy's sigkill already ran), and the server stops WITHOUT
+        # drain — its sequence state and caches die with it.  Only the
+        # snapshots it pushed at each applied step survive.
+        self.servers[target].stop()
+
+    def drivers(self):
+        from client_tpu.http import InferInput
+
+        def driver(index):
+            sid = 1000 + index
+            expected = 0
+            for step in range(1, self.steps + 1):
+                value = self.values[index][step - 1]
+                expected += value
+                inp = InferInput("INPUT", [1], "INT32")
+                inp.set_data_from_numpy(np.array([value], np.int32))
+                result = self.client.infer(
+                    self.MODEL, [inp],
+                    sequence_id=sid,
+                    sequence_start=(step == 1),
+                    sequence_end=(step == self.steps),
+                    sequence_durable=True,
+                    sequence_step=step,
+                )
+                got = int(result.as_numpy("OUTPUT")[0])
+                assert got == expected, (
+                    f"sequence {sid} step {step}: got {got}, "
+                    f"want {expected} — resumed state diverged"
+                )
+                self.delivered[index].append(got)
+                time.sleep(self.think_s)
+
+        return [
+            (lambda i=i: driver(i)) for i in range(self.sessions)
+        ]
+
+    def check(self, result):
+        result.assert_clean()  # zero client-visible errors, no wedges
+        # byte-exact: every session saw the exact running-sum series
+        for index in range(self.sessions):
+            want = list(np.cumsum(self.values[index]))
+            assert_byte_exact(
+                self.delivered[index], want, label=f"sequence {1000 + index}"
+            )
+        # exactly-once: no (sequence, step) applied twice — applies
+        # orphaned on the SIGKILLed replica (applied but never acked /
+        # never replicated) are superseded by the survivor's resume
+        self.ledger.assert_exactly_once(orphans={"r0"})
+        for index in range(self.sessions):
+            assert self.ledger.steps_for(1000 + index) == list(
+                range(1, self.steps + 1)
+            )
+        # the kill actually hit live state: replica 0 had applied steps,
+        # and every sequence that CROSSED the kill (applies on r0 AND on
+        # a survivor) resumed from a replicated snapshot — a fork to
+        # fresh state would already have failed the byte-exact check,
+        # and a crossing with zero resumes means the tier never served
+        replicas = {r for _s, _p, r, _t in self.ledger.applies()}
+        assert "r0" in replicas, "replica 0 never served — kill proved nothing"
+        crossed = {
+            sid
+            for sid, _step, replica, _t in self.ledger.applies()
+            if replica == "r0"
+        } & {
+            sid
+            for sid, _step, replica, _t in self.ledger.applies()
+            if replica != "r0"
+        }
+        resumes = sum(
+            server.engine.metrics.get("ctpu_fleet_seq_resumes_total") or 0
+            for server in self.servers[1:]
+        )
+        if self.scenario.params.get("require_resume"):
+            # the deterministic acceptance pins its timing so sequences
+            # MUST straddle the kill; randomized-timing soak scenarios
+            # may legitimately kill after r0's sequences completed
+            assert crossed, "no sequence straddled the kill"
+        if crossed:
+            assert resumes > 0, (
+                f"{len(crossed)} sequence(s) crossed the kill but none "
+                "resumed from a replicated snapshot"
+            )
+        pushes = sum(t.stats()["seq_pushes"] for t in self.tiers)
+        assert pushes > 0
+
+    def close(self):
+        self.client.close()
+        for proxy in self.proxies:
+            proxy.close()
+        for server in self.servers[1:]:
+            server.stop()
+        for tier in self.tiers[1:]:
+            tier.close()
+        self.tiers[0].close()
+
+
+def _seq_sigkill_scenario(name, sessions, steps, at_s, seed=7, **extra):
+    return ChaosScenario(
+        name,
+        [FaultSpec("kill_replica", at_s=at_s, target=0)],
+        seed=seed, sessions=sessions, steps=steps, **extra,
+    )
+
+
+def test_sigkill_with_active_durable_sequences():
+    matrix = ChaosMatrix([
+        _seq_sigkill_scenario("seq-sigkill", sessions=5, steps=8,
+                              at_s=0.35, think_s=0.08,
+                              require_resume=True),
+    ])
+    results = matrix.run(_SeqChaosFixture, join_timeout_s=180)
+    assert results[0].fired, "the kill never fired"
+
+
+@pytest.mark.slow
+def test_sigkill_durable_sequences_soak():
+    """Scaled matrix for `make soak`: more sessions, longer sequences,
+    randomized kill timing — repetition over seeds is what finds the
+    apply/publish/ack window races."""
+    matrix = ChaosMatrix([
+        _seq_sigkill_scenario(f"seq-sigkill-{seed}", sessions=8, steps=12,
+                              at_s=("uniform", 0.3, 0.9), seed=seed,
+                              think_s=0.1)
+        for seed in (11, 23)
+    ])
+    matrix.run(_SeqChaosFixture, join_timeout_s=300)
+
+
+# -- acceptance 2: anti-entropy convergence under SIGKILL -------------------
+
+CFG = tfm.TransformerConfig(
+    vocab_size=128,
+    d_model=64,
+    n_layers=2,
+    n_heads=4,
+    n_kv_heads=2,
+    d_ff=128,
+    max_seq=96,
+    dtype="float32",
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return tfm.init_params(jax.random.PRNGKey(0), CFG)
+
+
+def _serial(params, prompt, n):
+    return list(tfm.generate(params, CFG, prompt, n, readback_depth=0))
+
+
+def _collect(q, timeout=120):
+    out = []
+    while True:
+        tok = q.get(timeout=timeout)
+        if tok is CLOSE:
+            return out
+        out.append(tok)
+
+
+class _AntiEntropyFixture:
+    """Three in-process LM replicas; replica 0 serves a hot shared
+    prefix whose chain the anti-entropy loop pushes to peers; replica 0
+    is then SIGKILLed and the sessions run on survivors — the chain must
+    be retrievable from peers and save prefill there."""
+
+    def __init__(self, scenario, params):
+        self.scenario = scenario
+        self.params = params
+        self.shared = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8, 9, 7, 9, 3]
+        self.n_sessions = int(scenario.params.get("sessions", 4))
+        self.budget = int(scenario.params.get("budget", 6))
+        self.killed = threading.Event()
+        self.tiers = [
+            _tier(replicate_k=2, hot_hits=2, fan_out=2)
+            for _ in range(3)
+        ]
+        _peer_up(self.tiers)
+        self.engines = [
+            LmEngine(params, CFG, max_slots=2, lane_counts=(2,),
+                     block_size=8, prefill_chunk=16, min_bucket=4,
+                     registry=Registry(), fleet=tier)
+            for tier in self.tiers
+        ]
+        self.outputs = [None] * self.n_sessions
+        # replica 0 serves the shared prefix HOT (re-publishes past the
+        # first insert bump the chain's demand counter to hot_hits=2),
+        # then the anti-entropy pass pushes the chain to both peers —
+        # all BEFORE the kill, which is the entire point: pull-only
+        # tiers lose content a dead replica never served to a peer
+        for _ in range(3):
+            _collect(self.engines[0].submit(self.shared + [99], 2)[0])
+        pushed = self.tiers[0].replicate_now()
+        assert pushed >= 1, "hot chain never replicated"
+
+    def apply_fault(self, fault):
+        dispatch_fault(fault, kill=self._kill)
+
+    def _kill(self, target):
+        self.killed.set()
+        self.engines[target].close()
+        self.tiers[target].close()
+
+    def drivers(self):
+        def driver(index):
+            prompt = self.shared + [10 + index] * 3
+            # spread sessions over the fleet; a session landing on the
+            # corpse hops to a survivor (the client-side failover shape)
+            order = [
+                self.engines[(index + hop) % 3] for hop in range(3)
+            ]
+            for _attempt in range(6):
+                engine = next(
+                    e for e in order
+                    if not (e is self.engines[0] and self.killed.is_set())
+                )
+                try:
+                    got = _collect(
+                        engine.submit(prompt, self.budget)[0]
+                    )
+                except Exception:
+                    continue  # engine closed mid-submit: hop
+                if len(got) >= self.budget:
+                    self.outputs[index] = got
+                    return
+            raise AssertionError(f"session {index} never completed")
+
+        return [(lambda i=i: driver(i)) for i in range(self.n_sessions)]
+
+    def check(self, result):
+        result.assert_clean()
+        # the killed replica's hot chain is retrievable from BOTH peers
+        for tier in self.tiers[1:]:
+            got = tier.store.lookup(np.asarray(self.shared), 8, 2,
+                                    count_hits=False)
+            assert got is not None and got[0] == 2, (
+                "killed replica's hot chain not on this survivor"
+            )
+        # byte-exact on survivors
+        for index in range(self.n_sessions):
+            prompt = self.shared + [10 + index] * 3
+            assert_byte_exact(
+                self.outputs[index],
+                _serial(self.params, prompt, self.budget),
+                label=f"session {index}",
+            )
+        # and the replicated chain actually saved prefill somewhere: a
+        # survivor either adopted peer blocks or hit its local trie on
+        # the shared prefix
+        saved = 0
+        for engine in self.engines[1:]:
+            saved += engine.fleet_stats()["remote_blocks"]
+            saved += engine.prefix_stats().get("hits", 0)
+        assert saved > 0, "replicated chain never saved any prefill"
+
+    def close(self):
+        for engine in self.engines[1:]:
+            engine.close()
+        for tier in self.tiers[1:]:
+            tier.close()
+        for engine in self.engines[1:]:
+            assert_kv_clean(engine)
+
+
+def test_anti_entropy_survives_sigkill(params):
+    scenario = ChaosScenario(
+        "anti-entropy",
+        [FaultSpec("kill_replica", at_s=0.1, target=0)],
+        seed=3, sessions=4, budget=6,
+    )
+    matrix = ChaosMatrix([scenario])
+    matrix.run(lambda s: _AntiEntropyFixture(s, params),
+               join_timeout_s=300)
+
+
+@pytest.mark.slow
+def test_anti_entropy_sigkill_soak(params):
+    scenario = ChaosScenario(
+        "anti-entropy-soak",
+        [FaultSpec("kill_replica", at_s=("uniform", 0.05, 0.5), target=0)],
+        seed=17, sessions=8, budget=10,
+    )
+    ChaosMatrix([scenario]).run(
+        lambda s: _AntiEntropyFixture(s, params), join_timeout_s=600,
+    )

@@ -717,6 +717,30 @@ class TestStickyPolicy:
         again = policy.pick(survivors, {"sequence_id": 5})
         assert again.url == err.new_endpoint
 
+    def test_durable_sequence_remaps_silently(self):
+        """A durable sequence's replica death never surfaces: its
+        server-side state replicates through the fleet tier's sequence
+        lane, so the remap is silent — the survivor rebuilds the context
+        from a peer snapshot on first touch instead of forcing the
+        client to restart (SequenceRestartError stays the non-durable
+        contract)."""
+        eps = _eps(3)
+        policy = Sticky()
+        ctx = {"sequence_id": 6, "sequence_durable": True}
+        pinned = policy.pick(eps, ctx)
+        survivors = [e for e in eps if e is not pinned]
+        remapped = policy.pick(survivors, ctx)  # no raise
+        assert remapped in survivors
+        # the remap sticks for the rest of the sequence
+        for _ in range(3):
+            assert policy.pick(survivors, ctx) is remapped
+        # the same death without the durable marker still raises
+        bare = Sticky()
+        pinned = bare.pick(eps, {"sequence_id": 7})
+        survivors = [e for e in eps if e is not pinned]
+        with pytest.raises(SequenceRestartError):
+            bare.pick(survivors, {"sequence_id": 7})
+
     def test_sequence_start_keeps_healthy_mapping(self):
         eps = _eps(3)
         policy = Sticky()

@@ -134,6 +134,11 @@ def test_peer_prefix_and_summary_roundtrip():
         assert got is not None and got[0] == 3
         np.testing.assert_array_equal(got[1][0], host_k[0])
         assert a.stats()["peer_hits"] == 1
+        # served counts AFTER the reply is sent: the client can observe
+        # the answer a beat before the handler bumps the counter
+        deadline = time.monotonic() + 5
+        while b.stats()["served"] < 1 and time.monotonic() < deadline:
+            time.sleep(0.01)
         assert b.stats()["served"] >= 1
         # the gossip/probe summary carries b's chain digests
         summary = fetch_summary(b.address)
@@ -278,9 +283,15 @@ def test_degraded_tier_is_never_slower_than_no_tier(params):
         return total, max(lat)
 
     base_total, base_p99 = run(None)
+    # STARTED tier: the anti-entropy replication thread is live too, so
+    # this re-proves the guarantee with proactive replication enabled —
+    # replication is off the request path and its failed pushes strike
+    # the same breakers
     dead_tier = FleetTier(peers=["127.0.0.1:9"], lookup_timeout_s=0.1,
-                          failure_threshold=1, gossip_interval_s=0)
+                          failure_threshold=1, gossip_interval_s=0,
+                          hot_hits=1).start()
     try:
+        assert dead_tier._repl_thread is not None  # replication armed
         degraded_total, degraded_p99 = run(dead_tier)
         assert dead_tier.stats()["peer_skips"] >= 1  # breaker did its job
     finally:
@@ -343,6 +354,114 @@ def test_probe_piggybacks_summary_into_pool_routing():
         lease.release()
     finally:
         pool.close()
+
+
+def test_probe_piggybacks_pressure_into_pool_introspection():
+    """Probes returning (state, digests, pressure) feed the autoscaling
+    gauges: EndpointPool.pressures() surfaces the per-replica queue
+    depth + prefix-affinity pressure a discovery source scales on, and
+    the observer exports ctpu_fleet_pressure_* per endpoint."""
+    from client_tpu.serve.metrics import BalancerMetricsObserver
+
+    registry = Registry()
+    pool = EndpointPool(
+        ["a:1", "b:1"], policy="least-inflight",
+        observer=BalancerMetricsObserver(registry),
+    )
+    feeds = {
+        "a:1": {"queue_depth": 7, "prefix_hot": 2},
+        "b:1": {"queue_depth": 1, "prefix_hot": 0},
+    }
+    pool.start_probes(
+        lambda url: (SERVER_READY, [], feeds[url]), interval_s=0.05,
+    )
+    try:
+        deadline = time.monotonic() + 10
+        while (
+            any(not p for p in pool.pressures().values())
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.01)
+        assert pool.pressures()["a:1"] == feeds["a:1"]
+        assert pool.pressures()["b:1"] == feeds["b:1"]
+        assert registry.get(
+            "ctpu_fleet_pressure_queue_depth", {"endpoint": "a:1"}
+        ) == 7
+        assert registry.get(
+            "ctpu_fleet_pressure_prefix", {"endpoint": "a:1"}
+        ) == 2
+    finally:
+        pool.close()
+
+
+def test_fetch_summary_carries_pressure():
+    """fetch_summary — the payload pool probes piggyback — now carries
+    the replica's pressure block alongside its digests."""
+    import types
+
+    tier = _tier()
+    try:
+        tier.attach(types.SimpleNamespace(
+            qos=None, metrics=None, response_cache=None,
+            pressure=lambda: {"queue_depth": 5, "inflight": 2},
+        ))
+        summary = fetch_summary(tier.address)
+        assert summary["pressure"]["queue_depth"] == 5
+        assert "prefix_hot" in summary["pressure"]
+    finally:
+        tier.close()
+
+
+def test_replicated_client_stamps_prefix_digests_from_tokens():
+    """ROADMAP fleet follow-up 3: the prefix-aware policy's
+    prefix_digests request-ctx is now stamped by the replicated client
+    itself — from an explicit prefix_tokens kwarg or a tokenizer-aware
+    prefix_fn hook — instead of hand-built by tests/operators."""
+    from client_tpu.balance.replicated import ReplicatedClient
+    from client_tpu.serve import Server
+
+    tokens = list(range(32))
+    server_a, server_b = Server().start(), Server().start()
+    seen = []
+
+    class _SpyPolicy(PrefixAware):
+        def pick(self, candidates, request_ctx=None):
+            seen.append(dict(request_ctx or {}))
+            return super().pick(candidates, request_ctx)
+
+    pool = EndpointPool(
+        [server_a.http_address, server_b.http_address],
+        policy=_SpyPolicy(),
+    )
+    pool.set_summary(server_b.http_address, chain_digests(tokens, 16))
+    client = ReplicatedClient(
+        pool, transport="http", probe_interval_s=None,
+        prefix_fn=lambda model, inputs: tokens, prefix_block_size=16,
+    )
+    try:
+        from client_tpu.http import InferInput
+
+        def infer(**kwargs):
+            inputs = [InferInput("INPUT0", [1, 16], "INT32"),
+                      InferInput("INPUT1", [1, 16], "INT32")]
+            inputs[0].set_data_from_numpy(
+                np.arange(16, dtype=np.int32).reshape(1, 16))
+            inputs[1].set_data_from_numpy(np.ones((1, 16), dtype=np.int32))
+            return client.infer("simple", inputs, **kwargs)
+
+        infer()  # prefix_fn path: digests computed from the tokens
+        assert seen[-1]["prefix_digests"] == chain_digests(tokens, 16)
+        # the digest-holding replica won the pick (cache affinity)
+        infer()
+        # explicit prefix_tokens / prefix_digests kwargs override the fn
+        infer(prefix_tokens=tokens[:16])
+        assert seen[-1]["prefix_digests"] == chain_digests(tokens[:16], 16)
+        infer(prefix_digests=["d0", "d1"])
+        assert seen[-1]["prefix_digests"] == ["d0", "d1"]
+    finally:
+        client.close()
+        server_a.stop()
+        server_b.stop()
 
 
 # -- fleet-wide tenant accounting ------------------------------------------
@@ -477,7 +596,15 @@ def _run_fleet_chaos(params, n_sessions, budget):
     """Three replicas under mixed-tenant shared-prefix load; replica 0
     is killed mid-stream; every session must complete byte-exact with
     zero errors, and the shared tier must add hits a single replica
-    would not have had."""
+    would not have had.  Expressed on the chaos-matrix harness
+    (client_tpu/testing/chaos.py): the schedule is one declarative kill,
+    the thread/error/wedge plumbing is the harness's."""
+    from client_tpu.testing.chaos import (
+        ChaosScenario,
+        FaultSpec,
+        run_scenario,
+    )
+
     shared = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8, 9, 7, 9, 3]  # 2 blocks
     tiers = [_tier() for _ in range(3)]
     _peer_up(tiers)
@@ -495,42 +622,41 @@ def _run_fleet_chaos(params, n_sessions, budget):
                    tenant="gold" if i % 2 else "bronze")
         for i in range(n_sessions)
     ]
-    errors = []
     killed = threading.Event()
+
+    def kill(_target):
+        # kill replica 0 mid-stream: its active streams close early and
+        # their sessions resume on survivors from the shared tier
+        killed.set()
+        engines[0].close()
 
     def drive(i, session):
         # sessions spread over the fleet; survivors carry the dead
         # replica's sessions to completion
         order = [engines[i % 3], engines[(i + 1) % 3], engines[(i + 2) % 3]]
-        for attempt in range(8):
-            try:
-                engine = next(
-                    e for e in order
-                    if not (e is engines[0] and killed.is_set())
-                )
-                if session.run_on(engine):
-                    return
-            except Exception as exc:  # noqa: BLE001
-                errors.append((i, exc))
+        for _attempt in range(8):
+            engine = next(
+                e for e in order
+                if not (e is engines[0] and killed.is_set())
+            )
+            if session.run_on(engine):
                 return
-        errors.append((i, "budget never met"))
+        raise AssertionError("budget never met")
 
-    threads = [
-        threading.Thread(target=drive, args=(i, s), daemon=True)
-        for i, s in enumerate(sessions)
-    ]
+    scenario = ChaosScenario(
+        "fleet-kill-mid-stream",
+        [FaultSpec("kill_replica", at_s=0.3, target=0)],
+    )
     try:
-        for t in threads:
-            t.start()
-        # kill replica 0 mid-stream: its active streams close early and
-        # their sessions resume on survivors from the shared tier
-        time.sleep(0.3)
-        killed.set()
-        engines[0].close()
-        for t in threads:
-            t.join(timeout=600)
-            assert not t.is_alive(), "session wedged across the kill"
-        assert not errors, errors
+        result = run_scenario(
+            scenario, lambda fault: kill(fault.target),
+            [
+                (lambda i=i, s=s: drive(i, s))
+                for i, s in enumerate(sessions)
+            ],
+            join_timeout_s=600,
+        )
+        result.assert_clean()
         hops = sum(s.hops for s in sessions)
         for session in sessions:
             reference = _serial(params, session.prompt, session.budget)

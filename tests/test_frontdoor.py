@@ -29,6 +29,7 @@ from client_tpu.serve.frontdoor import (
 )
 from client_tpu.serve.metrics import Registry, render_metrics
 from client_tpu.serve.model_runtime import InferenceEngine
+from client_tpu.testing.chaos import ChaosScenario, run_scenario
 from client_tpu.utils import InferenceServerException, to_wire_bytes
 
 
@@ -809,22 +810,25 @@ def _run_noisy_neighbor(n_per_tenant, flood_threads, storm_n, delay_s):
     )
     addr = server.http_address
     tenants = ["alice", "bob", "carol"]
+    def _compliant_driver(tenant, out, base):
+        # raising variant of _compliant_run: the chaos harness collects
+        # driver exceptions and assert_clean() is the zero-error gate
+        errs = []
+        _compliant_run(addr, tenant, n_per_tenant, out, errs, base)
+        assert not errs, errs
+
     try:
-        # -- phase 1: solo baselines ------------------------------------
+        # -- phase 1: solo baselines (chaos harness drives the threads,
+        # collects errors, detects wedged drivers) ----------------------
         solo = {t: [] for t in tenants}
-        errors = []
-        threads = [
-            threading.Thread(
-                target=_compliant_run,
-                args=(addr, t, n_per_tenant, solo[t], errors, 1000 * i),
-            )
-            for i, t in enumerate(tenants)
-        ]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join(timeout=120)
-        assert not errors, errors
+        run_scenario(
+            ChaosScenario("noisy-neighbor-solo"), lambda fault: None,
+            [
+                (lambda t=t, i=i: _compliant_driver(t, solo[t], 1000 * i))
+                for i, t in enumerate(tenants)
+            ],
+            join_timeout_s=120,
+        ).assert_clean()
 
         # -- phase 2: flooder + hot-key storm + compliant tenants -------
         stop_flood = threading.Event()
@@ -864,7 +868,6 @@ def _run_noisy_neighbor(n_per_tenant, flood_threads, storm_n, delay_s):
 
         # hot-key storm: identical concurrent requests on one value
         storm_barrier = threading.Barrier(storm_n)
-        storm_errors = []
         hot_value = 99_999.0
 
         def storm():
@@ -872,40 +875,29 @@ def _run_noisy_neighbor(n_per_tenant, flood_threads, storm_n, delay_s):
             try:
                 storm_barrier.wait(timeout=60)
                 _infer(client, hot_value, "alice")
-            except Exception as e:  # noqa: BLE001
-                storm_errors.append(e)
             finally:
                 client.close()
 
-        storms = [threading.Thread(target=storm) for _ in range(storm_n)]
-        for t in storms:
-            t.start()
-
+        # compliant tenants + the storm ride the chaos harness as one
+        # driver set (one scenario, one zero-error/zero-wedge gate); the
+        # flooders stay background load, stopped after the run
         attack = {t: [] for t in tenants}
-        attack_errors = []
-        threads = [
-            threading.Thread(
-                target=_compliant_run,
-                args=(
-                    addr, t, n_per_tenant, attack[t], attack_errors,
-                    10_000 + 1000 * i,
-                ),
-            )
-            for i, t in enumerate(tenants)
-        ]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join(timeout=120)
-        for t in storms:
-            t.join(timeout=60)
+        attack_result = run_scenario(
+            ChaosScenario("noisy-neighbor-attack"), lambda fault: None,
+            [
+                (lambda t=t, i=i: _compliant_driver(
+                    t, attack[t], 10_000 + 1000 * i,
+                ))
+                for i, t in enumerate(tenants)
+            ] + [storm] * storm_n,
+            join_timeout_s=180,
+        )
         stop_flood.set()
         for t in flooders:
             t.join(timeout=60)
 
-        # -- acceptance: zero errors for compliant tenants --------------
-        assert not attack_errors, attack_errors
-        assert not storm_errors, storm_errors
+        # -- acceptance: zero errors for compliant tenants + storm ------
+        attack_result.assert_clean()
         # flooder rejections were absorbed by its RetryPolicy: its
         # requests slowed down but did not ERROR
         assert not flood_errors, flood_errors[:3]
