@@ -48,11 +48,18 @@ check: lint
 
 # Fast chaos-matrix gate: the deterministic fault schedules + invariant
 # checkers (SIGKILL-with-active-sequences, anti-entropy convergence,
-# harness units) under the dynamic lock-order witness.
+# harness units) under the dynamic lock-order witness.  TPU_FLIGHT_DIR
+# routes flight-recorder dumps (an invariant failure dumps every
+# replica's ring automatically) into build/flight/ so a red run ships
+# its own postmortem artifacts.
 chaos:
-	JAX_PLATFORMS=cpu TPULINT_LOCK_WITNESS=1 \
+	@mkdir -p build/flight/chaos
+	@JAX_PLATFORMS=cpu TPULINT_LOCK_WITNESS=1 \
+	    TPU_FLIGHT_DIR=build/flight/chaos \
 	    python -m pytest tests/test_chaos.py -q -m 'not slow' \
-	    -p no:cacheprovider -p no:xdist -p no:randomly
+	    -p no:cacheprovider -p no:xdist -p no:randomly || { \
+	  echo "chaos FAILED — flight-recorder dumps archived:"; \
+	  ls -l build/flight/chaos 2>/dev/null; exit 1; }
 
 # Churn + isolation soak: the slow tier tier-1 excludes — repeats the
 # replica-churn chaos acceptance (discovery add/retire, stream-pinned
@@ -64,14 +71,18 @@ chaos:
 # isolation bugs are timing bugs, repetition finds them.
 SOAK_N ?= 3
 soak:
+	@mkdir -p build/flight/soak
 	@for i in $$(seq 1 $(SOAK_N)); do \
 	  echo "== soak round $$i/$(SOAK_N) (lock-order witness armed) =="; \
 	  JAX_PLATFORMS=cpu TPULINT_LOCK_WITNESS=1 \
+	      TPU_FLIGHT_DIR=build/flight/soak \
 	      python -m pytest tests/test_discovery.py \
 	      tests/test_balance.py tests/test_frontdoor.py \
 	      tests/test_lm.py tests/test_fleet.py tests/test_chaos.py \
 	      -q -m slow \
-	      -p no:cacheprovider -p no:xdist -p no:randomly || exit 1; \
+	      -p no:cacheprovider -p no:xdist -p no:randomly || { \
+	    echo "soak round $$i FAILED — flight-recorder dumps archived:"; \
+	    ls -l build/flight/soak 2>/dev/null; exit 1; }; \
 	done
 
 all: protos native cpp

@@ -114,6 +114,91 @@ def _delta_pct(cur, prev_doc, key):
     return round(100.0 * (cur - prev) / prev, 1)
 
 
+# Capacity headlines the SLO regression gate ratchets round-over-round
+# (ROADMAP: a PR that regresses sustainable capacity must fail loudly,
+# the way the lint ratchet fails on new findings).  Every key is a
+# sustainable-throughput statement; slo_qps_under_p99 is the headline
+# throughput CONDITIONED on its p99 meeting the objective.
+_SLO_GATE_KEYS = (
+    "value",                 # headline cnn224 tpushm infer/s
+    "sp_infer_per_sec",
+    "wire_infer_per_sec",
+    "wire_small64_infer_per_sec",
+    "ensemble_infer_per_sec",
+    "lm_tokens_per_sec",
+    "lm_batched_tokens_per_sec",
+    "slo_qps_under_p99",
+)
+
+
+def _slo_block(result, slo_series):
+    """The per-round SLO record: headline max-QPS-under-p99 (the
+    headline throughput, zeroed when its measured p99 misses the
+    ``BENCH_SLO_P99_MS`` objective — unset = unconditioned) plus the
+    server's own ``ctpu_slo_*`` sketch summary scraped before stop."""
+    objective = os.environ.get("BENCH_SLO_P99_MS")
+    objective = float(objective) if objective else None
+    qps, p99 = result.get("value"), result.get("p99_ms")
+    under = None
+    if qps is not None and p99 is not None:
+        under = qps if objective is None or p99 <= objective else 0.0
+    return {
+        "slo_objective_p99_ms": objective,
+        "slo_qps_under_p99": under,
+        "slo_series": slo_series or {},
+    }
+
+
+def _slo_gate(result, prev, tolerance_pct=20.0):
+    """Round-over-round sustainable-capacity ratchet over
+    :data:`_SLO_GATE_KEYS`.
+
+    A key regressing more than *tolerance_pct* vs the prior BENCH file
+    fails the gate (bench exits non-zero) — unless the same-instrument
+    link-drift probe says the tunnel itself moved >10% during the run,
+    in which case the key is recorded as skipped with the reason (the
+    r05 post-mortem verdict: tunnel drift is not a code regression).
+    ``BENCH_SLO_GATE=0`` disables enforcement; the block still records.
+    """
+    checked, regressions, skipped = {}, [], {}
+    drift = result.get("mp_link_drift_pct")
+    drifted = drift is not None and abs(drift) > 10.0
+
+    def figure(doc, key):
+        if not doc:
+            return None
+        if key == "slo_qps_under_p99":
+            return (doc.get("slo") or {}).get(key)
+        return doc.get(key)
+
+    for key in _SLO_GATE_KEYS:
+        cur, prev_val = figure(result, key), figure(prev, key)
+        # cur == 0.0 is the LOUDEST regression (e.g. qps_under_p99
+        # zeroed by a missed objective) — only None means "not measured"
+        if cur is None or not prev_val:
+            continue
+        delta = round(100.0 * (cur - prev_val) / prev_val, 1)
+        checked[key] = delta
+        if delta < -float(tolerance_pct):
+            if drifted:
+                skipped[key] = (
+                    f"link drifted {drift}% under the run — instrument, "
+                    "not capacity (BENCH_NOTES r05 post-mortem)"
+                )
+            else:
+                regressions.append({
+                    "key": key, "prev": prev_val, "cur": cur,
+                    "delta_pct": delta,
+                })
+    return {
+        "tolerance_pct": float(tolerance_pct),
+        "checked": checked,
+        "regressions": regressions,
+        "skipped": skipped,
+        "pass": not regressions,
+    }
+
+
 def _measure_link():
     """Honest host<->device link characteristics (MB/s both ways, RTT ms).
 
@@ -1146,6 +1231,13 @@ def main():
             model_name="lm_streaming_batched", concurrency=8,
             key_prefix="lm_batched",
         ) or {}
+        # the server's own SLO sketch summary (ctpu_slo_* figures) for
+        # this round's record — scraped while the engine is still up
+        slo_series = attempt(
+            "slo_series",
+            lambda: server.engine.slo.check_now()
+            if server.engine.slo is not None else {},
+        ) or {}
     finally:
         server.stop()
     lm_inproc = attempt("lm_inproc", _run_lm_inproc) or {}
@@ -1413,8 +1505,29 @@ def main():
             lm_batched["lm_batched_tokens_per_sec"], flops_lane,
             peak_tflops,
         )
+    # SLO record + regression gate (ROADMAP item): max-QPS-under-p99 and
+    # the server's ctpu_slo_* figures recorded per round; a capacity key
+    # regressing past tolerance vs the prior BENCH file fails the run
+    # loudly, the way the lint ratchet fails on new findings.
+    result["slo"] = _slo_block(result, slo_series)
+    gate = _slo_gate(result, prev)
+    result["slo_gate"] = gate
     print(json.dumps(result))
-    return 0 if tpu["n"] and not tpu["errors"] else 1
+    rc = 0 if tpu["n"] and not tpu["errors"] else 1
+    if not gate["pass"] and os.environ.get("BENCH_SLO_GATE", "1") != "0":
+        for reg in gate["regressions"]:
+            print(
+                "bench SLO gate: {key} regressed {delta_pct}% "
+                "({prev} -> {cur})".format(**reg),
+                file=sys.stderr,
+            )
+        print(
+            "bench SLO regression gate FAILED "
+            "(BENCH_SLO_GATE=0 to record without enforcing)",
+            file=sys.stderr,
+        )
+        rc = rc or 2
+    return rc
 
 
 if __name__ == "__main__":

@@ -1374,3 +1374,155 @@ class RefcountPairRule(Rule):
                         "with a release on each holder's exit path",
                     ))
         return findings
+
+
+_TRACERISH_RE = re.compile(r"(?i)tracer")
+# explicit span/timer starters (any receiver) + the tracers' sample()
+_SPAN_START_METHODS = {"start_span", "begin_span", "start_timer"}
+# calls that end a started span's lifetime (receiver = the span, or the
+# span passed as an argument: trace.close() / tracer.complete(trace))
+_SPAN_FINISH_METHODS = {"complete", "finish", "close", "end", "stop"}
+
+
+@register
+class SpanLeakRule(Rule):
+    """SPAN-LEAK — a span/timer started without a finish on every exit
+    path.
+
+    The tracing layer's contract is that every sampled span COMPLETES:
+    completion is what appends the record to the bounded deque and the
+    trace file.  A span started (``tracer.sample(...)``, ``start_span``,
+    ``start_timer``) whose finish (``complete``/``finish``/``close``/
+    ``end``/``stop``) is not inside a ``finally`` leaks the moment any
+    statement between start and finish raises — the request happened,
+    the timeline says it didn't, and the flight recorder's ring (fed by
+    the completion hook) has a hole exactly where the postmortem needs
+    it.  Every tracing bracket in this repo is a ``try/finally`` or a
+    context manager for this reason; the rule freezes that shape.
+
+    Heuristic, per function: an assignment ``x = <tracer-ish>.sample(...)``
+    (or any ``*.start_span/begin_span/start_timer(...)``) must be paired
+    with a finish call on ``x`` that sits inside a ``finally`` block.  A
+    span that ESCAPES the function — returned, yielded, stored on an
+    attribute, or handed to another call — transfers ownership and is
+    exempt (the frontends sample, then complete in their own finally).
+    """
+
+    id = "SPAN-LEAK"
+    rationale = (
+        "a span started without a finish on every exit path (try/finally "
+        "or context manager) vanishes from the trace file and the flight "
+        "recorder exactly when a failure makes it interesting — the "
+        "timeline hole the tracing brackets exist to prevent"
+    )
+
+    @classmethod
+    def _start_call(cls, node):
+        """The span-starting Call inside an assignment value, or None."""
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            fn = sub.func
+            if not isinstance(fn, ast.Attribute):
+                continue
+            if fn.attr in _SPAN_START_METHODS:
+                return sub
+            if fn.attr == "sample":
+                recv = _expr_text(fn.value)
+                if recv and _TRACERISH_RE.search(_last_segment(recv)):
+                    return sub
+        return None
+
+    @staticmethod
+    def _uses_name(node, name):
+        return any(
+            isinstance(sub, ast.Name) and sub.id == name
+            for sub in ast.walk(node)
+        )
+
+    @classmethod
+    def _classify(cls, fn, name, start_assign):
+        """(finishes, protected_finishes, escapes) of span var *name*."""
+        finishes = []
+        protected = []
+        escaped = False
+        final_nodes = set()
+        for sub in _walk_no_functions(fn):
+            if isinstance(sub, ast.Try):
+                for stmt in sub.finalbody:
+                    final_nodes.update(id(n) for n in ast.walk(stmt))
+        for sub in _walk_no_functions(fn):
+            if isinstance(sub, (ast.Return, ast.Yield, ast.YieldFrom)):
+                if sub.value is not None and cls._uses_name(sub.value, name):
+                    escaped = True
+            elif isinstance(sub, ast.Assign) and sub is not start_assign:
+                # self._trace = x: stored; finished elsewhere
+                if cls._uses_name(sub.value, name) and any(
+                    isinstance(t, (ast.Attribute, ast.Subscript))
+                    for t in sub.targets
+                ):
+                    escaped = True
+            elif isinstance(sub, ast.Call):
+                fn_expr = sub.func
+                is_finish = (
+                    isinstance(fn_expr, ast.Attribute)
+                    and fn_expr.attr in _SPAN_FINISH_METHODS
+                    and (
+                        cls._uses_name(fn_expr.value, name)
+                        or any(cls._uses_name(a, name) for a in sub.args)
+                    )
+                )
+                if is_finish:
+                    finishes.append(sub)
+                    if id(sub) in final_nodes:
+                        protected.append(sub)
+                elif any(
+                    cls._uses_name(a, name) for a in sub.args
+                ) or any(
+                    kw.value is not None and cls._uses_name(kw.value, name)
+                    for kw in sub.keywords
+                ):
+                    # handed to another callable: ownership transferred
+                    # (the callee finishing it is beyond a per-file pass)
+                    escaped = True
+        return finishes, protected, escaped
+
+    def check(self, tree, lines, path):
+        findings = []
+        for fn in ast.walk(tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for node in _walk_no_functions(fn):
+                if not isinstance(node, ast.Assign):
+                    continue
+                if len(node.targets) != 1 or not isinstance(
+                    node.targets[0], ast.Name
+                ):
+                    continue
+                start = self._start_call(node.value)
+                if start is None:
+                    continue
+                name = node.targets[0].id
+                finishes, protected, escaped = self._classify(
+                    fn, name, node
+                )
+                if escaped:
+                    continue
+                if not finishes:
+                    findings.append(self.finding(
+                        path, lines, node,
+                        f"{fn.name}() starts span {name!r} and never "
+                        "finishes it — the sampled request vanishes from "
+                        "the trace file; complete it in a try/finally or "
+                        "use the context-manager bracket",
+                    ))
+                elif not protected:
+                    findings.append(self.finding(
+                        path, lines, node,
+                        f"{fn.name}() finishes span {name!r} outside any "
+                        "finally block — an exception between start and "
+                        "finish leaks the span exactly when the timeline "
+                        "matters; move the finish into try/finally or use "
+                        "the context-manager bracket",
+                    ))
+        return findings

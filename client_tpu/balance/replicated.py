@@ -305,8 +305,18 @@ class ReplicatedClient:
         routing hint; a ``prefix_fn`` client hook computes it from the
         inputs when neither is given).  The sequence kwargs double as
         the routing context for the sticky policy (see the module
-        docstring)."""
-        with _tracing.client_span(self._tracer, model_name) as trace:
+        docstring).
+
+        Sequence requests trace under ONE pinned trace id per sequence
+        (``ClientTracer`` context pinning): every step — including the
+        failover retries after a replica death — joins a single trace,
+        which is what lets traceview show a kill-mid-stream failover as
+        one timeline spanning client and both replicas."""
+        seq_id = kwargs.get("sequence_id", 0)
+        context_key = ("sequence", seq_id) if seq_id else None
+        with _tracing.client_span(
+            self._tracer, model_name, context_key=context_key
+        ) as trace:
             headers = dict(kwargs.pop("headers", None) or {})
             if trace is not None:
                 headers["traceparent"] = trace.traceparent()
@@ -333,9 +343,14 @@ class ReplicatedClient:
                         model_name, inputs, **call_kwargs
                     )
 
-            return _resilience.call_with_failover(
+            result = _resilience.call_with_failover(
                 attempt, self._retry_policy, route
             )
+            if (context_key is not None and kwargs.get("sequence_end")
+                    and self._tracer is not None):
+                # the sequence is over: a restarted id starts fresh
+                self._tracer.release_context(context_key)
+            return result
 
     # -- health --------------------------------------------------------------
     # "The service" is live/ready when ANY replica is; per-replica detail
@@ -589,7 +604,13 @@ class AsyncReplicatedClient:
     # -- inference -----------------------------------------------------------
 
     async def infer(self, model_name, inputs, **kwargs):
-        with _tracing.client_span(self._tracer, model_name) as trace:
+        # sequence requests pin one trace id per sequence id (see the
+        # sync client's infer for the rationale)
+        seq_id = kwargs.get("sequence_id", 0)
+        context_key = ("sequence", seq_id) if seq_id else None
+        with _tracing.client_span(
+            self._tracer, model_name, context_key=context_key
+        ) as trace:
             headers = dict(kwargs.pop("headers", None) or {})
             if trace is not None:
                 headers["traceparent"] = trace.traceparent()
@@ -616,9 +637,13 @@ class AsyncReplicatedClient:
                         model_name, inputs, **call_kwargs
                     )
 
-            return await _resilience.acall_with_failover(
+            result = await _resilience.acall_with_failover(
                 attempt, self._retry_policy, route
             )
+            if (context_key is not None and kwargs.get("sequence_end")
+                    and self._tracer is not None):
+                self._tracer.release_context(context_key)
+            return result
 
     # -- health --------------------------------------------------------------
 

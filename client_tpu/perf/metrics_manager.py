@@ -222,13 +222,26 @@ class MetricsManager:
             self._snapshots = []
         return snaps
 
+    # Series families summarize() folds in wholesale: the LM engine
+    # (PR 9-10), the fleet tier (PR 11-12) and the SLO watchdog all
+    # export under these prefixes, and a fixed gauge list would silently
+    # drop every series added after it was written (which is exactly
+    # what happened to ctpu_lm_*/ctpu_fleet_* until this audit).
+    SERIES_PREFIXES = ("ctpu_lm_", "ctpu_fleet_", "ctpu_slo_",
+                      "ctpu_flight_")
+
     @staticmethod
     def summarize(snapshots, gauges=("ctpu_tpu_memory_used_bytes",
                                      "ctpu_tpu_memory_total_bytes",
                                      "ctpu_tpu_memory_peak_bytes",
-                                     "ctpu_probe_queue_delay_us")):
+                                     "ctpu_probe_queue_delay_us"),
+                  prefixes=None):
         """Max/avg per gauge over the window's snapshots (the reference
-        merges per-GPU utilization/memory the same way)."""
+        merges per-GPU utilization/memory the same way), plus every
+        series matching :data:`SERIES_PREFIXES`: gauges aggregate as
+        avg/max of their per-snapshot label-summed values, ``*_total``
+        counters as the window delta (reported as avg==max so the
+        report's column pair renders them unchanged)."""
         summary = {}
         for gauge in gauges:
             values = []
@@ -239,6 +252,41 @@ class MetricsManager:
                 summary[gauge] = {
                     "avg": float(np.mean(values)),
                     "max": float(np.max(values)),
+                }
+        prefixes = (
+            MetricsManager.SERIES_PREFIXES if prefixes is None else prefixes
+        )
+        names = sorted({
+            name
+            for snap in snapshots
+            for name in snap
+            if name.startswith(tuple(prefixes)) and name not in summary
+        })
+        for name in names:
+            # quantile/rate gauges are NOT additive across label sets:
+            # summing two models' p99s reports a latency nobody saw (and
+            # summed error rates exceed 1.0) — take the worst label
+            # instead; usage/count gauges fold by sum as before
+            additive = not (
+                name.endswith(("_ms", "_rate", "_pct"))
+            )
+            fold = sum if additive else max
+            sums = [
+                fold(v for _, v in snap[name])
+                for snap in snapshots
+                if snap.get(name)
+            ]
+            if not sums:
+                continue
+            if name.endswith("_total"):
+                delta = float(sums[-1] - sums[0]) if len(sums) > 1 else float(
+                    sums[-1]
+                )
+                summary[name] = {"avg": delta, "max": delta}
+            else:
+                summary[name] = {
+                    "avg": float(np.mean(sums)),
+                    "max": float(np.max(sums)),
                 }
         # utilization gauges are emitted in PERCENT: the report renders
         # tpu_metrics with :.0f, which would flatten a 0-1 fraction to 0/1

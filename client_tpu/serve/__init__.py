@@ -35,6 +35,7 @@ class Server:
         coalescing=False,
         qos=None,
         fleet=None,
+        slo=None,
     ):
         all_models = list(models or [])
         if with_default_models:
@@ -46,6 +47,7 @@ class Server:
             coalescing=coalescing,
             qos=qos,
             fleet=fleet,
+            slo=slo,
         )
         self._http = None
         self._grpc = None

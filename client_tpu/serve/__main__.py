@@ -47,6 +47,21 @@ def main():
         "--max-inflight", type=int, default=None,
         help="global concurrent-request cap (retryable 503 beyond)",
     )
+    parser.add_argument(
+        "--slo-p99-ms", type=float, default=None,
+        help="arm the SLO watchdog: windowed p99 objective in ms for "
+             "every model (breach increments ctpu_slo_breaches_total "
+             "and dumps the flight recorder)",
+    )
+    parser.add_argument(
+        "--slo-error-rate", type=float, default=None,
+        help="SLO error-rate objective as a fraction (server faults only)",
+    )
+    parser.add_argument(
+        "--flight-dir", default=None,
+        help="directory for flight-recorder dumps (default: "
+             "$TPU_FLIGHT_DIR, else the system temp dir)",
+    )
     args = parser.parse_args()
 
     from client_tpu.serve.models import model_sets
@@ -73,6 +88,17 @@ def main():
             default_rate_per_s=args.tenant_rate,
         )
 
+    slo = None
+    if args.slo_p99_ms is not None or args.slo_error_rate is not None:
+        from client_tpu.serve.slo import SloWatchdog
+
+        objective = {}
+        if args.slo_p99_ms is not None:
+            objective["p99_ms"] = args.slo_p99_ms
+        if args.slo_error_rate is not None:
+            objective["error_rate"] = args.slo_error_rate
+        slo = SloWatchdog(objectives={"*": objective})
+
     server = Server(
         models=extra,
         http_port=args.http_port,
@@ -84,7 +110,10 @@ def main():
         response_cache=cache,
         coalescing=args.coalescing,
         qos=qos,
+        slo=slo,
     ).start()
+    if args.flight_dir:
+        server.engine.flight.dump_dir = args.flight_dir
     print(f"client_tpu.serve: HTTP on {server.http_address}", flush=True)
     if server.grpc_address:
         print(f"client_tpu.serve: gRPC on {server.grpc_address}", flush=True)

@@ -67,6 +67,31 @@ __all__ = [
 ]
 
 
+def _frame_bytes(payload):
+    """Approximate payload size of one fleet frame: the base64 KV/blob
+    fields dominate every heavy op, so summing their lengths (plus the
+    snapshot's encoded values) is within a few percent of the wire size
+    at none of json.dumps' cost.  Only computed for TRACED calls."""
+    n = 0
+    for key in ("k", "v"):
+        for e in payload.get(key) or ():
+            if isinstance(e, dict):
+                n += len(e.get("data") or "")
+    for b in payload.get("blobs") or ():
+        n += len(b)
+    n += 4 * len(payload.get("tokens") or ())
+    snapshot = payload.get("snapshot")
+    if isinstance(snapshot, dict):
+        for value in snapshot.values():
+            if isinstance(value, str):
+                n += len(value)
+            elif isinstance(value, dict):
+                n += sum(
+                    len(v) for v in value.values() if isinstance(v, str)
+                )
+    return n
+
+
 def chain_digests(tokens, block_size, max_blocks=None):
     """Cumulative digest per FULL token block of *tokens*.
 
@@ -534,7 +559,7 @@ class FleetTier:
         try:
             conn.settimeout(max(self.lookup_timeout_s * 4, 1.0))
             request = recv_frame(conn)
-            send_frame(conn, self._handle(request))
+            send_frame(conn, self._handle_traced(request))
             with self._lock:
                 self.served += 1
         except Exception:
@@ -545,6 +570,33 @@ class FleetTier:
                 conn.close()
             except OSError:
                 pass
+
+    def _tracer(self):
+        """The attached engine's Tracer (or None) — fleet spans land in
+        the same store/trace file as the replica's request spans."""
+        engine = self._engine
+        return getattr(engine, "tracer", None) if engine else None
+
+    def _handle_traced(self, request):
+        """Serve one peer frame, recording the peer-server span under the
+        CALLING replica's trace id when the frame carried a traceparent —
+        a cross-replica fetch then reads as one trace spanning both
+        processes (the other half is the caller's peer_span)."""
+        tracer = self._tracer()
+        traceparent = request.get("traceparent")
+        if tracer is None or not traceparent:
+            return self._handle(request)
+        op = str(request.get("op") or "?")
+        with tracer.serve_span(op, traceparent=traceparent) as span:
+            reply = self._handle(request)
+            if span is not None:
+                for key in ("hit", "stored", "ok"):
+                    if key in reply:
+                        span.tags[key] = bool(reply[key])
+                span.tags["bytes"] = _frame_bytes(reply) or _frame_bytes(
+                    request
+                )
+        return reply
 
     def _handle(self, request):
         op = request.get("op")
@@ -688,6 +740,34 @@ class FleetTier:
             send_frame(sock, payload)
             return recv_frame(sock)
 
+    def _traced_peer_call(self, addr, payload, breaker=None):
+        """One framed peer RPC recorded as a trace span: a request-thread
+        call (prefix/cache/seq lookup, the synchronous durability push)
+        becomes a CHILD span under the active request trace, an
+        anti-entropy-thread call a standalone subsampled span.  The
+        traceparent rides the frame so the peer's serve span joins the
+        same trace.  Raises exactly like :meth:`_peer_call`; tracing off
+        (or unsampled) adds two attribute reads and nothing else."""
+        tracer = self._tracer()
+        if tracer is None:
+            return self._peer_call(addr, payload)
+        op = str(payload.get("op") or "?")
+        with tracer.peer_span(
+            op, peer=addr,
+            breaker=(breaker.state if breaker is not None else ""),
+        ) as span:
+            if span is None:
+                return self._peer_call(addr, payload)
+            framed = dict(payload)
+            framed["traceparent"] = span.traceparent()
+            sent = _frame_bytes(payload)
+            reply = self._peer_call(addr, framed)
+            for key in ("hit", "stored", "ok"):
+                if key in reply:
+                    span.tags[key] = bool(reply[key])
+            span.tags["bytes"] = sent + _frame_bytes(reply)
+            return reply
+
     def _candidates(self, limit=None):
         """Breaker-admitted peer snapshot (skips counted): at most
         ``limit`` (default ``fan_out``) peers per call, so a lookup's
@@ -715,7 +795,7 @@ class FleetTier:
         a local-only fallback, never a caller-visible error."""
         for addr, breaker in self._candidates():
             try:
-                reply = self._peer_call(addr, payload)
+                reply = self._traced_peer_call(addr, payload, breaker)
             except Exception:  # noqa: BLE001 - containment is the point
                 breaker.record_failure()
                 with self._lock:
@@ -859,7 +939,7 @@ class FleetTier:
                     pending.record_failure()
                 break
             try:
-                reply = self._peer_call(addr, payload)
+                reply = self._traced_peer_call(addr, payload, breaker)
             except Exception:  # noqa: BLE001 - containment is the point
                 breaker.record_failure()
                 with self._lock:

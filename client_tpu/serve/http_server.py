@@ -13,7 +13,7 @@ import math
 import re
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from urllib.parse import unquote
+from urllib.parse import parse_qs, unquote
 
 from client_tpu import _codec
 from client_tpu.serve import frontdoor, model_runtime
@@ -152,6 +152,24 @@ class _Handler(BaseHTTPRequestHandler):
             return self._send_json(eng.log_settings)
         if path == "/v2/trace/setting":
             return self._send_json(eng.trace_settings)
+        if path == "/v2/debug/flight":
+            # flight-recorder ring as JSON-lines (the on-demand dump);
+            # ?dump=1 instead writes a file server-side and reports it
+            query = parse_qs((self.path.split("?", 1) + [""])[1])
+            if query.get("dump", [""])[-1] == "1":
+                path_written = eng.flight.dump("debug_endpoint")
+                return self._send_json({
+                    "dumped": path_written,
+                    "events": len(eng.flight.snapshot()),
+                })
+            body = eng.flight.render("debug_endpoint")
+            return self._send(
+                200, body.encode("utf-8"),
+                {"Content-Type": "application/jsonl"},
+            )
+        if path == "/v2/debug/slo":
+            slo = eng.slo
+            return self._send_json(slo.check_now() if slo is not None else {})
         if path == "/v2/models/stats":
             return self._send_json({"model_stats": eng.statistics()})
         shm = _SHM_URI.match(path)

@@ -360,6 +360,10 @@ class LmEngine:
         self.check_prompt = check_prompt  # optional prompt validator
         self.registry = registry
         self.tracer = tracer
+        # flight recorder (serve/flight.py; bound by the model binder):
+        # preemptions and a wedged scheduler loop land in the server's
+        # postmortem ring — a wedge also dumps it automatically
+        self.flight = None
         self.tenant_lane_share = tenant_lane_share
         self.block_size = int(block_size)
         chunk = int(prefill_chunk or min(64, cfg.max_seq))
@@ -1370,6 +1374,11 @@ class LmEngine:
                     "ctpu_lm_preemptions_total", None,
                     help_=LM_PREFIX_HELP["ctpu_lm_preemptions_total"],
                 )
+            if self.flight is not None:
+                self.flight.note(
+                    "lm_preemption", slot=slot, tenant=lane.tenant,
+                    swapped=bool(use_swap), blocks=written_blocks,
+                )
             self._swap_gauge_locked()
             # pause, don't end: the stream's queue stays open
             self._retire_lane_locked(lane, close_queue=False)
@@ -1521,11 +1530,18 @@ class LmEngine:
     def _loop(self):
         try:
             self._loop_inner()
-        except Exception:
+        except Exception as exc:
             # a dying scheduler must never strand consumers on q.get()
             with self._cv:
                 self._release_all_locked()
                 self._closed = True
+            # an engine wedge is the flagship flight-recorder anomaly:
+            # capture the ring (recent ticks, spans, preemptions) NOW —
+            # the postmortem must not depend on tracing having been on
+            flight = self.flight
+            if flight is not None:
+                flight.note("lm_engine_wedge", error=repr(exc))
+                flight.dump("lm_engine_wedge")
             raise
 
     def _loop_inner(self):

@@ -80,6 +80,24 @@ LM_PREFIX_HELP = {
         "KV blocks currently parked in the host-side swap store",
 }
 
+# SLO watchdog + flight recorder series (written by serve/slo.py and
+# serve/flight.py into the engine registry; one help catalog so
+# /metrics, README, bench and tests agree).
+SLO_HELP = {
+    "ctpu_slo_p50_ms":
+        "Windowed p50 request latency per model/tenant (sketch quantile)",
+    "ctpu_slo_p95_ms":
+        "Windowed p95 request latency per model/tenant (sketch quantile)",
+    "ctpu_slo_p99_ms":
+        "Windowed p99 request latency per model/tenant (sketch quantile)",
+    "ctpu_slo_error_rate":
+        "Windowed server-fault rate per model/tenant (5xx/transport only)",
+    "ctpu_slo_breaches_total":
+        "SLO objective breaches (by model/tenant and objective kind)",
+    "ctpu_flight_dumps_total":
+        "Flight-recorder dumps written (by trigger reason)",
+}
+
 # Fleet cache-tier series (written by serve/fleet.py and the fleet hooks
 # in serve/lm/engine.py + model_runtime into whichever registry the tier
 # is bound to; one help catalog so /metrics, README and tests agree).

@@ -36,10 +36,13 @@ from client_tpu.serve.metrics import (
     Histogram,
     Registry,
 )
+from client_tpu.serve.flight import FlightRecorder
 from client_tpu.serve.tracing import (
     TRACE_SETTING_DEFAULTS,
     Tracer,
+    current_trace,
     normalize_trace_settings,
+    push_trace,
 )
 
 SERVER_NAME = "client_tpu.serve"
@@ -151,6 +154,11 @@ class SequenceContext:
         # what an idempotent duplicate replay returns
         self.last_response = None
         self.last_used = time.monotonic()
+        # traceparent of the last committed step's request trace: rides
+        # the replicated snapshot so a survivor resuming this sequence
+        # can CONTINUE the dead replica's trace id (serve/tracing.py
+        # resume_span) — a SIGKILL failover reads as one trace
+        self.trace_ctx = None
 
     def export(self):
         """Serializable snapshot: JSON-safe through the fleet tier's
@@ -173,6 +181,7 @@ class SequenceContext:
             "durable": self.durable,
             "state": _seq_encode(self.state),
             "last_response": last,
+            "traceparent": self.trace_ctx,
         }
 
     @classmethod
@@ -184,6 +193,7 @@ class SequenceContext:
         ctx.step = int(snapshot.get("step", 0))
         ctx.epoch = float(snapshot.get("epoch", 0.0))
         ctx.state = _seq_decode(snapshot.get("state") or {})
+        ctx.trace_ctx = snapshot.get("traceparent")
         last = snapshot.get("last_response")
         if last is not None:
             ctx.last_response = (
@@ -864,6 +874,8 @@ class InferenceEngine:
         coalescing=False,
         qos=None,
         fleet=None,
+        slo=None,
+        flight=None,
     ):
         self._lock = threading.Lock()
         self._models = {}
@@ -891,6 +903,29 @@ class InferenceEngine:
         # drain counters for /metrics
         self.tracer = Tracer(self.trace_settings)
         self.metrics = Registry()
+        # Flight recorder (serve/flight.py): a bounded ring of recent
+        # spans + anomaly events dumped on demand (/v2/debug/flight) and
+        # automatically on SLO breach / engine wedge / chaos invariant
+        # failure — postmortems never depend on tracing having been on.
+        self.flight = flight if flight is not None else FlightRecorder(
+            registry=self.metrics
+        )
+        self.tracer.on_complete = self.flight.note_span
+        # SLO watchdog (serve/slo.py): streaming latency quantile
+        # sketches per (model, tenant), ctpu_slo_* gauges, breach counter
+        # + flight dump.  slo=None builds the observation-only default;
+        # pass a configured SloWatchdog to arm objectives, or False to
+        # disable entirely.
+        if slo is None:
+            from client_tpu.serve.slo import SloWatchdog
+
+            slo = SloWatchdog()
+        self.slo = slo or None
+        if self.slo is not None:
+            if self.slo.registry is None:
+                self.slo.registry = self.metrics
+            if self.slo.flight is None:
+                self.slo.flight = self.flight
         # Multi-tenant front door (serve/frontdoor.py): response cache,
         # in-flight coalescing, per-tenant QoS.  All opt-in; their metrics
         # land in this engine's registry unless already bound elsewhere.
@@ -1234,8 +1269,57 @@ class InferenceEngine:
         that is the point: serving a hot key from the cache costs the
         server almost nothing, so shedding it would be self-defeating
         (they still count in the per-tenant request series).
+
+        The whole request runs with *trace* installed as the thread's
+        active trace (serve/tracing.push_trace), so fleet peer RPCs made
+        while serving it — prefix/cache/sequence lookups, the durable
+        snapshot push — record child spans under its trace id.  The SLO
+        watchdog observes every completion; 4xx rejections count as
+        latency only (the client's fault, not a server error).
         """
+        # the CM form costs ~1us/request: on the untraced hot path the
+        # thread-local needs no touch at all (the 2% tracing-overhead
+        # budget is measured against the sub-ms headline request)
+        if trace is None:
+            return self._execute_measured(
+                model_name, model_version, request, binary_section,
+                trace, tenant,
+            )
+        with push_trace(trace):
+            return self._execute_measured(
+                model_name, model_version, request, binary_section,
+                trace, tenant,
+            )
+
+    def _execute_measured(self, model_name, model_version, request,
+                          binary_section, trace, tenant):
+        """SLO accounting bracket: every completion (or failure) of one
+        request lands in the watchdog's sketch; 5xx/transport count
+        against the error-rate objective, 4xx as latency only."""
         t0 = time.monotonic_ns()
+        status = ""
+        try:
+            return self._execute_request(
+                model_name, model_version, request, binary_section,
+                trace, tenant, t0,
+            )
+        except InferenceServerException as e:
+            status = str(e.status())
+            raise
+        except BaseException:
+            status = "500"
+            raise
+        finally:
+            slo = self.slo
+            if slo is not None:
+                slo.observe(
+                    model_name, tenant,
+                    (time.monotonic_ns() - t0) / 1e9,
+                    error=bool(status) and not status.startswith("4"),
+                )
+
+    def _execute_request(self, model_name, model_version, request,
+                         binary_section, trace, tenant, t0):
         if trace is not None:
             trace.tenant = tenant
             trace.event("QUEUE_START")
@@ -1669,7 +1753,18 @@ class InferenceEngine:
                 self.busy.begin()
                 try:
                     try:
-                        partial = next(gen)
+                        # the model's production step runs under the
+                        # request trace: generator bodies execute at
+                        # next(), often on the CONSUMER's thread, so the
+                        # engine's execute() bracket no longer covers
+                        # them — an LM submit's fleet prefix lookup
+                        # records its child span because of this push
+                        # (untraced streams skip the thread-local)
+                        if trace is None:
+                            partial = next(gen)
+                        else:
+                            with push_trace(trace):
+                                partial = next(gen)
                     except StopIteration:
                         break
                     rendered = self._render_response(
@@ -1800,6 +1895,7 @@ class InferenceEngine:
         A context another thread installed meanwhile wins unless the
         snapshot is strictly newer — replication must never move a
         sequence backwards."""
+        resumed = False
         with self._lock:
             ctx = self._sequences.get(seq_id)
             if snapshot is not None and (
@@ -1808,6 +1904,7 @@ class InferenceEngine:
                     int(snapshot.get("step", 0))) > (ctx.epoch, ctx.step)
             ):
                 ctx = SequenceContext.restore(snapshot)
+                resumed = True
                 self.metrics.inc(
                     "ctpu_fleet_seq_resumes_total",
                     help_=FLEET_HELP["ctpu_fleet_seq_resumes_total"],
@@ -1832,7 +1929,27 @@ class InferenceEngine:
             self._sequences[seq_id] = ctx
             if params.get("sequence_end"):
                 self._sequences.pop(seq_id, None)
-            return ctx
+        if resumed:
+            # record the resume AFTER releasing the engine lock (span
+            # completion may flush to the trace file).  The marker span
+            # CONTINUES the dead replica's trace id (the snapshot's
+            # traceparent); the current request's own trace is tagged so
+            # both directions of the join are explicit in traceview.
+            trace = current_trace()
+            span = self.tracer.resume_span(
+                ctx.trace_ctx, seq_id, step=ctx.step,
+                resumed_by=(trace.trace_id if trace is not None else ""),
+            )
+            if trace is not None:
+                trace.event("SEQ_RESUME")
+                trace.tags["resumed_sequence"] = seq_id
+                if span is not None:
+                    trace.tags["resumed_trace"] = span.trace_id
+            self.flight.note(
+                "seq_resume", sequence_id=seq_id, step=ctx.step,
+                trace=ctx.trace_ctx,
+            )
+        return ctx
 
     def _sequence_replay(self, context, params, request):
         """Idempotent duplicate-step short-circuit.
@@ -1883,11 +2000,17 @@ class InferenceEngine:
         (an unreachable fleet degrades to local-only durability)."""
         response, blobs = rendered
         ended = bool(params.get("sequence_end"))
+        trace = current_trace()
         with self._lock:
             context.step += 1
             context.last_response = (
                 context.step, _strip_id(response), list(blobs),
             )
+            if trace is not None:
+                # the snapshot carries the committing request's trace
+                # context: a survivor resuming this sequence after our
+                # death continues the SAME trace id (resume_span)
+                context.trace_ctx = trace.traceparent()
         fleet = self.fleet
         if fleet is None or not context.durable:
             return

@@ -230,6 +230,7 @@ def lm_streaming_batched_model(name="lm_streaming_batched", runner=None,
         sched = batched.scheduler
         sched.set_registry(engine.metrics)
         sched.tracer = engine.tracer
+        sched.flight = getattr(engine, "flight", None)
         if engine.qos is not None:
             sched.tenant_lane_share = engine.qos.lane_share
             sched.tenant_priority = engine.qos.priority

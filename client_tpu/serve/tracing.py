@@ -25,6 +25,7 @@ trace).
 """
 
 import collections
+import contextlib
 import threading
 import time
 
@@ -42,8 +43,34 @@ __all__ = [
     "RequestTrace",
     "Tracer",
     "TRACE_SETTING_DEFAULTS",
+    "current_trace",
     "normalize_trace_settings",
+    "push_trace",
 ]
+
+# The request trace active on THIS thread (the engine brackets execute()
+# with push_trace).  The fleet tier reads it so a peer RPC issued while
+# serving a traced request records a child span under the request's trace
+# id — no plumbing of the trace object through every call layer.
+_ACTIVE = threading.local()
+
+
+def current_trace():
+    """The RequestTrace the current thread is serving, or None."""
+    return getattr(_ACTIVE, "trace", None)
+
+
+@contextlib.contextmanager
+def push_trace(trace):
+    """Install *trace* (may be None) as this thread's active request
+    trace for the duration of the block; always restores the previous
+    one (nested ensemble steps re-enter the engine on the same thread)."""
+    prev = getattr(_ACTIVE, "trace", None)
+    _ACTIVE.trace = trace
+    try:
+        yield trace
+    finally:
+        _ACTIVE.trace = prev
 
 TRACE_LEVELS = ("OFF", "TIMESTAMPS", "TENSORS")
 
@@ -120,6 +147,10 @@ class RequestTrace(_SpanBase):
         # branch overlap reads straight off the exported timeline
         self.step = step
         self.ensemble = ensemble
+        # free-form key/value tags (peer url, bytes, breaker state,
+        # hit/miss, resume provenance) — exported verbatim so traceview
+        # can attribute time without parsing event names
+        self.tags = {}
 
     def traceparent(self):
         return format_traceparent(self.trace_id, self.span_id)
@@ -143,6 +174,8 @@ class RequestTrace(_SpanBase):
             record["composing_model"] = self.model_name
         if self.ensemble:
             record["ensemble"] = self.ensemble
+        if self.tags:
+            record["tags"] = dict(self.tags)
         if self.error:
             record["error"] = self.error
         return record
@@ -167,6 +200,15 @@ class Tracer:
         # hundreds of times a second and must not evict request spans
         self._tick_seen = 0
         self.tick_completed = collections.deque(maxlen=max_traces)
+        # fleet peer-RPC child spans (client side of prefix/cache/seq
+        # lookups, durability pushes, anti-entropy) and the peer-server
+        # side's serve spans — bounded apart from request spans for the
+        # same reason as ticks
+        self.peer_completed = collections.deque(maxlen=max_traces)
+        # completion hook (the engine points it at the flight recorder so
+        # every finished span lands in the postmortem ring even when no
+        # trace_file is configured); called OUTSIDE the tracer lock
+        self.on_complete = None
 
     def enabled(self):
         levels = self._settings.get("trace_level") or ["OFF"]
@@ -236,6 +278,12 @@ class Tracer:
                     to_write = self._pending_flush
                     self._pending_flush = []
         self._write(trace_file, to_write)
+        on_complete = self.on_complete
+        if on_complete is not None:
+            try:
+                on_complete(trace)
+            except Exception:
+                pass  # observability must never fail the request path
 
     def tick_span(self, kind, t0, t1):
         """One continuous-batching scheduler tick as a completed COMPUTE
@@ -269,6 +317,115 @@ class Tracer:
         span.event("COMPUTE_START", now - int((t1 - t0) * 1e9))
         span.event("COMPUTE_END", now)
         self._complete_into(span, self.tick_completed)
+
+    def _span_seq(self):
+        with self._lock:
+            self._seq += 1
+            return self._seq
+
+    @contextlib.contextmanager
+    def peer_span(self, op, peer="", **tags):
+        """Bracket one fleet peer RPC with PEER_START/PEER_END.
+
+        A request-thread peer call (prefix/cache/sequence lookup, the
+        synchronous durability push) records a CHILD span under the
+        thread's active request trace, so a peer fetch shows inside the
+        originating request's timeline.  Off-request callers (the
+        anti-entropy thread) get a standalone span with its own trace id,
+        subsampled on ``trace_rate`` with the tick counter so background
+        pushes never drain the request budget.  Yields the span (or None
+        when nothing records); callers stamp result tags onto
+        ``span.tags`` before the block exits."""
+        parent = current_trace()
+        if parent is not None:
+            span = RequestTrace(
+                parent.trace_id, gen_span_id(),
+                parent_span_id=parent.span_id,
+                model_name=f"__peer_{op}__", protocol="fleet",
+                seq=self._span_seq(),
+            )
+        elif self.enabled():
+            rate = max(
+                self._int_setting(self._settings, "trace_rate", 1), 1
+            )
+            with self._lock:
+                seen = self._tick_seen
+                self._tick_seen += 1
+            if seen % rate:
+                span = None
+            else:
+                span = RequestTrace(
+                    gen_trace_id(), gen_span_id(),
+                    model_name=f"__peer_{op}__", protocol="fleet",
+                    seq=self._span_seq(),
+                )
+        else:
+            span = None
+        if span is None:
+            yield None
+            return
+        span.tags["op"] = op
+        if peer:
+            span.tags["peer"] = peer
+        span.tags.update(tags)
+        span.event("PEER_START")
+        try:
+            yield span
+        except Exception as e:
+            span.error = str(e)
+            raise
+        finally:
+            span.event("PEER_END")
+            self._complete_into(span, self.peer_completed)
+
+    @contextlib.contextmanager
+    def serve_span(self, op, traceparent=None, **tags):
+        """The peer-server half of a fleet RPC: a span under the CALLING
+        replica's trace id when the frame carried a traceparent — the
+        receipt that joins a cross-replica fetch into one trace spanning
+        both processes.  Frames with no trace context record nothing
+        (the caller decided not to sample)."""
+        parent = parse_traceparent(traceparent)
+        if parent is None:
+            yield None
+            return
+        span = RequestTrace(
+            parent[0], gen_span_id(), parent_span_id=parent[1],
+            model_name=f"__peer_{op}__", protocol="fleet",
+            seq=self._span_seq(),
+        )
+        span.tags["op"] = op
+        span.tags["side"] = "serve"
+        span.tags.update(tags)
+        span.event("COMPUTE_START")
+        try:
+            yield span
+        except Exception as e:
+            span.error = str(e)
+            raise
+        finally:
+            span.event("COMPUTE_END")
+            self._complete_into(span, self.peer_completed)
+
+    def resume_span(self, traceparent, seq_id, **tags):
+        """One SEQ_RESUME marker span CONTINUING a replicated snapshot's
+        trace id: a survivor resuming a dead replica's durable sequence
+        stamps the resume into the ORIGINATING trace, so the failover
+        reads as one trace spanning the dead and surviving processes.
+        No-op (returns None) when the snapshot carried no trace context."""
+        parent = parse_traceparent(traceparent)
+        if parent is None:
+            return None
+        span = RequestTrace(
+            parent[0], gen_span_id(), parent_span_id=parent[1],
+            model_name="__seq_resume__", protocol="fleet",
+            seq=self._span_seq(),
+        )
+        span.tags["sequence_id"] = seq_id
+        span.tags.update(tags)
+        span.event("SEQ_RESUME")
+        self._complete_into(span, self.peer_completed)
+        return span
 
     def flush(self):
         """Force any buffered records to the trace file (engine close)."""

@@ -358,6 +358,29 @@ def assert_lock_witness_acyclic(witness):
     return witness.assert_acyclic()
 
 
+def _fixture_recorders(fixture):
+    """Every flight recorder reachable from *fixture*: an explicit
+    ``flight_recorders()`` hook wins; otherwise the standard shapes —
+    ``fixture.servers`` (Server objects) and ``fixture.engines`` — are
+    scanned for ``engine.flight``."""
+    hook = getattr(fixture, "flight_recorders", None)
+    if callable(hook):
+        try:
+            return list(hook())
+        except Exception:
+            return []
+    recorders = []
+    for server in getattr(fixture, "servers", None) or ():
+        flight = getattr(getattr(server, "engine", None), "flight", None)
+        if flight is not None:
+            recorders.append(flight)
+    for engine in getattr(fixture, "engines", None) or ():
+        flight = getattr(engine, "flight", None)
+        if flight is not None:
+            recorders.append(flight)
+    return recorders
+
+
 class ChaosMatrix:
     """A suite of scenarios over one fixture family.
 
@@ -367,16 +390,37 @@ class ChaosMatrix:
     - ``apply_fault(fault)`` — usually a :func:`dispatch_fault` closure;
     - ``drivers()`` — the workload callables to run on threads;
     - ``check(result)`` — the scenario's invariant pass (raise to fail);
-    - ``close()`` (optional) — teardown, always called.
+    - ``close()`` (optional) — teardown, always called;
+    - ``flight_recorders()`` (optional) — recorders to dump when an
+      invariant fails (default: every ``server.engine.flight`` /
+      ``engine.flight`` on the fixture).
 
     Invariants passed to the constructor run after EVERY scenario's own
     ``check`` — the cross-cutting floor (exactly-once, pool-free, lock
     witness) that no scenario may opt out of.
+
+    A failed ``check``/invariant DUMPS every reachable flight recorder
+    before the failure propagates: the red matrix entry ships its own
+    postmortem (recent spans, tick timings, preemptions, faults) instead
+    of asking for a re-run with tracing on.  ``make chaos``/``make soak``
+    point ``TPU_FLIGHT_DIR`` at ``build/flight/`` so the dumps survive
+    the failed run.
     """
 
     def __init__(self, scenarios, invariants=()):
         self.scenarios = list(scenarios)
         self.invariants = list(invariants)
+
+    def _dump_on_failure(self, fixture, scenario, exc):
+        for recorder in _fixture_recorders(fixture):
+            try:
+                recorder.note(
+                    "chaos_invariant_failure", scenario=scenario.name,
+                    error=repr(exc),
+                )
+                recorder.dump(f"chaos-{scenario.name}")
+            except Exception:
+                pass  # the invariant failure is the story, not the dump
 
     def run(self, make_fixture, join_timeout_s=600.0):
         results = []
@@ -387,9 +431,13 @@ class ChaosMatrix:
                     scenario, fixture.apply_fault, fixture.drivers(),
                     join_timeout_s=join_timeout_s,
                 )
-                fixture.check(result)
-                for invariant in self.invariants:
-                    invariant(fixture, result)
+                try:
+                    fixture.check(result)
+                    for invariant in self.invariants:
+                        invariant(fixture, result)
+                except BaseException as exc:
+                    self._dump_on_failure(fixture, scenario, exc)
+                    raise
             finally:
                 close = getattr(fixture, "close", None)
                 if close is not None:

@@ -133,15 +133,23 @@ class ClientTrace:
 
 
 @contextlib.contextmanager
-def client_span(tracer, model_name):
+def client_span(tracer, model_name, context_key=None):
     """Bracket one client request: sample a trace from *tracer* (yields
     None when tracing is off or the request is not sampled), record
     CLIENT_REQUEST_START/END, capture the error on failure, and always
     complete the trace.  The shared request-bracket all four clients use —
     span semantics change here, once, not per transport.  Synchronous on
     purpose: the trace calls never block, so coroutine clients use it too.
+
+    ``context_key`` pins every request sharing the key under ONE trace id
+    (each request still gets its own span): the replicated clients key it
+    on the sequence id, so all steps of a sequence — including the retries
+    and failover hops after a replica death — join as one trace.
     """
-    trace = tracer.sample(model_name) if tracer is not None else None
+    trace = (
+        tracer.sample(model_name, context_key=context_key)
+        if tracer is not None else None
+    )
     if trace is None:
         yield None
         return
@@ -189,15 +197,49 @@ class ClientTracer:
         self._lock = threading.Lock()
         self._seen = 0
         self.traces = collections.deque(maxlen=max_traces)
+        # context_key -> pinned decision: a trace id (every request
+        # sharing the key joins one trace) or None (the key's FIRST
+        # request was unsampled, so the whole sequence stays untraced —
+        # a sequence is traced whole or not at all, never from a random
+        # mid-step).  Bounded; release_context drops a finished key.
+        self._pinned = collections.OrderedDict()
 
-    def sample(self, model_name=""):
-        """A new ClientTrace for this request, or None (not sampled)."""
+    def sample(self, model_name="", context_key=None):
+        """A new ClientTrace for this request, or None (not sampled).
+
+        With ``context_key``, the key's FIRST request decides sampling
+        for every request sharing it: sampled mints the shared trace id,
+        unsampled pins the whole key untraced — so with ``trace_rate``
+        > 1 a sequence is traced from its first step or not at all."""
         with self._lock:
             seen = self._seen
             self._seen += 1
-        if seen % self.trace_rate:
+            if context_key is not None and context_key in self._pinned:
+                trace_id = self._pinned[context_key]
+                if trace_id is None:
+                    return None
+                return ClientTrace(trace_id, gen_span_id(), model_name)
+        sampled = not seen % self.trace_rate
+        if context_key is None:
+            if not sampled:
+                return None
+            return ClientTrace(gen_trace_id(), gen_span_id(), model_name)
+        with self._lock:
+            trace_id = self._pinned.setdefault(
+                context_key, gen_trace_id() if sampled else None
+            )
+            self._pinned.move_to_end(context_key)
+            while len(self._pinned) > 4096:
+                self._pinned.popitem(last=False)
+        if trace_id is None:
             return None
-        return ClientTrace(gen_trace_id(), gen_span_id(), model_name)
+        return ClientTrace(trace_id, gen_span_id(), model_name)
+
+    def release_context(self, context_key):
+        """Drop a pinned trace id (the sequence ended; a restarted
+        sequence id then starts a fresh trace)."""
+        with self._lock:
+            self._pinned.pop(context_key, None)
 
     def complete(self, trace):
         with self._lock:

@@ -1,0 +1,24 @@
+"""SPAN-LEAK fixture: spans started without a finish on every exit path.
+
+Freezes the two leak shapes the rule exists for: a sampled span whose
+completion sits on the happy path only (any raise between start and
+finish loses the record), and a started timer that is never finished at
+all.  Pre-fix shape of the tracing brackets before they grew their
+try/finally.
+"""
+
+
+def handle_request(tracer, engine, request):
+    trace = tracer.sample(request.model)  # BAD: completed outside finally
+    trace.event("REQUEST_START")
+    response = engine.execute(request.model, request.body)
+    trace.event("RESPONSE_SENT")
+    tracer.complete(trace)  # never runs when execute() raises
+    return response
+
+
+def time_tick(metrics, fn):
+    timer = metrics.start_timer("tick")  # BAD: never finished at all
+    result = fn()
+    metrics.observe("tick_result", result)
+    return result

@@ -233,6 +233,24 @@ def test_bg_thread_crash_clean():
     assert _scan("bg_thread_crash_ok.py") == []
 
 
+def test_span_leak_hits():
+    """The leaked-span shapes (the tracing brackets' invariant): a
+    sampled span completed on the happy path only, and a started timer
+    never finished at all."""
+    findings = _scan("span_leak_bad.py")
+    assert _rules_hit(findings) == ["SPAN-LEAK"]
+    assert len(findings) == 2
+    messages = " ".join(f.message for f in findings)
+    assert "outside any finally" in messages
+    assert "never finishes" in messages
+
+
+def test_span_leak_clean():
+    """try/finally completion, the context-manager form, and both
+    ownership transfers (returned / handed to a callee) stay silent."""
+    assert _scan("span_leak_ok.py") == []
+
+
 def test_time_wall_hits():
     findings = _scan("time_wall_bad.py")
     assert _rules_hit(findings) == ["TIME-WALL"]
@@ -900,6 +918,7 @@ def test_cli_fails_on_each_seeded_bad_fixture():
         ("bare_suppress_bad.py", "BARE-SUPPRESS"),
         ("refcount_pair_bad.py", "REFCOUNT-PAIR"),
         ("bg_thread_crash_bad.py", "BG-THREAD-CRASH"),
+        ("span_leak_bad.py", "SPAN-LEAK"),
     ):
         proc = _cli(
             f"tests/analysis_fixtures/{name}", "--no-baseline", "--no-cache"

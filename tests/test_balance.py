@@ -1037,11 +1037,20 @@ class TestChaosReplicaSet:
                 if hop_trace is not None:
                     break
             assert hop_trace is not None
-            joined = [
-                r for r in read_trace_file(trace_file)
-                if r["trace_id"] == hop_trace.trace_id
-            ]
-            sources = {r["source"] for r in joined}
+            # the server appends its span AFTER sending the response, so
+            # the client can observe success before the record lands —
+            # poll briefly instead of racing the handler's final write
+            deadline = time.monotonic() + 2.0
+            while True:
+                joined = [
+                    r for r in read_trace_file(trace_file)
+                    if r["trace_id"] == hop_trace.trace_id
+                ]
+                sources = {r["source"] for r in joined}
+                if sources == {"client", "server"} \
+                        or time.monotonic() > deadline:
+                    break
+                time.sleep(0.02)
             assert sources == {"client", "server"}
             client_rec = next(r for r in joined if r["source"] == "client")
             server_rec = next(r for r in joined if r["source"] == "server")

@@ -23,17 +23,23 @@ Three layers under test:
 ``make soak`` repeats the slow-marked scaled variants.
 """
 
+import json
+import os
 import threading
 import time
+import types
 
 import numpy as np
 import pytest
 
 import jax
 
+from client_tpu import traceview
 from client_tpu.balance.replicated import ReplicatedClient
 from client_tpu.serve import InferenceEngine, Model, Server, TensorSpec
 from client_tpu.serve.fleet import FleetTier
+from client_tpu.serve.flight import FlightRecorder
+from client_tpu.tracing import ClientTracer
 from client_tpu.serve.lm import LmEngine
 from client_tpu.serve.metrics import Registry
 from client_tpu.serve.models import transformer as tfm
@@ -406,6 +412,11 @@ class _SeqChaosFixture:
             for _ in range(3)
         ]
         _peer_up(self.tiers)
+        # fleet-wide tracing (the one-trace failover acceptance): each
+        # replica writes its own trace file, the client a fourth —
+        # traceview joins them by trace id after the run
+        self.trace_dir = scenario.params.get("trace_dir")
+        self.trace_files = []
         self.servers = []
         self.proxies = []
         for i, tier in enumerate(self.tiers):
@@ -413,11 +424,26 @@ class _SeqChaosFixture:
                 models=[_seq_model(self.ledger, f"r{i}")],
                 with_default_models=False, fleet=tier,
             ).start()
+            if self.trace_dir:
+                trace_file = os.path.join(
+                    self.trace_dir, f"replica{i}.jsonl"
+                )
+                self.trace_files.append(trace_file)
+                server.engine.update_trace_settings({
+                    "trace_level": ["TIMESTAMPS"], "trace_rate": "1",
+                    "trace_count": "-1", "trace_file": trace_file,
+                })
             self.servers.append(server)
             self.proxies.append(FaultProxy(server.http_address))
+        tracer = None
+        if self.trace_dir:
+            client_file = os.path.join(self.trace_dir, "client.jsonl")
+            self.trace_files.append(client_file)
+            tracer = ClientTracer(trace_file=client_file, trace_rate=1)
         self.client = ReplicatedClient(
             [proxy.address for proxy in self.proxies],
             transport="http", policy="sticky", probe_interval_s=0.5,
+            tracer=tracer,
         )
 
     def apply_fault(self, fault):
@@ -537,6 +563,104 @@ def test_sigkill_with_active_durable_sequences():
     ])
     results = matrix.run(_SeqChaosFixture, join_timeout_s=180)
     assert results[0].fired, "the kill never fired"
+
+
+def test_sigkill_failover_joins_one_trace(tmp_path, capsys):
+    """Acceptance: a kill-mid-stream failover reads as ONE trace spanning
+    three processes' trace files.  The client pins every step of a
+    sequence under one trace id, the dead replica's server spans joined
+    it via traceparent, the survivor's ``__seq_resume__`` marker
+    CONTINUES it from the replicated snapshot, and the peer-tier child
+    spans (durability ``seq_put`` pushes, the resume-side lookup) hang
+    under it — and traceview joins all four files into one timeline."""
+    scenario = _seq_sigkill_scenario(
+        "seq-sigkill-traced", sessions=5, steps=8, at_s=0.35,
+        think_s=0.08, require_resume=True, trace_dir=str(tmp_path),
+    )
+    matrix = ChaosMatrix([scenario])
+    results = matrix.run(_SeqChaosFixture, join_timeout_s=180)
+    assert results[0].fired, "the kill never fired"
+    files = sorted(str(p) for p in tmp_path.glob("*.jsonl"))
+    assert len(files) == 4  # three replicas + the client
+    records = traceview.load_records(files)
+    traces = traceview.join_traces(records)
+    by_file = {
+        f: {r.get("trace_id") for r in traceview.load_records([f])}
+        for f in files
+    }
+    # a survivor resumed the dead replica's sequence INTO the same trace
+    resumes = [
+        r for r in records if r.get("model_name") == "__seq_resume__"
+    ]
+    assert resumes, "no resume marker span — the failover left no trace"
+    trace_id = resumes[0]["trace_id"]
+    spans = traces[trace_id]
+    assert {r.get("source") for r in spans} == {"client", "server"}
+    # the ONE trace id appears in the client's file and >= 2 replicas'
+    holding = [f for f, tids in by_file.items() if trace_id in tids]
+    assert any(f.endswith("client.jsonl") for f in holding)
+    assert sum(1 for f in holding if "replica" in f) >= 2, (
+        f"trace {trace_id} should span the dead replica AND a survivor; "
+        f"found only {holding}"
+    )
+    # peer-tier child spans under the same trace (durability pushes
+    # and/or the survivor's sequence lookup)
+    assert any(
+        str(r.get("model_name", "")).startswith("__peer_seq")
+        for r in spans
+    )
+    # the client's attempt pairs show the endpoint hop across the kill
+    endpoints = {
+        ts.get("endpoint")
+        for r in spans if r.get("source") == "client"
+        for ts in r.get("timestamps") or ()
+        if ts.get("endpoint")
+    }
+    assert len(endpoints) >= 2, (
+        f"expected attempts on both sides of the kill, saw {endpoints}"
+    )
+    # the traceview CLI joins the same story (and --format json scripts)
+    assert traceview.main(["--format", "json", "--trace", trace_id,
+                           *files]) == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 1
+    doc = json.loads(out[0])
+    assert doc["trace_id"] == trace_id
+    assert doc["critical_path"]["total_ms"] > 0
+    assert doc["critical_path"]["peer_ms"] > 0
+
+
+def test_invariant_failure_dumps_flight_recorders(tmp_path):
+    """A failed chaos invariant ships its own postmortem: ChaosMatrix
+    dumps every reachable flight recorder before the failure
+    propagates, and the dump names the scenario and the error."""
+    recorder = FlightRecorder(dump_dir=str(tmp_path), name="r0")
+    recorder.note("tick", n=1)
+
+    class _Fixture:
+        servers = [types.SimpleNamespace(
+            engine=types.SimpleNamespace(flight=recorder)
+        )]
+
+        def apply_fault(self, fault):
+            pass
+
+        def drivers(self):
+            return []
+
+        def check(self, result):
+            raise AssertionError("invariant broken")
+
+    matrix = ChaosMatrix([ChaosScenario("boom")])
+    with pytest.raises(AssertionError, match="invariant broken"):
+        matrix.run(lambda scenario: _Fixture(), join_timeout_s=5)
+    dumps = sorted(tmp_path.glob("flight-*.jsonl"))
+    assert dumps, "no flight dump written on invariant failure"
+    lines = [json.loads(line) for line in open(dumps[0])]
+    assert lines[0]["kind"] == "flight_dump"
+    assert lines[0]["reason"].startswith("chaos-boom")
+    kinds = {r["kind"] for r in lines[1:]}
+    assert {"tick", "chaos_invariant_failure"} <= kinds
 
 
 @pytest.mark.slow

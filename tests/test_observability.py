@@ -13,7 +13,9 @@ Covers:
   and the RetryPolicy/CircuitBreaker observer hooks feeding the registry.
 """
 
+import json
 import re
+import time
 import urllib.request
 
 import numpy as np
@@ -558,3 +560,152 @@ class TestTraceparentHelpers:
     def test_malformed_headers_are_ignored(self):
         for bad in ("", None, "zz", "00-short-span-01", "oo-" + "0" * 53):
             assert parse_traceparent(bad) is None
+
+
+class TestSloAndFlightSurface:
+    """The SLO watchdog + flight recorder as wired into a real server:
+    gauges on /metrics, the debug endpoints, breach-triggered dumps, and
+    the metrics-manager prefix audit."""
+
+    def test_slo_gauges_reach_metrics_endpoint(self):
+        with Server(http_port=0) as server:
+            with httpclient.InferenceServerClient(server.http_address) as c:
+                _infer_simple(c, n=4)
+            server.engine.slo.check_now()
+            families = parse_exposition(_scrape(server))
+            assert "ctpu_slo_p99_ms" in families
+            samples = families["ctpu_slo_p99_ms"]["samples"]
+            assert any(
+                labels.get("model") == "simple" and value > 0
+                for _n, labels, value in samples
+            )
+            assert "ctpu_slo_error_rate" in families
+
+    def test_slo_debug_endpoint(self):
+        with Server(http_port=0) as server:
+            with httpclient.InferenceServerClient(server.http_address) as c:
+                _infer_simple(c, n=2)
+            body = urllib.request.urlopen(
+                f"http://{server.http_address}/v2/debug/slo"
+            ).read()
+            summary = json.loads(body)
+            assert summary["simple|"]["count"] == 2
+
+    def test_flight_debug_endpoint_serves_ring(self, tmp_path):
+        with Server(http_port=0) as server:
+            server.engine.update_trace_settings({
+                "trace_level": ["TIMESTAMPS"], "trace_rate": "1",
+            })
+            with httpclient.InferenceServerClient(server.http_address) as c:
+                _infer_simple(c, n=1)
+            # the span reaches the ring when the handler COMPLETES the
+            # trace, after the response is sent — poll instead of racing
+            # the handler's final write
+            deadline = time.monotonic() + 2.0
+            while True:
+                body = urllib.request.urlopen(
+                    f"http://{server.http_address}/v2/debug/flight"
+                ).read().decode()
+                lines = [json.loads(line) for line in body.splitlines()]
+                if any(r["kind"] == "span" for r in lines[1:]) \
+                        or time.monotonic() > deadline:
+                    break
+                time.sleep(0.02)
+            assert lines[0]["kind"] == "flight_dump"
+            assert lines[0]["reason"] == "debug_endpoint"
+            assert any(r["kind"] == "span" for r in lines[1:])
+
+    def test_induced_breach_counts_and_dumps(self, tmp_path):
+        """The acceptance bullet: an induced p99 breach produces a
+        flight-recorder dump plus ctpu_slo_breaches_total >= 1."""
+        from client_tpu.serve.flight import FlightRecorder
+        from client_tpu.serve.slo import SloWatchdog
+
+        def slow_fn(inputs, params, ctx):
+            time.sleep(0.02)  # 20ms against a 1ms objective
+            return {"OUT": inputs["IN"]}
+
+        slow = Model(
+            "slow",
+            inputs=[TensorSpec("IN", "FP32", [-1])],
+            outputs=[TensorSpec("OUT", "FP32", [-1])],
+            fn=slow_fn,
+        )
+        watchdog = SloWatchdog(
+            objectives={"slow": {"p99_ms": 1.0}},
+            min_samples=4, check_every=4, dump_interval_s=0.0,
+        )
+        with Server(models=[slow], with_default_models=False,
+                    http_port=0, slo=watchdog) as server:
+            server.engine.flight.dump_dir = str(tmp_path)
+            with httpclient.InferenceServerClient(server.http_address) as c:
+                inp = httpclient.InferInput("IN", [4], "FP32")
+                inp.set_data_from_numpy(np.ones(4, np.float32))
+                for _ in range(8):
+                    c.infer("slow", [inp])
+            families = parse_exposition(_scrape(server))
+            assert "ctpu_slo_breaches_total" in families
+            total = sum(
+                value
+                for _n, labels, value in
+                families["ctpu_slo_breaches_total"]["samples"]
+                if labels.get("model") == "slow"
+            )
+            assert total >= 1
+            dumps = list(tmp_path.glob("flight-*-slo_breach.jsonl"))
+            assert dumps, "breach produced no flight dump"
+            lines = [json.loads(line) for line in open(dumps[0])]
+            assert any(r["kind"] == "slo_breach" for r in lines[1:])
+            assert "ctpu_flight_dumps_total" in families
+
+    def test_4xx_is_not_an_slo_error(self):
+        with Server(http_port=0) as server:
+            client = httpclient.InferenceServerClient(server.http_address)
+            with pytest.raises(InferenceServerException):
+                client.infer("no_such_model", [])
+            client.close()
+            summary = server.engine.slo.check_now()
+            entry = summary.get("no_such_model|")
+            assert entry is not None and entry["error_rate"] == 0.0
+
+    def test_metrics_manager_summarizes_prefixed_series(self):
+        from client_tpu.perf.metrics_manager import MetricsManager
+
+        first = {
+            "ctpu_slo_p99_ms": [('{model="m",tenant=""}', 12.0)],
+            "ctpu_fleet_peer_hits_total": [('{op="prefix"}', 3.0)],
+            "ctpu_lm_kv_blocks_used": [("", 7.0)],
+        }
+        last = {
+            "ctpu_slo_p99_ms": [('{model="m",tenant=""}', 16.0)],
+            "ctpu_fleet_peer_hits_total": [('{op="prefix"}', 9.0)],
+            "ctpu_lm_kv_blocks_used": [("", 5.0)],
+        }
+        summary = MetricsManager.summarize([first, last])
+        assert summary["ctpu_slo_p99_ms"] == {"avg": 14.0, "max": 16.0}
+        # counters report the window delta
+        assert summary["ctpu_fleet_peer_hits_total"]["avg"] == 6.0
+        assert summary["ctpu_lm_kv_blocks_used"]["max"] == 7.0
+
+    def test_quantile_and_rate_gauges_fold_by_max_not_sum(self):
+        """Two models' p99s must NOT sum into a latency nobody saw (and
+        summed error rates would exceed 1.0) — non-additive gauges take
+        the worst label set per snapshot."""
+        from client_tpu.perf.metrics_manager import MetricsManager
+
+        snap = {
+            "ctpu_slo_p99_ms": [
+                ('{model="a",tenant=""}', 100.0),
+                ('{model="b",tenant=""}', 400.0),
+            ],
+            "ctpu_slo_error_rate": [
+                ('{model="a",tenant=""}', 0.5),
+                ('{model="b",tenant=""}', 0.5),
+            ],
+            "ctpu_lm_kv_blocks_used": [("", 3.0), ("", 4.0)],
+        }
+        summary = MetricsManager.summarize([snap])
+        assert summary["ctpu_slo_p99_ms"]["max"] == 400.0
+        assert summary["ctpu_slo_error_rate"]["max"] == 0.5
+        # usage gauges still fold additively across label sets
+        assert summary["ctpu_lm_kv_blocks_used"]["max"] == 7.0
