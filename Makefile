@@ -4,24 +4,34 @@
 #   make lint        tpu-lint static analysis (client_tpu/analysis):
 #                    per-file concurrency & numpy-semantics rules PLUS the
 #                    whole-program pass (call-graph lock summaries:
-#                    LOCK-INV, BLOCK-UNDER-LOCK, CALLBACK-UNDER-LOCK).
-#                    Runs over client_tpu/ AND tests/; exits non-zero on
-#                    any finding not grandfathered in analysis/baseline.json.
-#                    Incremental (mtime+rules-hash cache); `--no-cache` to
-#                    force cold.  Suppressions require a reason:
-#                    `# tpulint: disable=RULE -- why`.
+#                    LOCK-INV, BLOCK-UNDER-LOCK, CALLBACK-UNDER-LOCK,
+#                    PEER-CALL-UNDER-LOCK, and Eraser-style lockset
+#                    inference: LOCKSET-RACE).  Runs over client_tpu/ AND
+#                    tests/; exits non-zero on any finding not
+#                    grandfathered in analysis/baseline.json.  Incremental
+#                    (mtime+rules-hash per-file cache + a fileset-digest
+#                    cache for the program pass — a warm repeat run is
+#                    ~1s); `--no-cache` to force cold.  Suppressions
+#                    require a reason (`# tpulint: disable=RULE -- why`)
+#                    and are audited: a waiver whose rule no longer fires
+#                    is itself a finding (STALE-SUPPRESS).
+#   make lint-sarif  lint, emitting SARIF 2.1.0 to build/lint.sarif for
+#                    CI annotators and editors (same gate semantics).
 #   make lint-strict lint, plus examples/ in the scanned program.
 #   make test        ASAN native tests + the python suite.
 #   make check       the PR gate, reproduced locally: make lint + the
 #                    tier-1 pytest command (ROADMAP.md "Tier-1 verify").
 #   make chaos       the fast chaos-matrix subset (tests/test_chaos.py:
 #                    deterministic fault schedules + invariant checkers)
-#                    under the dynamic lock-order witness — the quick
-#                    failure-domain gate.
+#                    under the dynamic lock-order AND race witnesses
+#                    (TPULINT_LOCK_WITNESS=1 TPULINT_RACE_WITNESS=1) —
+#                    the quick failure-domain gate.
 #   make soak        slow-tier chaos repetition, run under the DYNAMIC
-#                    lock-order witness (TPULINT_LOCK_WITNESS=1): every
-#                    lock built under client_tpu/ records the real
-#                    acquisition DAG; a cycle fails the round.
+#                    witnesses: every lock built under client_tpu/
+#                    records the real acquisition DAG (a cycle fails the
+#                    round) and @witness_shared classes run the Eraser
+#                    lockset algorithm per field access (an unguarded
+#                    shared write fails with both stacks + a flight dump).
 
 PROTO_DIR := proto
 PB_OUT := client_tpu/_proto
@@ -31,10 +41,18 @@ NATIVE_OUT := client_tpu/utils/shared_memory
 TPUSHM_OUT := client_tpu/utils/tpu_shared_memory
 
 .PHONY: all protos native cpp clean test asan java java-bindings lint \
-        lint-strict check soak chaos
+        lint-sarif lint-strict check soak chaos
 
 lint:
 	python -m client_tpu.analysis client_tpu tests
+
+# Same gate, SARIF 2.1.0 artifact for CI annotation / editor import.
+# The redirect preserves the exit code: findings still fail the target,
+# but the .sarif lands either way so the annotator can show them.
+lint-sarif:
+	@mkdir -p build
+	python -m client_tpu.analysis client_tpu tests --format sarif \
+	    > build/lint.sarif
 
 lint-strict:
 	python -m client_tpu.analysis client_tpu tests examples
@@ -54,7 +72,7 @@ check: lint
 # its own postmortem artifacts.
 chaos:
 	@mkdir -p build/flight/chaos
-	@JAX_PLATFORMS=cpu TPULINT_LOCK_WITNESS=1 \
+	@JAX_PLATFORMS=cpu TPULINT_LOCK_WITNESS=1 TPULINT_RACE_WITNESS=1 \
 	    TPU_FLIGHT_DIR=build/flight/chaos \
 	    python -m pytest tests/test_chaos.py -q -m 'not slow' \
 	    -p no:cacheprovider -p no:xdist -p no:randomly || { \
@@ -73,8 +91,8 @@ SOAK_N ?= 3
 soak:
 	@mkdir -p build/flight/soak
 	@for i in $$(seq 1 $(SOAK_N)); do \
-	  echo "== soak round $$i/$(SOAK_N) (lock-order witness armed) =="; \
-	  JAX_PLATFORMS=cpu TPULINT_LOCK_WITNESS=1 \
+	  echo "== soak round $$i/$(SOAK_N) (lock-order + race witness armed) =="; \
+	  JAX_PLATFORMS=cpu TPULINT_LOCK_WITNESS=1 TPULINT_RACE_WITNESS=1 \
 	      TPU_FLIGHT_DIR=build/flight/soak \
 	      python -m pytest tests/test_discovery.py \
 	      tests/test_balance.py tests/test_frontdoor.py \

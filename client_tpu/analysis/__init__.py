@@ -4,13 +4,18 @@ Encodes this repo's recurring bug shapes as enforced rules — numpy
 truthiness in control flow, blocking calls in async bodies, device
 dispatch under scheduler locks, streaming queues abandoned without their
 close sentinel, loop-less ``Condition.wait``, unlocked writes to
-thread-shared state — plus three whole-program rules over a project-wide
-call graph with per-function lock summaries: lock-order inversion
+thread-shared state, waivers that outlived their hazard
+(STALE-SUPPRESS) — plus whole-program rules over a project-wide call
+graph with per-function lock summaries: lock-order inversion
 (LOCK-INV), blocking work reached under a lock through any call depth
-(BLOCK-UNDER-LOCK), and observer callbacks invoked while a private lock
-is held (CALLBACK-UNDER-LOCK).  A dynamic lock-order witness
-(``client_tpu.analysis.witness``) records the real acquisition DAG under
-test and keeps the static pass honest.
+(BLOCK-UNDER-LOCK), observer callbacks invoked while a private lock is
+held (CALLBACK-UNDER-LOCK), peer RPCs under engine/pool locks
+(PEER-CALL-UNDER-LOCK), and Eraser-style per-field lockset inference
+across thread roots (LOCKSET-RACE, ``analysis/locksets.py``).  Dynamic
+witnesses (``client_tpu.analysis.witness``) keep the static pass
+honest: ``LockWitness`` records the real acquisition DAG under test,
+and ``RaceWitness`` runs the lockset algorithm at runtime on
+``@witness_shared`` classes (``TPULINT_RACE_WITNESS=1``).
 
 Run ``python -m client_tpu.analysis [paths]`` (exits non-zero on
 findings) or ``make lint``.
@@ -18,21 +23,6 @@ findings) or ``make lint``.
 Pure stdlib on purpose: the gate must run anywhere the repo checks out,
 with or without jax present.
 """
-
-from client_tpu.analysis.core import (  # noqa: F401
-    Finding,
-    PROGRAM_REGISTRY,
-    ProgramRule,
-    REGISTRY,
-    Rule,
-    all_rules,
-    scan_paths,
-    scan_source,
-)
-from client_tpu.analysis import rules as _rules  # noqa: F401  (registers)
-from client_tpu.analysis import (  # noqa: F401  (registers)
-    concurrency as _concurrency,
-)
 
 __all__ = [
     "Finding",
@@ -44,3 +34,28 @@ __all__ = [
     "scan_paths",
     "scan_source",
 ]
+
+
+def _load_core():
+    """Import the analyzer on first use (PEP 562 lazy init).
+
+    Production modules import ``client_tpu.analysis.witness`` for the
+    ``@witness_shared`` decorator — a stdlib-only leaf.  An eager
+    package init would drag the full rule catalog (rules, callgraph,
+    concurrency, locksets) into every serving/perf process just to
+    attach an inert class attribute; loading lazily keeps the product
+    free of the lint tool until someone actually lints."""
+    from client_tpu.analysis import core
+    from client_tpu.analysis import rules  # noqa: F401  (registers)
+    from client_tpu.analysis import (  # noqa: F401  (registers)
+        concurrency,
+    )
+    return core
+
+
+def __getattr__(name):
+    if name in __all__:
+        return getattr(_load_core(), name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}"
+    )

@@ -7,6 +7,7 @@ usage/internal error.  ``make lint`` runs this over ``client_tpu tests``;
 
 import argparse
 import os
+import subprocess
 import sys
 
 from client_tpu.analysis import (
@@ -18,6 +19,54 @@ from client_tpu.analysis import (
 from client_tpu.analysis import baseline as baseline_mod
 from client_tpu.analysis import cache as cache_mod
 from client_tpu.analysis import report
+
+
+def _changed_files():
+    """Files changed vs the merge base with origin/main (falling back to
+    a local main, then to the index alone), plus untracked files —
+    normalized paths, or None when git itself is unusable (the caller
+    errors loudly: a silently-empty changed set would green-light
+    anything)."""
+    def git(*args):
+        try:
+            proc = subprocess.run(
+                ["git", *args], capture_output=True, text=True,
+                timeout=30,
+            )
+        except (subprocess.TimeoutExpired, OSError):
+            # a hung git (stale index lock, dead network fs) must reach
+            # the caller's loud exit-2 path, not die in a traceback
+            return None
+        if proc.returncode != 0:
+            return None
+        return proc.stdout
+
+    toplevel = git("rev-parse", "--show-toplevel")
+    if not toplevel:
+        return None
+    toplevel = toplevel.strip()
+    base = None
+    for ref in ("origin/main", "main"):
+        out = git("merge-base", "HEAD", ref)
+        if out:
+            base = out.strip()
+            break
+    diff = git("diff", "--name-only", base) if base else git(
+        "diff", "--name-only", "HEAD"
+    )
+    if diff is None:
+        return None
+    untracked = git("ls-files", "--others", "--exclude-standard")
+    names = diff.splitlines() + (
+        untracked.splitlines() if untracked else []
+    )
+    # git names are repo-root-relative; finding paths are CLI-relative
+    # (or absolute) — compare on one realpath basis so an absolute scan
+    # root or a subdirectory cwd cannot silently empty the changed set
+    return {
+        os.path.realpath(os.path.join(toplevel, n))
+        for n in names if n.strip()
+    }
 
 
 def main(argv=None):
@@ -34,12 +83,26 @@ def main(argv=None):
         help="files or directories to scan (default: client_tpu tests)",
     )
     parser.add_argument(
-        "--format", choices=("text", "json"), default="text",
-        help="report format (json is the machine-readable CI surface)",
+        "--format", choices=("text", "json", "sarif"), default="text",
+        help=(
+            "report format (json is the machine-readable CI surface; "
+            "sarif is SARIF 2.1.0 for CI annotators and editors — "
+            "`make lint-sarif` writes build/lint.sarif)"
+        ),
     )
     parser.add_argument(
         "--json", action="store_true",
         help="alias for --format json",
+    )
+    parser.add_argument(
+        "--changed-only", action="store_true",
+        help=(
+            "report per-file findings only for files changed vs "
+            "`git merge-base HEAD origin/main` (plus untracked files); "
+            "the whole-program passes still run over the full tree — "
+            "warm from cache — so cross-file findings never go dark. "
+            "The fast pre-commit path."
+        ),
     )
     parser.add_argument(
         "--baseline", default=baseline_mod.DEFAULT_BASELINE,
@@ -125,13 +188,36 @@ def main(argv=None):
         program_rules=program_rules,
     )
 
+    if args.changed_only:
+        changed = _changed_files()
+        if changed is None:
+            print(
+                "tpu-lint: --changed-only needs a working git checkout "
+                "(git diff failed)",
+                file=sys.stderr,
+            )
+            return 2
+        # per-file findings (the waiver audit included) narrow to the
+        # changed set; whole-program findings keep their full-tree
+        # scope — a cross-file hazard introduced by a changed file can
+        # anchor in an unchanged one
+        findings = [
+            f for f in findings
+            if f.rule not in REGISTRY
+            or os.path.realpath(f.path) in changed
+        ]
+
     if args.write_baseline:
-        if args.rules or args.paths != parser.get_default("paths"):
+        if (
+            args.rules
+            or args.changed_only
+            or args.paths != parser.get_default("paths")
+        ):
             # a filtered scan would overwrite the whole file and silently
             # drop every other rule's/path's grandfathered entries
             print(
                 "tpu-lint: --write-baseline requires a full default scan "
-                "(no --rules, default paths)",
+                "(no --rules, no --changed-only, default paths)",
                 file=sys.stderr,
             )
             return 2
@@ -149,6 +235,8 @@ def main(argv=None):
 
     if args.json or args.format == "json":
         print(report.render_json(new, old, all_rules()))
+    elif args.format == "sarif":
+        print(report.render_sarif(new, old, all_rules()))
     else:
         print(report.render_text(new, old, all_rules()))
     return 1 if new else 0

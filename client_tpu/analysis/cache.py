@@ -20,7 +20,7 @@ import hashlib
 import json
 import os
 
-_VERSION = 1
+_VERSION = 2  # v2: per-file suppression comments + the program entry
 DEFAULT_CACHE = os.path.join(os.path.dirname(__file__), ".cache.json")
 
 
@@ -40,15 +40,25 @@ def rules_hash():
 
 
 class AnalysisCache:
-    """mtime-keyed per-file result cache (see module docstring)."""
+    """mtime-keyed per-file result cache (see module docstring).
+
+    Beyond per-file entries, one **program entry** caches the whole-
+    program pass (program rules + the STALE-SUPPRESS audit) keyed on a
+    digest over every scanned file's ``(path, stat-key)``: edit one file
+    and only that file re-analyzes but the program pass reruns; touch
+    nothing and both come straight from cache.
+    """
 
     def __init__(self, path=DEFAULT_CACHE):
         self.path = path
         self._rules_hash = rules_hash()
         self._entries = {}
+        self._program = None  # {"digest": ..., "findings": [...]}
         self._dirty = False
         self.hits = 0
         self.misses = 0
+        self.program_hits = 0
+        self.program_misses = 0
         self._load()
 
     def _load(self):
@@ -65,6 +75,9 @@ class AnalysisCache:
         entries = data.get("files")
         if isinstance(entries, dict):
             self._entries = entries
+        program = data.get("program")
+        if isinstance(program, dict) and "digest" in program:
+            self._program = program
 
     def stat_key(self, path):
         """Freshness key for *path* (None when unstattable).  Callers
@@ -88,11 +101,42 @@ class AnalysisCache:
         self.hits += 1
         return entry["data"]
 
+    def stat_for(self, path):
+        """The stat key stored with *path*'s entry (None when absent) —
+        the fileset digest reuses it so a cache hit never re-stats."""
+        entry = self._entries.get(path)
+        return entry.get("stat") if entry else None
+
     def put(self, path, data, key):
         """Store *data* under the stat *key* captured before the read."""
         if key is None:
             return
         self._entries[path] = {"stat": key, "data": data}
+        self._dirty = True
+
+    def fileset_digest(self, fileset):
+        """Digest over the full scanned fileset's (path, stat-key)
+        pairs — the whole-program pass's freshness key.  Order-free:
+        the same files in any scan order digest identically."""
+        h = hashlib.sha256()
+        for path, key in sorted(fileset):
+            h.update(path.encode("utf-8"))
+            h.update(repr(key).encode("utf-8"))
+            h.update(b"\x00")
+        return h.hexdigest()
+
+    def get_program(self, digest):
+        """Cached whole-program findings (as dicts) when the fileset
+        digest still matches, else None."""
+        entry = self._program
+        if entry is None or entry.get("digest") != digest:
+            self.program_misses += 1
+            return None
+        self.program_hits += 1
+        return entry["findings"]
+
+    def put_program(self, digest, findings):
+        self._program = {"digest": digest, "findings": findings}
         self._dirty = True
 
     def save(self):
@@ -102,6 +146,7 @@ class AnalysisCache:
             "version": _VERSION,
             "rules_hash": self._rules_hash,
             "files": self._entries,
+            "program": self._program,
         }
         tmp = self.path + ".tmp"
         try:

@@ -13,9 +13,13 @@ builds the interprocedural substrate the ``concurrency.py`` rules run on:
   point), the *blocking operations* it performs (device dispatch, sleeps,
   timeout-less waits/joins/queue gets, sockets/subprocess), the *dynamic
   callback invocations* it makes (observer/callback-shaped attribute
-  calls, calls through parameters or ``getattr`` results), and its
-  outgoing *call edges* — each event stamped with the lock set lexically
-  held where it happens;
+  calls, calls through parameters or ``getattr`` results), its outgoing
+  *call edges*, and its **shared-field accesses** — ``self.``-rooted
+  reads/writes at up-to-two-segment path granularity (``kv`` vs
+  ``kv.pools``), each classified rebind vs interior mutation (*deep*)
+  and bare reference load vs interior observation, the raw material the
+  lockset pass (``locksets.py``) intersects — every event stamped with
+  the lock set lexically held where it happens;
 - a **program** index that resolves call references class/module-aware:
   ``self.method()`` through the class and its resolvable bases, bare and
   dotted names through module scope and import aliases, constructor calls
@@ -98,6 +102,15 @@ _EVENTISH_RE = re.compile(r"(?i)(^|_)(event|ev|done|ready|stop|closed)s?$")
 _QUEUEISH_RE = re.compile(r"(?i)(^|_)(q|queue|backlog|inbox|outbox)s?$")
 _THREADISH_RE = re.compile(r"(?i)(^|_)(thread|prober|worker|pump)s?$")
 
+# In-place mutators: a method call on a self-field through one of these
+# names mutates the field's object — for the lockset pass that is a
+# *write* access (the discovery-membership shape), not a read.
+_MUTATOR_METHODS = {
+    "append", "appendleft", "extend", "extendleft", "insert", "remove",
+    "pop", "popleft", "popitem", "clear", "discard", "add", "update",
+    "setdefault", "sort", "reverse",
+}
+
 
 def module_name_for(path):
     """Dotted module name for *path*.
@@ -141,9 +154,9 @@ class FunctionSummary:
 
     __slots__ = ("qualname", "name", "cls", "line", "requires_lock",
                  "params_min", "params_max", "acquisitions", "calls",
-                 "blocking", "callbacks",
+                 "blocking", "callbacks", "accesses",
                  # scanner scratch (never serialized)
-                 "_param_names", "_getattr_locals")
+                 "_param_names", "_getattr_locals", "_access_seen")
 
     def __init__(self, qualname, name, cls, line, requires_lock,
                  params_min, params_max):
@@ -163,6 +176,10 @@ class FunctionSummary:
         self.blocking = []
         # [{"desc", "line", "col", "held": [...]}]
         self.callbacks = []
+        # shared-field accesses (lockset pass): one entry per distinct
+        # (attr, kind, held) triple — [{"attr", "kind": "read"|"write",
+        # "line", "col", "held": [...]}]
+        self.accesses = []
 
     def to_dict(self):
         return {
@@ -172,6 +189,7 @@ class FunctionSummary:
             "acquisitions": self.acquisitions,
             "calls": [dict(c, ref=list(c["ref"])) for c in self.calls],
             "blocking": self.blocking, "callbacks": self.callbacks,
+            "accesses": self.accesses,
         }
 
     @classmethod
@@ -182,6 +200,7 @@ class FunctionSummary:
         fn.calls = [dict(c, ref=tuple(c["ref"])) for c in d["calls"]]
         fn.blocking = d["blocking"]
         fn.callbacks = d["callbacks"]
+        fn.accesses = d.get("accesses", [])
         return fn
 
 
@@ -287,6 +306,7 @@ class _FunctionScanner:
         self.cls = cls_name
         self.fn = fn_summary
         self.local_locks = local_locks  # local name -> kind
+        self._lambda_depth = 0
 
     # -- lock identity -------------------------------------------------------
 
@@ -415,6 +435,89 @@ class _FunctionScanner:
             return f"{recv}.{func.attr}()"
         return None
 
+    # -- shared-field accesses ----------------------------------------------
+
+    def _field_path(self, expr):
+        """(path, n_segments) for a ``self.``-rooted expression, or
+        (None, 0).  The path keeps up to two segments past ``self`` so
+        an owner-confined interior (``kv.pools``) is a distinct variable
+        from the shared reference (``kv``) — ``self.kv.pools["k"]``
+        accesses ``kv.pools``, ``self.kv.alloc(...)`` accesses ``kv``."""
+        text = _expr_text(expr)
+        if not text or not text.startswith("self.") or self.cls is None:
+            return None, 0
+        parts = text.split(".")
+        return ".".join(parts[1:3]), len(parts) - 1
+
+    def _field_of(self, expr):
+        """The class-field path a ``self.``-rooted expression accesses
+        (``self._pending[k].x`` -> ``_pending``), or None."""
+        return self._field_path(expr)[0]
+
+    def _record_access(self, attr, kind, node, held, deep=False):
+        """Record one shared-field access, deduped per (attr, kind,
+        deep, held).  *deep* marks writes that mutate the field's object
+        (``self._map[k] = v``, ``self._q.append(x)``) as opposed to a
+        pure reference rebind (``self.x = v``) — the lockset pass treats
+        consistently guarded rebinds as safe publication (GIL-atomic
+        reads) but never interior mutation.
+
+        Lock/semaphore/jit attributes and the class's own methods are
+        not data fields; they never enter the access table."""
+        if attr is None or self.cls is None:
+            return
+        base = attr.split(".")[0]
+        info = self.mod.classes.get(self.cls, {})
+        if (
+            base in info.get("lock_attrs", {})
+            or base in info.get("sem_attrs", [])
+            or base in info.get("jit_attrs", [])
+            or base in info.get("methods", [])
+        ):
+            return
+        key = (attr, kind, deep, tuple(held))
+        if key in self.fn._access_seen:
+            return
+        self.fn._access_seen.add(key)
+        self.fn.accesses.append({
+            "attr": attr, "kind": kind, "deep": deep,
+            "line": node.lineno, "col": node.col_offset,
+            "held": list(held),
+        })
+
+    def _record_target(self, target, held):
+        """Record write accesses for an assignment/delete target and walk
+        its non-field parts (subscript keys) for the reads they perform."""
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._record_target(elt, held)
+            return
+        if isinstance(target, ast.Starred):
+            self._record_target(target.value, held)
+            return
+        if isinstance(target, ast.Attribute):
+            attr, nparts = self._field_path(target)
+            if attr is not None:
+                # self.a = v / self.a.b = v rebind their own path's
+                # slot; self.a.b.c = v mutates the a.b object's interior
+                self._record_access(attr, "write", target, held,
+                                    deep=nparts > 2)
+                return
+            self._walk(target.value, held)
+            return
+        if isinstance(target, ast.Subscript):
+            attr = self._field_of(target.value)
+            if attr is not None:
+                # self._map[k] = v mutates the object the field holds —
+                # a write at field granularity
+                self._record_access(attr, "write", target, held,
+                                    deep=True)
+            else:
+                self._walk(target.value, held)
+            self._walk(target.slice, held)
+            return
+        self._walk(target, held)
+
     def _call_ref(self, call):
         """Resolvable reference for a call site, or None."""
         func = call.func
@@ -449,16 +552,28 @@ class _FunctionScanner:
             for t in node.targets
             if isinstance(t, ast.Name)
         }
+        self.fn._access_seen = set()
         for stmt in fn_node.body:
             self._walk(stmt, ())
         self.fn._param_names = self.fn._getattr_locals = None
+        self.fn._access_seen = None
 
     def _walk(self, node, held):
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             return  # nested defs are summarized separately
         if isinstance(node, ast.Lambda):
-            # deferred body: runs later, not under the current locks
-            self._walk(node.body, ())
+            # Split semantics: field ACCESSES keep the current held set
+            # (inline combinator lambdas — sorted key=, filter preds —
+            # run where they stand, the shape that produced a false
+            # race on _resume_step's sort key), while blocking/callback/
+            # call EVENTS inside the body record an empty held set as
+            # before (a deferred lambda — Thread target, timer callback
+            # — runs later on another thread; stamping the registration
+            # site's locks onto it would fabricate BLOCK-UNDER-LOCK
+            # findings).  _handle_call consults _lambda_depth.
+            self._lambda_depth += 1
+            self._walk(node.body, held)
+            self._lambda_depth -= 1
             return
         if isinstance(node, (ast.With, ast.AsyncWith)):
             inner = list(held)
@@ -478,8 +593,96 @@ class _FunctionScanner:
             for stmt in node.body:
                 self._walk(stmt, tuple(inner))
             return
+        if isinstance(node, ast.Assign):
+            for target in node.targets:
+                self._record_target(target, held)
+            self._walk(node.value, held)
+            return
+        if isinstance(node, ast.AugAssign):
+            # x += 1 reads and writes the field
+            attr = self._field_of(node.target) or (
+                self._field_of(node.target.value)
+                if isinstance(node.target, ast.Subscript)
+                else None
+            )
+            if attr is not None:
+                self._record_access(attr, "read", node.target, held)
+            self._record_target(node.target, held)
+            self._walk(node.value, held)
+            return
+        if isinstance(node, ast.AnnAssign):
+            if node.value is not None:
+                self._record_target(node.target, held)
+                self._walk(node.value, held)
+            return
+        if isinstance(node, ast.Delete):
+            for target in node.targets:
+                self._record_target(target, held)
+            return
         if isinstance(node, ast.Call):
             self._handle_call(node, held)
+            for child in node.args:
+                self._walk(child, held)
+            for kw in node.keywords:
+                self._walk(kw.value, held)
+            func = node.func
+            # plain Name/dotted-chain callees were fully consumed by
+            # _handle_call; anything else (a chained receiver like
+            # self._factory().dispatch() or self._map[k].append())
+            # still carries calls/accesses in its subtree — walk it
+            if not isinstance(func, ast.Name) and (
+                not isinstance(func, ast.Attribute)
+                or _expr_text(func) is None
+            ):
+                self._walk(func, held)
+            return
+        if isinstance(node, ast.Subscript):
+            # self.x[i] in load position observes the field's interior
+            attr = self._field_of(node.value)
+            if attr is not None:
+                self._record_access(attr, "read", node, held, deep=True)
+            else:
+                self._walk(node.value, held)
+            self._walk(node.slice, held)
+            return
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            # iterating a shared field observes (and walks) its interior
+            attr = self._field_of(node.iter)
+            if attr is not None:
+                self._record_access(attr, "read", node.iter, held,
+                                    deep=True)
+            else:
+                self._walk(node.iter, held)
+            self._walk(node.target, held)
+            for stmt in node.body + node.orelse:
+                self._walk(stmt, held)
+            return
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                             ast.GeneratorExp)):
+            for gen in node.generators:
+                attr = self._field_of(gen.iter)
+                if attr is not None:
+                    self._record_access(attr, "read", gen.iter, held,
+                                        deep=True)
+                else:
+                    self._walk(gen.iter, held)
+                for cond in gen.ifs:
+                    self._walk(cond, held)
+            if isinstance(node, ast.DictComp):
+                self._walk(node.key, held)
+                self._walk(node.value, held)
+            else:
+                self._walk(node.elt, held)
+            return
+        if isinstance(node, ast.Attribute):
+            # a bare self-rooted chain in load position: a GIL-atomic
+            # reference load of its path (deep when it dereferences past
+            # the recorded two-segment path)
+            attr, nparts = self._field_path(node)
+            if attr is not None:
+                self._record_access(attr, "read", node, held,
+                                    deep=nparts > 2)
+                return
             for child in ast.iter_child_nodes(node):
                 self._walk(child, held)
             return
@@ -488,8 +691,23 @@ class _FunctionScanner:
 
     def _handle_call(self, call, held):
         text = _expr_text(call.func) or ""
+        # events inside lambda bodies never inherit the enclosing held
+        # set (see the Lambda branch in _walk); field accesses do
+        event_held = [] if self._lambda_depth else list(held)
         site = {"line": call.lineno, "col": call.col_offset,
-                "held": list(held)}
+                "held": event_held}
+        # a method call THROUGH a field dereferences the receiver: a
+        # mutator (self._q.append) writes its interior, anything else is
+        # a deep read of it.  self.method() (one segment) is a call
+        # edge, not a data access.
+        if text.startswith("self.") and text.count(".") >= 2:
+            recv, _ = self._field_path(call.func.value)
+            if recv is not None:
+                kind = (
+                    "write" if call.func.attr in _MUTATOR_METHODS
+                    else "read"
+                )
+                self._record_access(recv, kind, call, held, deep=True)
         # callback registration points: the registered callable runs later,
         # on another thread or frame — a deferred edge with no held locks
         if text.endswith("Thread"):
@@ -512,7 +730,7 @@ class _FunctionScanner:
             if recv and self._is_lockish(recv):
                 self.fn.acquisitions.append({
                     "lock": self.lock_id(recv), "line": call.lineno,
-                    "col": call.col_offset, "held": list(held),
+                    "col": call.col_offset, "held": event_held,
                 })
                 return
         blocking = self._classify_blocking(call, text)
@@ -561,7 +779,7 @@ def summarize_module(tree, path):
     # class inventory first: lock/sem/jit attrs inform the scanners
     def collect_class(cls):
         info = {"bases": [], "methods": [], "lock_attrs": {},
-                "sem_attrs": [], "jit_attrs": []}
+                "sem_attrs": [], "jit_attrs": [], "field_ctors": {}}
         for base in cls.bases:
             text = _expr_text(base)
             if text:
@@ -584,6 +802,18 @@ def summarize_module(tree, path):
                     for tt in ttexts:
                         if tt and tt.startswith("self."):
                             info["jit_attrs"].append(tt[len("self."):])
+                elif ftext and kind is None:
+                    # which constructor each plain field came from — the
+                    # lockset pass resolves these to spot fields holding
+                    # instances of lock-owning (self-synchronized)
+                    # classes
+                    for tt in ttexts:
+                        if tt and tt.startswith("self.") and (
+                            "." not in tt[len("self."):]
+                        ):
+                            info["field_ctors"].setdefault(
+                                tt[len("self."):], ftext
+                            )
         for item in cls.body:
             if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 info["methods"].append(item.name)

@@ -48,6 +48,7 @@ Precision choices (documented FN > noisy FP):
   finding per rule.
 """
 
+from client_tpu.analysis import locksets
 from client_tpu.analysis.core import Finding, ProgramRule, register_program
 
 _MAX_DEPTH = 12
@@ -399,6 +400,43 @@ class PeerCallUnderLockRule(ProgramRule):
                         "peer call outside the critical section", "",
                     ))
                     break  # one finding per call site
+        return findings
+
+
+@register_program
+class LocksetRaceRule(ProgramRule):
+    """LOCKSET-RACE — Eraser-style per-field lockset inference across
+    thread roots (see :mod:`client_tpu.analysis.locksets`).
+
+    SHARED-MUT is lexical and per-file: it flags an unlocked assignment
+    in the same class that spawns the thread.  This rule intersects the
+    *candidate guard sets* of every access to a shared field, carried
+    interprocedurally: a field written under ``self._lock`` in one
+    method and read lock-free from a background thread three calls away
+    — or written under lock A while the loop reads under lock B — has an
+    empty candidate set and is flagged with both witness sites (file:
+    line, holding set, thread-root chain).  Exemptions keep the gate
+    honest: ``__init__`` writes (virgin state), single-root fields,
+    fields frozen after construction, event/queue/thread handle fields,
+    and anything vouched for by the ``*_locked`` caller-holds-the-lock
+    convention.  The dynamic twin (``RaceWitness``, armed by
+    ``TPULINT_RACE_WITNESS=1``) runs the same algorithm against the real
+    held-lock stack at runtime.
+    """
+
+    id = "LOCKSET-RACE"
+    rationale = (
+        "a shared field whose accesses share no common lock across "
+        "thread roots is a data race — the Eraser lockset invariant"
+    )
+
+    def check_program(self, program):
+        findings = []
+        for report in locksets.analyze(program):
+            findings.append(Finding(
+                self.id, report.write.path, report.write.line,
+                report.write.col, report.message(), "",
+            ))
         return findings
 
 

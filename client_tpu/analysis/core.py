@@ -27,12 +27,18 @@ import os
 import re
 import tokenize
 
-# ``# tpulint: disable=RULE-A,RULE-B -- reason`` or ``# tpulint: disable
-# -- reason`` (all rules).  On a code line it suppresses that line; on a
-# comment-only line it suppresses the line below (so a rationale can sit
-# above the statement it excuses).  The ``-- reason`` tail is mandatory:
-# reason-less suppressions become BARE-SUPPRESS findings.
-_SUPPRESS_RE = re.compile(r"#\s*tpulint:\s*disable(?P<tail>.*)")
+# A suppression directive must BE the comment, not merely appear inside
+# one (anchored match): prose quoting the syntax — like this very
+# paragraph would if it spelled the directive unquoted at a comment
+# start — is neither a waiver nor a STALE-SUPPRESS finding.  Forms:
+# the directive with ``=RULE-A,RULE-B`` plus a ``-- reason`` tail, or
+# reason + no rule list (all rules).  On a code line it suppresses that
+# line; on a comment-only line it suppresses the line below (so a
+# rationale can sit above the statement it excuses).  The ``-- reason``
+# tail is mandatory: reason-less suppressions become BARE-SUPPRESS
+# findings, and reasoned ones whose rule no longer fires on the line
+# become STALE-SUPPRESS findings.
+_SUPPRESS_RE = re.compile(r"^#+\s*tpulint:\s*disable(?P<tail>.*)")
 _ALL = "*"
 
 
@@ -138,12 +144,16 @@ def _comment_tokens(lines):
 def parse_suppressions(lines):
     """Parse suppression comments.
 
-    Returns ``(by_line, bare)`` where *by_line* maps line number -> set of
-    suppressed rule ids ('*' = all) and *bare* lists ``(line, ids)`` for
-    suppressions missing the mandatory ``-- reason`` tail.
+    Returns ``(by_line, bare, comments)``: *by_line* maps line number ->
+    set of suppressed rule ids ('*' = all), *bare* lists ``(line, ids)``
+    for suppressions missing the mandatory ``-- reason`` tail, and
+    *comments* records every suppression comment individually
+    (``{"line", "covers", "ids", "bare"}``) so the STALE-SUPPRESS pass
+    can audit each waiver against what actually fired on its lines.
     """
     out = {}
     bare = []
+    comments = []
     for i, col, comment in _comment_tokens(lines):
         m = _SUPPRESS_RE.search(comment)
         if not m:
@@ -159,30 +169,39 @@ def parse_suppressions(lines):
             }
         else:
             ids = {_ALL}
-        if not sep or not reason.strip():
+        is_bare = not sep or not reason.strip()
+        if is_bare:
             bare.append((i, ids))
         target = i
         if not lines[i - 1][:col].strip():
             target = i + 1  # comment-only line covers the next line
         out.setdefault(target, set()).update(ids)
         out.setdefault(i, set()).update(ids)
-    return out, bare
+        comments.append({
+            "line": i, "covers": sorted({i, target}),
+            "ids": sorted(ids), "bare": is_bare,
+        })
+    return out, bare, comments
 
 
 def _suppressed(finding, by_line):
-    if finding.rule == "BARE-SUPPRESS":
-        # a waiver cannot waive the rule about waivers
+    if finding.rule in ("BARE-SUPPRESS", "STALE-SUPPRESS"):
+        # a waiver cannot waive the rules about waivers
         return False
     ids = by_line.get(finding.line, ())
     return _ALL in ids or finding.rule.upper() in ids
 
 
-def scan_source(source, path, rules=None, tree=None, parsed_suppressions=None):
+def scan_source(source, path, rules=None, tree=None, parsed_suppressions=None,
+                suppressed_out=None):
     """Run every (or the given) per-file rule over one file's source.
 
     *tree* / *parsed_suppressions* accept precomputed results so a driver
     that also needs them (``_analyze_file`` builds the callgraph summary
     from the same tree) parses and tokenizes each file exactly once.
+    *suppressed_out*, when given a list, receives the findings a
+    suppression comment filtered — the STALE-SUPPRESS pass audits
+    waivers against them.
     """
     active = list((rules if rules is not None else REGISTRY).values())
     lines = source.splitlines()
@@ -198,7 +217,7 @@ def scan_source(source, path, rules=None, tree=None, parsed_suppressions=None):
             ]
     if parsed_suppressions is None:
         parsed_suppressions = parse_suppressions(lines)
-    suppressed, bare = parsed_suppressions
+    suppressed, bare, _comments = parsed_suppressions
     findings = []
     reported = set()  # one finding per (rule, line): passes can overlap
     for rule in active:
@@ -208,6 +227,8 @@ def scan_source(source, path, rules=None, tree=None, parsed_suppressions=None):
             found = rule.check(tree, lines, path)
         for f in found:
             if _suppressed(f, suppressed):
+                if suppressed_out is not None:
+                    suppressed_out.append(f)
                 continue
             if (f.rule, f.line) in reported:
                 continue
@@ -252,26 +273,35 @@ def iter_python_files(paths, exclude_parts=("analysis_fixtures",)):
 
 
 def _analyze_file(source, path, rules):
-    """(findings, summary, suppression-map) for one file.
+    """(findings, summary, suppression-map, comments, suppressed-hits)
+    for one file.
 
     *summary* is None on parse errors (the PARSE-ERROR finding carries
     the news; program rules skip the file).  The file is parsed and
     tokenized exactly once, shared between the per-file rules and the
-    callgraph summary.
+    callgraph summary.  *comments* are the parsed suppression comments;
+    *suppressed-hits* lists ``(rule, line)`` for every per-file finding
+    a suppression filtered (STALE-SUPPRESS input).
     """
     from client_tpu.analysis import callgraph
 
     lines = source.splitlines()
-    by_line, bare = parse_suppressions(lines)
+    by_line, bare, comments = parse_suppressions(lines)
     try:
         tree = ast.parse(source, filename=path)
     except SyntaxError:
-        return scan_source(source, path, rules), None, by_line
+        return scan_source(source, path, rules), None, by_line, [], []
+    suppressed_hits = []
     findings = scan_source(
-        source, path, rules, tree=tree, parsed_suppressions=(by_line, bare)
+        source, path, rules, tree=tree,
+        parsed_suppressions=(by_line, bare, comments),
+        suppressed_out=suppressed_hits,
     )
     summary = callgraph.summarize_module(tree, path)
-    return findings, summary, by_line
+    return (
+        findings, summary, by_line, comments,
+        [(f.rule, f.line) for f in suppressed_hits],
+    )
 
 
 def scan_paths(paths, rules=None, exclude_parts=("analysis_fixtures",),
@@ -282,7 +312,12 @@ def scan_paths(paths, rules=None, exclude_parts=("analysis_fixtures",),
     filter (an empty dict disables that family).  ``cache`` is an
     optional :class:`client_tpu.analysis.cache.AnalysisCache` reused
     across runs — only consulted for full-default-rule scans (a filtered
-    scan must not poison or be poisoned by cached full results).
+    scan must not poison or be poisoned by cached full results).  On a
+    full scan the whole-program pass (program rules + the
+    STALE-SUPPRESS audit) is additionally cached under a *fileset
+    digest* over every scanned file's stat key: when nothing changed,
+    the graph walks are skipped entirely and a warm ``make lint`` stays
+    ~a second.
     """
     from client_tpu.analysis import callgraph
 
@@ -290,6 +325,9 @@ def scan_paths(paths, rules=None, exclude_parts=("analysis_fixtures",),
     findings = []
     summaries = []
     suppress_by_path = {}
+    comments_by_path = {}    # path -> (comments, per-file suppressed hits)
+    fileset = []             # (path, stat-key) pairs -> program digest
+    digest_ok = use_cache
     snippet_lines = {}  # program-finding snippets come from the source
     for path in iter_python_files(paths, exclude_parts):
         entry = cache.get(path) if use_cache else None
@@ -303,6 +341,9 @@ def scan_paths(paths, rules=None, exclude_parts=("analysis_fixtures",),
             by_line = {
                 int(k): set(v) for k, v in entry["suppress"].items()
             }
+            comments = entry.get("comments", [])
+            hits = [tuple(h) for h in entry.get("suppressed", [])]
+            stat_key = cache.stat_for(path)
         else:
             # stat BEFORE reading: a save landing mid-analysis must leave
             # the entry stale (re-scan next run), never fresh-looking
@@ -314,9 +355,10 @@ def scan_paths(paths, rules=None, exclude_parts=("analysis_fixtures",),
                 findings.append(
                     Finding("READ-ERROR", path, 1, 0, f"unreadable: {e}", "")
                 )
+                digest_ok = False
                 continue
-            file_findings, summary, by_line = _analyze_file(
-                source, path, rules
+            file_findings, summary, by_line, comments, hits = (
+                _analyze_file(source, path, rules)
             )
             # keep THIS run's lines for program-finding snippets: a save
             # landing mid-run must not produce a snippet (the baseline's
@@ -331,47 +373,89 @@ def scan_paths(paths, rules=None, exclude_parts=("analysis_fixtures",),
                     "suppress": {
                         str(k): sorted(v) for k, v in by_line.items()
                     },
+                    "comments": comments,
+                    "suppressed": [list(h) for h in hits],
                 }, stat_key)
+        if stat_key is None:
+            digest_ok = False
+        else:
+            fileset.append((path, stat_key))
         findings.extend(file_findings)
         if summary is not None:
             summaries.append(summary)
             suppress_by_path[path] = by_line
+            comments_by_path[path] = (comments, hits)
 
     active_program = (
         PROGRAM_REGISTRY if program_rules is None else program_rules
     )
-    if active_program and summaries:
-        program = callgraph.build_program(summaries)
-        reported = set()
+    full_scan = rules is None and program_rules is None
+    digest = (
+        cache.fileset_digest(fileset)
+        if digest_ok and full_scan else None
+    )
+    cached_program = (
+        cache.get_program(digest) if digest is not None else None
+    )
+    def snippet_at(path, line):
+        """Drift-stable baseline snippet for a program/stale finding —
+        lazily reading cache-hit files whose source this run never saw."""
+        if path not in snippet_lines:
+            try:
+                with open(path, "r", encoding="utf-8") as fh:
+                    snippet_lines[path] = fh.read().splitlines()
+            except OSError:
+                snippet_lines[path] = []
+        lines = snippet_lines[path]
+        return lines[line - 1].strip() if 1 <= line <= len(lines) else ""
+
+    if cached_program is not None:
+        findings.extend(Finding(**f) for f in cached_program)
+    elif (active_program or full_scan) and summaries:
         program_findings = []
-        for rule in active_program.values():
-            for f in rule.check_program(program):
-                by_line = suppress_by_path.get(f.path, {})
-                if _suppressed(f, by_line):
-                    continue
-                # message is part of the key: two DISTINCT cycles can
-                # anchor on the same witness line (a call made under two
-                # held locks); only true duplicates may collapse
-                key = (f.rule, f.path, f.line, f.message)
-                if key in reported:
-                    continue
-                reported.add(key)
-                if f.path not in snippet_lines:
-                    # cache-hit file: its source was not read this run
-                    try:
-                        with open(f.path, "r", encoding="utf-8") as fh:
-                            snippet_lines[f.path] = fh.read().splitlines()
-                    except OSError:
-                        snippet_lines[f.path] = []
-                lines = snippet_lines[f.path]
-                snippet = (
-                    lines[f.line - 1].strip()
-                    if 1 <= f.line <= len(lines)
-                    else ""
-                )
-                program_findings.append(
-                    dataclasses.replace(f, snippet=snippet)
-                )
+        if active_program:
+            program = callgraph.build_program(summaries)
+            reported = set()
+            for rule in active_program.values():
+                for f in rule.check_program(program):
+                    by_line = suppress_by_path.get(f.path, {})
+                    if _suppressed(f, by_line):
+                        comments_by_path.get(f.path, ([], []))[1].append(
+                            (f.rule, f.line)
+                        )
+                        continue
+                    # message is part of the key: two DISTINCT cycles can
+                    # anchor on the same witness line (a call made under
+                    # two held locks); only true duplicates may collapse
+                    key = (f.rule, f.path, f.line, f.message)
+                    if key in reported:
+                        continue
+                    reported.add(key)
+                    program_findings.append(dataclasses.replace(
+                        f, snippet=snippet_at(f.path, f.line),
+                    ))
+        if full_scan:
+            # the STALE-SUPPRESS audit needs BOTH rule families' verdicts
+            # (a waiver may exist for a program finding), so it runs — and
+            # is cached — with the program pass
+            stale_rule = REGISTRY.get("STALE-SUPPRESS")
+            if stale_rule is not None:
+                for path, (comments, hits) in sorted(
+                    comments_by_path.items()
+                ):
+                    for f in stale_rule.check_comments(
+                        path, comments, hits
+                    ):
+                        # the comment line IS the snippet: the baseline
+                        # key must tell two stale waivers in one file
+                        # apart
+                        program_findings.append(dataclasses.replace(
+                            f, snippet=snippet_at(f.path, f.line),
+                        ))
+        if digest is not None:
+            cache.put_program(
+                digest, [f.to_dict() for f in program_findings]
+            )
         findings.extend(program_findings)
 
     if use_cache:
@@ -399,7 +483,7 @@ class BareSuppressRule(Rule):
     )
 
     def check(self, tree, lines, path):
-        _by_line, bare = parse_suppressions(lines)
+        _by_line, bare, _comments = parse_suppressions(lines)
         return self.check_parsed(bare, lines, path)
 
     def check_parsed(self, bare, lines, path):
@@ -416,4 +500,67 @@ class BareSuppressRule(Rule):
                 f"suppression of {what} carries no reason — append "
                 "`-- <why this is safe>`", snippet,
             ))
+        return findings
+
+
+@register
+class StaleSuppressRule(Rule):
+    """STALE-SUPPRESS — a reasoned waiver whose rule no longer fires.
+
+    A ``# tpulint: disable=RULE -- why`` comment on a line where RULE no
+    longer produces a finding is debt pointing at code that moved on:
+    either the hazard was fixed (delete the waiver) or the code drifted
+    out from under it (the waiver now silences NOTHING today and the
+    wrong thing tomorrow).  Auditing it automatically keeps the waiver
+    set honest as rules and code evolve.
+
+    The audit needs every rule family's verdicts for the file — a waiver
+    may exist for a whole-program finding — so the driver computes it
+    alongside the program pass on full scans (``scan_paths`` with the
+    default rule sets); per-file ``scan_source`` calls and ``--rules``-
+    filtered runs never report it (a filtered scan cannot tell unused
+    from unchecked).  Blanket waivers (``disable`` with no rule list)
+    are stale when NO finding at all was suppressed on their lines.
+    Reason-less waivers are BARE-SUPPRESS findings already and are not
+    double-reported here.  Like BARE-SUPPRESS, a STALE-SUPPRESS finding
+    cannot itself be waived — the fix is deleting the dead comment.
+    """
+
+    id = "STALE-SUPPRESS"
+    rationale = (
+        "a suppression whose rule no longer fires on its line silences "
+        "nothing today and the wrong thing tomorrow — delete it"
+    )
+
+    def check(self, tree, lines, path):
+        return []  # driver-computed on full scans (needs program verdicts)
+
+    def check_comments(self, path, comments, suppressed_hits):
+        """*suppressed_hits*: (rule, line) for every finding — per-file
+        AND program — that a suppression in this file filtered."""
+        hits_by_line = {}
+        for rule, line in suppressed_hits:
+            hits_by_line.setdefault(line, set()).add(rule.upper())
+        findings = []
+        for comment in comments:
+            if comment["bare"]:
+                continue  # already a BARE-SUPPRESS finding
+            covered = set()
+            for line in comment["covers"]:
+                covered |= hits_by_line.get(line, set())
+            ids = set(comment["ids"])
+            if _ALL in ids:
+                stale = sorted(ids) if not covered else []
+            else:
+                stale = sorted(ids - covered)
+            for rule_id in stale:
+                what = (
+                    "any rule" if rule_id == _ALL else rule_id
+                )
+                findings.append(Finding(
+                    self.id, path, comment["line"], 0,
+                    f"suppression of {what} no longer matches a "
+                    "finding on its line — the waived hazard is gone "
+                    "(or moved); delete the stale comment", "",
+                ))
         return findings

@@ -40,6 +40,69 @@ def render_json(new, grandfathered, rules):
     )
 
 
+def render_sarif(new, grandfathered, rules):
+    """SARIF 2.1.0 — the interchange format CI annotators, editors, and
+    code-scanning UIs consume directly.  Grandfathered (baselined)
+    findings are emitted with ``"baselineState": "unchanged"`` so a
+    consumer can show or hide the ratchet debt; new findings are
+    ``level: error`` (they fail the gate)."""
+    def result(f, baselined):
+        out = {
+            "ruleId": f.rule,
+            "level": "note" if baselined else "error",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": f.path.replace("\\", "/"),
+                    },
+                    "region": {
+                        "startLine": max(int(f.line), 1),
+                        "startColumn": max(int(f.col) + 1, 1),
+                    },
+                },
+            }],
+        }
+        if baselined:
+            out["baselineState"] = "unchanged"
+        return out
+
+    driver = {
+        "name": "tpu-lint",
+        "informationUri": (
+            "https://github.com/tpu-client/tpu-client"
+            "#static-analysis"
+        ),
+        "rules": [
+            {
+                "id": rule.id,
+                "shortDescription": {"text": rule.rationale},
+                "fullDescription": {
+                    "text": (type(rule).__doc__ or "").strip(),
+                },
+            }
+            for _rid, rule in sorted(rules.items())
+        ],
+    }
+    return json.dumps(
+        {
+            "$schema": (
+                "https://raw.githubusercontent.com/oasis-tcs/"
+                "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"
+            ),
+            "version": "2.1.0",
+            "runs": [{
+                "tool": {"driver": driver},
+                "results": (
+                    [result(f, False) for f in new]
+                    + [result(f, True) for f in grandfathered]
+                ),
+            }],
+        },
+        indent=2,
+    )
+
+
 def render_rules(rules):
     lines = ["tpu-lint rule catalog:"]
     for rule_id in sorted(rules):

@@ -1,13 +1,32 @@
-"""Dynamic lock-order witness: TSan-shaped evidence for the static pass.
+"""Dynamic lock-order + data-race witness: TSan-shaped evidence for the
+static pass.
 
-The static LOCK-INV rule reasons over *names*; this module watches the
-*objects*.  Opt-in wrappers around the repo's lock/condition objects
-record the actual acquisition DAG a test run exercises — every edge
-``A -> B`` where some thread acquired B while holding A, stamped with
-the acquiring source sites — and report any cycle.  Static analysis and
-the witness keep each other honest: a cycle only one of them sees is
-either an unexercised static path (add a test) or a dynamic aliasing
-pattern the summaries cannot name (add a rule).
+The static LOCK-INV / LOCKSET-RACE rules reason over *names*; this
+module watches the *objects*.  Two witnesses share the held-lock
+machinery:
+
+- :class:`LockWitness` wraps the repo's lock/condition objects and
+  records the actual acquisition DAG a test run exercises — every edge
+  ``A -> B`` where some thread acquired B while holding A, stamped with
+  the acquiring source sites — and reports any cycle.
+- :class:`RaceWitness` (a LockWitness) additionally runs the Eraser
+  lockset state machine *at runtime* on the shared fields of classes
+  opted in via :func:`witness_shared` (or armed ad hoc with
+  :meth:`RaceWitness.watch_class`): while installed, the class's
+  ``__setattr__``/``__getattribute__`` are instrumented so every
+  witnessed-field access consults the current thread's real held-lock
+  stack.  A field starts first-thread-exclusive; once a second thread
+  touches it, its *write lockset* is intersected write by write — an
+  empty intersection (an unguarded or inconsistently-guarded write to a
+  shared field) raises :class:`RaceViolation` carrying BOTH access
+  stacks and dumps to the flight recorder when one is attached.
+  Lock-free *reads* are tolerated by design: CPython reference loads
+  are atomic, and the static pass's safe-publication exemption makes
+  the same call — the witness checks the write-side protocol.
+
+Static analysis and the witnesses keep each other honest: a race only
+one side sees is either an unexercised static path (add a test) or a
+dynamic aliasing pattern the summaries cannot name (add a rule).
 
 Usage (tests)::
 
@@ -15,6 +34,11 @@ Usage (tests)::
     with w.installed():           # patches threading.Lock/RLock/Condition
         run_concurrent_scenario() # locks built inside client_tpu/ record
     w.assert_acyclic()            # raises LockOrderViolation on a cycle
+
+    w = RaceWitness()
+    with w.installed():           # + instruments @witness_shared classes
+        run_concurrent_scenario() # unguarded shared write -> raises
+    w.assert_race_free()
 
 The ``installed()`` patch only wraps locks *constructed from files under
 the configured prefixes* (default ``client_tpu``): stdlib internals
@@ -24,22 +48,27 @@ construction site (``client_tpu/balance/pool.py:223``) — all instances
 born at one line share a name, which matches how the static pass (and a
 human) reasons about lock order.
 
-Pytest integration: ``--lock-witness`` (or ``TPULINT_LOCK_WITNESS=1``,
-the ``make soak`` hookup) arms a per-test witness via the fixture in
-``tests/conftest.py`` and fails any test whose acquisition graph closed
-a cycle.
+Pytest integration: ``--lock-witness`` / ``TPULINT_LOCK_WITNESS=1`` arms
+a per-test LockWitness via the fixture in ``tests/conftest.py`` and
+fails any test whose acquisition graph closed a cycle;
+``TPULINT_RACE_WITNESS=1`` (the ``make chaos`` / ``make soak`` hookup)
+arms a RaceWitness instead — lock-order duty included.
 """
 
 import contextlib
 import os
 import sys
 import threading
+import weakref
 
 __all__ = [
     "LockOrderViolation",
     "LockWitness",
+    "RaceViolation",
+    "RaceWitness",
     "WitnessLock",
     "WitnessCondition",
+    "witness_shared",
 ]
 
 _HERE = os.path.abspath(os.path.dirname(__file__))
@@ -47,6 +76,10 @@ _HERE = os.path.abspath(os.path.dirname(__file__))
 
 class LockOrderViolation(AssertionError):
     """A cycle exists in the observed lock-acquisition graph."""
+
+
+class RaceViolation(AssertionError):
+    """A witnessed shared field was written with an empty lockset."""
 
 
 def _call_site(prefixes):
@@ -370,3 +403,289 @@ class WitnessCondition:
 
     def __repr__(self):
         return f"WitnessCondition({self._name!r})"
+
+
+# -- dynamic race witness ----------------------------------------------------
+
+# Classes opted in via @witness_shared, with their declared guard/field
+# spec.  The decorator records intent only — zero runtime cost until a
+# RaceWitness is installed and patches the class.
+_WITNESSED_CLASSES = []
+
+
+def witness_shared(*guards, fields=None):
+    """Class decorator opting a class into dynamic race witnessing.
+
+    *guards* name the lock/condition attributes that protect the class's
+    shared state (``@witness_shared("_lock")``) — they are excluded from
+    witnessing themselves.  *fields* narrows witnessing to the named
+    instance attributes; by default every instance attribute that is not
+    a guard (and not class-level: methods, properties, class vars) is
+    witnessed.  The decorator is free until a :class:`RaceWitness` is
+    installed: no wrapper, no per-access cost, nothing changes on the
+    class.
+    """
+    def decorate(cls):
+        spec = {
+            "guards": frozenset(guards),
+            "fields": frozenset(fields) if fields is not None else None,
+        }
+        cls._tpulint_witness_shared = spec
+        _WITNESSED_CLASSES.append(weakref.ref(cls))
+        return cls
+    return decorate
+
+
+def _access_stack(limit=8):
+    """Compact caller stack (outside this module), innermost first."""
+    frame = sys._getframe(2)
+    out = []
+    while frame is not None and len(out) < limit:
+        filename = frame.f_code.co_filename
+        if not os.path.abspath(filename).startswith(_HERE):
+            out.append(
+                f"{filename}:{frame.f_lineno} in "
+                f"{frame.f_code.co_name}"
+            )
+        frame = frame.f_back
+    return out
+
+
+class _FieldState:
+    """Eraser state for one (instance, field)."""
+
+    __slots__ = ("owner", "shared", "modified", "wlock", "last_write",
+                 "last")
+
+    def __init__(self, owner):
+        self.owner = owner       # first-accessing thread ident
+        self.shared = False      # a second thread has touched it
+        self.modified = False    # written after becoming shared
+        self.wlock = None        # candidate write lockset (None = all)
+        self.last_write = None   # (thread, held, stack) of last write
+        self.last = None         # same, for the last access of any kind
+
+
+class RaceWitness(LockWitness):
+    """LockWitness + runtime Eraser lockset checking on witnessed
+    classes (see the module docstring for the algorithm and the
+    read-side tolerance rationale).
+
+    ``flight`` (optional) is a
+    :class:`~client_tpu.serve.flight.FlightRecorder`: every violation is
+    noted and the ring dumped, so a red chaos round ships the race
+    evidence alongside its other postmortem artifacts.
+    """
+
+    def __init__(self, prefixes=("client_tpu",), flight=None):
+        super().__init__(prefixes=prefixes)
+        self.flight = flight
+        self._race_mu = threading.Lock()
+        self._obj_states = {}    # id(obj) -> {field: _FieldState}
+        self._finalizers = {}    # id(obj) -> weakref.finalize
+        self._extra_classes = []  # watch_class() registrations
+        self.race_violations = []  # [(cls, field, description)]
+        self.field_accesses = 0
+
+    # -- opt-in surface ------------------------------------------------------
+
+    def watch_class(self, cls, guards=(), fields=None):
+        """Arm *cls* for this witness without the decorator — the
+        programmatic hook for ad-hoc classes (seeded-race tests, classes
+        named by a static LOCKSET-RACE verdict).  Call before
+        ``installed()``."""
+        self._extra_classes.append((cls, {
+            "guards": frozenset(guards),
+            "fields": frozenset(fields) if fields is not None else None,
+        }))
+
+    def _armed_classes(self):
+        out = []
+        seen = set()
+        for ref in _WITNESSED_CLASSES:
+            cls = ref()
+            if cls is not None and id(cls) not in seen:
+                seen.add(id(cls))
+                out.append((cls, cls._tpulint_witness_shared))
+        for cls, spec in self._extra_classes:
+            # a decorated class passed to watch_class() again must not
+            # be instrumented twice (double wrappers never fully unwind)
+            if id(cls) not in seen:
+                seen.add(id(cls))
+                out.append((cls, spec))
+        return out
+
+    # -- the state machine ---------------------------------------------------
+
+    def _held_names(self):
+        return frozenset(name for name, _site in self._stack())
+
+    def note_field_access(self, obj, cls, field, kind):
+        """Run one access through the lockset state machine.  Raises
+        :class:`RaceViolation` on an empty write lockset."""
+        tid = threading.get_ident()
+        held = self._held_names()
+        info = (threading.current_thread().name, held, _access_stack())
+        report = None
+        with self._race_mu:
+            self.field_accesses += 1
+            key = id(obj)
+            states = self._obj_states.get(key)
+            if states is None:
+                states = self._obj_states[key] = {}
+                try:
+                    self._finalizers[key] = weakref.finalize(
+                        obj, self._drop_state, key
+                    )
+                except TypeError:
+                    pass  # not weakref-able: entry lives with the witness
+            state = states.get(field)
+            if state is None:
+                state = states[field] = _FieldState(tid)
+            if tid != state.owner and not state.shared:
+                state.shared = True  # first-thread-exclusive phase over
+            if kind == "write":
+                if state.shared:
+                    state.modified = True
+                    state.wlock = (
+                        held if state.wlock is None
+                        else state.wlock & held
+                    )
+                    if not state.wlock:
+                        prior = state.last_write or state.last
+                        report = self._describe_race(
+                            cls, field, info, prior
+                        )
+                        self.race_violations.append(
+                            (cls.__name__, field, report)
+                        )
+                state.last_write = info
+            state.last = info
+        if report is not None:
+            self._dump_race(cls, field, report)
+            raise RaceViolation(report)
+
+    def _drop_state(self, key):
+        # finalizers can fire inside any allocation — including while a
+        # note_field_access holds _race_mu on this very thread — so a
+        # blocking acquire here could self-deadlock.  Skipping the
+        # cleanup just leaves an inert id-keyed entry behind.
+        if self._race_mu.acquire(False):
+            try:
+                self._obj_states.pop(key, None)
+                self._finalizers.pop(key, None)
+            finally:
+                self._race_mu.release()
+
+    @staticmethod
+    def _describe_race(cls, field, current, prior):
+        def fmt(info):
+            if info is None:
+                return "  (no prior access recorded)"
+            thread, held, stack = info
+            locks = (
+                "{" + ", ".join(sorted(held)) + "}" if held
+                else "no locks"
+            )
+            frames = "\n".join(f"    {line}" for line in stack)
+            return f"  thread {thread!r} holding {locks}:\n{frames}"
+
+        return (
+            f"unguarded shared write: {cls.__name__}.{field} written "
+            "with an empty candidate lockset (no lock common to every "
+            "write since the field became thread-shared)\n"
+            "this access:\n" + fmt(current)
+            + "\nprior conflicting access:\n" + fmt(prior)
+        )
+
+    def _dump_race(self, cls, field, report):
+        flight = self.flight
+        if flight is None:
+            return
+        try:
+            flight.note(
+                "race_witness_violation", cls=cls.__name__, field=field,
+                report=report,
+            )
+            flight.dump(f"race-{cls.__name__}-{field}")
+        except Exception:
+            pass  # evidence is best-effort; the raise is the verdict
+
+    def assert_race_free(self):
+        """Raise :class:`RaceViolation` if any violation was recorded
+        (covers violations swallowed by driver try/except); returns the
+        witnessed access count otherwise."""
+        with self._race_mu:
+            violations = list(self.race_violations)
+            n = self.field_accesses
+        if violations:
+            lines = [
+                f"{cls}.{field}" for cls, field, _r in violations
+            ]
+            raise RaceViolation(
+                f"{len(violations)} unguarded shared write(s) observed: "
+                + ", ".join(lines) + "\n\n" + violations[0][2]
+            )
+        return n
+
+    # -- class instrumentation ----------------------------------------------
+
+    def _instrument(self, cls, spec):
+        witness = self
+        guards = spec["guards"]
+        fields = spec["fields"]
+        class_attrs = frozenset(dir(cls))
+        had_set = "__setattr__" in cls.__dict__
+        had_get = "__getattribute__" in cls.__dict__
+        orig_set = cls.__setattr__
+        orig_get = cls.__getattribute__
+
+        def watched(name):
+            if fields is not None:
+                return name in fields
+            return (
+                not name.startswith("__")
+                and name not in guards
+                and name not in class_attrs
+            )
+
+        def instrumented_setattr(obj, name, value):
+            if watched(name):
+                witness.note_field_access(obj, cls, name, "write")
+            orig_set(obj, name, value)
+
+        def instrumented_getattribute(obj, name):
+            value = orig_get(obj, name)
+            if watched(name):
+                witness.note_field_access(obj, cls, name, "read")
+            return value
+
+        cls.__setattr__ = instrumented_setattr
+        cls.__getattribute__ = instrumented_getattribute
+        return (cls, had_set, orig_set, had_get, orig_get)
+
+    @contextlib.contextmanager
+    def installed(self):
+        """The LockWitness threading patch PLUS per-class
+        ``__setattr__``/``__getattribute__`` instrumentation on every
+        witnessed class, all restored on exit."""
+        patched = []
+        with super().installed():
+            try:
+                for cls, spec in self._armed_classes():
+                    patched.append(self._instrument(cls, spec))
+                yield self
+            finally:
+                # reversed: if a class ever carries stacked wrappers,
+                # unwinding inner-first restores the true originals
+                for cls, had_set, orig_set, had_get, orig_get in (
+                    reversed(patched)
+                ):
+                    if had_set:
+                        cls.__setattr__ = orig_set
+                    else:
+                        del cls.__setattr__
+                    if had_get:
+                        cls.__getattribute__ = orig_get
+                    else:
+                        del cls.__getattribute__

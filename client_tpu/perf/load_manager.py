@@ -333,18 +333,21 @@ class AsyncConcurrencyManager(LoadManager):
 
         if self._loop is not None:
             return
+        # the loop object is created HERE (caller thread) so self._loop
+        # is only ever written caller-side (_ensure_loop/cleanup); the
+        # pump thread works through its closure, never through self
+        loop = asyncio.new_event_loop()
         started = threading.Event()
 
         def run():
-            loop = asyncio.new_event_loop()
             asyncio.set_event_loop(loop)
-            self._loop = loop
             started.set()
             try:
                 loop.run_forever()
             finally:
                 loop.close()
 
+        self._loop = loop
         self._loop_thread = threading.Thread(
             target=run, name="perf-aio-loop", daemon=True
         )

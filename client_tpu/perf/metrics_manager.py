@@ -14,6 +14,7 @@ import urllib.request
 
 import numpy as np
 
+from client_tpu.analysis.witness import witness_shared
 from client_tpu.utils import escape_label
 
 
@@ -130,6 +131,7 @@ class DeviceUtilizationProbe:
         return delay_us, busy
 
 
+@witness_shared("_lock")
 class MetricsManager:
     def __init__(self, metrics_url, interval_s=1.0, timeout_s=5.0,
                  include_local_devices=False, utilization_probe=None):
@@ -166,7 +168,8 @@ class MetricsManager:
             self._probe_into(local)
             if not local:
                 raise
-            self.scrape_errors += 1
+            with self._lock:  # scrape() runs caller- and loop-side
+                self.scrape_errors += 1
             return local
         if self.include_local_devices:
             for name, entries in self._local_snapshot().items():
@@ -202,7 +205,8 @@ class MetricsManager:
                     with self._lock:
                         self._snapshots.append(snap)
                 except Exception:
-                    self.scrape_errors += 1
+                    with self._lock:
+                        self.scrape_errors += 1
                 self._stop.wait(self.interval_s)
 
         self._thread = threading.Thread(target=loop, daemon=True)

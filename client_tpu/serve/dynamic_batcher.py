@@ -321,9 +321,12 @@ class ModelBatcher:
         return bool(getattr(self.model, "fused_batching", False))
 
     def _fused_jit(self):
-        if self._fused is None:
-            self._fused = _fused_group_fn(self.model.fn)
-        return self._fused
+        # memoized under _cond: warmup (caller thread) and the batcher
+        # loop both reach this — an unguarded rebind races them
+        with self._cond:
+            if self._fused is None:
+                self._fused = _fused_group_fn(self.model.fn)
+            return self._fused
 
     def warmup(self, input_specs):
         """Pre-compile every padded bucket (the reference's ``model_warmup``

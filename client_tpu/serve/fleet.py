@@ -56,6 +56,7 @@ from collections import OrderedDict
 
 import numpy as np
 
+from client_tpu.analysis.witness import witness_shared
 from client_tpu.perf.rendezvous import recv_frame, send_frame
 from client_tpu.resilience import CircuitBreakerRegistry, CircuitOpenError
 from client_tpu.serve.metrics import FLEET_HELP
@@ -289,6 +290,7 @@ def _seq_version(snapshot):
     )
 
 
+@witness_shared("_lock")
 class _SequenceStore:
     """Replicated sequence-state snapshots, versioned by (epoch, step).
 

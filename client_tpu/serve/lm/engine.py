@@ -444,8 +444,10 @@ class LmEngine:
 
     def decode_executables(self):
         """Compiled decode-tick executable count (<= len(lane_counts))."""
+        with self._cv:  # the scheduler inserts into _tick_jits mid-run
+            fns = list(self._tick_jits.values())
         total = 0
-        for fn in self._tick_jits.values():
+        for fn in fns:
             size = getattr(fn, "_cache_size", None)
             total += size() if callable(size) else 1
         return total
@@ -1212,16 +1214,22 @@ class LmEngine:
         return len(exports)
 
     def _tick_for(self, n):
-        fn = self._tick_jits.get(n)
-        if fn is None:
-            fn = jax.jit(
-                functools.partial(
-                    _decode_tick, cfg=self.cfg, n=n,
-                    block_size=self.block_size,
-                ),
-                donate_argnums=self._donate,
-            )
-            self._tick_jits[n] = fn
+        # memoized under _cv: decode_executables() iterates this dict
+        # from the caller thread while the scheduler inserts — jax.jit
+        # here only CONSTRUCTS the callable (tracing happens at the
+        # dispatch site, outside the lock), so the critical section
+        # stays cheap
+        with self._cv:
+            fn = self._tick_jits.get(n)
+            if fn is None:
+                fn = jax.jit(
+                    functools.partial(
+                        _decode_tick, cfg=self.cfg, n=n,
+                        block_size=self.block_size,
+                    ),
+                    donate_argnums=self._donate,
+                )
+                self._tick_jits[n] = fn
         return fn
 
     def _decode_pass(self):

@@ -40,6 +40,8 @@ import random
 import threading
 import time
 
+from client_tpu.analysis.witness import witness_shared
+
 __all__ = [
     "FaultSpec",
     "ChaosScenario",
@@ -51,6 +53,7 @@ __all__ = [
     "assert_byte_exact",
     "assert_kv_clean",
     "assert_lock_witness_acyclic",
+    "assert_race_witness_clean",
 ]
 
 
@@ -260,6 +263,7 @@ def run_scenario(scenario, apply_fault, drivers, join_timeout_s=600.0):
     )
 
 
+@witness_shared("_lock")
 class StepLedger:
     """Cross-replica ``(sequence, step)`` application ledger.
 
@@ -356,6 +360,17 @@ def assert_lock_witness_acyclic(witness):
     if witness is None:
         return 0
     return witness.assert_acyclic()
+
+
+def assert_race_witness_clean(witness):
+    """The dynamic race witness (``TPULINT_RACE_WITNESS=1``) recorded no
+    unguarded shared writes — covering violations a driver's own
+    try/except swallowed mid-scenario.  No-op for None or a plain
+    LockWitness so matrices run unarmed (or lock-order-only) too."""
+    check = getattr(witness, "assert_race_free", None)
+    if check is None:
+        return 0
+    return check()
 
 
 def _fixture_recorders(fixture):

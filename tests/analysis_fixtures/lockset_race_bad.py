@@ -1,0 +1,121 @@
+"""LOCKSET-RACE fixtures: the pre-fix shapes of this PR's live catches.
+
+Each class freezes one real bug the Eraser-style lockset pass surfaced
+in the tree (and this PR fixed):
+
+- ``ScrapeLoop`` — perf/metrics_manager.py's ``scrape_errors``: both
+  thread roots bump a counter lock-free (read-modify-write lost update).
+- ``TickEngine`` — serve/lm/engine.py's ``_tick_jits``: the scheduler
+  memoizes into a dict lock-free while the caller side iterates it.
+- ``Publisher`` — the pre-fix ``set_registry`` shape: a late-bound
+  reference rebound with NO lock while the loop dereferences it (the
+  post-fix guarded rebind is the sanctioned safe-publication pattern,
+  see lockset_race_ok.py).
+- ``SplitGuard`` — writes under one lock, reads under a DIFFERENT one:
+  lexically every access is "under a lock", so SHARED-MUT stays silent;
+  only the lockset intersection sees the empty guard set.  The write
+  side is two calls deep to prove the interprocedural chain.
+"""
+
+import threading
+
+
+class ScrapeLoop:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._snapshots = []
+        self.scrape_errors = 0
+        self._t = threading.Thread(target=self._loop, daemon=True)
+        self._t.start()
+
+    def scrape(self):
+        try:
+            return {"up": 1}
+        except Exception:
+            self.scrape_errors += 1  # racy: no lock, both roots reach it
+            raise
+
+    def _loop(self):
+        while True:
+            try:
+                snap = self.scrape()
+                with self._lock:
+                    self._snapshots.append(snap)
+            except Exception:
+                self.scrape_errors += 1  # racy: loop side, still no lock
+
+
+class TickEngine:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._jits = {}
+        self._pending = []
+        self._t = threading.Thread(target=self._loop, daemon=True)
+        self._t.start()
+
+    def submit(self, n):
+        with self._cv:
+            self._pending.append(n)
+            self._cv.notify()
+
+    def executables(self):
+        return sum(1 for _ in self._jits.values())  # iterates lock-free
+
+    def _loop(self):
+        while True:
+            try:
+                with self._cv:
+                    while not self._pending:
+                        self._cv.wait()
+                    n = self._pending.pop()
+                if self._jits.get(n) is None:
+                    self._jits[n] = object()  # racy: insert outside _cv
+            except Exception:
+                return
+
+
+class Publisher:
+    def __init__(self):
+        self.registry = None
+        self._t = threading.Thread(target=self._loop, daemon=True)
+        self._t.start()
+
+    def set_registry(self, registry):
+        self.registry = registry  # racy: unguarded late-bind rebind
+
+    def _loop(self):
+        while True:
+            try:
+                registry = self.registry
+                if registry is not None:
+                    registry.inc("tick")
+            except Exception:
+                return
+
+
+class SplitGuard:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stats_lock = threading.Lock()
+        self._inflight = {}
+        self._t = threading.Thread(target=self._loop, daemon=True)
+        self._t.start()
+
+    def note(self, key):
+        self._note_stats(key)
+
+    def _note_stats(self, key):
+        with self._stats_lock:
+            self._bump(key)
+
+    def _bump(self, key):
+        self._inflight[key] = 1  # "under a lock" — the WRONG lock
+
+    def _loop(self):
+        while True:
+            try:
+                with self._lock:
+                    for key in self._inflight:  # reader holds the other
+                        _ = key
+            except Exception:
+                return

@@ -1,0 +1,185 @@
+"""LOCKSET-RACE clean twins: the post-fix shapes plus every documented
+exemption.
+
+- ``FixedScrapeLoop`` / ``FixedTickEngine`` / ``FixedSplitGuard`` — the
+  bad fixtures' races fixed with one consistent guard.
+- ``FixedPublisher`` — the safe-publication pattern: every write is a
+  pure reference rebind under one lock, reads are GIL-atomic reference
+  loads (the post-fix ``set_registry``/``fleet.attach`` shape).
+- ``InitOnly`` — fields written only in ``__init__``/the spawning
+  method (the virgin phase: the thread does not exist yet).
+- ``LoopLocal`` — a field only the loop root touches (single-threaded).
+- ``Convention`` — the ``*_locked`` caller-holds-the-lock convention
+  vouches for the helper's writes.
+"""
+
+import threading
+
+
+class FixedScrapeLoop:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._snapshots = []
+        self.scrape_errors = 0
+        self._t = threading.Thread(target=self._loop, daemon=True)
+        self._t.start()
+
+    def scrape(self):
+        try:
+            return {"up": 1}
+        except Exception:
+            with self._lock:
+                self.scrape_errors += 1
+            raise
+
+    def _loop(self):
+        while True:
+            try:
+                snap = self.scrape()
+                with self._lock:
+                    self._snapshots.append(snap)
+            except Exception:
+                with self._lock:
+                    self.scrape_errors += 1
+
+
+class FixedTickEngine:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._jits = {}
+        self._pending = []
+        self._t = threading.Thread(target=self._loop, daemon=True)
+        self._t.start()
+
+    def submit(self, n):
+        with self._cv:
+            self._pending.append(n)
+            self._cv.notify()
+
+    def executables(self):
+        with self._cv:
+            return sum(1 for _ in self._jits.values())
+
+    def _loop(self):
+        while True:
+            try:
+                with self._cv:
+                    while not self._pending:
+                        self._cv.wait()
+                    n = self._pending.pop()
+                    if self._jits.get(n) is None:
+                        self._jits[n] = object()
+            except Exception:
+                return
+
+
+class FixedPublisher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.registry = None
+        self._t = threading.Thread(target=self._loop, daemon=True)
+        self._t.start()
+
+    def set_registry(self, registry):
+        with self._lock:  # guarded rebind: safe publication
+            self.registry = registry
+
+    def _loop(self):
+        while True:
+            try:
+                registry = self.registry  # atomic reference load
+                if registry is not None:
+                    registry.inc("tick")
+            except Exception:
+                return
+
+
+class FixedSplitGuard:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._inflight = {}
+        self._t = threading.Thread(target=self._loop, daemon=True)
+        self._t.start()
+
+    def note(self, key):
+        self._note_stats(key)
+
+    def _note_stats(self, key):
+        with self._lock:
+            self._bump(key)
+
+    def _bump(self, key):
+        self._inflight[key] = 1  # under the SAME lock the reader holds
+
+    def _loop(self):
+        while True:
+            try:
+                with self._lock:
+                    for key in self._inflight:
+                        _ = key
+            except Exception:
+                return
+
+
+class InitOnly:
+    def __init__(self):
+        # virgin phase: no second thread exists yet (the spawning
+        # method enjoys the same exemption — unit-tested directly)
+        self.block_size = 16
+        self.limit = self.block_size * 8
+
+    def start(self):
+        self._t = threading.Thread(target=self._loop, daemon=True)
+        self._t.start()
+
+    def describe(self):
+        return (self.block_size, self.limit)
+
+    def _loop(self):
+        while True:
+            try:
+                if self.block_size > self.limit:
+                    return
+            except Exception:
+                return
+
+
+class LoopLocal:
+    def __init__(self):
+        self._ticks = 0
+        self._t = threading.Thread(target=self._loop, daemon=True)
+        self._t.start()
+
+    def ping(self):
+        return True
+
+    def _loop(self):
+        while True:
+            try:
+                self._ticks += 1  # only this root ever touches it
+            except Exception:
+                return
+
+
+class Convention:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries = {}
+        self._t = threading.Thread(target=self._loop, daemon=True)
+        self._t.start()
+
+    def put(self, key):
+        with self._lock:
+            self._put_locked(key)
+
+    def _put_locked(self, key):
+        self._entries[key] = 1  # caller holds the lock by convention
+
+    def _loop(self):
+        while True:
+            try:
+                with self._lock:
+                    for key in self._entries:
+                        _ = key
+            except Exception:
+                return

@@ -1,0 +1,26 @@
+"""STALE-SUPPRESS fixtures: waivers whose rules no longer fire.
+
+Three stale shapes: a waiver left behind after the hazard was fixed
+(monotonic deadline, TIME-WALL long gone), a multi-rule waiver where
+only one rule still fires (the other id is dead weight), and a blanket
+reasoned waiver on a line where nothing fires at all.
+"""
+
+import time
+
+
+def fixed_long_ago():
+    # the code moved to monotonic; the waiver outlived the hazard
+    deadline = time.monotonic() + 5  # tpulint: disable=TIME-WALL -- wall clock mandated (no longer true)
+    return deadline
+
+
+def half_stale():
+    # TIME-WALL still fires (and is waived); NPY-TRUTH never did
+    deadline = time.time() + 5  # tpulint: disable=TIME-WALL,NPY-TRUTH -- protocol deadline
+    return deadline
+
+
+def blanket_over_nothing():
+    value = 1  # tpulint: disable -- defensive waiver nobody needed
+    return value

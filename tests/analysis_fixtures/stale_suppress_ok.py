@@ -1,0 +1,16 @@
+"""STALE-SUPPRESS clean twin: every reasoned waiver still matches a
+live finding on its line — used waivers are decisions, not debt."""
+
+import time
+
+
+def protocol_deadline():
+    # TIME-WALL fires here and the waiver absorbs it: not stale
+    deadline = time.time() + 5  # tpulint: disable=TIME-WALL -- wire protocol requires wall-clock budget
+    return deadline
+
+
+def rationale_above():
+    # tpulint: disable=TIME-WALL -- server compares against epoch stamps
+    expiry = time.time() + 60
+    return expiry

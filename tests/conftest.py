@@ -42,29 +42,64 @@ def pytest_addoption(parser):
             "does the same — the make-soak hookup)"
         ),
     )
+    parser.addoption(
+        "--race-witness", action="store_true", default=False,
+        help=(
+            "arm the dynamic RACE witness on top of the lock-order one: "
+            "@witness_shared classes run the Eraser lockset algorithm "
+            "on every field access against the real held-lock stack; an "
+            "unguarded shared write fails the test with both stacks "
+            "(TPULINT_RACE_WITNESS=1 does the same — the make-chaos/"
+            "make-soak hookup)"
+        ),
+    )
 
 
 import pytest  # noqa: E402
+
+
+def _env_truthy(name):
+    return os.environ.get(name, "").strip().lower() not in (
+        "", "0", "false", "no", "off"
+    )
 
 
 @pytest.fixture(autouse=True)
 def _lock_order_witness(request):
     """Opt-in dynamic lock-order witness (see client_tpu.analysis.witness):
     records the acquisition DAG the test actually exercises and fails on a
-    cycle — the runtime complement of the static LOCK-INV rule."""
-    env = os.environ.get("TPULINT_LOCK_WITNESS", "").strip().lower()
-    enabled = request.config.getoption("--lock-witness") or env not in (
-        "", "0", "false", "no", "off"
+    cycle — the runtime complement of the static LOCK-INV rule.  With
+    --race-witness / TPULINT_RACE_WITNESS=1 the witness is a RaceWitness:
+    lock-order duty plus runtime Eraser lockset checks on @witness_shared
+    classes (the complement of the static LOCKSET-RACE rule), violations
+    dumped to the flight recorder."""
+    race = request.config.getoption("--race-witness") or _env_truthy(
+        "TPULINT_RACE_WITNESS"
     )
+    enabled = race or request.config.getoption(
+        "--lock-witness"
+    ) or _env_truthy("TPULINT_LOCK_WITNESS")
     if not enabled:
         yield None
         return
-    from client_tpu.analysis.witness import LockWitness
+    if race:
+        from client_tpu.analysis.witness import RaceWitness
 
-    witness = LockWitness()
+        flight = None
+        if os.environ.get("TPU_FLIGHT_DIR"):
+            from client_tpu.serve.flight import FlightRecorder
+
+            flight = FlightRecorder(name="race-witness")
+        witness = RaceWitness(flight=flight)
+    else:
+        from client_tpu.analysis.witness import LockWitness
+
+        witness = LockWitness()
     with witness.installed():
         yield witness
     witness.assert_acyclic()
+    if race:
+        witness.assert_race_free()
 
 
 # Native libraries are build artifacts (gitignored): build them on demand so a

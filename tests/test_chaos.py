@@ -188,6 +188,90 @@ def test_run_scenario_collects_errors_and_wedges():
         slow.set()
 
 
+def test_chaos_matrix_round_under_race_witness(tmp_path):
+    """A chaos-matrix round with the dynamic race witness armed (the
+    TPULINT_RACE_WITNESS=1 shape `make chaos` runs): concurrent drivers
+    hammering the @witness_shared StepLedger stay green through the
+    assert_race_witness_clean invariant, and a seeded unguarded-write
+    fixture goes red — with the violation evidence dumped to the
+    fixture's flight recorder."""
+    from client_tpu.analysis.witness import RaceViolation, RaceWitness
+    from client_tpu.testing.chaos import assert_race_witness_clean
+
+    class _LedgerFixture:
+        def __init__(self, racy):
+            self.racy = racy
+            self.ledger = StepLedger()  # @witness_shared("_lock")
+            self.flight = FlightRecorder(
+                dump_dir=str(tmp_path), name="race-round"
+            )
+            self.seq = 0
+
+        def flight_recorders(self):
+            return [self.flight]
+
+        def apply_fault(self, fault):
+            dispatch_fault(fault)
+
+        def drivers(self):
+            def drive(replica):
+                def run():
+                    for step in range(40):
+                        self.ledger.record(replica, step, f"r{replica}")
+                        if self.racy:
+                            try:
+                                # a deliberately unguarded shared write —
+                                # SWALLOWED here so only the matrix
+                                # invariant can fail the round
+                                self.seq = self.seq + 1
+                            except RaceViolation:
+                                pass
+                return run
+
+            return [drive(0), drive(1), drive(2)]
+
+        def check(self, result):
+            result.assert_clean()
+            self.ledger.assert_exactly_once()
+
+        def close(self):
+            pass
+
+    scenario = ChaosScenario("race-witness-round")
+
+    witness = RaceWitness()
+    with witness.installed():
+        ChaosMatrix(
+            [scenario],
+            invariants=[lambda fx, res: assert_race_witness_clean(witness)],
+        ).run(lambda s: _LedgerFixture(racy=False))
+    assert witness.assert_race_free() > 0  # the ledger WAS witnessed
+    assert witness.assert_acyclic() >= 0   # lock-order duty intact
+
+    seeded = RaceWitness()
+    seeded.watch_class(_LedgerFixture, fields=("seq",))
+    fixtures = []
+
+    def make_racy(s):
+        fixtures.append(_LedgerFixture(racy=True))
+        return fixtures[-1]
+
+    with seeded.installed():
+        with pytest.raises(RaceViolation):
+            ChaosMatrix(
+                [scenario],
+                invariants=[
+                    lambda fx, res: assert_race_witness_clean(seeded)
+                ],
+            ).run(make_racy)
+    assert seeded.race_violations
+    # the red round dumped its own postmortem via the matrix hook
+    flight = fixtures[0].flight
+    kinds = [r["kind"] for r in flight.snapshot()]
+    assert "chaos_invariant_failure" in kinds
+    assert flight.dumps
+
+
 def test_dispatch_fault_drives_a_fault_proxy():
     import socket
 
