@@ -23,15 +23,19 @@
 #                    tier-1 pytest command (ROADMAP.md "Tier-1 verify").
 #   make chaos       the fast chaos-matrix subset (tests/test_chaos.py:
 #                    deterministic fault schedules + invariant checkers)
-#                    under the dynamic lock-order AND race witnesses
-#                    (TPULINT_LOCK_WITNESS=1 TPULINT_RACE_WITNESS=1) —
-#                    the quick failure-domain gate.
+#                    under the dynamic lock-order, race AND resource
+#                    witnesses (TPULINT_LOCK_WITNESS=1
+#                    TPULINT_RACE_WITNESS=1 TPULINT_RESOURCE_WITNESS=1)
+#                    — the quick failure-domain gate.
 #   make soak        slow-tier chaos repetition, run under the DYNAMIC
 #                    witnesses: every lock built under client_tpu/
 #                    records the real acquisition DAG (a cycle fails the
-#                    round) and @witness_shared classes run the Eraser
+#                    round), @witness_shared classes run the Eraser
 #                    lockset algorithm per field access (an unguarded
-#                    shared write fails with both stacks + a flight dump).
+#                    shared write fails with both stacks + a flight
+#                    dump), and every registered acquire/release pair is
+#                    tracked in a live-handle table (a leaked KV block /
+#                    lease / span fails the round with its stack).
 
 PROTO_DIR := proto
 PB_OUT := client_tpu/_proto
@@ -73,6 +77,7 @@ check: lint
 chaos:
 	@mkdir -p build/flight/chaos
 	@JAX_PLATFORMS=cpu TPULINT_LOCK_WITNESS=1 TPULINT_RACE_WITNESS=1 \
+	    TPULINT_RESOURCE_WITNESS=1 \
 	    TPU_FLIGHT_DIR=build/flight/chaos \
 	    python -m pytest tests/test_chaos.py -q -m 'not slow' \
 	    -p no:cacheprovider -p no:xdist -p no:randomly || { \
@@ -91,8 +96,9 @@ SOAK_N ?= 3
 soak:
 	@mkdir -p build/flight/soak
 	@for i in $$(seq 1 $(SOAK_N)); do \
-	  echo "== soak round $$i/$(SOAK_N) (lock-order + race witness armed) =="; \
+	  echo "== soak round $$i/$(SOAK_N) (lock-order + race + resource witness armed) =="; \
 	  JAX_PLATFORMS=cpu TPULINT_LOCK_WITNESS=1 TPULINT_RACE_WITNESS=1 \
+	      TPULINT_RESOURCE_WITNESS=1 \
 	      TPU_FLIGHT_DIR=build/flight/soak \
 	      python -m pytest tests/test_discovery.py \
 	      tests/test_balance.py tests/test_frontdoor.py \

@@ -10,12 +10,16 @@ graph with per-function lock summaries: lock-order inversion
 (LOCK-INV), blocking work reached under a lock through any call depth
 (BLOCK-UNDER-LOCK), observer callbacks invoked while a private lock is
 held (CALLBACK-UNDER-LOCK), peer RPCs under engine/pool locks
-(PEER-CALL-UNDER-LOCK), and Eraser-style per-field lockset inference
-across thread roots (LOCKSET-RACE, ``analysis/locksets.py``).  Dynamic
+(PEER-CALL-UNDER-LOCK), Eraser-style per-field lockset inference
+across thread roots (LOCKSET-RACE, ``analysis/locksets.py``), and
+interprocedural resource-lifecycle ownership tracking (RESOURCE-LEAK,
+DOUBLE-RELEASE, USE-AFTER-RELEASE, ``analysis/resources.py``).  Dynamic
 witnesses (``client_tpu.analysis.witness``) keep the static pass
 honest: ``LockWitness`` records the real acquisition DAG under test,
-and ``RaceWitness`` runs the lockset algorithm at runtime on
-``@witness_shared`` classes (``TPULINT_RACE_WITNESS=1``).
+``RaceWitness`` runs the lockset algorithm at runtime on
+``@witness_shared`` classes (``TPULINT_RACE_WITNESS=1``), and
+``ResourceWitness`` keeps a live-handle table over the registered
+acquire/release pairs (``TPULINT_RESOURCE_WITNESS=1``).
 
 Run ``python -m client_tpu.analysis [paths]`` (exits non-zero on
 findings) or ``make lint``.
@@ -46,6 +50,7 @@ def _load_core():
     attach an inert class attribute; loading lazily keeps the product
     free of the lint tool until someone actually lints."""
     from client_tpu.analysis import core
+    from client_tpu.analysis import resources  # noqa: F401  (registers)
     from client_tpu.analysis import rules  # noqa: F401  (registers)
     from client_tpu.analysis import (  # noqa: F401  (registers)
         concurrency,

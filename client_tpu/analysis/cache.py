@@ -20,7 +20,7 @@ import hashlib
 import json
 import os
 
-_VERSION = 2  # v2: per-file suppression comments + the program entry
+_VERSION = 3  # v3: summaries carry resource events (resources/res_facts)
 DEFAULT_CACHE = os.path.join(os.path.dirname(__file__), ".cache.json")
 
 

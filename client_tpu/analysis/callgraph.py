@@ -50,6 +50,7 @@ import ast
 import os
 import re
 
+from client_tpu.analysis import resources as _res
 from client_tpu.analysis.rules import (
     _CVLIKE_RE,
     _DISPATCH_FULL,
@@ -154,7 +155,8 @@ class FunctionSummary:
 
     __slots__ = ("qualname", "name", "cls", "line", "requires_lock",
                  "params_min", "params_max", "acquisitions", "calls",
-                 "blocking", "callbacks", "accesses",
+                 "blocking", "callbacks", "accesses", "resources",
+                 "res_facts",
                  # scanner scratch (never serialized)
                  "_param_names", "_getattr_locals", "_access_seen")
 
@@ -180,6 +182,15 @@ class FunctionSummary:
         # (attr, kind, held) triple — [{"attr", "kind": "read"|"write",
         # "line", "col", "held": [...]}]
         self.accesses = []
+        # resource handle records (lifecycle pass, see resources.py):
+        # one entry per acquisition site / wrapper-call binding, each
+        # carrying its branch-arm context, the ops/arg-passes performed
+        # on the handle, and how (if at all) ownership escaped
+        self.resources = []
+        # function-level ownership facts: {"returns", "ret_calls",
+        # "params", "exits"} — what the interprocedural transfer
+        # resolution reads from the CALLEE side
+        self.res_facts = {}
 
     def to_dict(self):
         return {
@@ -190,6 +201,7 @@ class FunctionSummary:
             "calls": [dict(c, ref=list(c["ref"])) for c in self.calls],
             "blocking": self.blocking, "callbacks": self.callbacks,
             "accesses": self.accesses,
+            "resources": self.resources, "res_facts": self.res_facts,
         }
 
     @classmethod
@@ -201,6 +213,8 @@ class FunctionSummary:
         fn.blocking = d["blocking"]
         fn.callbacks = d["callbacks"]
         fn.accesses = d.get("accesses", [])
+        fn.resources = d.get("resources", [])
+        fn.res_facts = d.get("res_facts", {})
         return fn
 
 
@@ -762,6 +776,520 @@ class _FunctionScanner:
         return ("dotted", text)
 
 
+class _ResourceScanner:
+    """Walk one function body collecting resource-handle lifecycles.
+
+    Complements :class:`_FunctionScanner` (which tracks the held-lock
+    dimension) with the OWNERSHIP dimension: every acquisition site from
+    the registered spec table (``resources.SPECS``) — plus every local
+    bound from a resolvable call, a *candidate* whose resource-ness the
+    program pass decides through the callee's summary — gets a handle
+    record carrying its branch-arm context, the ops/arg-passes performed
+    on the handle, and how (if at all) ownership escaped the function.
+    Function-level facts (what the function returns freshly acquired,
+    which parameters it takes ownership of, its explicit exits) feed the
+    callee side of the interprocedural transfer resolution.
+
+    Contexts are "nid:arm" tokens per enclosing if/try/loop arm — the
+    branch-arm bookkeeping ``resources.py``'s path algebra consumes.
+    """
+
+    def __init__(self, modsum, fn_summary):
+        self.mod = modsum
+        self.fn = fn_summary
+        self.records = []
+        self.open = {}        # local name -> its current handle record
+        self.params = {}      # param name -> ownership events
+        self.exits = []
+        self.ret_calls = []
+        self.returns = None
+        self._param_idx = {}
+        self._raises_depth = 0  # inside `with pytest.raises(...)`
+
+    def scan(self, fn_node):
+        args = fn_node.args
+        pos = args.posonlyargs + args.args
+        names = [a.arg for a in pos]
+        if names and names[0] in ("self", "cls"):
+            names = names[1:]
+        self._param_idx = {n: i for i, n in enumerate(names)}
+        for stmt in fn_node.body:
+            self._stmt(stmt, (), False, ())
+        if self.returns is None:
+            for rec in self.records:
+                if rec["res"] and "returned" in rec["escapes"] and (
+                    not rec["in_with"]
+                ):
+                    self.returns = rec["res"]
+                    break
+        for rec in self.records:
+            # a returned wrapper-call binding chains the returns fact
+            if rec["via"] and "returned" in rec["escapes"]:
+                self.ret_calls.append(list(rec["via"]))
+        self.fn.resources = self.records
+        facts = {}
+        if self.returns is not None:
+            facts["returns"] = self.returns
+        if self.ret_calls:
+            facts["ret_calls"] = self.ret_calls
+        if self.params:
+            facts["params"] = self.params
+        if self.exits:
+            facts["exits"] = self.exits
+        self.fn.res_facts = facts
+
+    # -- plumbing ------------------------------------------------------------
+
+    @staticmethod
+    def _unwrap(value):
+        while isinstance(value, ast.Await):
+            value = value.value
+        return value
+
+    def _ref(self, call):
+        """Resolvable [kind, value] reference for a call, or None
+        (list-typed: these are serialized into the summary)."""
+        func = call.func
+        if isinstance(func, ast.Name):
+            return ["name", func.id]
+        if not isinstance(func, ast.Attribute):
+            return None
+        text = _expr_text(func)
+        if text is None:
+            return None
+        if text.startswith("self.") and text.count(".") == 1:
+            return ["self", func.attr]
+        base = text.split(".", 1)[0]
+        if base in self.mod.imports or base in self.mod.classes:
+            return ["dotted", text]
+        return ["method", func.attr]
+
+    def _open_record(self, var, res, api, via, node, ctx, fin,
+                     in_with=False, daemon=False):
+        rec = {
+            "res": res, "api": api, "via": via, "var": var,
+            "line": node.lineno, "col": node.col_offset,
+            "ctx": list(ctx), "fin": fin, "in_with": in_with,
+            "daemon": daemon, "escapes": [], "ops": [], "passed": [],
+        }
+        self.records.append(rec)
+        if var is not None:
+            self._bind(var, rec)
+        return rec
+
+    def _bind(self, name, rec):
+        """Bind *name* to *rec*.  A rebind in a conditional arm does NOT
+        drop earlier records for the name — on the other arm the name
+        still refers to the old handle, so later ops/escapes must apply
+        to both (``fresh = alloc(); if ...: fresh = alloc(); return
+        fresh`` returns either one)."""
+        ctx = rec["ctx"]
+        kept = [
+            r for r in self.open.get(name, ())
+            if not _res._unconditional_after(r["ctx"], ctx)
+        ]
+        kept.append(rec)
+        self.open[name] = kept
+
+    def _clear(self, name, ctx):
+        """*name* rebound to a non-handle at *ctx*: drop only the
+        records the rebind definitely shadows."""
+        kept = [
+            r for r in self.open.get(name, ())
+            if not _res._unconditional_after(r["ctx"], ctx)
+        ]
+        if kept:
+            self.open[name] = kept
+        else:
+            self.open.pop(name, None)
+
+    def _param_entry(self, name):
+        idx = self._param_idx.get(name)
+        if idx is None:
+            return None
+        entry = self.params.get(name)
+        if entry is None:
+            entry = self.params[name] = {
+                "idx": idx, "released": False, "stored": False,
+                "passed": [],
+            }
+        return entry
+
+    def _op(self, name, api, node, ctx, fin):
+        for rec in self.open.get(name, ()):
+            rec["ops"].append({
+                "api": api, "line": node.lineno,
+                "col": node.col_offset, "ctx": list(ctx), "fin": fin,
+            })
+
+    def _escape(self, value, how):
+        """Every tracked/param name inside *value* escapes as *how*."""
+        for sub in ast.walk(value):
+            if not isinstance(sub, ast.Name):
+                continue
+            recs = self.open.get(sub.id)
+            if recs:
+                for rec in recs:
+                    if how not in rec["escapes"]:
+                        rec["escapes"].append(how)
+                continue
+            entry = self._param_entry(sub.id)
+            if entry is not None and how == "stored":
+                entry["stored"] = True
+
+    @staticmethod
+    def _daemon_kw(call):
+        for kw in call.keywords:
+            if kw.arg == "daemon" and isinstance(kw.value, ast.Constant):
+                return bool(kw.value.value)
+        return False
+
+    @staticmethod
+    def _none_guards(test, guards):
+        """(then-arm, else-arm) guard sets for an if-test: the arm on
+        which a named handle is known None/falsy (so an exit there never
+        leaks it — the admission-backpressure idiom)."""
+        then_g, else_g = list(guards), list(guards)
+        if (
+            isinstance(test, ast.Compare) and len(test.ops) == 1
+            and isinstance(test.comparators[0], ast.Constant)
+            and test.comparators[0].value is None
+            and isinstance(test.left, ast.Name)
+        ):
+            if isinstance(test.ops[0], ast.Is):
+                then_g.append(test.left.id)
+            elif isinstance(test.ops[0], ast.IsNot):
+                else_g.append(test.left.id)
+        elif isinstance(test, ast.UnaryOp) and isinstance(
+            test.op, ast.Not
+        ) and isinstance(test.operand, ast.Name):
+            then_g.append(test.operand.id)
+        elif isinstance(test, ast.Name):
+            else_g.append(test.id)
+        return tuple(then_g), tuple(else_g)
+
+    # -- statements ----------------------------------------------------------
+
+    def _stmt(self, node, ctx, fin, guards):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # nested defs carry their own summaries
+        if isinstance(node, ast.Assign):
+            self._assign(node.targets, node.value, node, ctx, fin)
+            return
+        if isinstance(node, ast.AnnAssign):
+            if node.value is not None:
+                self._assign([node.target], node.value, node, ctx, fin)
+            return
+        if isinstance(node, ast.AugAssign):
+            self._expr(node.value, ctx, fin)
+            return
+        if isinstance(node, ast.Expr):
+            self._expr(node.value, ctx, fin, discard=True)
+            return
+        if isinstance(node, ast.Return):
+            self._return(node, ctx, fin, guards)
+            return
+        if isinstance(node, ast.Raise):
+            for child in ast.iter_child_nodes(node):
+                self._escape(child, "raised")
+                self._expr(child, ctx, fin)
+            self.exits.append({
+                "kind": "raise", "line": node.lineno,
+                "ctx": list(ctx), "guards": list(guards),
+            })
+            return
+        if isinstance(node, ast.If):
+            self._expr(node.test, ctx, fin)
+            nid = f"if{node.lineno}"
+            then_g, else_g = self._none_guards(node.test, guards)
+            for stmt in node.body:
+                self._stmt(stmt, ctx + (f"{nid}:t",), fin, then_g)
+            for stmt in node.orelse:
+                self._stmt(stmt, ctx + (f"{nid}:e",), fin, else_g)
+            return
+        if isinstance(node, ast.While):
+            self._expr(node.test, ctx, fin)
+            tok = f"loop{node.lineno}:l"
+            for stmt in node.body:
+                self._stmt(stmt, ctx + (tok,), fin, guards)
+            for stmt in node.orelse:
+                self._stmt(stmt, ctx, fin, guards)
+            return
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            if isinstance(node.iter, ast.Name):
+                self._op(node.iter.id, "[iterated]", node.iter, ctx, fin)
+            else:
+                self._expr(node.iter, ctx, fin)
+            tok = f"loop{node.lineno}:l"
+            for stmt in node.body:
+                self._stmt(stmt, ctx + (tok,), fin, guards)
+            for stmt in node.orelse:
+                self._stmt(stmt, ctx, fin, guards)
+            return
+        if isinstance(node, ast.Try):
+            nid = f"try{node.lineno}"
+            for stmt in node.body:
+                self._stmt(stmt, ctx + (f"{nid}:b",), fin, guards)
+            for i, handler in enumerate(node.handlers):
+                for stmt in handler.body:
+                    self._stmt(stmt, ctx + (f"{nid}:h{i}",), fin, guards)
+            for stmt in node.orelse:
+                self._stmt(stmt, ctx + (f"{nid}:o",), fin, guards)
+            for stmt in node.finalbody:
+                self._stmt(stmt, ctx + (f"{nid}:f",), True, guards)
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            raisesctx = False
+            for item in node.items:
+                ce = self._unwrap(item.context_expr)
+                acq = None
+                if isinstance(ce, ast.Call):
+                    ftext = _expr_text(ce.func) or ""
+                    acq = _res.classify_acquire(ftext)
+                    last = _last_segment(ftext)
+                    if last == "raises" or last.startswith("assertRaises"):
+                        raisesctx = True
+                if acq is not None:
+                    var = (
+                        item.optional_vars.id
+                        if isinstance(item.optional_vars, ast.Name)
+                        else None
+                    )
+                    self._open_record(var, acq[0], acq[1], None, ce, ctx,
+                                      fin, in_with=True)
+                    for a in ce.args:
+                        self._expr(a, ctx, fin)
+                else:
+                    self._expr(item.context_expr, ctx, fin)
+            self._raises_depth += raisesctx
+            for stmt in node.body:
+                self._stmt(stmt, ctx, fin, guards)
+            self._raises_depth -= raisesctx
+            return
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.stmt):
+                self._stmt(child, ctx, fin, guards)
+            elif isinstance(child, ast.expr):
+                self._expr(child, ctx, fin)
+
+    def _assign(self, targets, value_node, node, ctx, fin):
+        value = self._unwrap(value_node)
+        if len(targets) == 1 and isinstance(targets[0], ast.Name):
+            name = targets[0].id
+            if isinstance(value, ast.Call):
+                acq = _res.classify_acquire(
+                    _expr_text(value.func) or ""
+                )
+                if acq is not None:
+                    kind, api = acq
+                    daemon = (
+                        kind == "thread" and self._daemon_kw(value)
+                    )
+                    for a in value.args:
+                        self._expr(a, ctx, fin)
+                    for kw in value.keywords:
+                        self._expr(kw.value, ctx, fin)
+                    self._open_record(name, kind, api, None, node, ctx,
+                                      fin, daemon=daemon)
+                    return
+                callee = self._ref(value)
+                self._call(value, ctx, fin)
+                if callee is not None:
+                    nargs = len(value.args) + len(value.keywords)
+                    self._open_record(
+                        name, None, _expr_text(value.func) or callee[1],
+                        callee + [nargs], node, ctx, fin,
+                    )
+                else:
+                    self._clear(name, ctx)
+                return
+            if isinstance(value, ast.Name):
+                recs = self.open.get(value.id)
+                if recs:
+                    self.open[name] = list(recs)  # alias: same handles
+                    return
+            # a tracked handle folded into a composite value (tuple,
+            # list concat, slice) now travels under another local our
+            # per-name map cannot follow — benefit of the doubt, it
+            # escaped (FN over FP)
+            self._escape(value_node, "merged")
+            self._expr(value_node, ctx, fin)
+            self._clear(name, ctx)
+            return
+        for target in targets:
+            if isinstance(target, (ast.Attribute, ast.Subscript,
+                                   ast.Tuple, ast.List, ast.Starred)):
+                self._escape(value_node, "stored")
+                if (
+                    isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.attr == "daemon"
+                ):
+                    for rec in self.open.get(target.value.id, ()):
+                        rec["daemon"] = not (
+                            isinstance(value, ast.Constant)
+                            and not value.value
+                        )
+                self._expr(target, ctx, fin)
+        self._expr(value_node, ctx, fin)
+
+    def _return(self, node, ctx, fin, guards):
+        value = node.value
+        if value is not None:
+            v = self._unwrap(value)
+            if isinstance(v, ast.Call):
+                acq = _res.classify_acquire(_expr_text(v.func) or "")
+                if acq is not None:
+                    if self.returns is None:
+                        self.returns = acq[0]
+                else:
+                    callee = self._ref(v)
+                    if callee is not None:
+                        nargs = len(v.args) + len(v.keywords)
+                        self.ret_calls.append(
+                            [callee[0], callee[1], nargs]
+                        )
+            self._escape(value, "returned")
+            self._expr(value, ctx, fin)
+        self.exits.append({
+            "kind": "return", "line": node.lineno,
+            "ctx": list(ctx), "guards": list(guards),
+        })
+
+    # -- expressions ---------------------------------------------------------
+
+    def _expr(self, node, ctx, fin, discard=False):
+        if node is None or isinstance(node, ast.Lambda):
+            return
+        if isinstance(node, ast.Await):
+            self._expr(node.value, ctx, fin, discard=discard)
+            return
+        if isinstance(node, (ast.Yield, ast.YieldFrom)):
+            if node.value is not None:
+                self._escape(node.value, "yielded")
+                self._expr(node.value, ctx, fin)
+            return
+        if isinstance(node, ast.Call):
+            self._call(node, ctx, fin, discard=discard)
+            return
+        if isinstance(node, ast.Subscript):
+            if isinstance(node.value, ast.Name):
+                self._op(node.value.id, "[subscript]", node, ctx, fin)
+            else:
+                self._expr(node.value, ctx, fin)
+            self._expr(node.slice, ctx, fin)
+            return
+        if isinstance(node, ast.Attribute):
+            if isinstance(node.value, ast.Name):
+                self._op(node.value.id, f"[attr {node.attr}]", node,
+                         ctx, fin)
+            else:
+                self._expr(node.value, ctx, fin)
+            return
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self._expr(child, ctx, fin)
+
+    def _call(self, call, ctx, fin, discard=False):
+        func = call.func
+        text = _expr_text(func) or ""
+        if discard:
+            acq = _res.classify_acquire(text)
+            if acq is not None and acq[1] == "retain":
+                # a standalone retain() increments a reference whose
+                # owner lives elsewhere (prefix-trie nodes, an adopting
+                # lane): class-level inc/dec balance is the lexical
+                # REFCOUNT-PAIR rule's beat, not a local lifecycle
+                acq = None
+            if acq is not None and self._raises_depth:
+                # `with pytest.raises(...): pool.lease()` — the call is
+                # asserted to raise, so nothing is ever acquired
+                acq = None
+            if acq is not None:
+                kind, api = acq
+                daemon = kind == "thread" and self._daemon_kw(call)
+                self._open_record(None, kind, api, None, call, ctx,
+                                  fin, daemon=daemon)
+                for a in call.args:
+                    self._expr(a, ctx, fin)
+                for kw in call.keywords:
+                    self._expr(kw.value, ctx, fin)
+                return
+        # the callee itself: a method ON a tracked handle / param
+        if isinstance(func, ast.Attribute) and isinstance(
+            func.value, ast.Name
+        ):
+            name = func.value.id
+            recs = self.open.get(name)
+            if recs:
+                self._op(name, func.attr, call, ctx, fin)
+                if func.attr == "setDaemon":
+                    for rec in recs:
+                        rec["daemon"] = True
+            else:
+                entry = self._param_entry(name)
+                if entry is not None and _res.release_api_any(func.attr):
+                    entry["released"] = True
+        elif isinstance(func, ast.Name):
+            if self.open.get(func.id):
+                self._op(func.id, "[called]", call, ctx, fin)
+        else:
+            self._expr(func, ctx, fin)
+        # top-level arguments: handles/params handed to the callee
+        recv_last = ""
+        meth = None
+        if isinstance(func, ast.Attribute):
+            meth = func.attr
+            recv_text = _expr_text(func.value)
+            if recv_text:
+                recv_last = _last_segment(recv_text)
+        elif isinstance(func, ast.Name):
+            meth = func.id
+        callee = self._ref(call)
+        nargs = len(call.args) + len(call.keywords)
+        for i, arg in enumerate(call.args):
+            argpos = i
+            if isinstance(arg, ast.Starred):
+                arg = arg.value
+                argpos = -1
+            if isinstance(arg, ast.Name):
+                self._passed(arg.id, callee, nargs, argpos, meth,
+                             recv_last, arg, ctx, fin)
+            else:
+                self._expr(arg, ctx, fin)
+        for kw in call.keywords:
+            if isinstance(kw.value, ast.Name):
+                self._passed(kw.value.id, callee, nargs, -1, meth,
+                             recv_last, kw.value, ctx, fin)
+            else:
+                self._expr(kw.value, ctx, fin)
+
+    def _passed(self, name, callee, nargs, argpos, meth, recv_last,
+                node, ctx, fin):
+        recs = self.open.get(name)
+        if recs:
+            for rec in recs:
+                rec["passed"].append({
+                    "ref": callee, "nargs": nargs, "argpos": argpos,
+                    "meth": meth, "recv": recv_last,
+                    "line": node.lineno, "col": node.col_offset,
+                    "ctx": list(ctx), "fin": fin,
+                })
+            return
+        entry = self._param_entry(name)
+        if entry is None:
+            return
+        if meth and _res.release_by_arg_any(meth, recv_last):
+            entry["released"] = True
+        elif callee is not None:
+            entry["passed"].append([callee[0], callee[1], nargs, argpos])
+        else:
+            # handed to an unresolvable callee: claim ownership so the
+            # CALLER treats its hand-off as a transfer (FN over FP)
+            entry["passed"].append(["?", "", -1, -1])
+
+
 def summarize_module(tree, path):
     """Build the ModuleSummary for one parsed file."""
     mod = ModuleSummary(path, module_name_for(path))
@@ -853,6 +1381,7 @@ def summarize_module(tree, path):
                         if isinstance(t, ast.Name):
                             local_locks[t.id] = kind
         _FunctionScanner(mod, cls_name, summary, local_locks).scan(fn_node)
+        _ResourceScanner(mod, summary).scan(fn_node)
         mod.functions[qual] = summary
         for child in _direct_nested(fn_node):
             # nested defs: own summary, class context inherited

@@ -1139,9 +1139,10 @@ class JitUnboundedShapeRule(Rule):
         return findings
 
 
-_REFCOUNT_NAME_RE = re.compile(
-    r"(^|_)(refs?|ref_?counts?)$", re.IGNORECASE
-)
+# Shared with the interprocedural resource engine: one spec vocabulary
+# drives this lexical pre-filter, the whole-program rules, and the
+# dynamic ResourceWitness (see analysis/resources.py).
+from client_tpu.analysis.resources import _REFCOUNT_NAME_RE  # noqa: E402
 
 
 @register
@@ -1376,12 +1377,15 @@ class RefcountPairRule(Rule):
         return findings
 
 
-_TRACERISH_RE = re.compile(r"(?i)tracer")
-# explicit span/timer starters (any receiver) + the tracers' sample()
-_SPAN_START_METHODS = {"start_span", "begin_span", "start_timer"}
+# Span vocabulary also lives in the resource spec table: explicit
+# span/timer starters (any receiver) + the tracers' sample(), and the
 # calls that end a started span's lifetime (receiver = the span, or the
-# span passed as an argument: trace.close() / tracer.complete(trace))
-_SPAN_FINISH_METHODS = {"complete", "finish", "close", "end", "stop"}
+# span passed as an argument: trace.close() / tracer.complete(trace)).
+from client_tpu.analysis.resources import (  # noqa: E402
+    _SPAN_FINISH_METHODS,
+    _SPAN_START_METHODS,
+    _TRACERISH_RE,
+)
 
 
 @register

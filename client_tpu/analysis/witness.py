@@ -53,6 +53,17 @@ a per-test LockWitness via the fixture in ``tests/conftest.py`` and
 fails any test whose acquisition graph closed a cycle;
 ``TPULINT_RACE_WITNESS=1`` (the ``make chaos`` / ``make soak`` hookup)
 arms a RaceWitness instead — lock-order duty included.
+
+A third, independent witness covers the OWNERSHIP dimension:
+:class:`ResourceWitness` (``--resource-witness`` /
+``TPULINT_RESOURCE_WITNESS=1``) patches the registered acquire/release
+pairs from ``analysis/resources.py``'s :data:`DYNAMIC_SPECS` (KV block
+alloc/retain/release, endpoint leases, tracer spans) into a live-handle
+table keyed per handle with the acquisition stack; a handle still live
+at :meth:`ResourceWitness.assert_clean` — the per-test teardown audit
+and a chaos-matrix invariant — raises :class:`ResourceLeakError` and
+dumps the table plus stacks to the flight recorder.  The runtime
+complement of the static RESOURCE-LEAK rule, from the same spec table.
 """
 
 import contextlib
@@ -66,6 +77,8 @@ __all__ = [
     "LockWitness",
     "RaceViolation",
     "RaceWitness",
+    "ResourceLeakError",
+    "ResourceWitness",
     "WitnessLock",
     "WitnessCondition",
     "witness_shared",
@@ -689,3 +702,206 @@ class RaceWitness(LockWitness):
                         cls.__getattribute__ = orig_get
                     else:
                         del cls.__getattribute__
+
+
+# -- dynamic resource-leak witness -------------------------------------------
+
+
+class ResourceLeakError(AssertionError):
+    """Handles acquired while the resource witness was armed are still
+    live at a checkpoint."""
+
+
+class ResourceWitness:
+    """Live-handle table over the registered acquire/release pairs.
+
+    The dynamic half of the resource-lifecycle analysis: while
+    installed, every acquire/release pair in
+    ``analysis/resources.py``'s :data:`~client_tpu.analysis.resources.
+    DYNAMIC_SPECS` is patched so each acquisition registers the handle
+    (with its acquisition stack) and each release retires it.  KV block
+    references are counted per ``(pool, block)`` — a retain adds a
+    reference the same release must drop — leases and spans are keyed by
+    object identity.  A release of a handle acquired BEFORE the witness
+    armed is ignored (the table audits the armed window, not history),
+    so a function-scoped witness composes with session-scoped pools.
+
+    :meth:`assert_clean` is the verdict: anything still live raises
+    :class:`ResourceLeakError` carrying every leaked handle's kind,
+    label, reference count, and acquisition stack, and — when a
+    ``flight`` recorder is attached — dumps the table alongside the
+    round's other postmortem artifacts.  Threads, sockets, and files
+    stay static-only (see the DYNAMIC_SPECS comment): patching those
+    class-wide would flag every stdlib-internal fd in the suite.
+    """
+
+    def __init__(self, flight=None):
+        self.flight = flight
+        self._mu = threading.Lock()
+        self._live = {}  # key -> {"kind","label","count","stack"}
+        self.acquisitions = 0
+        self.releases = 0
+
+    # -- the table -----------------------------------------------------------
+
+    def _acquired(self, kind, key, label):
+        stack = _access_stack()
+        with self._mu:
+            self.acquisitions += 1
+            entry = self._live.get(key)
+            if entry is None:
+                self._live[key] = {
+                    "kind": kind, "label": label, "count": 1,
+                    "stack": stack,
+                }
+            else:
+                entry["count"] += 1
+
+    def _released(self, key):
+        with self._mu:
+            entry = self._live.get(key)
+            if entry is None:
+                return  # acquired before arming (or idempotent re-release)
+            self.releases += 1
+            entry["count"] -= 1
+            if entry["count"] <= 0:
+                del self._live[key]
+
+    def live(self):
+        """Snapshot of the live-handle table."""
+        with self._mu:
+            return {k: dict(v) for k, v in self._live.items()}
+
+    def assert_clean(self):
+        """Raise :class:`ResourceLeakError` when handles acquired while
+        armed are still live; returns the acquisition count otherwise
+        (so callers can assert the witness actually saw traffic)."""
+        with self._mu:
+            leaked = {k: dict(v) for k, v in self._live.items()}
+            n = self.acquisitions
+        if not leaked:
+            return n
+        lines = []
+        for key, entry in sorted(
+            leaked.items(), key=lambda kv: str(kv[0])
+        ):
+            frames = "\n".join(
+                f"    {frame}" for frame in entry["stack"]
+            )
+            lines.append(
+                f"  {entry['kind']} {entry['label']} "
+                f"x{entry['count']} acquired at:\n{frames}"
+            )
+        report = (
+            f"{len(leaked)} leaked resource handle(s) at witness "
+            "checkpoint:\n" + "\n".join(lines)
+        )
+        self._dump_leak(leaked, report)
+        raise ResourceLeakError(report)
+
+    def _dump_leak(self, leaked, report):
+        flight = self.flight
+        if flight is None:
+            return
+        try:
+            flight.note(
+                "resource_witness_leak",
+                handles=[
+                    {"kind": e["kind"], "label": e["label"],
+                     "count": e["count"], "stack": e["stack"]}
+                    for e in leaked.values()
+                ],
+                report=report,
+            )
+            flight.dump("resource-leak")
+        except Exception:
+            pass  # evidence is best-effort; the raise is the verdict
+
+    # -- patching ------------------------------------------------------------
+
+    def _wrap_acquire(self, kind, cls, method, mode):
+        orig = getattr(cls, method)
+        witness = self
+
+        def wrapped(self_obj, *args, **kwargs):
+            out = orig(self_obj, *args, **kwargs)
+            try:
+                if mode == "ret-each":
+                    for item in out or ():
+                        witness._acquired(
+                            kind, (kind, id(self_obj), item),
+                            f"{cls.__name__}.{method}() block {item}",
+                        )
+                elif mode == "arg-each":
+                    for item in (args[0] if args else ()) or ():
+                        witness._acquired(
+                            kind, (kind, id(self_obj), item),
+                            f"{cls.__name__}.{method}() block {item}",
+                        )
+                elif mode == "ret" and out is not None:
+                    witness._acquired(
+                        kind, (kind, id(out)),
+                        f"{cls.__name__}.{method}() -> "
+                        f"{type(out).__name__}",
+                    )
+            except Exception:
+                pass  # bookkeeping must never break the product call
+            return out
+
+        setattr(cls, method, wrapped)
+        return cls, method, orig
+
+    def _wrap_release(self, kind, cls, method, mode):
+        orig = getattr(cls, method)
+        witness = self
+
+        def wrapped(self_obj, *args, **kwargs):
+            out = orig(self_obj, *args, **kwargs)
+            try:
+                if mode == "arg-each":
+                    for item in (args[0] if args else ()) or ():
+                        witness._released((kind, id(self_obj), item))
+                elif mode == "self":
+                    witness._released((kind, id(self_obj)))
+                elif mode == "arg" and args and args[0] is not None:
+                    witness._released((kind, id(args[0])))
+            except Exception:
+                pass
+            return out
+
+        setattr(cls, method, wrapped)
+        return cls, method, orig
+
+    @contextlib.contextmanager
+    def installed(self):
+        """Patch every DYNAMIC_SPECS acquire/release pair (modules
+        imported lazily — an absent optional surface is skipped), all
+        restored on exit."""
+        import importlib
+
+        from client_tpu.analysis.resources import DYNAMIC_SPECS
+
+        patched = []
+        try:
+            for spec in DYNAMIC_SPECS:
+                try:
+                    module = importlib.import_module(spec["module"])
+                    cls = getattr(module, spec["cls"])
+                except Exception:
+                    continue
+                for method, mode in spec["acquire"].items():
+                    patched.append(
+                        self._wrap_acquire(spec["kind"], cls, method,
+                                           mode)
+                    )
+                for method, mode in spec["release"].items():
+                    patched.append(
+                        self._wrap_release(spec["kind"], cls, method,
+                                           mode)
+                    )
+            yield self
+        finally:
+            # reversed: stacked witnesses unwind inner-first so the
+            # true originals come back
+            for cls, method, orig in reversed(patched):
+                setattr(cls, method, orig)

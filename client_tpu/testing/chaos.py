@@ -54,6 +54,7 @@ __all__ = [
     "assert_kv_clean",
     "assert_lock_witness_acyclic",
     "assert_race_witness_clean",
+    "assert_no_leaked_resources",
 ]
 
 
@@ -368,6 +369,18 @@ def assert_race_witness_clean(witness):
     try/except swallowed mid-scenario.  No-op for None or a plain
     LockWitness so matrices run unarmed (or lock-order-only) too."""
     check = getattr(witness, "assert_race_free", None)
+    if check is None:
+        return 0
+    return check()
+
+
+def assert_no_leaked_resources(witness):
+    """The dynamic resource witness (``TPULINT_RESOURCE_WITNESS=1``)
+    holds no live handles — every KV block reservation, endpoint lease
+    and tracer span acquired during the scenario was released, even on
+    the fault paths the schedule injected.  No-op for None so matrices
+    run unarmed too."""
+    check = getattr(witness, "assert_clean", None)
     if check is None:
         return 0
     return check()

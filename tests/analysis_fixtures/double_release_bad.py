@@ -1,0 +1,27 @@
+"""DOUBLE-RELEASE fixture: two releases reachable on one path.
+
+For a refcounted handle the second release decrements SOMEONE ELSE'S
+reference — the pool frees a block another request still maps.  Freezes
+the two shapes: a plain sequential double release, and the
+release-in-body-plus-release-in-finally shape (the finally also runs on
+the no-raise path, so both releases execute back to back).
+"""
+
+
+class Retire:
+    def drain(self, pool, n):
+        blocks = pool.alloc(n)
+        if blocks is None:
+            return
+        pool.release(blocks)
+        self.note_free(n)
+        pool.release(blocks)  # BAD: second release on the same path
+
+    def retire(self, pool, n):
+        blocks = pool.alloc(n)
+        if blocks is None:
+            return
+        try:
+            pool.release(blocks)
+        finally:
+            pool.release(blocks)  # BAD: finally re-runs on no-raise path

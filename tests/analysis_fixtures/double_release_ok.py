@@ -1,0 +1,22 @@
+"""DOUBLE-RELEASE ok fixture: either-or releases are one release.
+
+A release in each exclusive arm — if/else, reject-vs-accept, except vs
+the no-raise path — is the normal shape: exactly one runs.  The rule's
+path algebra must never pair them.
+"""
+
+
+class Retire:
+    def commit(self, pool, n):
+        blocks = pool.alloc(n)
+        if blocks is None:
+            return None
+        try:
+            if blocks[0] < 0:
+                pool.release(blocks)  # reject arm
+                return None
+            pool.release(blocks)  # accept arm: exclusive with reject
+            return n
+        except Exception:
+            pool.release(blocks)  # exception arm: exclusive with both
+            raise

@@ -1,0 +1,53 @@
+"""RESOURCE-LEAK fixture: handles that can go out of scope unreleased.
+
+Freezes the four leak shapes the interprocedural pass exists for: a
+lease whose strike path forgets ``failure()`` (released only on some
+branches), a KV reservation dropped by an early return between acquire
+and release, a socket that is simply never closed, and — the shape no
+per-file pass can see — a reservation acquired through a WRAPPER whose
+summary returns a fresh ``alloc``.  These are the pre-fix shapes of the
+balance/engine lifecycle bugs the rule guards against reintroducing.
+"""
+
+import socket
+
+
+def probe(pool, payload):
+    lease = pool.lease(())  # BAD: released only when the reply is ok
+    reply = send_probe(lease.url, payload)
+    if reply.ok:
+        lease.success()
+        return reply
+    return None  # strike path forgets lease.failure()
+
+
+class Admitter:
+    def reserve(self, pool, n):
+        blocks = pool.alloc(n)
+        if blocks is None:
+            return None  # fine: nothing was acquired (backpressure)
+        if blocks[0] < 0:
+            return None  # BAD: early return drops the reservation
+        pool.release(blocks)
+        return n
+
+
+def open_feed(host):
+    conn = socket.create_connection((host, 9100))  # BAD: never closed
+    banner = conn.recv(64)
+    return banner
+
+
+class PoolFronted:
+    def _fresh(self, n):
+        return self.kv.alloc(n)
+
+    def admit(self, n):
+        blocks = self._fresh(n)  # BAD: wrapper-acquired, never released
+        if blocks is None:
+            return None
+        blocks.sort()
+
+
+def send_probe(url, payload):
+    raise NotImplementedError

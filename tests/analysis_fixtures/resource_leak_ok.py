@@ -1,0 +1,67 @@
+"""RESOURCE-LEAK ok fixture: the exception-safe and transfer shapes.
+
+Post-fix counterparts of resource_leak_bad.py: try/finally release, a
+``with`` acquisition, success/failure on every arm of a try, ownership
+transferred to a callee whose summary stores the handle, the
+``if handle is None: return`` backpressure guard, and the two thread
+shapes that never leak (daemon fire-and-forget, started-then-joined).
+Every function here must scan clean through every rule family.
+"""
+
+import socket
+import threading
+
+
+def probe(pool, payload):
+    lease = pool.lease(())
+    try:
+        reply = send_probe(lease.url, payload)
+        lease.success()
+        return reply
+    except Exception as exc:
+        lease.failure(exc, retryable=True)
+        raise
+
+
+class Admitter:
+    def reserve(self, pool, n):
+        blocks = pool.alloc(n)
+        if blocks is None:
+            return None  # backpressure: nothing acquired, nothing leaked
+        try:
+            if blocks[0] < 0:
+                return None
+            return n
+        finally:
+            pool.release(blocks)
+
+
+def fetch_banner(host):
+    with socket.create_connection((host, 9100)) as conn:
+        return conn.recv(64)
+
+
+class Handoff:
+    def admit(self, pool, n):
+        blocks = pool.alloc(n)
+        if blocks is None:
+            return None
+        self._commit(blocks)  # ownership transferred: _commit stores it
+
+    def _commit(self, blocks):
+        self._table = blocks
+
+
+def spawn_daemon(work):
+    t = threading.Thread(target=work, daemon=True)  # dies with process
+    t.start()
+
+
+def run_joined(work):
+    t = threading.Thread(target=work)
+    t.start()
+    t.join()
+
+
+def send_probe(url, payload):
+    raise NotImplementedError

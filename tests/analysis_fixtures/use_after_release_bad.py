@@ -1,0 +1,23 @@
+"""USE-AFTER-RELEASE fixture: the handle touched after its release.
+
+Released block indices spliced into a lane table scatter new KV writes
+into blocks the free list already handed to another request; a read on
+a closed file raises at best.  Both uses sit on the same sequential
+path as the release.
+"""
+
+
+class Splice:
+    def finish(self, pool, table, n):
+        blocks = pool.alloc(n)
+        if blocks is None:
+            return
+        pool.release(blocks)
+        table[0] = blocks[0]  # BAD: freed index spliced into the table
+
+
+def tail(path):
+    fh = open(path)
+    head = fh.read(1024)
+    fh.close()
+    return head + fh.read()  # BAD: read on the closed handle

@@ -1,0 +1,26 @@
+"""USE-AFTER-RELEASE ok fixture: either-or hand-off and finally close.
+
+Releasing in one arm and using in the other is the normal hand-off
+shape (exactly one runs); a use inside a try whose finally closes the
+handle is the canonical safe bracket.  Neither may pair as a
+use-after-release.
+"""
+
+
+class Splice:
+    def finish(self, pool, table, n, keep):
+        blocks = pool.alloc(n)
+        if blocks is None:
+            return
+        if keep:
+            table[0] = blocks[0]  # hand-off arm: reservation still held
+        else:
+            pool.release(blocks)  # release arm: exclusive with the use
+
+
+def read_all(path):
+    fh = open(path)
+    try:
+        return fh.read()
+    finally:
+        fh.close()

@@ -53,6 +53,18 @@ def pytest_addoption(parser):
             "make-soak hookup)"
         ),
     )
+    parser.addoption(
+        "--resource-witness", action="store_true", default=False,
+        help=(
+            "arm the dynamic resource-leak witness: every registered "
+            "acquire/release pair (KvBlockPool alloc/release, endpoint "
+            "leases, tracer spans) is tracked in a live-handle table "
+            "with acquisition stacks, and a test that ends with live "
+            "handles fails at its own teardown with the stacks that "
+            "acquired them (TPULINT_RESOURCE_WITNESS=1 does the same — "
+            "the make-chaos/make-soak hookup)"
+        ),
+    )
 
 
 import pytest  # noqa: E402
@@ -100,6 +112,34 @@ def _lock_order_witness(request):
     witness.assert_acyclic()
     if race:
         witness.assert_race_free()
+
+
+@pytest.fixture(autouse=True)
+def _resource_leak_audit(request):
+    """Opt-in dynamic resource-leak audit (the runtime complement of the
+    static RESOURCE-LEAK rule): with --resource-witness /
+    TPULINT_RESOURCE_WITNESS=1 every registered acquire/release pair is
+    patched into a live-handle table, and a test that leaks a KV block
+    reservation, endpoint lease or tracer span fails at its own teardown
+    with the acquisition stacks of the leaked handles.  Leaks are also
+    dumped to the flight recorder when TPU_FLIGHT_DIR is set."""
+    enabled = request.config.getoption("--resource-witness") or _env_truthy(
+        "TPULINT_RESOURCE_WITNESS"
+    )
+    if not enabled:
+        yield None
+        return
+    from client_tpu.analysis.witness import ResourceWitness
+
+    flight = None
+    if os.environ.get("TPU_FLIGHT_DIR"):
+        from client_tpu.serve.flight import FlightRecorder
+
+        flight = FlightRecorder(name="resource-witness")
+    witness = ResourceWitness(flight=flight)
+    with witness.installed():
+        yield witness
+    witness.assert_clean()
 
 
 # Native libraries are build artifacts (gitignored): build them on demand so a

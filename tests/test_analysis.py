@@ -50,8 +50,9 @@ def test_registry_has_all_rules():
     assert set(PROGRAM_REGISTRY) >= {
         "LOCK-INV", "BLOCK-UNDER-LOCK", "CALLBACK-UNDER-LOCK",
         "PEER-CALL-UNDER-LOCK", "LOCKSET-RACE",
+        "RESOURCE-LEAK", "DOUBLE-RELEASE", "USE-AFTER-RELEASE",
     }
-    assert len(all_rules()) >= 15
+    assert len(all_rules()) >= 18
     for rule in all_rules().values():
         assert rule.rationale  # every rule documents its motivating bug
 
@@ -929,6 +930,155 @@ def test_lockset_race_suppressible_with_reason(tmp_path):
     assert not any("TickEngine" in f.message for f in findings)
 
 
+# -- resource-lifecycle analysis (ownership tracking + leak rules) ----------
+
+def test_resource_leak_hits():
+    """The four leak shapes: a lease released only on the ok path, an
+    early return between alloc and release, a socket never closed, and —
+    the interprocedural case the lexical rules cannot see — a KV
+    reservation acquired through a wrapper (`self._fresh` returns
+    `alloc`'s result) and then dropped."""
+    findings = _pscan("resource_leak_bad.py")
+    assert _rules_hit(findings) == ["RESOURCE-LEAK"]
+    assert sorted(f.line for f in findings) == [16, 26, 36, 46]
+    messages = {f.line: f.message for f in findings}
+    assert "only on some paths" in messages[16]
+    assert "return path" in messages[26]
+    assert "never releases or transfers" in messages[36]
+    # the wrapper acquisition is attributed through the call chain
+    assert "self._fresh()" in messages[46]
+    assert "KV block reservation" in messages[46]
+
+
+def test_resource_leak_clean():
+    """Every safe custody shape — try/finally, release on all try arms,
+    `with`, ownership transfer to a storing callee, None-guard, daemon
+    thread, started-then-joined thread — scans clean through every rule
+    family."""
+    assert _pscan("resource_leak_ok.py") == []
+
+
+def test_double_release_hits():
+    """Sequential double release and release-in-body-plus-finally (the
+    finally re-runs on the no-raise path) both pair on one path."""
+    findings = _pscan("double_release_bad.py")
+    assert _rules_hit(findings) == ["DOUBLE-RELEASE"]
+    assert sorted(f.line for f in findings) == [18, 27]
+    for f in findings:
+        assert "twice on one path" in f.message
+
+
+def test_double_release_clean():
+    """Either-or releases (if/else arms, except vs the no-raise path)
+    are one release; the path algebra must never pair them."""
+    assert _pscan("double_release_ok.py") == []
+
+
+def test_use_after_release_hits():
+    """A freed block index spliced into a table and a read on a closed
+    file — both uses on the same sequential path as the release."""
+    findings = _pscan("use_after_release_bad.py")
+    assert _rules_hit(findings) == ["USE-AFTER-RELEASE"]
+    assert sorted(f.line for f in findings) == [16, 23]
+    for f in findings:
+        assert "after releasing it" in f.message
+
+
+def test_use_after_release_clean():
+    """Release-in-one-arm/use-in-the-other and use-inside-try-with-
+    finally-close are the normal hand-off shapes."""
+    assert _pscan("use_after_release_ok.py") == []
+
+
+def test_resource_leak_exception_edge(tmp_path):
+    """A release that lives only in the except handler covers only the
+    exception edge — the no-raise path walks out with the reservation
+    still held; routing the release through a finally covers both."""
+    leaky = tmp_path / "leaky.py"
+    leaky.write_text(
+        "def fetch(pool, n, sink):\n"
+        "    blocks = pool.alloc(n)\n"
+        "    if blocks is None:\n"
+        "        return None\n"
+        "    try:\n"
+        "        sink.push(n)\n"
+        "    except ValueError:\n"
+        "        pool.release(blocks)\n"
+        "        raise\n"
+        "    return n\n"
+    )
+    findings = scan_paths([str(leaky)])
+    assert _rules_hit(findings) == ["RESOURCE-LEAK"]
+    assert "only on some paths" in findings[0].message
+    fixed = tmp_path / "fixed.py"
+    fixed.write_text(
+        "def fetch(pool, n, sink):\n"
+        "    blocks = pool.alloc(n)\n"
+        "    if blocks is None:\n"
+        "        return None\n"
+        "    try:\n"
+        "        sink.push(n)\n"
+        "    finally:\n"
+        "        pool.release(blocks)\n"
+        "    return n\n"
+    )
+    assert scan_paths([str(fixed)]) == []
+
+
+def test_resource_transfer_to_storing_callee_is_ownership(tmp_path):
+    """Passing the handle to a callee that stores it on self is a
+    custody transfer — the caller is off the hook; passing it to a
+    callee the program cannot resolve gets the same benefit of the
+    doubt (FN over FP)."""
+    mod = tmp_path / "transfer.py"
+    mod.write_text(
+        "from somewhere import ship_out\n\n\n"
+        "class Table:\n"
+        "    def adopt(self, blocks):\n"
+        "        self._rows = blocks\n\n"
+        "    def admit(self, pool, n):\n"
+        "        blocks = pool.alloc(n)\n"
+        "        if blocks is None:\n"
+        "            return\n"
+        "        self.adopt(blocks)\n\n\n"
+        "def export(pool, n):\n"
+        "    blocks = pool.alloc(n)\n"
+        "    if blocks is None:\n"
+        "        return\n"
+        "    ship_out(blocks)\n"
+    )
+    assert scan_paths([str(mod)]) == []
+
+
+def test_wrapper_acquired_span_leak_is_interprocedural(tmp_path):
+    """A span acquired through a helper (`return tracer.sample(...)`)
+    and never completed: the lexical SPAN-LEAK rule cannot see through
+    the call, the ownership engine can."""
+    mod = tmp_path / "spans.py"
+    mod.write_text(
+        "def span_for(tracer, name):\n"
+        "    return tracer.sample(name)\n\n\n"
+        "def handle(tracer, payload):\n"
+        "    span = span_for(tracer, 'handle')\n"
+        "    return len(payload)\n"
+    )
+    findings = scan_paths([str(mod)])
+    assert "RESOURCE-LEAK" in _rules_hit(findings)
+    assert any("span_for()" in f.message for f in findings)
+    fixed = tmp_path / "spans_ok.py"
+    fixed.write_text(
+        "def span_for(tracer, name):\n"
+        "    return tracer.sample(name)\n\n\n"
+        "def handle(tracer, payload):\n"
+        "    span = span_for(tracer, 'handle')\n"
+        "    try:\n"
+        "        return len(payload)\n"
+        "    finally:\n"
+        "        span.complete(ok=True)\n"
+    )
+    assert scan_paths([str(fixed)]) == []
+
+
 # -- STALE-SUPPRESS (waiver audit) ------------------------------------------
 
 def test_stale_suppress_hits():
@@ -1555,3 +1705,97 @@ def test_cli_changed_only(tmp_path):
     assert proc.returncode == 0, proc.stdout + proc.stderr
     proc = lint()
     assert proc.returncode == 1
+
+
+# -- dynamic resource witness ------------------------------------------------
+
+def _kv_pool():
+    from client_tpu.serve.lm.kv import KvBlockPool
+    from client_tpu.serve.models import transformer as tfm
+
+    cfg = tfm.TransformerConfig(
+        vocab_size=128, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq=96, dtype="float32",
+    )
+    return KvBlockPool(cfg, n_blocks=8, block_size=4)
+
+
+def test_resource_witness_fires_on_leaked_reservation(tmp_path):
+    """A KV reservation still live at the checkpoint raises
+    ResourceLeakError carrying the acquisition stack, and dumps the
+    live-handle table to the attached flight recorder."""
+    import pytest
+
+    from client_tpu.analysis.witness import (
+        ResourceLeakError,
+        ResourceWitness,
+    )
+    from client_tpu.serve.flight import FlightRecorder
+
+    flight = FlightRecorder(dump_dir=str(tmp_path), name="leak-test")
+    witness = ResourceWitness(flight=flight)
+    with witness.installed():
+        pool = _kv_pool()
+        blocks = pool.alloc(2)
+        assert blocks is not None  # deliberately never released
+        with pytest.raises(ResourceLeakError) as excinfo:
+            witness.assert_clean()
+        pool.release(blocks)  # drain: outer session audits stay clean
+    msg = str(excinfo.value)
+    assert "kv-blocks" in msg and "acquired at" in msg
+    # the failed checkpoint shipped its own postmortem
+    assert flight.dumps
+    kinds = [r["kind"] for r in flight.snapshot()]
+    assert "resource_witness_leak" in kinds
+
+
+def test_resource_witness_silent_after_full_release():
+    """alloc + retain = two references per block; two releases drain the
+    table and the checkpoint passes, returning the acquisition count (so
+    callers can assert the witness actually saw traffic)."""
+    from client_tpu.analysis.witness import ResourceWitness
+
+    witness = ResourceWitness()
+    with witness.installed():
+        pool = _kv_pool()
+        blocks = pool.alloc(2)
+        pool.retain(blocks)
+        pool.release(blocks)
+        pool.release(blocks)
+        assert witness.assert_clean() == 4  # 2 alloc + 2 retain refs
+
+
+def test_resource_witness_lease_round_trip():
+    """An endpoint lease registers on lease() and retires on any of the
+    three release verbs; a second (idempotent) release stays lenient."""
+    from client_tpu.analysis.witness import ResourceWitness
+    from client_tpu.balance.pool import EndpointPool
+
+    witness = ResourceWitness()
+    with witness.installed():
+        pool = EndpointPool(["a:1", "b:2"])
+        lease = pool.lease()
+        assert witness.live()
+        lease.success()
+        assert witness.assert_clean() == 1
+        lease.release()  # idempotent re-release: ignored, still clean
+        assert witness.assert_clean() == 1
+
+
+def test_resource_witness_restores_and_ignores_prior_handles():
+    """Handles acquired before arming are invisible (their release is a
+    no-op in the table), and after installed() exits the patched
+    methods are restored — post-restore traffic never registers."""
+    from client_tpu.analysis.witness import ResourceWitness
+
+    pool = _kv_pool()
+    pre = pool.alloc(1)  # acquired before the witness armed
+    witness = ResourceWitness()
+    with witness.installed():
+        pool.release(pre)  # pre-arming handle: lenient no-op
+        assert witness.assert_clean() == 0
+    post = pool.alloc(2)  # after restore: invisible
+    try:
+        assert witness.assert_clean() == 0
+    finally:
+        pool.release(post)

@@ -272,6 +272,89 @@ def test_chaos_matrix_round_under_race_witness(tmp_path):
     assert flight.dumps
 
 
+def test_chaos_matrix_round_under_resource_witness(tmp_path):
+    """A chaos-matrix round with the dynamic resource witness armed (the
+    TPULINT_RESOURCE_WITNESS=1 shape `make chaos` runs): drivers cycling
+    KV block reservations through alloc/release stay green through the
+    assert_no_leaked_resources invariant, and a seeded leak — a
+    reservation deliberately never released — goes red with the
+    acquisition stack in the report."""
+    from client_tpu.analysis.witness import ResourceLeakError, ResourceWitness
+    from client_tpu.serve.lm.kv import KvBlockPool
+    from client_tpu.testing.chaos import assert_no_leaked_resources
+
+    cfg = tfm.TransformerConfig(
+        vocab_size=128, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq=96, dtype="float32",
+    )
+
+    class _PoolFixture:
+        def __init__(self, leak):
+            self.leak = leak
+            self.leaked = []
+            self.pool = KvBlockPool(cfg, n_blocks=16, block_size=4)
+            self.flight = FlightRecorder(
+                dump_dir=str(tmp_path), name="resource-round"
+            )
+
+        def flight_recorders(self):
+            return [self.flight]
+
+        def apply_fault(self, fault):
+            dispatch_fault(fault)
+
+        def drivers(self):
+            def run():
+                for _ in range(20):
+                    blocks = self.pool.alloc(2)
+                    self.pool.retain(blocks)
+                    self.pool.release(blocks)
+                    self.pool.release(blocks)
+                if self.leak:
+                    self.leaked.extend(self.pool.alloc(1))
+
+            return [run]
+
+        def check(self, result):
+            result.assert_clean()
+
+        def close(self):
+            pass
+
+    scenario = ChaosScenario("resource-witness-round")
+
+    witness = ResourceWitness()
+    with witness.installed():
+        ChaosMatrix(
+            [scenario],
+            invariants=[lambda fx, res: assert_no_leaked_resources(witness)],
+        ).run(lambda s: _PoolFixture(leak=False))
+    assert witness.assert_clean() > 0  # the pool WAS witnessed
+
+    seeded = ResourceWitness()
+    fixtures = []
+
+    def make_leaky(s):
+        fixtures.append(_PoolFixture(leak=True))
+        return fixtures[-1]
+
+    with seeded.installed():
+        with pytest.raises(ResourceLeakError) as excinfo:
+            ChaosMatrix(
+                [scenario],
+                invariants=[
+                    lambda fx, res: assert_no_leaked_resources(seeded)
+                ],
+            ).run(make_leaky)
+    assert "kv-blocks" in str(excinfo.value)
+    assert "acquired at" in str(excinfo.value)
+    # drain the seeded leak so an outer session-level audit (the
+    # TPULINT_RESOURCE_WITNESS=1 conftest hook `make chaos` arms) stays
+    # clean — the leak was the test subject, not a real loss
+    for fx in fixtures:
+        fx.pool.release(fx.leaked)
+
+
 def test_dispatch_fault_drives_a_fault_proxy():
     import socket
 
