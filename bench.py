@@ -130,6 +130,13 @@ _SLO_GATE_KEYS = (
     "slo_qps_under_p99",
 )
 
+# Latency-class headlines where LOWER is better: the gate inverts the
+# comparison (a delta past +tolerance fails).  Kept separate from
+# _SLO_GATE_KEYS so every key's direction is explicit, not inferred.
+_SLO_GATE_LOWER_KEYS = (
+    "fleet_autoscale_settle_s",  # burst-end to fleet-at-floor
+)
+
 
 def _slo_block(result, slo_series):
     """The per-round SLO record: headline max-QPS-under-p99 (the
@@ -171,7 +178,7 @@ def _slo_gate(result, prev, tolerance_pct=20.0):
             return (doc.get("slo") or {}).get(key)
         return doc.get(key)
 
-    for key in _SLO_GATE_KEYS:
+    for key in _SLO_GATE_KEYS + _SLO_GATE_LOWER_KEYS:
         cur, prev_val = figure(result, key), figure(prev, key)
         # cur == 0.0 is the LOUDEST regression (e.g. qps_under_p99
         # zeroed by a missed objective) — only None means "not measured"
@@ -179,7 +186,11 @@ def _slo_gate(result, prev, tolerance_pct=20.0):
             continue
         delta = round(100.0 * (cur - prev_val) / prev_val, 1)
         checked[key] = delta
-        if delta < -float(tolerance_pct):
+        if key in _SLO_GATE_LOWER_KEYS:
+            regressed = delta > float(tolerance_pct)
+        else:
+            regressed = delta < -float(tolerance_pct)
+        if regressed:
             if drifted:
                 skipped[key] = (
                     f"link drifted {drift}% under the run — instrument, "
@@ -1020,6 +1031,130 @@ def _run_fleet_seq_failover(n_sequences=8, warm_steps=4):
     }
 
 
+def _run_fleet_autoscale_settle(burst_threads=6, burst_s=2.0,
+                                settle_timeout_s=90.0):
+    """Elastic-fleet headline: burst-end-to-converged settle latency.
+
+    One floor replica (a real in-process HTTP server + fleet tier); an
+    Autoscaler steers the fleet from the pressure its pool probes
+    gossip.  A burst of concurrent clients forces a scale-up; when the
+    burst stops, ``fleet_autoscale_settle_s`` is the latency from the
+    last load request until the fleet is back at the floor — every
+    spawned replica retired THROUGH drain.  Lower is better: this is
+    elasticity's shed-capacity-promptly half, the one that costs money
+    when it regresses (the gate treats it inverted, see
+    ``_SLO_GATE_LOWER_KEYS``)."""
+    import threading
+
+    from client_tpu.balance.pool import EndpointPool
+    from client_tpu.balance.replicated import ReplicatedClient
+    from client_tpu.http import InferInput
+    from client_tpu.serve.autoscale import (
+        AutoscalePolicy,
+        Autoscaler,
+        ServerReplicaLauncher,
+    )
+    from client_tpu.serve.builtins import slow_identity_model
+    from client_tpu.serve.fleet import fetch_summary
+    from client_tpu.utils import SERVER_UNREACHABLE
+
+    launcher = ServerReplicaLauncher(
+        lambda: [slow_identity_model(delay_s=0.05)],
+        fleet_kwargs=dict(gossip_interval_s=0, replicate_k=1, fan_out=2),
+    )
+    floor = launcher.spawn()
+    pool = EndpointPool([floor.url])
+    autoscaler = Autoscaler(
+        pool, launcher,
+        policy=AutoscalePolicy(
+            min_replicas=1, max_replicas=3, scale_up_at=3.0,
+            scale_down_at=1.0, up_after=2, down_after=5,
+            cooldown_s=0.8, tick_interval_s=0.1,
+        ),
+    ).adopt([floor])
+    client = ReplicatedClient(
+        pool, transport="http", policy="least-inflight",
+        probe_interval_s=None,
+    )
+
+    def probe(url):
+        handle = next(
+            (h for h in autoscaler.replicas() if h.url == url), None
+        )
+        if handle is None:
+            return SERVER_UNREACHABLE
+        state = client.client_for(url).server_state(timeout_s=1.0)
+        try:
+            summary = fetch_summary(handle.fleet_address, timeout_s=1.0)
+        except OSError:
+            return state
+        return state, summary, summary["pressure"]
+
+    pool.start_probes(probe, interval_s=0.15)
+    stop_load = threading.Event()
+
+    def load():
+        inp = InferInput("INPUT0", [1], "INT32")
+        inp.set_data_from_numpy(np.array([1], np.int32))
+        while not stop_load.is_set():
+            try:
+                client.infer("slow_identity", [inp])
+            except Exception:  # membership churn: retry, not a result
+                time.sleep(0.02)
+
+    threads = [
+        threading.Thread(target=load, daemon=True)
+        for _ in range(burst_threads)
+    ]
+    t_first_up = None
+    settle_s = None
+    try:
+        autoscaler.start()
+        for t in threads:
+            t.start()
+        deadline = time.perf_counter() + settle_timeout_s
+        while time.perf_counter() < deadline:
+            if autoscaler.status()["scale_ups"] > 0:
+                t_first_up = time.perf_counter()
+                break
+            time.sleep(0.05)
+        time.sleep(burst_s)  # sustain the burst past the scale-up
+        stop_load.set()
+        for t in threads:
+            t.join(timeout=10)
+        t_burst_end = time.perf_counter()
+        while time.perf_counter() < deadline:
+            status = autoscaler.status()
+            if (
+                status["replicas"] == 1
+                and status["scale_downs"] == status["scale_ups"]
+            ):
+                settle_s = time.perf_counter() - t_burst_end
+                break
+            time.sleep(0.05)
+        status = autoscaler.status()
+    finally:
+        stop_load.set()
+        autoscaler.close()
+        client.close()
+        pool.close()
+        for handle in autoscaler.replicas():
+            try:
+                handle.server.stop()
+                handle.tier.close()
+            except Exception:
+                pass
+    assert t_first_up is not None, "burst never forced a scale-up"
+    assert settle_s is not None, "fleet never converged to the floor"
+    return {
+        # headline (lower is better): burst-end to floor-converged
+        "fleet_autoscale_settle_s": round(settle_s, 3),
+        "fleet_autoscale_scale_ups": status["scale_ups"],
+        "fleet_autoscale_scale_downs": status["scale_downs"],
+        "fleet_autoscale_flap_suppressed": status["flap_suppressed"],
+    }
+
+
 def _lm_prompt(i):
     # zero-padded so EVERY prompt (and the warmup) encodes to the same
     # token shape — the LM forward is shape-keyed jit
@@ -1245,6 +1380,9 @@ def main():
     fleet_prefix = attempt("fleet_prefix", _run_fleet_prefix) or {}
     fleet_failover = attempt(
         "fleet_seq_failover", _run_fleet_seq_failover
+    ) or {}
+    fleet_autoscale = attempt(
+        "fleet_autoscale_settle", _run_fleet_autoscale_settle
     ) or {}
 
     # Headline instrument: the native C++ worker when built (GIL-free async
@@ -1475,6 +1613,7 @@ def main():
         **lm_prefix,
         **fleet_prefix,
         **fleet_failover,
+        **fleet_autoscale,
         **link,
     }
     if lm:

@@ -1530,3 +1530,86 @@ class SpanLeakRule(Rule):
                         "the context-manager bracket",
                     ))
         return findings
+
+
+# peer-RPC callees whose bound replies the ACK-BEFORE-STORE rule tracks
+# (last dotted segment) — the fleet tier's transport verbs
+_PEER_REPLY_CALLS = {
+    "_peer_call", "peer_call", "_traced_peer_call", "recv_frame",
+    "_recv_frame", "_ask",
+}
+# counter names that read as durability acks (bounded: 'ack' at a word
+# boundary so e.g. 'backoff' never matches)
+_ACK_NAME_RE = re.compile(r"(?i)(^|_)(n?acks?|acked)(_|$)")
+
+
+@register
+class AckBeforeStoreRule(Rule):
+    """ACK-BEFORE-STORE — a peer reply counted as durability unchecked.
+
+    The fleet tier's replicated stores answer every reachable request
+    with a frame, and the frame says whether the payload was actually
+    STORED (``{"stored": false}`` marks a stale snapshot the peer
+    REJECTED).  A quorum/durability counter that increments on the mere
+    arrival of a reply counts reachability, not durability: a fleet of
+    peers all rejecting a stale snapshot would still 'reach quorum' and
+    the client would hold an ack for a step a SIGKILL can lose — the
+    exact acks-then-loses fork the write-quorum mode exists to prevent.
+    Fires in functions that (a) bind a peer-transport reply, (b) bump
+    an ack-named counter, and (c) never consult a ``"stored"`` field.
+    Transport-level delivery counters should use a non-ack name
+    (``accepted``, ``delivered``); real ack accounting must check
+    ``reply.get("stored")``.
+    """
+
+    id = "ACK-BEFORE-STORE"
+    rationale = (
+        "a peer reply is reachability, not durability: acking without "
+        "checking the reply's 'stored' field can ack a step every peer "
+        "rejected as stale (acks-then-loses)"
+    )
+
+    @staticmethod
+    def _binds_peer_reply(fn):
+        for node in _walk_no_functions(fn):
+            calls = ()
+            if isinstance(node, ast.Assign):
+                calls = ast.walk(node.value)
+            elif isinstance(node, ast.For):
+                calls = ast.walk(node.iter)
+            for sub in calls:
+                if isinstance(sub, ast.Call) and _last_segment(
+                    _expr_text(sub.func) or ""
+                ) in _PEER_REPLY_CALLS:
+                    return True
+        return False
+
+    @staticmethod
+    def _checks_stored(fn):
+        for node in _walk_no_functions(fn):
+            if isinstance(node, ast.Constant) and node.value == "stored":
+                return True
+        return False
+
+    def check(self, tree, lines, path):
+        findings = []
+        for fn in _functions(tree):
+            if not self._binds_peer_reply(fn) or self._checks_stored(fn):
+                continue
+            for node in _walk_no_functions(fn):
+                if not (
+                    isinstance(node, ast.AugAssign)
+                    and isinstance(node.op, ast.Add)
+                ):
+                    continue
+                target = _last_segment(_expr_text(node.target) or "")
+                if target and _ACK_NAME_RE.search(target):
+                    findings.append(self.finding(
+                        path, lines, node,
+                        f"{fn.name}() counts a peer reply as ack "
+                        f"{target!r} without checking the reply's "
+                        "'stored' field — a stale-rejecting peer is "
+                        "reachable but is no durability; gate the "
+                        "increment on reply.get('stored')",
+                    ))
+        return findings

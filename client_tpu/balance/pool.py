@@ -105,6 +105,11 @@ class Endpoint:
         # pressures() and the observer's on_endpoint_pressure hook so a
         # discovery source can scale on it.  Empty = never gossiped.
         self.pressure = {}
+        # monotonic stamp of the last set_pressure delivery: pressures()
+        # drops entries older than a few probe intervals so a dead
+        # replica's final gossip cannot steer the autoscaler forever.
+        # None = never gossiped.
+        self.pressure_at = None
         # Probation ramp-up (slow start): stamped at promote time when the
         # pool has a rampup window; ramp_fraction() climbs floor -> 1 over
         # [ramp_started, ramp_started + ramp_span].
@@ -418,10 +423,12 @@ class EndpointPool:
         ``ctpu_fleet_pressure_*`` per-endpoint gauges."""
         pressure = dict(pressure or {})
         matched = False
+        now = time.monotonic()
         with self._lock:
             for endpoint in self._endpoints:
                 if endpoint.url == url:
                     endpoint.pressure = pressure
+                    endpoint.pressure_at = now
                     matched = True
         if matched:
             # unknown urls (an in-flight probe completing after eviction)
@@ -430,12 +437,33 @@ class EndpointPool:
             # them again
             _notify(self.observer, "on_endpoint_pressure", url, pressure)
 
+    # pressure entries older than this many probe intervals are stale:
+    # a dead replica's last gossip must not steer the autoscaler forever
+    PRESSURE_FRESH_INTERVALS = 3.0
+
     def pressures(self):
         """{url: pressure dict} autoscaling-signal view — what a
-        discovery source polls to scale the fleet on queue depth and
-        prefix-affinity pressure."""
+        discovery source (or the autoscaler) polls to scale the fleet on
+        queue depth, KV occupancy and prefix-affinity pressure.  With a
+        prober armed, an entry not refreshed within
+        ``PRESSURE_FRESH_INTERVALS`` probe intervals reads as ``{}`` —
+        same as never-gossiped — so a dead replica's final numbers age
+        out instead of lingering at their last value."""
+        now = time.monotonic()
+        horizon = (
+            self.PRESSURE_FRESH_INTERVALS * self._probe_interval_s
+            if self._probe_interval_s > 0 else None
+        )
         with self._lock:
-            return {e.url: dict(e.pressure) for e in self._endpoints}
+            out = {}
+            for e in self._endpoints:
+                stale = (
+                    horizon is not None
+                    and e.pressure_at is not None
+                    and now - e.pressure_at > horizon
+                )
+                out[e.url] = {} if stale else dict(e.pressure)
+            return out
 
     # -- live membership (the discovery entry point) -------------------------
 

@@ -389,7 +389,11 @@ class FleetTier:
                  summary_limit=512, registry=None, replicate_k=1,
                  replicate_budget_bytes_s=4 << 20, hot_hits=3,
                  replicate_interval_s=0.2, max_sequences=4096,
-                 seq_ttl_s=120.0):
+                 seq_ttl_s=120.0, quorum="any"):
+        if quorum not in ("any", "majority"):
+            raise ValueError(
+                f"quorum must be 'any' or 'majority', got {quorum!r}"
+            )
         host, _, port = str(bind).rpartition(":")
         self._bind_host = host or "127.0.0.1"
         self._bind_port = int(port)
@@ -406,6 +410,11 @@ class FleetTier:
         # peers on a bounded byte/sec budget, strictly OFF the request
         # path (a dedicated thread drains the queue)
         self.replicate_k = max(int(replicate_k), 0)
+        # write-quorum mode for the durable sequence lane: "any" is the
+        # historical best-effort ack (any peer count, including zero),
+        # "majority" requires ceil((K+1)/2) peers to report `stored`
+        # before a durable step acks to the client
+        self.quorum = quorum
         self.hot_hits = max(int(hot_hits), 1)
         self.replicate_interval_s = float(replicate_interval_s)
         self._repl_rate = float(replicate_budget_bytes_s)
@@ -445,6 +454,14 @@ class FleetTier:
         self.peer_skips = 0
         self.gossip_rounds = 0
         self.served = 0  # peer requests this replica answered
+        self.seq_quorum_acks = 0
+        self.seq_quorum_refusals = 0
+        # chaos seam: when set, a predicate addr -> bool consulted before
+        # every outbound peer connection; False = partitioned (the
+        # connection fails as if the network dropped it, so the per-peer
+        # breakers accumulate real evidence).  Installed/cleared by the
+        # chaos harness's partition/heal fault kinds.
+        self._transport_filter = None
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -730,10 +747,23 @@ class FleetTier:
 
     # -- peer client side (NEVER call with an engine/pool lock held) -------
 
+    def set_transport_filter(self, fn):
+        """Install (or clear, with None) the chaos transport filter: a
+        predicate ``addr -> bool`` consulted before every outbound peer
+        connection.  ``False`` makes the call fail with OSError exactly
+        where a severed network would — downstream breaker/quorum
+        behavior is the real code path, not a mock."""
+        with self._lock:
+            self._transport_filter = fn
+
     def _peer_call(self, addr, payload):
         """One framed request/response against *addr* with bounded
         connect + read timeouts.  Raises OSError-family on any transport
         failure — callers feed the per-peer breaker."""
+        with self._lock:  # released before any transport work
+            filt = self._transport_filter
+        if filt is not None and not filt(addr):
+            raise OSError(f"partitioned from peer {addr}")
         host, _, port = addr.rpartition(":")
         with socket.create_connection(
             (host or "127.0.0.1", int(port)), timeout=self.lookup_timeout_s
@@ -770,14 +800,17 @@ class FleetTier:
             span.tags["bytes"] = sent + _frame_bytes(reply)
             return reply
 
-    def _candidates(self, limit=None):
+    def _candidates(self, limit=None, exclude=()):
         """Breaker-admitted peer snapshot (skips counted): at most
         ``limit`` (default ``fan_out``) peers per call, so a lookup's
         worst case is ``fan_out * lookup_timeout_s`` even before
-        breakers open."""
+        breakers open.  ``exclude`` skips peers a caller already tried
+        this round (the quorum push's widening waves)."""
         limit = self.fan_out if limit is None else int(limit)
         out = []
         for addr in self.peers():
+            if addr in exclude:
+                continue
             breaker = self._breakers.get(addr)
             try:
                 breaker.before_attempt()
@@ -918,7 +951,7 @@ class FleetTier:
     # -- replicated sequence state (the failure-domain lane) ---------------
 
     def _push(self, payload, nbytes=0, limit=None, stop=None, accept=None,
-              candidates=None):
+              candidates=None, until=None):
         """Push one payload to up to ``limit`` (default ``replicate_k``)
         breaker-admitted peers; returns the ack count.  ``nbytes`` > 0
         charges the anti-entropy byte budget FIRST (per peer) — the
@@ -928,30 +961,45 @@ class FleetTier:
         evidence of health).  ``candidates`` lets a caller that already
         admitted peers (consuming half-open probe slots) hand them in —
         an admitted candidate MUST have its outcome recorded, or the
-        breaker's single-probe gate wedges."""
-        if candidates is None:
+        breaker's single-probe gate wedges.  ``until``, for calls that
+        source their own candidates, keeps admitting ONE additional
+        untried peer per widening wave until that many acks land (or no
+        admissible peer remains): a quorum write must not refuse just
+        because a first-wave candidate sits behind a partition while
+        another peer is healthy.  Worst case stays bounded by
+        ``len(peers) x timeout`` with per-peer breakers."""
+        sourced = candidates is None
+        if sourced:
             limit = self.replicate_k if limit is None else int(limit)
             candidates = self._candidates(limit=limit)
-        acked = 0
-        for i, (addr, breaker) in enumerate(candidates):
-            if nbytes and not self._budget_wait(nbytes, stop):
-                # shutting down mid-wait: release the remaining admitted
-                # half-open probe slots so no breaker stays wedged
-                for _addr, pending in candidates[i:]:
-                    pending.record_failure()
-                break
-            try:
-                reply = self._traced_peer_call(addr, payload, breaker)
-            except Exception:  # noqa: BLE001 - containment is the point
-                breaker.record_failure()
-                with self._lock:
-                    self.peer_errors += 1
-                self._count("ctpu_fleet_peer_errors_total")
-                continue
-            breaker.record_success()
-            if accept is None or accept(reply):
-                acked += 1
-        return acked
+        tried = set()
+        accepted = 0
+        while True:
+            for i, (addr, breaker) in enumerate(candidates):
+                if nbytes and not self._budget_wait(nbytes, stop):
+                    # shutting down mid-wait: release the remaining
+                    # admitted half-open probe slots so no breaker stays
+                    # wedged
+                    for _addr, pending in candidates[i:]:
+                        pending.record_failure()
+                    return accepted
+                tried.add(addr)
+                try:
+                    reply = self._traced_peer_call(addr, payload, breaker)
+                except Exception:  # noqa: BLE001 - containment is the point
+                    breaker.record_failure()
+                    with self._lock:
+                        self.peer_errors += 1
+                    self._count("ctpu_fleet_peer_errors_total")
+                    continue
+                breaker.record_success()
+                if accept is None or accept(reply):
+                    accepted += 1
+            if not sourced or until is None or accepted >= until:
+                return accepted
+            candidates = self._candidates(limit=1, exclude=tried)
+            if not candidates:
+                return accepted
 
     def publish_sequence(self, snapshot):
         """Replicate one durable sequence snapshot to ``replicate_k``
@@ -961,16 +1009,53 @@ class FleetTier:
         k x lookup timeout with per-peer breakers: an unreachable fleet
         costs (almost) nothing and degrades to local-only durability.
         Returns the number of peers that STORED the snapshot — a peer
-        that rejected it as stale is reachable but is no durability."""
+        that rejected it as stale is reachable but is no durability.
+        Under ``quorum="majority"`` the push widens past the first-wave
+        candidates until the quorum is met or every admissible peer was
+        tried (see ``_push``'s ``until``)."""
         acked = self._push(
             {"op": "seq_put", "snapshot": snapshot},
             accept=lambda reply: bool(reply.get("stored")),
+            until=self.seq_quorum_required() or None,
         )
         if acked:
             with self._lock:
                 self.seq_pushes += 1
             self._count("ctpu_fleet_seq_snapshots_total")
         return acked
+
+    def seq_quorum_required(self):
+        """Peer-ack floor for a durable step under the configured quorum
+        mode: 0 under ``"any"`` (best-effort: a partition degrades to
+        local-only durability), ceil((K+1)/2) under ``"majority"`` — a
+        majority of the K+1 copies (K peers + this replica) must hold
+        the snapshot before the step may ack."""
+        if self.quorum == "any":
+            return 0
+        return (self.replicate_k + 2) // 2
+
+    def note_quorum(self, ok):
+        """Record one quorum decision for a durable step (called by the
+        engine at the ack/refuse site, NOT inside publish_sequence —
+        drain-time exports also push snapshots but are not acks)."""
+        with self._lock:
+            if ok:
+                self.seq_quorum_acks += 1
+            else:
+                self.seq_quorum_refusals += 1
+        self._count(
+            "ctpu_fleet_seq_quorum_acks_total" if ok
+            else "ctpu_fleet_seq_quorum_refusals_total"
+        )
+
+    def quorum_evidence(self):
+        """Breaker-state snapshot for the degraded-mode error message:
+        which peers are open/half-open when a quorum write refuses."""
+        states = self._breakers.states()
+        return {
+            addr: state for addr, state in states.items()
+            if state != "closed"
+        }
 
     def forget_sequence(self, seq_id):
         """A sequence ended cleanly: queue the drop so peers stop holding
@@ -983,13 +1068,15 @@ class FleetTier:
             self._repl_queue.put(("seq_end", seq_id))
 
     def sequence_lookup(self, seq_id):
-        """The freshest replicated snapshot for *seq_id*: local store
-        first, then a bounded peer fan-out.  A peer hit is cached
-        locally (stale-rejecting), so a sequence resumes with ONE fleet
-        round trip.  None when nobody holds it."""
-        best = self.seq_store.get(seq_id)
-        if best is not None:
-            return best
+        """The freshest replicated snapshot for *seq_id*: the local
+        store AND a bounded peer fan-out, newest version wins.  The
+        local copy alone is never authoritative — with replicate_k
+        below the fleet size each step's snapshot lands on a subset of
+        peers, so a mid-sequence failover that trusted a local
+        anti-entropy copy could resume steps behind the applied
+        counter.  A peer hit is cached locally (stale-rejecting).
+        None when nobody holds it."""
+        best = local = self.seq_store.get(seq_id)
         for _addr, reply in self._ask(
             {"op": "seq_get", "sequence_id": seq_id}
         ):
@@ -999,7 +1086,7 @@ class FleetTier:
             if best is None or _seq_version(snapshot) > _seq_version(best):
                 best = snapshot
         self._note_lookup(best is not None, "seq")
-        if best is not None:
+        if best is not None and best is not local:
             self.seq_store.put(best)
         return best
 
@@ -1216,6 +1303,7 @@ class FleetTier:
             "queue_depth": queue_depth,
             "prefix_hot": self.store.hot_count(self.hot_hits),
             "sequences": self.seq_store.count,
+            "kv_used_fraction": self._kv_used_fraction(),
         }
         if self.registry is not None:
             self.registry.set(
@@ -1227,6 +1315,20 @@ class FleetTier:
                 help_=FLEET_HELP["ctpu_fleet_pressure_prefix"],
             )
         return out
+
+    def _kv_used_fraction(self):
+        """Paged-KV occupancy (used / total blocks) from the registry
+        gauges the KV pool publishes — block exhaustion is the earliest
+        scale-up signal for LM workloads.  0.0 when no LM model is bound
+        (no gauges) so the key is always present and comparable."""
+        if self.registry is None:
+            return 0.0
+        used = self.registry.get("ctpu_lm_kv_blocks_used", None)
+        free = self.registry.get("ctpu_lm_kv_blocks_free", None)
+        if used is None or free is None:
+            return 0.0
+        total = float(used) + float(free)
+        return round(float(used) / total, 4) if total > 0 else 0.0
 
     # -- metrics / introspection -------------------------------------------
 
@@ -1270,6 +1372,8 @@ class FleetTier:
                 "sequences": sequences,
                 "seq_pushes": self.seq_pushes,
                 "seq_stale_rejected": stale,
+                "seq_quorum_acks": self.seq_quorum_acks,
+                "seq_quorum_refusals": self.seq_quorum_refusals,
                 "replicated_items": self.replicated_items,
                 "replicated_bytes": self.replicated_bytes,
                 "peers": list(self._peers),

@@ -505,14 +505,25 @@ class LmEngine:
     def pressure(self):
         """Autoscaling signal: queued submissions + parked (swapped)
         streams + active lanes — the LM half of the per-replica
-        queue-depth gauge the fleet tier gossips on probes."""
+        queue-depth gauge the fleet tier gossips on probes — plus
+        paged-KV occupancy (block exhaustion is the earliest scale-up
+        signal for LM workloads)."""
         with self._cv:
             pending = sum(len(dq) for dq in self._pending.values())
             active = sum(1 for lane in self._lanes if lane.active)
-            return {
-                "queue_depth": pending + len(self._swapped),
-                "inflight": active,
-            }
+            kv = self.kv
+        # KV accounting outside the condition lock: the pool has its own
+        # synchronization and holding _cv across it invites lock nesting
+        kv_fraction = 0.0
+        if kv is not None:
+            used = kv.used_blocks
+            total = used + kv.free_blocks
+            kv_fraction = round(used / total, 4) if total > 0 else 0.0
+        return {
+            "queue_depth": pending + len(self._swapped),
+            "inflight": active,
+            "kv_used_fraction": kv_fraction,
+        }
 
     # -- request side ------------------------------------------------------
 

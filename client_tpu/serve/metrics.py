@@ -128,6 +128,8 @@ FLEET_HELP = {
         "Sequences resumed from a fleet-replicated snapshot",
     "ctpu_fleet_seq_stale_total":
         "Stale sequence snapshots rejected by the replicated store",
+    "ctpu_fleet_seq_heals_total":
+        "Skips-ahead gaps healed by re-looking up a fresher snapshot",
     "ctpu_fleet_replicated_items_total":
         "Anti-entropy items proactively pushed to peers (by kind)",
     "ctpu_fleet_replicated_bytes_total":
@@ -136,6 +138,23 @@ FLEET_HELP = {
         "Gossiped per-replica queued+inflight work (autoscaling signal)",
     "ctpu_fleet_pressure_prefix":
         "Gossiped per-replica prefix-affinity pressure (hot chains held)",
+    "ctpu_fleet_seq_quorum_acks_total":
+        "Durable sequence steps acked with write quorum satisfied",
+    "ctpu_fleet_seq_quorum_refusals_total":
+        "Durable sequence steps refused (503) for unreachable quorum",
+}
+
+# Autoscaler control-loop series (written by serve/autoscale.py into the
+# registry it is constructed with).
+AUTOSCALE_HELP = {
+    "ctpu_autoscale_scale_ups_total":
+        "Autoscaler scale-up actions taken (replicas spawned)",
+    "ctpu_autoscale_scale_downs_total":
+        "Autoscaler scale-down actions taken (replicas drained+retired)",
+    "ctpu_autoscale_flap_suppressed_total":
+        "Autoscaler decisions suppressed by cooldown/hysteresis",
+    "ctpu_autoscale_replicas":
+        "Current replica count the autoscaler is steering",
 }
 
 

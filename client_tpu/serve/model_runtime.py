@@ -159,6 +159,12 @@ class SequenceContext:
         # can CONTINUE the dead replica's trace id (serve/tracing.py
         # resume_span) — a SIGKILL failover reads as one trace
         self.trace_ctx = None
+        # set when a quorum-mode publish could not reach its peer-ack
+        # floor: the step is applied locally but was answered 503, and
+        # the idempotent replay path must re-attempt the publish before
+        # releasing the retained rendering (a 200 always implies the
+        # snapshot reached quorum)
+        self.quorum_deficit = False
 
     def export(self):
         """Serializable snapshot: JSON-safe through the fleet tier's
@@ -1970,6 +1976,12 @@ class InferenceEngine:
         with self._lock:
             step = context.step
             last = context.last_response
+        if declared > step + 1:
+            # The client saw step declared-1 acked somewhere, so this
+            # context is provably stale (a failover resumed from an old
+            # snapshot while the newest one was briefly unreachable).
+            # Re-look the fleet up, bounded, before declaring a fork.
+            step, last = self._heal_seq_gap(context, declared)
         if declared == step + 1:
             return None  # the expected next step: apply it
         if declared > step:
@@ -1981,6 +1993,7 @@ class InferenceEngine:
                 status="409",
             )
         if last is not None and last[0] == declared:
+            self._retry_seq_quorum(context)
             response, blobs = last[1], last[2]
             return _stamp_id(response, request), list(blobs)
         raise InferenceServerException(
@@ -1989,6 +2002,71 @@ class InferenceEngine:
             "retained",
             status="409",
         )
+
+    def _heal_seq_gap(self, context, declared, timeout_s=2.0):
+        """Bounded fleet re-lookup when a declared step skips ahead of
+        the applied counter.  A declared step N means the client holds
+        an ack for step N-1, so a counter below N-1 is not a client
+        bug — it is this replica resuming from a stale snapshot while
+        the replica (or peer copy) holding the newest one was briefly
+        unreachable.  Retrying the lookup for a short window turns that
+        transient miss into a clean resume; only when the window closes
+        without finding step >= N-1 does the caller raise the
+        restartable 409 (the snapshot really is gone).  Peer RPCs run
+        with no engine lock held.  Returns the refreshed
+        ``(step, last_response)`` pair."""
+        fleet = self.fleet
+        with self._lock:
+            durable = context.durable
+            step, last = context.step, context.last_response
+        lookup = getattr(fleet, "sequence_lookup", None)
+        if lookup is None or not durable:
+            return step, last
+        deadline = time.monotonic() + timeout_s
+        while True:
+            try:
+                snapshot = lookup(context.sequence_id)
+            except Exception:  # pragma: no cover - defensive
+                snapshot = None
+            if snapshot is not None:
+                fresh = SequenceContext.restore(snapshot)
+                with self._lock:
+                    if (fresh.epoch, fresh.step) > (
+                        context.epoch, context.step
+                    ):
+                        context.step = fresh.step
+                        context.epoch = fresh.epoch
+                        context.state = fresh.state
+                        context.last_response = fresh.last_response
+                        context.trace_ctx = fresh.trace_ctx
+                    step, last = context.step, context.last_response
+                if step + 1 >= declared:
+                    self.metrics.inc(
+                        "ctpu_fleet_seq_heals_total",
+                        help_=FLEET_HELP["ctpu_fleet_seq_heals_total"],
+                    )
+                    return step, last
+            if time.monotonic() >= deadline:
+                return step, last
+            time.sleep(0.05)
+
+    def _retry_seq_quorum(self, context):
+        """Replay-path half of the quorum gate: a retried step whose
+        original commit was refused for quorum deficit re-attempts the
+        publish before the retained rendering is released.  Success
+        clears the deficit (the retry acks 200, now quorum-durable);
+        another shortfall refuses again, so no response ever reaches the
+        wire without its snapshot at quorum.  No-op when the context is
+        not in deficit — the common replay path costs one flag read."""
+        with self._lock:
+            deficit = context.quorum_deficit
+        if not deficit:
+            return
+        fleet = self.fleet
+        if fleet is None or not context.durable:
+            return
+        acked = fleet.publish_sequence(context.export())
+        self._enforce_seq_quorum(fleet, context, acked)
 
     def _sequence_commit(self, context, params, rendered):
         """Advance the applied-step counter, retain the rendering for
@@ -2019,10 +2097,44 @@ class InferenceEngine:
             # state under the repository-wide _lock would stall every
             # concurrent admission.  Steps of ONE sequence are serial by
             # contract, so the context is stable while we encode.
-            fleet.publish_sequence(context.export())
+            acked = fleet.publish_sequence(context.export())
+            self._enforce_seq_quorum(fleet, context, acked)
         else:
             # the sequence is complete: peers can drop their snapshots
             fleet.forget_sequence(context.sequence_id)
+
+    def _enforce_seq_quorum(self, fleet, context, acked):
+        """Quorum gate for a durable step's ack.
+
+        Under ``quorum="majority"`` a step whose snapshot reached fewer
+        than ceil((K+1)/2) peers must NOT ack: the step stays applied
+        locally (with its retained rendering), the context is flagged
+        ``quorum_deficit``, and the client gets a retryable 503 carrying
+        breaker evidence.  The retry declares the SAME ``sequence_step``;
+        the idempotent replay path re-attempts the publish and only
+        releases the retained rendering once quorum is met — so a 200
+        always implies the snapshot is quorum-durable, and the model
+        never re-applies the step (exactly-once holds).  If this replica
+        dies while in deficit, the step was never acked, so losing it is
+        a correct (unacked) loss, not acks-then-loses."""
+        required = fleet.seq_quorum_required()
+        if required <= 0:
+            return
+        ok = acked >= required
+        fleet.note_quorum(ok)
+        with self._lock:
+            context.quorum_deficit = not ok
+        if ok:
+            return
+        evidence = fleet.quorum_evidence()
+        raise InferenceServerException(
+            f"sequence {context.sequence_id} step {context.step}: write "
+            f"quorum unreachable ({acked}/{required} peer acks, "
+            f"replicate_k={fleet.replicate_k}); step applied locally but "
+            "not acked — retry the same sequence_step "
+            f"(open breakers: {evidence or 'none'})",
+            status="503",
+        )
 
     def export_sequence(self, seq_id):
         """One live sequence's snapshot (the fleet tier's ``seq_get``

@@ -162,15 +162,60 @@ class ScenarioResult:
         )
 
 
-def dispatch_fault(fault, proxies=(), kill=None, drain=None):
+def partition_fleet(tiers, groups):
+    """Sever the peer transport between replica *groups*.
+
+    *tiers* is the fixture's ordered FleetTier list; *groups* is a list
+    of index lists (e.g. ``[[0], [1, 2]]``) — tiers in different groups
+    can no longer reach each other (symmetric), tiers in the same group
+    still can.  An index in no group is isolated from everyone.  The
+    severing installs a transport filter on each tier
+    (:meth:`FleetTier.set_transport_filter`), so outbound peer calls
+    fail with OSError exactly where a dropped network would and the
+    per-peer breakers accumulate real evidence.  ``heal_fleet`` undoes
+    it."""
+    group_of = {}
+    for gi, members in enumerate(groups):
+        for idx in members:
+            group_of[int(idx)] = gi
+    addr_group = {}
+    for ti, tier in enumerate(tiers):
+        addr = tier.address
+        if addr is not None:
+            addr_group[addr] = group_of.get(ti)
+    for ti, tier in enumerate(tiers):
+        mine = group_of.get(ti)
+
+        def allow(addr, _mine=mine):
+            their = addr_group.get(addr)
+            if their is None and addr not in addr_group:
+                return True  # not a partitioned tier: unaffected
+            return _mine is not None and their == _mine
+
+        tier.set_transport_filter(allow)
+
+
+def heal_fleet(tiers):
+    """Clear every partition filter installed by ``partition_fleet`` —
+    the network is whole again; convergence (anti-entropy, gossip
+    retry, quorum retries) is the code under test, not the harness."""
+    for tier in tiers:
+        tier.set_transport_filter(None)
+
+
+def dispatch_fault(fault, proxies=(), kill=None, drain=None, tiers=()):
     """Standard fault dispatch for FaultProxy-fronted replica sets.
 
     *proxies* maps ``fault.target`` to a
     :class:`~client_tpu.testing.faults.FaultProxy`; *kill*/*drain* are
     optional ``fn(target)`` hooks for the replica-lifecycle kinds (a
     SIGKILL is proxy ``sigkill`` + the *kill* hook stopping the server
-    WITHOUT drain; a ``drain`` is the planned-retire path).  Fixtures
-    with non-standard kinds use ``FaultSpec("custom", fn=...)``.
+    WITHOUT drain; a ``drain`` is the planned-retire path).  *tiers* is
+    the ordered FleetTier list for the network-severing kinds:
+    ``FaultSpec("partition", groups=[[0], [1, 2]])`` severs the peer
+    transport between the index groups, ``FaultSpec("heal")`` restores
+    it.  Fixtures with non-standard kinds use
+    ``FaultSpec("custom", fn=...)``.
     """
     kind = fault.kind
     proxy = None
@@ -209,6 +254,12 @@ def dispatch_fault(fault, proxies=(), kill=None, drain=None):
         if drain is None:
             raise ValueError("scenario uses 'drain' but no drain hook given")
         drain(fault.target)
+        return
+    if kind == "partition":
+        partition_fleet(tiers, fault.params["groups"])
+        return
+    if kind == "heal":
+        heal_fleet(tiers)
         return
     if kind == "custom":
         fault.params["fn"]()

@@ -1,0 +1,43 @@
+"""Peer replies counted as durability acks unchecked — the
+acks-then-loses shape ACK-BEFORE-STORE exists to catch.
+
+Every reachable peer answers with a frame; the frame's ``stored`` field
+says whether the payload was actually kept (a stale snapshot is
+REJECTED with ``{"stored": false}``).  Bumping the ack counter on mere
+arrival counts reachability: a fleet of rejecting peers still 'reaches
+quorum' and the client holds an ack a SIGKILL can lose.
+"""
+
+
+class QuorumWriter:
+    def __init__(self, transport, peers):
+        self.transport = transport
+        self.peers = peers
+
+    def publish(self, snapshot):
+        acks = 0
+        for addr in self.peers:
+            try:
+                reply = self.transport._peer_call(
+                    addr, {"op": "seq_put", "snapshot": snapshot}
+                )
+            except OSError:
+                continue
+            # BAD: the reply proves the peer is reachable, nothing more
+            # — it may have rejected the snapshot as stale
+            acks += 1
+            del reply
+        return acks
+
+    def rebalance(self, payload):
+        acked = 0
+        for _addr, reply in self._ask({"op": "seq_put", "p": payload}):
+            if reply.get("ok"):
+                # BAD: 'ok' is transport success; durability lives in
+                # the (never consulted) 'stored' field
+                acked += 1
+        return acked
+
+    def _ask(self, payload):
+        for addr in self.peers:
+            yield addr, self.transport._peer_call(addr, payload)

@@ -1,0 +1,46 @@
+"""The compliant shapes ACK-BEFORE-STORE must NOT flag: ack counters
+gated on the reply's ``stored`` field, transport-level delivery
+counters under a non-ack name, and ack arithmetic in functions that
+never touch the peer transport.
+"""
+
+
+class QuorumWriter:
+    def __init__(self, transport, peers):
+        self.transport = transport
+        self.peers = peers
+        self.seq_quorum_acks = 0
+
+    def publish(self, snapshot):
+        acks = 0
+        for addr in self.peers:
+            try:
+                reply = self.transport._peer_call(
+                    addr, {"op": "seq_put", "snapshot": snapshot}
+                )
+            except OSError:
+                continue
+            # OK: durability is the peer's 'stored' verdict, not its
+            # reachability
+            if reply.get("stored"):
+                acks += 1
+        return acks
+
+    def gossip(self, payload):
+        # OK: transport delivery counted under a non-ack name — gossip
+        # has no stored semantics to check
+        delivered = 0
+        for addr in self.peers:
+            try:
+                self.transport._peer_call(addr, payload)
+            except OSError:
+                continue
+            delivered += 1
+        return delivered
+
+    def note_quorum(self, ok):
+        # OK: pure ack bookkeeping — no peer reply is bound here, the
+        # decision was made by a caller that checked 'stored'
+        if ok:
+            self.seq_quorum_acks += 1
+        return self.seq_quorum_acks

@@ -45,7 +45,7 @@ def test_registry_has_all_rules():
         "NPY-TRUTH", "ASYNC-BLOCK", "LOCK-DISPATCH", "QUEUE-SENTINEL",
         "CV-WAIT-LOOP", "SHARED-MUT", "TIME-WALL", "METRIC-LABEL",
         "RESP-PARAM-OVERWRITE", "BARE-SUPPRESS", "STALE-SUPPRESS",
-        "JIT-UNBOUNDED-SHAPE", "REFCOUNT-PAIR",
+        "JIT-UNBOUNDED-SHAPE", "REFCOUNT-PAIR", "ACK-BEFORE-STORE",
     }
     assert set(PROGRAM_REGISTRY) >= {
         "LOCK-INV", "BLOCK-UNDER-LOCK", "CALLBACK-UNDER-LOCK",
@@ -250,6 +250,26 @@ def test_span_leak_clean():
     """try/finally completion, the context-manager form, and both
     ownership transfers (returned / handed to a callee) stay silent."""
     assert _scan("span_leak_ok.py") == []
+
+
+def test_ack_before_store_hits():
+    """Peer replies counted as durability acks without consulting the
+    reply's 'stored' field — both the assigned-reply and the
+    for-loop-over-_ask shapes (the write-quorum lane's acks-then-loses
+    fork)."""
+    findings = _scan("ack_before_store_bad.py")
+    assert _rules_hit(findings) == ["ACK-BEFORE-STORE"]
+    assert len(findings) == 2
+    messages = " ".join(f.message for f in findings)
+    assert "'stored'" in messages
+    assert "reachable" in messages
+
+
+def test_ack_before_store_clean():
+    """'stored'-gated ack counting, transport delivery under a non-ack
+    name, and ack bookkeeping with no peer reply in scope all stay
+    silent."""
+    assert _scan("ack_before_store_ok.py") == []
 
 
 def test_time_wall_hits():

@@ -68,14 +68,17 @@ def _peer_up(tiers):
         tier.set_peers([t.address for t in tiers if t is not tier])
 
 
-def _seq_model(ledger, replica, name="chaos_sequence"):
+def _seq_model(ledger, replica, name="chaos_sequence", busy_s=0.0):
     """Stateful accumulator that records every APPLIED step into the
     ledger — idempotent replays served from the retained rendering never
     reach this function, which is exactly what the exactly-once checker
-    verifies."""
+    verifies.  ``busy_s`` holds the request in-flight so load is visible
+    as engine pressure (the autoscale ramp's scale signal)."""
 
     def fn(inputs, params, ctx):
         value = inputs["INPUT"]
+        if busy_s:
+            time.sleep(busy_s)
         if ctx is None:
             return {"OUTPUT": value}
         if params.get("sequence_start") or "acc" not in ctx.state:
@@ -553,6 +556,189 @@ def test_drain_exports_sequences_to_the_tier():
         tier_b.close()
 
 
+# -- quorum-durable sequences ------------------------------------------------
+
+def test_seq_quorum_arithmetic():
+    """ceil((K+1)/2) peers must report ``stored`` before a
+    quorum="majority" durable step acks; best-effort mode never requires
+    any; an unknown discipline is a loud constructor error."""
+    with pytest.raises(ValueError):
+        FleetTier(quorum="all")
+    tier = _tier()
+    try:
+        assert tier.quorum == "any"
+        assert tier.seq_quorum_required() == 0
+    finally:
+        tier.close()
+    for k, need in ((1, 1), (2, 2), (3, 2), (4, 3), (5, 3)):
+        tier = _tier(replicate_k=k, quorum="majority")
+        try:
+            assert tier.seq_quorum_required() == need, (k, need)
+        finally:
+            tier.close()
+
+
+def test_quorum_refusal_is_retryable_and_never_reapplies():
+    """Quorum unreachable: the step REFUSES with a retryable 503 naming
+    the deficit, stays applied locally exactly once, and the client's
+    retry of the SAME declared step (without re-declaring start) acks
+    200 as soon as a peer is reachable — through the retained-rendering
+    replay, never a second apply."""
+    from client_tpu.utils import InferenceServerException
+
+    ledger = StepLedger()
+    tier_a = _tier(replicate_k=1, quorum="majority")
+    tier_b = _tier(replicate_k=1)
+    eng_a = InferenceEngine(models=[_seq_model(ledger, "rA")], fleet=tier_a)
+    try:
+        # no peers wired: zero acks possible — the partitioned shape
+        with pytest.raises(InferenceServerException) as exc:
+            eng_a.execute(
+                "chaos_sequence", "",
+                _seq_request(3, 21, 1, start=True), b"",
+            )
+        assert exc.value.status() == "503"
+        msg = str(exc.value)
+        assert "quorum" in msg and "0/1" in msg
+        assert ledger.steps_for(21) == [1]  # applied locally, not lost
+        assert tier_a.stats()["seq_quorum_refusals"] == 1
+        # the partition heals; the retry declares the SAME step and goes
+        # through the replay path, which re-publishes before releasing
+        # the retained rendering
+        tier_a.set_peers([tier_b.address])
+        response, _ = eng_a.execute(
+            "chaos_sequence", "", _seq_request(3, 21, 1), b"",
+        )
+        assert _out_value(response) == 3
+        assert ledger.steps_for(21) == [1]  # STILL exactly once
+        ledger.assert_exactly_once()
+        assert tier_a.stats()["seq_quorum_acks"] >= 1
+        snap = tier_b.seq_store.get(21)
+        assert snap is not None and snap["step"] == 1
+        # and the sequence continues normally, quorum-durable per step
+        response, _ = eng_a.execute(
+            "chaos_sequence", "", _seq_request(4, 21, 2), b"",
+        )
+        assert _out_value(response) == 7
+        assert tier_b.seq_store.get(21)["step"] == 2
+    finally:
+        eng_a.close()
+        tier_a.close()
+        tier_b.close()
+
+
+def test_stale_peer_reply_is_not_a_quorum_ack():
+    """A reachable peer that REJECTS the snapshot as stale answered the
+    RPC but stored nothing — it must not count toward the write quorum
+    (the ACK-BEFORE-STORE lint rule guards this exact shape)."""
+    from client_tpu.utils import InferenceServerException
+
+    ledger = StepLedger()
+    tier_a = _tier(replicate_k=1, quorum="majority")
+    tier_b = _tier(replicate_k=1)
+    _peer_up([tier_a, tier_b])
+    eng_a = InferenceEngine(models=[_seq_model(ledger, "rA")], fleet=tier_a)
+    eng_b = InferenceEngine(models=[_seq_model(ledger, "rB")], fleet=tier_b)
+    try:
+        # poison B's store with a higher-epoch incarnation of the id so
+        # A's pushes are stale-rejected despite B being fully reachable
+        eng_b.execute(
+            "chaos_sequence", "",
+            _seq_request(1, 55, 1, start=True, durable=False), b"",
+        )
+        poisoned = eng_b.export_sequence(55)
+        poisoned["epoch"] = float(poisoned["epoch"]) + 1e6
+        assert tier_b.seq_store.put(dict(poisoned)) is True
+        with pytest.raises(InferenceServerException) as exc:
+            eng_a.execute(
+                "chaos_sequence", "",
+                _seq_request(5, 55, 1, start=True), b"",
+            )
+        assert exc.value.status() == "503"
+        msg = str(exc.value)
+        assert "0/1" in msg  # the reply arrived but was NOT an ack
+        assert "open breakers: none" in msg  # transport was healthy
+        assert tier_b.stats()["seq_stale_rejected"] >= 1
+        assert tier_a.stats()["seq_quorum_refusals"] >= 1
+    finally:
+        eng_a.close()
+        eng_b.close()
+        tier_a.close()
+        tier_b.close()
+
+
+def test_dispatch_partition_and_heal_fleet_tiers():
+    """The partition fault kind: tiers in different groups cannot
+    exchange frames (both directions), same-group tiers still can, an
+    address OUTSIDE the partitioned set is unaffected, and heal restores
+    everything."""
+    tiers = [_tier() for _ in range(3)]
+    _peer_up(tiers)
+    outside = _tier()
+    try:
+        dispatch_fault(
+            FaultSpec("partition", groups=[[0], [1, 2]]), tiers=tiers
+        )
+        with pytest.raises(OSError, match="partitioned"):
+            tiers[0]._peer_call(tiers[1].address, {"op": "ping"})
+        with pytest.raises(OSError, match="partitioned"):
+            tiers[1]._peer_call(tiers[0].address, {"op": "ping"})
+        tiers[1]._peer_call(tiers[2].address, {"op": "ping"})  # same group
+        tiers[0]._peer_call(outside.address, {"op": "ping"})   # unlisted
+        dispatch_fault(FaultSpec("heal"), tiers=tiers)
+        tiers[0]._peer_call(tiers[1].address, {"op": "ping"})
+    finally:
+        for tier in tiers:
+            tier.close()
+        outside.close()
+
+
+def test_best_effort_acks_without_quorum_and_loss_is_visible():
+    """The quorum="any" contrast: under a partition, durable steps still
+    ack 200 with ZERO peer acks (local-only durability), so the
+    replica's death CAN lose them — but the loss surfaces as a loud
+    restartable 409 on the survivor, never a silent wrong answer."""
+    from client_tpu.testing.chaos import heal_fleet, partition_fleet
+    from client_tpu.utils import InferenceServerException
+
+    ledger = StepLedger()
+    tier_a = _tier(replicate_k=1)  # quorum="any" is the default
+    tier_b = _tier(replicate_k=1)
+    _peer_up([tier_a, tier_b])
+    partition_fleet([tier_a, tier_b], groups=[[0], [1]])
+    eng_a = InferenceEngine(models=[_seq_model(ledger, "rA")], fleet=tier_a)
+    eng_b = InferenceEngine(models=[_seq_model(ledger, "rB")], fleet=tier_b)
+    try:
+        total = 0
+        for step, value in enumerate((2, 4), start=1):
+            total += value
+            response, _ = eng_a.execute(
+                "chaos_sequence", "",
+                _seq_request(value, 61, step, start=(step == 1)), b"",
+            )
+            assert _out_value(response) == total  # acked best-effort
+        stats = tier_a.stats()
+        assert stats["seq_quorum_acks"] == 0  # no quorum accounting
+        assert stats["seq_quorum_refusals"] == 0
+        assert tier_b.seq_store.get(61) is None  # nothing replicated
+        # A dies unplanned; its acked-but-unreplicated steps are gone —
+        # the survivor refuses with the restartable 409 rather than
+        # serving silently forked state
+        tier_a.close()
+        eng_a.close()
+        heal_fleet([tier_b])
+        with pytest.raises(InferenceServerException) as exc:
+            eng_b.execute(
+                "chaos_sequence", "", _seq_request(9, 61, 3), b"",
+            )
+        assert exc.value.status() == "409"
+    finally:
+        eng_a.close()
+        eng_b.close()
+        tier_a.close()
+        tier_b.close()
+
+
 # -- acceptance 1: three-replica SIGKILL with active durable sequences ------
 
 class _SeqChaosFixture:
@@ -1001,3 +1187,363 @@ def test_anti_entropy_sigkill_soak(params):
     ChaosMatrix([scenario]).run(
         lambda s: _AntiEntropyFixture(s, params), join_timeout_s=600,
     )
+
+
+# -- acceptance 3: network partition vs the write quorum --------------------
+
+class _QuorumPartitionFixture:
+    """Three engine replicas with majority-quorum durable sequences; a
+    network partition isolates replica 0 from both peers mid-run, then
+    heals.  Minority-side steps REFUSE (retryable 503, retried by the
+    driver) until the heal; majority-side steps keep acking straight
+    through the partition.  After the run replica 0 dies WITHOUT drain
+    and every minority sequence resumes byte-exact on a survivor —
+    possible only because no 200 was ever returned for a step whose
+    snapshot had not reached a peer (never acks-then-loses)."""
+
+    MINORITY = 4   # sequences driven on (to-be-partitioned) replica 0
+    MAJORITY = 2   # sequences driven on replica 1
+
+    def __init__(self, scenario):
+        self.scenario = scenario
+        self.ledger = StepLedger()
+        self.steps = int(scenario.params.get("steps", 8))
+        self.think_s = float(scenario.params.get("think_s", 0.1))
+        rng = scenario.rng()
+        self.n = self.MINORITY + self.MAJORITY
+        self.values = [
+            [rng.randrange(1, 9) for _ in range(self.steps)]
+            for _ in range(self.n)
+        ]
+        self.refusals = []
+        self.tiers = [
+            _tier(replicate_k=1, quorum="majority", fan_out=2,
+                  lookup_timeout_s=0.3, failure_threshold=2,
+                  reset_timeout_s=0.25)
+            for _ in range(3)
+        ]
+        _peer_up(self.tiers)
+        self.engines = [
+            InferenceEngine(models=[_seq_model(self.ledger, f"r{i}")],
+                            fleet=tier)
+            for i, tier in enumerate(self.tiers)
+        ]
+        self.killed = False
+
+    def apply_fault(self, fault):
+        dispatch_fault(fault, tiers=self.tiers)
+
+    def drivers(self):
+        from client_tpu.utils import InferenceServerException
+
+        def driver(index):
+            sid = 500 + index
+            engine = self.engines[0 if index < self.MINORITY else 1]
+            expected = 0
+            for step in range(1, self.steps + 1):
+                value = self.values[index][step - 1]
+                expected += value
+                start = step == 1
+                deadline = time.monotonic() + 60
+                while True:
+                    try:
+                        response, _ = engine.execute(
+                            "chaos_sequence", "",
+                            _seq_request(value, sid, step, start=start),
+                            b"",
+                        )
+                        break
+                    except InferenceServerException as exc:
+                        # quorum unreachable: retryable 503.  The retry
+                        # declares the SAME step WITHOUT re-declaring
+                        # start (the step stayed applied locally; a
+                        # restart would fork a fresh incarnation)
+                        assert exc.status() == "503", exc
+                        assert "quorum" in str(exc)
+                        start = False
+                        self.refusals.append((sid, step))
+                        if time.monotonic() > deadline:
+                            raise
+                        time.sleep(0.05)
+                assert _out_value(response) == expected, (sid, step)
+                time.sleep(self.think_s)
+
+        return [(lambda i=i: driver(i)) for i in range(self.n)]
+
+    def check(self, result):
+        result.assert_clean()  # every refused step eventually acked
+        if self.scenario.params.get("require_refusal", True):
+            assert self.refusals, "the partition never refused a step"
+        # only the minority side ever refused: the majority side kept
+        # its quorum (1 reachable peer) straight through the partition
+        refused_sids = {sid for sid, _step in self.refusals}
+        assert refused_sids <= {
+            500 + i for i in range(self.MINORITY)
+        }, f"majority-side sequences refused: {refused_sids}"
+        stats = self.tiers[0].stats()
+        assert stats["seq_quorum_refusals"] >= len(self.refusals)
+        assert stats["seq_quorum_acks"] >= self.MINORITY * self.steps
+        # replica 0 dies UNPLANNED (no drain).  Every step it ever acked
+        # is on a survivor by the quorum contract — resume each minority
+        # sequence there and apply one more step, byte-exact
+        self.engines[0].close()
+        self.tiers[0].close()
+        self.killed = True
+        for index in range(self.MINORITY):
+            sid = 500 + index
+            total = int(np.sum(self.values[index]))
+            response, _ = self.engines[1].execute(
+                "chaos_sequence", "",
+                _seq_request(7, sid, self.steps + 1), b"",
+            )
+            assert _out_value(response) == total + 7, (
+                f"sequence {sid} resumed with lost acked steps"
+            )
+        # no (sequence, step) applied twice anywhere: refused steps were
+        # never re-applied (the replay path re-published instead), and
+        # the resumes continued from the replicated snapshots
+        self.ledger.assert_exactly_once()
+
+    def close(self):
+        for engine in self.engines:
+            engine.close()
+        for tier in self.tiers:
+            tier.close()
+
+
+def test_partitioned_quorum_never_acks_then_loses():
+    scenario = ChaosScenario(
+        "quorum-partition",
+        [FaultSpec("partition", at_s=0.25, groups=[[0], [1, 2]]),
+         FaultSpec("heal", at_s=0.7)],
+        seed=13, steps=8, think_s=0.1,
+    )
+    results = ChaosMatrix([scenario]).run(
+        _QuorumPartitionFixture, join_timeout_s=180,
+    )
+    assert results[0].fired, "the partition never fired"
+
+
+@pytest.mark.slow
+def test_partitioned_quorum_soak():
+    """Scaled matrix for `make soak`: randomized partition windows over
+    seeds — the refusal/heal/retry races live in the window edges."""
+    matrix = ChaosMatrix([
+        ChaosScenario(
+            f"quorum-partition-{seed}",
+            [FaultSpec("partition", at_s=("uniform", 0.1, 0.4),
+                       groups=[[0], [1, 2]]),
+             FaultSpec("heal", at_s=("uniform", 0.6, 1.1))],
+            seed=seed, steps=12, think_s=0.12, require_refusal=False,
+        )
+        for seed in (5, 29)
+    ])
+    matrix.run(_QuorumPartitionFixture, join_timeout_s=300)
+
+
+# -- acceptance 4: diurnal ramp against the elastic fleet -------------------
+
+class _AutoscaleRampFixture:
+    """A diurnal load ramp against an elastic fleet: one floor replica,
+    an Autoscaler steering real in-process HTTP servers from gossiped
+    pressure, a sticky client driving durable sequences.  The burst
+    forces scale-up (prefix-aware peer wiring + anti-entropy warm +
+    probation ramp before traffic); the quiet tail forces the fleet back
+    down THROUGH drain — zero client-visible errors, zero lost
+    sequences, and the fleet converges to the floor."""
+
+    MODEL = "chaos_sequence"
+
+    def __init__(self, scenario):
+        from client_tpu.balance.pool import EndpointPool
+        from client_tpu.serve.autoscale import (
+            AutoscalePolicy,
+            Autoscaler,
+            ServerReplicaLauncher,
+        )
+
+        self.scenario = scenario
+        self.ledger = StepLedger()
+        self.base = int(scenario.params.get("base", 1))
+        self.burst = int(scenario.params.get("burst", 6))
+        self.tail = int(scenario.params.get("tail", 1))
+        self.steps = int(scenario.params.get("steps", 8))
+        rng = scenario.rng()
+        self.n = self.base + self.burst + self.tail
+        self.values = [
+            [rng.randrange(1, 9) for _ in range(self.steps)]
+            for _ in range(self.n)
+        ]
+        self.delivered = [[] for _ in range(self.n)]
+        self.settled = threading.Event()
+        self._lock = threading.Lock()
+        self._spawned = 0
+        self._load_left = self.n
+
+        def models():
+            with self._lock:
+                name = f"r{self._spawned}"
+                self._spawned += 1
+            return [_seq_model(self.ledger, name, busy_s=0.05)]
+
+        self.launcher = ServerReplicaLauncher(
+            models,
+            fleet_kwargs=dict(gossip_interval_s=0, replicate_k=1,
+                              fan_out=2, lookup_timeout_s=0.5),
+            drain_timeout_s=30.0,
+        )
+        floor = self.launcher.spawn()
+        self.registry = Registry()
+        self.pool = EndpointPool([floor.url])
+        self.autoscaler = Autoscaler(
+            self.pool, self.launcher,
+            policy=AutoscalePolicy(
+                min_replicas=1, max_replicas=3, scale_up_at=3.0,
+                scale_down_at=1.0, up_after=2, down_after=5,
+                cooldown_s=0.8, tick_interval_s=0.1,
+            ),
+            registry=self.registry,
+        ).adopt([floor])
+        self.client = ReplicatedClient(
+            self.pool, transport="http", policy="sticky",
+            probe_interval_s=None,
+        )
+        assert self.pool.start_probes(self._probe, interval_s=0.15)
+
+    def _probe(self, url):
+        """Readiness + gossip in one round trip: the real HTTP health
+        verb for state, the replica's fleet peer port for the pressure
+        signals the autoscaler steers on."""
+        from client_tpu.serve.fleet import fetch_summary
+        from client_tpu.utils import SERVER_UNREACHABLE
+
+        handle = next(
+            (h for h in self.autoscaler.replicas() if h.url == url), None
+        )
+        if handle is None:
+            return SERVER_UNREACHABLE
+        state = self.client.client_for(url).server_state(timeout_s=1.0)
+        try:
+            summary = fetch_summary(handle.fleet_address, timeout_s=1.0)
+        except OSError:
+            return state
+        return state, summary, summary["pressure"]
+
+    def apply_fault(self, fault):
+        dispatch_fault(fault)
+
+    def drivers(self):
+        from client_tpu.http import InferInput
+
+        def load(index, delay_s, think_s):
+            try:
+                sid = 2000 + index
+                expected = 0
+                time.sleep(delay_s)
+                for step in range(1, self.steps + 1):
+                    value = self.values[index][step - 1]
+                    expected += value
+                    inp = InferInput("INPUT", [1], "INT32")
+                    inp.set_data_from_numpy(np.array([value], np.int32))
+                    result = self.client.infer(
+                        self.MODEL, [inp],
+                        sequence_id=sid,
+                        sequence_start=(step == 1),
+                        sequence_end=(step == self.steps),
+                        sequence_durable=True,
+                        sequence_step=step,
+                    )
+                    got = int(result.as_numpy("OUTPUT")[0])
+                    assert got == expected, (sid, step, got, expected)
+                    self.delivered[index].append(got)
+                    time.sleep(think_s)
+            finally:
+                with self._lock:
+                    self._load_left -= 1
+
+        def controller():
+            # the fixture owns the clock: synchronous ticks make the
+            # matrix deterministic-ish and keep the loop single-threaded
+            deadline = time.monotonic() + float(
+                self.scenario.params.get("settle_timeout_s", 60)
+            )
+            while time.monotonic() < deadline:
+                self.autoscaler.tick()
+                status = self.autoscaler.status()
+                with self._lock:
+                    quiet = self._load_left == 0
+                if (quiet and status["scale_ups"] > 0
+                        and status["replicas"]
+                        == self.autoscaler.policy.min_replicas):
+                    self.settled.set()
+                    return
+                time.sleep(0.1)
+
+        plans = (
+            [(i, 0.0, 0.12) for i in range(self.base)]
+            + [(self.base + i, 0.5, 0.01) for i in range(self.burst)]
+            + [(self.base + self.burst + i, 1.4, 0.1)
+               for i in range(self.tail)]
+        )
+        return [controller] + [
+            (lambda p=p: load(*p)) for p in plans
+        ]
+
+    def check(self, result):
+        result.assert_clean()  # zero client-visible errors, no wedges
+        for index in range(self.n):
+            want = list(np.cumsum(self.values[index]))
+            assert_byte_exact(
+                self.delivered[index], want,
+                label=f"sequence {2000 + index}",
+            )
+        status = self.autoscaler.status()
+        assert status["scale_ups"] >= 1, "the ramp never scaled up"
+        assert self.settled.is_set(), (
+            f"fleet never converged back to the floor: {status}"
+        )
+        assert status["scale_downs"] == status["scale_ups"]
+        # every scale-down went through drain (the launcher's only
+        # retire path), and nothing was applied twice anywhere —
+        # sequences caught on a retiring replica migrated through its
+        # tier and resumed, they were not replayed from scratch
+        self.ledger.assert_exactly_once()
+        assert (
+            self.registry.get("ctpu_autoscale_scale_ups_total", None)
+            == status["scale_ups"]
+        )
+        assert self.registry.get("ctpu_autoscale_replicas", None) == 1
+
+    def close(self):
+        self.autoscaler.close()
+        self.client.close()
+        self.pool.close()
+        for handle in self.autoscaler.replicas():
+            try:
+                handle.server.stop()
+            except Exception:
+                pass
+            handle.tier.close()
+
+
+def test_autoscale_absorbs_diurnal_ramp():
+    scenario = ChaosScenario(
+        "autoscale-ramp", [], seed=31,
+        base=1, burst=6, tail=1, steps=10,
+    )
+    ChaosMatrix([scenario]).run(_AutoscaleRampFixture, join_timeout_s=180)
+
+
+@pytest.mark.slow
+def test_autoscale_diurnal_ramp_soak():
+    """Scaled ramp for `make soak`: a 10x burst over more sessions and
+    longer sequences — repetition is what finds the drain/retire vs
+    sticky-lease races."""
+    matrix = ChaosMatrix([
+        ChaosScenario(
+            f"autoscale-ramp-{seed}", [], seed=seed,
+            base=2, burst=10, tail=3, steps=12, settle_timeout_s=120,
+        )
+        for seed in (7, 19)
+    ])
+    matrix.run(_AutoscaleRampFixture, join_timeout_s=600)

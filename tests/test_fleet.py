@@ -412,6 +412,52 @@ def test_fetch_summary_carries_pressure():
         tier.close()
 
 
+def test_pressure_entries_age_out_behind_a_dead_replica():
+    """A pressure entry not refreshed within PRESSURE_FRESH_INTERVALS
+    probe intervals reads as {} — same as never-gossiped — so a dead
+    replica's final numbers cannot steer the autoscaler forever.  The
+    url key stays present (membership is the pool's concern; freshness
+    only blanks the signals)."""
+    pool = EndpointPool(["a:1", "b:1"], policy="least-inflight")
+    try:
+        pool._probe_interval_s = 0.05  # what start_probes would stamp
+        pool.set_pressure("a:1", {"queue_depth": 9})
+        pool.set_pressure("b:1", {"queue_depth": 1})
+        assert pool.pressures()["a:1"] == {"queue_depth": 9}
+        horizon = pool.PRESSURE_FRESH_INTERVALS * 0.05
+        with pool._lock:
+            for endpoint in pool._endpoints:
+                if endpoint.url == "a:1":
+                    endpoint.pressure_at -= horizon + 0.01
+        got = pool.pressures()
+        assert got["a:1"] == {}  # aged out; key still present
+        assert got["b:1"] == {"queue_depth": 1}  # fresh peer unaffected
+        # without an armed prober there is no staleness horizon at all
+        pool._probe_interval_s = 0.0
+        assert pool.pressures()["a:1"] == {"queue_depth": 9}
+    finally:
+        pool.close()
+
+
+def test_pressure_carries_kv_occupancy_fraction():
+    """FleetTier.pressure() surfaces paged-KV occupancy (used / total
+    blocks) from the gauges the KV pool publishes — the earliest LM
+    scale-up signal — and 0.0 when no LM model is bound, so the key is
+    always present and comparable."""
+    registry = Registry()
+    tier = _tier(registry=registry)
+    try:
+        assert tier.pressure()["kv_used_fraction"] == 0.0
+        registry.set("ctpu_lm_kv_blocks_used", None, 3,
+                     help_="KV blocks in use")
+        registry.set("ctpu_lm_kv_blocks_free", None, 1,
+                     help_="KV blocks free")
+        assert tier.pressure()["kv_used_fraction"] == 0.75
+        assert tier.local_summary()["pressure"]["kv_used_fraction"] == 0.75
+    finally:
+        tier.close()
+
+
 def test_replicated_client_stamps_prefix_digests_from_tokens():
     """ROADMAP fleet follow-up 3: the prefix-aware policy's
     prefix_digests request-ctx is now stamped by the replicated client
