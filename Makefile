@@ -21,6 +21,10 @@
 #   make test        ASAN native tests + the python suite.
 #   make check       the PR gate, reproduced locally: make lint + the
 #                    tier-1 pytest command (ROADMAP.md "Tier-1 verify").
+#   make prof        continuous-profiler demo: spin an in-process
+#                    engine, run the cnn headline workload, print the
+#                    time-attribution table (python -m client_tpu.profview
+#                    --live; serve/prof.py is the instrument).
 #   make chaos       the fast chaos-matrix subset (tests/test_chaos.py:
 #                    deterministic fault schedules + invariant checkers)
 #                    under the dynamic lock-order, race AND resource
@@ -45,7 +49,7 @@ NATIVE_OUT := client_tpu/utils/shared_memory
 TPUSHM_OUT := client_tpu/utils/tpu_shared_memory
 
 .PHONY: all protos native cpp clean test asan java java-bindings lint \
-        lint-sarif lint-strict check soak chaos
+        lint-sarif lint-strict check soak chaos prof
 
 lint:
 	python -m client_tpu.analysis client_tpu tests
@@ -67,6 +71,13 @@ check: lint
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
 	    --continue-on-collection-errors -p no:cacheprovider \
 	    -p no:xdist -p no:randomly
+
+# Where the engine's time goes, in one command: an in-process engine
+# runs the cnn headline workload and profview renders the
+# dispatch/compute/host/idle attribution + MFU table from its own
+# /v2/debug/prof-shaped report.
+prof:
+	JAX_PLATFORMS=cpu python -m client_tpu.profview --live
 
 # Fast chaos-matrix gate: the deterministic fault schedules + invariant
 # checkers (SIGKILL-with-active-sequences, anti-entropy convergence,

@@ -60,24 +60,22 @@ def _timed(fn):
 
 
 def _chip_peak_tflops():
-    """Advertised dense bf16 peak of the local accelerator, in TFLOP/s —
-    the MFU denominator.  Returns None off-TPU (MFU is then omitted)."""
-    import jax
+    """(peak_tflops, peak_kind) — the MFU denominator.  On TPU this is
+    the advertised dense bf16 peak; off-TPU it falls back to a measured
+    host GEMM peak tagged ``"cpu_fallback"`` (serve/prof.py owns both
+    the table and the probe), so every ``*_mfu_pct`` is recorded
+    everywhere — in BENCH r07 they were all null because the peak was
+    simply unprobed off-TPU."""
+    from client_tpu.serve.prof import device_peak_tflops
 
-    kind = getattr(jax.devices()[0], "device_kind", "").lower()
-    for pat, peak in (
-        ("v5 lite", 197.0), ("v5e", 197.0),   # v5e / v5 litepod
-        ("v5p", 459.0), ("v5", 459.0),
-        ("v6", 918.0),                          # Trillium
-        ("v4", 275.0), ("v3", 123.0),
-    ):
-        if pat in kind:
-            return peak
-    return None
+    return device_peak_tflops()
 
 
 def _mfu_pct(items_per_sec, flops_per_item, peak_tflops):
-    """Achieved model FLOPs / advertised peak, in percent (None off-TPU)."""
+    """Achieved model FLOPs / peak, in percent.  Off-TPU the peak is the
+    cpu_fallback probe, so the figure is an attribution *ratio* against
+    the host's demonstrated dense capability, not a chip-efficiency
+    claim — peak_kind in the record says which reading applies."""
     if not peak_tflops or not flops_per_item:
         return None
     return round(100.0 * items_per_sec * flops_per_item / (peak_tflops * 1e12), 2)
@@ -169,7 +167,17 @@ def _slo_gate(result, prev, tolerance_pct=20.0):
     """
     checked, regressions, skipped = {}, [], {}
     drift = result.get("mp_link_drift_pct")
-    drifted = drift is not None and abs(drift) > 10.0
+    # Absolute floor on the drift verdict: on a sub-millisecond local
+    # link, tiny absolute wiggle reads as huge relative drift (r07
+    # recorded mp_link_drift_pct: 143.7 on a 0.1 ms link) — there the
+    # probe says nothing about the tunnel, so it must neither excuse a
+    # regression nor alarm anyone.  Only a >= 1 ms baseline RTT (a real
+    # tunneled link) makes relative drift meaningful.
+    rtt = result.get("link_rtt_ms")
+    drift_meaningful = rtt is None or rtt >= 1.0
+    drifted = (
+        drift is not None and drift_meaningful and abs(drift) > 10.0
+    )
 
     def figure(doc, key):
         if not doc:
@@ -206,8 +214,117 @@ def _slo_gate(result, prev, tolerance_pct=20.0):
         "checked": checked,
         "regressions": regressions,
         "skipped": skipped,
+        # the drift escape hatch was floored out: baseline RTT < 1 ms
+        # made the relative drift figure meaningless this round
+        "drift_floor_applied": bool(
+            drift is not None and not drift_meaningful
+        ),
         "pass": not regressions,
     }
+
+
+def _prof_block(report, overhead_pct, peak_kind, lm_rollup=None):
+    """The per-round continuous-profiler attribution block: the server
+    engines' dispatch/compute/host/idle shares (serve/prof.py rollups,
+    each summing to ~100) for the cnn224 headline path ("serve": unary +
+    batched ticks), the LM scheduler ("lm") and the socket frontends
+    ("wire"), plus the measured cost of leaving the profiler armed.
+
+    The served lm headline path (per-request generate, no scheduler)
+    never ticks the server's "lm" engine, so ``lm_rollup`` — the
+    in-process continuous-batching scheduler's own rollup from
+    _run_lm_inproc — fills the "lm" slot when the server report has no
+    ticked engine of that name."""
+    engines = {}
+    for e in (report or {}).get("engines", []):
+        if not isinstance(e, dict):
+            continue
+        name = str(e.get("engine"))
+        cur = engines.get(name)
+        if cur is None or (e.get("ticks") or 0) > (cur.get("ticks") or 0):
+            engines[name] = e
+    if (isinstance(lm_rollup, dict) and lm_rollup.get("ticks")
+            and not (engines.get("lm") or {}).get("ticks")):
+        engines["lm"] = lm_rollup
+
+    def attribution(name):
+        rollup = engines.get(name) or {}
+        return rollup.get("attribution") if rollup.get("ticks") else None
+
+    return {
+        "cnn224": attribution("serve"),
+        "lm": attribution("lm"),
+        "wire": attribution("wire"),
+        "prof_overhead_pct": overhead_pct,
+        "peak_kind": peak_kind,
+    }
+
+
+def _measure_prof_overhead(requests=40, commit_iters=20000):
+    """Measured cost of the always-on profiler on the in-process
+    headline path, in percent.
+
+    Two measurements, one ratio: (a) the per-commit cost of the armed
+    profiler, micro-benchmarked in situ on the engine's own profiler
+    with a representative unary record; (b) the per-request wall time
+    of the in-process headline path (a probe model carrying a fixed
+    GEMM, ~10 ms/request, so the denominator is the compute-bound
+    shape the <=2% always-on budget is defined against).  The unary
+    path adds exactly one commit per request, so overhead_pct =
+    100 * commit_s / request_s.  A/B arming runs were tried first and
+    rejected: the true delta (~0.05%) drowns in multi-percent BLAS and
+    scheduler noise, so a paired-run estimate is dominated by the sign
+    of the noise (tests/test_prof.py asserts the same bound the same
+    way)."""
+    import numpy as np
+
+    from client_tpu.serve.model_runtime import InferenceEngine
+    from client_tpu.serve import Model, TensorSpec
+    from client_tpu.utils import to_wire_bytes
+
+    work = np.ones((384, 384), np.float32) * 1e-3
+
+    def fn(inputs, params, ctx):
+        acc = work
+        for _ in range(6):
+            acc = acc @ work
+        return {"OUT": inputs["IN"] + acc[0, 0]}
+
+    engine = InferenceEngine(models=[Model(
+        "prof_probe",
+        inputs=[TensorSpec("IN", "FP32", [-1, 8])],
+        outputs=[TensorSpec("OUT", "FP32", [-1, 8])],
+        fn=fn,
+    )])
+    try:
+        arr = np.zeros((1, 8), np.float32)
+        raw = to_wire_bytes(arr, "FP32")
+        request = {
+            "id": "",
+            "inputs": [{
+                "name": "IN", "datatype": "FP32", "shape": [1, 8],
+                "parameters": {"binary_data_size": len(raw)},
+            }],
+            "outputs": [{"name": "OUT", "parameters": {"binary_data": True}}],
+        }
+
+        def run():
+            for _ in range(requests):
+                engine.execute("prof_probe", "", dict(request), raw)
+
+        run()  # warm the execute path (imports, BLAS threads, ring)
+        request_s = min(_timed(run), _timed(run)) / requests
+
+        prof = engine.prof
+        phases = {"host": 2e-5, "compute": 9e-3, "render": 1e-5}
+        t0 = time.perf_counter()
+        for _ in range(commit_iters):
+            prof.commit("unary", 9.1e-3, phases=phases,
+                        model="prof_probe", items=1, flops_per_item=1e6)
+        commit_s = (time.perf_counter() - t0) / commit_iters
+        return round(100.0 * commit_s / request_s, 2)
+    finally:
+        engine.close()
 
 
 def _measure_link():
@@ -748,12 +865,18 @@ def _run_lm_inproc(n_streams=8, max_tokens=32):
                         break
                     total += 1
         batched_rate = total / (time.perf_counter() - t0)
+        # the scheduler IS the lm attribution workload for the prof
+        # block: the served lm headline (lm_streaming_int8) decodes via
+        # tfm.generate with no scheduler, so its engine never ticks —
+        # this LmEngine's rollup is the real decode timeline
+        lm_prof = sched.prof.rollup(window_s=0)
     finally:
         sched.close()
     return {
         "lm_inproc_serial_tokens_per_sec": round(serial_rate, 1),
         "lm_inproc_batched_tokens_per_sec": round(batched_rate, 1),
         "lm_inproc_streams": n_streams,
+        "lm_prof_rollup": lm_prof,
     }
 
 
@@ -1373,9 +1496,16 @@ def main():
             lambda: server.engine.slo.check_now()
             if server.engine.slo is not None else {},
         ) or {}
+        # the continuous profiler's whole-run rollup (serve/prof.py):
+        # the unary/batched engine, the LM scheduler (adopted through
+        # the model binder) and the wire frontends, scraped before stop
+        prof_report = attempt(
+            "prof", lambda: server.engine.prof.report(window_s=0)
+        ) or {}
     finally:
         server.stop()
     lm_inproc = attempt("lm_inproc", _run_lm_inproc) or {}
+    lm_prof_rollup = lm_inproc.pop("lm_prof_rollup", None)
     lm_prefix = attempt("lm_prefix", _run_lm_prefix) or {}
     fleet_prefix = attempt("fleet_prefix", _run_fleet_prefix) or {}
     fleet_failover = attempt(
@@ -1390,7 +1520,7 @@ def main():
     # number stays alongside as sp_* for r1-r3 comparability.
     headline = tpu_nw if tpu_nw else tpu
     image_bytes = 3 * IMAGE_SIZE * IMAGE_SIZE * 4
-    peak_tflops = _chip_peak_tflops()
+    peak_tflops, peak_kind = _chip_peak_tflops()
     cnn_flops = cnn_flops_per_image(IMAGE_SIZE)
     rn_flops = resnet50_flops_per_image(IMAGE_SIZE)
     prev = _prev_bench()
@@ -1429,6 +1559,10 @@ def main():
         # MFU — that is the honest statement; resnet50_* below carries the
         # compute-bound story.
         "chip_peak_bf16_tflops": peak_tflops,
+        # "tpu" = advertised chip peak (MFU is a chip-efficiency claim);
+        # "cpu_fallback" = measured host GEMM peak (MFU is an
+        # attribution ratio) — see _chip_peak_tflops
+        "peak_kind": peak_kind,
         "mfu_pct": _mfu_pct(headline["infer_per_sec"], cnn_flops, peak_tflops),
         "model_tflops": round(
             headline["infer_per_sec"] * cnn_flops / 1e12, 3
@@ -1648,6 +1782,14 @@ def main():
     # the server's ctpu_slo_* figures recorded per round; a capacity key
     # regressing past tolerance vs the prior BENCH file fails the run
     # loudly, the way the lint ratchet fails on new findings.
+    # Continuous-profiler attribution (ROADMAP observability item): where
+    # the round's time went — dispatch/compute/host/idle shares for the
+    # cnn224 headline engine, the LM scheduler and the wire frontends —
+    # with the measured cost of leaving the profiler armed.
+    prof_overhead = attempt("prof_overhead", _measure_prof_overhead)
+    result["prof"] = _prof_block(
+        prof_report, prof_overhead, peak_kind, lm_rollup=lm_prof_rollup
+    )
     result["slo"] = _slo_block(result, slo_series)
     gate = _slo_gate(result, prev)
     result["slo_gate"] = gate

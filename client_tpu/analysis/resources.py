@@ -83,7 +83,11 @@ __all__ = [
 # pair in the repo, everywhere.
 
 _TRACERISH_RE = re.compile(r"(?i)tracer")
-_SPAN_START_METHODS = {"start_span", "begin_span", "start_timer"}
+# start_tick: the continuous profiler's tick handles (serve/prof.py)
+# follow the same bracket discipline as trace spans — an unfinished
+# tick is a hole in the attribution timeline.
+_SPAN_START_METHODS = {"start_span", "begin_span", "start_timer",
+                       "start_tick"}
 _SPAN_FINISH_METHODS = {"complete", "finish", "close", "end", "stop"}
 _REFCOUNT_NAME_RE = re.compile(
     r"(^|_)(refs?|ref_?counts?)$", re.IGNORECASE

@@ -21,7 +21,15 @@ import time
 
 import numpy as np
 
+from client_tpu.serve.prof import PhaseProfiler
 from client_tpu.utils import InferenceServerException
+
+# Client-side wire accounting (serve/prof.py): every backend commits a
+# tick per request — build/serialize, wait (the whole server round
+# trip), deserialize — so the perf harness can attribute its own side
+# of the link; perf/metrics_manager folds the resulting ctpu_prof_*
+# series and profview renders them next to the server's.
+CLIENT_PROF = PhaseProfiler(name="perf_client")
 
 
 class BackendKind:
@@ -147,19 +155,21 @@ class _GrpcBackend(ClientBackend):
     def infer(self, model_name, inputs, outputs=None, request_id="",
               sequence_id=0, sequence_start=False, sequence_end=False,
               model_version="", priority=0, timeout_us=None, headers=None):
-        return self._client.infer(
-            model_name,
-            inputs,
-            model_version=model_version,
-            outputs=outputs,
-            request_id=request_id,
-            sequence_id=sequence_id,
-            sequence_start=sequence_start,
-            sequence_end=sequence_end,
-            priority=priority,
-            client_timeout=(timeout_us / 1e6) if timeout_us else None,
-            headers=headers,
-        )
+        with CLIENT_PROF.start_tick("grpc_client") as ptick:
+            with ptick.phase("wait"):  # serialize+rtt+parse live in the lib
+                return self._client.infer(
+                    model_name,
+                    inputs,
+                    model_version=model_version,
+                    outputs=outputs,
+                    request_id=request_id,
+                    sequence_id=sequence_id,
+                    sequence_start=sequence_start,
+                    sequence_end=sequence_end,
+                    priority=priority,
+                    client_timeout=(timeout_us / 1e6) if timeout_us else None,
+                    headers=headers,
+                )
 
     def statistics(self, model_name="", model_version=""):
         return self._client.get_inference_statistics(
@@ -250,19 +260,21 @@ class _HttpBackend(_GrpcBackend):
     def infer(self, model_name, inputs, outputs=None, request_id="",
               sequence_id=0, sequence_start=False, sequence_end=False,
               model_version="", priority=0, timeout_us=None, headers=None):
-        return self._client.infer(
-            model_name,
-            inputs,
-            model_version=model_version,
-            outputs=outputs,
-            request_id=request_id,
-            sequence_id=sequence_id,
-            sequence_start=sequence_start,
-            sequence_end=sequence_end,
-            priority=priority,
-            timeout=int(timeout_us) if timeout_us else None,
-            headers=headers,
-        )
+        with CLIENT_PROF.start_tick("http_client") as ptick:
+            with ptick.phase("wait"):  # serialize+rtt+parse live in the lib
+                return self._client.infer(
+                    model_name,
+                    inputs,
+                    model_version=model_version,
+                    outputs=outputs,
+                    request_id=request_id,
+                    sequence_id=sequence_id,
+                    sequence_start=sequence_start,
+                    sequence_end=sequence_end,
+                    priority=priority,
+                    timeout=int(timeout_us) if timeout_us else None,
+                    headers=headers,
+                )
 
     def statistics(self, model_name="", model_version=""):
         return self._client.get_inference_statistics(model_name, model_version)
@@ -329,6 +341,8 @@ class _InprocessBackend(ClientBackend):
     def infer(self, model_name, inputs, outputs=None, request_id="",
               sequence_id=0, sequence_start=False, sequence_end=False,
               model_version="", priority=0, timeout_us=None, headers=None):
+        ptick = CLIENT_PROF.start_tick("inprocess")
+        t_mark = time.perf_counter()
         request = {"id": request_id, "inputs": []}
         if sequence_id:
             request["parameters"] = {
@@ -357,13 +371,22 @@ class _InprocessBackend(ClientBackend):
                 for o in outputs
             ]
         tenant = (headers or {}).get("x-tenant-id", "")
-        result = self._engine.execute(
-            model_name, model_version, request, binary, tenant=tenant
-        )
-        if not isinstance(result, tuple):  # decoupled stream (generator/list)
-            return [_EngineResult(r, b) for r, b in result]
-        response, blobs = result
-        return _EngineResult(response, blobs)
+        try:
+            ptick.add("serialize", time.perf_counter() - t_mark)
+            t_mark = time.perf_counter()
+            result = self._engine.execute(
+                model_name, model_version, request, binary, tenant=tenant
+            )
+            ptick.add("wait", time.perf_counter() - t_mark)
+            if not isinstance(result, tuple):  # decoupled (generator/list)
+                return [_EngineResult(r, b) for r, b in result]
+            response, blobs = result
+            t_mark = time.perf_counter()
+            view = _EngineResult(response, blobs)
+            ptick.add("deserialize", time.perf_counter() - t_mark)
+            return view
+        finally:
+            CLIENT_PROF.finish(ptick)
 
     def statistics(self, model_name="", model_version=""):
         return self._engine.statistics(model_name, model_version)

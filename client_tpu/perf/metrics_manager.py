@@ -232,7 +232,7 @@ class MetricsManager:
     # drop every series added after it was written (which is exactly
     # what happened to ctpu_lm_*/ctpu_fleet_* until this audit).
     SERIES_PREFIXES = ("ctpu_lm_", "ctpu_fleet_", "ctpu_slo_",
-                      "ctpu_flight_")
+                      "ctpu_flight_", "ctpu_prof_")
 
     @staticmethod
     def summarize(snapshots, gauges=("ctpu_tpu_memory_used_bytes",

@@ -246,11 +246,13 @@ class ModelBatcher:
     single padded forward pass and splits the host-materialized outputs."""
 
     def __init__(self, model, stats, max_queue_delay_s=0.003, busy=None,
-                 pipeline_depth=4, max_queue_depth=None, registry=None):
+                 pipeline_depth=4, max_queue_depth=None, registry=None,
+                 prof=None):
         self.model = model
         self.stats = stats
         self._busy = busy  # engine BusyTracker (duty-cycle metric), optional
         self._registry = registry  # engine metrics Registry (shed counters)
+        self.prof = prof  # engine PhaseProfiler: one "batch" tick per group
         self.max_batch = max(int(model.max_batch_size), 1)
         self.max_queue_delay_s = max_queue_delay_s
         # Admission control: requests beyond this queue depth are shed with
@@ -698,6 +700,28 @@ class ModelBatcher:
                 group, first, rows, self._max_arity(first)
             )
 
+    def _prof_commit(self, rows, t0, t_in, infer_ns, output_ns):
+        """Fold one completed group into the engine's continuous
+        profiler (serve/prof.py) as a "batch" tick, reusing the
+        timestamps record_batched already took.  Queue wait is omitted:
+        it overlaps other groups' device time, so summing it would
+        double-count the wall."""
+        prof = self.prof
+        if prof is None:
+            return
+        prof.commit(
+            "batch",
+            (t_in - t0 + infer_ns + output_ns) / 1e9,
+            phases={
+                "host": (t_in - t0) / 1e9,
+                "compute": infer_ns / 1e9,
+                "render": output_ns / 1e9,
+            },
+            model=self.model.name,
+            items=rows,
+            flops_per_item=self.model.flops_per_item,
+        )
+
     def _dispatch(self, group):
         """Host-concat the group, pad to a power-of-two bucket, and issue the
         (asynchronous) forward.  Returns state for _complete, or None if the
@@ -821,6 +845,7 @@ class ModelBatcher:
                 queue_ns=sum(t_in - p.t_enq for p in group),
                 queue_ns_each=[t_in - p.t_enq for p in group],
             )
+            self._prof_commit(rows, t0, t_in, t1 - t_in, 0)
             return watch
         except Exception as e:  # noqa: BLE001 - failure propagates per-request
             self._fail(group, e)
@@ -868,6 +893,7 @@ class ModelBatcher:
                 queue_ns=queue_ns,
                 queue_ns_each=[t_in - p.t_enq for p in group],
             )
+            self._prof_commit(rows, t0, t_in, t_inf - t_in, t1 - t_inf)
         except Exception as e:  # noqa: BLE001 - failure propagates per-request
             if busy_open:
                 self._busy.end()  # device_get raised before the span closed

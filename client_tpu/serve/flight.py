@@ -39,11 +39,12 @@ class FlightRecorder:
     """Bounded ring of observability events with JSON-lines dumps."""
 
     def __init__(self, capacity=4096, dump_dir=None, registry=None,
-                 name=""):
+                 name="", prof=None):
         self.capacity = int(capacity)
         self.dump_dir = dump_dir
         self.registry = registry
         self.name = str(name)  # distinguishes replicas sharing a dir
+        self.prof = prof  # PhaseProfiler whose ticks ride along in dumps
         self._lock = threading.Lock()
         self._ring = collections.deque(maxlen=self.capacity)
         self._dump_seq = 0
@@ -93,6 +94,20 @@ class FlightRecorder:
             json.dumps(r, separators=(",", ":"), default=str)
             for r in records
         )
+        if self.prof is not None:
+            # the last N tick profiles ride along so a postmortem sees
+            # where time was going right before the anomaly
+            try:
+                for record in self.prof.recent(last=32):
+                    tagged = dict(record)
+                    tagged["tick_kind"] = tagged.pop("kind", None)
+                    tagged["kind"] = "prof_tick"
+                    lines.append(
+                        json.dumps(tagged, separators=(",", ":"),
+                                   default=str)
+                    )
+            except Exception:
+                pass  # profiling must never break a dump
         return "\n".join(lines) + "\n"
 
     def _dir(self):

@@ -6,6 +6,7 @@ form, including bidirectional ModelStreamInfer with decoupled (N-response)
 model support — the transport the LLM token-streaming configs use.
 """
 
+import time
 from concurrent import futures
 
 import grpc
@@ -404,12 +405,19 @@ class _Handlers:
         trace = self._sample_trace(request, context)
         if trace is not None:
             trace.event("REQUEST_START")
+        # wire-path profiling (serve/prof.py): proto-decode / execute-
+        # wait / proto-encode splits committed as one "grpc" tick
+        ptick = self.engine.wire_prof.start_tick("grpc")
         try:
+            t_mark = time.perf_counter()
             req, binary = _request_to_dict(request)
+            ptick.add("deserialize", time.perf_counter() - t_mark)
+            t_mark = time.perf_counter()
             result = self.engine.execute(
                 request.model_name, request.model_version, req, binary,
                 trace=trace, tenant=_tenant_of(context),
             )
+            ptick.add("wait", time.perf_counter() - t_mark)
             if not isinstance(result, tuple):  # list/generator = decoupled
                 if hasattr(result, "close"):
                     result.close()  # release its in-flight admission slot
@@ -419,9 +427,11 @@ class _Handlers:
                     status="400",
                 )
             response_json, blobs = result
+            t_mark = time.perf_counter()
             response = _dict_to_response(
                 request.model_name, request.model_version, response_json, blobs
             )
+            ptick.add("serialize", time.perf_counter() - t_mark)
             if trace is not None:
                 trace.event("RESPONSE_SENT")
             return response
@@ -430,6 +440,7 @@ class _Handlers:
                 trace.error = str(e)
             _abort(context, e)
         finally:
+            self.engine.wire_prof.finish(ptick)
             if trace is not None:
                 self.engine.tracer.complete(trace)
 

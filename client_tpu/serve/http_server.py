@@ -12,6 +12,7 @@ import json
 import math
 import re
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, unquote
 
@@ -167,6 +168,17 @@ class _Handler(BaseHTTPRequestHandler):
                 200, body.encode("utf-8"),
                 {"Content-Type": "application/jsonl"},
             )
+        if path == "/v2/debug/prof":
+            # continuous profiler's windowed rollup (serve/prof.py):
+            # per-phase attribution, tick counts, per-model MFU /
+            # compute share for this engine and every adopted child.
+            # ?window=SECONDS bounds the rollup (0 = whole ring).
+            query = parse_qs((self.path.split("?", 1) + [""])[1])
+            try:
+                window = float(query.get("window", [""])[-1])
+            except ValueError:
+                window = None
+            return self._send_json(eng.prof.report(window_s=window))
         if path == "/v2/debug/slo":
             slo = eng.slo
             return self._send_json(slo.check_now() if slo is not None else {})
@@ -303,10 +315,16 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _infer(self, model, version):
         body = self._post_body
+        # wire-path profiling (serve/prof.py): deserialize / execute-wait
+        # / serialize / send splits, committed as one "http" tick so the
+        # idle-link question becomes a ranked table
+        ptick = self.engine.wire_prof.start_tick("http")
+        t_mark = time.perf_counter()
         header_length = self.headers.get("Inference-Header-Content-Length")
         request, binary = _codec.parse_infer_request_body(
             body, int(header_length) if header_length is not None else None
         )
+        ptick.add("deserialize", time.perf_counter() - t_mark)
         # request tracing: joins the client's trace id when the request
         # carries a W3C traceparent header (see serve/tracing.py)
         trace = self.engine.tracer.sample(
@@ -319,6 +337,7 @@ class _Handler(BaseHTTPRequestHandler):
         if trace is not None:
             trace.event("REQUEST_START")
         try:
+            t_mark = time.perf_counter()
             result = self.engine.execute(
                 model, version, request, binary, trace=trace, tenant=tenant
             )
@@ -333,7 +352,9 @@ class _Handler(BaseHTTPRequestHandler):
                         status="400",
                     )
                 result = responses[0]
+            ptick.add("wait", time.perf_counter() - t_mark)
             response_json, blobs = result
+            t_mark = time.perf_counter()
             body, json_size = _codec.build_infer_response_body(
                 response_json, blobs
             )
@@ -346,7 +367,10 @@ class _Handler(BaseHTTPRequestHandler):
                     body = _codec.compress(body, algo)
                     headers["Content-Encoding"] = algo
                     break
+            ptick.add("serialize", time.perf_counter() - t_mark)
+            t_mark = time.perf_counter()
             self._send(200, body, headers)
+            ptick.add("send", time.perf_counter() - t_mark)
             if trace is not None:
                 trace.event("RESPONSE_SENT")
         except Exception as e:
@@ -354,6 +378,7 @@ class _Handler(BaseHTTPRequestHandler):
                 trace.error = str(e)
             raise
         finally:
+            self.engine.wire_prof.finish(ptick)
             if trace is not None:
                 self.engine.tracer.complete(trace)
 

@@ -80,8 +80,10 @@ from client_tpu.serve.models.transformer import (
     _mm,
     _rms_norm,
     _rope,
+    lm_flops_per_token,
     paged_attention,
 )
+from client_tpu.serve.prof import NULL_TICK, PhaseProfiler
 
 # sentinel object closing a stream's token queue
 _CLOSE = object()
@@ -389,6 +391,16 @@ class LmEngine:
         self._inflight = deque()
         self._thread = None  # started lazily on the first submit
 
+        # continuous profiler (serve/prof.py): each scheduler pass is one
+        # tick with schedule/dispatch/device-wait/delivery phase spans;
+        # the model binder rebinds the registry and adopts this profiler
+        # into the server's, so /v2/debug/prof and flight dumps cover
+        # the LM engine too.  _ptick is the scheduler thread's current
+        # tick — only that thread ever touches it.
+        self.prof = PhaseProfiler(name="lm", registry=registry)
+        self._ptick = NULL_TICK
+        self._flops_per_token = lm_flops_per_token(cfg)
+
         # prefix cache + preemption state
         self._prefix_enabled = bool(prefix_cache)
         self.min_prefix_blocks = int(min_prefix_blocks)
@@ -485,6 +497,7 @@ class LmEngine:
             kv = self.kv
         if kv is not None:
             kv.set_registry(registry)
+        self.prof.set_registry(registry)
 
     def set_fleet(self, fleet):
         """Late-bind the cross-replica prefix tier (add_model wiring):
@@ -1310,10 +1323,14 @@ class LmEngine:
         if tracer is not None:
             tracer.tick_span(kind, t0, t1)
 
-    def _drain_one(self):
+    def _drain_one(self, ptick=NULL_TICK):
         tokens_dev, snapshot = self._inflight.popleft()
-        vals = np.asarray(tokens_dev).reshape(-1)
-        with self._cv:
+        with ptick.phase("device_wait"):
+            # the host-side materialization is where async dispatch pays:
+            # this np.asarray blocks until the tick's device work lands
+            vals = np.asarray(tokens_dev).reshape(-1)
+        delivered = 0
+        with ptick.phase("deliver"), self._cv:
             for slot_idx, gen in snapshot:
                 lane = self._lanes[slot_idx]
                 if not lane.active or lane.gen != gen:
@@ -1326,6 +1343,7 @@ class LmEngine:
                 lane.queue.put(token)
                 lane.produced += 1
                 lane.tokens.append(token)  # recompute-replay history
+                delivered += 1
                 if self.registry is not None:
                     self.registry.inc(
                         "ctpu_lm_tokens_total",
@@ -1337,6 +1355,8 @@ class LmEngine:
                 )
                 if done:
                     self._retire_lane_locked(lane)
+        if delivered:
+            ptick.compute("lm", delivered, self._flops_per_token)
 
     # -- preemption / swap -------------------------------------------------
 
@@ -1565,32 +1585,66 @@ class LmEngine:
 
     def _loop_inner(self):
         while True:
-            if self._preempt is not None:
-                self._preempt_step()  # device copies outside _cv
-            if self._swapped:
-                self._resume_step()
-            self._admit()  # takes/releases _cv itself; no dispatch inside
-            worked = False
-            if self._job is not None:
-                self._prefill_step()  # ONE chunk, outside _cv
-                worked = True
-            ticked = self._decode_pass()  # ONE decode tick, outside _cv
-            worked = worked or ticked
-            with self._cv:
-                if self._closed:
-                    break
-            while len(self._inflight) > (self.depth if ticked else 0):
-                self._drain_one()
-            if not worked and not self._inflight:
-                with self._cv:
-                    if self._closed:
-                        break
-                    # swapped streams deliberately DON'T block the wait:
-                    # an unresumable one (blocks pinned) retries on the
-                    # 50ms tick instead of busy-spinning the loop
-                    if (not self._queued_locked()
-                            and self._job is None
-                            and not any(l.active for l in self._lanes)):
-                        self._cv.wait(timeout=0.05)
+            # every pass is one profiler tick; finish-in-finally is the
+            # bracket shape the SPAN-LEAK lint demands, so a pass that
+            # dies still commits the phases it measured before wedging
+            tick = self.prof.start_tick("sched")
+            self._ptick = tick
+            try:
+                alive = self._loop_pass(tick)
+            finally:
+                self._ptick = NULL_TICK
+                self.prof.finish(tick)
+            if not alive:
+                break
         # shutdown: drop the in-flight tail (queues already closed)
         self._inflight.clear()
+
+    def _loop_pass(self, ptick):
+        """One scheduler pass (the former _loop_inner body); returns
+        False when the engine closed and the loop must stop."""
+        if self._preempt is not None:
+            with ptick.phase("preempt"):
+                self._preempt_step()  # device copies outside _cv
+        if self._swapped:
+            with ptick.phase("resume"):
+                self._resume_step()
+        with ptick.phase("schedule"):
+            self._admit()  # takes/releases _cv itself; no dispatch inside
+        worked = False
+        if self._job is not None:
+            with ptick.phase("prefill_dispatch"):
+                self._prefill_step()  # ONE chunk, outside _cv
+            ptick.relabel("prefill")
+            worked = True
+        with ptick.phase("decode_dispatch"):
+            ticked = self._decode_pass()  # ONE decode tick, outside _cv
+        if ticked:
+            ptick.relabel("decode")
+        worked = worked or ticked
+        with self._cv:
+            if self._closed:
+                return False
+        while len(self._inflight) > (self.depth if ticked else 0):
+            self._drain_one(ptick)
+        if not worked and not self._inflight:
+            with self._cv:
+                if self._closed:
+                    return False
+                # swapped streams deliberately DON'T block the wait:
+                # an unresumable one (blocks pinned) retries on the
+                # 50ms tick instead of busy-spinning the loop
+                if (not self._queued_locked()
+                        and self._job is None
+                        and not any(l.active for l in self._lanes)):
+                    ptick.relabel("idle")
+                    with ptick.phase("idle"):
+                        self._cv.wait_for(
+                            lambda: (self._closed
+                                     or self._job is not None
+                                     or self._queued_locked()
+                                     or any(l.active
+                                            for l in self._lanes)),
+                            timeout=0.05,
+                        )
+        return True

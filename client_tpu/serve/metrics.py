@@ -144,6 +144,22 @@ FLEET_HELP = {
         "Durable sequence steps refused (503) for unreachable quorum",
 }
 
+# Continuous-profiler series (written by serve/prof.py's PhaseProfiler
+# into whichever registry the profiler is bound to; engine label is the
+# profiler name — "serve" for the unary engine, "lm" for an LM
+# scheduler, "perf_client" for the perf harness's client-side splits).
+PROF_HELP = {
+    "ctpu_prof_ticks_total":
+        "Profiler ticks committed (by engine and tick kind)",
+    "ctpu_prof_phase_seconds_total":
+        "Cumulative seconds attributed to each profiled phase",
+    "ctpu_prof_mfu_pct":
+        "Model FLOP utilization over measured device time (vs "
+        "device_peak_tflops; cpu_fallback peak off-TPU)",
+    "ctpu_prof_compute_share_pct":
+        "Share of measured device time attributed to each model",
+}
+
 # Autoscaler control-loop series (written by serve/autoscale.py into the
 # registry it is constructed with).
 AUTOSCALE_HELP = {

@@ -37,6 +37,7 @@ from client_tpu.serve.metrics import (
     Registry,
 )
 from client_tpu.serve.flight import FlightRecorder
+from client_tpu.serve.prof import PhaseProfiler
 from client_tpu.serve.tracing import (
     TRACE_SETTING_DEFAULTS,
     Tracer,
@@ -917,6 +918,19 @@ class InferenceEngine:
             registry=self.metrics
         )
         self.tracer.on_complete = self.flight.note_span
+        # Continuous profiler (serve/prof.py): always-on per-tick phase
+        # timings + MFU attribution.  The unary execute path commits its
+        # pre-measured splits here; LM schedulers keep their own
+        # profiler and are adopted through Model.binder so
+        # /v2/debug/prof and flight dumps cover every engine.
+        self.prof = PhaseProfiler(name="serve", registry=self.metrics)
+        # the frontends' wire-path ticks (deserialize/wait/serialize/
+        # send) keep their own ring: their "wait" phase CONTAINS the
+        # engine's execute ticks, so sharing a ring would double-count
+        self.wire_prof = PhaseProfiler(name="wire", registry=self.metrics)
+        self.prof.adopt(self.wire_prof)
+        if self.flight.prof is None:
+            self.flight.prof = self.prof
         # SLO watchdog (serve/slo.py): streaming latency quantile
         # sketches per (model, tenant), ctpu_slo_* gauges, breach counter
         # + flight dump.  slo=None builds the observation-only default;
@@ -1655,6 +1669,19 @@ class InferenceEngine:
                     True, t1 - t0, work_ns, t_in1 - t_in0, t1 - t_inf1,
                     batch=_batch_of(model, request),
                 )
+                # the profiler reuses the timestamps stats already took:
+                # zero added clocks on the hot path
+                self.prof.commit(
+                    "ensemble", (t1 - t0) / 1e9,
+                    phases={
+                        "host": (t_in1 - t_in0) / 1e9,
+                        "compute": work_ns / 1e9,
+                        "render": (t1 - t_inf1) / 1e9,
+                    },
+                    model=model.name,
+                    items=_batch_of(model, request),
+                    flops_per_item=model.flops_per_item,
+                )
                 return rendered
             if _batchable_request(model, inputs, params, context, request):
                 # The batcher records execution-level statistics (and the
@@ -1712,6 +1739,19 @@ class InferenceEngine:
             stats.record(
                 True, t1 - t0, t_inf1 - t_in1, t_in1 - t_in0, t1 - t_inf1,
                 batch=_batch_of(model, request),
+            )
+            # pre-measured splits (same timestamps stats used) fold into
+            # the continuous profiler without touching another clock
+            self.prof.commit(
+                "unary", (t1 - t0) / 1e9,
+                phases={
+                    "host": (t_in1 - t_in0) / 1e9,
+                    "compute": (t_inf1 - t_in1) / 1e9,
+                    "render": (t1 - t_inf1) / 1e9,
+                },
+                model=model.name,
+                items=_batch_of(model, request),
+                flops_per_item=model.flops_per_item,
             )
             if context is not None:
                 # applied-step accounting + durable snapshot replication
@@ -1839,6 +1879,7 @@ class InferenceEngine:
                     busy=self.busy,
                     max_queue_depth=model.max_queue_depth,
                     registry=self.metrics,
+                    prof=self.prof,
                 )
                 self._batchers[model.name] = batcher
             return batcher

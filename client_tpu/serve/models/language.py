@@ -231,6 +231,10 @@ def lm_streaming_batched_model(name="lm_streaming_batched", runner=None,
         sched.set_registry(engine.metrics)
         sched.tracer = engine.tracer
         sched.flight = getattr(engine, "flight", None)
+        if getattr(engine, "prof", None) is not None:
+            # the scheduler's per-tick profiler joins the server's so
+            # /v2/debug/prof and flight dumps cover the LM engine
+            engine.prof.adopt(sched.prof)
         if engine.qos is not None:
             sched.tenant_lane_share = engine.qos.lane_share
             sched.tenant_priority = engine.qos.priority

@@ -22,3 +22,11 @@ def time_tick(metrics, fn):
     result = fn()
     metrics.observe("tick_result", result)
     return result
+
+
+def profile_pass(prof, sched):
+    ptick = prof.start_tick("sched")  # BAD: finish not on the raise path
+    ptick.add("schedule", sched.admit())
+    alive = sched.step()
+    prof.finish(ptick)  # never runs when step() raises
+    return alive

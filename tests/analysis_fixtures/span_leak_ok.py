@@ -29,3 +29,16 @@ def sample_for_caller(tracer, model):
 def sample_and_delegate(tracer, engine, request):
     trace = tracer.sample(request.model)  # OK: handed to the engine,
     engine.execute(request, trace=trace)  # which owns completion
+
+
+def profile_pass(prof, sched):
+    ptick = prof.start_tick("sched")  # OK: finished in finally
+    try:
+        return sched.step()
+    finally:
+        prof.finish(ptick)
+
+
+def profile_request(prof, engine, request):
+    with prof.start_tick("unary"):  # OK: the handle closes itself
+        return engine.execute(request)

@@ -236,14 +236,16 @@ def test_bg_thread_crash_clean():
 
 def test_span_leak_hits():
     """The leaked-span shapes (the tracing brackets' invariant): a
-    sampled span completed on the happy path only, and a started timer
-    never finished at all."""
+    sampled span completed on the happy path only, a started timer
+    never finished at all, and a profiler tick whose finish sits on
+    the happy path only."""
     findings = _scan("span_leak_bad.py")
     assert _rules_hit(findings) == ["SPAN-LEAK"]
-    assert len(findings) == 2
+    assert len(findings) == 3
     messages = " ".join(f.message for f in findings)
     assert "outside any finally" in messages
     assert "never finishes" in messages
+    assert "ptick" in messages
 
 
 def test_span_leak_clean():

@@ -72,3 +72,43 @@ def test_slo_block_zeroes_qps_on_missed_objective(bench, monkeypatch):
     block = bench._slo_block({"value": 500.0, "p99_ms": 50.0}, {"m|": {}})
     assert block["slo_qps_under_p99"] == 500.0
     assert block["slo_series"] == {"m|": {}}
+
+
+def test_link_drift_floor_blocks_the_escape_hatch(bench):
+    """Sub-millisecond baseline RTTs turn microsecond jitter into huge
+    drift percentages — below the 1 ms floor the drift escape hatch
+    stays shut and real regressions still fail the gate."""
+    gate = bench._slo_gate(
+        {"value": 100.0, "mp_link_drift_pct": 143.7, "link_rtt_ms": 0.1},
+        {"value": 200.0},
+    )
+    assert not gate["pass"]
+    assert gate["drift_floor_applied"]
+    assert not gate["skipped"]
+    assert gate["regressions"][0]["key"] == "value"
+
+
+def test_link_drift_above_floor_still_skips(bench):
+    gate = bench._slo_gate(
+        {"value": 100.0, "mp_link_drift_pct": -22.0, "link_rtt_ms": 8.0},
+        {"value": 200.0},
+    )
+    assert gate["pass"]
+    assert "value" in gate["skipped"]
+    assert not gate["drift_floor_applied"]
+
+
+def test_prof_block_attributes_only_ticked_engines(bench):
+    split = {"compute_pct": 60.0, "dispatch_pct": 10.0,
+             "host_pct": 25.0, "idle_pct": 5.0}
+    report = {"engines": [
+        {"engine": "serve", "ticks": 12, "attribution": split},
+        {"engine": "lm", "ticks": 0, "attribution": None},
+    ]}
+    block = bench._prof_block(report, 0.4, "cpu_fallback")
+    assert block["cnn224"] == split
+    assert block["lm"] is None          # no ticks -> no made-up split
+    assert block["wire"] is None
+    assert block["prof_overhead_pct"] == 0.4
+    assert block["peak_kind"] == "cpu_fallback"
+    assert abs(sum(split.values()) - 100.0) < 0.5
