@@ -125,6 +125,9 @@ _SLO_GATE_KEYS = (
     "ensemble_infer_per_sec",
     "lm_tokens_per_sec",
     "lm_batched_tokens_per_sec",
+    # speculative-decoding headline (r09+): _slo_gate skips keys the
+    # prior round lacks, so this records in r09 and ratchets from r10
+    "lm_spec_tokens_per_sec",
     "slo_qps_under_p99",
 )
 
@@ -983,6 +986,58 @@ def _run_lm_prefix(prompts=24, prompt_len=64, share=0.8, max_tokens=4,
     return result
 
 
+def _run_lm_spec(warm_tokens=96, timed_tokens=160):
+    """Speculative-decoding headline, in-process on the engine at
+    batch 1 (the latency configuration speculation exists for).
+
+    A repetitive greedy prompt (the n-gram drafter's home turf: output
+    echoes input) runs through two single-lane engines — spec off vs
+    spec on (k=4, prompt-lookup drafter) — and the tokens/s ratio is
+    ``lm_spec_speedup_x``, with the measured draft-acceptance rate
+    alongside so a speedup regression can be attributed (drafter miss
+    vs verify overhead).  The warm submit generates enough tokens to
+    compile EVERY verify width (k=4 -> widths 2/4/5, each a distinct
+    XLA program, seconds apiece on CPU) plus the decode tick before the
+    clock starts; without that the timed run eats the compiles and the
+    comparison is meaningless."""
+    from client_tpu.serve.lm import LmEngine
+    from client_tpu.serve.models.language import _LmRunner, encode_text
+
+    base = _LmRunner()  # float weights, like _run_lm_prefix
+    params, cfg = base.params, base.cfg
+    prompt = encode_text("the quick brown fox jumps over the lazy dog; " * 3)
+
+    def run(spec):
+        eng = LmEngine(params, cfg, max_slots=1, lane_counts=(1,),
+                       readback_depth=8, speculative=spec)
+        try:
+            warm_q, _ = eng.submit(prompt, warm_tokens)
+            while warm_q.get(timeout=600) is not LmEngine.CLOSE:
+                pass
+            total = 0
+            t0 = time.perf_counter()
+            q, _ = eng.submit(prompt, timed_tokens)
+            while q.get(timeout=600) is not LmEngine.CLOSE:
+                total += 1
+            elapsed = time.perf_counter() - t0
+            stats = eng.spec_stats()
+        finally:
+            eng.close()
+        return total / elapsed, stats
+
+    plain_rate, _ = run(None)
+    spec_rate, stats = run({"k": 4, "drafter": "ngram"})
+    return {
+        "lm_spec_tokens_per_sec": round(spec_rate, 1),
+        "lm_spec_plain_tokens_per_sec": round(plain_rate, 1),
+        "lm_spec_speedup_x": round(spec_rate / plain_rate, 2)
+        if plain_rate else None,
+        "lm_spec_acceptance_pct": round(
+            100.0 * stats.get("acceptance_rate", 0.0), 1
+        ),
+    }
+
+
 def _run_fleet_prefix(prompts=12, prompt_len=64, share=0.75, max_tokens=2):
     """Fleet cache-tier headline: the same shared-prefix workload split
     across TWO replicas, with and without the cross-replica prefix tier
@@ -1507,6 +1562,7 @@ def main():
     lm_inproc = attempt("lm_inproc", _run_lm_inproc) or {}
     lm_prof_rollup = lm_inproc.pop("lm_prof_rollup", None)
     lm_prefix = attempt("lm_prefix", _run_lm_prefix) or {}
+    lm_spec = attempt("lm_spec", _run_lm_spec) or {}
     fleet_prefix = attempt("fleet_prefix", _run_fleet_prefix) or {}
     fleet_failover = attempt(
         "fleet_seq_failover", _run_fleet_seq_failover
@@ -1745,6 +1801,7 @@ def main():
         **lm_batched,
         **lm_inproc,
         **lm_prefix,
+        **lm_spec,
         **fleet_prefix,
         **fleet_failover,
         **fleet_autoscale,

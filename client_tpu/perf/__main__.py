@@ -149,6 +149,18 @@ def build_parser():
     p.add_argument("--prefix-prompts", type=int, default=16,
                    help="distinct prompts generated for --prefix-share "
                         "(workers rotate over them)")
+    p.add_argument("--speculative", type=int, default=None, metavar="K",
+                   help="LM engine knob (requires --hermetic): enable "
+                        "speculative decoding with up to K draft tokens "
+                        "per verify tick on the batched LM engines; the "
+                        "summary/CSV/JSON gain per-sweep "
+                        "spec_acceptance_pct + spec tokens/s from the "
+                        "engine's counters")
+    p.add_argument("--drafter", choices=["ngram", "bigram"],
+                   default="ngram",
+                   help="drafter for --speculative: 'ngram' "
+                        "(prompt-lookup) or 'bigram' (static greedy-"
+                        "bigram table seeded from the prompt)")
     p.add_argument("--tenants", default=None,
                    help="tenant mix for the worker slots: "
                         "'gold:3,bronze:1' assigns slots to tenants "
@@ -354,6 +366,13 @@ def main(argv=None):
         name, _, dims = item.partition(":")
         shape_overrides[name] = [int(d) for d in dims.split(",")]
 
+    if args.speculative is not None:
+        if args.speculative < 1:
+            sys.exit("error: --speculative K must be >= 1")
+        if not args.hermetic:
+            sys.exit("error: --speculative configures the in-process LM "
+                     "engine; add --hermetic")
+
     engine = None
     fake = None
     backend_kwargs = {}
@@ -402,8 +421,11 @@ def main(argv=None):
             from client_tpu.serve.frontdoor import ResponseCache
 
             cache = ResponseCache(max_entries=args.hermetic_cache_entries)
+        speculative = None
+        if args.speculative is not None:
+            speculative = {"k": args.speculative, "drafter": args.drafter}
         engine = InferenceEngine(  # no sockets
-            model_sets(args.hermetic_models),
+            model_sets(args.hermetic_models, speculative=speculative),
             response_cache=cache,
             coalescing=args.hermetic_cache_entries > 0,
         )
@@ -739,6 +761,24 @@ def main(argv=None):
                 }
 
             profiler.prefix_probe = _prefix_probe
+
+        if args.speculative is not None and engine is not None:
+            # same in-process counter-delta scheme as the prefix probe:
+            # per-sweep acceptance comes from the engine registry, not a
+            # scrape (delivered = accepted + one correction per verify)
+            spec_registry = engine.metrics
+
+            def _spec_probe():
+                def count(name):
+                    return int(spec_registry.get(name) or 0)
+
+                return {
+                    "proposed": count("ctpu_lm_spec_proposed_tokens_total"),
+                    "accepted": count("ctpu_lm_spec_accepted_tokens_total"),
+                    "lm_tokens": count("ctpu_lm_tokens_total"),
+                }
+
+            profiler.spec_probe = _spec_probe
 
         json_extra = {}
         try:

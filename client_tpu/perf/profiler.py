@@ -50,6 +50,10 @@ class PerfStatus:
         # ({"prefix_hit_pct", "prefill_tokens_saved_pct", raw deltas};
         # empty when no prefix probe is wired)
         self.lm_prefix = {}
+        # --speculative sweeps: this level's draft/verify outcome
+        # ({"spec_acceptance_pct", "spec_tokens_per_sec", raw deltas};
+        # empty when no spec probe is wired)
+        self.lm_spec = {}
 
     def latency_us(self, percentile=None):
         if percentile is None:
@@ -116,6 +120,9 @@ class InferenceProfiler:
         # wired by the CLI for --prefix-share runs so every sweep level
         # reports its hit rate and prefill savings as a counter DELTA
         self.prefix_probe = None
+        # --speculative analogue ({proposed, accepted, lm_tokens}); per
+        # level the delta yields acceptance rate and decode tokens/s
+        self.spec_probe = None
 
     # -- one window ----------------------------------------------------------
 
@@ -247,9 +254,16 @@ class InferenceProfiler:
         before_prefix = (
             self.prefix_probe() if self.prefix_probe is not None else None
         )
+        before_spec = (
+            self.spec_probe() if self.spec_probe is not None else None
+        )
+        t0 = time.monotonic()
         status = self._profile_level_windows(label, value)
+        elapsed_s = time.monotonic() - t0
         if before_prefix is not None:
             status.lm_prefix = self._prefix_delta(before_prefix)
+        if before_spec is not None:
+            status.lm_spec = self._spec_delta(before_spec, elapsed_s)
         return status
 
     def _prefix_delta(self, before):
@@ -267,6 +281,24 @@ class InferenceProfiler:
             "prefill_tokens_saved_pct": (
                 round(100.0 * delta.get("saved_tokens", 0) / prefilled, 2)
                 if prefilled else 0.0
+            ),
+            **delta,
+        }
+
+    def _spec_delta(self, before, elapsed_s):
+        after = self.spec_probe()
+        delta = {k: after.get(k, 0) - before.get(k, 0) for k in after}
+        proposed = delta.get("proposed", 0)
+        return {
+            "spec_acceptance_pct": (
+                round(100.0 * delta.get("accepted", 0) / proposed, 2)
+                if proposed else 0.0
+            ),
+            # delivered LM tokens over the level's wall clock: the
+            # speedup readout a spec-on vs spec-off A/B divides
+            "spec_tokens_per_sec": (
+                round(delta.get("lm_tokens", 0) / elapsed_s, 1)
+                if elapsed_s > 0 else 0.0
             ),
             **delta,
         }

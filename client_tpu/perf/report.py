@@ -63,6 +63,12 @@ def print_summary(results, percentile=None):
                 f"{s.lm_prefix['prefill_tokens_saved_pct']:.1f}% prefill "
                 "tokens saved"
             )
+        if s.lm_spec:
+            print(
+                f"    speculative: {s.lm_spec['spec_acceptance_pct']:.1f}% "
+                "draft acceptance, "
+                f"{s.lm_spec['spec_tokens_per_sec']:.1f} LM tokens/s"
+            )
         if s.overhead_pct:
             print(f"    harness overhead: {s.overhead_pct:.1f}% of slot time")
         if s.server_stats:
@@ -128,6 +134,10 @@ def write_csv(path, results, verbose=False):
     has_prefix = any(s.lm_prefix for s in results)
     if has_prefix:
         fields += ["Prefix Hit %", "Prefill Tokens Saved %"]
+    # --speculative sweeps: the per-level draft/verify outcome
+    has_spec = any(s.lm_spec for s in results)
+    if has_spec:
+        fields += ["Spec Acceptance %", "LM Tokens/Second"]
     # ensemble targets: one queue/compute column pair per composing model
     # (the reference appends per-composing columns the same way)
     composing = sorted({n for s in results for n in s.ensemble_stats})
@@ -170,6 +180,12 @@ def write_csv(path, results, verbose=False):
                      f"{s.lm_prefix['prefill_tokens_saved_pct']:.2f}"]
                     if s.lm_prefix else ["", ""]
                 )
+            if has_spec:
+                row += (
+                    [f"{s.lm_spec['spec_acceptance_pct']:.2f}",
+                     f"{s.lm_spec['spec_tokens_per_sec']:.1f}"]
+                    if s.lm_spec else ["", ""]
+                )
             for name in composing:
                 counters = s.ensemble_stats.get(name)
                 if not counters:
@@ -211,6 +227,7 @@ def status_record(s):
         "server_stats": s.server_stats,
         "ensemble_stats": s.ensemble_stats,
         "lm_prefix": s.lm_prefix,
+        "lm_spec": s.lm_spec,
     }
 
 

@@ -69,12 +69,15 @@ from jax import lax
 from client_tpu.serve.lm.kv import KvBlockPool
 from client_tpu.serve.lm.policy import (
     LaneAutoscaler,
+    bucket_for,
     chunk_plan,
     geometric_buckets,
     pad_prompt,
+    verify_widths,
 )
 from client_tpu.serve.lm.prefix import PrefixCache
-from client_tpu.serve.metrics import FLEET_HELP, LM_PREFIX_HELP
+from client_tpu.serve.lm.spec import LaneSpec, SpecConfig
+from client_tpu.serve.metrics import FLEET_HELP, LM_PREFIX_HELP, LM_SPEC_HELP
 from client_tpu.serve.models.transformer import (
     _ffn_block,
     _mm,
@@ -160,6 +163,129 @@ def _decode_tick(params, tokens_full, pool_k, pool_v, tables, lens,
     return tokens_out, pool_k, pool_v, keys_out
 
 
+def _accept_lane(logits, props, count, temp, top_k, keys, *, width):
+    """One lane's speculative acceptance rule on device.
+
+    ``logits`` [w, V] are the target model's scores at positions
+    ``length .. length + w - 1`` (position j scores the token FOLLOWING
+    ``seq[j]``), ``props`` [w - 1] the drafted tokens (``props[j]`` is
+    the proposal for what position j generates), ``count`` how many are
+    real, ``keys`` [w + 1, 2] this lane's per-position RNG subkeys.
+
+    Greedy lanes (temperature 0) accept a draft iff it equals the
+    argmax — the accepted prefix + the argmax correction reconstructs
+    plain greedy decode byte-exactly.  Temperature lanes run rejection
+    sampling for a point-mass proposal: accept draft ``x`` with
+    probability ``p(x)`` under the lane's filtered/tempered target
+    distribution (the exact `_select_token` distribution), and on
+    rejection sample the correction from the residual (``p`` with
+    ``x``'s mass removed, renormalized) — the delivered tokens are an
+    exact draw from the target distribution.  When every draft is
+    accepted the correction is a free "bonus" sample from the last
+    position's full distribution.
+
+    Returns (n_accepted, correction_token).
+    """
+    w = width
+    vocab = logits.shape[-1]
+    greedy = jnp.argmax(logits, axis=-1)  # [w]
+    kmax = min(_TOPK_CAP, vocab)
+    vals = lax.top_k(logits, kmax)[0]
+    thresh = vals[:, jnp.clip(top_k - 1, 0, kmax - 1)]
+    keep = (top_k <= 0) | (logits >= thresh[:, None])
+    scaled = jnp.where(keep, logits, -jnp.inf) / jnp.maximum(temp, 1e-6)
+    probs = jax.nn.softmax(scaled, axis=-1)  # [w, V] target distribution
+    j = jnp.arange(w - 1)
+    p_draft = probs[j, props]
+    u = jax.vmap(jax.random.uniform)(keys[:w - 1])
+    accept = jnp.where(temp > 0.0, u < p_draft, props == greedy[:w - 1])
+    # longest accepted prefix of the REAL drafts (cumprod stops at the
+    # first rejection; padding past ``count`` never counts)
+    chain = jnp.cumprod(
+        jnp.where(j < count, accept, False).astype(jnp.int32)
+    )
+    n_acc = jnp.sum(chain).astype(jnp.int32)
+    rejected = n_acc < count
+    rej_tok = props[jnp.minimum(n_acc, w - 2)]
+    corr_scaled = jnp.where(
+        rejected & (jnp.arange(vocab) == rej_tok), -jnp.inf,
+        scaled[n_acc],
+    )
+    sampled = jax.random.categorical(keys[w - 1], corr_scaled)
+    corr = jnp.where(temp > 0.0, sampled, greedy[n_acc])
+    return n_acc, corr.astype(jnp.int32)
+
+
+def _verify_tick(params, tokens_full, pool_k, pool_v, tables, lens,
+                 temps, topks, keys_full, props, counts, *, cfg, n,
+                 width, block_size):
+    """One speculative verify step over the first ``n`` lanes: embed the
+    pending input token plus up to ``width - 1`` drafted tokens per lane
+    and score all of them in ONE multi-position paged-attention pass
+    (``paged_attention`` already handles [n, T] query positions — this
+    is ``_decode_tick`` generalized from T = 1 to T = width).
+
+    K/V for every drafted position scatters into the lane's own block
+    reservation as it is computed (position ``lens + j`` attends only
+    positions ``<= lens + j``, all of which this tick or history wrote),
+    so accepted positions need no second write.  Positions past the
+    lane's draft count write to the trash block (the prefill padding
+    trick); positions past the ACCEPTED prefix hold garbage the length
+    mask never reads — the host advances ``lane.length`` only to the
+    accepted end, and the next tick overwrites from there.  Rejection
+    therefore "rewinds" by pointer arithmetic alone: no block ever
+    leaves the lane's reservation, so nothing can leak.
+
+    Returns ``(out, tokens_out, pool_k, pool_v, keys_out)`` where
+    ``out`` is ``[2, n]`` (accepted count, correction token) — one
+    host readback for the whole tick.  ``n`` and ``width`` are static:
+    executables stay ``<= len(verify_widths) * len(lane_counts)``.
+    """
+    pool_k = list(pool_k)
+    pool_v = list(pool_v)
+    w = width
+    seq = jnp.concatenate([tokens_full[:n, None], props], axis=1)  # [n,w]
+    x = jnp.take(params["embed"], seq, axis=0)  # [n,w,D]
+    pos = lens[:, None] + jnp.arange(w)[None, :]  # [n,w]
+    writable = jnp.arange(w)[None, :] <= counts[:, None]
+    hd = cfg.head_dim
+    col = jnp.minimum(pos // block_size, tables.shape[1] - 1)
+    blk = jnp.where(
+        writable, jnp.take_along_axis(tables, col, axis=1),
+        KvBlockPool.TRASH,
+    )
+    slot = pos % block_size
+    for i, layer in enumerate(params["layers"]):
+        h = _rms_norm(x, layer["ln_attn"])
+        q = _mm(h, layer["attn"]["wq"]).reshape(n, w, cfg.n_heads, hd)
+        k = _mm(h, layer["attn"]["wk"]).reshape(n, w, cfg.n_kv_heads, hd)
+        v = _mm(h, layer["attn"]["wv"]).reshape(n, w, cfg.n_kv_heads, hd)
+        q = _rope(q, pos, cfg.rope_theta)
+        k = _rope(k, pos, cfg.rope_theta)
+        pool_k[i] = pool_k[i].at[blk, slot].set(k)
+        pool_v[i] = pool_v[i].at[blk, slot].set(v)
+        attn = paged_attention(
+            q, pool_k[i], pool_v[i], tables, pos, cfg, block_size
+        )
+        out = _mm(
+            attn.reshape(n, w, cfg.n_heads * hd), layer["attn"]["wo"]
+        )
+        x = x + out.astype(x.dtype)
+        x, _ = _ffn_block(layer, x, cfg)
+    x = _rms_norm(x, params["ln_f"])
+    logits = _mm(x, params["lm_head"]).astype(jnp.float32)  # [n,w,V]
+    keys = jax.vmap(functools.partial(jax.random.split, num=w + 1))(
+        keys_full[:n]
+    )  # [n, w+1, 2]: w-1 accept draws, 1 correction sample, 1 carry
+    n_acc, corr = jax.vmap(
+        functools.partial(_accept_lane, width=w)
+    )(logits, props, counts, temps, topks, keys)
+    tokens_out = tokens_full.at[:n].set(corr)
+    keys_out = keys_full.at[:n].set(keys[:, w])
+    out = jnp.stack([n_acc, corr])  # [2, n]: one readback per tick
+    return out, tokens_out, pool_k, pool_v, keys_out
+
+
 def _prefill_chunk(params, chunk, pool_k, pool_v, table, start,
                    prompt_len, key, temperature, top_k, *, cfg,
                    block_size):
@@ -218,7 +344,7 @@ def _adopt(tokens, keys, slot, tok, key):
 class _Lane:
     __slots__ = ("gen", "active", "queue", "remaining", "produced",
                  "length", "limit", "tenant", "temperature", "top_k",
-                 "table", "blocks", "prompt", "tokens", "handle")
+                 "table", "blocks", "prompt", "tokens", "handle", "spec")
 
     def __init__(self, table_width):
         self.gen = 0        # bumped on every (re)assignment and cancel
@@ -236,6 +362,7 @@ class _Lane:
         self.prompt = None  # [1, T] prompt row (prefix-cache insertion)
         self.tokens = []    # delivered generation tokens (recompute replay)
         self.handle = None  # the submit() handle streaming on this lane
+        self.spec = None    # LaneSpec when speculative decoding is on
 
 
 class _Handle:
@@ -344,7 +471,8 @@ class LmEngine:
                  tenant_lane_share=0.75, scale_up_after=3,
                  scale_down_after=50, tick_log_len=8192,
                  prefix_cache=True, min_prefix_blocks=1,
-                 tenant_priority=None, swap_block_limit=None, fleet=None):
+                 tenant_priority=None, swap_block_limit=None, fleet=None,
+                 speculative=None):
         self.params = params
         self.cfg = cfg
         self.max_slots = int(max_slots)
@@ -446,6 +574,18 @@ class LmEngine:
         self._adopt = jax.jit(_adopt)
         self._tick_jits = {}
 
+        # speculative decoding (serve/lm/spec.py; off by default): the
+        # drafter + adaptive-k policy is per-model config, the verify
+        # widths a fixed geometric set so the verify executable count is
+        # provably <= len(_verify_widths) * len(lane_counts)
+        self._spec = SpecConfig.parse(speculative)
+        self._verify_widths = (
+            verify_widths(self._spec.k) if self._spec is not None else ()
+        )
+        self._verify_jits = {}
+        self._spec_proposed = 0
+        self._spec_accepted = 0
+
     # -- executable accounting (the bounded-compile proofs) ---------------
 
     def prefill_executables(self):
@@ -463,6 +603,30 @@ class LmEngine:
             size = getattr(fn, "_cache_size", None)
             total += size() if callable(size) else 1
         return total
+
+    def verify_executables(self):
+        """Compiled speculative-verify executable count
+        (<= len(verify_widths(k)) * len(lane_counts) by construction)."""
+        with self._cv:  # the scheduler inserts into _verify_jits mid-run
+            fns = list(self._verify_jits.values())
+        total = 0
+        for fn in fns:
+            size = getattr(fn, "_cache_size", None)
+            total += size() if callable(size) else 1
+        return total
+
+    def spec_stats(self):
+        """Speculative-decoding counters ({} when speculation is off)."""
+        with self._cv:
+            if self._spec is None:
+                return {}
+            prop, acc = self._spec_proposed, self._spec_accepted
+            return {
+                "proposed": prop,
+                "accepted": acc,
+                "rejected": prop - acc,
+                "acceptance_rate": round(acc / max(prop, 1), 4),
+            }
 
     def tick_trace(self):
         """Recent per-tick records ({kind, t0, t1, lanes, n_lanes}) —
@@ -679,6 +843,7 @@ class LmEngine:
         prompt, lane.prompt = lane.prompt, None
         lane.tokens = []
         lane.handle = None
+        lane.spec = None
         blocks, lane.blocks = lane.blocks, None
         if blocks:
             self._release_blocks_locked(prompt, written, blocks)
@@ -856,6 +1021,13 @@ class LmEngine:
         lane.prompt = entry.prompt
         lane.tokens = list(entry.tokens)
         lane.handle = entry.handle
+        # drafter state rebuilds from the prompt; the adaptive-k window
+        # restarts (a resume is rare — one extra window to re-disable an
+        # adversarial lane is noise)
+        lane.spec = (
+            LaneSpec(self._spec, entry.prompt[0])
+            if self._spec is not None else None
+        )
         if entry.handle is not None:
             entry.handle.placed = (slot, lane.gen)
         self._resume_ms.append((time.monotonic() - entry.t_swap) * 1e3)
@@ -1132,6 +1304,10 @@ class LmEngine:
                 lane.prompt = handle.prompt
                 lane.tokens = []
                 lane.handle = handle
+                lane.spec = (
+                    LaneSpec(self._spec, handle.prompt[0])
+                    if self._spec is not None else None
+                )
                 handle.placed = (job.slot, lane.gen)
                 if self.prefix is not None:
                     # the prompt's full blocks are fully written as of
@@ -1311,6 +1487,233 @@ class LmEngine:
         self._inflight.append((self._tokens, tuple(active)))
         self._log_tick("decode", t0, tuple(i for i, _ in active))
         return True
+
+    def _verify_for(self, n, w):
+        # memoized under _cv exactly like _tick_for: jit here only
+        # CONSTRUCTS the callable, tracing happens at dispatch outside
+        # the lock
+        with self._cv:
+            fn = self._verify_jits.get((n, w))
+            if fn is None:
+                fn = jax.jit(
+                    functools.partial(
+                        _verify_tick, cfg=self.cfg, n=n, width=w,
+                        block_size=self.block_size,
+                    ),
+                    donate_argnums=self._donate,
+                )
+                self._verify_jits[(n, w)] = fn
+        return fn
+
+    def _spec_pass(self, ptick):
+        """One speculative draft + verify pass over the active lanes;
+        True when a verify tick ran, False to fall through to the plain
+        decode tick.
+
+        The fall-through IS the never-slower path: a lane whose adaptive
+        k backed off to 0 skips drafting, and when NO lane drafts the
+        pass returns before touching the readback pipeline — the engine
+        then runs the exact plain-decode code (dispatch-ahead included),
+        paying only this method's host-side enabled check.
+
+        The verify tick is SYNCHRONOUS (no dispatch-ahead): how far a
+        lane advances depends on its accepted count, which the host
+        learns only at readback.  The in-flight pipeline is drained
+        before drafting so each lane's host history is complete
+        (``lane.tokens[-1]`` == the device-side next input token — the
+        same consistency point ``_preempt_step`` establishes), and
+        because verify never spans a pass boundary, a preemption, swap
+        or cancel can never observe a half-applied verify: the
+        swap/recompute byte-exactness argument is unchanged.
+        """
+        with self._cv:
+            if self._closed:
+                return False
+            n = self._scaler.n_lanes
+            want = False
+            for i in range(n):
+                lane = self._lanes[i]
+                if (not lane.active or lane.spec is None
+                        or lane.length >= lane.limit):
+                    continue
+                room = min(lane.limit - 1 - lane.length,
+                           lane.remaining - lane.produced - 1)
+                if lane.spec.k > 0 and room > 0:
+                    want = True
+                else:
+                    lane.spec.note_plain()  # re-probe timer while k == 0
+            if not want:
+                return False
+        while self._inflight:
+            self._drain_one(ptick)
+        cands = []
+        with self._cv:
+            if self._closed:
+                return False
+            for i in range(n):
+                lane = self._lanes[i]
+                if (not lane.active or lane.spec is None
+                        or lane.length >= lane.limit or not lane.tokens):
+                    continue
+                room = min(lane.limit - 1 - lane.length,
+                           lane.remaining - lane.produced - 1)
+                if lane.spec.k <= 0 or room <= 0:
+                    continue
+                hist = np.concatenate([
+                    lane.prompt[0], np.asarray(lane.tokens, np.int32),
+                ])
+                cands.append((i, lane.gen, lane.spec, hist, room))
+        if not cands:
+            return False
+        # drafting is pure host work, outside the lock; its own phase +
+        # tick-span so profview prices draft against verify and decode
+        t_draft = time.monotonic()
+        proposals = {}
+        with ptick.phase("draft"):
+            for i, gen, lane_spec, hist, room in cands:
+                toks = lane_spec.draft(hist)[:room]
+                if toks:
+                    proposals[i] = (gen, toks)
+        if not proposals:
+            return False
+        self._log_tick("draft", t_draft, tuple(sorted(proposals)))
+        with self._cv:
+            if self._closed:
+                return False
+            active = [
+                (i, self._lanes[i].gen)
+                for i in range(n)
+                if self._lanes[i].active
+                and self._lanes[i].length < self._lanes[i].limit
+            ]
+            if not active:
+                return False
+            included = {i for i, _ in active}
+            # gen-checked: a lane cancelled while drafting drops its
+            # proposal; other active lanes ride the tick as plain decode
+            # (count 0 — they deliver exactly one token)
+            drafts = {
+                i: toks for i, (gen, toks) in proposals.items()
+                if i in included and self._lanes[i].gen == gen
+            }
+            if not drafts:
+                return False
+            max_d = max(len(toks) for toks in drafts.values())
+            w = bucket_for(max_d + 1, self._verify_widths)
+            props = np.zeros((n, w - 1), np.int32)
+            counts = np.zeros((n,), np.int32)
+            for i, toks in drafts.items():
+                d = min(len(toks), w - 1)
+                props[i, :d] = toks[:d]
+                counts[i] = d
+            trash_row = np.zeros((self._table_width,), np.int32)
+            tables = np.stack([
+                self._lanes[i].table if i in included else trash_row
+                for i in range(n)
+            ])
+            lens = np.array(
+                [self._lanes[i].length if i in included else 0
+                 for i in range(n)], np.int32,
+            )
+            temps = np.array(
+                [self._lanes[i].temperature for i in range(n)], np.float32
+            )
+            topks = np.array(
+                [self._lanes[i].top_k for i in range(n)], np.int32
+            )
+            self._lane_gauges_locked(active_count=len(active))
+        t0 = time.monotonic()
+        fn = self._verify_for(n, w)
+        with ptick.phase("verify_dispatch"):
+            out, self._tokens, pool_k, pool_v, self._keys = fn(
+                self.params, self._tokens, self.kv.pools["k"],
+                self.kv.pools["v"], jnp.asarray(tables),
+                jnp.asarray(lens), jnp.asarray(temps),
+                jnp.asarray(topks), self._keys, jnp.asarray(props),
+                jnp.asarray(counts),
+            )
+            self.kv.pools["k"] = pool_k
+            self.kv.pools["v"] = pool_v
+        with ptick.phase("device_wait"):
+            vals = np.asarray(out)  # [2, n]: accepted count, correction
+        self._log_tick("verify", t0, tuple(i for i, _ in active))
+        self._deliver_verified(ptick, active, vals, props, counts)
+        return True
+
+    def _deliver_verified(self, ptick, active, vals, props, counts):
+        """Stream one verify tick's accepted drafts + correction token
+        per lane and advance the per-lane length/budget/adaptive-k
+        bookkeeping (under _cv; the tick already completed on device)."""
+        delivered = 0
+        proposed = accepted = 0
+        with ptick.phase("deliver"), self._cv:
+            for slot_idx, gen in active:
+                lane = self._lanes[slot_idx]
+                if not lane.active or lane.gen != gen:
+                    continue  # cancelled since dispatch: stale tick
+                d = int(counts[slot_idx])
+                acc = min(int(vals[0, slot_idx]), d)
+                toks = [int(t) for t in props[slot_idx, :acc]]
+                toks.append(int(vals[1, slot_idx]))
+                if lane.spec is not None:
+                    if d:
+                        lane.spec.note(d, acc)
+                    else:
+                        lane.spec.note_plain()
+                proposed += d
+                accepted += acc
+                for token in toks:
+                    lane.queue.put(token)
+                    lane.produced += 1
+                    lane.tokens.append(token)
+                    # the tick wrote K/V for this token's position; the
+                    # first garbage (rejected) position becomes the next
+                    # tick's write position — the rewind is this pointer
+                    lane.length += 1
+                    delivered += 1
+                    if self.registry is not None:
+                        self.registry.inc(
+                            "ctpu_lm_tokens_total",
+                            help_="Tokens streamed by the LM engine",
+                        )
+                    if (lane.produced >= lane.remaining
+                            or (self.eos_id is not None
+                                and token == self.eos_id)):
+                        self._retire_lane_locked(lane)
+                        break
+            self._spec_proposed += proposed
+            self._spec_accepted += accepted
+            if proposed and self.registry is not None:
+                self.registry.inc(
+                    "ctpu_lm_spec_proposed_tokens_total", None,
+                    value=proposed,
+                    help_=LM_SPEC_HELP[
+                        "ctpu_lm_spec_proposed_tokens_total"],
+                )
+                if accepted:
+                    self.registry.inc(
+                        "ctpu_lm_spec_accepted_tokens_total", None,
+                        value=accepted,
+                        help_=LM_SPEC_HELP[
+                            "ctpu_lm_spec_accepted_tokens_total"],
+                    )
+                if proposed - accepted:
+                    self.registry.inc(
+                        "ctpu_lm_spec_rejected_tokens_total", None,
+                        value=proposed - accepted,
+                        help_=LM_SPEC_HELP[
+                            "ctpu_lm_spec_rejected_tokens_total"],
+                    )
+                self.registry.set(
+                    "ctpu_lm_spec_acceptance_rate", None,
+                    round(
+                        self._spec_accepted
+                        / max(self._spec_proposed, 1), 4,
+                    ),
+                    help_=LM_SPEC_HELP["ctpu_lm_spec_acceptance_rate"],
+                )
+        if delivered:
+            ptick.compute("lm", delivered, self._flops_per_token)
 
     def _log_tick(self, kind, t0, slots):
         t1 = time.monotonic()
@@ -1617,10 +2020,18 @@ class LmEngine:
                 self._prefill_step()  # ONE chunk, outside _cv
             ptick.relabel("prefill")
             worked = True
-        with ptick.phase("decode_dispatch"):
-            ticked = self._decode_pass()  # ONE decode tick, outside _cv
+        verified = False
+        if self._spec is not None:
+            # _spec_pass brackets its own phases (draft / verify_dispatch
+            # / device_wait / deliver); False falls through to the plain
+            # decode tick — the never-slower path
+            verified = self._spec_pass(ptick)
+        ticked = verified
+        if not ticked:
+            with ptick.phase("decode_dispatch"):
+                ticked = self._decode_pass()  # ONE decode tick, outside _cv
         if ticked:
-            ptick.relabel("decode")
+            ptick.relabel("verify" if verified else "decode")
         worked = worked or ticked
         with self._cv:
             if self._closed:

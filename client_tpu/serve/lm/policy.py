@@ -75,6 +75,18 @@ def chunk_plan(prompt_len, buckets, start=0):
     return [(s, chunk) for s in range(start, prompt_len, chunk)]
 
 
+def verify_widths(max_k, min_width=2):
+    """The speculative verify tick's fixed window widths: geometric from
+    ``min_width`` up to ``max_k + 1`` (k draft tokens + the pending input
+    token).  Same bounded-compile discipline as prefill bucketing — a
+    verify dispatch pads its draft count up to the next width, so the
+    verify executable set is provably
+    ``<= len(verify_widths(k)) * len(lane_counts)``."""
+    if max_k < 1:
+        raise ValueError("speculative k must be >= 1")
+    return geometric_buckets(min(min_width, max_k + 1), max_k + 1)
+
+
 class LaneAutoscaler:
     """Step the decode lane count through a small precompiled set.
 

@@ -29,7 +29,11 @@ observability surface:
   ``ctpu_lm_prefill_tokens_total`` / ``ctpu_lm_prefill_tokens_saved_total``
   (the perf/bench ``prefix_hit_pct`` numerators), and
   ``ctpu_lm_preemptions_total`` / ``ctpu_lm_swapped_blocks`` (lanes
-  swapped to the host store under priority pressure).
+  swapped to the host store under priority pressure), and the
+  **speculative decoding** series (:data:`LM_SPEC_HELP`):
+  ``ctpu_lm_spec_{proposed,accepted,rejected}_tokens_total`` +
+  ``ctpu_lm_spec_acceptance_rate`` — draft/verify outcomes when a model
+  enables ``speculative={...}``.
 
 Every label value passes through :func:`escape_label`: the exposition format
 reserves ``\\``, ``"`` and newline inside quoted label values, and a model
@@ -78,6 +82,22 @@ LM_PREFIX_HELP = {
         "Decode lanes preempted (KV swapped out) under priority pressure",
     "ctpu_lm_swapped_blocks":
         "KV blocks currently parked in the host-side swap store",
+}
+
+# Speculative-decoding series (written by serve/lm/engine.py's verify
+# pass when a model enables ``speculative={...}``; serve/lm/spec.py owns
+# the drafter/adaptive-k policy).  Acceptance rate is the cumulative
+# accepted/proposed ratio — the per-lane adaptive controller uses its
+# own rolling window.
+LM_SPEC_HELP = {
+    "ctpu_lm_spec_proposed_tokens_total":
+        "Draft tokens proposed to the speculative verify tick",
+    "ctpu_lm_spec_accepted_tokens_total":
+        "Draft tokens the verify tick accepted (target-model-exact)",
+    "ctpu_lm_spec_rejected_tokens_total":
+        "Draft tokens the verify tick rejected (KV rewound, not leaked)",
+    "ctpu_lm_spec_acceptance_rate":
+        "Cumulative speculative acceptance rate (accepted / proposed)",
 }
 
 # SLO watchdog + flight recorder series (written by serve/slo.py and

@@ -21,9 +21,9 @@ def resnet_models():
     return [resnet50_model()]
 
 
-def language_models():
+def language_models(speculative=None):
     from client_tpu.serve.models.language import language_models as _lm
-    return _lm()
+    return _lm(speculative=speculative)
 
 
 def pipeline_models(warmup=False):
@@ -40,16 +40,19 @@ def pipeline_models(warmup=False):
     )
 
 
-def model_sets(names):
+def model_sets(names, speculative=None):
     """Resolve a comma-separated set list
-    (builtin,jax,resnet,language,pipeline)."""
+    (builtin,jax,resnet,language,pipeline).  ``speculative`` (a
+    SpecConfig-shaped dict) applies to the ``language`` set's batched
+    engines only — perf's ``--speculative K --drafter ngram`` threads
+    through here."""
     from client_tpu.serve.builtins import default_models
 
     loaders = {
         "builtin": default_models,
         "jax": jax_models,
         "resnet": resnet_models,
-        "language": language_models,
+        "language": lambda: language_models(speculative=speculative),
         "pipeline": pipeline_models,
     }
     models = []

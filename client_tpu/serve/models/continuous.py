@@ -14,7 +14,11 @@ untouched:
   lm_streaming_batched_model plugs into — now with per-request
   temperature / top-k / seed (per-lane RNG inside the jitted tick
   removed the old "greedy only" 400) and a ``tenant`` identity that
-  feeds per-tenant decode-lane quotas.
+  feeds per-tenant decode-lane quotas.  Engine-level features arriving
+  after the split (speculative decoding via
+  ``lm_streaming_batched_model(speculative=...)``, prefix-cache
+  adoption, lane autoscaling) pass through this surface untouched:
+  they live below submit/cancel/stream.
 
 See ``client_tpu/serve/lm/`` for the engine internals and README
 "LLM serving / continuous batching" for the design.

@@ -186,7 +186,7 @@ def lm_streaming_model(name="lm_streaming", runner=None):
 
 def lm_streaming_batched_model(name="lm_streaming_batched", runner=None,
                                max_slots=8, response_cache=None,
-                               **engine_kwargs):
+                               speculative=None, **engine_kwargs):
     """Decoupled LM with CONTINUOUS BATCHING: concurrent streams share one
     batched decode tick per token step (serve/lm: paged KV cache, bucketed
     + chunked prefill, KV prefix caching, lane autoscaling), so aggregate
@@ -201,7 +201,13 @@ def lm_streaming_batched_model(name="lm_streaming_batched", runner=None,
     model's engine honors: ``{"prefix_cache": {"enable": bool,
     "min_prefix_blocks": int}}`` (the response-cache half is moot here —
     decoupled models never hit the unary response cache — but the block
-    rides the model config so operators read one policy surface)."""
+    rides the model config so operators read one policy surface).
+
+    ``speculative`` turns on speculative decoding for this model's
+    engine (off by default): ``{"k": 4, "drafter": "ngram", ...}`` —
+    see serve/lm/spec.py:SpecConfig for the full knob set.  Greedy
+    streams keep byte-exact output; temperature streams stay
+    distribution-exact via rejection sampling."""
     from client_tpu.serve.models.continuous import BatchedLmRunner
 
     prefix_knobs = dict((response_cache or {}).get("prefix_cache") or {})
@@ -211,6 +217,8 @@ def lm_streaming_batched_model(name="lm_streaming_batched", runner=None,
     if "min_prefix_blocks" in prefix_knobs:
         engine_kwargs.setdefault("min_prefix_blocks",
                                  int(prefix_knobs["min_prefix_blocks"]))
+    if speculative is not None:
+        engine_kwargs.setdefault("speculative", speculative)
     base = runner or _LmRunner()
     batched = BatchedLmRunner(
         base.params, base.cfg, max_slots=max_slots, eos_id=_EOS,
@@ -277,11 +285,23 @@ def text_ensemble_model(name="text_generator", runner=None):
     )
 
 
-def language_models(shared_runner=True):
+def language_models(shared_runner=True, speculative=None,
+                    int8_batched=None):
     """The full language set; one shared LM runner keeps params/compile warm.
 
     ``lm_streaming_int8`` serves the same architecture from int8-quantized
-    weights (weight-only; client_tpu.ops.quant).
+    weights (weight-only; client_tpu.ops.quant).  On TPU it serves through
+    the continuous-batching engine exactly like the float model (the int8
+    dequant-matmul is the same ``_mm`` dispatch the engine's jitted
+    tick/prefill/verify programs already route through); off-TPU the
+    Pallas kernel only runs in interpret mode — hundreds of ms per
+    dispatch, which would bury the engine's scheduling wins — so the
+    serial path stays the default there.  ``int8_batched`` overrides the
+    auto-detection either way.
+
+    ``speculative`` enables speculative decoding on the batched engines
+    (see :func:`lm_streaming_batched_model`); the perf CLI's
+    ``--speculative K --drafter ngram`` lands here.
     """
     runner = _LmRunner() if shared_runner else None
     # the int8 runner quantizes the SHARED weights (no second param init)
@@ -290,15 +310,22 @@ def language_models(shared_runner=True):
         params=runner.params if runner else None,
         quantize=True,
     )
+    if int8_batched is None:
+        int8_batched = jax.default_backend() == "tpu"
+    int8_model = (
+        lm_streaming_batched_model(
+            name="lm_streaming_int8", runner=int8_runner,
+            speculative=speculative,
+        )
+        if int8_batched else
+        lm_streaming_model(name="lm_streaming_int8", runner=int8_runner)
+    )
     return [
         tokenizer_model(),
         detokenizer_model(),
         lm_streaming_model(runner=runner),
-        lm_streaming_model(name="lm_streaming_int8", runner=int8_runner),
-        # the batched model serves the float weights: the continuous-
-        # batching engine's win is lane sharing, and the int8 kernel's
-        # off-TPU interpret mode is too slow to measure it (int8 serving
-        # stays available as lm_streaming_int8)
-        lm_streaming_batched_model(runner=runner),
+        int8_model,
+        lm_streaming_batched_model(runner=runner,
+                                   speculative=speculative),
         text_ensemble_model(runner=runner),
     ]

@@ -73,10 +73,10 @@ _TPU_PEAKS = (
 # where async TPU dispatch actually pays.
 ATTRIBUTION_GROUPS = {
     "compute": ("compute", "decode_dispatch", "prefill_dispatch",
-                "device_wait"),
+                "verify_dispatch", "device_wait"),
     "dispatch": ("schedule", "preempt", "resume", "execute"),
     "host": ("host", "render", "deliver", "sample", "serialize",
-             "deserialize", "send", "wait"),
+             "deserialize", "send", "wait", "draft"),
     "idle": ("idle",),
 }
 
